@@ -1,0 +1,64 @@
+"""Cluster-fixture builders for tests.
+
+The Python analog of the reference's util.BuildPod/BuildNode/BuildResourceList
+(pkg/scheduler/util/test_utils.go:30-93): construct ClusterInfo snapshots by
+hand, feed them to sessions/actions, and assert on the resulting bind maps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from volcano_tpu.api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo,
+                             Resource, TaskInfo, TaskStatus)
+
+
+def res(cpu=0, memory=0, **scalars) -> Resource:
+    rl = {}
+    if cpu:
+        rl["cpu"] = cpu
+    if memory:
+        rl["memory"] = memory
+    rl.update(scalars)
+    return Resource.from_resource_list(rl)
+
+
+def build_node(name: str, cpu="4", memory="8Gi", labels: Optional[Dict] = None,
+               max_pods: int = 110, **kw) -> NodeInfo:
+    allocatable = res(cpu=cpu, memory=memory,
+                      **kw.pop("scalars", {}))
+    return NodeInfo(name, allocatable=allocatable, labels=labels or {},
+                    max_pods=max_pods, **kw)
+
+
+def build_task(name: str, cpu="1", memory="1Gi", namespace="default",
+               status=TaskStatus.PENDING, node_name="", priority=0,
+               role="", **kw) -> TaskInfo:
+    t = TaskInfo(uid=f"{namespace}/{name}", name=name, namespace=namespace,
+                 resreq=res(cpu=cpu, memory=memory, **kw.pop("scalars", {})),
+                 status=status, priority=priority, task_role=role, **kw)
+    t.node_name = node_name
+    return t
+
+
+def build_job(uid: str, queue="default", min_available=1, priority=0,
+              namespace="default", **kw) -> JobInfo:
+    name = uid.split("/")[-1]
+    return JobInfo(uid=uid, name=name, namespace=namespace, queue=queue,
+                   priority=priority, min_available=min_available, **kw)
+
+
+def simple_cluster(n_nodes=2, node_cpu="4", node_mem="8Gi") -> ClusterInfo:
+    ci = ClusterInfo()
+    for i in range(n_nodes):
+        ci.add_node(build_node(f"n{i}", cpu=node_cpu, memory=node_mem))
+    ci.add_queue(QueueInfo("default", weight=1))
+    return ci
+
+
+def place_running(ci: ClusterInfo, job: JobInfo, task: TaskInfo,
+                  node: str) -> None:
+    """Attach a Running task to a job and account it on a node."""
+    task.status = TaskStatus.RUNNING
+    job.add_task(task)
+    ci.nodes[node].add_task(task)

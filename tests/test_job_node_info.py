@@ -1,0 +1,92 @@
+"""JobInfo/NodeInfo accounting tests — mirrors pkg/scheduler/api/job_info_test.go
+and node_info_test.go assertions (status index, gang readiness, node buckets)."""
+
+from volcano_tpu.api import JobInfo, NodeInfo, TaskStatus
+
+from fixtures import build_job, build_node, build_task, res
+
+
+class TestJobInfo:
+    def test_status_index_and_ready(self):
+        job = build_job("default/j1", min_available=2)
+        t1 = build_task("p1", status=TaskStatus.RUNNING)
+        t2 = build_task("p2", status=TaskStatus.PENDING)
+        job.add_task(t1)
+        job.add_task(t2)
+        assert job.ready_task_num() == 1
+        assert not job.is_ready()
+        job.update_task_status(t2, TaskStatus.ALLOCATED)
+        assert job.ready_task_num() == 2
+        assert job.is_ready()
+
+    def test_allocated_tracking(self):
+        job = build_job("default/j1")
+        t = build_task("p1", cpu="2", status=TaskStatus.PENDING)
+        job.add_task(t)
+        assert job.allocated.milli_cpu == 0
+        job.update_task_status(t, TaskStatus.ALLOCATED)
+        assert job.allocated.milli_cpu == 2000
+        job.update_task_status(t, TaskStatus.PENDING)
+        assert job.allocated.milli_cpu == 0
+
+    def test_pipelined_and_starving(self):
+        job = build_job("default/j1", min_available=2)
+        t1 = build_task("p1", status=TaskStatus.RUNNING)
+        t2 = build_task("p2", status=TaskStatus.PENDING)
+        job.add_task(t1)
+        job.add_task(t2)
+        assert job.is_starving()
+        job.update_task_status(t2, TaskStatus.PIPELINED)
+        assert job.is_pipelined()
+        assert not job.is_starving()
+
+    def test_valid_min_available(self):
+        job = build_job("default/j1", min_available=3)
+        for i in range(2):
+            job.add_task(build_task(f"p{i}"))
+        ok, reason = job.is_valid()
+        assert not ok and "minAvailable" in reason
+
+    def test_task_min_available_per_role(self):
+        job = build_job("default/j1", min_available=2,
+                        task_min_available={"ps": 1, "worker": 2})
+        job.add_task(build_task("ps-0", role="ps"))
+        job.add_task(build_task("w-0", role="worker"))
+        assert not job.check_task_min_available()
+        job.add_task(build_task("w-1", role="worker"))
+        assert job.check_task_min_available()
+
+    def test_clone_is_deep(self):
+        job = build_job("default/j1")
+        t = build_task("p1")
+        job.add_task(t)
+        c = job.clone()
+        c.update_task_status(c.tasks[t.uid], TaskStatus.ALLOCATED)
+        assert job.tasks[t.uid].status == TaskStatus.PENDING
+
+
+class TestNodeInfo:
+    def test_add_remove_task(self):
+        node = build_node("n1", cpu="4", memory="8Gi")
+        t = build_task("p1", cpu="1", memory="1Gi", status=TaskStatus.RUNNING)
+        node.add_task(t)
+        assert node.idle.milli_cpu == 3000
+        assert node.used.milli_cpu == 1000
+        node.remove_task(t)
+        assert node.idle.milli_cpu == 4000
+        assert node.used.milli_cpu == 0
+
+    def test_releasing_and_future_idle(self):
+        node = build_node("n1", cpu="4", memory="8Gi")
+        releasing = build_task("p1", cpu="2", status=TaskStatus.RELEASING)
+        pipelined = build_task("p2", cpu="1", status=TaskStatus.PIPELINED)
+        node.add_task(releasing)
+        node.add_task(pipelined)
+        # idle = 2, releasing = 2, pipelined = 1 -> future idle = 3
+        assert node.idle.milli_cpu == 2000
+        assert node.future_idle().milli_cpu == 3000
+
+    def test_pipelined_does_not_consume_idle(self):
+        node = build_node("n1", cpu="4")
+        node.add_task(build_task("p1", cpu="4", status=TaskStatus.PIPELINED))
+        assert node.idle.milli_cpu == 4000

@@ -1,0 +1,105 @@
+"""Resource algebra tests — mirrors the assertions of the reference's
+pkg/scheduler/api/resource_info_test.go:1-632."""
+
+import pytest
+
+from volcano_tpu.api import Resource
+from volcano_tpu.api.resource import parse_quantity
+
+
+def R(cpu=0, memory=0, **s):
+    rl = {}
+    if cpu:
+        rl["cpu"] = cpu
+    if memory:
+        rl["memory"] = memory
+    rl.update(s)
+    return Resource.from_resource_list(rl)
+
+
+class TestParseQuantity:
+    def test_cpu_millicores(self):
+        assert parse_quantity("100m", is_cpu=True) == 100
+        assert parse_quantity("2", is_cpu=True) == 2000
+        assert parse_quantity("1.5", is_cpu=True) == 1500
+
+    def test_memory_suffixes(self):
+        assert parse_quantity("1Ki") == 1024
+        assert parse_quantity("2Gi") == 2 * 2**30
+        assert parse_quantity("1G") == 1e9
+        assert parse_quantity("42") == 42
+
+
+class TestArithmetic:
+    def test_add(self):
+        a = R(cpu="1", memory="1Gi").add(R(cpu="2", memory="1Gi"))
+        assert a == R(cpu="3", memory="2Gi")
+
+    def test_sub(self):
+        a = R(cpu="3", memory="3Gi").sub(R(cpu="1", memory="1Gi"))
+        assert a == R(cpu="2", memory="2Gi")
+
+    def test_sub_underflow_raises(self):
+        with pytest.raises(ValueError):
+            R(cpu="1").sub(R(cpu="2"))
+
+    def test_multi(self):
+        assert R(cpu="1", memory="2Gi").multi(2) == R(cpu="2", memory="4Gi")
+
+    def test_set_max(self):
+        a = R(cpu="1", memory="4Gi").set_max_resource(R(cpu="2", memory="1Gi"))
+        assert a == R(cpu="2", memory="4Gi")
+
+    def test_min_dimension(self):
+        a = R(cpu="3", memory="1Gi").min_dimension_resource(R(cpu="1", memory="4Gi"))
+        assert a == R(cpu="1", memory="1Gi")
+
+    def test_scalar_resources(self):
+        a = R(cpu="1", **{"nvidia.com/gpu": "2"})
+        b = R(**{"nvidia.com/gpu": "1"})
+        assert a.clone().add(b).get("nvidia.com/gpu") == 3
+        assert b.less_equal(a)
+        assert not a.less_equal(b)
+
+
+class TestComparisons:
+    def test_less_equal_zero_semantics(self):
+        # missing dims on the left count as zero -> always <=
+        assert R().less_equal(R(cpu="1"))
+        assert R(cpu="1").less_equal(R(cpu="1"))
+        assert not R(cpu="2").less_equal(R(cpu="1"))
+        # scalar present on left only: right treated as zero
+        assert not R(**{"gpu": "1"}).less_equal(R(cpu="4"))
+
+    def test_less_equal_strict(self):
+        assert R(cpu="1").less_equal_strict(R(cpu="2", memory="1Gi"))
+        assert not R(**{"gpu": "0"}).less_equal_strict(R(cpu="4"))
+
+    def test_less_all_dims(self):
+        assert R(cpu="1", memory="1Gi").less(R(cpu="2", memory="2Gi"))
+        assert not R(cpu="1", memory="2Gi").less(R(cpu="2", memory="2Gi"))
+
+    def test_less_partly(self):
+        assert R(cpu="1", memory="2Gi").less_partly(R(cpu="2", memory="1Gi"))
+        assert not R(cpu="2", memory="2Gi").less_partly(R(cpu="1", memory="1Gi"))
+
+    def test_diff(self):
+        inc, dec = R(cpu="3", memory="1Gi").diff(R(cpu="1", memory="2Gi"))
+        assert inc == R(cpu="2")
+        assert dec == R(memory="1Gi")
+
+    def test_is_empty(self):
+        assert R().is_empty()
+        assert Resource({"cpu": 0.05}).is_empty()
+        assert not R(cpu="1").is_empty()
+
+    def test_fit_delta(self):
+        a = R(cpu="1").fit_delta(R(cpu="1"))
+        assert a.milli_cpu > 2000  # epsilon added
+
+
+class TestMaxTaskNum:
+    def test_pods_becomes_max_task_num(self):
+        r = Resource.from_resource_list({"cpu": "4", "pods": "110"})
+        assert r.max_task_num == 110
+        assert "pods" not in r.quantities

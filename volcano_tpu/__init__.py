@@ -1,0 +1,38 @@
+"""volcano_tpu — a TPU-native batch-scheduling framework.
+
+A ground-up re-design of the capabilities of Volcano (the CNCF Kubernetes batch
+scheduler, reference at /root/reference) for TPU execution: the per-cycle
+scheduling Session (snapshot -> predicates -> scoring -> placement -> gang
+commit) is a batched JAX/XLA array program instead of a goroutine fan-out.
+
+Layering (mirrors SURVEY.md section 1, re-designed TPU-first):
+
+- ``volcano_tpu.api``        — in-memory data model (Resource algebra, TaskInfo,
+                               JobInfo, NodeInfo, QueueInfo, ClusterInfo);
+                               reference: pkg/scheduler/api.
+- ``volcano_tpu.arrays``     — dense array schema + snapshot packing (the
+                               device-side mirror of cache.Snapshot);
+                               reference: pkg/scheduler/cache/cache.go:712.
+- ``volcano_tpu.ops``        — jittable kernels: feasibility masks, score
+                               terms, argmax selection, the allocate scan,
+                               fair-share solvers, victim selection.
+- ``volcano_tpu.plugins``    — policy plugins contributing kernel terms and
+                               ordering keys; reference: pkg/scheduler/plugins.
+- ``volcano_tpu.actions``    — the pass pipeline (enqueue, allocate, backfill,
+                               preempt, reclaim, elect, reserve);
+                               reference: pkg/scheduler/actions.
+- ``volcano_tpu.framework``  — Session/conf/registries gluing plugins into the
+                               compiled cycle; reference: pkg/scheduler/framework.
+- ``volcano_tpu.parallel``   — device-mesh sharding of the node axis (pjit /
+                               shard_map + collectives).
+- ``volcano_tpu.controllers``— job/queue/podgroup lifecycle state machines and
+                               garbage collection; reference: pkg/controllers.
+- ``volcano_tpu.webhooks``   — admission validation/mutation;
+                               reference: pkg/webhooks.
+- ``volcano_tpu.cli``        — vcctl-equivalent CLI; reference: pkg/cli.
+- ``volcano_tpu.runtime``    — the cluster I/O seam: in-memory API server,
+                               binder/evictor sinks, scheduler loop driver;
+                               reference: pkg/scheduler/cache + cmd/scheduler.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,23 @@
+"""In-memory scheduling data model (reference: pkg/scheduler/api)."""
+
+from .cluster_info import ClusterInfo
+from .job_info import FitError, FitErrors, JobInfo, Taint, TaskInfo, Toleration
+from .node_info import NodeInfo
+from .queue_info import (DEFAULT_NAMESPACE_WEIGHT, HIERARCHY_ANNOTATION,
+                         HIERARCHY_WEIGHTS_ANNOTATION, NamespaceInfo, QueueInfo)
+from .resource import (CPU, MEMORY, MIN_RESOURCE, PODS, Resource,
+                       build_resource_list, parse_quantity)
+from .types import (ALLOCATED_STATUSES, DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME,
+                    BusAction, BusEvent, JobPhase, PodGroupPhase, QueueState,
+                    TaskStatus, is_allocated_status)
+
+__all__ = [
+    "ClusterInfo", "FitError", "FitErrors", "JobInfo", "Taint", "TaskInfo",
+    "Toleration", "NodeInfo", "NamespaceInfo", "QueueInfo", "Resource",
+    "build_resource_list", "parse_quantity", "CPU", "MEMORY", "PODS",
+    "MIN_RESOURCE", "ALLOCATED_STATUSES", "DEFAULT_QUEUE",
+    "DEFAULT_SCHEDULER_NAME", "DEFAULT_NAMESPACE_WEIGHT",
+    "HIERARCHY_ANNOTATION", "HIERARCHY_WEIGHTS_ANNOTATION", "BusAction",
+    "BusEvent", "JobPhase", "PodGroupPhase", "QueueState", "TaskStatus",
+    "is_allocated_status",
+]

@@ -1,0 +1,51 @@
+"""The full-cluster snapshot handed to a scheduling session.
+
+Reference: ClusterInfo, pkg/scheduler/api/cluster_info.go:24-40 — the deep-copy
+result of SchedulerCache.Snapshot (pkg/scheduler/cache/cache.go:712-811).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from .job_info import JobInfo
+from .node_info import NodeInfo
+from .queue_info import NamespaceInfo, QueueInfo
+
+
+@dataclass
+class ClusterInfo:
+    jobs: Dict[str, JobInfo] = field(default_factory=dict)
+    nodes: Dict[str, NodeInfo] = field(default_factory=dict)
+    queues: Dict[str, QueueInfo] = field(default_factory=dict)
+    namespaces: Dict[str, NamespaceInfo] = field(default_factory=dict)
+
+    def add_job(self, job: JobInfo) -> None:
+        self.jobs[job.uid] = job
+        self.namespaces.setdefault(job.namespace, NamespaceInfo(job.namespace))
+
+    def add_node(self, node: NodeInfo) -> None:
+        self.nodes[node.name] = node
+
+    def add_queue(self, queue: QueueInfo) -> None:
+        self.queues[queue.name] = queue
+
+    def total_resource(self):
+        """Sum of node allocatables (cluster capacity) — the DRF denominator.
+
+        Reference: total resource accumulation in drf.OnSessionOpen
+        (pkg/scheduler/plugins/drf/drf.go:118-131)."""
+        from .resource import Resource
+        total = Resource()
+        for node in self.nodes.values():
+            total.add(node.allocatable)
+        return total
+
+    def clone(self) -> "ClusterInfo":
+        return ClusterInfo(
+            jobs={k: j.clone() for k, j in self.jobs.items()},
+            nodes={k: n.clone() for k, n in self.nodes.items()},
+            queues={k: q.clone() for k, q in self.queues.items()},
+            namespaces={k: ns.clone() for k, ns in self.namespaces.items()},
+        )

@@ -1,0 +1,281 @@
+"""Task and gang-job projections of the cluster model.
+
+Reference semantics: pkg/scheduler/api/job_info.go:70-613 (TaskInfo, JobInfo),
+pkg/scheduler/api/unschedule_info.go:20-101 (FitErrors). The new design keeps
+the same invariants (status index, Ready()/Pipelined()/Starving() arithmetic,
+per-role minAvailable) but as plain dataclasses that the array packer
+(:mod:`volcano_tpu.arrays.pack`) can flatten into device tensors.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional
+
+from .resource import Resource
+from .types import TaskStatus, PodGroupPhase, is_allocated_status
+
+
+@dataclass
+class Toleration:
+    """Pod toleration. Reference: k8s core/v1 Toleration as consumed by
+    the tainttoleration predicate (pkg/scheduler/plugins/predicates)."""
+
+    key: str = ""
+    operator: str = "Equal"   # Equal | Exists
+    value: str = ""
+    effect: str = ""          # "", NoSchedule, PreferNoSchedule, NoExecute
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and taint.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"
+
+
+@dataclass
+class TaskInfo:
+    """A schedulable unit (pod) of a gang job.
+
+    Reference: TaskInfo + NewTaskInfo, pkg/scheduler/api/job_info.go:70-171.
+    """
+
+    uid: str
+    name: str
+    namespace: str = "default"
+    job: str = ""                       # JobInfo key "ns/name"
+    task_role: str = ""                 # template (task spec) name
+    resreq: Resource = field(default_factory=Resource)
+    init_resreq: Resource = field(default_factory=Resource)
+    status: TaskStatus = TaskStatus.PENDING
+    priority: int = 0
+    node_name: str = ""                 # assigned node ("" = unassigned)
+    preemptable: bool = False
+    best_effort: bool = False
+    revocable_zone: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    affinity_required: List[Dict[str, str]] = field(default_factory=list)
+    # anti/affinity to other tasks, encoded as label selectors on pods:
+    pod_affinity: List[Dict[str, str]] = field(default_factory=list)
+    pod_anti_affinity: List[Dict[str, str]] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.init_resreq.quantities:
+            self.init_resreq = self.resreq.clone()
+        self.best_effort = self.resreq.is_empty()
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "TaskInfo":
+        t = TaskInfo(
+            uid=self.uid, name=self.name, namespace=self.namespace, job=self.job,
+            task_role=self.task_role, resreq=self.resreq.clone(),
+            init_resreq=self.init_resreq.clone(), status=self.status,
+            priority=self.priority, node_name=self.node_name,
+            preemptable=self.preemptable, revocable_zone=self.revocable_zone,
+            node_selector=dict(self.node_selector),
+            tolerations=list(self.tolerations), labels=dict(self.labels),
+            affinity_required=[dict(m) for m in self.affinity_required],
+            pod_affinity=[dict(m) for m in self.pod_affinity],
+            pod_anti_affinity=[dict(m) for m in self.pod_anti_affinity],
+        )
+        t.best_effort = self.best_effort
+        return t
+
+
+@dataclass
+class FitError:
+    """Why a task failed on a node. Reference: unschedule_info.go:20-60."""
+
+    task: str
+    node: str
+    reasons: List[str]
+
+    def __str__(self) -> str:
+        return f"task {self.task} on node {self.node}: {'; '.join(self.reasons)}"
+
+
+class FitErrors:
+    """Per-job aggregation of fit errors. Reference: unschedule_info.go:62-101."""
+
+    def __init__(self):
+        self.errors: Dict[str, FitError] = {}
+
+    def set_node_error(self, node: str, err: FitError) -> None:
+        self.errors[node] = err
+
+    def __str__(self) -> str:
+        return "; ".join(str(e) for e in self.errors.values())
+
+
+class JobInfo:
+    """A gang job: the scheduler-side projection of a PodGroup plus its pods.
+
+    Reference: JobInfo, pkg/scheduler/api/job_info.go:181-613.
+    """
+
+    def __init__(self, uid: str, name: str = "", namespace: str = "default",
+                 queue: str = "default", priority: int = 0,
+                 min_available: int = 0,
+                 task_min_available: Optional[Mapping[str, int]] = None,
+                 min_resources: Optional[Resource] = None,
+                 creation_timestamp: float = 0.0,
+                 pod_group_phase: PodGroupPhase = PodGroupPhase.PENDING,
+                 preemptable: bool = False):
+        self.uid = uid
+        self.name = name or uid.split("/")[-1]
+        self.namespace = namespace
+        self.queue = queue
+        self.priority = priority
+        self.min_available = min_available
+        self.task_min_available: Dict[str, int] = dict(task_min_available or {})
+        self.min_resources = min_resources or Resource()
+        self.creation_timestamp = creation_timestamp or time.time()
+        self.pod_group_phase = pod_group_phase
+        self.preemptable = preemptable
+
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
+        self.allocated = Resource()      # resources of allocated-status tasks
+        self.total_request = Resource()
+        self.fit_errors: Dict[str, FitErrors] = {}   # task uid -> node errors
+        self.job_fit_errors: str = ""
+
+    # --------------------------------------------------------------- mutation
+    def add_task(self, task: TaskInfo) -> None:
+        """Reference: AddTaskInfo, job_info.go:300-320."""
+        task.job = self.uid
+        self.tasks[task.uid] = task
+        self._index(task)
+        self.total_request.add(task.resreq)
+        if is_allocated_status(task.status):
+            self.allocated.add(task.resreq)
+
+    def delete_task(self, task: TaskInfo) -> None:
+        stored = self.tasks.pop(task.uid, None)
+        if stored is None:
+            return
+        self._unindex(stored)
+        self.total_request.sub_floored(stored.resreq)
+        if is_allocated_status(stored.status):
+            self.allocated.sub_floored(stored.resreq)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Reference: UpdateTaskStatus, job_info.go:402-420."""
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            return
+        if is_allocated_status(stored.status):
+            self.allocated.sub_floored(stored.resreq)
+        self._unindex(stored)
+        stored.status = status
+        self._index(stored)
+        if is_allocated_status(status):
+            self.allocated.add(stored.resreq)
+
+    def _index(self, task: TaskInfo) -> None:
+        self.task_status_index.setdefault(task.status, {})[task.uid] = task
+
+    def _unindex(self, task: TaskInfo) -> None:
+        bucket = self.task_status_index.get(task.status)
+        if bucket:
+            bucket.pop(task.uid, None)
+            if not bucket:
+                del self.task_status_index[task.status]
+
+    # ------------------------------------------------------------- accounting
+    def _count(self, *statuses: TaskStatus) -> int:
+        return sum(len(self.task_status_index.get(s, {})) for s in statuses)
+
+    def ready_task_num(self) -> int:
+        """Tasks occupying resources now (Allocated|Binding|Bound|Running) plus
+        Succeeded. Reference: ReadyTaskNum, job_info.go:560-575."""
+        return self._count(TaskStatus.ALLOCATED, TaskStatus.BINDING,
+                           TaskStatus.BOUND, TaskStatus.RUNNING,
+                           TaskStatus.SUCCEEDED)
+
+    def waiting_task_num(self) -> int:
+        """Pipelined tasks. Reference: WaitingTaskNum, job_info.go:577-585."""
+        return self._count(TaskStatus.PIPELINED)
+
+    def pending_task_num(self) -> int:
+        return self._count(TaskStatus.PENDING)
+
+    def valid_task_num(self) -> int:
+        """Tasks in a schedulable/occupying state.
+
+        Reference: ValidTaskNum, job_info.go (Pending|Allocated|Bound|Binding|
+        Running|Pipelined|Succeeded)."""
+        return self._count(TaskStatus.PENDING, TaskStatus.ALLOCATED,
+                           TaskStatus.BOUND, TaskStatus.BINDING,
+                           TaskStatus.RUNNING, TaskStatus.PIPELINED,
+                           TaskStatus.SUCCEEDED)
+
+    def is_ready(self) -> bool:
+        """Gang admission: ready >= minAvailable. Reference: Ready, job_info.go:596-600."""
+        return self.ready_task_num() >= self.min_available
+
+    def is_pipelined(self) -> bool:
+        """Reference: gang JobPipelined — waiting + ready >= minAvailable
+        (pkg/scheduler/plugins/gang/gang.go:140-148)."""
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    def is_starving(self) -> bool:
+        """Reference: gang JobStarving (gang.go:150-155)."""
+        return not self.is_ready() and not self.is_pipelined()
+
+    def check_task_min_available(self) -> bool:
+        """Per-role minAvailable across valid tasks.
+
+        Reference: CheckTaskMinAvailable, job_info.go:552-575."""
+        if not self.task_min_available:
+            return True
+        actual: Dict[str, int] = {}
+        for task in self.tasks.values():
+            if task.status in (TaskStatus.PENDING, TaskStatus.ALLOCATED,
+                               TaskStatus.BOUND, TaskStatus.BINDING,
+                               TaskStatus.RUNNING, TaskStatus.PIPELINED,
+                               TaskStatus.SUCCEEDED):
+                actual[task.task_role] = actual.get(task.task_role, 0) + 1
+        return all(actual.get(role, 0) >= need
+                   for role, need in self.task_min_available.items())
+
+    def is_valid(self) -> tuple[bool, str]:
+        """Gang JobValid: enough valid tasks for minAvailable and per-role
+        minima. Reference: gang.go:52-81."""
+        if self.valid_task_num() < self.min_available:
+            return False, (f"job {self.uid} has {self.valid_task_num()} valid tasks, "
+                           f"less than minAvailable {self.min_available}")
+        if not self.check_task_min_available():
+            return False, f"job {self.uid} does not satisfy per-task minAvailable"
+        return True, ""
+
+    def pending_tasks(self) -> List[TaskInfo]:
+        return list(self.task_status_index.get(TaskStatus.PENDING, {}).values())
+
+    def clone(self) -> "JobInfo":
+        """Deep copy. Reference: Clone, job_info.go:448-478."""
+        j = JobInfo(self.uid, self.name, self.namespace, self.queue,
+                    self.priority, self.min_available, self.task_min_available,
+                    self.min_resources.clone(), self.creation_timestamp,
+                    self.pod_group_phase, self.preemptable)
+        for task in self.tasks.values():
+            j.add_task(task.clone())
+        return j
+
+    def __repr__(self) -> str:
+        return (f"JobInfo({self.uid}, queue={self.queue}, prio={self.priority}, "
+                f"minAvailable={self.min_available}, tasks={len(self.tasks)})")

@@ -1,0 +1,96 @@
+"""Per-node resource accounting.
+
+Reference: NodeInfo, pkg/scheduler/api/node_info.go:28-437. Invariants kept:
+``idle + used == allocatable``; ``future_idle = idle + releasing - pipelined``
+(node_info.go:62-65); task add/remove moves quantities between the buckets by
+task status.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .job_info import Taint, TaskInfo
+from .resource import Resource
+from .types import TaskStatus, is_allocated_status
+
+
+@dataclass
+class NodeInfo:
+    name: str
+    allocatable: Resource = field(default_factory=Resource)
+    capability: Resource = field(default_factory=Resource)
+    labels: Dict[str, str] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+    ready: bool = True
+    max_pods: int = 110
+
+    def __post_init__(self):
+        if not self.capability.quantities:
+            self.capability = self.allocatable.clone()
+        if self.allocatable.max_task_num is not None:
+            self.max_pods = self.allocatable.max_task_num
+        self.idle = self.allocatable.clone()
+        self.used = Resource()
+        self.releasing = Resource()
+        self.pipelined = Resource()
+        self.tasks: Dict[str, TaskInfo] = {}
+
+    # ----------------------------------------------------------------- state
+    def future_idle(self) -> Resource:
+        """idle + releasing - pipelined. Reference: FutureIdle, node_info.go:62-65."""
+        return self.idle.clone().add(self.releasing).sub_floored(self.pipelined)
+
+    def pod_count(self) -> int:
+        return len(self.tasks)
+
+    # -------------------------------------------------------------- mutation
+    def add_task(self, task: TaskInfo) -> None:
+        """Reference: AddTask, node_info.go:247-292."""
+        if task.uid in self.tasks:
+            raise ValueError(f"task {task.uid} already on node {self.name}")
+        if task.status == TaskStatus.RELEASING:
+            self.used.add(task.resreq)
+            self.releasing.add(task.resreq)
+            self.idle.sub(task.resreq)
+        elif task.status == TaskStatus.PIPELINED:
+            self.pipelined.add(task.resreq)
+        elif is_allocated_status(task.status):
+            self.used.add(task.resreq)
+            self.idle.sub(task.resreq)
+        # terminal statuses (Succeeded/Failed) occupy nothing
+        task.node_name = self.name
+        self.tasks[task.uid] = task
+
+    def remove_task(self, task: TaskInfo) -> None:
+        """Reference: RemoveTask, node_info.go:294-326."""
+        stored = self.tasks.pop(task.uid, None)
+        if stored is None:
+            return
+        if stored.status == TaskStatus.RELEASING:
+            self.used.sub_floored(stored.resreq)
+            self.releasing.sub_floored(stored.resreq)
+            self.idle.add(stored.resreq)
+        elif stored.status == TaskStatus.PIPELINED:
+            self.pipelined.sub_floored(stored.resreq)
+        elif is_allocated_status(stored.status):
+            self.used.sub_floored(stored.resreq)
+            self.idle.add(stored.resreq)
+
+    def update_task(self, task: TaskInfo) -> None:
+        """Reference: UpdateTask, node_info.go:328-340."""
+        self.remove_task(task)
+        self.add_task(task)
+
+    def clone(self) -> "NodeInfo":
+        n = NodeInfo(self.name, self.allocatable.clone(), self.capability.clone(),
+                     dict(self.labels), list(self.taints), self.unschedulable,
+                     self.ready, self.max_pods)
+        for task in self.tasks.values():
+            n.add_task(task.clone())
+        return n
+
+    def __repr__(self) -> str:
+        return f"NodeInfo({self.name}, idle={self.idle}, used={self.used})"

@@ -1,0 +1,70 @@
+"""Queue and namespace projections.
+
+Reference: QueueInfo (pkg/scheduler/api/queue_info.go:27-88, including the
+fork's hierarchical-DRF fields parsed from the ``volcano.sh/hierarchy`` and
+``volcano.sh/hierarchy-weights`` annotations) and NamespaceInfo
+(pkg/scheduler/api/namespace_info.go:28-145).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .resource import Resource
+from .types import QueueState
+
+HIERARCHY_ANNOTATION = "volcano.sh/hierarchy"
+HIERARCHY_WEIGHTS_ANNOTATION = "volcano.sh/hierarchy-weights"
+
+#: Default namespace weight when no ResourceQuota sets one.
+#: Reference: DefaultNamespaceWeight, namespace_info.go:35.
+DEFAULT_NAMESPACE_WEIGHT = 1
+
+
+@dataclass
+class QueueInfo:
+    name: str
+    weight: int = 1
+    capability: Resource = field(default_factory=Resource)
+    reclaimable: bool = True
+    state: QueueState = QueueState.OPEN
+    hierarchy: str = ""          # "/root/sci/dev" style path
+    hierarchy_weights: str = ""  # "1/2/3" weights along the path
+    annotations: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.hierarchy:
+            self.hierarchy = self.annotations.get(HIERARCHY_ANNOTATION, "")
+        if not self.hierarchy_weights:
+            self.hierarchy_weights = self.annotations.get(
+                HIERARCHY_WEIGHTS_ANNOTATION, "")
+
+    def hierarchy_path(self) -> List[str]:
+        return [p for p in self.hierarchy.split("/") if p]
+
+    def hierarchy_weight_values(self) -> List[float]:
+        return [float(w) for w in self.hierarchy_weights.split("/") if w]
+
+    def is_open(self) -> bool:
+        return self.state == QueueState.OPEN
+
+    def clone(self) -> "QueueInfo":
+        return QueueInfo(self.name, self.weight, self.capability.clone(),
+                         self.reclaimable, self.state, self.hierarchy,
+                         self.hierarchy_weights, dict(self.annotations))
+
+
+@dataclass
+class NamespaceInfo:
+    """Namespace with fairness weight from its ResourceQuota.
+
+    Reference: NamespaceInfo/NamespaceCollection, namespace_info.go:28-145
+    (weight = max over quotas of the ``volcano.sh/namespace.weight`` hard limit).
+    """
+
+    name: str
+    weight: int = DEFAULT_NAMESPACE_WEIGHT
+
+    def clone(self) -> "NamespaceInfo":
+        return NamespaceInfo(self.name, self.weight)

@@ -1,0 +1,247 @@
+"""Resource vector algebra.
+
+Re-design of the reference's dense resource arithmetic
+(pkg/scheduler/api/resource_info.go:32-470): a Resource is a mapping of
+resource-dimension name -> float quantity, with CPU in millicores and memory in
+bytes, plus arbitrary scalar resources (GPUs, ephemeral storage, ...). The
+arithmetic here is the host-side (Python) twin of the packed ``f32[R]`` device
+vectors in :mod:`volcano_tpu.arrays`; both must agree, and the unit tests assert
+the same algebraic identities the reference's resource_info_test.go does.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Mapping, Optional
+
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+
+#: Tiny quantities below which a dimension counts as empty.
+#: Reference: minResource in pkg/scheduler/api/resource_info.go:27-30.
+MIN_RESOURCE = 0.1
+
+_SUFFIX = {
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50, "Ei": 2**60,
+}
+_QTY_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(m|[kKMGTPE]i?)?$")
+
+
+def parse_quantity(value, *, is_cpu: bool = False) -> float:
+    """Parse a Kubernetes-style quantity string ("100m", "2Gi", "1.5") to float.
+
+    CPU quantities are returned in millicores; everything else in base units.
+    """
+    if isinstance(value, (int, float)):
+        return float(value) * (1000.0 if is_cpu else 1.0)
+    m = _QTY_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"unparseable quantity: {value!r}")
+    num = float(m.group(1))
+    suffix = m.group(2)
+    if suffix == "m":
+        milli = num
+        return milli if is_cpu else num / 1000.0
+    scale = _SUFFIX.get(suffix, 1.0) if suffix else 1.0
+    base = num * scale
+    return base * 1000.0 if is_cpu else base
+
+
+class Resource:
+    """A named resource vector.
+
+    ``cpu`` is stored in millicores, ``memory`` in bytes; any other key is an
+    opaque scalar resource. ``max_task_num`` mirrors the reference's
+    ``MaxTaskNum`` (pod capacity, resource_info.go:44-47) and rides along
+    without participating in the vector arithmetic.
+    """
+
+    __slots__ = ("quantities", "max_task_num")
+
+    def __init__(self, quantities: Optional[Mapping[str, float]] = None,
+                 max_task_num: Optional[int] = None):
+        self.quantities: Dict[str, float] = dict(quantities or {})
+        self.max_task_num = max_task_num
+
+    # ---------------------------------------------------------------- factory
+    @classmethod
+    def from_resource_list(cls, rl: Mapping[str, object]) -> "Resource":
+        """Build from a k8s-style ResourceList mapping (quantity strings ok).
+
+        Reference: NewResource, resource_info.go:60-84.
+        """
+        q: Dict[str, float] = {}
+        max_tasks: Optional[int] = None
+        for name, val in (rl or {}).items():
+            if name == CPU:
+                q[CPU] = q.get(CPU, 0.0) + parse_quantity(val, is_cpu=True)
+            elif name == PODS:
+                max_tasks = int(parse_quantity(val))
+            else:
+                q[name] = q.get(name, 0.0) + parse_quantity(val)
+        return cls(q, max_task_num=max_tasks)
+
+    @classmethod
+    def empty(cls) -> "Resource":
+        return cls({})
+
+    def clone(self) -> "Resource":
+        return Resource(dict(self.quantities), self.max_task_num)
+
+    # ---------------------------------------------------------------- access
+    def get(self, name: str) -> float:
+        return self.quantities.get(name, 0.0)
+
+    @property
+    def milli_cpu(self) -> float:
+        return self.get(CPU)
+
+    @property
+    def memory(self) -> float:
+        return self.get(MEMORY)
+
+    def resource_names(self) -> Iterable[str]:
+        return self.quantities.keys()
+
+    def is_empty(self) -> bool:
+        """Every dimension below MIN_RESOURCE. Reference: IsEmpty, resource_info.go:184-196."""
+        return all(v < MIN_RESOURCE for v in self.quantities.values())
+
+    def is_zero(self, name: str) -> bool:
+        """Reference: IsZero, resource_info.go:198-210."""
+        return self.get(name) < MIN_RESOURCE
+
+    # ------------------------------------------------------------ arithmetic
+    def add(self, other: "Resource") -> "Resource":
+        """In-place add. Reference: Add, resource_info.go:230-242."""
+        for name, v in other.quantities.items():
+            self.quantities[name] = self.quantities.get(name, 0.0) + v
+        return self
+
+    def sub(self, other: "Resource") -> "Resource":
+        """In-place subtract; raises if other is not <= self.
+
+        Reference: Sub, resource_info.go:244-258 (panics on underflow).
+        """
+        if not other.less_equal(self):
+            raise ValueError(f"resource underflow: {other} not <= {self}")
+        for name, v in other.quantities.items():
+            self.quantities[name] = self.quantities.get(name, 0.0) - v
+        return self
+
+    def sub_floored(self, other: "Resource") -> "Resource":
+        """In-place subtract clamped at zero (used for Diff-style accounting)."""
+        for name, v in other.quantities.items():
+            self.quantities[name] = max(0.0, self.quantities.get(name, 0.0) - v)
+        return self
+
+    def multi(self, ratio: float) -> "Resource":
+        """In-place scale. Reference: Multi, resource_info.go:260-270."""
+        for name in self.quantities:
+            self.quantities[name] *= ratio
+        return self
+
+    def set_max_resource(self, other: "Resource") -> "Resource":
+        """Element-wise max. Reference: SetMaxResource, resource_info.go:272-292."""
+        for name, v in other.quantities.items():
+            if v > self.quantities.get(name, 0.0):
+                self.quantities[name] = v
+        return self
+
+    def min_dimension_resource(self, other: "Resource") -> "Resource":
+        """Element-wise min over self's dimensions.
+
+        Reference: MinDimensionResource, resource_info.go:294-330 (zero-fill
+        semantics: dimensions missing from other clamp to 0).
+        """
+        for name in list(self.quantities):
+            self.quantities[name] = min(self.quantities[name], other.get(name))
+        return self
+
+    def fit_delta(self, other: "Resource") -> "Resource":
+        """Add other with a MIN_RESOURCE epsilon on each of other's nonzero
+        dims so that subsequent LessEqual checks are strict fits.
+
+        Reference: FitDelta, resource_info.go:212-228.
+        """
+        for name, v in other.quantities.items():
+            if v > 0:
+                self.quantities[name] = self.quantities.get(name, 0.0) + v + MIN_RESOURCE
+        return self
+
+    # ------------------------------------------------------------ comparison
+    def less_equal(self, other: "Resource") -> bool:
+        """self <= other on every dimension of self (missing = 0).
+
+        Reference: LessEqual with zero semantics, resource_info.go:376-414.
+        """
+        return all(v <= other.get(name) + 1e-9 for name, v in self.quantities.items())
+
+    def less_equal_strict(self, other: "Resource") -> bool:
+        """Strict <= requiring every dim of self to exist in other.
+
+        Reference: LessEqualStrict, resource_info.go:416-430.
+        """
+        return all(
+            name in other.quantities and v <= other.quantities[name] + 1e-9
+            for name, v in self.quantities.items()
+        )
+
+    def less(self, other: "Resource") -> bool:
+        """self < other on EVERY dimension. Reference: Less, resource_info.go:332-360."""
+        if not self.quantities and not other.quantities:
+            return False
+        names = set(self.quantities) | set(other.quantities)
+        return all(self.get(n) < other.get(n) for n in names)
+
+    def less_partly(self, other: "Resource") -> bool:
+        """self < other on AT LEAST one dimension.
+
+        Reference: LessPartly, resource_info.go (used by reclaim/overused checks).
+        """
+        names = set(self.quantities) | set(other.quantities)
+        return any(self.get(n) < other.get(n) for n in names)
+
+    def diff(self, other: "Resource") -> tuple["Resource", "Resource"]:
+        """Return (increased, decreased) vs other.
+
+        Reference: Diff, resource_info.go:432-470.
+        """
+        inc, dec = Resource(), Resource()
+        names = set(self.quantities) | set(other.quantities)
+        for n in names:
+            d = self.get(n) - other.get(n)
+            if d > 0:
+                inc.quantities[n] = d
+            elif d < 0:
+                dec.quantities[n] = -d
+        return inc, dec
+
+    # ---------------------------------------------------------------- dunder
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Resource):
+            return NotImplemented
+        names = set(self.quantities) | set(other.quantities)
+        return all(abs(self.get(n) - other.get(n)) < 1e-6 for n in names)
+
+    def __hash__(self):  # pragma: no cover - Resources are not hashed
+        raise TypeError("Resource is mutable and unhashable")
+
+    def __repr__(self) -> str:
+        parts = ", ".join(f"{k}={v:g}" for k, v in sorted(self.quantities.items()))
+        return f"Resource({parts})"
+
+
+def build_resource_list(cpu: str | float = 0, memory: str | float = 0,
+                        **scalars) -> Dict[str, object]:
+    """Test/fixture helper mirroring util.BuildResourceList
+    (pkg/scheduler/util/test_utils.go:30-45)."""
+    rl: Dict[str, object] = {}
+    if cpu:
+        rl[CPU] = cpu
+    if memory:
+        rl[MEMORY] = memory
+    rl.update(scalars)
+    return rl

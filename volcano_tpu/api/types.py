@@ -1,0 +1,129 @@
+"""Core enums and constants of the scheduling data model.
+
+Reference semantics: pkg/scheduler/api/types.go:29-113 (TaskStatus and helpers),
+vendor/volcano.sh/apis/pkg/apis/scheduling/v1beta1/types.go:25-66 (PodGroup
+phases), vendor/.../bus/v1alpha1/{actions.go,events.go} (bus actions/events),
+vendor/.../batch/v1alpha1/job.go (Job phases).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TaskStatus(enum.IntEnum):
+    """Lifecycle status of a task (pod).
+
+    Reference: pkg/scheduler/api/types.go:29-61.
+    """
+
+    PENDING = 0      # not scheduled yet
+    ALLOCATED = 1    # assigned to a node inside the session, not yet bound
+    PIPELINED = 2    # assigned to a node whose resources are releasing
+    BINDING = 3      # bind RPC in flight
+    BOUND = 4        # bind acknowledged
+    RUNNING = 5
+    RELEASING = 6    # terminating; resources count as releasing on the node
+    SUCCEEDED = 7
+    FAILED = 8
+    UNKNOWN = 9
+
+
+#: Statuses that occupy node resources "now".
+#: Reference: pkg/scheduler/api/types.go:87-96 (AllocatedStatus).
+ALLOCATED_STATUSES = frozenset(
+    {TaskStatus.ALLOCATED, TaskStatus.BINDING, TaskStatus.BOUND, TaskStatus.RUNNING}
+)
+
+
+def is_allocated_status(status: TaskStatus) -> bool:
+    return status in ALLOCATED_STATUSES
+
+
+class JobPhase(str, enum.Enum):
+    """Phase of a batch Job (controller-side state machine).
+
+    Reference: vendor/volcano.sh/apis/pkg/apis/batch/v1alpha1/job.go (JobPhase)
+    and pkg/controllers/job/state/factory.go:62-85.
+    """
+
+    PENDING = "Pending"
+    ABORTING = "Aborting"
+    ABORTED = "Aborted"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    COMPLETING = "Completing"
+    COMPLETED = "Completed"
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+    FAILED = "Failed"
+
+
+class PodGroupPhase(str, enum.Enum):
+    """Scheduler-side gang phase.
+
+    Reference: vendor/.../scheduling/v1beta1/types.go:25-43.
+    """
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+    INQUEUE = "Inqueue"
+
+
+class QueueState(str, enum.Enum):
+    """Reference: vendor/.../scheduling/v1beta1/types.go (QueueState)."""
+
+    OPEN = "Open"
+    CLOSED = "Closed"
+    CLOSING = "Closing"
+    UNKNOWN = "Unknown"
+
+
+class BusAction(str, enum.Enum):
+    """Actions carried by bus Commands / lifecycle policies.
+
+    Reference: vendor/.../bus/v1alpha1/actions.go:20-60.
+    """
+
+    ABORT_JOB = "AbortJob"
+    RESTART_JOB = "RestartJob"
+    RESTART_TASK = "RestartTask"
+    TERMINATE_JOB = "TerminateJob"
+    COMPLETE_JOB = "CompleteJob"
+    RESUME_JOB = "ResumeJob"
+    SYNC_JOB = "SyncJob"
+    ENQUEUE_JOB = "EnqueueJob"
+    SYNC_QUEUE = "SyncQueue"
+    OPEN_QUEUE = "OpenQueue"
+    CLOSE_QUEUE = "CloseQueue"
+
+
+class BusEvent(str, enum.Enum):
+    """Events that trigger lifecycle policies.
+
+    Reference: vendor/.../bus/v1alpha1/events.go:20-53.
+    """
+
+    ANY = "*"
+    POD_FAILED = "PodFailed"
+    POD_EVICTED = "PodEvicted"
+    JOB_UNKNOWN = "Unknown"
+    TASK_COMPLETED = "TaskCompleted"
+    TASK_FAILED = "TaskFailed"
+    OUT_OF_SYNC = "OutOfSync"
+    COMMAND_ISSUED = "CommandIssued"
+    JOB_UPDATED = "JobUpdated"
+
+
+#: PodGroup condition types written by the gang plugin at session close.
+#: Reference: pkg/scheduler/plugins/gang/gang.go:158-216.
+POD_GROUP_CONDITION_UNSCHEDULABLE = "Unschedulable"
+POD_GROUP_CONDITION_SCHEDULED = "Scheduled"
+
+#: The default queue every unassigned job lands in.
+#: Reference: pkg/scheduler/cache/cache.go (newDefaultQueue at startup).
+DEFAULT_QUEUE = "default"
+
+#: Default scheduler identity (pods opt in via schedulerName).
+DEFAULT_SCHEDULER_NAME = "volcano"
