@@ -9,8 +9,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from volcano_tpu.api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo,
-                             Resource, TaskInfo, TaskStatus)
+from volcano_tpu.api import (ClusterInfo, JobInfo, NodeInfo, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
 
 
 def res(cpu=0, memory=0, **scalars) -> Resource:
@@ -43,6 +43,10 @@ def build_task(name: str, cpu="1", memory="1Gi", namespace="default",
 
 def build_job(uid: str, queue="default", min_available=1, priority=0,
               namespace="default", **kw) -> JobInfo:
+    # Fixtures build already-admitted gangs (phase Inqueue) so action tests can
+    # run allocate directly, the way the reference's allocate_test.go builds
+    # PodGroups already past the enqueue gate.
+    kw.setdefault("pod_group_phase", PodGroupPhase.INQUEUE)
     name = uid.split("/")[-1]
     return JobInfo(uid=uid, name=name, namespace=namespace, queue=queue,
                    priority=priority, min_available=min_available, **kw)

@@ -1,0 +1,210 @@
+"""Allocate-pass tests: behavior fixtures mirroring the reference's
+TestAllocate (pkg/scheduler/actions/allocate/allocate_test.go:43-279) plus
+TPU-vs-CPU decision-equivalence on randomized snapshots (SURVEY.md section 4)."""
+
+import numpy as np
+import jax
+import pytest
+
+from volcano_tpu.api import QueueInfo, TaskStatus
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops import (MODE_ALLOCATED, MODE_PIPELINED, AllocateConfig,
+                             make_allocate_cycle)
+from volcano_tpu.runtime.cpu_reference import allocate_cpu
+
+from fixtures import build_job, build_node, build_task, simple_cluster
+
+
+def run_both(ci, cfg=AllocateConfig(), job_share=None, queue_deserved=None,
+             ns_share=None):
+    snap, maps = pack(ci)
+    J = snap.jobs.min_available.shape[0]
+    Q = snap.queues.weight.shape[0]
+    S = snap.namespace_weight.shape[0]
+    R = snap.cluster_capacity.shape[0]
+    if job_share is None:
+        job_share = np.zeros(J, np.float32)
+    if queue_deserved is None:
+        queue_deserved = np.full((Q, R), np.inf, np.float32)
+    if ns_share is None:
+        ns_share = np.zeros(S, np.float32)
+    fn = jax.jit(make_allocate_cycle(cfg))
+    tpu = fn(snap, job_share, queue_deserved, ns_share)
+    cpu = allocate_cpu(snap, job_share, queue_deserved, ns_share, cfg)
+    return snap, maps, tpu, cpu
+
+
+def binds(maps, task_node, task_mode):
+    out = {}
+    for uid, ti in maps.task_index.items():
+        if int(task_mode[ti]) == MODE_ALLOCATED:
+            out[uid] = maps.node_names[int(task_node[ti])]
+    return out
+
+
+class TestAllocateBehavior:
+    def test_single_job_fits(self):
+        """One gang job, two tasks, two nodes — both must bind
+        (allocate_test.go case 'one Job with two Pods on one node')."""
+        ci = simple_cluster(n_nodes=2, node_cpu="2", node_mem="4Gi")
+        job = build_job("default/j1", min_available=2)
+        job.add_task(build_task("p1", cpu="1", memory="1Gi"))
+        job.add_task(build_task("p2", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        _, maps, tpu, cpu = run_both(ci)
+        b = binds(maps, tpu.task_node, tpu.task_mode)
+        assert len(b) == 2
+        assert bool(tpu.job_ready[maps.job_index["default/j1"]])
+
+    def test_gang_all_or_nothing(self):
+        """minAvailable=3 but capacity for 2 -> nothing binds
+        (gang discard, statement.go:352-374)."""
+        ci = simple_cluster(n_nodes=1, node_cpu="2", node_mem="4Gi")
+        job = build_job("default/j1", min_available=3)
+        for i in range(3):
+            job.add_task(build_task(f"p{i}", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        _, maps, tpu, cpu = run_both(ci)
+        assert binds(maps, tpu.task_node, tpu.task_mode) == {}
+        assert not bool(tpu.job_ready[0])
+        np.testing.assert_allclose(np.array(tpu.idle)[0, 0],
+                                   ci.nodes["n0"].idle.get("cpu"), atol=1e-3)
+
+    def test_partial_gang_discard_frees_capacity_for_next_job(self):
+        """Discarded gang's capacity goes to the next job in order."""
+        ci = simple_cluster(n_nodes=1, node_cpu="2", node_mem="4Gi")
+        big = build_job("default/big", min_available=3, priority=10)
+        for i in range(3):
+            big.add_task(build_task(f"b{i}", cpu="1", memory="1Gi"))
+        small = build_job("default/small", min_available=1, priority=1)
+        small.add_task(build_task("s0", cpu="1", memory="1Gi"))
+        ci.add_job(big)
+        ci.add_job(small)
+        _, maps, tpu, cpu = run_both(ci)
+        b = binds(maps, tpu.task_node, tpu.task_mode)
+        assert b == {"default/s0": "n0"}
+
+    def test_priority_order(self):
+        """Higher-priority job wins scarce capacity (priority plugin
+        JobOrderFn, priority.go:83)."""
+        ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="2Gi")
+        lo = build_job("default/lo", min_available=1, priority=1)
+        lo.add_task(build_task("lo-0", cpu="1", memory="1Gi"))
+        hi = build_job("default/hi", min_available=1, priority=5)
+        hi.add_task(build_task("hi-0", cpu="1", memory="1Gi"))
+        ci.add_job(lo)
+        ci.add_job(hi)
+        _, maps, tpu, cpu = run_both(ci)
+        b = binds(maps, tpu.task_node, tpu.task_mode)
+        assert b == {"default/hi-0": "n0"}
+
+    def test_pipelining_on_releasing(self):
+        """Task that fits only future idle gets Pipelined, not Allocated
+        (allocate.go:200-240 Idle/FutureIdle candidate split)."""
+        ci = simple_cluster(n_nodes=1, node_cpu="2", node_mem="4Gi")
+        # a releasing task occupies the whole node
+        rel_job = build_job("default/old", min_available=1)
+        rel = build_task("old-0", cpu="2", memory="4Gi")
+        rel.status = TaskStatus.RELEASING
+        rel_job.add_task(rel)
+        ci.nodes["n0"].add_task(rel)
+        ci.add_job(rel_job)
+        new = build_job("default/new", min_available=1)
+        new.add_task(build_task("new-0", cpu="2", memory="4Gi"))
+        ci.add_job(new)
+        _, maps, tpu, cpu = run_both(ci)
+        ti = maps.task_index["default/new-0"]
+        assert int(tpu.task_mode[ti]) == MODE_PIPELINED
+        assert bool(tpu.job_pipelined[maps.job_index["default/new"]])
+        assert binds(maps, tpu.task_node, tpu.task_mode) == {}
+
+    def test_closed_queue_skipped(self):
+        from volcano_tpu.api import QueueState
+        ci = simple_cluster(n_nodes=1)
+        ci.add_queue(QueueInfo("closed", state=QueueState.CLOSED))
+        job = build_job("default/j1", queue="closed", min_available=1)
+        job.add_task(build_task("p0"))
+        ci.add_job(job)
+        _, maps, tpu, cpu = run_both(ci)
+        assert binds(maps, tpu.task_node, tpu.task_mode) == {}
+
+    def test_best_effort_skipped_in_allocate(self):
+        """Zero-request tasks are backfill's business (backfill.go:40-93)."""
+        ci = simple_cluster(n_nodes=1)
+        job = build_job("default/j1", min_available=0)
+        job.add_task(build_task("be", cpu=0, memory=0))
+        ci.add_job(job)
+        _, maps, tpu, cpu = run_both(ci)
+        assert binds(maps, tpu.task_node, tpu.task_mode) == {}
+
+    def test_node_selector_constrains_placement(self):
+        ci = simple_cluster(n_nodes=3)
+        ci.nodes["n2"].labels = {"disk": "ssd"}
+        job = build_job("default/j1", min_available=1)
+        job.add_task(build_task("p0", node_selector={"disk": "ssd"}))
+        ci.add_job(job)
+        _, maps, tpu, cpu = run_both(ci)
+        assert binds(maps, tpu.task_node, tpu.task_mode) == {"default/p0": "n2"}
+
+    def test_queue_deserved_share_ordering(self):
+        """Two queues, one far over its deserved share: the underserved
+        queue's job goes first (proportion queueOrderFn, proportion.go:198-212)."""
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        ci.add_queue(QueueInfo("qa", weight=1))
+        ci.add_queue(QueueInfo("qb", weight=1))
+        ja = build_job("default/ja", queue="qa", min_available=1)
+        ja.add_task(build_task("a0", cpu="1", memory=0))
+        jb = build_job("default/jb", queue="qb", min_available=1)
+        jb.add_task(build_task("b0", cpu="1", memory=0))
+        ci.add_job(ja)
+        ci.add_job(jb)
+        snap, maps = pack(ci)
+        Q = snap.queues.weight.shape[0]
+        R = snap.cluster_capacity.shape[0]
+        deserved = np.full((Q, R), np.inf, np.float32)
+        # qa deserved tiny, and already allocated beyond it -> overused
+        qa = maps.queue_index["qa"]
+        deserved[qa] = 0.0
+        job_share = np.zeros(snap.jobs.min_available.shape[0], np.float32)
+        ns_share = np.zeros(snap.namespace_weight.shape[0], np.float32)
+        fn = jax.jit(make_allocate_cycle(AllocateConfig()))
+        tpu = fn(snap, job_share, deserved, ns_share)
+        b = binds(maps, tpu.task_node, tpu.task_mode)
+        assert b == {"default/b0": "n0"}
+
+
+NODE_CPUS = ["1", "2", "4", "8"]
+
+
+class TestDecisionEquivalence:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_snapshots_match_cpu(self, seed):
+        rng = np.random.RandomState(seed)
+        ci = simple_cluster(n_nodes=0)
+        for i in range(rng.randint(2, 6)):
+            ci.add_node(build_node(
+                f"n{i}", cpu=NODE_CPUS[rng.randint(len(NODE_CPUS))],
+                memory="8Gi",
+                labels={"zone": f"z{rng.randint(2)}"}))
+        ci.add_queue(QueueInfo("default", weight=1))
+        ci.add_queue(QueueInfo("q2", weight=2))
+        for j in range(rng.randint(1, 5)):
+            queue = "default" if rng.rand() < 0.5 else "q2"
+            n_tasks = rng.randint(1, 4)
+            job = build_job(f"default/j{j}", queue=queue,
+                            min_available=rng.randint(1, n_tasks + 1),
+                            priority=int(rng.randint(3)))
+            for t in range(n_tasks):
+                kw = {}
+                if rng.rand() < 0.3:
+                    kw["node_selector"] = {"zone": f"z{rng.randint(2)}"}
+                job.add_task(build_task(f"j{j}-t{t}",
+                                        cpu=str(rng.randint(1, 3)),
+                                        memory="1Gi", **kw))
+            ci.add_job(job)
+        cfg = AllocateConfig(binpack_weight=float(rng.rand() < 0.5))
+        snap, maps, tpu, cpu = run_both(ci, cfg=cfg)
+        np.testing.assert_array_equal(np.array(tpu.task_node), cpu["task_node"])
+        np.testing.assert_array_equal(np.array(tpu.task_mode), cpu["task_mode"])
+        np.testing.assert_array_equal(np.array(tpu.job_ready), cpu["job_ready"])
+        np.testing.assert_allclose(np.array(tpu.idle), cpu["idle"], atol=1e-2)

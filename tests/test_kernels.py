@@ -1,0 +1,195 @@
+"""Kernel unit tests: packing, predicates, scoring, selection.
+
+Mirrors the assertion style of the reference's predicate/binpack tests
+(pkg/scheduler/plugins/binpack/binpack_test.go exact-score assertions)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from volcano_tpu.api import Taint, Toleration
+from volcano_tpu.arrays import pack, stable_hash
+from volcano_tpu.ops import predicates as P
+from volcano_tpu.ops import scoring as S
+from volcano_tpu.ops import select as SEL
+
+from fixtures import build_job, build_node, build_task, simple_cluster
+
+
+def packed_cluster(**kw):
+    ci = simple_cluster(**kw)
+    job = build_job("default/j1", min_available=1)
+    job.add_task(build_task("p1", cpu="1", memory="1Gi"))
+    ci.add_job(job)
+    return ci
+
+
+class TestPack:
+    def test_shapes_and_masks(self):
+        snap, maps = pack(packed_cluster(n_nodes=3))
+        assert snap.nodes.idle.shape[0] >= 3
+        assert snap.nodes.valid.sum() == 3
+        assert snap.tasks.valid.sum() == 1
+        assert snap.jobs.valid.sum() == 1
+        assert maps.resource_names[:2] == ["cpu", "memory"]
+
+    def test_node_accounting_packed(self):
+        ci = packed_cluster(n_nodes=2)
+        running_job = build_job("default/j0", min_available=1)
+        t = build_task("r1", cpu="1")
+        from volcano_tpu.api import TaskStatus
+        t.status = TaskStatus.RUNNING
+        running_job.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(running_job)
+        snap, maps = pack(ci)
+        n0 = maps.node_index["n0"]
+        assert snap.nodes.idle[n0][0] == 3000.0
+        assert snap.nodes.used[n0][0] == 1000.0
+        assert snap.nodes.pod_count[n0] == 1
+
+    def test_pending_task_table_sorted_by_priority(self):
+        ci = simple_cluster()
+        job = build_job("default/j1", min_available=2)
+        job.add_task(build_task("lo", priority=1))
+        job.add_task(build_task("hi", priority=10))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        ji = maps.job_index["default/j1"]
+        first = snap.jobs.task_table[ji][0]
+        assert maps.task_uids[first] == "default/hi"
+
+
+class TestPredicates:
+    def test_resource_fit(self):
+        snap, maps = pack(packed_cluster(n_nodes=2))
+        req = jnp.asarray(snap.tasks.resreq[0])
+        fit = P.resource_fit(req, jnp.asarray(snap.nodes.idle))
+        assert bool(fit[maps.node_index["n0"]])
+        big = req * 100
+        assert not bool(P.resource_fit(big, jnp.asarray(snap.nodes.idle))[0])
+
+    def test_selector_match(self):
+        ci = simple_cluster(n_nodes=2)
+        ci.nodes["n0"].labels = {"zone": "a"}
+        job = build_job("default/j1")
+        job.add_task(build_task("p1", node_selector={"zone": "a"}))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        m = P.selector_match(jnp.asarray(snap.tasks.selector[0]),
+                             jnp.asarray(snap.nodes.labels))
+        assert bool(m[maps.node_index["n0"]])
+        assert not bool(m[maps.node_index["n1"]])
+
+    def test_taints(self):
+        ci = simple_cluster(n_nodes=2)
+        ci.nodes["n0"].taints = [Taint("dedicated", "gpu", "NoSchedule")]
+        job = build_job("default/j1")
+        job.add_task(build_task("plain"))
+        tol = build_task("tolerant",
+                         tolerations=[Toleration("dedicated", "Equal", "gpu",
+                                                 "NoSchedule")])
+        job.add_task(tol)
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        i_plain = maps.task_index["default/plain"]
+        i_tol = maps.task_index["default/tolerant"]
+        nodes = snap.nodes
+        ok_plain = P.taints_tolerated(
+            jnp.asarray(snap.tasks.tol_hash[i_plain]),
+            jnp.asarray(snap.tasks.tol_effect[i_plain]),
+            jnp.asarray(snap.tasks.tol_mode[i_plain]), nodes)
+        ok_tol = P.taints_tolerated(
+            jnp.asarray(snap.tasks.tol_hash[i_tol]),
+            jnp.asarray(snap.tasks.tol_effect[i_tol]),
+            jnp.asarray(snap.tasks.tol_mode[i_tol]), nodes)
+        n0 = maps.node_index["n0"]
+        assert not bool(ok_plain[n0])
+        assert bool(ok_tol[n0])
+        assert bool(ok_plain[maps.node_index["n1"]])
+
+    def test_prefer_no_schedule_does_not_block(self):
+        ci = simple_cluster(n_nodes=1)
+        ci.nodes["n0"].taints = [Taint("soft", "x", "PreferNoSchedule")]
+        job = build_job("default/j1")
+        job.add_task(build_task("p1"))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        ok = P.taints_tolerated(jnp.asarray(snap.tasks.tol_hash[0]),
+                                jnp.asarray(snap.tasks.tol_effect[0]),
+                                jnp.asarray(snap.tasks.tol_mode[0]), snap.nodes)
+        assert bool(ok[0])
+
+    def test_pod_count(self):
+        ci = simple_cluster(n_nodes=1)
+        ci.nodes["n0"].max_pods = 0
+        snap, _ = pack(ci)
+        assert not bool(P.pod_count_fit(snap.nodes)[0])
+
+
+class TestScoring:
+    def test_binpack_exact(self):
+        # node: 4 cpu, 8Gi; used 1 cpu, 2Gi; request 1 cpu 2Gi,
+        # weights cpu=1 memory=1 -> score = ((2/4) + (4/8))/2 * 100 = 50
+        used = jnp.array([[1000.0, 2.0 * 2**30]])
+        alloc = jnp.array([[4000.0, 8.0 * 2**30]])
+        req = jnp.array([1000.0, 2.0 * 2**30])
+        w = jnp.array([1.0, 1.0])
+        score = S.binpack_score(used, alloc, req, w)
+        np.testing.assert_allclose(score, [50.0], rtol=1e-5)
+
+    def test_binpack_prefers_fuller_node(self):
+        used = jnp.array([[3000.0, 0.0], [0.0, 0.0]])
+        alloc = jnp.array([[4000.0, 1.0], [4000.0, 1.0]])
+        req = jnp.array([1000.0, 0.0])
+        s = S.binpack_score(used, alloc, req, jnp.array([1.0, 1.0]))
+        assert s[0] > s[1]
+
+    def test_binpack_overflow_zero(self):
+        used = jnp.array([[3500.0, 0.0]])
+        alloc = jnp.array([[4000.0, 1.0]])
+        req = jnp.array([1000.0, 0.0])
+        s = S.binpack_score(used, alloc, req, jnp.array([1.0, 0.0]))
+        assert float(s[0]) == 0.0
+
+    def test_least_vs_most(self):
+        used = jnp.array([[2000.0, 0.0], [0.0, 0.0]])
+        alloc = jnp.array([[4000.0, 4.0], [4000.0, 4.0]])
+        req = jnp.array([0.0, 0.0])
+        least = S.least_allocated_score(used, alloc, req)
+        most = S.most_allocated_score(used, alloc, req)
+        assert least[1] > least[0]
+        assert most[0] > most[1]
+
+    def test_balanced(self):
+        # node 0 perfectly balanced, node 1 skewed
+        used = jnp.array([[2000.0, 2.0], [4000.0, 0.0]])
+        alloc = jnp.array([[4000.0, 4.0], [4000.0, 4.0]])
+        req = jnp.array([0.0, 0.0])
+        s = S.balanced_allocation_score(used, alloc, req)
+        assert s[0] > s[1]
+
+
+class TestSelect:
+    def test_best_node_tie_break_first(self):
+        score = jnp.array([5.0, 5.0, 3.0])
+        feas = jnp.array([True, True, True])
+        idx, found = SEL.best_node(score, feas)
+        assert int(idx) == 0 and bool(found)
+
+    def test_best_node_infeasible(self):
+        idx, found = SEL.best_node(jnp.array([1.0]), jnp.array([False]))
+        assert not bool(found)
+
+    def test_lex_argmin(self):
+        k1 = jnp.array([1.0, 1.0, 0.0, 1.0])
+        k2 = jnp.array([9.0, 2.0, 5.0, 2.0])
+        mask = jnp.array([True, True, False, True])
+        idx, found = SEL.lex_argmin([k1, k2], mask)
+        assert int(idx) == 1 and bool(found)  # index 2 masked out; 1 before 3
+
+    def test_sort_order_lexicographic(self):
+        k1 = jnp.array([2.0, 1.0, 1.0, 3.0])
+        k2 = jnp.array([0.0, 5.0, 2.0, 0.0])
+        mask = jnp.array([True, True, True, False])
+        order = SEL.sort_order([k1, k2], mask)
+        assert list(order[:3]) == [2, 1, 0]

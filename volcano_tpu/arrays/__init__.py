@@ -1,0 +1,16 @@
+"""Dense snapshot arrays — device-side world state (reference: cache.Snapshot)."""
+
+from .labels import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE, EFFECT_NONE,
+                     EFFECT_PREFER_NO_SCHEDULE, TOL_EQUAL, TOL_EXISTS_ALL,
+                     TOL_EXISTS_KEY, effect_code, label_hashes, stable_hash)
+from .pack import pack, resource_dims
+from .schema import (IndexMaps, JobArrays, NodeArrays, QueueArrays,
+                     SnapshotArrays, TaskArrays, bucket)
+
+__all__ = [
+    "pack", "resource_dims", "IndexMaps", "JobArrays", "NodeArrays",
+    "QueueArrays", "SnapshotArrays", "TaskArrays", "bucket", "stable_hash",
+    "label_hashes", "effect_code", "EFFECT_NONE", "EFFECT_NO_SCHEDULE",
+    "EFFECT_PREFER_NO_SCHEDULE", "EFFECT_NO_EXECUTE", "TOL_EQUAL",
+    "TOL_EXISTS_KEY", "TOL_EXISTS_ALL",
+]

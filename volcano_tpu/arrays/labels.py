@@ -1,0 +1,61 @@
+"""Stable string hashing for label / taint / selector tensor encodings.
+
+The reference's predicates walk Go maps of labels and taint structs
+(pkg/scheduler/plugins/predicates/predicates.go:201-288). On TPU, pointer
+chasing is replaced by fixed-width integer hash sets: every label ``key=value``
+becomes a nonzero int32; membership tests become vectorized equality scans
+(SURVEY.md section 7, array schema / hard part 3).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+#: Taint-effect codes used in the packed arrays.
+EFFECT_NONE = 0
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+
+_EFFECTS = {
+    "": EFFECT_NONE,
+    "NoSchedule": EFFECT_NO_SCHEDULE,
+    "PreferNoSchedule": EFFECT_PREFER_NO_SCHEDULE,
+    "NoExecute": EFFECT_NO_EXECUTE,
+}
+
+#: Toleration match modes.
+TOL_EQUAL = 0        # match key=value hash
+TOL_EXISTS_KEY = 1   # match key hash
+TOL_EXISTS_ALL = 2   # tolerates everything
+
+
+def stable_hash(s: str) -> int:
+    """Deterministic nonzero 31-bit hash of a string (0 is the empty slot)."""
+    h = zlib.crc32(s.encode("utf-8")) & 0x7FFFFFFF
+    return h if h != 0 else 1
+
+
+def label_hashes(labels: Dict[str, str]) -> List[int]:
+    return sorted(stable_hash(f"{k}={v}") for k, v in labels.items())
+
+
+def effect_code(effect: str) -> int:
+    return _EFFECTS.get(effect, EFFECT_NONE)
+
+
+def pack_hash_rows(rows: Iterable[List[int]], width: int | None = None,
+                   dtype=np.int32) -> np.ndarray:
+    """Pack variable-length hash lists into a zero-padded [n, width] matrix."""
+    rows = [list(r) for r in rows]
+    if width is None:
+        width = max((len(r) for r in rows), default=0)
+    width = max(width, 1)
+    out = np.zeros((len(rows), width), dtype=dtype)
+    for i, r in enumerate(rows):
+        r = r[:width]
+        out[i, : len(r)] = r
+    return out

@@ -1,0 +1,314 @@
+"""ClusterInfo -> dense SnapshotArrays packing.
+
+The host-side half of the cycle: flatten the object snapshot into the
+struct-of-array schema. The reference's equivalent moment is
+SchedulerCache.Snapshot deep-copying maps (cache.go:712-811); here the copy IS
+the pack, and the result is what gets shipped to the device.
+
+Known encoding divergences from the reference (documented per SURVEY section 7
+hard part 3):
+- Node-affinity required terms are encoded as a single all-of label-hash set
+  (match-labels style); multi-term OR expressions collapse to their union.
+- InterPodAffinity is approximated by the task-topology plugin's bucket
+  scoring rather than arbitrary pod label selectors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import (CPU, MEMORY, ClusterInfo, JobInfo, PodGroupPhase,
+                   QueueState, TaskStatus)
+from ..api.job_info import Toleration
+from . import labels as L
+from .schema import (IndexMaps, JobArrays, NodeArrays, QueueArrays,
+                     SnapshotArrays, TaskArrays, bucket, pad_rows)
+
+#: Statuses whose resreq counts as ready/occupying (api/types.go:87-96 + Succeeded).
+_READY_STATUSES = (TaskStatus.ALLOCATED, TaskStatus.BINDING, TaskStatus.BOUND,
+                   TaskStatus.RUNNING, TaskStatus.SUCCEEDED)
+
+
+def resource_dims(ci: ClusterInfo) -> List[str]:
+    """Stable resource-dimension order: cpu, memory, then sorted scalars."""
+    names = {CPU, MEMORY}
+    for node in ci.nodes.values():
+        names.update(node.allocatable.resource_names())
+    for job in ci.jobs.values():
+        names.update(job.min_resources.resource_names())
+        for task in job.tasks.values():
+            names.update(task.resreq.resource_names())
+    for queue in ci.queues.values():
+        names.update(queue.capability.resource_names())
+    scalars = sorted(n for n in names if n not in (CPU, MEMORY))
+    return [CPU, MEMORY] + scalars
+
+
+def _vec(res, dims: List[str]) -> np.ndarray:
+    return np.array([res.get(d) for d in dims], dtype=np.float32)
+
+
+def _toleration_rows(tols: List[Toleration]) -> Tuple[List[int], List[int], List[int]]:
+    hashes, effects, modes = [], [], []
+    for t in tols:
+        eff = L.effect_code(t.effect)
+        if t.operator == "Exists":
+            if not t.key:
+                hashes.append(1); effects.append(eff); modes.append(L.TOL_EXISTS_ALL)
+            else:
+                hashes.append(L.stable_hash(t.key)); effects.append(eff)
+                modes.append(L.TOL_EXISTS_KEY)
+        else:
+            hashes.append(L.stable_hash(f"{t.key}={t.value}"))
+            effects.append(eff); modes.append(L.TOL_EQUAL)
+    return hashes, effects, modes
+
+
+def pack(ci: ClusterInfo,
+         buckets: Optional[Dict[str, int]] = None) -> Tuple[SnapshotArrays, IndexMaps]:
+    """Flatten a ClusterInfo into padded, masked device arrays."""
+    buckets = buckets or {}
+    dims = resource_dims(ci)
+    R = len(dims)
+    inf = np.float32(np.inf)
+
+    maps = IndexMaps(resource_names=dims)
+
+    # ---------------------------------------------------------------- queues
+    queue_names = sorted(ci.queues)
+    maps.queue_names = queue_names
+    maps.queue_index = {n: i for i, n in enumerate(queue_names)}
+    nq = len(queue_names)
+    Q = bucket(max(nq, 1), buckets.get("Q", 4))
+    q_weight = np.zeros(Q, np.float32)
+    q_cap = np.full((Q, R), inf, np.float32)
+    q_reclaimable = np.zeros(Q, bool)
+    q_open = np.zeros(Q, bool)
+    for i, name in enumerate(queue_names):
+        q = ci.queues[name]
+        q_weight[i] = max(q.weight, 0)
+        if q.capability.quantities:
+            cap = _vec(q.capability, dims)
+            # unset dims stay unbounded (proportion.go clamps by capability
+            # only where declared)
+            declared = np.array([d in q.capability.quantities for d in dims])
+            q_cap[i] = np.where(declared, cap, inf)
+        q_reclaimable[i] = q.reclaimable
+        q_open[i] = q.state == QueueState.OPEN
+
+    # hierarchy tree (fork's hdrf): build parent pointers from paths
+    q_parent = np.full(Q, -1, np.int32)
+    q_depth = np.zeros(Q, np.int32)
+    path_of = {name: ci.queues[name].hierarchy_path() for name in queue_names}
+    for i, name in enumerate(queue_names):
+        path = path_of[name]
+        q_depth[i] = max(len(path) - 1, 0)
+        if len(path) > 1:
+            # parent is the queue whose path is path[:-1]; if none exists the
+            # queue is treated as a root child
+            for j, other in enumerate(queue_names):
+                if path_of[other] == path[:-1]:
+                    q_parent[i] = j
+                    break
+
+    # ------------------------------------------------------------ namespaces
+    ns_names = sorted(ci.namespaces) or ["default"]
+    maps.namespace_names = ns_names
+    ns_index = {n: i for i, n in enumerate(ns_names)}
+    S = bucket(len(ns_names), buckets.get("S", 4))
+    ns_weight = np.ones(S, np.float32)
+    for i, n in enumerate(ns_names):
+        ns_weight[i] = max(ci.namespaces[n].weight if n in ci.namespaces else 1, 1)
+
+    # ----------------------------------------------------------------- nodes
+    node_names = sorted(ci.nodes)
+    maps.node_names = node_names
+    maps.node_index = {n: i for i, n in enumerate(node_names)}
+    nn = len(node_names)
+    N = bucket(max(nn, 1), buckets.get("N", 8))
+    n_idle = np.zeros((N, R), np.float32)
+    n_used = np.zeros((N, R), np.float32)
+    n_rel = np.zeros((N, R), np.float32)
+    n_pip = np.zeros((N, R), np.float32)
+    n_alloc = np.zeros((N, R), np.float32)
+    n_capab = np.zeros((N, R), np.float32)
+    n_podcount = np.zeros(N, np.int32)
+    n_maxpods = np.zeros(N, np.int32)
+    n_sched = np.zeros(N, bool)
+    n_valid = np.zeros(N, bool)
+    label_rows, taint_kv_rows, taint_key_rows, taint_eff_rows = [], [], [], []
+    for i, name in enumerate(node_names):
+        node = ci.nodes[name]
+        n_idle[i] = _vec(node.idle, dims)
+        n_used[i] = _vec(node.used, dims)
+        n_rel[i] = _vec(node.releasing, dims)
+        n_pip[i] = _vec(node.pipelined, dims)
+        n_alloc[i] = _vec(node.allocatable, dims)
+        n_capab[i] = _vec(node.capability, dims)
+        n_podcount[i] = node.pod_count()
+        n_maxpods[i] = node.max_pods
+        n_sched[i] = node.ready and not node.unschedulable
+        n_valid[i] = True
+        label_rows.append(L.label_hashes(node.labels))
+        taint_kv_rows.append([L.stable_hash(f"{t.key}={t.value}") for t in node.taints])
+        taint_key_rows.append([L.stable_hash(t.key) for t in node.taints])
+        taint_eff_rows.append([L.effect_code(t.effect) for t in node.taints])
+    n_labels = pad_rows(L.pack_hash_rows(label_rows or [[]]), N)
+    n_taint_kv = pad_rows(L.pack_hash_rows(taint_kv_rows or [[]]), N)
+    n_taint_key = pad_rows(L.pack_hash_rows(taint_key_rows or [[]]), N)
+    n_taint_eff = pad_rows(L.pack_hash_rows(taint_eff_rows or [[]]), N)
+
+    nodes = NodeArrays(
+        idle=n_idle, used=n_used, releasing=n_rel, pipelined=n_pip,
+        allocatable=n_alloc, capability=n_capab, labels=n_labels,
+        taint_kv=n_taint_kv, taint_key=n_taint_key, taint_effect=n_taint_eff,
+        pod_count=n_podcount, max_pods=n_maxpods, schedulable=n_sched,
+        valid=n_valid)
+
+    # ------------------------------------------------------- jobs and tasks
+    job_uids = sorted(ci.jobs)
+    maps.job_uids = job_uids
+    maps.job_index = {u: i for i, u in enumerate(job_uids)}
+    nj = len(job_uids)
+    J = bucket(max(nj, 1), buckets.get("J", 4))
+
+    task_entries = []  # (job_idx, TaskInfo, insertion_rank)
+    for ji, uid in enumerate(job_uids):
+        for rank, task in enumerate(ci.jobs[uid].tasks.values()):
+            task_entries.append((ji, task, rank))
+    nt = len(task_entries)
+    T = bucket(max(nt, 1), buckets.get("T", 8))
+
+    t_resreq = np.zeros((T, R), np.float32)
+    t_job = np.full(T, -1, np.int32)
+    t_status = np.zeros(T, np.int32)
+    t_priority = np.zeros(T, np.int32)
+    t_node = np.full(T, -1, np.int32)
+    t_best_effort = np.zeros(T, bool)
+    t_preempt = np.zeros(T, bool)
+    t_valid = np.zeros(T, bool)
+    sel_rows, tolh_rows, tole_rows, tolm_rows = [], [], [], []
+    maps.task_uids = []
+    for ti, (ji, task, _rank) in enumerate(task_entries):
+        maps.task_uids.append(task.uid)
+        maps.task_index[task.uid] = ti
+        t_resreq[ti] = _vec(task.resreq, dims)
+        t_job[ti] = ji
+        t_status[ti] = int(task.status)
+        t_priority[ti] = task.priority
+        t_node[ti] = maps.node_index.get(task.node_name, -1)
+        t_best_effort[ti] = task.best_effort
+        t_preempt[ti] = task.preemptable
+        t_valid[ti] = True
+        required = dict(task.node_selector)
+        for term in task.affinity_required:
+            required.update(term)
+        sel_rows.append(sorted(L.stable_hash(f"{k}={v}")
+                               for k, v in required.items()))
+        h, e, m = _toleration_rows(task.tolerations)
+        tolh_rows.append(h); tole_rows.append(e); tolm_rows.append(m)
+    t_selector = pad_rows(L.pack_hash_rows(sel_rows or [[]]), T)
+    t_tol_hash = pad_rows(L.pack_hash_rows(tolh_rows or [[]]), T)
+    t_tol_eff = pad_rows(L.pack_hash_rows(tole_rows or [[]]), T)
+    t_tol_mode = pad_rows(L.pack_hash_rows(tolm_rows or [[]]), T)
+
+    tasks = TaskArrays(
+        resreq=t_resreq, job=t_job, status=t_status, priority=t_priority,
+        node=t_node, selector=t_selector, tol_hash=t_tol_hash,
+        tol_effect=t_tol_eff, tol_mode=t_tol_mode, best_effort=t_best_effort,
+        preemptable=t_preempt, valid=t_valid)
+
+    j_minavail = np.zeros(J, np.int32)
+    j_queue = np.zeros(J, np.int32)
+    j_ns = np.zeros(J, np.int32)
+    j_priority = np.zeros(J, np.int32)
+    j_created = np.zeros(J, np.int32)
+    j_ready = np.zeros(J, np.int32)
+    j_allocated = np.zeros((J, R), np.float32)
+    j_request = np.zeros((J, R), np.float32)
+    j_minres = np.zeros((J, R), np.float32)
+    j_npending = np.zeros(J, np.int32)
+    j_sched = np.zeros(J, bool)
+    j_inqueue = np.zeros(J, bool)
+    j_pending_phase = np.zeros(J, bool)
+    j_preempt = np.zeros(J, bool)
+    j_valid = np.zeros(J, bool)
+
+    order = {u: r for r, u in enumerate(
+        sorted(job_uids, key=lambda u: ci.jobs[u].creation_timestamp))}
+    pending_lists: List[List[int]] = [[] for _ in range(J)]
+    for ti, (ji, task, _rank) in enumerate(task_entries):
+        if task.status == TaskStatus.PENDING:
+            pending_lists[ji].append(ti)
+    j_queue_known = np.zeros(J, bool)
+    for ji, uid in enumerate(job_uids):
+        job = ci.jobs[uid]
+        j_minavail[ji] = job.min_available
+        j_queue[ji] = maps.queue_index.get(job.queue, 0)
+        j_queue_known[ji] = job.queue in maps.queue_index
+        j_ns[ji] = ns_index.get(job.namespace, 0)
+        j_priority[ji] = job.priority
+        j_created[ji] = order[uid]
+        j_ready[ji] = job.ready_task_num()
+        j_allocated[ji] = _vec(job.allocated, dims)
+        j_request[ji] = _vec(job.total_request, dims)
+        j_minres[ji] = _vec(job.min_resources, dims)
+        # task order within job: priority desc, then insertion order
+        # (reference: priority plugin TaskOrderFn, priority.go:63)
+        pending_lists[ji].sort(key=lambda ti: (-t_priority[ti], ti))
+        j_npending[ji] = len(pending_lists[ji])
+        gang_valid, _ = job.is_valid()
+        qi = maps.queue_index.get(job.queue)
+        queue_open = qi is not None and bool(q_open[qi])
+        j_pending_phase[ji] = job.pod_group_phase == PodGroupPhase.PENDING
+        j_inqueue[ji] = not j_pending_phase[ji]
+        j_sched[ji] = gang_valid and queue_open and j_inqueue[ji]
+        j_preempt[ji] = job.preemptable
+        j_valid[ji] = True
+
+    M = bucket(max((len(p) for p in pending_lists), default=1),
+               buckets.get("M", 4))
+    j_table = np.full((J, M), -1, np.int32)
+    for ji, plist in enumerate(pending_lists):
+        j_table[ji, : len(plist)] = plist[:M]
+
+    jobs = JobArrays(
+        min_available=j_minavail, queue=j_queue, namespace=j_ns,
+        priority=j_priority, creation_rank=j_created, ready_num=j_ready,
+        allocated=j_allocated, total_request=j_request, min_resources=j_minres,
+        task_table=j_table, n_pending=j_npending, schedulable=j_sched,
+        inqueue=j_inqueue, pending_phase=j_pending_phase,
+        preemptable=j_preempt, valid=j_valid)
+
+    # queue aggregates (reference: proportion.OnSessionOpen sums member jobs,
+    # proportion.go:95-139)
+    q_allocated = np.zeros((Q, R), np.float32)
+    q_request = np.zeros((Q, R), np.float32)
+    q_inqueue_minres = np.zeros((Q, R), np.float32)
+    for ji in range(nj):
+        if not j_queue_known[ji]:
+            # jobs in unknown/deleted queues are unschedulable (pack leaves
+            # j_sched False above) and must not pollute queue aggregates
+            continue
+        qi = j_queue[ji]
+        q_allocated[qi] += j_allocated[ji]
+        q_request[qi] += j_request[ji]
+        if j_inqueue[ji]:
+            q_inqueue_minres[qi] += j_minres[ji]
+    q_valid = np.zeros(Q, bool)
+    q_valid[:nq] = True
+
+    queues = QueueArrays(
+        weight=q_weight, capability=q_cap, reclaimable=q_reclaimable,
+        open=q_open, allocated=q_allocated, request=q_request,
+        inqueue_minres=q_inqueue_minres, parent=q_parent, depth=q_depth,
+        valid=q_valid)
+
+    snap = SnapshotArrays(
+        nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,
+        namespace_weight=ns_weight,
+        cluster_capacity=n_alloc[:nn].sum(axis=0) if nn else np.zeros(R, np.float32),
+    )
+    return snap, maps

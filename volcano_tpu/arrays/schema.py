@@ -1,0 +1,182 @@
+"""Dense array schema of a cluster snapshot — the device-side world state.
+
+This is the TPU re-design of the reference's per-cycle Snapshot
+(pkg/scheduler/cache/cache.go:712-811 producing api.ClusterInfo): instead of
+maps of pointers, the session operates on struct-of-array tensors with validity
+masks. All shapes are static per bucket so XLA compiles the cycle once per
+(N, T, J, Q, R) bucket (SURVEY.md section 7).
+
+Axis legend: N nodes, T tasks, J jobs, Q queues, S namespaces, R resource dims,
+L label slots, K selector slots, E taint slots, O toleration slots, M max
+pending tasks per job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+
+def _register(cls):
+    """Register a dataclass as a JAX pytree (all fields are children)."""
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+    return cls
+
+
+@_register
+@dataclass
+class NodeArrays:
+    """Per-node accounting tensors (reference: api.NodeInfo, node_info.go:28-437)."""
+
+    idle: jax.Array          # f32[N, R]
+    used: jax.Array          # f32[N, R]
+    releasing: jax.Array     # f32[N, R]
+    pipelined: jax.Array     # f32[N, R]
+    allocatable: jax.Array   # f32[N, R]
+    capability: jax.Array    # f32[N, R]
+    labels: jax.Array        # i32[N, L]  label key=value hashes, 0 pad
+    taint_kv: jax.Array      # i32[N, E]  taint key=value hashes, 0 pad
+    taint_key: jax.Array     # i32[N, E]  taint key hashes
+    taint_effect: jax.Array  # i32[N, E]  effect codes (labels.EFFECT_*)
+    pod_count: jax.Array     # i32[N]
+    max_pods: jax.Array      # i32[N]
+    schedulable: jax.Array   # bool[N]  ready && !unschedulable
+    valid: jax.Array         # bool[N]
+
+    @property
+    def n(self) -> int:
+        return self.idle.shape[0]
+
+    def future_idle(self) -> jax.Array:
+        """idle + releasing - pipelined, floored at 0 (node_info.go:62-65)."""
+        import jax.numpy as jnp
+        return jnp.maximum(self.idle + self.releasing - self.pipelined, 0.0)
+
+
+@_register
+@dataclass
+class TaskArrays:
+    """Per-task tensors (reference: api.TaskInfo, job_info.go:70-171)."""
+
+    resreq: jax.Array        # f32[T, R]
+    job: jax.Array           # i32[T] job index
+    status: jax.Array        # i32[T] TaskStatus codes
+    priority: jax.Array      # i32[T]
+    node: jax.Array          # i32[T] current node index, -1 unassigned
+    selector: jax.Array      # i32[T, K] required label hashes, 0 pad
+    tol_hash: jax.Array      # i32[T, O] toleration match hashes
+    tol_effect: jax.Array    # i32[T, O] effect codes (0 = all effects)
+    tol_mode: jax.Array      # i32[T, O] labels.TOL_* modes
+    best_effort: jax.Array   # bool[T] empty resreq (backfill targets)
+    preemptable: jax.Array   # bool[T]
+    valid: jax.Array         # bool[T]
+
+    @property
+    def t(self) -> int:
+        return self.resreq.shape[0]
+
+
+@_register
+@dataclass
+class JobArrays:
+    """Per-gang-job tensors (reference: api.JobInfo, job_info.go:181-613)."""
+
+    min_available: jax.Array  # i32[J]
+    queue: jax.Array          # i32[J] queue index
+    namespace: jax.Array      # i32[J]
+    priority: jax.Array       # i32[J]
+    creation_rank: jax.Array  # i32[J] older = smaller (FIFO tie-break)
+    ready_num: jax.Array      # i32[J] tasks already in ready statuses
+    allocated: jax.Array      # f32[J, R] resources of allocated-status tasks
+    total_request: jax.Array  # f32[J, R]
+    min_resources: jax.Array  # f32[J, R] PodGroup MinResources (enqueue gate)
+    task_table: jax.Array     # i32[J, M] pending task indices sorted by task
+    #                           order (priority desc, creation), -1 pad
+    n_pending: jax.Array      # i32[J]
+    schedulable: jax.Array    # bool[J] gang-valid && queue open && inqueue
+    inqueue: jax.Array        # bool[J] PodGroup phase is Inqueue/Running
+    pending_phase: jax.Array  # bool[J] PodGroup phase is Pending (enqueue input)
+    preemptable: jax.Array    # bool[J]
+    valid: jax.Array          # bool[J]
+
+    @property
+    def j(self) -> int:
+        return self.min_available.shape[0]
+
+    @property
+    def m(self) -> int:
+        return self.task_table.shape[1]
+
+
+@_register
+@dataclass
+class QueueArrays:
+    """Per-queue tensors (reference: api.QueueInfo + proportion queueAttr,
+    pkg/scheduler/plugins/proportion/proportion.go:59-90)."""
+
+    weight: jax.Array       # f32[Q]
+    capability: jax.Array   # f32[Q, R] +inf where unset
+    reclaimable: jax.Array  # bool[Q]
+    open: jax.Array         # bool[Q]
+    allocated: jax.Array    # f32[Q, R] sum of member jobs' allocated
+    request: jax.Array      # f32[Q, R] sum of member jobs' total_request
+    inqueue_minres: jax.Array  # f32[Q, R] sum of MinResources of inqueue jobs
+    # Hierarchical fairness (fork's hdrf): parent pointer tree, root = self.
+    parent: jax.Array       # i32[Q] parent queue index (-1 for roots)
+    depth: jax.Array        # i32[Q]
+    valid: jax.Array        # bool[Q]
+
+    @property
+    def q(self) -> int:
+        return self.weight.shape[0]
+
+
+@_register
+@dataclass
+class SnapshotArrays:
+    """The full device-side snapshot consumed by the compiled cycle."""
+
+    nodes: NodeArrays
+    tasks: TaskArrays
+    jobs: JobArrays
+    queues: QueueArrays
+    namespace_weight: jax.Array   # f32[S]
+    cluster_capacity: jax.Array   # f32[R] sum of node allocatable
+
+
+@dataclass
+class IndexMaps:
+    """Host-side decode tables (NOT a pytree; never crosses to device)."""
+
+    node_names: List[str] = field(default_factory=list)
+    task_uids: List[str] = field(default_factory=list)
+    job_uids: List[str] = field(default_factory=list)
+    queue_names: List[str] = field(default_factory=list)
+    namespace_names: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    node_index: Dict[str, int] = field(default_factory=dict)
+    task_index: Dict[str, int] = field(default_factory=dict)
+    job_index: Dict[str, int] = field(default_factory=dict)
+    queue_index: Dict[str, int] = field(default_factory=dict)
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two (static-shape bucketing; SURVEY
+    section 7 hard part 2)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    """Zero-pad axis 0 of ``a`` to length n."""
+    if a.shape[0] == n:
+        return a
+    pad = [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+    return np.pad(a, pad)

@@ -1,0 +1,11 @@
+"""Jittable scheduling kernels (the compute path of the framework)."""
+
+from .allocate_scan import (MODE_ALLOCATED, MODE_NONE, MODE_PIPELINED,
+                            AllocateConfig, AllocateResult, make_allocate_cycle)
+from .select import best_node, lex_argmin, sort_order
+
+__all__ = [
+    "AllocateConfig", "AllocateResult", "make_allocate_cycle",
+    "MODE_NONE", "MODE_ALLOCATED", "MODE_PIPELINED",
+    "best_node", "lex_argmin", "sort_order",
+]

@@ -1,0 +1,295 @@
+"""The allocate action as one compiled array program.
+
+TPU re-design of the reference's dominant pass
+(pkg/scheduler/actions/allocate/allocate.go:43-281 plus the Statement
+commit/discard transaction, framework/statement.go:27-395):
+
+- The four nested priority queues (namespace -> queue -> job -> task,
+  allocate.go:60-118) become a lexicographic masked argmin over key vectors
+  recomputed every outer iteration — queue share ordering stays *dynamic*
+  exactly like the reference, where proportion's event handlers bump queue
+  share as tasks place (proportion.go:281-325).
+- PredicateNodes + PrioritizeNodes + SelectBestNode
+  (util/scheduler_helper.go:74-228) become a fused feasibility-mask ->
+  score-sum -> argmax step over the node axis.
+- Statement.Allocate/Pipeline with gang Commit/Discard (statement.go:229-395)
+  becomes: the inner scan mutates capacity arrays; after a job's tasks are
+  tried, JobReady commits by promoting the working state to the saved state,
+  JobPipelined keeps capacity held without emitting binds, and Discard is a
+  copy-back of the saved state (pure-functional undo).
+
+Semantics preserved: a task allocates when it fits current idle, pipelines
+when it fits future idle (idle + releasing - pipelined, allocate.go:200-240);
+gang all-or-nothing per PodGroup minAvailable; overused queues are skipped
+(proportion Overused, proportion.go:240-253).
+
+Documented divergence: score ties break to the lowest node index instead of
+rand.Intn (scheduler_helper.go:227) — the reference is nondeterministic there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..arrays.schema import SnapshotArrays
+from . import predicates as P
+from . import scoring as S
+from .select import NEG, lex_argmin
+
+#: task placement modes in the result arrays
+MODE_NONE = 0
+MODE_ALLOCATED = 1   # bind now (fits idle)
+MODE_PIPELINED = 2   # placed on releasing capacity, no bind yet
+
+
+@dataclass(frozen=True)
+class AllocateConfig:
+    """Static kernel-composition config (the analog of the conf YAML tiers +
+    plugin arguments, pkg/scheduler/conf/scheduler_conf.go:20-82)."""
+
+    binpack_weight: float = 0.0          # binpack.weight (binpack.go:85-151)
+    least_allocated_weight: float = 1.0  # nodeorder leastrequested.weight
+    most_allocated_weight: float = 0.0   # nodeorder mostrequested.weight
+    balanced_weight: float = 1.0         # nodeorder balanced.weight
+    taint_prefer_weight: float = 1.0     # nodeorder tainttoleration.weight
+    enable_pipelining: bool = True       # allow placement on FutureIdle
+    enable_gang: bool = True             # gang all-or-nothing semantics
+    max_rounds: Optional[int] = None     # cap on outer job iterations
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class AllocateResult:
+    task_node: jax.Array       # i32[T] node index or -1
+    task_mode: jax.Array       # i32[T] MODE_*
+    job_ready: jax.Array       # bool[J] gang became ready (binds emitted)
+    job_pipelined: jax.Array   # bool[J] gang holds capacity, no binds
+    job_attempted: jax.Array   # bool[J] job was popped this cycle
+    idle: jax.Array            # f32[N, R] remaining idle after the pass
+    queue_allocated: jax.Array  # f32[Q, R] post-pass queue usage
+
+
+def _score_fn(cfg: AllocateConfig, snap: SnapshotArrays, resreq, idle,
+              tol_hash, tol_effect, tol_mode):
+    """Weighted additive node score — the PrioritizeNodes reduce
+    (scheduler_helper.go:133-195) with plugin weights folded in."""
+    nodes = snap.nodes
+    used_dyn = nodes.allocatable - idle
+    resource_w = jnp.ones_like(resreq)
+    score = jnp.zeros(idle.shape[0], jnp.float32)
+    if cfg.binpack_weight:
+        score += cfg.binpack_weight * S.binpack_score(
+            used_dyn, nodes.allocatable, resreq, resource_w)
+    if cfg.least_allocated_weight:
+        score += cfg.least_allocated_weight * S.least_allocated_score(
+            used_dyn, nodes.allocatable, resreq)
+    if cfg.most_allocated_weight:
+        score += cfg.most_allocated_weight * S.most_allocated_score(
+            used_dyn, nodes.allocatable, resreq)
+    if cfg.balanced_weight:
+        score += cfg.balanced_weight * S.balanced_allocation_score(
+            used_dyn, nodes.allocatable, resreq)
+    if cfg.taint_prefer_weight:
+        score += cfg.taint_prefer_weight * S.taint_prefer_score(
+            tol_hash, tol_effect, tol_mode, nodes)
+    return score
+
+
+def make_allocate_cycle(cfg: AllocateConfig):
+    """Build the jittable allocate pass for a given static config.
+
+    Returned signature:
+        allocate(snap, job_share, queue_deserved, ns_share) -> AllocateResult
+    where job_share f32[J] is the DRF share ordering key (zeros when drf is
+    off), queue_deserved f32[Q, R] is proportion's deserved share (+inf when
+    proportion is off), and ns_share f32[S] is the weighted namespace share
+    (drf namespaceOrderFn, drf.go:474-507; zeros when namespace fairness is
+    off — namespaces then order by index, i.e. by name, like the reference's
+    fallback).
+    """
+
+    def allocate(snap: SnapshotArrays, job_share: jax.Array,
+                 queue_deserved: jax.Array,
+                 ns_share: jax.Array) -> AllocateResult:
+        snap = jax.tree.map(jnp.asarray, snap)
+        job_share = jnp.asarray(job_share)
+        queue_deserved = jnp.asarray(queue_deserved)
+        ns_share = jnp.asarray(ns_share)
+        nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
+        N, R = nodes.idle.shape
+        T = tasks.resreq.shape[0]
+        J, M = jobs.task_table.shape
+
+        init = dict(
+            idle=nodes.idle,
+            pipe_extra=jnp.zeros((N, R), jnp.float32),
+            pods_extra=jnp.zeros(N, jnp.int32),
+            saved_idle=nodes.idle,
+            saved_pipe=jnp.zeros((N, R), jnp.float32),
+            saved_pods=jnp.zeros(N, jnp.int32),
+            task_node=jnp.full(T, -1, jnp.int32),
+            task_mode=jnp.zeros(T, jnp.int32),
+            job_done=jnp.zeros(J, bool),
+            job_ready=jnp.zeros(J, bool),
+            job_pipelined=jnp.zeros(J, bool),
+            queue_allocated=queues.allocated,
+            rounds=jnp.int32(0),
+        )
+
+        max_rounds = cfg.max_rounds or J
+
+        def eligible(st):
+            # Overused queues are skipped (proportion.Overused,
+            # proportion.go:240-253): allocated >= deserved on every dim.
+            overused = jnp.all(st["queue_allocated"] >= queue_deserved - 1e-6,
+                               axis=-1)
+            job_overused = overused[jobs.queue]
+            return (jobs.valid & jobs.schedulable & ~st["job_done"]
+                    & (jobs.n_pending > 0) & ~job_overused)
+
+        def cond(st):
+            return jnp.any(eligible(st)) & (st["rounds"] < max_rounds)
+
+        def body(st):
+            elig = eligible(st)
+
+            # ---- job selection: lexicographic pop of ns->queue->job PQs ----
+            # Queue share: max over dims of allocated/deserved (proportion
+            # queueOrderFn, proportion.go:198-212); neutral when deserved=inf.
+            qshare = jnp.max(
+                jnp.where(jnp.isfinite(queue_deserved) & (queue_deserved > 0),
+                          st["queue_allocated"] / jnp.maximum(queue_deserved, 1e-9),
+                          0.0),
+                axis=-1)
+            job_q = jobs.queue
+            job_ns = jobs.namespace
+            ready_now = (jobs.ready_num >= jobs.min_available) & (jobs.min_available > 0)
+            keys = [
+                ns_share[job_ns],                    # namespace order (drf ns fairness)
+                job_ns.astype(jnp.float32),          # namespace tie-break (by name)
+                qshare[job_q],                       # queue order (proportion)
+                job_q.astype(jnp.float32),           # queue tie-break
+                -jobs.priority.astype(jnp.float32),  # priority plugin JobOrderFn
+                ready_now.astype(jnp.float32),       # gang: ready jobs last
+                job_share,                           # drf JobOrderFn
+                jobs.creation_rank.astype(jnp.float32),  # FIFO fallback
+            ]
+            ji, _found = lex_argmin(keys, elig)
+
+            task_ids = jobs.task_table[ji]           # i32[M]
+            min_avail = jobs.min_available[ji]
+            ready0 = jobs.ready_num[ji]
+
+            # ---- inner scan: try every pending task of the job ------------
+            def task_step(carry, t_idx):
+                idle, pipe_extra, pods_extra, t_node, t_mode, n_alloc, n_pipe = carry
+                active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
+                t = jnp.maximum(t_idx, 0)
+                resreq = tasks.resreq[t]
+                sel = tasks.selector[t]
+                th, te, tm = tasks.tol_hash[t], tasks.tol_effect[t], tasks.tol_mode[t]
+
+                future = jnp.maximum(
+                    idle + nodes.releasing - nodes.pipelined - pipe_extra, 0.0)
+                feas_now = P.feasible(nodes, resreq, sel, th, te, tm, idle,
+                                      pods_extra)
+                feas_fut = P.feasible(nodes, resreq, sel, th, te, tm, future,
+                                      pods_extra)
+                score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
+
+                m_now = jnp.where(feas_now & active, score, NEG)
+                m_fut = jnp.where(feas_fut & active, score, NEG)
+                n_now = jnp.argmax(m_now).astype(jnp.int32)
+                n_fut = jnp.argmax(m_fut).astype(jnp.int32)
+                can_now = jnp.any(feas_now) & active
+                can_fut = (jnp.any(feas_fut) & active
+                           & jnp.bool_(cfg.enable_pipelining))
+
+                do_alloc = can_now
+                do_pipe = ~can_now & can_fut
+                node = jnp.where(do_alloc, n_now, n_fut)
+
+                delta = jnp.where(do_alloc, 1.0, 0.0) * resreq
+                idle = idle.at[node].add(-delta)
+                pipe_delta = jnp.where(do_pipe, 1.0, 0.0) * resreq
+                pipe_extra = pipe_extra.at[node].add(pipe_delta)
+                pods_extra = pods_extra.at[node].add(
+                    jnp.where(do_alloc | do_pipe, 1, 0))
+                t_node = t_node.at[t].set(
+                    jnp.where(do_alloc | do_pipe, node, t_node[t]))
+                t_mode = t_mode.at[t].set(
+                    jnp.where(do_alloc, MODE_ALLOCATED,
+                              jnp.where(do_pipe, MODE_PIPELINED, t_mode[t])))
+                n_alloc += jnp.where(do_alloc, 1, 0)
+                n_pipe += jnp.where(do_pipe, 1, 0)
+                return (idle, pipe_extra, pods_extra, t_node, t_mode,
+                        n_alloc, n_pipe), None
+
+            carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
+                      st["task_node"], st["task_mode"],
+                      jnp.int32(0), jnp.int32(0))
+            (idle, pipe_extra, pods_extra, t_node, t_mode,
+             n_alloc, n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
+
+            # ---- gang finalize: JobReady / JobPipelined / Discard ---------
+            ready = (ready0 + n_alloc) >= min_avail
+            pipelined = (ready0 + n_alloc + n_pipe) >= min_avail
+            if not cfg.enable_gang:
+                ready = jnp.bool_(True)
+            keep = ready | pipelined
+
+            # Discard = restore saved state and clear this job's placements
+            # (statement.go:352-374 reverse-order undo, here a pure copy-back).
+            job_tasks = tasks.job == ji
+            idle = jnp.where(keep, idle, st["saved_idle"])
+            pipe_extra = jnp.where(keep, pipe_extra, st["saved_pipe"])
+            pods_extra = jnp.where(keep, pods_extra, st["saved_pods"])
+            t_node = jnp.where(keep | ~job_tasks, t_node,
+                               jnp.full_like(t_node, -1))
+            t_mode = jnp.where(keep | ~job_tasks, t_mode,
+                               jnp.zeros_like(t_mode))
+
+            # Commit promotes working state to saved (statement.go:377-395);
+            # pipelined jobs also hold their capacity in-session.
+            saved_idle = jnp.where(keep, idle, st["saved_idle"])
+            saved_pipe = jnp.where(keep, pipe_extra, st["saved_pipe"])
+            saved_pods = jnp.where(keep, pods_extra, st["saved_pods"])
+
+            # queue accounting for the share ordering (proportion event
+            # handlers on Allocate, proportion.go:281-325)
+            placed_mask = job_tasks & (t_mode != MODE_NONE)
+            placed_res = jnp.sum(
+                jnp.where(placed_mask[:, None], tasks.resreq, 0.0), axis=0)
+            qi = jobs.queue[ji]
+            queue_allocated = st["queue_allocated"].at[qi].add(
+                jnp.where(keep, 1.0, 0.0) * placed_res)
+
+            return dict(
+                idle=idle, pipe_extra=pipe_extra, pods_extra=pods_extra,
+                saved_idle=saved_idle, saved_pipe=saved_pipe,
+                saved_pods=saved_pods, task_node=t_node, task_mode=t_mode,
+                job_done=st["job_done"].at[ji].set(True),
+                job_ready=st["job_ready"].at[ji].set(ready),
+                job_pipelined=st["job_pipelined"].at[ji].set(
+                    pipelined & ~ready),
+                queue_allocated=queue_allocated,
+                rounds=st["rounds"] + 1,
+            )
+
+        final = jax.lax.while_loop(cond, body, init)
+        return AllocateResult(
+            task_node=final["task_node"],
+            task_mode=final["task_mode"],
+            job_ready=final["job_ready"],
+            job_pipelined=final["job_pipelined"],
+            job_attempted=final["job_done"],
+            idle=final["idle"],
+            queue_allocated=final["queue_allocated"],
+        )
+
+    return allocate

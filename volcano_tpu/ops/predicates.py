@@ -1,0 +1,91 @@
+"""Feasibility-mask kernels: one task vs all nodes, vectorized.
+
+TPU re-design of the reference's predicate plugins
+(pkg/scheduler/plugins/predicates/predicates.go:181-288 wrapping the k8s
+filters NodeUnschedulable, NodeAffinity, NodePorts, TaintToleration + pod
+count) and of the parallel PredicateNodes helper
+(pkg/scheduler/util/scheduler_helper.go:74-130): the 16-goroutine fan-out
+becomes a single masked vector op over the node axis.
+
+All functions are shape-polymorphic jittable JAX; none contain Python control
+flow on traced values.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..arrays.labels import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE, TOL_EQUAL,
+                             TOL_EXISTS_ALL, TOL_EXISTS_KEY)
+from ..arrays.schema import NodeArrays
+
+_EPS = 1e-5
+
+
+def resource_fit(resreq: jax.Array, avail: jax.Array) -> jax.Array:
+    """bool[N]: does ``resreq`` f32[R] fit into ``avail`` f32[N, R]?
+
+    Matches Resource.LessEqual zero semantics (resource_info.go:376-414):
+    absent dims are zero in the packed vectors, so plain <= suffices.
+    """
+    return jnp.all(resreq[None, :] <= avail + _EPS, axis=-1)
+
+
+def selector_match(selector: jax.Array, node_labels: jax.Array) -> jax.Array:
+    """bool[N]: every nonzero required hash present in the node's label set.
+
+    Kernel form of nodeaffinity/nodeselector matching (predicates.go: the
+    NodeAffinity filter); selector i32[K], node_labels i32[N, L].
+    """
+    # present[n, k] = any_l labels[n, l] == selector[k]
+    present = jnp.any(node_labels[:, None, :] == selector[None, :, None], axis=-1)
+    return jnp.all((selector == 0)[None, :] | present, axis=-1)
+
+
+def taints_tolerated(tol_hash: jax.Array, tol_effect: jax.Array,
+                     tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
+    """bool[N]: no hard-effect node taint left untolerated.
+
+    Kernel form of the TaintToleration filter: a taint with effect NoSchedule
+    or NoExecute blocks unless some toleration matches it;
+    PreferNoSchedule never blocks (it only scores, see scoring.py).
+    tol_* are i32[O]; taint tensors are i32[N, E].
+    """
+    kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
+    # match[n, e, o]: toleration o covers taint e of node n
+    m_all = (tol_mode == TOL_EXISTS_ALL)[None, None, :]
+    m_key = ((tol_mode == TOL_EXISTS_KEY)[None, None, :]
+             & (key[:, :, None] == tol_hash[None, None, :]))
+    m_eq = ((tol_mode == TOL_EQUAL)[None, None, :]
+            & (kv[:, :, None] == tol_hash[None, None, :]))
+    eff_ok = ((tol_effect == 0)[None, None, :]
+              | (tol_effect[None, None, :] == eff[:, :, None]))
+    covered = jnp.any((m_all | m_key | m_eq) & eff_ok, axis=-1)  # [N, E]
+    hard = (eff == EFFECT_NO_SCHEDULE) | (eff == EFFECT_NO_EXECUTE)
+    return jnp.all(~hard | covered, axis=-1)
+
+
+def pod_count_fit(nodes: NodeArrays, extra: jax.Array | None = None) -> jax.Array:
+    """bool[N]: node has pod slots left (the CheckNodeUnschedulable +
+    pod-number predicate, predicates.go:213-230). ``extra`` i32[N] adds
+    in-cycle placements."""
+    count = nodes.pod_count if extra is None else nodes.pod_count + extra
+    return count < nodes.max_pods
+
+
+def feasible(nodes: NodeArrays, resreq: jax.Array, selector: jax.Array,
+             tol_hash: jax.Array, tol_effect: jax.Array, tol_mode: jax.Array,
+             avail: jax.Array, extra_pods: jax.Array | None = None) -> jax.Array:
+    """bool[N]: full predicate conjunction for one task against every node.
+
+    ``avail`` chooses the capacity view: current idle for immediate
+    allocation, future idle for pipelining (allocate.go:200-240 candidate
+    split vs Idle/FutureIdle).
+    """
+    return (nodes.valid
+            & nodes.schedulable
+            & pod_count_fit(nodes, extra_pods)
+            & resource_fit(resreq, avail)
+            & selector_match(selector, nodes.labels)
+            & taints_tolerated(tol_hash, tol_effect, tol_mode, nodes))

@@ -1,0 +1,104 @@
+"""Additive node-score kernels.
+
+TPU re-design of the reference's scoring plugins: binpack
+(pkg/scheduler/plugins/binpack/binpack.go:196-260), nodeorder's wrapped k8s
+scorers least/most-allocated and balanced-allocation
+(pkg/scheduler/plugins/nodeorder/nodeorder.go:219-271), the tainttoleration
+PreferNoSchedule score, and tdm's revocable-node bonus
+(pkg/scheduler/plugins/tdm/tdm.go:296). Each kernel returns f32[N]; the
+session sums them with configured weights, replacing the PrioritizeNodes
+map/reduce (pkg/scheduler/util/scheduler_helper.go:133-195).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..arrays.labels import (EFFECT_PREFER_NO_SCHEDULE, TOL_EQUAL,
+                             TOL_EXISTS_ALL, TOL_EXISTS_KEY)
+from ..arrays.schema import NodeArrays
+
+_EPS = 1e-9
+
+
+def binpack_score(used: jax.Array, allocatable: jax.Array, resreq: jax.Array,
+                  resource_weights: jax.Array) -> jax.Array:
+    """Best-fit score, higher = fuller node after placement.
+
+    Reference: BinPackingScore (binpack.go:196-260) — for each resource in the
+    task's request with a configured weight w_r:
+    ``score += (used_r + req_r) / allocatable_r * w_r``, normalized by the sum
+    of participating weights, scaled to 0-100.
+    used/allocatable f32[N,R], resreq f32[R], resource_weights f32[R].
+    """
+    applicable = (resreq > 0)[None, :] & (allocatable > 0) \
+        & (resource_weights > 0)[None, :]
+    frac = jnp.where(applicable, (used + resreq[None, :]) / jnp.maximum(allocatable, _EPS), 0.0)
+    over = frac > 1.0 + 1e-6  # request overflows this dim -> score 0 like reference
+    w = resource_weights[None, :] * applicable
+    wsum = jnp.sum(w, axis=-1)
+    raw = jnp.sum(frac * w, axis=-1) / jnp.maximum(wsum, _EPS)
+    raw = jnp.where(jnp.any(over, axis=-1), 0.0, raw)
+    return raw * 100.0
+
+
+def least_allocated_score(used: jax.Array, allocatable: jax.Array,
+                          resreq: jax.Array) -> jax.Array:
+    """Spread score, higher = emptier node after placement (k8s
+    NodeResourcesLeastAllocated as wrapped at nodeorder.go:219-240)."""
+    cap = jnp.maximum(allocatable, _EPS)
+    free_frac = (allocatable - used - resreq[None, :]) / cap
+    counted = allocatable > 0
+    n = jnp.maximum(jnp.sum(counted, axis=-1), 1)
+    return jnp.sum(jnp.clip(free_frac, 0.0, 1.0) * counted, axis=-1) / n * 100.0
+
+
+def most_allocated_score(used: jax.Array, allocatable: jax.Array,
+                         resreq: jax.Array) -> jax.Array:
+    """Packing score via k8s NodeResourcesMostAllocated (nodeorder.go)."""
+    cap = jnp.maximum(allocatable, _EPS)
+    used_frac = (used + resreq[None, :]) / cap
+    counted = allocatable > 0
+    n = jnp.maximum(jnp.sum(counted, axis=-1), 1)
+    return jnp.sum(jnp.clip(used_frac, 0.0, 1.0) * counted, axis=-1) / n * 100.0
+
+
+def balanced_allocation_score(used: jax.Array, allocatable: jax.Array,
+                              resreq: jax.Array) -> jax.Array:
+    """100 - 100*std(resource fractions): k8s NodeResourcesBalancedAllocation
+    (nodeorder.go:241-260). Penalizes skewed cpu-vs-memory usage."""
+    cap = jnp.maximum(allocatable, _EPS)
+    frac = jnp.clip((used + resreq[None, :]) / cap, 0.0, 1.0)
+    counted = (allocatable > 0).astype(frac.dtype)
+    n = jnp.maximum(jnp.sum(counted, axis=-1), 1.0)
+    mean = jnp.sum(frac * counted, axis=-1) / n
+    var = jnp.sum(((frac - mean[:, None]) ** 2) * counted, axis=-1) / n
+    return (1.0 - jnp.sqrt(var)) * 100.0
+
+
+def taint_prefer_score(tol_hash: jax.Array, tol_effect: jax.Array,
+                       tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
+    """Fewer intolerable PreferNoSchedule taints = higher score (k8s
+    TaintToleration scorer as wrapped at nodeorder.go:219-271)."""
+    kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
+    m_all = (tol_mode == TOL_EXISTS_ALL)[None, None, :]
+    m_key = ((tol_mode == TOL_EXISTS_KEY)[None, None, :]
+             & (key[:, :, None] == tol_hash[None, None, :]))
+    m_eq = ((tol_mode == TOL_EQUAL)[None, None, :]
+            & (kv[:, :, None] == tol_hash[None, None, :]))
+    eff_ok = ((tol_effect == 0)[None, None, :]
+              | (tol_effect[None, None, :] == eff[:, :, None]))
+    covered = jnp.any((m_all | m_key | m_eq) & eff_ok, axis=-1)
+    prefer = eff == EFFECT_PREFER_NO_SCHEDULE
+    intolerable = jnp.sum(prefer & ~covered, axis=-1)
+    max_count = jnp.maximum(jnp.max(intolerable), 1)
+    return (1.0 - intolerable / max_count) * 100.0
+
+
+def node_preference_score(preferred_node: jax.Array, n_nodes: int) -> jax.Array:
+    """One-hot bonus for a specific node — used by task-topology's bucket
+    preference (pkg/scheduler/plugins/task-topology/topology.go:344) and the
+    reservation plugin's locked nodes."""
+    idx = jnp.arange(n_nodes)
+    return jnp.where((preferred_node >= 0) & (idx == preferred_node), 100.0, 0.0)

@@ -1,0 +1,58 @@
+"""Selection and ordering primitives.
+
+Replaces SelectBestNode's argmax + rand.Intn tie-break
+(pkg/scheduler/util/scheduler_helper.go:213-228) with a deterministic
+lowest-index tie-break (documented divergence: the reference is
+nondeterministic on ties, SURVEY.md section 7 hard part 4), and the four nested
+container/heap priority queues (pkg/scheduler/util/priority_queue.go:36-94)
+with lexicographic masked argmin over key vectors.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30
+
+
+def best_node(score: jax.Array, feasible: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(index i32, found bool): argmax of score over feasible nodes,
+    first-index tie-break (jnp.argmax returns the first maximum)."""
+    masked = jnp.where(feasible, score, NEG)
+    idx = jnp.argmax(masked)
+    return idx.astype(jnp.int32), jnp.any(feasible)
+
+
+def lex_argmin(keys: Sequence[jax.Array], mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Lexicographic masked argmin.
+
+    ``keys`` is an ordered list of f32/i32 vectors (most significant first);
+    returns (index of the lexicographically smallest masked entry, any-valid
+    flag). This is the kernel replacement for popping nested priority queues
+    ordered by tiered LessFns (framework/session_plugins.go:440-554).
+    """
+    m = mask
+    for k in keys:
+        k = k.astype(jnp.float32)
+        kmin = jnp.min(jnp.where(m, k, jnp.inf))
+        m = m & (k <= kmin + 0.0)
+    # first surviving index
+    idx = jnp.argmax(m)
+    return idx.astype(jnp.int32), jnp.any(mask)
+
+
+def sort_order(keys: Sequence[jax.Array], mask: jax.Array) -> jax.Array:
+    """i32[n]: indices sorted lexicographically by ``keys`` (most significant
+    first), masked-out entries last. Stable, so equal keys keep index order."""
+    n = keys[0].shape[0]
+    order = jnp.arange(n)
+    # lexsort: apply stable sorts from least-significant key to most
+    for k in reversed(list(keys)):
+        k = jnp.where(mask, k.astype(jnp.float32), jnp.inf)
+        order = order[jnp.argsort(k[order], stable=True)]
+    # push masked entries to the end while keeping relative order
+    masked_last = jnp.argsort(~mask[order], stable=True)
+    return order[masked_last].astype(jnp.int32)

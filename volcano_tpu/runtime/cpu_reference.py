@@ -1,0 +1,243 @@
+"""Sequential CPU reference of the allocate pass.
+
+An independent numpy re-implementation of the reference Go scheduler's
+allocate loop (pkg/scheduler/actions/allocate/allocate.go:43-281 +
+statement.go commit/discard), kept deliberately loop-structured the way the Go
+code is. Two roles:
+
+1. Decision-equivalence oracle for the compiled TPU path (SURVEY.md section 4:
+   "JAX-vs-reference decision-equivalence tests") — both implementations must
+   produce identical bind decisions on the same packed snapshot.
+2. The CPU baseline bench.py measures against (BASELINE.md north star), since
+   the Go toolchain is not available in this image.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..arrays.labels import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
+                             EFFECT_PREFER_NO_SCHEDULE, TOL_EQUAL,
+                             TOL_EXISTS_ALL, TOL_EXISTS_KEY)
+from ..arrays.schema import SnapshotArrays
+from ..ops.allocate_scan import (MODE_ALLOCATED, MODE_NONE, MODE_PIPELINED,
+                                 AllocateConfig)
+
+_EPS = 1e-5
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def _feasible_one(nodes, resreq, sel, th, te, tm, avail, pods_extra):
+    N = avail.shape[0]
+    ok = np.array(nodes.valid) & np.array(nodes.schedulable)
+    ok &= (np.array(nodes.pod_count) + pods_extra) < np.array(nodes.max_pods)
+    ok &= np.all(resreq[None, :] <= avail + _EPS, axis=-1)
+    labels = np.array(nodes.labels)
+    for s in sel:
+        if s != 0:
+            ok &= np.any(labels == s, axis=-1)
+    kv, key, eff = (np.array(nodes.taint_kv), np.array(nodes.taint_key),
+                    np.array(nodes.taint_effect))
+    for n in range(N):
+        if not ok[n]:
+            continue
+        for e in range(kv.shape[1]):
+            if eff[n, e] not in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+                continue
+            tolerated = False
+            for o in range(len(th)):
+                if tm[o] == TOL_EXISTS_ALL and th[o] != 0:
+                    match = True
+                elif tm[o] == TOL_EXISTS_KEY:
+                    match = key[n, e] == th[o]
+                else:
+                    match = kv[n, e] == th[o] and th[o] != 0
+                if match and (te[o] == 0 or te[o] == eff[n, e]):
+                    tolerated = True
+                    break
+            if not tolerated:
+                ok[n] = False
+                break
+    return ok
+
+
+def _score_one(cfg: AllocateConfig, nodes, resreq, idle, th, te, tm):
+    allocatable = np.array(nodes.allocatable)
+    used = allocatable - idle
+    N = idle.shape[0]
+    score = np.zeros(N)
+    if cfg.binpack_weight:
+        applicable = (resreq > 0)[None, :] & (allocatable > 0)
+        frac = np.divide(used + resreq[None, :], allocatable,
+                         out=np.zeros_like(used), where=allocatable > 0)
+        w = np.ones_like(resreq)[None, :] * applicable
+        wsum = np.maximum(w.sum(-1), 1e-9)
+        raw = (np.where(applicable, frac, 0) * w).sum(-1) / wsum
+        raw = np.where((np.where(applicable, frac, 0) > 1 + 1e-6).any(-1), 0, raw)
+        score += cfg.binpack_weight * raw * 100
+    if cfg.least_allocated_weight:
+        cap = np.maximum(allocatable, 1e-9)
+        free = np.clip((allocatable - used - resreq[None, :]) / cap, 0, 1)
+        counted = allocatable > 0
+        n = np.maximum(counted.sum(-1), 1)
+        score += cfg.least_allocated_weight * (free * counted).sum(-1) / n * 100
+    if cfg.most_allocated_weight:
+        cap = np.maximum(allocatable, 1e-9)
+        uf = np.clip((used + resreq[None, :]) / cap, 0, 1)
+        counted = allocatable > 0
+        n = np.maximum(counted.sum(-1), 1)
+        score += cfg.most_allocated_weight * (uf * counted).sum(-1) / n * 100
+    if cfg.balanced_weight:
+        cap = np.maximum(allocatable, 1e-9)
+        frac = np.clip((used + resreq[None, :]) / cap, 0, 1)
+        counted = (allocatable > 0).astype(float)
+        n = np.maximum(counted.sum(-1), 1.0)
+        mean = (frac * counted).sum(-1) / n
+        var = (((frac - mean[:, None]) ** 2) * counted).sum(-1) / n
+        score += cfg.balanced_weight * (1.0 - np.sqrt(var)) * 100
+    if cfg.taint_prefer_weight:
+        kv, key, eff = (np.array(nodes.taint_kv), np.array(nodes.taint_key),
+                        np.array(nodes.taint_effect))
+        intol = np.zeros(N)
+        for n in range(N):
+            for e in range(kv.shape[1]):
+                if eff[n, e] != EFFECT_PREFER_NO_SCHEDULE:
+                    continue
+                tolerated = False
+                for o in range(len(th)):
+                    if tm[o] == TOL_EXISTS_ALL and th[o] != 0:
+                        match = True
+                    elif tm[o] == TOL_EXISTS_KEY:
+                        match = key[n, e] == th[o]
+                    else:
+                        match = kv[n, e] == th[o] and th[o] != 0
+                    if match and (te[o] == 0 or te[o] == eff[n, e]):
+                        tolerated = True
+                        break
+                if not tolerated:
+                    intol[n] += 1
+        mx = max(intol.max(), 1)
+        score += cfg.taint_prefer_weight * (1.0 - intol / mx) * 100
+    return score
+
+
+def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
+                 queue_deserved: np.ndarray, ns_share: np.ndarray = None,
+                 cfg: AllocateConfig = AllocateConfig()) -> Dict[str, np.ndarray]:
+    """Run the allocate pass sequentially on the host. Returns the same
+    decision arrays as ops.allocate_scan (task_node, task_mode, job_ready,
+    job_pipelined)."""
+    nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
+    N, R = np.array(nodes.idle).shape
+    T = np.array(tasks.resreq).shape[0]
+    J, M = np.array(jobs.task_table).shape
+
+    idle = np.array(nodes.idle, dtype=np.float64).copy()
+    pipe_extra = np.zeros((N, R))
+    pods_extra = np.zeros(N, np.int64)
+    queue_allocated = np.array(queues.allocated, dtype=np.float64).copy()
+    task_node = np.full(T, -1, np.int64)
+    task_mode = np.zeros(T, np.int64)
+    job_done = np.zeros(J, bool)
+    job_ready = np.zeros(J, bool)
+    job_pipelined = np.zeros(J, bool)
+
+    jns = np.array(jobs.namespace)
+    if ns_share is None:
+        ns_share = np.zeros(int(jns.max(initial=0)) + 1, np.float32)
+    jvalid = np.array(jobs.valid) & np.array(jobs.schedulable)
+    n_pending = np.array(jobs.n_pending)
+    jqueue = np.array(jobs.queue)
+    jprio = np.array(jobs.priority)
+    jrank = np.array(jobs.creation_rank)
+    jready0 = np.array(jobs.ready_num)
+    jmin = np.array(jobs.min_available)
+    table = np.array(jobs.task_table)
+    releasing = np.array(nodes.releasing)
+    pipelined0 = np.array(nodes.pipelined)
+    resreq = np.array(tasks.resreq, dtype=np.float64)
+    best_effort = np.array(tasks.best_effort)
+    tjob = np.array(tasks.job)
+
+    while True:
+        overused = np.all(queue_allocated >= queue_deserved - 1e-6, axis=-1)
+        elig = jvalid & ~job_done & (n_pending > 0) & ~overused[jqueue]
+        if not elig.any():
+            break
+        qshare = np.max(
+            np.where(np.isfinite(queue_deserved) & (queue_deserved > 0),
+                     queue_allocated / np.maximum(queue_deserved, 1e-9), 0.0),
+            axis=-1)
+        ready_now = (jready0 >= jmin) & (jmin > 0)
+        keys = np.stack([
+            np.asarray(ns_share, float)[jns], jns.astype(float),
+            qshare[jqueue], jqueue.astype(float), -jprio.astype(float),
+            ready_now.astype(float), np.asarray(job_share, float),
+            jrank.astype(float)])
+        best_ji, best_key = -1, None
+        for ji in range(J):
+            if not elig[ji]:
+                continue
+            k = tuple(keys[:, ji])
+            if best_key is None or k < best_key:
+                best_key, best_ji = k, ji
+        ji = best_ji
+
+        saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy())
+        placed: List[int] = []
+        n_alloc = n_pipe = 0
+        for slot in range(M):
+            t = table[ji, slot]
+            if t < 0 or best_effort[t]:
+                continue
+            sel = np.array(tasks.selector)[t]
+            th = np.array(tasks.tol_hash)[t]
+            te = np.array(tasks.tol_effect)[t]
+            tm = np.array(tasks.tol_mode)[t]
+            req = resreq[t]
+            feas_now = _feasible_one(nodes, req, sel, th, te, tm, idle, pods_extra)
+            score = _score_one(cfg, nodes, req, idle, th, te, tm)
+            if feas_now.any():
+                node = int(np.argmax(np.where(feas_now, score, -np.inf)))
+                idle[node] -= req
+                pods_extra[node] += 1
+                task_node[t] = node
+                task_mode[t] = MODE_ALLOCATED
+                placed.append(t)
+                n_alloc += 1
+            elif cfg.enable_pipelining:
+                future = np.maximum(idle + releasing - pipelined0 - pipe_extra, 0)
+                feas_fut = _feasible_one(nodes, req, sel, th, te, tm, future,
+                                         pods_extra)
+                if feas_fut.any():
+                    node = int(np.argmax(np.where(feas_fut, score, -np.inf)))
+                    pipe_extra[node] += req
+                    pods_extra[node] += 1
+                    task_node[t] = node
+                    task_mode[t] = MODE_PIPELINED
+                    placed.append(t)
+                    n_pipe += 1
+
+        ready = (jready0[ji] + n_alloc) >= jmin[ji]
+        pipelined = (jready0[ji] + n_alloc + n_pipe) >= jmin[ji]
+        if not cfg.enable_gang:
+            ready = True
+        if ready or pipelined:
+            queue_allocated[jqueue[ji]] += resreq[placed].sum(axis=0) if placed else 0
+            job_ready[ji] = bool(ready)
+            job_pipelined[ji] = bool(pipelined and not ready)
+        else:
+            idle, pipe_extra, pods_extra = saved
+            for t in placed:
+                task_node[t] = -1
+                task_mode[t] = MODE_NONE
+        job_done[ji] = True
+
+    return dict(task_node=task_node, task_mode=task_mode, job_ready=job_ready,
+                job_pipelined=job_pipelined, idle=idle,
+                queue_allocated=queue_allocated)
