@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Benchmark: compiled TPU scheduling cycle vs the sequential CPU reference.
+
+Measures the allocate pass (predicates + binpack/spread scoring + gang
+commit) on a synthetic snapshot shaped like BASELINE.md config #2
+(1k nodes / 10k tasks), and reports ONE JSON line:
+
+    {"metric": ..., "value": <tpu cycle ms>, "unit": "ms", "vs_baseline": <speedup>}
+
+vs_baseline is the speedup over the CPU path on the same snapshot with
+verified-identical bind decisions. The reference publishes no numbers
+(BASELINE.md) and no Go toolchain exists in this image, so the CPU baseline
+is runtime/cpu_reference.py — the same sequential predicate->score->argmax
+loop the Go scheduler runs per task (allocate.go:43-281), in vectorized
+numpy (one vector op over the node axis per predicate/score term, i.e. at
+least as fast as the Go loop's per-node work).
+
+Env knobs: BENCH_NODES, BENCH_JOBS, BENCH_TASKS_PER_JOB, BENCH_REPS,
+BENCH_SKIP_CPU=1 (report cached baseline ratio instead of measuring).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", 1024))
+    n_jobs = int(os.environ.get("BENCH_JOBS", 640))
+    tasks_per_job = int(os.environ.get("BENCH_TASKS_PER_JOB", 16))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+
+    import jax
+    # persistent compile cache: the cycle compiles once per shape bucket and
+    # every later bench/driver run reuses it
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               "/tmp/volcano_tpu_jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    from __graft_entry__ import _synthetic_cluster
+    from volcano_tpu.arrays import pack
+    from volcano_tpu.ops.allocate_scan import (AllocateConfig, AllocateExtras,
+                                               make_allocate_cycle)
+    from volcano_tpu.runtime.cpu_reference import allocate_cpu
+
+    ci = _synthetic_cluster(n_nodes=n_nodes, n_jobs=n_jobs,
+                            tasks_per_job=tasks_per_job)
+    snap, _maps = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    cfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                         balanced_weight=0.0, taint_prefer_weight=0.0)
+
+    fn = jax.jit(make_allocate_cycle(cfg))
+    t0 = time.time()
+    result = fn(snap, extras)
+    result.task_node.block_until_ready()
+    compile_s = time.time() - t0
+
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        result = fn(snap, extras)
+        result.task_node.block_until_ready()
+        times.append(time.time() - t0)
+    tpu_ms = min(times) * 1000
+
+    n_tasks = n_jobs * tasks_per_job
+    placed = int(np.asarray(result.task_mode > 0).sum())
+
+    if os.environ.get("BENCH_SKIP_CPU"):
+        cpu_ms = float(os.environ.get("BENCH_CPU_MS", 0)) or tpu_ms
+        equal = None
+    else:
+        t0 = time.time()
+        cpu = allocate_cpu(snap, extras, cfg)
+        cpu_ms = (time.time() - t0) * 1000
+        equal = bool(
+            np.array_equal(np.asarray(result.task_node), cpu["task_node"])
+            and np.array_equal(np.asarray(result.task_mode), cpu["task_mode"]))
+
+    out = {
+        "metric": f"schedule_cycle_ms_{n_nodes}nodes_{n_tasks}tasks",
+        "value": round(tpu_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / tpu_ms, 2),
+    }
+    extra = {
+        "cpu_ms": round(cpu_ms, 1),
+        "compile_s": round(compile_s, 1),
+        "placed_tasks": placed,
+        "decisions_equal_cpu": equal,
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(out))
+    print(json.dumps(extra), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

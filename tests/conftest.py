@@ -3,14 +3,22 @@
 Mirrors the reference's test strategy (SURVEY.md section 4): unit tests run
 against fake backends with no real cluster; here, additionally, no real TPU —
 sharding tests use 8 virtual CPU devices.
+
+Note: the environment's axon site hook sets jax_platforms=axon,cpu, which
+overrides the JAX_PLATFORMS env var — the config must be updated via the API
+before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

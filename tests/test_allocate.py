@@ -10,27 +10,20 @@ from volcano_tpu.api import QueueInfo, TaskStatus
 from volcano_tpu.arrays import pack
 from volcano_tpu.ops import (MODE_ALLOCATED, MODE_PIPELINED, AllocateConfig,
                              make_allocate_cycle)
+from volcano_tpu.ops.allocate_scan import AllocateExtras
 from volcano_tpu.runtime.cpu_reference import allocate_cpu
 
 from fixtures import build_job, build_node, build_task, simple_cluster
 
 
-def run_both(ci, cfg=AllocateConfig(), job_share=None, queue_deserved=None,
-             ns_share=None):
+def run_both(ci, cfg=AllocateConfig(), extras_fn=None):
     snap, maps = pack(ci)
-    J = snap.jobs.min_available.shape[0]
-    Q = snap.queues.weight.shape[0]
-    S = snap.namespace_weight.shape[0]
-    R = snap.cluster_capacity.shape[0]
-    if job_share is None:
-        job_share = np.zeros(J, np.float32)
-    if queue_deserved is None:
-        queue_deserved = np.full((Q, R), np.inf, np.float32)
-    if ns_share is None:
-        ns_share = np.zeros(S, np.float32)
+    extras = AllocateExtras.neutral(snap)
+    if extras_fn:
+        extras = extras_fn(snap, maps, extras)
     fn = jax.jit(make_allocate_cycle(cfg))
-    tpu = fn(snap, job_share, queue_deserved, ns_share)
-    cpu = allocate_cpu(snap, job_share, queue_deserved, ns_share, cfg)
+    tpu = fn(snap, extras)
+    cpu = allocate_cpu(snap, extras, cfg)
     return snap, maps, tpu, cpu
 
 
@@ -159,16 +152,13 @@ class TestAllocateBehavior:
         ci.add_job(ja)
         ci.add_job(jb)
         snap, maps = pack(ci)
-        Q = snap.queues.weight.shape[0]
-        R = snap.cluster_capacity.shape[0]
-        deserved = np.full((Q, R), np.inf, np.float32)
-        # qa deserved tiny, and already allocated beyond it -> overused
-        qa = maps.queue_index["qa"]
-        deserved[qa] = 0.0
-        job_share = np.zeros(snap.jobs.min_available.shape[0], np.float32)
-        ns_share = np.zeros(snap.namespace_weight.shape[0], np.float32)
+        extras = AllocateExtras.neutral(snap)
+        # qa deserved tiny -> overused, so qb's job goes first
+        deserved = np.array(extras.queue_deserved)
+        deserved[maps.queue_index["qa"]] = 0.0
+        extras.queue_deserved = deserved
         fn = jax.jit(make_allocate_cycle(AllocateConfig()))
-        tpu = fn(snap, job_share, deserved, ns_share)
+        tpu = fn(snap, extras)
         b = binds(maps, tpu.task_node, tpu.task_mode)
         assert b == {"default/b0": "n0"}
 

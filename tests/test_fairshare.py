@@ -1,0 +1,205 @@
+"""Fair-share solver tests — mirrors the reference's proportion_test.go and
+drf/hdrf_test.go outcome assertions."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from volcano_tpu.api import QueueInfo, Resource, TaskStatus
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops.enqueue import EnqueueConfig, make_enqueue_pass
+from volcano_tpu.ops.backfill import make_backfill_pass
+from volcano_tpu.ops.fairshare import (dominant_share, drf_job_shares,
+                                       hierarchical_shares, namespace_shares,
+                                       proportion_deserved)
+
+from fixtures import build_job, build_task, res, simple_cluster
+
+
+def packed(ci):
+    return pack(ci)
+
+
+def make_queue_snapshot(total_cpu, specs):
+    """specs: list of (name, weight, request_cpu_millis, capability_cpu or None)."""
+    ci = simple_cluster(n_nodes=0)
+    from fixtures import build_node
+    ci.add_node(build_node("n0", cpu=str(total_cpu), memory="64Gi"))
+    del ci.queues["default"]
+    for name, weight, req, cap in specs:
+        q = QueueInfo(name, weight=weight)
+        if cap is not None:
+            q.capability = res(cpu=str(cap))
+        ci.add_queue(q)
+        if req:
+            job = build_job(f"default/{name}-job", queue=name,
+                            min_available=1)
+            job.add_task(build_task(f"{name}-t", cpu=f"{req}m", memory=0))
+            ci.add_job(job)
+    return pack(ci)
+
+
+class TestProportion:
+    def test_water_filling_two_queues(self):
+        # total 10 cpu; q1 w1 requests 8, q2 w1 requests 2
+        snap, maps = make_queue_snapshot(10, [("q1", 1, 8000, None),
+                                              ("q2", 1, 2000, None)])
+        deserved = proportion_deserved(jax.tree.map(jnp.asarray, snap.queues),
+                                       jnp.asarray(snap.cluster_capacity))
+        d = np.array(deserved)
+        assert abs(d[maps.queue_index["q1"]][0] - 8000) < 1
+        assert abs(d[maps.queue_index["q2"]][0] - 2000) < 1
+
+    def test_weights_split_contention(self):
+        # total 9 cpu; q1 w2 requests 9, q2 w1 requests 9 -> 6 / 3
+        snap, maps = make_queue_snapshot(9, [("q1", 2, 9000, None),
+                                             ("q2", 1, 9000, None)])
+        d = np.array(proportion_deserved(
+            jax.tree.map(jnp.asarray, snap.queues),
+            jnp.asarray(snap.cluster_capacity)))
+        assert abs(d[maps.queue_index["q1"]][0] - 6000) < 1
+        assert abs(d[maps.queue_index["q2"]][0] - 3000) < 1
+
+    def test_capability_clamps(self):
+        # q1 w1 requests 8 but capability 2 -> gets 2; q2 absorbs the rest
+        snap, maps = make_queue_snapshot(10, [("q1", 1, 8000, 2),
+                                              ("q2", 1, 8000, None)])
+        d = np.array(proportion_deserved(
+            jax.tree.map(jnp.asarray, snap.queues),
+            jnp.asarray(snap.cluster_capacity)))
+        assert abs(d[maps.queue_index["q1"]][0] - 2000) < 1
+        assert abs(d[maps.queue_index["q2"]][0] - 8000) < 1
+
+    def test_deserved_never_exceeds_request(self):
+        snap, maps = make_queue_snapshot(100, [("q1", 1, 1000, None),
+                                               ("q2", 1, 500, None)])
+        d = np.array(proportion_deserved(
+            jax.tree.map(jnp.asarray, snap.queues),
+            jnp.asarray(snap.cluster_capacity)))
+        assert d[maps.queue_index["q1"]][0] <= 1000 + 1
+        assert d[maps.queue_index["q2"]][0] <= 500 + 1
+
+
+class TestDRF:
+    def test_dominant_share(self):
+        total = jnp.array([10000.0, 100.0])
+        alloc = jnp.array([[1000.0, 50.0], [5000.0, 10.0]])
+        s = np.array(dominant_share(alloc, total))
+        assert abs(s[0] - 0.5) < 1e-6   # memory dominant
+        assert abs(s[1] - 0.5) < 1e-6   # cpu dominant
+
+    def test_job_shares_order_jobs(self):
+        total = jnp.array([10000.0])
+        alloc = jnp.array([[2000.0], [8000.0], [0.0]])
+        valid = jnp.array([True, True, False])
+        s = np.array(drf_job_shares(alloc, total, valid))
+        assert s[0] < s[1]
+        assert np.isinf(s[2])
+
+    def test_namespace_shares_weighted(self):
+        total = jnp.array([10000.0])
+        job_alloc = jnp.array([[4000.0], [4000.0]])
+        job_ns = jnp.array([0, 1])
+        valid = jnp.array([True, True])
+        w = jnp.array([4.0, 1.0])
+        s = np.array(namespace_shares(job_alloc, job_ns, valid, w, total))
+        assert s[0] < s[1]  # same usage, higher weight -> lower share
+
+
+class TestHDRF:
+    def test_subtree_accumulation(self):
+        ci = simple_cluster(n_nodes=1, node_cpu="10")
+        del ci.queues["default"]
+        ci.add_queue(QueueInfo("root", hierarchy="root", hierarchy_weights="1"))
+        ci.add_queue(QueueInfo("root.a", hierarchy="root/a",
+                               hierarchy_weights="1/1"))
+        ci.add_queue(QueueInfo("root.b", hierarchy="root/b",
+                               hierarchy_weights="1/3"))
+        for qname, cpu in [("root.a", "4"), ("root.b", "4")]:
+            job = build_job(f"default/{qname}", queue=qname)
+            t = build_task(f"{qname}-t", cpu=cpu, memory=0)
+            t.status = TaskStatus.RUNNING
+            job.add_task(t)
+            ci.add_job(job)
+        snap, maps = pack(ci)
+        q = jax.tree.map(jnp.asarray, snap.queues)
+        hw = jnp.asarray(
+            [ci.queues[n].hierarchy_weight_values()[-1] if n in ci.queues
+             and ci.queues[n].hierarchy_weight_values() else 1.0
+             for n in maps.queue_names] + [1.0] * (q.weight.shape[0] - len(maps.queue_names)),
+            dtype=jnp.float32)
+        s = np.array(hierarchical_shares(q, jnp.asarray(snap.cluster_capacity), hw))
+        ia, ib = maps.queue_index["root.a"], maps.queue_index["root.b"]
+        # same usage; b has 3x hierarchy weight -> lower share -> favored
+        assert s[ib] < s[ia]
+        # root aggregates both children
+        assert s[maps.queue_index["root"]] >= s[ia]
+
+
+class TestEnqueue:
+    def test_proportion_gate_admits_within_deserved(self):
+        from volcano_tpu.api import PodGroupPhase
+        ci = simple_cluster(n_nodes=1, node_cpu="4")
+        j1 = build_job("default/j1", min_available=1,
+                       pod_group_phase=PodGroupPhase.PENDING,
+                       min_resources=res(cpu="2"))
+        j1.add_task(build_task("p1", cpu="2", memory=0))
+        j2 = build_job("default/j2", min_available=1,
+                       pod_group_phase=PodGroupPhase.PENDING,
+                       min_resources=res(cpu="3"))
+        j2.add_task(build_task("p2", cpu="3", memory=0))
+        ci.add_job(j1)
+        ci.add_job(j2)
+        snap, maps = pack(ci)
+        Q, R = snap.queues.allocated.shape
+        deserved = np.full((Q, R), np.inf, np.float32)
+        deserved[maps.queue_index["default"]] = [4000.0, np.inf]
+        fn = jax.jit(make_enqueue_pass(EnqueueConfig()))
+        admitted = np.array(fn(snap, deserved,
+                               np.zeros(snap.jobs.valid.shape[0], bool)))
+        # j1 (2 cpu) admitted; j2 (3 cpu) would exceed 4 cpu deserved
+        assert admitted[maps.job_index["default/j1"]]
+        assert not admitted[maps.job_index["default/j2"]]
+
+    def test_sla_overrides_gate(self):
+        from volcano_tpu.api import PodGroupPhase
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        j = build_job("default/j1", min_available=1,
+                      pod_group_phase=PodGroupPhase.PENDING,
+                      min_resources=res(cpu="5"))
+        j.add_task(build_task("p1", cpu="5", memory=0))
+        ci.add_job(j)
+        snap, maps = pack(ci)
+        Q, R = snap.queues.allocated.shape
+        deserved = np.zeros((Q, R), np.float32)  # nothing deserved
+        fn = jax.jit(make_enqueue_pass(EnqueueConfig()))
+        sla = np.zeros(snap.jobs.valid.shape[0], bool)
+        assert not np.array(fn(snap, deserved, sla))[0]
+        sla[maps.job_index["default/j1"]] = True
+        assert np.array(fn(snap, deserved, sla))[0]
+
+
+class TestBackfill:
+    def test_places_best_effort_tasks(self):
+        ci = simple_cluster(n_nodes=2)
+        job = build_job("default/j1", min_available=0)
+        job.add_task(build_task("be-0", cpu=0, memory=0))
+        job.add_task(build_task("be-1", cpu=0, memory=0))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        t_node, placed = jax.jit(make_backfill_pass())(snap)
+        for uid in ("default/be-0", "default/be-1"):
+            ti = maps.task_index[uid]
+            assert bool(placed[ti])
+            assert int(t_node[ti]) >= 0
+
+    def test_respects_pod_capacity(self):
+        ci = simple_cluster(n_nodes=1)
+        ci.nodes["n0"].max_pods = 1
+        job = build_job("default/j1", min_available=0)
+        job.add_task(build_task("be-0", cpu=0, memory=0))
+        job.add_task(build_task("be-1", cpu=0, memory=0))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        t_node, placed = jax.jit(make_backfill_pass())(snap)
+        assert int(np.array(placed).sum()) == 1

@@ -1,0 +1,42 @@
+"""The action pass pipeline (reference: pkg/scheduler/actions/factory.go:31-39).
+
+Each action is a thin host-side driver around a compiled pass; the Session
+holds the state they mutate. Execution order comes from the conf's
+``actions`` string, exactly like the reference scheduler loop
+(pkg/scheduler/scheduler.go:105).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from .allocate import AllocateAction
+from .backfill import BackfillAction
+from .base import Action
+from .elect import ElectAction
+from .enqueue import EnqueueAction
+from .preempt import PreemptAction
+from .reclaim import ReclaimAction
+from .reserve import ReserveAction
+
+_ACTIONS: Dict[str, Type[Action]] = {}
+
+
+def register_action(cls: Type[Action]) -> None:
+    """Reference: framework.RegisterAction (framework/plugins.go:107)."""
+    _ACTIONS[cls.name] = cls
+
+
+def get_action(name: str) -> Action:
+    if name not in _ACTIONS:
+        raise KeyError(f"unknown action {name!r}; registered: {sorted(_ACTIONS)}")
+    return _ACTIONS[name]()
+
+
+def registered_actions():
+    return sorted(_ACTIONS)
+
+
+for _cls in (EnqueueAction, AllocateAction, BackfillAction, PreemptAction,
+             ReclaimAction, ElectAction, ReserveAction):
+    register_action(_cls)

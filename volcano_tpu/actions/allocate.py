@@ -1,0 +1,24 @@
+"""Allocate action (reference: pkg/scheduler/actions/allocate/allocate.go:43-281).
+
+The whole pass — ordering, predicates, scoring, placement, gang
+commit/discard — is the compiled kernel in ops/allocate_scan.py; this driver
+just runs it and reads out decisions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Action
+
+
+class AllocateAction(Action):
+    name = "allocate"
+
+    def execute(self, ssn) -> None:
+        result = ssn.run_allocate()
+        ssn.stats["allocated_binds"] = int(
+            sum(1 for _ in ssn.binds))
+        ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
+        ssn.stats["jobs_pipelined"] = int(
+            np.asarray(result.job_pipelined).sum())

@@ -1,0 +1,27 @@
+"""Elect action: choose the reservation target job.
+
+Reference: pkg/scheduler/actions/elect/elect.go:29-50 — the highest-priority,
+longest-waiting pending job becomes the reservation target via the
+reservation plugin's TargetJobFn.
+"""
+
+from __future__ import annotations
+
+from .base import Action
+
+
+class ElectAction(Action):
+    name = "elect"
+
+    def execute(self, ssn) -> None:
+        plugin = ssn.plugin("reservation")
+        if plugin is None:
+            return
+        state = plugin.state
+        if state.target_job_uid:
+            job = ssn.cluster.jobs.get(state.target_job_uid)
+            if job is None or job.is_ready():
+                # target scheduled or deleted: release everything
+                state.reset()
+        if state.target_job_uid is None:
+            state.target_job_uid = plugin.elect_target(ssn)

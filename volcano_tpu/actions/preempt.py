@@ -1,0 +1,31 @@
+"""Preempt action (reference: pkg/scheduler/actions/preempt/preempt.go:42-291).
+
+Runs the compiled intra-queue preemption pass, applies evictions and
+pipelined placements, then performs the victimTasks sweep (tdm's periodic
+eviction of preemptable tasks outside their revocable window,
+preempt.go:280-291).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Action
+
+
+class PreemptAction(Action):
+    name = "preempt"
+
+    def execute(self, ssn) -> None:
+        result = ssn.run_preempt(mode="preempt")
+        ssn.stats["preempt_evictions"] = int(
+            np.asarray(result.evicted).sum()) if result is not None else 0
+
+        # victimTasks sweep: unconditional evictions requested by plugins
+        victims = ssn.victim_tasks_mask()
+        count = 0
+        for uid, ti in ssn.maps.task_index.items():
+            if victims[ti]:
+                ssn.evict_task(uid, reason="tdm revocable window closed")
+                count += 1
+        ssn.stats["victim_sweep"] = count

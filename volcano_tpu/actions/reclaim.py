@@ -1,0 +1,18 @@
+"""Reclaim action (reference: pkg/scheduler/actions/reclaim/reclaim.go:40-191):
+cross-queue eviction of reclaimable, over-served queues' tasks in favor of
+starving jobs in underserved queues."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Action
+
+
+class ReclaimAction(Action):
+    name = "reclaim"
+
+    def execute(self, ssn) -> None:
+        result = ssn.run_preempt(mode="reclaim")
+        ssn.stats["reclaim_evictions"] = int(
+            np.asarray(result.evicted).sum()) if result is not None else 0

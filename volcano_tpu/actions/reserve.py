@@ -1,0 +1,27 @@
+"""Reserve action: lock nodes for the elected target job.
+
+Reference: pkg/scheduler/actions/reserve/reserve.go:43-77 — while the target
+job stays unready, lock one more node per cycle (the emptiest unlocked one,
+reservation.go:56-63); locked nodes reject every other job in the allocate
+kernel via AllocateExtras.node_locked.
+"""
+
+from __future__ import annotations
+
+from .base import Action
+
+
+class ReserveAction(Action):
+    name = "reserve"
+
+    def execute(self, ssn) -> None:
+        plugin = ssn.plugin("reservation")
+        if plugin is None or plugin.state.target_job_uid is None:
+            return
+        job = ssn.cluster.jobs.get(plugin.state.target_job_uid)
+        if job is None or job.is_ready():
+            plugin.state.reset()
+            return
+        node = plugin.reserve_node(ssn)
+        if node is not None:
+            plugin.state.locked_nodes.add(node)

@@ -1,0 +1,156 @@
+"""Batch Job CRD types — the controller-side job model.
+
+Reference: vendor/volcano.sh/apis/pkg/apis/batch/v1alpha1/job.go:32-310
+(JobSpec/TaskSpec/LifecyclePolicy/JobStatus), bus command/event enums in
+api.types. These are the objects users submit (vcctl job run), reconciled by
+the job controller into pods + a PodGroup for the scheduler.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .job_info import Toleration
+from .resource import Resource
+from .types import BusAction, BusEvent, JobPhase
+
+
+@dataclass
+class PodTemplate:
+    """Reduced pod template: what the scheduler and controllers consume."""
+
+    resources: Dict[str, object] = field(default_factory=dict)  # ResourceList
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    priority: int = 0
+    restart_policy: str = "OnFailure"
+    volumes: List[str] = field(default_factory=list)    # volume claim names
+    env: Dict[str, str] = field(default_factory=dict)
+
+    def resreq(self) -> Resource:
+        return Resource.from_resource_list(self.resources)
+
+
+@dataclass
+class LifecyclePolicy:
+    """event/exit-code -> action matrix entry (job.go:143-180)."""
+
+    action: BusAction
+    event: Optional[BusEvent] = None
+    events: List[BusEvent] = field(default_factory=list)
+    exit_code: Optional[int] = None
+    timeout_seconds: Optional[float] = None
+
+    def matches_event(self, event: BusEvent) -> bool:
+        evs = set(self.events)
+        if self.event is not None:
+            evs.add(self.event)
+        return event in evs or BusEvent.ANY in evs
+
+    def matches_exit_code(self, code: Optional[int]) -> bool:
+        return (self.exit_code is not None and code is not None
+                and self.exit_code == code)
+
+
+@dataclass
+class TaskSpec:
+    """One role of the gang (job.go:182-213)."""
+
+    name: str = ""
+    replicas: int = 0
+    template: PodTemplate = field(default_factory=PodTemplate)
+    policies: List[LifecyclePolicy] = field(default_factory=list)
+    min_available: Optional[int] = None
+    max_retry: int = 0
+
+
+@dataclass
+class VolumeSpec:
+    """Job volume (job.go:106-141): an existing claim name or a size to
+    provision a PVC for."""
+
+    mount_path: str = ""
+    volume_claim_name: str = ""
+    storage: str = ""          # e.g. "1Gi" -> controller creates a PVC
+
+
+@dataclass
+class JobState:
+    phase: JobPhase = JobPhase.PENDING
+    reason: str = ""
+    transition_time: float = 0.0
+
+
+@dataclass
+class JobStatus:
+    """job.go:232-310."""
+
+    state: JobState = field(default_factory=JobState)
+    pending: int = 0
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    terminating: int = 0
+    unknown: int = 0
+    version: int = 0
+    retry_count: int = 0
+    min_available: int = 0
+    task_status_count: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    controlled_resources: Dict[str, str] = field(default_factory=dict)
+    conditions: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Job:
+    """The batch.volcano.sh/v1alpha1 Job object."""
+
+    name: str
+    namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    uid: str = ""
+
+    # spec (job.go:48-105)
+    scheduler_name: str = ""
+    min_available: int = 0
+    min_success: Optional[int] = None
+    volumes: List[VolumeSpec] = field(default_factory=list)
+    tasks: List[TaskSpec] = field(default_factory=list)
+    policies: List[LifecyclePolicy] = field(default_factory=list)
+    plugins: Dict[str, List[str]] = field(default_factory=dict)
+    queue: str = ""
+    max_retry: int = 0
+    ttl_seconds_after_finished: Optional[int] = None
+    priority_class_name: str = ""
+
+    status: JobStatus = field(default_factory=JobStatus)
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def total_replicas(self) -> int:
+        return sum(t.replicas for t in self.tasks)
+
+
+@dataclass
+class Command:
+    """bus.volcano.sh/v1alpha1 Command: an action requested on a target
+    object (vendor/.../bus/v1alpha1/commands.go:12-43)."""
+
+    name: str
+    namespace: str = "default"
+    action: BusAction = BusAction.SYNC_JOB
+    target_name: str = ""       # owner reference (job or queue name)
+    target_kind: str = "Job"
+    reason: str = ""
+    message: str = ""

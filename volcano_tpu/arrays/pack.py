@@ -20,7 +20,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import (CPU, MEMORY, ClusterInfo, JobInfo, PodGroupPhase,
-                   QueueState, TaskStatus)
+                   QueueState, TaskStatus, is_allocated_status)
 from ..api.job_info import Toleration
 from . import labels as L
 from .schema import (IndexMaps, JobArrays, NodeArrays, QueueArrays,
@@ -242,6 +242,11 @@ def pack(ci: ClusterInfo,
     for ti, (ji, task, _rank) in enumerate(task_entries):
         if task.status == TaskStatus.PENDING:
             pending_lists[ji].append(ti)
+        # fair-share "request" counts allocated-status + pending tasks only
+        # (proportion.OnSessionOpen, proportion.go:100-110)
+        if task.status == TaskStatus.PENDING or is_allocated_status(
+                TaskStatus(task.status)):
+            j_request[ji] += t_resreq[ti]
     j_queue_known = np.zeros(J, bool)
     for ji, uid in enumerate(job_uids):
         job = ci.jobs[uid]
@@ -253,7 +258,6 @@ def pack(ci: ClusterInfo,
         j_created[ji] = order[uid]
         j_ready[ji] = job.ready_task_num()
         j_allocated[ji] = _vec(job.allocated, dims)
-        j_request[ji] = _vec(job.total_request, dims)
         j_minres[ji] = _vec(job.min_resources, dims)
         # task order within job: priority desc, then insertion order
         # (reference: priority plugin TaskOrderFn, priority.go:63)

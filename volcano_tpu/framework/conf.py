@@ -1,0 +1,154 @@
+"""Scheduler policy configuration: actions list + plugin tiers.
+
+Reference: pkg/scheduler/conf/scheduler_conf.go:20-82 (SchedulerConfiguration,
+Tier, PluginOption with Enabled* switches) and pkg/scheduler/util.go:31-92
+(defaultSchedulerConf, unmarshalSchedulerConf incl. the hdrf+proportion
+conflict check). Same YAML shape as the reference so existing conf files port
+unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+DEFAULT_SCHEDULER_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+@dataclass
+class PluginOption:
+    """One plugin entry in a tier (scheduler_conf.go:44-82)."""
+
+    name: str
+    arguments: Dict[str, str] = field(default_factory=dict)
+    # Enabled* switches default to on, like the reference's nil-means-true
+    # pointers (plugins.ApplyPluginConfDefaults).
+    enabled_job_order: bool = True
+    enabled_namespace_order: bool = True
+    enabled_hierarchy: bool = False       # drf-only: hdrf
+    enabled_job_ready: bool = True
+    enabled_job_pipelined: bool = True
+    enabled_task_order: bool = True
+    enabled_preemptable: bool = True
+    enabled_reclaimable: bool = True
+    enabled_queue_order: bool = True
+    enabled_predicate: bool = True
+    enabled_best_node: bool = True
+    enabled_node_order: bool = True
+    enabled_target_job: bool = True
+    enabled_reserved_nodes: bool = True
+    enabled_job_enqueued: bool = True
+    enabled_victim: bool = True
+    enabled_job_starving: bool = True
+
+    def get_argument(self, key: str, default=None):
+        return self.arguments.get(key, default)
+
+
+@dataclass
+class Tier:
+    plugins: List[PluginOption] = field(default_factory=list)
+
+
+@dataclass
+class Configuration:
+    """Per-action arguments block (scheduler_conf.go:30-42, used by the
+    fork's ScaleAllocatable / dap conf)."""
+
+    name: str
+    arguments: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfiguration:
+    actions: List[str] = field(default_factory=lambda: ["enqueue", "allocate",
+                                                        "backfill"])
+    tiers: List[Tier] = field(default_factory=list)
+    configurations: List[Configuration] = field(default_factory=list)
+
+    def plugin_option(self, name: str) -> Optional[PluginOption]:
+        for tier in self.tiers:
+            for opt in tier.plugins:
+                if opt.name == name:
+                    return opt
+        return None
+
+    def enabled(self, name: str) -> bool:
+        return self.plugin_option(name) is not None
+
+    def action_arguments(self, action: str) -> Dict[str, Any]:
+        for c in self.configurations:
+            if c.name == action:
+                return c.arguments
+        return {}
+
+
+_BOOL_KEYS = {
+    "enableJobOrder": "enabled_job_order",
+    "enableNamespaceOrder": "enabled_namespace_order",
+    "enableHierarchy": "enabled_hierarchy",
+    "enableJobReady": "enabled_job_ready",
+    "enableJobPipelined": "enabled_job_pipelined",
+    "enableTaskOrder": "enabled_task_order",
+    "enablePreemptable": "enabled_preemptable",
+    "enableReclaimable": "enabled_reclaimable",
+    "enableQueueOrder": "enabled_queue_order",
+    "enablePredicate": "enabled_predicate",
+    "enableBestNode": "enabled_best_node",
+    "enableNodeOrder": "enabled_node_order",
+    "enableTargetJob": "enabled_target_job",
+    "enableReservedNodes": "enabled_reserved_nodes",
+    "enableJobEnqueued": "enabled_job_enqueued",
+    "enableVictim": "enabled_victim",
+    "enableJobStarving": "enabled_job_starving",
+}
+
+
+def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
+    """Parse reference-shaped YAML; raises ValueError on the hdrf+proportion
+    conflict exactly like unmarshalSchedulerConf (util.go:60-71)."""
+    data = yaml.safe_load(text or DEFAULT_SCHEDULER_CONF) or {}
+    sc = SchedulerConfiguration()
+    raw_actions = data.get("actions", "enqueue, allocate, backfill")
+    if isinstance(raw_actions, str):
+        sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]
+    else:
+        sc.actions = list(raw_actions)
+
+    hdrf = proportion = False
+    for tier_data in data.get("tiers", []) or []:
+        tier = Tier()
+        for p in tier_data.get("plugins", []) or []:
+            opt = PluginOption(name=p["name"],
+                               arguments=dict(p.get("arguments") or {}))
+            for yaml_key, attr in _BOOL_KEYS.items():
+                if yaml_key in p:
+                    setattr(opt, attr, bool(p[yaml_key]))
+            if opt.name == "drf" and opt.enabled_hierarchy:
+                hdrf = True
+            if opt.name == "proportion":
+                proportion = True
+            tier.plugins.append(opt)
+        sc.tiers.append(tier)
+    if hdrf and proportion:
+        raise ValueError("proportion and drf with hierarchy enabled conflicts")
+
+    for c in data.get("configurations", []) or []:
+        sc.configurations.append(
+            Configuration(name=c["name"], arguments=dict(c.get("arguments") or {})))
+    if not sc.tiers:
+        sc.tiers = parse_conf(DEFAULT_SCHEDULER_CONF).tiers
+    return sc

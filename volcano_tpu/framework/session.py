@@ -1,0 +1,323 @@
+"""Session: one scheduling cycle's world state and compiled-pass composer.
+
+Reference: pkg/scheduler/framework/session.go:38-468 (per-cycle snapshot +
+plugin instances + Allocate/Pipeline/Evict ops) and framework.go:29-63
+(OpenSession/CloseSession). Re-designed so the session's job is to
+- pack the ClusterInfo snapshot into device arrays,
+- query each plugin's kernel contributions (score weights, fairness arrays,
+  gates, vetoes) and bake them into AllocateConfig/AllocateExtras,
+- run the actions' compiled passes,
+- and translate decision arrays back into bind/pipeline/evict intents
+  (the Statement commit boundary, statement.go:377-395 — here the kernels
+  already did commit/discard internally, so apply is a pure readout).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import lru_cache
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..api import ClusterInfo, TaskStatus
+from ..arrays import pack
+from ..ops.allocate_scan import (MODE_ALLOCATED, MODE_PIPELINED,
+                                 AllocateConfig, AllocateExtras,
+                                 AllocateResult, make_allocate_cycle)
+from ..ops.backfill import make_backfill_pass
+from ..ops.enqueue import EnqueueConfig, make_enqueue_pass
+from .conf import SchedulerConfiguration, parse_conf
+
+
+@dataclasses.dataclass
+class BindIntent:
+    """A decided placement to flush to the cluster (cache.Bind seam,
+    pkg/scheduler/cache/cache.go:549)."""
+
+    task_uid: str
+    job_uid: str
+    node_name: str
+
+
+@dataclasses.dataclass
+class EvictIntent:
+    """A decided eviction (cache.Evict seam, cache.go:496)."""
+
+    task_uid: str
+    job_uid: str
+    reason: str = ""
+
+
+@lru_cache(maxsize=64)
+def _allocate_fn(cfg: AllocateConfig):
+    return jax.jit(make_allocate_cycle(cfg))
+
+
+@lru_cache(maxsize=64)
+def _enqueue_fn(cfg: EnqueueConfig):
+    return jax.jit(make_enqueue_pass(cfg))
+
+
+@lru_cache(maxsize=1)
+def _backfill_fn():
+    return jax.jit(make_backfill_pass())
+
+
+@lru_cache(maxsize=64)
+def _preempt_fn(cfg):
+    from ..ops.preempt import make_preempt_cycle
+    return jax.jit(make_preempt_cycle(cfg))
+
+
+class Session:
+    def __init__(self, cluster: ClusterInfo,
+                 conf: Optional[SchedulerConfiguration] = None,
+                 now: Optional[float] = None,
+                 plugin_overrides: Optional[Dict[str, object]] = None):
+        from ..plugins.factory import build_plugin
+
+        self.cluster = cluster
+        self.conf = conf or parse_conf()
+        self.now = now if now is not None else time.time()
+        self.plugins = []
+        overrides = plugin_overrides or {}
+        for tier in self.conf.tiers:
+            for opt in tier.plugins:
+                if opt.name in overrides:
+                    self.plugins.append(overrides[opt.name])
+                else:
+                    self.plugins.append(build_plugin(opt))
+
+        self.binds: List[BindIntent] = []
+        self.evictions: List[EvictIntent] = []
+        self.pipelined: Dict[str, str] = {}     # task uid -> node name
+        self.conditions: Dict[str, str] = {}    # job uid -> condition type
+        self.phase_updates: Dict[str, object] = {}  # job uid -> new PG phase
+        self.last_allocate: Optional[AllocateResult] = None
+        self.stats: Dict[str, float] = {}
+
+        self.repack()
+        for p in self.plugins:
+            p.on_session_open(self)
+
+    # ------------------------------------------------------------- packing
+    def repack(self) -> None:
+        """Re-flatten the cluster into device arrays (cache.Snapshot seam)."""
+        self.snap, self.maps = pack(self.cluster)
+
+    def plugin(self, name: str):
+        for p in self.plugins:
+            if p.name == name:
+                return p
+        return None
+
+    # ------------------------------------------------- kernel composition
+    def allocate_config(self) -> AllocateConfig:
+        weights: Dict[str, float] = dict(
+            binpack_weight=0.0, least_allocated_weight=0.0,
+            most_allocated_weight=0.0, balanced_weight=0.0,
+            taint_prefer_weight=0.0)
+        any_scorer = False
+        for p in self.plugins:
+            w = p.score_weights(self)
+            if w:
+                any_scorer = True
+                for k, v in w.items():
+                    weights[k] = weights.get(k, 0.0) + v
+        if not any_scorer:
+            # no scoring plugin: fall back to spread defaults like the
+            # reference's nodeorder defaults
+            weights.update(least_allocated_weight=1.0, balanced_weight=1.0)
+        return AllocateConfig(enable_gang=self.plugin("gang") is not None,
+                              **weights)
+
+    def allocate_extras(self) -> AllocateExtras:
+        extras = AllocateExtras.neutral(self.snap)
+        for p in self.plugins:
+            deserved = p.queue_deserved(self)
+            if deserved is not None:
+                extras.queue_deserved = np.asarray(deserved, np.float32)
+            share = p.job_order_share(self)
+            if share is not None and p.option.enabled_job_order:
+                extras.job_share = np.asarray(share, np.float32)
+            ns = p.namespace_share(self)
+            if ns is not None:
+                extras.ns_share = np.asarray(ns, np.float32)
+            if hasattr(p, "hierarchical_queue_share"):
+                h = p.hierarchical_queue_share(self)
+                if h is not None:
+                    extras.queue_share_extra = np.asarray(h, np.float32)
+            if hasattr(p, "block_nonpreempt"):
+                extras.block_nonpreempt = np.asarray(p.block_nonpreempt(self))
+            if hasattr(p, "task_pref_node"):
+                extras.task_pref_node = np.asarray(
+                    p.task_pref_node(self), np.int32)
+            if hasattr(p, "node_locked_mask"):
+                extras.node_locked = np.asarray(p.node_locked_mask(self))
+                extras.target_job = np.int32(p.target_job_index(self))
+        return extras
+
+    def enqueue_config(self) -> EnqueueConfig:
+        gates: Dict[str, object] = {}
+        for p in self.plugins:
+            gates.update(p.enqueue_gates(self))
+        return EnqueueConfig(
+            enable_proportion_gate=bool(gates.get("enable_proportion_gate",
+                                                  False)),
+            enable_overcommit_gate=bool(gates.get("enable_overcommit_gate",
+                                                  False)),
+            overcommit_factor=float(gates.get("overcommit_factor", 1.2)))
+
+    def sla_waiting_flags(self) -> np.ndarray:
+        J = np.asarray(self.snap.jobs.valid).shape[0]
+        flags = np.zeros(J, bool)
+        for p in self.plugins:
+            f = p.sla_waiting(self)
+            if f is not None:
+                flags |= np.asarray(f, bool)
+        return flags
+
+    # --------------------------------------------------------- pass runners
+    def run_enqueue(self) -> int:
+        """Run the enqueue pass; promote admitted jobs Pending -> Inqueue.
+        Returns the number admitted."""
+        fn = _enqueue_fn(self.enqueue_config())
+        extras = self.allocate_extras()
+        admitted = np.asarray(fn(self.snap, extras.queue_deserved,
+                                 self.sla_waiting_flags()))
+        count = 0
+        from ..api import PodGroupPhase
+        for uid, ji in self.maps.job_index.items():
+            if admitted[ji]:
+                self.cluster.jobs[uid].pod_group_phase = PodGroupPhase.INQUEUE
+                self.phase_updates[uid] = PodGroupPhase.INQUEUE
+                count += 1
+        if count:
+            self.repack()
+        return count
+
+    def run_allocate(self) -> AllocateResult:
+        cfg = self.allocate_config()
+        result = _allocate_fn(cfg)(self.snap, self.allocate_extras())
+        self.last_allocate = result
+        self.apply_allocate(result)
+        return result
+
+    def run_backfill(self) -> int:
+        t_node, placed = _backfill_fn()(self.snap)
+        t_node, placed = np.asarray(t_node), np.asarray(placed)
+        count = 0
+        for uid, ti in self.maps.task_index.items():
+            if placed[ti]:
+                self._bind_task(uid, self.maps.node_names[int(t_node[ti])])
+                count += 1
+        return count
+
+    def victim_veto_mask(self) -> np.ndarray:
+        """Union of plugin vetoes (tiered victim intersection,
+        session_plugins.go:131-215: a veto in any tier removes the victim)."""
+        T = np.asarray(self.snap.tasks.status).shape[0]
+        veto = np.zeros(T, bool)
+        for p in self.plugins:
+            v = p.victim_veto(self)
+            if v is not None:
+                veto |= np.asarray(v, bool)
+        return veto
+
+    def victim_tasks_mask(self) -> np.ndarray:
+        """Union of plugin victimsFn sweeps (tdm.go:298-340)."""
+        T = np.asarray(self.snap.tasks.status).shape[0]
+        victims = np.zeros(T, bool)
+        for p in self.plugins:
+            if hasattr(p, "victim_tasks"):
+                victims |= np.asarray(p.victim_tasks(self), bool)
+        return victims
+
+    def run_preempt(self, mode: str = "preempt"):
+        from ..ops.preempt import PreemptConfig
+        # the priority and drf victim filters are Preemptable fns only; the
+        # reference's priority plugin registers no Reclaimable fn
+        # (priority.go:114 vs reclaim's gang/conformance/proportion voters)
+        cfg = PreemptConfig(
+            mode=mode,
+            scoring=self.allocate_config(),
+            enable_priority_rule=(mode == "preempt"
+                                  and self.plugin("priority") is not None),
+            enable_drf_rule=(mode == "preempt"
+                             and self.plugin("drf") is not None))
+        result = _preempt_fn(cfg)(self.snap, self.allocate_extras(),
+                                  self.victim_veto_mask())
+        self.apply_preempt(result, mode)
+        return result
+
+    def apply_preempt(self, result, mode: str) -> None:
+        evicted = np.asarray(result.evicted)
+        task_node = np.asarray(result.task_node)
+        task_mode = np.asarray(result.task_mode)
+        for uid, ti in self.maps.task_index.items():
+            if evicted[ti]:
+                self.evict_task(uid, reason=f"{mode} victim")
+        for uid, ti in self.maps.task_index.items():
+            if int(task_mode[ti]) == MODE_PIPELINED:
+                self.pipelined[uid] = self.maps.node_names[int(task_node[ti])]
+
+    def evict_task(self, task_uid: str, reason: str = "") -> None:
+        """Session evict (session.go:357 -> cache.Evict, cache.go:496):
+        mark Releasing, keep node accounting in the releasing bucket, queue
+        the evict intent."""
+        job, task = self._find_task(task_uid)
+        if task is None:
+            return
+        node = self.cluster.nodes.get(task.node_name)
+        if node is not None and task.uid in node.tasks:
+            node.remove_task(task)
+            job.update_task_status(task, TaskStatus.RELEASING)
+            node.add_task(task)
+        else:
+            job.update_task_status(task, TaskStatus.RELEASING)
+        self.evictions.append(EvictIntent(task_uid, job.uid, reason))
+
+    # -------------------------------------------------------- apply/readout
+    def _find_task(self, uid: str):
+        for job in self.cluster.jobs.values():
+            task = job.tasks.get(uid)
+            if task is not None:
+                return job, task
+        return None, None
+
+    def _bind_task(self, task_uid: str, node_name: str) -> None:
+        """Session dispatch: mark Binding, account on the node, queue the
+        bind intent (session.go:264-355 Allocate -> dispatch -> cache.Bind)."""
+        job, task = self._find_task(task_uid)
+        if task is None:
+            return
+        job.update_task_status(task, TaskStatus.BINDING)
+        node = self.cluster.nodes.get(node_name)
+        if node is not None and task.uid not in node.tasks:
+            node.add_task(task)
+        self.binds.append(BindIntent(task_uid, job.uid, node_name))
+
+    def apply_allocate(self, result: AllocateResult) -> None:
+        task_node = np.asarray(result.task_node)
+        task_mode = np.asarray(result.task_mode)
+        job_ready = np.asarray(result.job_ready)
+        for uid, ti in self.maps.task_index.items():
+            mode = int(task_mode[ti])
+            if mode == 0:
+                continue
+            ji = int(np.asarray(self.snap.tasks.job)[ti])
+            node_name = self.maps.node_names[int(task_node[ti])]
+            if mode == MODE_ALLOCATED and bool(job_ready[ji]):
+                self._bind_task(uid, node_name)
+            else:
+                # held in-session only (pipelined or allocated-but-unready):
+                # no cache flush, like an uncommitted Statement
+                self.pipelined[uid] = node_name
+
+    # --------------------------------------------------------------- close
+    def close(self) -> None:
+        for p in self.plugins:
+            p.on_session_close(self)

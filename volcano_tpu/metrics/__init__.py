@@ -1,0 +1,11 @@
+"""Scheduler metrics (reference: pkg/scheduler/metrics/ — Prometheus
+histograms/counters in subsystem ``volcano``, metrics.go:38-202).
+
+Histogram buckets and metric names mirror the reference so dashboards port;
+exposition is the Prometheus text format over a plain string (no client
+library dependency).
+"""
+
+from .metrics import Metrics, METRICS
+
+__all__ = ["Metrics", "METRICS"]

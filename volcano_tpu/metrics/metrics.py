@@ -1,0 +1,106 @@
+"""Metric registry with Prometheus text exposition.
+
+Reference metric names (pkg/scheduler/metrics/metrics.go:38-202):
+e2e_scheduling_latency_milliseconds, action_scheduling_latency_microseconds,
+plugin_scheduling_latency_microseconds, task_scheduling_latency_milliseconds,
+schedule_attempts_total, preemption_victims, unschedule_task_count; queue
+gauges in queue.go:28-284.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+_BUCKETS_MS = [5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000]
+
+
+class Histogram:
+    def __init__(self, buckets: List[float]):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        self.total += value
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def mean(self) -> float:
+        return self.total / self.n if self.n else 0.0
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = defaultdict(float)
+        self.gauges: Dict[Tuple[str, str], float] = {}
+        self.histograms: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] += value
+
+    def set_gauge(self, name: str, label: str, value: float) -> None:
+        with self._lock:
+            self.gauges[(name, label)] = value
+
+    def _hist(self, name: str) -> Histogram:
+        if name not in self.histograms:
+            self.histograms[name] = Histogram(_BUCKETS_MS)
+        return self.histograms[name]
+
+    def observe_cycle(self, seconds: float) -> None:
+        """volcano_e2e_scheduling_latency_milliseconds (metrics.go:38-45)."""
+        with self._lock:
+            self._hist("e2e_scheduling_latency_milliseconds").observe(
+                seconds * 1000)
+
+    def observe_action(self, action: str, seconds: float) -> None:
+        """volcano_action_scheduling_latency_microseconds (metrics.go:74-81)."""
+        with self._lock:
+            self._hist(f"action_scheduling_latency_microseconds"
+                       f'{{action="{action}"}}').observe(seconds * 1e6)
+
+    def observe_plugin(self, plugin: str, event: str, seconds: float) -> None:
+        with self._lock:
+            self._hist(f'plugin_scheduling_latency_microseconds'
+                       f'{{plugin="{plugin}",event="{event}"}}').observe(
+                seconds * 1e6)
+
+    def update_queue_metrics(self, queue: str, allocated_cpu: float,
+                             deserved_cpu: float, share: float) -> None:
+        """queue_allocated/deserved/share gauges (metrics/queue.go:28-284)."""
+        self.set_gauge("queue_allocated_milli_cpu", queue, allocated_cpu)
+        self.set_gauge("queue_deserved_milli_cpu", queue, deserved_cpu)
+        self.set_gauge("queue_share", queue, share)
+
+    def exposition(self) -> str:
+        """Prometheus text format (the /metrics endpoint payload)."""
+        lines = []
+        with self._lock:
+            for name, v in sorted(self.counters.items()):
+                lines.append(f"volcano_{name} {v}")
+            for (name, label), v in sorted(self.gauges.items()):
+                lines.append(f'volcano_{name}{{queue="{label}"}} {v}')
+            for name, h in sorted(self.histograms.items()):
+                base = name if "{" in name else name
+                lines.append(f"volcano_{base}_count {h.n}")
+                lines.append(f"volcano_{base}_sum {h.total}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+
+
+#: process-global registry, like the prometheus default registerer
+METRICS = Metrics()

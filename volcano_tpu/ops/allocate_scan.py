@@ -39,7 +39,7 @@ import jax.numpy as jnp
 from ..arrays.schema import SnapshotArrays
 from . import predicates as P
 from . import scoring as S
-from .select import NEG, lex_argmin
+from .select import best_node, lex_argmin
 
 #: task placement modes in the result arrays
 MODE_NONE = 0
@@ -60,6 +60,44 @@ class AllocateConfig:
     enable_pipelining: bool = True       # allow placement on FutureIdle
     enable_gang: bool = True             # gang all-or-nothing semantics
     max_rounds: Optional[int] = None     # cap on outer job iterations
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class AllocateExtras:
+    """Dynamic per-cycle plugin contributions consumed by the compiled pass.
+
+    Each field is supplied by the plugin named in its comment; the session
+    fills neutral defaults for disabled plugins (see :meth:`neutral`).
+    """
+
+    job_share: jax.Array        # f32[J] drf JobOrderFn key (drf.go:454-472)
+    queue_deserved: jax.Array   # f32[Q,R] proportion deserved (proportion.go:140-197)
+    ns_share: jax.Array         # f32[S] drf namespace fairness (drf.go:474-507)
+    queue_share_extra: jax.Array  # f32[Q] hdrf hierarchical key (drf.go:363-374)
+    block_nonpreempt: jax.Array   # bool[N] tdm revocable-zone gate (tdm.go:295)
+    task_pref_node: jax.Array     # i32[T] task-topology bucket node (topology.go:344)
+    node_locked: jax.Array        # bool[N] reservation locks (reservation.go:56-63)
+    target_job: jax.Array         # i32 job exempt from locks (elect.go:29-50)
+
+    @classmethod
+    def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
+        import numpy as np
+        J = np.asarray(snap.jobs.min_available).shape[0]
+        Q, R = np.asarray(snap.queues.allocated).shape
+        S = np.asarray(snap.namespace_weight).shape[0]
+        N = np.asarray(snap.nodes.pod_count).shape[0]
+        T = np.asarray(snap.tasks.status).shape[0]
+        return cls(
+            job_share=np.zeros(J, np.float32),
+            queue_deserved=np.full((Q, R), np.inf, np.float32),
+            ns_share=np.zeros(S, np.float32),
+            queue_share_extra=np.zeros(Q, np.float32),
+            block_nonpreempt=np.zeros(N, bool),
+            task_pref_node=np.full(T, -1, np.int32),
+            node_locked=np.zeros(N, bool),
+            target_job=np.int32(-1),
+        )
 
 
 @jax.tree_util.register_dataclass
@@ -104,22 +142,19 @@ def make_allocate_cycle(cfg: AllocateConfig):
     """Build the jittable allocate pass for a given static config.
 
     Returned signature:
-        allocate(snap, job_share, queue_deserved, ns_share) -> AllocateResult
-    where job_share f32[J] is the DRF share ordering key (zeros when drf is
-    off), queue_deserved f32[Q, R] is proportion's deserved share (+inf when
-    proportion is off), and ns_share f32[S] is the weighted namespace share
-    (drf namespaceOrderFn, drf.go:474-507; zeros when namespace fairness is
-    off — namespaces then order by index, i.e. by name, like the reference's
-    fallback).
+        allocate(snap, extras: AllocateExtras) -> AllocateResult
+    with all dynamic plugin contributions (drf shares, proportion deserved,
+    hdrf keys, tdm gates, topology preferences, reservation locks) in
+    ``extras``; use AllocateExtras.neutral(snap) when the plugins are off.
     """
 
-    def allocate(snap: SnapshotArrays, job_share: jax.Array,
-                 queue_deserved: jax.Array,
-                 ns_share: jax.Array) -> AllocateResult:
+    def allocate(snap: SnapshotArrays,
+                 extras: AllocateExtras) -> AllocateResult:
         snap = jax.tree.map(jnp.asarray, snap)
-        job_share = jnp.asarray(job_share)
-        queue_deserved = jnp.asarray(queue_deserved)
-        ns_share = jnp.asarray(ns_share)
+        extras = jax.tree.map(jnp.asarray, extras)
+        job_share = extras.job_share
+        queue_deserved = extras.queue_deserved
+        ns_share = extras.ns_share
         nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
         N, R = nodes.idle.shape
         T = tasks.resreq.shape[0]
@@ -141,7 +176,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
             rounds=jnp.int32(0),
         )
 
-        max_rounds = cfg.max_rounds or J
+        max_rounds = J if cfg.max_rounds is None else cfg.max_rounds
 
         def eligible(st):
             # Overused queues are skipped (proportion.Overused,
@@ -165,7 +200,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 jnp.where(jnp.isfinite(queue_deserved) & (queue_deserved > 0),
                           st["queue_allocated"] / jnp.maximum(queue_deserved, 1e-9),
                           0.0),
-                axis=-1)
+                axis=-1) + extras.queue_share_extra
             job_q = jobs.queue
             job_ns = jobs.namespace
             ready_now = (jobs.ready_num >= jobs.min_available) & (jobs.min_available > 0)
@@ -196,19 +231,24 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
                 future = jnp.maximum(
                     idle + nodes.releasing - nodes.pipelined - pipe_extra, 0.0)
-                feas_now = P.feasible(nodes, resreq, sel, th, te, tm, idle,
-                                      pods_extra)
-                feas_fut = P.feasible(nodes, resreq, sel, th, te, tm, future,
-                                      pods_extra)
+                # tdm: during an active revocable window, revocable nodes only
+                # admit preemptable tasks (tdm.go:295); reservation: locked
+                # nodes only admit the elected target job (reserve.go:43-77).
+                node_ok = (~(extras.block_nonpreempt & ~tasks.preemptable[t])
+                           & (~extras.node_locked | (ji == extras.target_job)))
+                feas_now = node_ok & P.feasible(nodes, resreq, sel, th, te, tm,
+                                                idle, pods_extra)
+                feas_fut = node_ok & P.feasible(nodes, resreq, sel, th, te, tm,
+                                                future, pods_extra)
                 score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
+                # task-topology bucket preference (topology.go:344)
+                score += S.node_preference_score(extras.task_pref_node[t],
+                                                 score.shape[0])
 
-                m_now = jnp.where(feas_now & active, score, NEG)
-                m_fut = jnp.where(feas_fut & active, score, NEG)
-                n_now = jnp.argmax(m_now).astype(jnp.int32)
-                n_fut = jnp.argmax(m_fut).astype(jnp.int32)
-                can_now = jnp.any(feas_now) & active
-                can_fut = (jnp.any(feas_fut) & active
-                           & jnp.bool_(cfg.enable_pipelining))
+                n_now, found_now = best_node(score, feas_now)
+                n_fut, found_fut = best_node(score, feas_fut)
+                can_now = found_now & active
+                can_fut = found_fut & active & jnp.bool_(cfg.enable_pipelining)
 
                 do_alloc = can_now
                 do_pipe = ~can_now & can_fut
@@ -253,6 +293,12 @@ def make_allocate_cycle(cfg: AllocateConfig):
                                jnp.full_like(t_node, -1))
             t_mode = jnp.where(keep | ~job_tasks, t_mode,
                                jnp.zeros_like(t_mode))
+            # A kept-but-unready gang holds capacity without binding: demote
+            # its Allocated placements to Pipelined so MODE_ALLOCATED always
+            # means "bind now" (the reference only dispatches binds on Commit
+            # when JobReady, session.go:317-330).
+            demote = keep & ~ready & job_tasks & (t_mode == MODE_ALLOCATED)
+            t_mode = jnp.where(demote, MODE_PIPELINED, t_mode)
 
             # Commit promotes working state to saved (statement.go:377-395);
             # pipelined jobs also hold their capacity in-session.

@@ -1,0 +1,51 @@
+"""Backfill pass: place zero-request (BestEffort) pending tasks.
+
+TPU re-design of pkg/scheduler/actions/backfill/backfill.go:40-93: every
+pending task with an empty resource request is placed on any node passing
+predicates (the reference has no scoring here — "TODO" in source); placement
+is immediate, with no gang transaction. Divergence: the reference iterates a
+Go map (nondeterministic node order); we take the lowest feasible node index.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..arrays.schema import SnapshotArrays
+from . import predicates as P
+
+
+def make_backfill_pass():
+    """Returns backfill(snap) -> (task_node i32[T], placed bool[T])."""
+
+    def backfill(snap: SnapshotArrays):
+        snap = jax.tree.map(jnp.asarray, snap)
+        nodes, tasks, jobs = snap.nodes, snap.tasks, snap.jobs
+        T = tasks.resreq.shape[0]
+        N = nodes.idle.shape[0]
+
+        from ..api.types import TaskStatus
+        candidate = (tasks.valid & tasks.best_effort
+                     & (tasks.status == int(TaskStatus.PENDING))
+                     & jobs.schedulable[jnp.maximum(tasks.job, 0)]
+                     & (tasks.job >= 0))
+
+        def step(carry, t):
+            pods_extra, t_node, placed = carry
+            feas = P.feasible(nodes, tasks.resreq[t], tasks.selector[t],
+                              tasks.tol_hash[t], tasks.tol_effect[t],
+                              tasks.tol_mode[t], nodes.idle, pods_extra)
+            node = jnp.argmax(feas).astype(jnp.int32)  # lowest feasible index
+            ok = candidate[t] & jnp.any(feas)
+            pods_extra = pods_extra.at[node].add(jnp.where(ok, 1, 0))
+            t_node = t_node.at[t].set(jnp.where(ok, node, -1))
+            placed = placed.at[t].set(ok)
+            return (pods_extra, t_node, placed), None
+
+        init = (jnp.zeros(N, jnp.int32), jnp.full(T, -1, jnp.int32),
+                jnp.zeros(T, bool))
+        (_, t_node, placed), _ = jax.lax.scan(step, init, jnp.arange(T))
+        return t_node, placed
+
+    return backfill

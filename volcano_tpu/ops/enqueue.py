@@ -1,0 +1,94 @@
+"""Enqueue pass: gate Pending PodGroups into Inqueue phase.
+
+TPU re-design of the enqueue action (pkg/scheduler/actions/enqueue/
+enqueue.go:43-102) and its JobEnqueueable voters: proportion's
+deserved-minus-allocated-minus-inqueue capacity test
+(proportion.go:254-280), overcommit's cluster-factor test
+(pkg/scheduler/plugins/overcommit/overcommit.go:28-124), and sla's
+waiting-deadline override (pkg/scheduler/plugins/sla/sla.go:146-148).
+
+Like the reference, admission is sequential — each admitted job's
+MinResources immediately counts against its queue for the next candidate —
+so the pass is a scan over jobs in queue/priority/FIFO order.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..arrays.schema import SnapshotArrays
+from .select import sort_order
+
+_EPS = 1e-5
+
+
+@dataclass(frozen=True)
+class EnqueueConfig:
+    enable_proportion_gate: bool = True
+    enable_overcommit_gate: bool = False
+    overcommit_factor: float = 1.2   # overcommit.go default
+    # sla override: jobs whose waiting time exceeded the SLA are always
+    # admitted (sla.go:146-148); wait flags are computed host-side.
+
+
+def make_enqueue_pass(cfg: EnqueueConfig):
+    """Returns enqueue(snap, queue_deserved, sla_waiting) -> bool[J] newly
+    admitted (Pending -> Inqueue) jobs. ``sla_waiting`` bool[J] marks jobs
+    past their SLA waiting deadline."""
+
+    def enqueue(snap: SnapshotArrays, queue_deserved: jax.Array,
+                sla_waiting: jax.Array) -> jax.Array:
+        snap = jax.tree.map(jnp.asarray, snap)
+        jobs, queues, nodes = snap.jobs, snap.queues, snap.nodes
+        J = jobs.min_available.shape[0]
+        Q, R = queues.allocated.shape
+
+        candidate = (jobs.valid & jobs.pending_phase
+                     & queues.open[jobs.queue] & queues.valid[jobs.queue])
+        order = sort_order([
+            jobs.queue.astype(jnp.float32),
+            -jobs.priority.astype(jnp.float32),
+            jobs.creation_rank.astype(jnp.float32),
+        ], candidate)
+
+        total_idle = jnp.sum(jnp.where(nodes.valid[:, None], nodes.idle, 0.0),
+                             axis=0)
+        total_alloc = jnp.sum(
+            jnp.where(nodes.valid[:, None], nodes.allocatable, 0.0), axis=0)
+
+        def step(carry, ji):
+            q_inqueue, cluster_inqueue, admitted = carry
+            ok = candidate[ji]
+            qi = jobs.queue[ji]
+            minres = jobs.min_resources[ji]
+
+            permit = jnp.bool_(True)
+            if cfg.enable_proportion_gate:
+                headroom = (queue_deserved[qi] - queues.allocated[qi]
+                            - q_inqueue[qi])
+                fits = jnp.all(
+                    jnp.where(jnp.isfinite(queue_deserved[qi]),
+                              minres <= headroom + _EPS, True))
+                permit &= fits
+            if cfg.enable_overcommit_gate:
+                head = (total_alloc * cfg.overcommit_factor
+                        - (total_alloc - total_idle) - cluster_inqueue)
+                permit &= jnp.all(minres <= head + _EPS)
+            permit = permit | sla_waiting[ji]
+            admit = ok & permit
+
+            upd = jnp.where(admit, 1.0, 0.0) * minres
+            q_inqueue = q_inqueue.at[qi].add(upd)
+            cluster_inqueue = cluster_inqueue + upd
+            admitted = admitted.at[ji].set(admit)
+            return (q_inqueue, cluster_inqueue, admitted), None
+
+        init = (queues.inqueue_minres, jnp.sum(queues.inqueue_minres, axis=0),
+                jnp.zeros(J, bool))
+        (_, _, admitted), _ = jax.lax.scan(step, init, order)
+        return admitted
+
+    return enqueue

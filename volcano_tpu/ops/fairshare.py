@@ -1,0 +1,123 @@
+"""Fair-share solvers: proportion water-filling, DRF, hierarchical DRF.
+
+TPU re-design of the reference's fairness plugins:
+- proportion's iterative deserved-share water-filling
+  (pkg/scheduler/plugins/proportion/proportion.go:140-197) becomes a bounded
+  ``lax.while_loop`` over dense [Q, R] arrays with branchless clamping.
+- drf's dominant-resource shares (pkg/scheduler/plugins/drf/drf.go:104-131,
+  calcShare) become one masked max-reduce per job.
+- the fork's hierarchical DRF (drf.go:42-87, 230-360) is computed over the
+  packed parent-pointer queue tree by propagating subtree allocations up a
+  fixed number of levels.
+
+All solvers run inside the same jit as the allocate pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..api.resource import MIN_RESOURCE
+from ..arrays.schema import QueueArrays
+
+_EPS = 1e-9
+
+
+def proportion_deserved(queues: QueueArrays, total: jax.Array,
+                        max_iters: int = 16) -> jax.Array:
+    """f32[Q, R]: each queue's deserved share by weighted water-filling.
+
+    Exact port of the fixed point computed by proportion.go:140-197:
+    repeatedly hand each unmet queue ``remaining * w_q / sum(unmet weights)``,
+    clamp elementwise by capability and request (all three branches of the Go
+    code reduce to ``min(deserved', capability?, request)`` with capability
+    applied only when exceeded — the min is a no-op otherwise, so the
+    branchless form is identical), mark queues meeting their request or
+    capability, and recycle the clamped-off amount into ``remaining``.
+    """
+    Q, R = queues.allocated.shape
+    weight = jnp.where(queues.valid, queues.weight, 0.0)
+    request = queues.request
+    capability = queues.capability
+
+    def cond(st):
+        deserved, remaining, meet, prev_remaining, it = st
+        total_w = jnp.sum(jnp.where(meet, 0.0, weight))
+        changed = jnp.any(jnp.abs(remaining - prev_remaining) > _EPS)
+        nonempty = jnp.any(remaining >= MIN_RESOURCE)
+        return (total_w > 0) & nonempty & changed & (it < max_iters)
+
+    def body(st):
+        deserved, remaining, meet, _prev, it = st
+        total_w = jnp.sum(jnp.where(meet, 0.0, weight))
+        frac = jnp.where(meet, 0.0, weight) / jnp.maximum(total_w, _EPS)
+        proposed = deserved + remaining[None, :] * frac[:, None]
+        cap_exceeded = ~jnp.all(proposed <= capability + _EPS, axis=-1)
+        new_deserved = jnp.minimum(jnp.minimum(proposed, capability), request)
+        new_deserved = jnp.where(meet[:, None], deserved, new_deserved)
+        new_meet = meet | cap_exceeded | jnp.all(request <= proposed + _EPS,
+                                                 axis=-1)
+        delta = jnp.sum(new_deserved - deserved, axis=0)
+        return (new_deserved, remaining - delta, new_meet, remaining, it + 1)
+
+    init = (jnp.zeros((Q, R), jnp.float32), total.astype(jnp.float32),
+            ~queues.valid, total.astype(jnp.float32) + 1.0, jnp.int32(0))
+    deserved, *_ = jax.lax.while_loop(cond, body, init)
+    return deserved
+
+
+def dominant_share(allocated: jax.Array, total: jax.Array) -> jax.Array:
+    """f32[...]: max over resource dims of allocated/total — the DRF share
+    (drf.go calcShare; dims with zero cluster capacity are ignored)."""
+    frac = jnp.where(total > 0, allocated / jnp.maximum(total, _EPS), 0.0)
+    return jnp.max(frac, axis=-1)
+
+
+def drf_job_shares(job_allocated: jax.Array, total: jax.Array,
+                   valid: jax.Array) -> jax.Array:
+    """f32[J]: per-job dominant-resource share used as the drf JobOrderFn key
+    (drf.go:454-472) and preemption fairness test (drf.go:330-360)."""
+    return jnp.where(valid, dominant_share(job_allocated, total), jnp.inf)
+
+
+def namespace_shares(job_allocated: jax.Array, job_namespace: jax.Array,
+                     job_valid: jax.Array, ns_weight: jax.Array,
+                     total: jax.Array) -> jax.Array:
+    """f32[S]: weighted namespace dominant share (drf namespaceOrderFn,
+    drf.go:474-507): share(ns) = dominantShare(sum of member jobs) / weight."""
+    S = ns_weight.shape[0]
+    contrib = jnp.where(job_valid[:, None], job_allocated, 0.0)
+    ns_alloc = jax.ops.segment_sum(contrib, job_namespace, num_segments=S)
+    return dominant_share(ns_alloc, total) / jnp.maximum(ns_weight, 1.0)
+
+
+def hierarchical_shares(queues: QueueArrays, total: jax.Array,
+                        hierarchy_weight: jax.Array,
+                        max_depth: int = 8) -> jax.Array:
+    """f32[Q]: hdrf-style queue ordering key over the parent-pointer tree.
+
+    The fork's hdrf (drf.go:230-360) water-fills dominant shares level by
+    level down the queue hierarchy. Here each queue's key is the maximum
+    weighted dominant share along its ancestor chain — a queue whose subtree
+    (or any ancestor's subtree) is over-served sorts later. Subtree
+    allocations are accumulated by propagating ``allocated`` up ``max_depth``
+    parent steps.
+    """
+    Q = queues.allocated.shape[0]
+    parent = queues.parent
+
+    def step(carry, _):
+        subtree, cursor = carry
+        has_anc = cursor >= 0
+        idx = jnp.where(has_anc, cursor, 0)
+        contrib = jnp.where(has_anc[:, None], queues.allocated, 0.0)
+        subtree = subtree + jax.ops.segment_sum(contrib, idx, num_segments=Q)
+        cursor = jnp.where(has_anc, parent[idx], -1)
+        return (subtree, cursor), None
+
+    (subtree, _), _ = jax.lax.scan(step, (queues.allocated, parent),
+                                   None, length=max_depth)
+    # subtree[q] = own allocation + all descendants' (within max_depth);
+    # a queue orders by the worst weighted share along its own subtree.
+    return dominant_share(subtree, total) / jnp.maximum(hierarchy_weight, 1.0)

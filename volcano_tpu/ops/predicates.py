@@ -43,14 +43,12 @@ def selector_match(selector: jax.Array, node_labels: jax.Array) -> jax.Array:
     return jnp.all((selector == 0)[None, :] | present, axis=-1)
 
 
-def taints_tolerated(tol_hash: jax.Array, tol_effect: jax.Array,
-                     tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
-    """bool[N]: no hard-effect node taint left untolerated.
+def toleration_covers(tol_hash: jax.Array, tol_effect: jax.Array,
+                      tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
+    """bool[N, E]: does any of the task's tolerations cover taint e of node n?
 
-    Kernel form of the TaintToleration filter: a taint with effect NoSchedule
-    or NoExecute blocks unless some toleration matches it;
-    PreferNoSchedule never blocks (it only scores, see scoring.py).
-    tol_* are i32[O]; taint tensors are i32[N, E].
+    Shared by the hard-taint filter below and the PreferNoSchedule scorer
+    (scoring.taint_prefer_score) so filter and scorer can never disagree.
     """
     kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
     # match[n, e, o]: toleration o covers taint e of node n
@@ -61,7 +59,20 @@ def taints_tolerated(tol_hash: jax.Array, tol_effect: jax.Array,
             & (kv[:, :, None] == tol_hash[None, None, :]))
     eff_ok = ((tol_effect == 0)[None, None, :]
               | (tol_effect[None, None, :] == eff[:, :, None]))
-    covered = jnp.any((m_all | m_key | m_eq) & eff_ok, axis=-1)  # [N, E]
+    return jnp.any((m_all | m_key | m_eq) & eff_ok, axis=-1)
+
+
+def taints_tolerated(tol_hash: jax.Array, tol_effect: jax.Array,
+                     tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
+    """bool[N]: no hard-effect node taint left untolerated.
+
+    Kernel form of the TaintToleration filter: a taint with effect NoSchedule
+    or NoExecute blocks unless some toleration matches it;
+    PreferNoSchedule never blocks (it only scores, see scoring.py).
+    tol_* are i32[O]; taint tensors are i32[N, E].
+    """
+    eff = nodes.taint_effect
+    covered = toleration_covers(tol_hash, tol_effect, tol_mode, nodes)
     hard = (eff == EFFECT_NO_SCHEDULE) | (eff == EFFECT_NO_EXECUTE)
     return jnp.all(~hard | covered, axis=-1)
 

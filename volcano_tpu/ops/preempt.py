@@ -1,0 +1,276 @@
+"""Preempt and reclaim passes as compiled kernels.
+
+TPU re-design of pkg/scheduler/actions/preempt/preempt.go:42-291 (intra-queue
+preemption for starving gangs) and pkg/scheduler/actions/reclaim/
+reclaim.go:40-191 (cross-queue reclaim for underserved queues). The tiered
+Preemptable/Reclaimable victim intersection (framework/session_plugins.go:
+131-215) becomes a conjunction of victim-eligibility masks:
+
+- gang: a job may only lose tasks above its minAvailable surplus
+  (gang.go:83-107),
+- priority: victims' job priority must be lower than the preemptor's
+  (priority.go:114),
+- drf: the victim job's dominant share must stay >= the preemptor's
+  (drf.go:330-360; evaluated statically per cycle — documented approximation),
+- conformance / tdm: host-supplied veto mask (conformance.go:30-68).
+
+ValidateVictims' capacity check (util/scheduler_helper.go:240-255) is the
+``future idle + evictable >= request`` test; the lowest-priority-first victim
+eviction is a bounded inner while-loop; gang commit/discard works exactly as
+in the allocate kernel (keep iff JobPipelined).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..api.types import TaskStatus
+from ..arrays.schema import SnapshotArrays
+from . import predicates as P
+from .allocate_scan import MODE_PIPELINED, AllocateConfig, AllocateExtras, _score_fn
+from .select import NEG, lex_argmin
+
+_OCCUPYING = (int(TaskStatus.ALLOCATED), int(TaskStatus.BINDING),
+              int(TaskStatus.BOUND), int(TaskStatus.RUNNING))
+
+
+@dataclass(frozen=True)
+class PreemptConfig:
+    mode: str = "preempt"               # "preempt" | "reclaim"
+    scoring: AllocateConfig = AllocateConfig()
+    enable_priority_rule: bool = True   # priority plugin victim filter
+    enable_drf_rule: bool = False       # drf share victim filter
+    max_victims_per_task: int = 16      # bound on the eviction loop
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PreemptResult:
+    task_node: jax.Array      # i32[T] pipelined placement of preemptor tasks
+    task_mode: jax.Array      # i32[T] MODE_PIPELINED where placed
+    evicted: jax.Array        # bool[T] victims to evict
+    job_pipelined: jax.Array  # bool[J] preemptor gangs that got capacity
+    job_attempted: jax.Array  # bool[J]
+
+
+def make_preempt_cycle(cfg: PreemptConfig):
+    """Build the jittable preempt/reclaim pass.
+
+    Signature: fn(snap, extras, victim_veto bool[T]) -> PreemptResult.
+    ``extras`` reuses the allocate inputs (job/ns/queue shares, deserved).
+    """
+    reclaim = cfg.mode == "reclaim"
+
+    def preempt(snap: SnapshotArrays, extras: AllocateExtras,
+                victim_veto: jax.Array) -> PreemptResult:
+        snap = jax.tree.map(jnp.asarray, snap)
+        extras = jax.tree.map(jnp.asarray, extras)
+        victim_veto = jnp.asarray(victim_veto)
+        nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
+        N, R = nodes.idle.shape
+        T = tasks.resreq.shape[0]
+        J, M = jobs.task_table.shape
+        queue_deserved = extras.queue_deserved
+
+        occupying = jnp.zeros(T, bool)
+        for s in _OCCUPYING:
+            occupying |= tasks.status == s
+        occupying &= tasks.valid & (tasks.node >= 0)
+
+        # gang surplus: occupying count above minAvailable per job
+        occ_per_job = jax.ops.segment_sum(
+            occupying.astype(jnp.int32), jnp.maximum(tasks.job, 0),
+            num_segments=J)
+        surplus0 = jnp.maximum(occ_per_job - jobs.min_available, 0)
+
+        waiting0 = jax.ops.segment_sum(
+            (tasks.status == int(TaskStatus.PIPELINED)).astype(jnp.int32),
+            jnp.maximum(tasks.job, 0), num_segments=J)
+
+        # starving gangs are the preemptors (gang JobStarving, gang.go:150-155)
+        starving = (jobs.valid & jobs.schedulable
+                    & (jobs.ready_num + waiting0 < jobs.min_available)
+                    & (jobs.n_pending > 0))
+
+        # reclaim only serves underserved queues (reclaim.go:80-100)
+        qshare = jnp.max(
+            jnp.where(jnp.isfinite(queue_deserved) & (queue_deserved > 0),
+                      queues.allocated / jnp.maximum(queue_deserved, 1e-9),
+                      0.0), axis=-1)
+        if reclaim:
+            starving &= qshare[jobs.queue] < 1.0 - 1e-6
+
+        future0 = nodes.future_idle()
+
+        init = dict(
+            extra_idle=jnp.zeros((N, R), jnp.float32),   # from evictions
+            pipe_extra=jnp.zeros((N, R), jnp.float32),   # new pipelines
+            evicted=jnp.zeros(T, bool),
+            surplus=surplus0,
+            task_node=jnp.full(T, -1, jnp.int32),
+            task_mode=jnp.zeros(T, jnp.int32),
+            job_done=jnp.zeros(J, bool),
+            job_pipelined=jnp.zeros(J, bool),
+            saved=None,  # replaced below
+            rounds=jnp.int32(0),
+        )
+        saved_keys = ("extra_idle", "pipe_extra", "evicted", "surplus",
+                      "task_node", "task_mode")
+        init["saved"] = {k: init[k] for k in saved_keys}
+
+        def eligible(st):
+            return starving & ~st["job_done"]
+
+        def cond(st):
+            return jnp.any(eligible(st)) & (st["rounds"] < J)
+
+        def body(st):
+            elig = eligible(st)
+            keys = [
+                extras.ns_share[jobs.namespace],
+                jobs.namespace.astype(jnp.float32),
+                qshare[jobs.queue] + extras.queue_share_extra[jobs.queue],
+                jobs.queue.astype(jnp.float32),
+                -jobs.priority.astype(jnp.float32),
+                extras.job_share,
+                jobs.creation_rank.astype(jnp.float32),
+            ]
+            ji, _ = lex_argmin(keys, elig)
+            task_ids = jobs.task_table[ji]
+            preemptor_prio = jobs.priority[ji]
+            preemptor_share = extras.job_share[ji]
+            preemptor_queue = jobs.queue[ji]
+
+            def victim_ok(evicted, surplus):
+                ok = occupying & ~evicted & ~victim_veto
+                ok &= surplus[jnp.maximum(tasks.job, 0)] > 0
+                if reclaim:
+                    # cross-queue, victim queue reclaimable and overused
+                    # (proportion Reclaimable, proportion.go:213-239)
+                    vq = jobs.queue[jnp.maximum(tasks.job, 0)]
+                    ok &= vq != preemptor_queue
+                    ok &= queues.reclaimable[vq]
+                    overused = jnp.any(
+                        queues.allocated > queue_deserved + 1e-6, axis=-1)
+                    ok &= overused[vq]
+                else:
+                    ok &= jobs.queue[jnp.maximum(tasks.job, 0)] == preemptor_queue
+                    ok &= tasks.job != ji
+                if cfg.enable_priority_rule:
+                    ok &= jobs.priority[jnp.maximum(tasks.job, 0)] < preemptor_prio
+                if cfg.enable_drf_rule:
+                    ok &= extras.job_share[jnp.maximum(tasks.job, 0)] \
+                        >= preemptor_share
+                return ok
+
+            def task_step(carry, t_idx):
+                (extra_idle, pipe_extra, evicted, surplus,
+                 t_node, t_mode, n_pipe) = carry
+                active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
+                t = jnp.maximum(t_idx, 0)
+                resreq = tasks.resreq[t]
+                base = P.feasible(
+                    nodes, jnp.zeros_like(resreq), tasks.selector[t],
+                    tasks.tol_hash[t], tasks.tol_effect[t], tasks.tol_mode[t],
+                    future0 + extra_idle, None)
+
+                vok = victim_ok(evicted, surplus)
+                evictable = jax.ops.segment_sum(
+                    jnp.where(vok[:, None], tasks.resreq, 0.0),
+                    jnp.where(vok, tasks.node, N), num_segments=N + 1)[:N]
+
+                avail = future0 + extra_idle - pipe_extra
+                enough = jnp.all(resreq[None, :] <= avail + evictable + 1e-5,
+                                 axis=-1)
+                feas = base & enough & active
+                score = _score_fn(cfg.scoring, snap, resreq, nodes.idle,
+                                  tasks.tol_hash[t], tasks.tol_effect[t],
+                                  tasks.tol_mode[t])
+                node = jnp.argmax(jnp.where(feas, score, NEG)).astype(jnp.int32)
+                found = jnp.any(feas)
+
+                # evict victims on `node`, lowest job/task priority first,
+                # until the task fits future idle (preempt.go:240-278)
+                def evict_cond(ec):
+                    extra_idle, _evicted, _surplus, k = ec
+                    fits = jnp.all(
+                        resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
+                    return found & ~fits & (k < cfg.max_victims_per_task)
+
+                def evict_body(ec):
+                    extra_idle, evicted, surplus, k = ec
+                    vok_now = victim_ok(evicted, surplus) & (tasks.node == node)
+                    vkeys = [
+                        jobs.priority[jnp.maximum(tasks.job, 0)].astype(jnp.float32),
+                        tasks.priority.astype(jnp.float32),
+                    ]
+                    vt, vfound = lex_argmin(vkeys, vok_now)
+                    doit = vfound
+                    extra_idle = extra_idle.at[node].add(
+                        jnp.where(doit, 1.0, 0.0) * tasks.resreq[vt])
+                    evicted = evicted.at[vt].set(evicted[vt] | doit)
+                    surplus = surplus.at[jnp.maximum(tasks.job[vt], 0)].add(
+                        jnp.where(doit, -1, 0))
+                    return (extra_idle, evicted, surplus,
+                            jnp.where(doit, k + 1, cfg.max_victims_per_task))
+
+                extra_idle, evicted, surplus, _ = jax.lax.while_loop(
+                    evict_cond, evict_body,
+                    (extra_idle, evicted, surplus, jnp.int32(0)))
+
+                fits = found & jnp.all(
+                    resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
+                pipe_extra = pipe_extra.at[node].add(
+                    jnp.where(fits, 1.0, 0.0) * resreq)
+                t_node = t_node.at[t].set(jnp.where(fits, node, t_node[t]))
+                t_mode = t_mode.at[t].set(
+                    jnp.where(fits, MODE_PIPELINED, t_mode[t]))
+                n_pipe += jnp.where(fits, 1, 0)
+                return (extra_idle, pipe_extra, evicted, surplus,
+                        t_node, t_mode, n_pipe), None
+
+            carry0 = (st["extra_idle"], st["pipe_extra"], st["evicted"],
+                      st["surplus"], st["task_node"], st["task_mode"],
+                      jnp.int32(0))
+            (extra_idle, pipe_extra, evicted, surplus, t_node, t_mode,
+             n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
+
+            pipelined = (jobs.ready_num[ji] + waiting0[ji] + n_pipe
+                         >= jobs.min_available[ji])
+            keep = pipelined
+
+            new = dict(extra_idle=extra_idle, pipe_extra=pipe_extra,
+                       evicted=evicted, surplus=surplus, task_node=t_node,
+                       task_mode=t_mode)
+            saved = st["saved"]
+            job_tasks = tasks.job == ji
+            merged = {}
+            for k in saved_keys:
+                if k in ("task_node", "task_mode"):
+                    cleared = jnp.where(job_tasks, saved[k], new[k])
+                    merged[k] = jnp.where(keep, new[k], cleared)
+                else:
+                    merged[k] = jnp.where(keep, new[k], saved[k])
+            new_saved = {k: merged[k] for k in saved_keys}
+
+            return dict(
+                **merged,
+                job_done=st["job_done"].at[ji].set(True),
+                job_pipelined=st["job_pipelined"].at[ji].set(pipelined),
+                saved=new_saved,
+                rounds=st["rounds"] + 1,
+            )
+
+        final = jax.lax.while_loop(cond, body, init)
+        return PreemptResult(
+            task_node=final["task_node"],
+            task_mode=final["task_mode"],
+            evicted=final["evicted"],
+            job_pipelined=final["job_pipelined"],
+            job_attempted=final["job_done"],
+        )
+
+    return preempt

@@ -15,8 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..arrays.labels import (EFFECT_PREFER_NO_SCHEDULE, TOL_EQUAL,
-                             TOL_EXISTS_ALL, TOL_EXISTS_KEY)
+from ..arrays.labels import EFFECT_PREFER_NO_SCHEDULE
 from ..arrays.schema import NodeArrays
 
 _EPS = 1e-9
@@ -81,16 +80,9 @@ def taint_prefer_score(tol_hash: jax.Array, tol_effect: jax.Array,
                        tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
     """Fewer intolerable PreferNoSchedule taints = higher score (k8s
     TaintToleration scorer as wrapped at nodeorder.go:219-271)."""
-    kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
-    m_all = (tol_mode == TOL_EXISTS_ALL)[None, None, :]
-    m_key = ((tol_mode == TOL_EXISTS_KEY)[None, None, :]
-             & (key[:, :, None] == tol_hash[None, None, :]))
-    m_eq = ((tol_mode == TOL_EQUAL)[None, None, :]
-            & (kv[:, :, None] == tol_hash[None, None, :]))
-    eff_ok = ((tol_effect == 0)[None, None, :]
-              | (tol_effect[None, None, :] == eff[:, :, None]))
-    covered = jnp.any((m_all | m_key | m_eq) & eff_ok, axis=-1)
-    prefer = eff == EFFECT_PREFER_NO_SCHEDULE
+    from .predicates import toleration_covers
+    covered = toleration_covers(tol_hash, tol_effect, tol_mode, nodes)
+    prefer = nodes.taint_effect == EFFECT_PREFER_NO_SCHEDULE
     intolerable = jnp.sum(prefer & ~covered, axis=-1)
     max_count = jnp.maximum(jnp.max(intolerable), 1)
     return (1.0 - intolerable / max_count) * 100.0

@@ -1,0 +1,6 @@
+"""Device-mesh parallelism for the scheduling cycle."""
+
+from .sharding import (make_sharded_allocate, node_sharding_specs,
+                       scheduler_mesh)
+
+__all__ = ["make_sharded_allocate", "node_sharding_specs", "scheduler_mesh"]

@@ -1,0 +1,9 @@
+"""Policy plugins (reference: pkg/scheduler/plugins/factory.go:36-53)."""
+
+from .base import Plugin
+from .factory import (build_plugin, get_plugin_builder, load_custom_plugins,
+                      register_plugin_builder, registered_plugins)
+
+__all__ = ["Plugin", "build_plugin", "get_plugin_builder",
+           "load_custom_plugins", "register_plugin_builder",
+           "registered_plugins"]

@@ -1,0 +1,91 @@
+"""Plugin model: policy plugins as kernel contributors.
+
+Re-design of the reference's callback-bag plugins (pkg/scheduler/framework/
+interface.go:35-60, session_plugins.go:26-127 with its 20 Add*Fn extension
+points): instead of registering Go closures dispatched per task×node, a
+plugin contributes
+- score weights folded into the compiled allocate pass,
+- fairness arrays (deserved shares, job/namespace shares),
+- admission gates for enqueue,
+- victim preferences/vetoes for preempt/reclaim,
+- and host-side session-close writebacks (conditions, metrics).
+
+The Session queries these contributions once per cycle and bakes them into
+the jitted kernels (SURVEY.md section 7: "plugins stop being callback bags
+and become kernel contributors").
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, Optional
+
+import numpy as np
+
+from ..framework.conf import PluginOption
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..framework.session import Session
+
+
+class Plugin:
+    """Base plugin. Subclasses override the contribution hooks they serve.
+
+    Reference seam: framework.Plugin interface (interface.go:35-43) with
+    OnSessionOpen/OnSessionClose.
+    """
+
+    name: str = ""
+
+    def __init__(self, option: Optional[PluginOption] = None):
+        self.option = option or PluginOption(name=self.name)
+
+    # lifecycle --------------------------------------------------------------
+    def on_session_open(self, ssn: "Session") -> None:
+        pass
+
+    def on_session_close(self, ssn: "Session") -> None:
+        pass
+
+    # compiled-pass contributions -------------------------------------------
+    def score_weights(self, ssn: "Session") -> Dict[str, float]:
+        """Additive weights merged into AllocateConfig (node-order terms)."""
+        return {}
+
+    def queue_deserved(self, ssn: "Session") -> Optional[np.ndarray]:
+        """f32[Q, R] deserved share, or None if this plugin doesn't gate
+        queue capacity (proportion's water-filling)."""
+        return None
+
+    def job_order_share(self, ssn: "Session") -> Optional[np.ndarray]:
+        """f32[J] fairness key for job ordering (drf)."""
+        return None
+
+    def namespace_share(self, ssn: "Session") -> Optional[np.ndarray]:
+        """f32[S] namespace ordering key (drf namespace fairness)."""
+        return None
+
+    def enqueue_gates(self, ssn: "Session") -> Dict[str, object]:
+        """Contributions to EnqueueConfig (proportion/overcommit/sla)."""
+        return {}
+
+    def sla_waiting(self, ssn: "Session") -> Optional[np.ndarray]:
+        """bool[J] jobs past their SLA waiting deadline."""
+        return None
+
+    # preempt/reclaim contributions (bool masks over the task axis) ----------
+    def victim_veto(self, ssn: "Session") -> Optional[np.ndarray]:
+        """bool[T] tasks this plugin forbids evicting (conformance, gang)."""
+        return None
+
+    def arg(self, key: str, default=None):
+        return self.option.get_argument(key, default)
+
+    def arg_float(self, key: str, default: float) -> float:
+        v = self.arg(key)
+        return float(v) if v is not None else default
+
+    def arg_bool(self, key: str, default: bool) -> bool:
+        v = self.arg(key)
+        if v is None:
+            return default
+        return str(v).lower() in ("1", "true", "yes", "on")

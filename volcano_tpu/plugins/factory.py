@@ -1,0 +1,69 @@
+"""Plugin registry (reference: pkg/scheduler/plugins/factory.go:36-53 and
+framework/plugins.go:38-119 incl. custom-plugin loading)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from typing import Callable, Dict, Type
+
+from ..framework.conf import PluginOption
+from .base import Plugin
+from .drf import DRFPlugin
+from .gang import GangPlugin
+from .proportion import ProportionPlugin
+from .reservation import ReservationPlugin
+from .simple_plugins import (BinpackPlugin, ConformancePlugin, NodeOrderPlugin,
+                             OvercommitPlugin, PredicatesPlugin,
+                             PriorityPlugin, SLAPlugin)
+from .task_topology import TaskTopologyPlugin
+from .tdm import TDMPlugin
+
+_REGISTRY: Dict[str, Type[Plugin]] = {}
+
+
+def register_plugin_builder(name: str, cls: Type[Plugin]) -> None:
+    """Reference: RegisterPluginBuilder (framework/plugins.go:38)."""
+    _REGISTRY[name] = cls
+
+
+def get_plugin_builder(name: str) -> Type[Plugin]:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown plugin {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def build_plugin(option: PluginOption) -> Plugin:
+    return get_plugin_builder(option.name)(option)
+
+
+def registered_plugins():
+    return sorted(_REGISTRY)
+
+
+def load_custom_plugins(plugins_dir: str) -> int:
+    """Load user plugin modules from a directory — the Python analog of the
+    reference's Go ``plugin.Open`` .so loading (framework/plugins.go:62-99,
+    docs/design/custom-plugin.md). Each ``*.py`` file must call
+    ``register_plugin_builder`` at import time. Returns the number of modules
+    loaded."""
+    count = 0
+    if not os.path.isdir(plugins_dir):
+        return 0
+    for fname in sorted(os.listdir(plugins_dir)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(plugins_dir, fname)
+        spec = importlib.util.spec_from_file_location(
+            f"volcano_tpu_custom_{fname[:-3]}", path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        count += 1
+    return count
+
+
+for _cls in (PriorityPlugin, GangPlugin, ConformancePlugin, DRFPlugin,
+             ProportionPlugin, PredicatesPlugin, NodeOrderPlugin,
+             BinpackPlugin, OvercommitPlugin, SLAPlugin, TDMPlugin,
+             TaskTopologyPlugin, ReservationPlugin):
+    register_plugin_builder(_cls.name, _cls)

@@ -1,0 +1,47 @@
+"""Gang plugin: all-or-nothing admission of PodGroups.
+
+Reference: pkg/scheduler/plugins/gang/gang.go:37-216. The core gang
+semantics (JobReady/JobPipelined/JobStarving, ready-jobs-order-last) are
+compiled into the allocate/preempt kernels; this class contributes the
+victim-surplus vector used to veto evictions that would break a running gang
+(gang.go:83-107) and writes PodGroup conditions at session close
+(gang.go:158-216).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..api.types import (POD_GROUP_CONDITION_SCHEDULED,
+                         POD_GROUP_CONDITION_UNSCHEDULABLE)
+from .base import Plugin
+
+
+class GangPlugin(Plugin):
+    name = "gang"
+
+    def job_evictable_surplus(self, ssn) -> np.ndarray:
+        """i32[J]: how many occupying tasks each job can lose before dropping
+        below minAvailable — the kernel form of gang's Preemptable veto
+        (victims rejected once occupied - victims < MinAvailable)."""
+        jobs = ssn.snap.jobs
+        return np.maximum(
+            np.asarray(jobs.ready_num) - np.asarray(jobs.min_available), 0
+        ).astype(np.int32)
+
+    def on_session_close(self, ssn) -> None:
+        """Write Scheduled/Unschedulable conditions onto jobs that were
+        attempted this cycle (gang.go:158-216)."""
+        for uid, ji in ssn.maps.job_index.items():
+            job = ssn.cluster.jobs.get(uid)
+            if job is None:
+                continue
+            if ssn.last_allocate is not None and bool(
+                    np.asarray(ssn.last_allocate.job_attempted)[ji]):
+                ready = bool(np.asarray(ssn.last_allocate.job_ready)[ji])
+                cond = (POD_GROUP_CONDITION_SCHEDULED if ready
+                        else POD_GROUP_CONDITION_UNSCHEDULABLE)
+                job.job_fit_errors = "" if ready else (
+                    f"{job.pending_task_num()}/{len(job.tasks)} tasks in gang "
+                    f"unschedulable: job is not ready")
+                ssn.conditions[uid] = cond

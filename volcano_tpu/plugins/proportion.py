@@ -1,0 +1,29 @@
+"""Proportion plugin: weighted queue fair share.
+
+Reference: pkg/scheduler/plugins/proportion/proportion.go:33-325. The
+water-filling deserved computation runs as the compiled kernel
+ops/fairshare.proportion_deserved; the Overused gate and queue share
+ordering consume its output inside the allocate pass; the JobEnqueueable
+gate runs in the enqueue pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import Plugin
+
+
+class ProportionPlugin(Plugin):
+    name = "proportion"
+
+    def queue_deserved(self, ssn) -> np.ndarray:
+        from ..ops.fairshare import proportion_deserved
+        q = jax.tree.map(jnp.asarray, ssn.snap.queues)
+        return np.asarray(proportion_deserved(
+            q, jnp.asarray(ssn.snap.cluster_capacity)))
+
+    def enqueue_gates(self, ssn):
+        return {"enable_proportion_gate": True}

@@ -1,0 +1,75 @@
+"""Reservation plugin: elect a starving target job and lock nodes for it.
+
+Reference: pkg/scheduler/plugins/reservation/reservation.go:28-141 with the
+elect/reserve actions (pkg/scheduler/actions/{elect,reserve}) and the global
+Reservation singleton (pkg/scheduler/util/scheduler_helper.go:44-48,257-269):
+the highest-priority, longest-waiting pending job becomes the target; while
+it stays unready, the scheduler locks the emptiest unlocked node each cycle
+so the target eventually fits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+import numpy as np
+
+from .base import Plugin
+
+
+class ReservationState:
+    """Cross-cycle reservation state (the util.Reservation singleton)."""
+
+    def __init__(self):
+        self.target_job_uid: Optional[str] = None
+        self.locked_nodes: Set[str] = set()
+
+    def reset(self):
+        self.target_job_uid = None
+        self.locked_nodes.clear()
+
+
+class ReservationPlugin(Plugin):
+    name = "reservation"
+
+    def __init__(self, option=None, state: Optional[ReservationState] = None):
+        super().__init__(option)
+        self.state = state or ReservationState()
+
+    def elect_target(self, ssn) -> Optional[str]:
+        """TargetJobFn: highest-priority then longest-waiting pending job
+        (reservation.go:39-54)."""
+        best_uid, best_key = None, None
+        for uid, job in ssn.cluster.jobs.items():
+            if job.pending_task_num() == 0 or job.is_ready():
+                continue
+            key = (-job.priority, job.creation_timestamp)
+            if best_key is None or key < best_key:
+                best_key, best_uid = key, uid
+        return best_uid
+
+    def reserve_node(self, ssn) -> Optional[str]:
+        """ReservedNodesFn: lock the unlocked node with the most idle
+        resources (reservation.go:56-63)."""
+        best_name, best_idle = None, -1.0
+        for name, node in ssn.cluster.nodes.items():
+            if name in self.state.locked_nodes:
+                continue
+            idle = node.idle.milli_cpu
+            if idle > best_idle:
+                best_idle, best_name = idle, name
+        return best_name
+
+    def node_locked_mask(self, ssn) -> np.ndarray:
+        N = np.asarray(ssn.snap.nodes.pod_count).shape[0]
+        locked = np.zeros(N, bool)
+        for name in self.state.locked_nodes:
+            ni = ssn.maps.node_index.get(name)
+            if ni is not None:
+                locked[ni] = True
+        return locked
+
+    def target_job_index(self, ssn) -> int:
+        if self.state.target_job_uid is None:
+            return -1
+        return ssn.maps.job_index.get(self.state.target_job_uid, -1)

@@ -1,0 +1,121 @@
+"""Small policy plugins: priority, binpack, nodeorder, predicates,
+conformance, overcommit, sla.
+
+Each contributes static weights/gates that the Session folds into the
+compiled passes:
+
+- priority (pkg/scheduler/plugins/priority/priority.go:30-117): job/task
+  priority ordering — the priority keys are always packed into the arrays;
+  this plugin's presence is what turns them on in the reference conf, and it
+  also vetoes preempting higher-or-equal-priority victims.
+- binpack (pkg/scheduler/plugins/binpack/binpack.go:157-260): best-fit score
+  weight from ``binpack.weight`` argument.
+- nodeorder (pkg/scheduler/plugins/nodeorder/nodeorder.go:39-414): k8s scorer
+  weights (leastrequested/mostrequested/balancedresource/tainttoleration).
+- predicates (pkg/scheduler/plugins/predicates/predicates.go:42-288): enables
+  the feasibility-mask conjunction (always compiled in; presence keeps
+  conf-file parity).
+- conformance (pkg/scheduler/plugins/conformance/conformance.go:30-68):
+  vetoes eviction of kube-system / critical pods.
+- overcommit (pkg/scheduler/plugins/overcommit/overcommit.go:28-124):
+  enqueue admission with cluster overcommit factor.
+- sla (pkg/scheduler/plugins/sla/sla.go:33-151): jobs waiting past
+  ``sla-waiting-time`` are force-admitted/ordered first.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+import numpy as np
+
+from .base import Plugin
+
+
+class PriorityPlugin(Plugin):
+    name = "priority"
+
+
+class PredicatesPlugin(Plugin):
+    name = "predicates"
+
+
+class BinpackPlugin(Plugin):
+    name = "binpack"
+
+    def score_weights(self, ssn):
+        return {"binpack_weight": self.arg_float("binpack.weight", 1.0)}
+
+
+class NodeOrderPlugin(Plugin):
+    name = "nodeorder"
+
+    def score_weights(self, ssn):
+        return {
+            "least_allocated_weight":
+                self.arg_float("leastrequested.weight", 1.0),
+            "most_allocated_weight":
+                self.arg_float("mostrequested.weight", 0.0),
+            "balanced_weight":
+                self.arg_float("balancedresource.weight", 1.0),
+            "taint_prefer_weight":
+                self.arg_float("tainttoleration.weight", 1.0),
+        }
+
+
+class ConformancePlugin(Plugin):
+    name = "conformance"
+
+    def victim_veto(self, ssn) -> np.ndarray:
+        """bool[T]: never evict kube-system or critical-priority tasks
+        (conformance.go:30-68)."""
+        T = np.asarray(ssn.snap.tasks.status).shape[0]
+        veto = np.zeros(T, bool)
+        for uid, ti in ssn.maps.task_index.items():
+            ns = uid.split("/")[0]
+            if ns == "kube-system":
+                veto[ti] = True
+        return veto
+
+
+class OvercommitPlugin(Plugin):
+    name = "overcommit"
+
+    def enqueue_gates(self, ssn):
+        return {"enable_overcommit_gate": True,
+                "overcommit_factor": self.arg_float("overcommit-factor", 1.2)}
+
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)([hms])")
+
+
+def parse_duration(s: str) -> float:
+    """'1h30m' / '300s' -> seconds (Go time.ParseDuration subset)."""
+    total, pos = 0.0, 0
+    for m in _DURATION_RE.finditer(s):
+        total += float(m.group(1)) * {"h": 3600, "m": 60, "s": 1}[m.group(2)]
+        pos = m.end()
+    if pos == 0:
+        raise ValueError(f"unparseable duration: {s!r}")
+    return total
+
+
+class SLAPlugin(Plugin):
+    name = "sla"
+
+    def sla_waiting(self, ssn) -> np.ndarray:
+        """bool[J]: jobs waiting longer than the global sla-waiting-time
+        (sla.go:129-148; per-job annotation override TODO)."""
+        J = np.asarray(ssn.snap.jobs.valid).shape[0]
+        waiting = np.zeros(J, bool)
+        arg = self.arg("sla-waiting-time")
+        if arg is None:
+            return waiting
+        threshold = parse_duration(str(arg))
+        now = ssn.now
+        for uid, ji in ssn.maps.job_index.items():
+            job = ssn.cluster.jobs.get(uid)
+            if job is not None and now - job.creation_timestamp > threshold:
+                waiting[ji] = True
+        return waiting

@@ -1,0 +1,65 @@
+"""Task-topology plugin: role affinity/anti-affinity within a job.
+
+Reference: pkg/scheduler/plugins/task-topology/{topology,manager,bucket}.go
+(964 LoC) — tasks of affine roles are grouped into buckets steered onto the
+same node; anti-affine roles are pushed apart. The bucket bookkeeping is
+host-side (like the reference's JobManager); the placement steer is the
+``task_pref_node`` score bonus in the allocate kernel.
+
+Annotation format (topology.go): job annotation ``volcano.sh/task-topology``
+with arguments ``task-topology.affinity: "role1,role2;..."`` and
+``task-topology.anti-affinity`` pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+import numpy as np
+
+from .base import Plugin
+
+AFFINITY_ARG = "task-topology.affinity"
+ANTI_AFFINITY_ARG = "task-topology.anti-affinity"
+
+
+def _parse_pairs(spec: str) -> List[Set[str]]:
+    groups = []
+    for part in str(spec).split(";"):
+        roles = {r.strip() for r in part.split(",") if r.strip()}
+        if roles:
+            groups.append(roles)
+    return groups
+
+
+class TaskTopologyPlugin(Plugin):
+    name = "task-topology"
+
+    def task_pref_node(self, ssn) -> np.ndarray:
+        """i32[T]: preferred node per pending task — the node already hosting
+        a bucket-mate (affine running/bound task of the same job)."""
+        T = np.asarray(ssn.snap.tasks.status).shape[0]
+        pref = np.full(T, -1, np.int32)
+        affinity = _parse_pairs(self.arg(AFFINITY_ARG, ""))
+        if not affinity:
+            return pref
+        for uid, job in ssn.cluster.jobs.items():
+            # node of the first placed task per role
+            role_node: Dict[str, str] = {}
+            for task in job.tasks.values():
+                if task.node_name and task.task_role:
+                    role_node.setdefault(task.task_role, task.node_name)
+            if not role_node:
+                continue
+            for task in job.tasks.values():
+                ti = ssn.maps.task_index.get(task.uid)
+                if ti is None or task.node_name:
+                    continue
+                for group in affinity:
+                    if task.task_role in group:
+                        for other in group:
+                            node = role_node.get(other)
+                            if node and node in ssn.maps.node_index:
+                                pref[ti] = ssn.maps.node_index[node]
+                                break
+        return pref

@@ -1,0 +1,80 @@
+"""TDM (time-division multiplexing) plugin: revocable-zone scheduling windows.
+
+Reference: pkg/scheduler/plugins/tdm/tdm.go:58-372 — nodes annotated with a
+revocable zone only admit preemptable tasks while the zone's configured
+daily window (``tdm.revocable-zone.<zone>: "hh:mm-hh:mm"``) is active; a
+score bonus steers preemptable tasks there during the window; outside the
+window, preemptable tasks on revocable nodes become victims (evicted in
+max-step batches by the victimsFn, tdm.go:298).
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .base import Plugin
+
+REVOCABLE_ZONE_LABEL = "volcano.sh/revocable-zone"
+
+
+def _parse_window(spec: str) -> Tuple[int, int]:
+    start, end = spec.strip().split("-")
+    h1, m1 = (int(x) for x in start.split(":"))
+    h2, m2 = (int(x) for x in end.split(":"))
+    return h1 * 60 + m1, h2 * 60 + m2
+
+
+class TDMPlugin(Plugin):
+    name = "tdm"
+
+    def _zones(self) -> Dict[str, Tuple[int, int]]:
+        zones = {}
+        for key, val in self.option.arguments.items():
+            if key.startswith("tdm.revocable-zone."):
+                zones[key[len("tdm.revocable-zone."):]] = _parse_window(str(val))
+        return zones
+
+    def _zone_active(self, zone: str, now: float) -> bool:
+        window = self._zones().get(zone)
+        if window is None:
+            return False
+        t = datetime.datetime.fromtimestamp(now)
+        minute = t.hour * 60 + t.minute
+        lo, hi = window
+        return lo <= minute <= hi if lo <= hi else (minute >= lo or minute <= hi)
+
+    def node_zone(self, ssn, name: str) -> str:
+        node = ssn.cluster.nodes.get(name)
+        return (node.labels.get(REVOCABLE_ZONE_LABEL, "") if node else "")
+
+    def block_nonpreempt(self, ssn) -> np.ndarray:
+        """bool[N]: revocable nodes (active window) admit only preemptable
+        tasks; outside the window they admit nothing new (tdm.go:295)."""
+        N = np.asarray(ssn.snap.nodes.pod_count).shape[0]
+        block = np.zeros(N, bool)
+        for name, ni in ssn.maps.node_index.items():
+            if self.node_zone(ssn, name):
+                block[ni] = True
+        return block
+
+    def victim_tasks(self, ssn) -> np.ndarray:
+        """bool[T]: preemptable tasks sitting on revocable nodes whose window
+        is closed — the periodic eviction sweep (tdm.go:298-340)."""
+        T = np.asarray(ssn.snap.tasks.status).shape[0]
+        victims = np.zeros(T, bool)
+        preemptable = np.asarray(ssn.snap.tasks.preemptable)
+        for uid, ti in ssn.maps.task_index.items():
+            task = None
+            for job in ssn.cluster.jobs.values():
+                task = job.tasks.get(uid)
+                if task is not None:
+                    break
+            if task is None or not task.node_name:
+                continue
+            zone = self.node_zone(ssn, task.node_name)
+            if zone and preemptable[ti] and not self._zone_active(zone, ssn.now):
+                victims[ti] = True
+        return victims

@@ -1,0 +1,6 @@
+"""Runtime: cluster I/O seam, scheduler loop, CPU reference oracle."""
+
+from .fake_cluster import FakeCluster
+from .scheduler import Scheduler
+
+__all__ = ["FakeCluster", "Scheduler"]

@@ -23,7 +23,7 @@ from ..arrays.labels import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
                              TOL_EXISTS_ALL, TOL_EXISTS_KEY)
 from ..arrays.schema import SnapshotArrays
 from ..ops.allocate_scan import (MODE_ALLOCATED, MODE_NONE, MODE_PIPELINED,
-                                 AllocateConfig)
+                                 AllocateConfig, AllocateExtras)
 
 _EPS = 1e-5
 
@@ -32,19 +32,32 @@ def _np(x):
     return np.asarray(x)
 
 
+def _as_np(nodes):
+    """One-time numpy view of the node tensors (hoisted out of the hot
+    loop so the CPU baseline is not penalized by per-call conversions)."""
+    from types import SimpleNamespace
+    return SimpleNamespace(
+        valid=np.asarray(nodes.valid), schedulable=np.asarray(nodes.schedulable),
+        pod_count=np.asarray(nodes.pod_count), max_pods=np.asarray(nodes.max_pods),
+        labels=np.asarray(nodes.labels), taint_kv=np.asarray(nodes.taint_kv),
+        taint_key=np.asarray(nodes.taint_key),
+        taint_effect=np.asarray(nodes.taint_effect),
+        allocatable=np.asarray(nodes.allocatable))
+
+
 def _feasible_one(nodes, resreq, sel, th, te, tm, avail, pods_extra):
     N = avail.shape[0]
-    ok = np.array(nodes.valid) & np.array(nodes.schedulable)
-    ok &= (np.array(nodes.pod_count) + pods_extra) < np.array(nodes.max_pods)
+    ok = nodes.valid & nodes.schedulable
+    ok &= (nodes.pod_count + pods_extra) < nodes.max_pods
     ok &= np.all(resreq[None, :] <= avail + _EPS, axis=-1)
-    labels = np.array(nodes.labels)
+    labels = nodes.labels
     for s in sel:
         if s != 0:
             ok &= np.any(labels == s, axis=-1)
-    kv, key, eff = (np.array(nodes.taint_kv), np.array(nodes.taint_key),
-                    np.array(nodes.taint_effect))
+    kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
+    has_hard = np.isin(eff, (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE)).any(axis=-1)
     for n in range(N):
-        if not ok[n]:
+        if not ok[n] or not has_hard[n]:
             continue
         for e in range(kv.shape[1]):
             if eff[n, e] not in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
@@ -67,7 +80,7 @@ def _feasible_one(nodes, resreq, sel, th, te, tm, avail, pods_extra):
 
 
 def _score_one(cfg: AllocateConfig, nodes, resreq, idle, th, te, tm):
-    allocatable = np.array(nodes.allocatable)
+    allocatable = nodes.allocatable
     used = allocatable - idle
     N = idle.shape[0]
     score = np.zeros(N)
@@ -101,10 +114,12 @@ def _score_one(cfg: AllocateConfig, nodes, resreq, idle, th, te, tm):
         var = (((frac - mean[:, None]) ** 2) * counted).sum(-1) / n
         score += cfg.balanced_weight * (1.0 - np.sqrt(var)) * 100
     if cfg.taint_prefer_weight:
-        kv, key, eff = (np.array(nodes.taint_kv), np.array(nodes.taint_key),
-                        np.array(nodes.taint_effect))
+        kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
         intol = np.zeros(N)
+        has_prefer = (eff == EFFECT_PREFER_NO_SCHEDULE).any(axis=-1)
         for n in range(N):
+            if not has_prefer[n]:
+                continue
             for e in range(kv.shape[1]):
                 if eff[n, e] != EFFECT_PREFER_NO_SCHEDULE:
                     continue
@@ -126,12 +141,21 @@ def _score_one(cfg: AllocateConfig, nodes, resreq, idle, th, te, tm):
     return score
 
 
-def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
-                 queue_deserved: np.ndarray, ns_share: np.ndarray = None,
+def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                  cfg: AllocateConfig = AllocateConfig()) -> Dict[str, np.ndarray]:
     """Run the allocate pass sequentially on the host. Returns the same
     decision arrays as ops.allocate_scan (task_node, task_mode, job_ready,
     job_pipelined)."""
+    if extras is None:
+        extras = AllocateExtras.neutral(snap)
+    job_share = np.asarray(extras.job_share)
+    queue_deserved = np.asarray(extras.queue_deserved)
+    ns_share = np.asarray(extras.ns_share)
+    queue_share_extra = np.asarray(extras.queue_share_extra)
+    block_nonpreempt = np.asarray(extras.block_nonpreempt)
+    task_pref_node = np.asarray(extras.task_pref_node)
+    node_locked = np.asarray(extras.node_locked)
+    target_job = int(extras.target_job)
     nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
     N, R = np.array(nodes.idle).shape
     T = np.array(tasks.resreq).shape[0]
@@ -148,8 +172,6 @@ def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
     job_pipelined = np.zeros(J, bool)
 
     jns = np.array(jobs.namespace)
-    if ns_share is None:
-        ns_share = np.zeros(int(jns.max(initial=0)) + 1, np.float32)
     jvalid = np.array(jobs.valid) & np.array(jobs.schedulable)
     n_pending = np.array(jobs.n_pending)
     jqueue = np.array(jobs.queue)
@@ -163,6 +185,12 @@ def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
     resreq = np.array(tasks.resreq, dtype=np.float64)
     best_effort = np.array(tasks.best_effort)
     tjob = np.array(tasks.job)
+    t_selector = np.array(tasks.selector)
+    t_tol_hash = np.array(tasks.tol_hash)
+    t_tol_effect = np.array(tasks.tol_effect)
+    t_tol_mode = np.array(tasks.tol_mode)
+    t_preemptable = np.array(tasks.preemptable)
+    nodes_np = _as_np(nodes)
 
     while True:
         overused = np.all(queue_allocated >= queue_deserved - 1e-6, axis=-1)
@@ -172,7 +200,7 @@ def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
         qshare = np.max(
             np.where(np.isfinite(queue_deserved) & (queue_deserved > 0),
                      queue_allocated / np.maximum(queue_deserved, 1e-9), 0.0),
-            axis=-1)
+            axis=-1) + queue_share_extra
         ready_now = (jready0 >= jmin) & (jmin > 0)
         keys = np.stack([
             np.asarray(ns_share, float)[jns], jns.astype(float),
@@ -195,13 +223,18 @@ def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
             t = table[ji, slot]
             if t < 0 or best_effort[t]:
                 continue
-            sel = np.array(tasks.selector)[t]
-            th = np.array(tasks.tol_hash)[t]
-            te = np.array(tasks.tol_effect)[t]
-            tm = np.array(tasks.tol_mode)[t]
+            sel = t_selector[t]
+            th = t_tol_hash[t]
+            te = t_tol_effect[t]
+            tm = t_tol_mode[t]
             req = resreq[t]
-            feas_now = _feasible_one(nodes, req, sel, th, te, tm, idle, pods_extra)
-            score = _score_one(cfg, nodes, req, idle, th, te, tm)
+            node_ok = (~(block_nonpreempt & ~t_preemptable[t])
+                       & (~node_locked | (ji == target_job)))
+            feas_now = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm,
+                                               idle, pods_extra)
+            score = _score_one(cfg, nodes_np, req, idle, th, te, tm)
+            if task_pref_node[t] >= 0:
+                score = score + 100.0 * (np.arange(len(score)) == task_pref_node[t])
             if feas_now.any():
                 node = int(np.argmax(np.where(feas_now, score, -np.inf)))
                 idle[node] -= req
@@ -212,7 +245,7 @@ def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
                 n_alloc += 1
             elif cfg.enable_pipelining:
                 future = np.maximum(idle + releasing - pipelined0 - pipe_extra, 0)
-                feas_fut = _feasible_one(nodes, req, sel, th, te, tm, future,
+                feas_fut = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm, future,
                                          pods_extra)
                 if feas_fut.any():
                     node = int(np.argmax(np.where(feas_fut, score, -np.inf)))
@@ -231,6 +264,10 @@ def allocate_cpu(snap: SnapshotArrays, job_share: np.ndarray,
             queue_allocated[jqueue[ji]] += resreq[placed].sum(axis=0) if placed else 0
             job_ready[ji] = bool(ready)
             job_pipelined[ji] = bool(pipelined and not ready)
+            if not ready:
+                # kept-but-unready gang: capacity held, no binds
+                for t in placed:
+                    task_mode[t] = MODE_PIPELINED
         else:
             idle, pipe_extra, pods_extra = saved
             for t in placed:

@@ -1,0 +1,82 @@
+"""In-memory cluster: the test/bench stand-in for the Kubernetes API server.
+
+Reference seam: the Cache interface with FakeBinder/FakeEvictor/
+FakeStatusUpdater (pkg/scheduler/cache/interface.go:29-86,
+pkg/scheduler/util/test_utils.go:95-176). The FakeCluster owns the
+authoritative ClusterInfo, serves deep-copy snapshots to sessions, and
+applies bind/evict intents the way the real binder/evictor REST calls would,
+recording them for assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
+                   TaskStatus)
+from ..framework.session import BindIntent, EvictIntent
+
+
+class FakeCluster:
+    def __init__(self, ci: Optional[ClusterInfo] = None):
+        self.ci = ci or ClusterInfo()
+        self.binds: List[Tuple[str, str]] = []      # (task uid, node)
+        self.evictions: List[str] = []              # task uid
+        self.bind_failures: Dict[str, str] = {}     # task uid -> error to inject
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> ClusterInfo:
+        """Deep copy, like SchedulerCache.Snapshot (cache.go:712-811)."""
+        return self.ci.clone()
+
+    # ----------------------------------------------------------- bind/evict
+    def bind(self, intent: BindIntent) -> bool:
+        """Apply a bind: task becomes Bound on the node (defaultBinder.Bind,
+        cache.go:123-143). Injectable failures exercise the resync path."""
+        if intent.task_uid in self.bind_failures:
+            return False
+        job = self.ci.jobs.get(intent.job_uid)
+        node = self.ci.nodes.get(intent.node_name)
+        if job is None or node is None:
+            return False
+        task = job.tasks.get(intent.task_uid)
+        if task is None:
+            return False
+        if task.uid in self.ci.nodes.get(task.node_name, node).tasks:
+            self.ci.nodes[task.node_name].remove_task(task)
+        job.update_task_status(task, TaskStatus.BOUND)
+        node.add_task(task)
+        self.binds.append((intent.task_uid, intent.node_name))
+        return True
+
+    def evict(self, intent: EvictIntent) -> bool:
+        """Apply an eviction: task goes back to Pending off-node
+        (defaultEvictor.Evict, cache.go:145-175)."""
+        job = self.ci.jobs.get(intent.job_uid)
+        if job is None:
+            return False
+        task = job.tasks.get(intent.task_uid)
+        if task is None:
+            return False
+        node = self.ci.nodes.get(task.node_name)
+        if node is not None and task.uid in node.tasks:
+            node.remove_task(task)
+        task.node_name = ""
+        job.update_task_status(task, TaskStatus.PENDING)
+        self.evictions.append(intent.task_uid)
+        return True
+
+    # --------------------------------------------------- lifecycle helpers
+    def run_task(self, task_uid: str) -> None:
+        """Kubelet-style transition Bound -> Running."""
+        for job in self.ci.jobs.values():
+            task = job.tasks.get(task_uid)
+            if task is not None:
+                node = self.ci.nodes.get(task.node_name)
+                if node is not None and task.uid in node.tasks:
+                    node.remove_task(task)
+                    job.update_task_status(task, TaskStatus.RUNNING)
+                    node.add_task(task)
+                else:
+                    job.update_task_status(task, TaskStatus.RUNNING)
+                return

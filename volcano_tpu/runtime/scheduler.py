@@ -1,0 +1,97 @@
+"""The scheduler loop: snapshot -> session -> actions -> bind.
+
+Reference: pkg/scheduler/scheduler.go:54-171 (Scheduler.Run / runOnce with
+the 1s wait.Until cycle, conf hot-reload) and cmd/scheduler/app/server.go.
+The loop is synchronous here; bind/evict intents flush to the cluster source
+at the end of each cycle (the reference fires them as goroutines mid-cycle —
+same external effect, recorded in order).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..framework.conf import SchedulerConfiguration, parse_conf
+from ..framework.session import Session
+from ..metrics import METRICS
+from .fake_cluster import FakeCluster
+
+
+class Scheduler:
+    def __init__(self, cluster: FakeCluster,
+                 conf: Optional[SchedulerConfiguration] = None,
+                 conf_path: Optional[str] = None,
+                 schedule_period: float = 1.0):
+        self.cluster = cluster
+        self.conf_path = conf_path
+        self._conf_mtime = 0.0
+        self.conf = conf or self._load_conf() or parse_conf()
+        self.schedule_period = schedule_period
+        self._plugin_state: Dict[str, object] = {}
+        self.cycles = 0
+
+    def _load_conf(self) -> Optional[SchedulerConfiguration]:
+        """Conf hot-reload (fsnotify watcher, scheduler.go:146-171 — here a
+        cheap mtime poll at cycle start)."""
+        if not self.conf_path or not os.path.exists(self.conf_path):
+            return None
+        mtime = os.path.getmtime(self.conf_path)
+        if mtime == self._conf_mtime:
+            return None
+        self._conf_mtime = mtime
+        with open(self.conf_path) as f:
+            return parse_conf(f.read())
+
+    def _persistent_plugins(self) -> Dict[str, object]:
+        """Plugins with cross-cycle state (the reservation singleton)."""
+        from ..plugins.reservation import ReservationPlugin
+        overrides = {}
+        if self.conf.plugin_option("reservation") is not None:
+            if "reservation" not in self._plugin_state:
+                self._plugin_state["reservation"] = ReservationPlugin(
+                    self.conf.plugin_option("reservation"))
+            overrides["reservation"] = self._plugin_state["reservation"]
+        return overrides
+
+    def run_once(self, now: Optional[float] = None) -> Session:
+        """One scheduling cycle (runOnce, scheduler.go:91-120)."""
+        reloaded = self._load_conf()
+        if reloaded is not None:
+            self.conf = reloaded
+        t0 = time.time()
+        ssn = Session(self.cluster.snapshot(), self.conf, now=now,
+                      plugin_overrides=self._persistent_plugins())
+        from ..actions import get_action
+        for name in self.conf.actions:
+            ta = time.time()
+            get_action(name).execute(ssn)
+            METRICS.observe_action(name, time.time() - ta)
+        ssn.close()
+
+        # PodGroup status write-back at session close (the jobUpdater's
+        # parallel UpdatePodGroup flush, framework/job_updater.go:66-108)
+        for uid, phase in ssn.phase_updates.items():
+            job = self.cluster.ci.jobs.get(uid)
+            if job is not None:
+                job.pod_group_phase = phase
+
+        for intent in ssn.evictions:
+            self.cluster.evict(intent)
+        for intent in ssn.binds:
+            ok = self.cluster.bind(intent)
+            if not ok:
+                METRICS.inc("resync_tasks")
+        METRICS.observe_cycle(time.time() - t0)
+        METRICS.inc("schedule_attempts")
+        self.cycles += 1
+        return ssn
+
+    def run(self, cycles: int = 1, sleep: bool = False) -> List[Session]:
+        out = []
+        for _ in range(cycles):
+            out.append(self.run_once())
+            if sleep:
+                time.sleep(self.schedule_period)
+        return out

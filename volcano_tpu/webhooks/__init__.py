@@ -1,0 +1,15 @@
+"""Admission webhooks (reference: pkg/webhooks)."""
+
+from .jobs import (AdmissionError, mutate_job, validate_job_create,
+                   validate_job_update)
+from .podgroups import mutate_podgroup
+from .pods import validate_pod
+from .queues import (mutate_queue, validate_queue, validate_queue_delete)
+from .router import get_service, register, registered_paths
+
+__all__ = [
+    "AdmissionError", "mutate_job", "validate_job_create",
+    "validate_job_update", "mutate_podgroup", "validate_pod", "mutate_queue",
+    "validate_queue", "validate_queue_delete", "get_service", "register",
+    "registered_paths",
+]

@@ -1,0 +1,162 @@
+"""Job admission: validation and defaulting.
+
+Reference: pkg/webhooks/admission/jobs/validate/admit_job.go:46-410 +
+util.go:1-187 (create/update validation matrices) and
+pkg/webhooks/admission/jobs/mutate/mutate_job.go:49-200 (defaults). The
+tests mirror admit_job_test.go:1-1351 case families.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional
+
+from ..api.batch import Job, LifecyclePolicy
+from ..api.types import BusAction, BusEvent, DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME, QueueState
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+#: Actions a policy may attach to (admit_job.go policy validation).
+_VALID_POLICY_ACTIONS = {
+    BusAction.ABORT_JOB, BusAction.RESTART_JOB, BusAction.RESTART_TASK,
+    BusAction.TERMINATE_JOB, BusAction.COMPLETE_JOB, BusAction.RESUME_JOB,
+    BusAction.SYNC_JOB,
+}
+
+
+class AdmissionError(ValueError):
+    pass
+
+
+def _validate_policies(policies: List[LifecyclePolicy], where: str) -> List[str]:
+    errs = []
+    seen_events = set()
+    for p in policies:
+        events = set(p.events)
+        if p.event is not None:
+            events.add(p.event)
+        if events and p.exit_code is not None:
+            errs.append(f"{where}: must not specify event and exitCode simultaneously")
+        if not events and p.exit_code is None:
+            errs.append(f"{where}: either event or exitCode must be specified")
+        if p.exit_code == 0:
+            errs.append(f"{where}: 0 is not a valid error code")
+        if p.action not in _VALID_POLICY_ACTIONS:
+            errs.append(f"{where}: invalid policy action {p.action}")
+        for e in events:
+            if e in seen_events and e != BusEvent.ANY:
+                errs.append(f"{where}: duplicate event {e.value}")
+            seen_events.add(e)
+        if p.timeout_seconds is not None and p.timeout_seconds <= 0:
+            errs.append(f"{where}: policy timeout must be positive")
+    return errs
+
+
+def validate_job_create(job: Job,
+                        queues: Optional[Dict[str, object]] = None) -> None:
+    """Raise AdmissionError on an invalid Job (admit_job.go:46-220)."""
+    errs: List[str] = []
+    if job.min_available < 0:
+        errs.append("job 'minAvailable' must be >= 0")
+    if job.max_retry < 0:
+        errs.append("'maxRetry' cannot be less than zero")
+    if (job.ttl_seconds_after_finished is not None
+            and job.ttl_seconds_after_finished < 0):
+        errs.append("'ttlSecondsAfterFinished' cannot be less than zero")
+    if not job.tasks:
+        errs.append("no task specified in job spec")
+
+    total_replicas = 0
+    names = set()
+    for task in job.tasks:
+        if task.replicas < 0:
+            errs.append(f"'replicas' < 0 in task: {task.name}")
+        if task.min_available is not None:
+            if task.min_available < 0:
+                errs.append(f"'minAvailable' < 0 in task: {task.name}")
+            elif task.min_available > task.replicas:
+                errs.append(
+                    f"'minAvailable' is greater than 'replicas' in task: {task.name}")
+        if task.name in names:
+            errs.append(f"duplicated task name {task.name}")
+        names.add(task.name)
+        if task.name and not _DNS1123.match(task.name):
+            errs.append(f"task name {task.name} is not a valid DNS-1123 label")
+        total_replicas += max(task.replicas, 0)
+        errs.extend(_validate_policies(task.policies, f"task {task.name}"))
+
+    if total_replicas < job.min_available:
+        errs.append("job 'minAvailable' should not be greater than total "
+                    "replicas in tasks")
+    if job.min_success is not None and job.min_success < 1:
+        errs.append("job 'minSuccess' must be >= 1")
+    errs.extend(_validate_policies(job.policies, "job"))
+
+    seen_mounts = set()
+    for v in job.volumes:
+        if v.mount_path in seen_mounts:
+            errs.append(f"duplicated mountPath: {v.mount_path}")
+        seen_mounts.add(v.mount_path)
+        if not v.volume_claim_name and not v.storage:
+            errs.append(f"volume {v.mount_path}: either volumeClaimName or "
+                        "storage must be specified")
+
+    if queues is not None:
+        queue = queues.get(job.queue or DEFAULT_QUEUE)
+        if queue is None:
+            errs.append(f"job queue {job.queue!r} does not exist")
+        elif getattr(queue, "state", QueueState.OPEN) != QueueState.OPEN:
+            errs.append(f"can only submit job to queue with state Open; "
+                        f"queue {job.queue!r} is {queue.state.value}")
+
+    if errs:
+        raise AdmissionError("; ".join(errs))
+
+
+def validate_job_update(old: Job, new: Job) -> None:
+    """Only minAvailable and task replicas may change
+    (admit_job.go:300-360)."""
+    errs: List[str] = []
+    if new.min_available < 0:
+        errs.append("job 'minAvailable' must be >= 0")
+    total = 0
+    for task in new.tasks:
+        if (task.min_available is not None
+                and task.min_available > task.replicas):
+            errs.append(f"'minAvailable' must be <= 'replicas' in task: {task.name}")
+        total += task.replicas
+    if new.min_available > total:
+        errs.append("job 'minAvailable' must not be greater than total replicas")
+
+    if len(old.tasks) != len(new.tasks):
+        errs.append("job updates may not add or remove tasks")
+    else:
+        for o, n in zip(old.tasks, new.tasks):
+            if o.name != n.name or o.template != n.template:
+                errs.append("job updates may not change fields other than "
+                            "'minAvailable' and 'tasks[*].replicas'")
+                break
+    for attr in ("queue", "scheduler_name", "max_retry",
+                 "priority_class_name"):
+        if getattr(old, attr) != getattr(new, attr):
+            errs.append(f"job updates may not change spec.{attr}")
+    if errs:
+        raise AdmissionError("; ".join(errs))
+
+
+def mutate_job(job: Job) -> Job:
+    """Apply defaults in place and return the job (mutate_job.go:49-200)."""
+    if not job.queue:
+        job.queue = DEFAULT_QUEUE
+    if not job.scheduler_name:
+        job.scheduler_name = DEFAULT_SCHEDULER_NAME
+    if job.max_retry == 0:
+        job.max_retry = 3
+    for i, task in enumerate(job.tasks):
+        if not task.name:
+            task.name = f"default{i}"
+        if task.min_available is None:
+            task.min_available = task.replicas
+    if job.min_available == 0:
+        job.min_available = job.total_replicas()
+    return job

@@ -1,0 +1,15 @@
+"""PodGroup mutation: default queue injection.
+
+Reference: pkg/webhooks/admission/podgroups/mutate/mutate_podgroup.go:39-110.
+"""
+
+from __future__ import annotations
+
+from ..api import DEFAULT_QUEUE
+from ..api.job_info import JobInfo
+
+
+def mutate_podgroup(pg: JobInfo) -> JobInfo:
+    if not pg.queue:
+        pg.queue = DEFAULT_QUEUE
+    return pg

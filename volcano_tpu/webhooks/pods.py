@@ -1,0 +1,25 @@
+"""Bare-pod admission: gate scheduling against closed queues.
+
+Reference: pkg/webhooks/admission/pods/admit_pod.go:42-214 — a pod using the
+volcano scheduler whose PodGroup's queue is not open is rejected.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..api import DEFAULT_SCHEDULER_NAME, QueueState
+from .jobs import AdmissionError
+
+
+def validate_pod(pod, queues: Optional[Dict[str, object]] = None,
+                 podgroup_queue: Optional[str] = None) -> None:
+    if getattr(pod, "scheduler_name", "") != DEFAULT_SCHEDULER_NAME:
+        return
+    if queues is None or podgroup_queue is None:
+        return
+    queue = queues.get(podgroup_queue)
+    if queue is not None and queue.state != QueueState.OPEN:
+        raise AdmissionError(
+            f"pod rejected: queue {podgroup_queue!r} is "
+            f"{queue.state.value}, not Open")

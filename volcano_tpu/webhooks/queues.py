@@ -1,0 +1,68 @@
+"""Queue admission: validation and defaulting.
+
+Reference: pkg/webhooks/admission/queues/validate/validate_queue.go:42-215
+(weight bounds, hierarchical-annotation consistency for hdrf, delete/state
+rules; test matrix validate_queue_test.go:1-918) and
+mutate/mutate_queue.go:40-140 (defaults).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import (DEFAULT_QUEUE, HIERARCHY_ANNOTATION,
+                   HIERARCHY_WEIGHTS_ANNOTATION, QueueInfo, QueueState)
+from .jobs import AdmissionError
+
+
+def validate_queue(queue: QueueInfo) -> None:
+    errs = []
+    if queue.weight < 1 or queue.weight > 65535:
+        errs.append(f"queue weight must be in [1, 65535]; got {queue.weight}")
+
+    hierarchy = queue.annotations.get(HIERARCHY_ANNOTATION, queue.hierarchy)
+    weights = queue.annotations.get(HIERARCHY_WEIGHTS_ANNOTATION,
+                                    queue.hierarchy_weights)
+    if hierarchy or weights:
+        path = [p for p in hierarchy.split("/") if p]
+        wparts = [w for w in weights.split("/") if w]
+        if len(path) != len(wparts):
+            errs.append(
+                f"hierarchy {hierarchy!r} and weights {weights!r} must have "
+                "the same depth")
+        if path and path[0] != "root":
+            errs.append("hierarchy must start at 'root'")
+        for w in wparts:
+            try:
+                if float(w) <= 0:
+                    errs.append(f"hierarchy weight {w} must be positive")
+            except ValueError:
+                errs.append(f"unparseable hierarchy weight {w!r}")
+    if errs:
+        raise AdmissionError("; ".join(errs))
+
+
+def validate_queue_delete(queue: QueueInfo) -> None:
+    """Only closed, non-default queues may be deleted
+    (validate_queue.go delete path)."""
+    if queue.name == DEFAULT_QUEUE:
+        raise AdmissionError("default queue can not be deleted")
+    if queue.state != QueueState.CLOSED:
+        raise AdmissionError(
+            f"only queue with state {QueueState.CLOSED.value} can be deleted; "
+            f"queue {queue.name} state is {queue.state.value}")
+
+
+def mutate_queue(queue: QueueInfo) -> QueueInfo:
+    """Defaults: weight 1, open state, hierarchy annotations normalized
+    (mutate_queue.go:40-140)."""
+    if queue.weight <= 0:
+        queue.weight = 1
+    if not queue.state:
+        queue.state = QueueState.OPEN
+    if queue.hierarchy and not queue.annotations.get(HIERARCHY_ANNOTATION):
+        queue.annotations[HIERARCHY_ANNOTATION] = queue.hierarchy
+    if queue.hierarchy_weights and not queue.annotations.get(
+            HIERARCHY_WEIGHTS_ANNOTATION):
+        queue.annotations[HIERARCHY_WEIGHTS_ANNOTATION] = queue.hierarchy_weights
+    return queue

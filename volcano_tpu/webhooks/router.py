@@ -1,0 +1,48 @@
+"""Admission service registry.
+
+Reference: pkg/webhooks/router/{interface.go:25-47, admission.go, server.go}
+— AdmissionService{Path, Func} entries served over HTTPS by the
+webhook-manager. Here the registry maps the same paths to Python callables;
+the runtime API server invokes them on create/update/delete, which is the
+same interception point the real webhook configuration gives.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_SERVICES: Dict[str, Callable] = {}
+
+
+def register(path: str):
+    def deco(fn):
+        _SERVICES[path] = fn
+        return fn
+    return deco
+
+
+def get_service(path: str) -> Callable:
+    return _SERVICES[path]
+
+
+def registered_paths():
+    return sorted(_SERVICES)
+
+
+def _install_builtin():
+    from .jobs import mutate_job, validate_job_create, validate_job_update
+    from .podgroups import mutate_podgroup
+    from .pods import validate_pod
+    from .queues import mutate_queue, validate_queue, validate_queue_delete
+
+    register("/jobs/validate")(validate_job_create)
+    register("/jobs/validate-update")(validate_job_update)
+    register("/jobs/mutate")(mutate_job)
+    register("/queues/validate")(validate_queue)
+    register("/queues/validate-delete")(validate_queue_delete)
+    register("/queues/mutate")(mutate_queue)
+    register("/podgroups/mutate")(mutate_podgroup)
+    register("/pods/validate")(validate_pod)
+
+
+_install_builtin()
