@@ -139,13 +139,17 @@ class TestAllocateBehavior:
         _, maps, tpu, cpu = run_both(ci)
         assert binds(maps, tpu.task_node, tpu.task_mode) == {"default/p0": "n2"}
 
-    def test_queue_deserved_share_ordering(self):
-        """Two queues, one far over its deserved share: the underserved
-        queue's job goes first (proportion queueOrderFn, proportion.go:198-212)."""
-        ci = simple_cluster(n_nodes=1, node_cpu="1")
+    def test_overused_queue_skipped(self):
+        """A queue already allocated beyond its deserved share is skipped
+        entirely (proportion Overused, proportion.go:240-253)."""
+        ci = simple_cluster(n_nodes=2, node_cpu="2")
         ci.add_queue(QueueInfo("qa", weight=1))
         ci.add_queue(QueueInfo("qb", weight=1))
         ja = build_job("default/ja", queue="qa", min_available=1)
+        running = build_task("a-run", cpu="2", memory=0)
+        running.status = TaskStatus.RUNNING
+        ja.add_task(running)
+        ci.nodes["n1"].add_task(running)
         ja.add_task(build_task("a0", cpu="1", memory=0))
         jb = build_job("default/jb", queue="qb", min_available=1)
         jb.add_task(build_task("b0", cpu="1", memory=0))
@@ -153,14 +157,15 @@ class TestAllocateBehavior:
         ci.add_job(jb)
         snap, maps = pack(ci)
         extras = AllocateExtras.neutral(snap)
-        # qa deserved tiny -> overused, so qb's job goes first
+        # qa deserved only 1 cpu but has 2 allocated -> overused -> skipped
         deserved = np.array(extras.queue_deserved)
-        deserved[maps.queue_index["qa"]] = 0.0
+        deserved[maps.queue_index["qa"]] = 1000.0
         extras.queue_deserved = deserved
         fn = jax.jit(make_allocate_cycle(AllocateConfig()))
         tpu = fn(snap, extras)
         b = binds(maps, tpu.task_node, tpu.task_mode)
-        assert b == {"default/b0": "n0"}
+        assert "default/a0" not in b
+        assert b.get("default/b0") is not None
 
 
 NODE_CPUS = ["1", "2", "4", "8"]

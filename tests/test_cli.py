@@ -1,0 +1,137 @@
+"""CLI tests — mirrors the reference's vcctl e2e suite (test/e2e/vcctl/)."""
+
+import os
+
+import pytest
+
+from volcano_tpu.cli import job_from_yaml
+from volcano_tpu.cli.vcctl import VcctlError, main
+from volcano_tpu.runtime.system import VolcanoSystem
+
+JOB_YAML = """
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata:
+  name: test-job
+spec:
+  minAvailable: 3
+  schedulerName: volcano
+  queue: default
+  plugins:
+    ssh: []
+    svc: []
+  policies:
+    - event: PodEvicted
+      action: RestartJob
+  tasks:
+    - replicas: 3
+      name: "test"
+      template:
+        spec:
+          containers:
+            - resources:
+                requests:
+                  cpu: "1"
+                  memory: "1Gi"
+"""
+
+
+@pytest.fixture
+def system(tmp_path):
+    sys_ = VolcanoSystem()
+    for i in range(2):
+        sys_.add_node(f"n{i}", cpu="4", memory="8Gi")
+    return sys_
+
+
+@pytest.fixture
+def job_file(tmp_path):
+    p = tmp_path / "job.yaml"
+    p.write_text(JOB_YAML)
+    return str(p)
+
+
+class TestLoader:
+    def test_reference_manifest_shape(self):
+        job = job_from_yaml(JOB_YAML)
+        assert job.name == "test-job"
+        assert job.min_available == 3
+        assert job.tasks[0].replicas == 3
+        assert job.tasks[0].template.resreq().milli_cpu == 1000
+        assert "ssh" in job.plugins
+        assert job.policies[0].event.value == "PodEvicted"
+
+
+class TestJobCommands:
+    def test_run_list_view(self, system, job_file):
+        out = main(["job", "run", "-f", job_file], system=system)
+        assert "successfully" in out
+        for _ in range(3):
+            system.tick()
+        out = main(["job", "list"], system=system)
+        assert "test-job" in out and "Running" in out
+        out = main(["job", "view", "-N", "test-job"], system=system)
+        assert "test-job-test-0" in out
+        assert "node=n" in out
+
+    def test_suspend_resume(self, system, job_file):
+        main(["job", "run", "-f", job_file], system=system)
+        for _ in range(3):
+            system.tick()
+        main(["job", "suspend", "-N", "test-job"], system=system)
+        system.reconcile()
+        assert "Abort" in main(["job", "list"], system=system)
+        main(["job", "resume", "-N", "test-job"], system=system)
+        for _ in range(4):
+            system.tick()
+        assert "Running" in main(["job", "list"], system=system)
+
+    def test_delete(self, system, job_file):
+        main(["job", "run", "-f", job_file], system=system)
+        system.reconcile()
+        main(["job", "delete", "-N", "test-job"], system=system)
+        system.reconcile()
+        assert system.job("test-job") is None
+        assert system.pods_of("test-job") == []
+
+    def test_view_missing_job_errors(self, system):
+        with pytest.raises(VcctlError):
+            main(["job", "view", "-N", "nope"], system=system)
+
+
+class TestQueueCommands:
+    def test_create_list_get(self, system):
+        main(["queue", "create", "-N", "q1", "-w", "3"], system=system)
+        out = main(["queue", "list"], system=system)
+        assert "q1" in out and "3" in out
+        out = main(["queue", "get", "-N", "q1"], system=system)
+        assert "Weight: 3" in out
+
+    def test_operate_close_open(self, system):
+        main(["queue", "create", "-N", "q2"], system=system)
+        main(["queue", "operate", "-N", "q2", "-a", "close"], system=system)
+        system.reconcile()
+        assert system.api.get("queues", "q2").state.value == "Closed"
+        main(["queue", "operate", "-N", "q2", "-a", "open"], system=system)
+        system.reconcile()
+        assert system.api.get("queues", "q2").state.value == "Open"
+
+    def test_delete_open_queue_rejected(self, system):
+        from volcano_tpu.webhooks import AdmissionError
+        main(["queue", "create", "-N", "q3"], system=system)
+        with pytest.raises(AdmissionError):
+            main(["queue", "delete", "-N", "q3"], system=system)
+
+    def test_invalid_operate_action(self, system):
+        main(["queue", "create", "-N", "q4"], system=system)
+        with pytest.raises(VcctlError):
+            main(["queue", "operate", "-N", "q4", "-a", "explode"],
+                 system=system)
+
+
+class TestStateFile:
+    def test_standalone_round_trip(self, tmp_path, job_file):
+        state = str(tmp_path / "vc.pkl")
+        main(["--state", state, "queue", "create", "-N", "sq"])
+        out = main(["--state", state, "queue", "list"])
+        assert "sq" in out

@@ -1,0 +1,254 @@
+"""Controller tests — mirrors the reference's job state machine transitions
+(pkg/controllers/job/job_state_test.go:1-1298), pod reconciliation
+(job_controller_actions_test.go:1-562), queue controller
+(queue_controller_test.go:1-312), and GC TTL (garbagecollector_test.go:1-385)."""
+
+import pytest
+
+from volcano_tpu.api.batch import (Command, Job, LifecyclePolicy, PodTemplate,
+                                   TaskSpec, VolumeSpec)
+from volcano_tpu.api.core import PodPhase
+from volcano_tpu.api.queue_info import QueueInfo
+from volcano_tpu.api.types import (BusAction, BusEvent, JobPhase,
+                                   PodGroupPhase, QueueState)
+from volcano_tpu.controllers.gc_controller import GarbageCollector
+from volcano_tpu.runtime.system import VolcanoSystem
+from volcano_tpu.webhooks import AdmissionError
+
+
+def two_task_job(name="job1", replicas=(1, 2), **kw):
+    return Job(name=name, tasks=[
+        TaskSpec(name="ps", replicas=replicas[0],
+                 template=PodTemplate(resources={"cpu": "1", "memory": "1Gi"})),
+        TaskSpec(name="worker", replicas=replicas[1],
+                 template=PodTemplate(resources={"cpu": "1", "memory": "1Gi"})),
+    ], **kw)
+
+
+def make_system(n_nodes=2):
+    sys_ = VolcanoSystem()
+    for i in range(n_nodes):
+        sys_.add_node(f"n{i}", cpu="8", memory="16Gi")
+    return sys_
+
+
+class TestJobController:
+    def test_sync_creates_podgroup_and_pods(self):
+        sys_ = make_system()
+        sys_.submit_job(two_task_job())
+        sys_.reconcile()
+        pg = sys_.api.podgroup_of_job("default/job1")
+        assert pg is not None
+        assert pg.min_member == 3  # defaulted minAvailable = total replicas
+        assert pg.min_resources  # calcPGMinResources populated
+        # pods are NOT created while the PodGroup is Pending (syncTask gate)
+        assert sys_.pods_of("job1") == []
+        # once the scheduler enqueues the group, pods appear
+        pg.phase = PodGroupPhase.INQUEUE
+        sys_.api.update("podgroups", pg)
+        sys_.reconcile()
+        assert len(sys_.pods_of("job1")) == 3
+
+    def test_full_lifecycle_to_completed(self):
+        sys_ = make_system()
+        sys_.submit_job(two_task_job())
+        for _ in range(3):
+            sys_.tick()
+        job = sys_.job("job1")
+        assert job.status.state.phase == JobPhase.RUNNING
+        assert job.status.running == 3
+        for pod in sys_.pods_of("job1"):
+            sys_.finish_pod(pod.key)
+        sys_.reconcile()
+        assert sys_.job("job1").status.state.phase == JobPhase.COMPLETED
+        assert sys_.job("job1").status.succeeded == 3
+
+    def test_scale_up_and_down(self):
+        sys_ = make_system()
+        sys_.submit_job(two_task_job())
+        for _ in range(3):
+            sys_.tick()
+        job = sys_.job("job1")
+        job.tasks[1].replicas = 4      # worker 2 -> 4
+        sys_.api.update("jobs", job)
+        sys_.tick()
+        assert len(sys_.pods_of("job1")) == 5
+        job.tasks[1].replicas = 1      # scale down
+        sys_.api.update("jobs", job)
+        sys_.reconcile()
+        assert len(sys_.pods_of("job1")) == 2
+
+    def test_pod_failed_policy_restart_job(self):
+        sys_ = make_system()
+        job = two_task_job(policies=[LifecyclePolicy(
+            action=BusAction.RESTART_JOB, event=BusEvent.POD_FAILED)],
+            max_retry=2)
+        sys_.submit_job(job)
+        for _ in range(3):
+            sys_.tick()
+        pod = sys_.pods_of("job1")[0]
+        sys_.finish_pod(pod.key, exit_code=137)
+        sys_.reconcile()
+        job = sys_.job("job1")
+        assert job.status.retry_count == 1
+        # restarting kills pods, then next sync recreates them
+        for _ in range(3):
+            sys_.tick()
+        assert sys_.job("job1").status.state.phase == JobPhase.RUNNING
+
+    def test_max_retry_exhausted_fails_job(self):
+        sys_ = make_system()
+        job = two_task_job(policies=[LifecyclePolicy(
+            action=BusAction.RESTART_JOB, event=BusEvent.POD_FAILED)],
+            max_retry=1)
+        sys_.submit_job(job)
+        for _ in range(3):
+            sys_.tick()
+        for round_ in range(2):
+            pods = sys_.pods_of("job1")
+            running = [p for p in pods if p.phase == PodPhase.RUNNING]
+            if not running:
+                for _ in range(3):
+                    sys_.tick()
+                running = [p for p in sys_.pods_of("job1")
+                           if p.phase == PodPhase.RUNNING]
+            sys_.finish_pod(running[0].key, exit_code=1)
+            sys_.reconcile()
+        assert sys_.job("job1").status.state.phase == JobPhase.FAILED
+
+    def test_exit_code_policy(self):
+        sys_ = make_system()
+        job = two_task_job(policies=[LifecyclePolicy(
+            action=BusAction.ABORT_JOB, exit_code=42)])
+        sys_.submit_job(job)
+        for _ in range(3):
+            sys_.tick()
+        pod = sys_.pods_of("job1")[0]
+        sys_.finish_pod(pod.key, exit_code=42)
+        sys_.reconcile()
+        assert sys_.job("job1").status.state.phase in (JobPhase.ABORTING,
+                                                       JobPhase.ABORTED)
+
+    def test_suspend_resume_via_commands(self):
+        """vcctl suspend/resume path (SURVEY.md section 3.4 call stack)."""
+        sys_ = make_system()
+        sys_.submit_job(two_task_job())
+        for _ in range(3):
+            sys_.tick()
+        sys_.suspend_job("job1")
+        sys_.reconcile()
+        job = sys_.job("job1")
+        assert job.status.state.phase in (JobPhase.ABORTING, JobPhase.ABORTED)
+        sys_.resume_job("job1")
+        for _ in range(4):
+            sys_.tick()
+        assert sys_.job("job1").status.state.phase == JobPhase.RUNNING
+
+    def test_job_plugins_create_artifacts(self):
+        sys_ = make_system()
+        job = two_task_job(plugins={"ssh": [], "svc": [], "env": []})
+        sys_.submit_job(job)
+        for _ in range(2):
+            sys_.tick()
+        assert sys_.api.get("secrets", "default/job1-ssh") is not None
+        assert sys_.api.get("services", "default/job1") is not None
+        cm = sys_.api.get("configmaps", "default/job1-svc")
+        assert "job1-worker-1.job1" in cm.data["hosts"]
+        pod = sys_.pods_of("job1")[0]
+        assert pod.env.get("VC_JOB_NAME") == "job1"
+        assert "VC_WORKER_HOSTS" in pod.env
+        assert f"{job.name}-ssh" in pod.volumes
+
+    def test_pvc_created_for_storage_volume(self):
+        sys_ = make_system()
+        job = two_task_job(volumes=[VolumeSpec(mount_path="/data",
+                                               storage="1Gi")])
+        sys_.submit_job(job)
+        sys_.reconcile()
+        assert sys_.api.get("pvcs", "default/job1-pvc-0") is not None
+
+
+class TestAdmissionIntegration:
+    def test_invalid_job_rejected_at_submit(self):
+        sys_ = make_system()
+        bad = Job(name="bad", min_available=10,
+                  tasks=[TaskSpec(name="t", replicas=1)])
+        with pytest.raises(AdmissionError):
+            sys_.submit_job(bad)
+        assert sys_.job("bad") is None
+
+    def test_job_to_closed_queue_rejected(self):
+        sys_ = make_system()
+        sys_.api.create("queues", QueueInfo("closed-q", weight=1,
+                                            state=QueueState.CLOSED))
+        with pytest.raises(AdmissionError):
+            sys_.submit_job(two_task_job(queue="closed-q"))
+
+
+class TestQueueController:
+    def test_close_queue_with_live_podgroups_goes_closing(self):
+        sys_ = make_system()
+        sys_.api.create("queues", QueueInfo("q1", weight=1))
+        sys_.submit_job(two_task_job(queue="q1"))
+        sys_.reconcile()
+        sys_.submit_command(Command(name="close-q1", action=BusAction.CLOSE_QUEUE,
+                                    target_name="q1", target_kind="Queue"))
+        sys_.reconcile()
+        assert sys_.api.get("queues", "q1").state == QueueState.CLOSING
+        # delete the job -> podgroup gone -> queue closes
+        sys_.api.delete("jobs", "default/job1")
+        sys_.reconcile()
+        assert sys_.api.get("queues", "q1").state == QueueState.CLOSED
+
+    def test_reopen_queue(self):
+        sys_ = make_system()
+        sys_.api.create("queues", QueueInfo("q2", weight=1,
+                                            state=QueueState.CLOSED))
+        sys_.submit_command(Command(name="open-q2", action=BusAction.OPEN_QUEUE,
+                                    target_name="q2", target_kind="Queue"))
+        sys_.reconcile()
+        assert sys_.api.get("queues", "q2").state == QueueState.OPEN
+
+
+class TestPodGroupController:
+    def test_bare_pod_adoption(self):
+        from volcano_tpu.api.core import Pod
+        sys_ = make_system()
+        pod = Pod(name="bare", resources={"cpu": "1"})
+        sys_.api.create("pods", pod)
+        sys_.reconcile()
+        assert pod.pod_group == "podgroup-bare"
+        assert sys_.api.get("podgroups", "default/podgroup-bare") is not None
+
+    def test_bare_pod_schedules_and_binds(self):
+        from volcano_tpu.api.core import Pod
+        sys_ = make_system()
+        sys_.api.create("pods", Pod(name="bare", resources={"cpu": "1"}))
+        for _ in range(3):
+            sys_.tick()
+        pod = sys_.api.get("pods", "default/bare")
+        assert pod.node_name != ""
+        assert pod.phase == PodPhase.RUNNING
+
+
+class TestGarbageCollector:
+    def test_ttl_cleanup(self):
+        clock = {"now": 1000.0}
+        sys_ = make_system()
+        gc = next(c for c in sys_.controllers if c.name == "gc")
+        gc.now = lambda: clock["now"]
+        job = two_task_job(ttl_seconds_after_finished=60)
+        sys_.submit_job(job)
+        for _ in range(3):
+            sys_.tick()
+        for pod in sys_.pods_of("job1"):
+            sys_.finish_pod(pod.key)
+        sys_.reconcile()
+        assert sys_.job("job1").status.state.phase == JobPhase.COMPLETED
+        clock["now"] = sys_.job("job1").status.state.transition_time + 30
+        sys_.reconcile()
+        assert sys_.job("job1") is not None  # not expired yet
+        clock["now"] += 31
+        sys_.reconcile()
+        assert sys_.job("job1") is None      # deleted
+        assert sys_.pods_of("job1") == []    # foreground propagation

@@ -137,9 +137,15 @@ class TestHDRF:
 
 
 class TestEnqueue:
-    def test_proportion_gate_admits_within_deserved(self):
+    def test_proportion_gate_respects_queue_capability(self):
+        """Permit iff minReq + allocated + inqueue <= capability; the running
+        inqueue tally makes admission sequential (proportion.go:254-280)."""
         from volcano_tpu.api import PodGroupPhase
-        ci = simple_cluster(n_nodes=1, node_cpu="4")
+        ci = simple_cluster(n_nodes=1, node_cpu="8")
+        del ci.queues["default"]
+        q = QueueInfo("default", weight=1)
+        q.capability = res(cpu="4")
+        ci.add_queue(q)
         j1 = build_job("default/j1", min_available=1,
                        pod_group_phase=PodGroupPhase.PENDING,
                        min_resources=res(cpu="2"))
@@ -151,32 +157,42 @@ class TestEnqueue:
         ci.add_job(j1)
         ci.add_job(j2)
         snap, maps = pack(ci)
-        Q, R = snap.queues.allocated.shape
-        deserved = np.full((Q, R), np.inf, np.float32)
-        deserved[maps.queue_index["default"]] = [4000.0, np.inf]
         fn = jax.jit(make_enqueue_pass(EnqueueConfig()))
-        admitted = np.array(fn(snap, deserved,
-                               np.zeros(snap.jobs.valid.shape[0], bool)))
-        # j1 (2 cpu) admitted; j2 (3 cpu) would exceed 4 cpu deserved
+        admitted = np.array(fn(snap, np.zeros(snap.jobs.valid.shape[0], bool)))
+        # j1 (2 cpu) fits the 4-cpu capability; j2 (2+3=5) does not
         assert admitted[maps.job_index["default/j1"]]
         assert not admitted[maps.job_index["default/j2"]]
+
+    def test_no_capability_always_admits(self):
+        from volcano_tpu.api import PodGroupPhase
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        j = build_job("default/j1", min_available=1,
+                      pod_group_phase=PodGroupPhase.PENDING,
+                      min_resources=res(cpu="500"))
+        j.add_task(build_task("p1", cpu="500", memory=0))
+        ci.add_job(j)
+        snap, maps = pack(ci)
+        fn = jax.jit(make_enqueue_pass(EnqueueConfig()))
+        assert np.array(fn(snap, np.zeros(snap.jobs.valid.shape[0], bool)))[0]
 
     def test_sla_overrides_gate(self):
         from volcano_tpu.api import PodGroupPhase
         ci = simple_cluster(n_nodes=1, node_cpu="1")
+        del ci.queues["default"]
+        q = QueueInfo("default", weight=1)
+        q.capability = res(cpu="1")
+        ci.add_queue(q)
         j = build_job("default/j1", min_available=1,
                       pod_group_phase=PodGroupPhase.PENDING,
                       min_resources=res(cpu="5"))
         j.add_task(build_task("p1", cpu="5", memory=0))
         ci.add_job(j)
         snap, maps = pack(ci)
-        Q, R = snap.queues.allocated.shape
-        deserved = np.zeros((Q, R), np.float32)  # nothing deserved
         fn = jax.jit(make_enqueue_pass(EnqueueConfig()))
         sla = np.zeros(snap.jobs.valid.shape[0], bool)
-        assert not np.array(fn(snap, deserved, sla))[0]
+        assert not np.array(fn(snap, sla))[0]
         sla[maps.job_index["default/j1"]] = True
-        assert np.array(fn(snap, deserved, sla))[0]
+        assert np.array(fn(snap, sla))[0]
 
 
 class TestBackfill:

@@ -33,7 +33,8 @@ class TestFullCycle:
         assert ssn.stats.get("enqueued") == 1
         assert len(sched.cluster.binds) == 2
         stored = sched.cluster.ci.jobs["default/j1"]
-        assert stored.pod_group_phase == PodGroupPhase.INQUEUE
+        # enqueued this cycle, then allocated -> gang ready -> Running
+        assert stored.pod_group_phase == PodGroupPhase.RUNNING
         assert all(t.status == TaskStatus.BOUND for t in stored.tasks.values())
         # nodes actually account the bound tasks
         used = sum(n.used.milli_cpu for n in sched.cluster.ci.nodes.values())

@@ -1,0 +1,116 @@
+"""Core cluster objects: Pod and PodGroup.
+
+Reference: k8s core/v1 Pod as consumed by the controllers/scheduler, and
+scheduling.volcano.sh/v1beta1 PodGroup
+(vendor/.../scheduling/v1beta1/types.go:147-243).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .job_info import Toleration
+from .resource import Resource
+from .types import DEFAULT_SCHEDULER_NAME, PodGroupPhase
+
+#: annotation linking a pod to its PodGroup (scheduling.k8s.io group-name).
+POD_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+#: annotation carrying the task (role) name on job pods.
+TASK_SPEC_ANNOTATION = "volcano.sh/task-spec"
+#: label carrying the parent job name.
+JOB_NAME_LABEL = "volcano.sh/job-name"
+
+
+class PodPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    resources: Dict[str, object] = field(default_factory=dict)  # ResourceList
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: List[Toleration] = field(default_factory=list)
+    priority: int = 0
+    restart_policy: str = "OnFailure"
+    env: Dict[str, str] = field(default_factory=dict)
+    volumes: List[str] = field(default_factory=list)
+
+    phase: str = PodPhase.PENDING
+    node_name: str = ""
+    exit_code: Optional[int] = None
+    deletion_timestamp: Optional[float] = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def uid(self) -> str:
+        return self.key
+
+    def resreq(self) -> Resource:
+        return Resource.from_resource_list(self.resources)
+
+    @property
+    def job_name(self) -> str:
+        return self.labels.get(JOB_NAME_LABEL, "")
+
+    @property
+    def task_role(self) -> str:
+        return self.annotations.get(TASK_SPEC_ANNOTATION, "")
+
+    @property
+    def pod_group(self) -> str:
+        return self.annotations.get(POD_GROUP_ANNOTATION, "")
+
+
+@dataclass
+class PodGroupCondition:
+    type: str
+    status: str = "True"
+    reason: str = ""
+    message: str = ""
+    transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class PodGroup:
+    """scheduling.volcano.sh/v1beta1 PodGroup
+    (vendor/.../scheduling/v1beta1/types.go:147-243)."""
+
+    name: str
+    namespace: str = "default"
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = field(default_factory=time.time)
+    owner_job: str = ""            # batch Job key that controls this group
+
+    min_member: int = 0
+    queue: str = ""
+    priority_class_name: str = ""
+    min_resources: Dict[str, object] = field(default_factory=dict)
+
+    phase: PodGroupPhase = PodGroupPhase.PENDING
+    conditions: List[PodGroupCondition] = field(default_factory=list)
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def min_resources_res(self) -> Resource:
+        return Resource.from_resource_list(self.min_resources)

@@ -1,0 +1,6 @@
+"""CLI (reference: pkg/cli + cmd/cli)."""
+
+from .loader import job_from_dict, job_from_yaml
+from .vcctl import VcctlError, main
+
+__all__ = ["job_from_dict", "job_from_yaml", "VcctlError", "main"]

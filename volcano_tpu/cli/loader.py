@@ -1,0 +1,93 @@
+"""YAML <-> batch Job conversion, accepting the reference's manifest shape
+(example/job.yaml style, batch.volcano.sh/v1alpha1) so existing Volcano
+manifests submit unchanged."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import yaml
+
+from ..api.batch import (Job, LifecyclePolicy, PodTemplate, TaskSpec,
+                         VolumeSpec)
+from ..api.job_info import Toleration
+from ..api.types import BusAction, BusEvent
+
+
+def _policies(raw: Optional[List[Dict]]) -> List[LifecyclePolicy]:
+    out = []
+    for p in raw or []:
+        out.append(LifecyclePolicy(
+            action=BusAction(p["action"]),
+            event=BusEvent(p["event"]) if p.get("event") else None,
+            events=[BusEvent(e) for e in p.get("events", [])],
+            exit_code=p.get("exitCode"),
+            timeout_seconds=p.get("timeout")))
+    return out
+
+
+def _template(raw: Optional[Dict]) -> PodTemplate:
+    raw = raw or {}
+    spec = raw.get("spec", raw)
+    meta = raw.get("metadata", {})
+    # container requests SUM across containers (kube pod-request semantics)
+    from ..api.resource import CPU, Resource, parse_quantity
+    summed: Dict[str, float] = {}
+    for c in spec.get("containers", []) or []:
+        reqs = (c.get("resources") or {}).get("requests") or {}
+        for k, v in reqs.items():
+            summed[k] = summed.get(k, 0.0) + parse_quantity(v, is_cpu=(k == CPU))
+    resources: Dict[str, object] = {
+        k: (v / 1000.0 if k == CPU else v) for k, v in summed.items()}
+    tolerations = [Toleration(key=t.get("key", ""),
+                              operator=t.get("operator", "Equal"),
+                              value=t.get("value", ""),
+                              effect=t.get("effect", ""))
+                   for t in spec.get("tolerations", []) or []]
+    return PodTemplate(
+        resources=resources,
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        tolerations=tolerations,
+        priority=int(spec.get("priority", 0)),
+        restart_policy=spec.get("restartPolicy", "OnFailure"))
+
+
+def job_from_dict(data: Dict) -> Job:
+    meta = data.get("metadata", {})
+    spec = data.get("spec", {})
+    tasks = []
+    for t in spec.get("tasks", []) or []:
+        tasks.append(TaskSpec(
+            name=t.get("name", ""),
+            replicas=int(t.get("replicas", 0)),
+            template=_template(t.get("template")),
+            policies=_policies(t.get("policies")),
+            min_available=t.get("minAvailable"),
+            max_retry=int(t.get("maxRetry", 0))))
+    volumes = [VolumeSpec(mount_path=v.get("mountPath", ""),
+                          volume_claim_name=v.get("volumeClaimName", ""),
+                          storage=v.get("storage", ""))
+               for v in spec.get("volumes", []) or []]
+    return Job(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        annotations=dict(meta.get("annotations") or {}),
+        labels=dict(meta.get("labels") or {}),
+        scheduler_name=spec.get("schedulerName", ""),
+        min_available=int(spec.get("minAvailable", 0)),
+        min_success=spec.get("minSuccess"),
+        volumes=volumes,
+        tasks=tasks,
+        policies=_policies(spec.get("policies")),
+        plugins={k: list(v or []) for k, v in
+                 (spec.get("plugins") or {}).items()},
+        queue=spec.get("queue", ""),
+        max_retry=int(spec.get("maxRetry", 0)),
+        ttl_seconds_after_finished=spec.get("ttlSecondsAfterFinished"),
+        priority_class_name=spec.get("priorityClassName", ""))
+
+
+def job_from_yaml(text: str) -> Job:
+    return job_from_dict(yaml.safe_load(text))

@@ -1,0 +1,226 @@
+"""vcctl — the CLI surface.
+
+Reference: pkg/cli/{job,queue}/ + cmd/cli (cobra commands ``vcctl job
+run/list/view/suspend/resume/delete`` and ``vcctl queue
+create/delete/operate/list/get``, cmd/cli/job.go:11-73,
+cmd/cli/queue.go:27-79). suspend/resume create bus Command objects exactly
+like the reference (pkg/cli/job/{suspend,resume}.go).
+
+Run against a live in-process VolcanoSystem (tests) or a pickled state file
+(standalone: ``python -m volcano_tpu.cli.vcctl --state /tmp/vc.pkl job list``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+import time
+from typing import List, Optional
+
+from ..api.batch import Command
+from ..api.queue_info import QueueInfo
+from ..api.types import BusAction, QueueState
+from .loader import job_from_yaml
+
+
+def _fmt_table(rows: List[List[str]], headers: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [headers] + rows)
+              for i in range(len(headers))]
+    lines = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+class VcctlError(Exception):
+    pass
+
+
+def cmd_job_run(system, args) -> str:
+    with open(args.filename) as f:
+        job = job_from_yaml(f.read())
+    if args.queue:
+        job.queue = args.queue
+    system.submit_job(job)
+    return f"run job {job.namespace}/{job.name} successfully"
+
+
+def cmd_job_list(system, args) -> str:
+    rows = []
+    for job in system.api.list("jobs"):
+        if args.namespace and job.namespace != args.namespace:
+            continue
+        s = job.status
+        rows.append([job.name, s.state.phase.value, str(job.min_available),
+                     str(s.pending), str(s.running), str(s.succeeded),
+                     str(s.failed), str(s.retry_count)])
+    return _fmt_table(rows, ["Name", "Phase", "MinAvailable", "Pending",
+                             "Running", "Succeeded", "Failed", "RetryCount"])
+
+
+def cmd_job_view(system, args) -> str:
+    job = system.api.get("jobs", f"{args.namespace}/{args.name}")
+    if job is None:
+        raise VcctlError(f"job {args.namespace}/{args.name} not found")
+    lines = [f"Name:        {job.name}",
+             f"Namespace:   {job.namespace}",
+             f"Queue:       {job.queue}",
+             f"Phase:       {job.status.state.phase.value}",
+             f"MinAvailable: {job.min_available}",
+             f"RetryCount:  {job.status.retry_count}",
+             "Tasks:"]
+    for t in job.tasks:
+        lines.append(f"  - {t.name}: replicas={t.replicas}")
+    pods = system.api.pods_of_job(job.key)
+    if pods:
+        lines.append("Pods:")
+        for p in sorted(pods, key=lambda p: p.name):
+            lines.append(f"  - {p.name}: {p.phase} node={p.node_name or '-'}")
+    return "\n".join(lines)
+
+
+def _check_job(system, args) -> None:
+    if system.api.get("jobs", f"{args.namespace}/{args.name}") is None:
+        raise VcctlError(f"job {args.namespace}/{args.name} not found")
+
+
+def cmd_job_suspend(system, args) -> str:
+    _check_job(system, args)
+    system.suspend_job(args.name, args.namespace)
+    return f"AbortJob job {args.namespace}/{args.name}"
+
+
+def cmd_job_resume(system, args) -> str:
+    _check_job(system, args)
+    system.resume_job(args.name, args.namespace)
+    return f"ResumeJob job {args.namespace}/{args.name}"
+
+
+def cmd_job_delete(system, args) -> str:
+    if system.api.delete("jobs", f"{args.namespace}/{args.name}") is None:
+        raise VcctlError(f"job {args.namespace}/{args.name} not found")
+    return f"delete job {args.namespace}/{args.name} successfully"
+
+
+def cmd_queue_create(system, args) -> str:
+    queue = QueueInfo(args.name, weight=args.weight,
+                      reclaimable=not args.no_reclaimable)
+    system.api.create("queues", queue)
+    return f"create queue {args.name} successfully"
+
+
+def cmd_queue_list(system, args) -> str:
+    rows = []
+    for q in system.api.list("queues"):
+        rows.append([q.name, str(q.weight), q.state.value,
+                     str(q.reclaimable)])
+    return _fmt_table(rows, ["Name", "Weight", "State", "Reclaimable"])
+
+
+def cmd_queue_get(system, args) -> str:
+    q = system.api.get("queues", args.name)
+    if q is None:
+        raise VcctlError(f"queue {args.name} not found")
+    counts = {k.replace("status.", ""): v for k, v in q.annotations.items()
+              if k.startswith("status.")}
+    return (f"Name: {q.name}\nWeight: {q.weight}\nState: {q.state.value}\n"
+            f"Reclaimable: {q.reclaimable}\nPodGroups: {counts}")
+
+
+def cmd_queue_operate(system, args) -> str:
+    """vcctl queue operate --action open|close (bus Command path,
+    SURVEY.md section 3.5)."""
+    action = {"open": BusAction.OPEN_QUEUE,
+              "close": BusAction.CLOSE_QUEUE}.get(args.action)
+    if action is None:
+        raise VcctlError(f"invalid action {args.action!r}; use open|close")
+    if system.api.get("queues", args.name) is None:
+        raise VcctlError(f"queue {args.name} not found")
+    system.submit_command(Command(
+        name=f"{args.action}-{args.name}-{time.time()}",
+        action=action, target_name=args.name, target_kind="Queue"))
+    return f"{args.action} queue {args.name}"
+
+
+def cmd_queue_delete(system, args) -> str:
+    if system.api.get("queues", args.name) is None:
+        raise VcctlError(f"queue {args.name} not found")
+    system.api.delete("queues", args.name)
+    return f"delete queue {args.name} successfully"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="vcctl",
+                                description="volcano_tpu batch CLI")
+    p.add_argument("--state", help="pickled VolcanoSystem state file")
+    sub = p.add_subparsers(dest="group", required=True)
+
+    job = sub.add_parser("job").add_subparsers(dest="cmd", required=True)
+    run = job.add_parser("run")
+    run.add_argument("-f", "--filename", required=True)
+    run.add_argument("-q", "--queue", default="")
+    run.set_defaults(fn=cmd_job_run)
+    ls = job.add_parser("list")
+    ls.add_argument("-n", "--namespace", default="")
+    ls.set_defaults(fn=cmd_job_list)
+    for name, fn in (("view", cmd_job_view), ("suspend", cmd_job_suspend),
+                     ("resume", cmd_job_resume), ("delete", cmd_job_delete)):
+        sp = job.add_parser(name)
+        sp.add_argument("-N", "--name", required=True)
+        sp.add_argument("-n", "--namespace", default="default")
+        sp.set_defaults(fn=fn)
+
+    queue = sub.add_parser("queue").add_subparsers(dest="cmd", required=True)
+    qc = queue.add_parser("create")
+    qc.add_argument("-N", "--name", required=True)
+    qc.add_argument("-w", "--weight", type=int, default=1)
+    qc.add_argument("--no-reclaimable", action="store_true")
+    qc.set_defaults(fn=cmd_queue_create)
+    queue.add_parser("list").set_defaults(fn=cmd_queue_list)
+    qg = queue.add_parser("get")
+    qg.add_argument("-N", "--name", required=True)
+    qg.set_defaults(fn=cmd_queue_get)
+    qo = queue.add_parser("operate")
+    qo.add_argument("-N", "--name", required=True)
+    qo.add_argument("-a", "--action", required=True)
+    qo.set_defaults(fn=cmd_queue_operate)
+    qd = queue.add_parser("delete")
+    qd.add_argument("-N", "--name", required=True)
+    qd.set_defaults(fn=cmd_queue_delete)
+    return p
+
+
+def main(argv: Optional[List[str]] = None, system=None) -> str:
+    args = build_parser().parse_args(argv)
+    persist = False
+    if system is None:
+        if not args.state:
+            raise VcctlError("--state required when no in-process system")
+        try:
+            with open(args.state, "rb") as f:
+                system = pickle.load(f)
+        except FileNotFoundError:
+            from ..runtime.system import VolcanoSystem
+            system = VolcanoSystem()
+        persist = True
+    out = args.fn(system, args)
+    if persist:
+        # standalone mode: drive a full control-plane step so submitted work
+        # makes progress between invocations (reconcile + schedule + kubelet)
+        if system.api.stores["nodes"]:
+            system.tick()
+        else:
+            system.reconcile()
+        with open(args.state, "wb") as f:
+            pickle.dump(system, f)
+    return out
+
+
+if __name__ == "__main__":
+    from ..webhooks import AdmissionError
+    try:
+        print(main())
+    except (VcctlError, AdmissionError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        sys.exit(1)

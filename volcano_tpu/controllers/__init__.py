@@ -1,0 +1,15 @@
+"""Lifecycle controllers (reference: pkg/controllers)."""
+
+from .framework import (Controller, build_controllers, register_controller,
+                        registered_controllers)
+from .gc_controller import GarbageCollector
+from .job_controller import JobController
+from .job_state import Request, apply_policies
+from .podgroup_controller import PodGroupController
+from .queue_controller import QueueController
+
+__all__ = [
+    "Controller", "build_controllers", "register_controller",
+    "registered_controllers", "GarbageCollector", "JobController",
+    "PodGroupController", "QueueController", "Request", "apply_policies",
+]

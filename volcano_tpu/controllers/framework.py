@@ -1,0 +1,41 @@
+"""Controller mini-framework: interface + registry.
+
+Reference: pkg/controllers/framework/{interface.go:36-41, factory.go:24-46}.
+The controller-manager instantiates every registered controller against the
+shared API server and runs them (cmd/controller-manager/app/server.go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+
+class Controller:
+    name: str = ""
+
+    def initialize(self, apiserver) -> None:
+        raise NotImplementedError
+
+    def process_all(self) -> None:
+        """Drain this controller's work queue (one reconcile sweep)."""
+        pass
+
+
+_REGISTRY: Dict[str, Type[Controller]] = {}
+
+
+def register_controller(cls: Type[Controller]) -> None:
+    _REGISTRY[cls.name] = cls
+
+
+def registered_controllers() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def build_controllers(apiserver) -> List[Controller]:
+    out = []
+    for name in registered_controllers():
+        c = _REGISTRY[name]()
+        c.initialize(apiserver)
+        out.append(c)
+    return out

@@ -1,0 +1,45 @@
+"""Garbage collector: TTL-after-finished for Jobs.
+
+Reference: pkg/controllers/garbagecollector/garbagecollector.go:40-291 —
+finished jobs (Completed/Failed/Terminated/Aborted) with
+``ttlSecondsAfterFinished`` set are deleted once the TTL expires, with
+foreground propagation (pods/podgroup go too, handled by the job
+controller's delete cleanup). The clock is injectable for tests, mirroring
+garbagecollector_test.go:1-385.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from ..api.types import JobPhase
+from .framework import Controller, register_controller
+
+FINISHED = (JobPhase.COMPLETED, JobPhase.FAILED, JobPhase.TERMINATED,
+            JobPhase.ABORTED)
+
+
+class GarbageCollector(Controller):
+    name = "gc"
+
+    def initialize(self, apiserver, now: Callable[[], float] = time.time) -> None:
+        self.api = apiserver
+        self.now = now
+
+    def process_all(self) -> None:
+        for job in list(self.api.stores["jobs"].values()):
+            if self.needs_cleanup(job):
+                self.api.delete("jobs", job.key)
+
+    def needs_cleanup(self, job) -> bool:
+        """Reference: needsCleanup + processTTL (garbagecollector.go:150-220)."""
+        if job.ttl_seconds_after_finished is None:
+            return False
+        if job.status.state.phase not in FINISHED:
+            return False
+        finish_time = job.status.state.transition_time or job.creation_timestamp
+        return self.now() >= finish_time + job.ttl_seconds_after_finished
+
+
+register_controller(GarbageCollector)

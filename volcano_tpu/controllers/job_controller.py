@@ -1,0 +1,348 @@
+"""Job controller: reconciles batch Jobs into pods + a PodGroup.
+
+Reference: pkg/controllers/job/ (3,546 LoC) — event handlers mapping
+pod/job/command events to Requests (job_controller_handler.go:40-436), the
+per-state Execute through the state machine (state/*.go), syncJob creating
+and deleting pods to match task replicas with the PodGroup-phase gate
+(job_controller_actions.go:200-444), killJob (46-150), PodGroup
+create/update with calcPGMinResources (533-676), PVC creation (445-532),
+maxRetry handling (job_controller.go:324-337), and the fork's counter-label
+numbering (job_controller_actions.go:266-324).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from ..api.batch import Job, TaskSpec
+from ..api.core import (JOB_NAME_LABEL, POD_GROUP_ANNOTATION,
+                        TASK_SPEC_ANNOTATION, Pod, PodGroup, PodPhase)
+from ..api.resource import Resource
+from ..api.types import BusAction, BusEvent, JobPhase, PodGroupPhase
+from .framework import Controller, register_controller
+from .job_plugins import get_job_plugin
+from .job_state import (ACTIVE_PHASES, TERMINAL_PHASES, Request,
+                        apply_policies, next_phase_for_action)
+
+#: fork feature: annotation enabling monotonically numbered pod labels
+#: (job_controller_actions.go:266-324)
+COUNTER_LABEL_ANNOTATION = "volcano.sh/counter-label"
+
+
+class JobController(Controller):
+    name = "job-controller"
+
+    def initialize(self, apiserver) -> None:
+        self.api = apiserver
+        self.queue: Deque[Request] = deque()
+        self._counter: Dict[str, int] = {}   # job key -> next counter label
+        # controller-local pod phase cache: objects in the store are mutated
+        # in place, so phase *transitions* are derived from this last-seen
+        # view (the role of pkg/controllers/cache, cache.go:1-325)
+        self._pod_phase: Dict[str, str] = {}
+        apiserver.watch("jobs", self._on_job_event)
+        apiserver.watch("pods", self._on_pod_event)
+        apiserver.watch("commands", self._on_command_event)
+        apiserver.watch("podgroups", self._on_podgroup_event)
+
+    # ------------------------------------------------------- event handlers
+    def _on_job_event(self, event, job: Job, old) -> None:
+        if event == "deleted":
+            self._cleanup_job(job)
+            return
+        self.queue.append(Request(job.key, event=BusEvent.OUT_OF_SYNC))
+
+    def _on_pod_event(self, event, pod: Pod, old) -> None:
+        job_name = pod.job_name
+        if not job_name:
+            return
+        key = f"{pod.namespace}/{job_name}"
+        if event == "deleted":
+            self._pod_phase.pop(pod.key, None)
+            self.queue.append(Request(key, event=BusEvent.OUT_OF_SYNC))
+            return
+        prev = self._pod_phase.get(pod.key)
+        self._pod_phase[pod.key] = pod.phase
+        if prev is not None and prev != pod.phase:
+            if pod.phase == PodPhase.FAILED:
+                ev = (BusEvent.POD_EVICTED if pod.deletion_timestamp
+                      else BusEvent.POD_FAILED)
+                self.queue.append(Request(key, event=ev,
+                                          task_role=pod.task_role,
+                                          exit_code=pod.exit_code))
+                return
+            if pod.phase == PodPhase.SUCCEEDED:
+                if self._task_completed(key, pod.task_role):
+                    self.queue.append(Request(key,
+                                              event=BusEvent.TASK_COMPLETED,
+                                              task_role=pod.task_role))
+                    return
+        self.queue.append(Request(key, event=BusEvent.OUT_OF_SYNC))
+
+    def _on_command_event(self, event, cmd, old) -> None:
+        """Bus commands become explicit-action requests; the Command object
+        is consumed (job_controller_handler.go:40 + handleCommands:364)."""
+        if event != "added" or cmd.target_kind != "Job":
+            return
+        self.api.delete("commands", self.api._key(cmd))
+        self.queue.append(Request(f"{cmd.namespace}/{cmd.target_name}",
+                                  event=BusEvent.COMMAND_ISSUED,
+                                  action=cmd.action))
+
+    def _on_podgroup_event(self, event, pg: PodGroup, old) -> None:
+        if pg.owner_job and event == "updated":
+            self.queue.append(Request(pg.owner_job, event=BusEvent.OUT_OF_SYNC))
+
+    def _task_completed(self, job_key: str, role: str) -> bool:
+        """All replicas of the role succeeded (controllers/cache TaskCompleted)."""
+        job = self.api.get("jobs", job_key)
+        if job is None:
+            return False
+        spec = next((t for t in job.tasks if t.name == role), None)
+        if spec is None:
+            return False
+        pods = [p for p in self.api.pods_of_job(job_key)
+                if p.task_role == role]
+        return (len([p for p in pods if p.phase == PodPhase.SUCCEEDED])
+                >= spec.replicas)
+
+    # ------------------------------------------------------------ reconcile
+    def process_all(self, max_items: int = 10000) -> None:
+        for _ in range(max_items):
+            if not self.queue:
+                return
+            req = self.queue.popleft()
+            self.process(req)
+
+    def process(self, req: Request) -> None:
+        job = self.api.get("jobs", req.job_key)
+        if job is None:
+            return
+        action = apply_policies(job, req)
+        phase = job.status.state.phase
+
+        if action == BusAction.RESTART_JOB and phase in ACTIVE_PHASES:
+            if job.status.retry_count >= job.max_retry:
+                # retries exhausted -> job fails (job_controller.go:324-337)
+                self._kill_job(job, JobPhase.FAILED,
+                               reason="retries exhausted")
+                return
+            job.status.retry_count += 1
+
+        target = next_phase_for_action(phase, action)
+        if target is not None:
+            if target == JobPhase.PENDING:   # ResumeJob
+                self._set_phase(job, JobPhase.PENDING, reason="resumed")
+                self._sync_job(job)
+            elif target == JobPhase.RESTARTING:
+                # restart deletes everything incl. Failed pods so sync can
+                # recreate them (PodRetainPhaseNone, state/restarting.go)
+                self._kill_job(job, JobPhase.RESTARTING,
+                               reason=str(action.value), retain=False)
+            else:
+                final = {JobPhase.ABORTING: JobPhase.ABORTED,
+                         JobPhase.TERMINATING: JobPhase.TERMINATED,
+                         JobPhase.COMPLETING: JobPhase.COMPLETED}
+                self._kill_job(job, target, reason=str(action.value),
+                               final_phase=final.get(target))
+            return
+
+        if phase in TERMINAL_PHASES:
+            return
+        self._sync_job(job)
+
+    # -------------------------------------------------------------- syncJob
+    def _sync_job(self, job: Job) -> None:
+        """Create/delete pods to match spec; manage PodGroup; update status
+        (job_controller_actions.go:200-444)."""
+        if job.status.state.phase == JobPhase.RESTARTING:
+            # wait for old pods to disappear, then recreate
+            if self.api.pods_of_job(job.key):
+                return
+            self._set_phase(job, JobPhase.PENDING, reason="restarting done")
+
+        self._ensure_job_initialized(job)
+        pg = self._ensure_podgroup(job)
+
+        pods = self.api.pods_of_job(job.key)
+        by_role: Dict[str, List[Pod]] = {}
+        for p in pods:
+            by_role.setdefault(p.task_role, []).append(p)
+
+        # pod creation is gated on the PodGroup leaving Pending
+        # (syncTask gate, job_controller_actions.go:224-231)
+        may_create = pg.phase != PodGroupPhase.PENDING
+        for task in job.tasks:
+            have = by_role.get(task.name, [])
+            have_names = {p.name for p in have}
+            # scale down: delete the highest-index extras first
+            want_names = [self._pod_name(job, task, i)
+                          for i in range(task.replicas)]
+            for p in have:
+                if p.name not in want_names:
+                    self._delete_pod(p)
+            if may_create:
+                for i, pname in enumerate(want_names):
+                    if pname not in have_names:
+                        self._create_pod(job, task, i)
+
+        self._update_status(job)
+
+    def _ensure_job_initialized(self, job: Job) -> None:
+        """First reconcile: plugins + PVCs (initiateJob,
+        job_controller_actions.go:151-199 + 445-532)."""
+        if job.status.controlled_resources.get("initialized"):
+            return
+        for plugin_name in job.plugins:
+            get_job_plugin(plugin_name).on_job_add(job, self.api)
+        for i, vol in enumerate(job.volumes):
+            if not vol.volume_claim_name and vol.storage:
+                vol.volume_claim_name = f"{job.name}-pvc-{i}"
+            if vol.volume_claim_name and self.api.get(
+                    "pvcs", f"{job.namespace}/{vol.volume_claim_name}") is None:
+                self.api.create("pvcs", PVC(name=vol.volume_claim_name,
+                                            namespace=job.namespace,
+                                            storage=vol.storage))
+        job.status.controlled_resources["initialized"] = "true"
+
+    def _ensure_podgroup(self, job: Job) -> PodGroup:
+        pg = self.api.podgroup_of_job(job.key)
+        if pg is None:
+            pg = PodGroup(
+                name=job.name, namespace=job.namespace, owner_job=job.key,
+                min_member=job.min_available, queue=job.queue,
+                priority_class_name=job.priority_class_name,
+                min_resources=self._calc_pg_min_resources(job))
+            self.api.create("podgroups", pg)
+        else:
+            pg.min_member = job.min_available
+            pg.min_resources = self._calc_pg_min_resources(job)
+        return pg
+
+    def _calc_pg_min_resources(self, job: Job) -> Dict[str, object]:
+        """Sum the first minAvailable pods' requests, tasks ordered by
+        priority (calcPGMinResources, job_controller_actions.go:533-676)."""
+        total = Resource()
+        remaining = job.min_available
+        for task in sorted(job.tasks, key=lambda t: -t.template.priority):
+            take = min(task.replicas, remaining)
+            if take > 0:
+                total.add(task.template.resreq().multi(take))
+            remaining -= take
+            if remaining <= 0:
+                break
+        out: Dict[str, object] = {}
+        for name in total.resource_names():
+            v = total.get(name)
+            out[name] = v / 1000.0 if name == "cpu" else v
+        return out
+
+    def _pod_name(self, job: Job, task: TaskSpec, index: int) -> str:
+        return f"{job.name}-{task.name}-{index}"
+
+    def _create_pod(self, job: Job, task: TaskSpec, index: int) -> None:
+        tmpl = task.template
+        pod = Pod(
+            name=self._pod_name(job, task, index), namespace=job.namespace,
+            labels={**tmpl.labels, JOB_NAME_LABEL: job.name},
+            annotations={**tmpl.annotations,
+                         TASK_SPEC_ANNOTATION: task.name,
+                         POD_GROUP_ANNOTATION: job.name},
+            scheduler_name=job.scheduler_name,
+            resources=dict(tmpl.resources),
+            node_selector=dict(tmpl.node_selector),
+            tolerations=list(tmpl.tolerations),
+            priority=tmpl.priority, restart_policy=tmpl.restart_policy,
+            env=dict(tmpl.env), volumes=list(tmpl.volumes))
+        # fork's counter-label: monotonically numbered pod label
+        if COUNTER_LABEL_ANNOTATION in job.annotations:
+            label_key = job.annotations[COUNTER_LABEL_ANNOTATION]
+            n = self._counter.get(job.key, 0)
+            pod.labels[label_key] = str(n)
+            self._counter[job.key] = n + 1
+        for plugin_name in job.plugins:
+            get_job_plugin(plugin_name).on_pod_create(job, pod, index, self.api)
+        self.api.create("pods", pod)
+
+    def _delete_pod(self, pod: Pod) -> None:
+        self.api.delete("pods", pod.key)
+
+    # -------------------------------------------------------------- killJob
+    def _kill_job(self, job: Job, phase: JobPhase, reason: str = "",
+                  final_phase: Optional[JobPhase] = None,
+                  retain: bool = True) -> None:
+        """Delete the job's pods; enter the -ing phase now and the final
+        phase once pods are gone (killJob, job_controller_actions.go:46-150).
+        With ``retain`` (PodRetainPhaseSoft) Succeeded/Failed pods survive;
+        restarts pass retain=False (PodRetainPhaseNone)."""
+        self._set_phase(job, phase, reason)
+        for pod in self.api.pods_of_job(job.key):
+            if retain and pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            self._delete_pod(pod)
+        if final_phase is not None:
+            self._set_phase(job, final_phase, reason)
+        self._update_status(job, transition=False)
+
+    def _cleanup_job(self, job: Job) -> None:
+        for pod in self.api.pods_of_job(job.key):
+            self._delete_pod(pod)
+        pg = self.api.podgroup_of_job(job.key)
+        if pg is not None:
+            self.api.delete("podgroups", pg.key)
+        for plugin_name in job.plugins:
+            get_job_plugin(plugin_name).on_job_delete(job, self.api)
+
+    # -------------------------------------------------------------- status
+    def _set_phase(self, job: Job, phase: JobPhase, reason: str = "") -> None:
+        if job.status.state.phase != phase:
+            job.status.state.phase = phase
+            job.status.state.reason = reason
+            job.status.state.transition_time = time.time()
+            job.status.version += 1
+
+    def _update_status(self, job: Job, transition: bool = True) -> None:
+        pods = self.api.pods_of_job(job.key)
+        s = job.status
+        s.pending = sum(1 for p in pods if p.phase == PodPhase.PENDING)
+        s.running = sum(1 for p in pods if p.phase == PodPhase.RUNNING)
+        s.succeeded = sum(1 for p in pods if p.phase == PodPhase.SUCCEEDED)
+        s.failed = sum(1 for p in pods if p.phase == PodPhase.FAILED)
+        s.min_available = job.min_available
+        s.task_status_count = {}
+        for p in pods:
+            s.task_status_count.setdefault(p.task_role, {}).setdefault(p.phase, 0)
+            s.task_status_count[p.task_role][p.phase] += 1
+
+        if not transition:
+            return
+        phase = s.state.phase
+        total = job.total_replicas()
+        if phase == JobPhase.PENDING and s.running >= job.min_available > 0:
+            self._set_phase(job, JobPhase.RUNNING, "min available running")
+        elif phase in (JobPhase.PENDING, JobPhase.RUNNING):
+            min_success = job.min_success or total
+            if total > 0 and s.succeeded >= min_success:
+                self._set_phase(job, JobPhase.COMPLETED, "job completed")
+            elif (total > 0 and s.failed > 0
+                    and s.failed > total - job.min_available):
+                # minAvailable no longer reachable
+                self._set_phase(job, JobPhase.FAILED, "insufficient pods")
+
+
+from dataclasses import dataclass as _dataclass
+
+
+@_dataclass
+class PVC:
+    """PersistentVolumeClaim stand-in created per job volume
+    (createJobIOIfNotExist, job_controller_actions.go:445-532)."""
+
+    name: str
+    namespace: str = "default"
+    storage: str = ""
+
+
+register_controller(JobController)

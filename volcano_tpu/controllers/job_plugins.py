@@ -1,0 +1,133 @@
+"""Job plugins: per-pod environment/service injection.
+
+Reference: pkg/controllers/job/plugins/ —
+- ``env``: VC_TASK_INDEX / job name env vars (env/env.go:45-83),
+- ``svc``: headless service + hosts configmap so gang members resolve each
+  other by stable DNS names (svc/svc.go:76-353),
+- ``ssh``: per-job keypair secret mounted as authorized_keys so MPI-style
+  launchers can fan out (ssh/ssh.go:64-238). Key material here is random
+  placeholder bytes — the contract (secret exists, pods reference it) is what
+  the controllers and tests exercise, not real crypto.
+
+Interface mirrors PluginInterface{OnPodCreate,OnJobAdd,OnJobDelete}
+(plugins/interface/interface.go:29-50).
+"""
+
+from __future__ import annotations
+
+import secrets as _secrets
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from ..api.batch import Job
+from ..api.core import Pod
+
+
+@dataclass
+class SecretObject:
+    name: str
+    namespace: str = "default"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ServiceObject:
+    name: str
+    namespace: str = "default"
+    headless: bool = True
+    selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ConfigMapObject:
+    name: str
+    namespace: str = "default"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+class JobPlugin:
+    name = ""
+
+    def on_job_add(self, job: Job, apiserver) -> None:
+        pass
+
+    def on_pod_create(self, job: Job, pod: Pod, index: int, apiserver) -> None:
+        pass
+
+    def on_job_delete(self, job: Job, apiserver) -> None:
+        pass
+
+
+class EnvPlugin(JobPlugin):
+    name = "env"
+
+    def on_pod_create(self, job, pod, index, apiserver):
+        pod.env["VC_TASK_INDEX"] = str(index)
+        pod.env["VK_TASK_INDEX"] = str(index)   # legacy name kept by reference
+        pod.env["VC_JOB_NAME"] = job.name
+
+
+class SvcPlugin(JobPlugin):
+    name = "svc"
+
+    def _hosts(self, job: Job) -> Dict[str, str]:
+        lines: List[str] = []
+        for task in job.tasks:
+            for i in range(task.replicas):
+                lines.append(f"{job.name}-{task.name}-{i}.{job.name}")
+        return {"hosts": "\n".join(lines)}
+
+    def on_job_add(self, job, apiserver):
+        svc = ServiceObject(name=job.name, namespace=job.namespace,
+                            selector={"volcano.sh/job-name": job.name})
+        cm = ConfigMapObject(name=f"{job.name}-svc", namespace=job.namespace,
+                             data=self._hosts(job))
+        if apiserver.get("services", f"{job.namespace}/{job.name}") is None:
+            apiserver.create("services", svc)
+        if apiserver.get("configmaps", f"{job.namespace}/{job.name}-svc") is None:
+            apiserver.create("configmaps", cm)
+        job.status.controlled_resources["plugin-svc"] = job.name
+
+    def on_pod_create(self, job, pod, index, apiserver):
+        hosts = []
+        for task in job.tasks:
+            names = ",".join(f"{job.name}-{task.name}-{i}.{job.name}"
+                             for i in range(task.replicas))
+            pod.env[f"VC_{task.name.upper().replace('-', '_')}_HOSTS"] = names
+            hosts.append(names)
+        pod.env["VC_JOB_HOSTS"] = ";".join(hosts)
+
+    def on_job_delete(self, job, apiserver):
+        apiserver.delete("services", f"{job.namespace}/{job.name}")
+        apiserver.delete("configmaps", f"{job.namespace}/{job.name}-svc")
+
+
+class SSHPlugin(JobPlugin):
+    name = "ssh"
+
+    def on_job_add(self, job, apiserver):
+        key = f"{job.namespace}/{job.name}-ssh"
+        if apiserver.get("secrets", key) is None:
+            private = _secrets.token_hex(32)
+            public = _secrets.token_hex(16)
+            apiserver.create("secrets", SecretObject(
+                name=f"{job.name}-ssh", namespace=job.namespace,
+                data={"id_rsa": private, "id_rsa.pub": public,
+                      "authorized_keys": public,
+                      "config": "StrictHostKeyChecking no\n"}))
+        job.status.controlled_resources["plugin-ssh"] = f"{job.name}-ssh"
+
+    def on_pod_create(self, job, pod, index, apiserver):
+        pod.volumes.append(f"{job.name}-ssh")
+
+    def on_job_delete(self, job, apiserver):
+        apiserver.delete("secrets", f"{job.namespace}/{job.name}-ssh")
+
+
+_PLUGINS = {p.name: p for p in (EnvPlugin(), SvcPlugin(), SSHPlugin())}
+
+
+def get_job_plugin(name: str) -> JobPlugin:
+    if name not in _PLUGINS:
+        raise KeyError(f"unknown job plugin {name!r}")
+    return _PLUGINS[name]

@@ -1,0 +1,88 @@
+"""Job state machine: phase x action -> behavior.
+
+Reference: pkg/controllers/job/state/ (9 files; factory.go:62-85 state
+dispatch, running.go:30-96 and siblings for per-state action handling) and
+the policy-resolution order in job_controller_util.go:145-200:
+explicit action > OutOfSync > task-level policies (event/exit-code match) >
+job-level policies > default SyncJob.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api.batch import Job
+from ..api.types import BusAction, BusEvent, JobPhase
+
+
+@dataclass
+class Request:
+    """A unit of reconcile work (reference: pkg/controllers/apis/request.go:25-42)."""
+
+    job_key: str
+    event: Optional[BusEvent] = None
+    action: Optional[BusAction] = None
+    task_role: str = ""
+    exit_code: Optional[int] = None
+
+
+def apply_policies(job: Job, req: Request) -> BusAction:
+    """Resolve which action to run for a request
+    (job_controller_util.go:145-200)."""
+    if req.action is not None:
+        return req.action
+    if req.event == BusEvent.OUT_OF_SYNC:
+        return BusAction.SYNC_JOB
+    if req.task_role:
+        for task in job.tasks:
+            if task.name != req.task_role:
+                continue
+            for policy in task.policies:
+                if req.event is not None and policy.matches_event(req.event):
+                    return policy.action
+                if policy.matches_exit_code(req.exit_code):
+                    return policy.action
+    for policy in job.policies:
+        if req.event is not None and policy.matches_event(req.event):
+            return policy.action
+        if policy.matches_exit_code(req.exit_code):
+            return policy.action
+    return BusAction.SYNC_JOB
+
+
+#: phases in which pods may still run / be created
+ACTIVE_PHASES = (JobPhase.PENDING, JobPhase.RUNNING, JobPhase.RESTARTING)
+#: terminal phases
+TERMINAL_PHASES = (JobPhase.COMPLETED, JobPhase.FAILED, JobPhase.TERMINATED,
+                   JobPhase.ABORTED)
+
+
+def next_phase_for_action(phase: JobPhase, action: BusAction) -> Optional[JobPhase]:
+    """The transition each action triggers from a given phase, or None if the
+    action is a no-op there (state/{pending,running,aborted,...}.go).
+
+    Kill-type actions first enter an intermediate *-ing phase; the controller
+    moves to the final phase once the pods are gone (see JobController._sync).
+    """
+    if action == BusAction.ABORT_JOB:
+        if phase not in (JobPhase.ABORTED, JobPhase.ABORTING):
+            return JobPhase.ABORTING
+        return None
+    if action == BusAction.TERMINATE_JOB:
+        if phase not in (JobPhase.TERMINATED, JobPhase.TERMINATING):
+            return JobPhase.TERMINATING
+        return None
+    if action == BusAction.COMPLETE_JOB:
+        if phase not in (JobPhase.COMPLETED, JobPhase.COMPLETING):
+            return JobPhase.COMPLETING
+        return None
+    if action == BusAction.RESTART_JOB or action == BusAction.RESTART_TASK:
+        if phase in ACTIVE_PHASES:
+            return JobPhase.RESTARTING
+        return None
+    if action == BusAction.RESUME_JOB:
+        if phase == JobPhase.ABORTED or phase == JobPhase.ABORTING:
+            return JobPhase.PENDING
+        return None
+    return None  # SyncJob / EnqueueJob handled by the sync path

@@ -1,0 +1,50 @@
+"""PodGroup controller: adopt bare pods into single-member PodGroups.
+
+Reference: pkg/controllers/podgroup/ (294 LoC) — any pod with
+``schedulerName: volcano`` and no group annotation gets a PodGroup created
+for it so the gang machinery treats it uniformly
+(createNormalPodPGIfNotExist, pg_controller_handler.go:75).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque
+
+from ..api.core import POD_GROUP_ANNOTATION, Pod, PodGroup
+from ..api.types import DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME
+from .framework import Controller, register_controller
+
+
+class PodGroupController(Controller):
+    name = "podgroup-controller"
+
+    def initialize(self, apiserver) -> None:
+        self.api = apiserver
+        self.queue: Deque[str] = deque()
+        apiserver.watch("pods", self._on_pod)
+
+    def _on_pod(self, event, pod: Pod, old) -> None:
+        if event == "added":
+            self.queue.append(pod.key)
+
+    def process_all(self) -> None:
+        while self.queue:
+            self.sync_pod(self.queue.popleft())
+
+    def sync_pod(self, pod_key: str) -> None:
+        pod = self.api.get("pods", pod_key)
+        if pod is None or pod.scheduler_name != DEFAULT_SCHEDULER_NAME:
+            return
+        if pod.annotations.get(POD_GROUP_ANNOTATION):
+            return
+        pg_name = f"podgroup-{pod.name}"
+        if self.api.get("podgroups", f"{pod.namespace}/{pg_name}") is None:
+            self.api.create("podgroups", PodGroup(
+                name=pg_name, namespace=pod.namespace, min_member=1,
+                queue=pod.annotations.get("volcano.sh/queue-name",
+                                          DEFAULT_QUEUE)))
+        pod.annotations[POD_GROUP_ANNOTATION] = pg_name
+
+
+register_controller(PodGroupController)

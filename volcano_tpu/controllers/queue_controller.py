@@ -1,0 +1,83 @@
+"""Queue controller: Open/Closed/Closing state machine + podgroup tallies.
+
+Reference: pkg/controllers/queue/ (1,010 LoC) — bus Commands
+OpenQueue/CloseQueue (queue_controller.go:267-331), open/close actions with
+live-podgroup checks (queue_controller_action.go:78-170), and aggregation of
+podgroup phase counts into QueueStatus (queue_controller_action.go:44-76).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque
+
+from ..api.queue_info import QueueInfo
+from ..api.types import BusAction, PodGroupPhase, QueueState
+from .framework import Controller, register_controller
+
+
+class QueueController(Controller):
+    name = "queue-controller"
+
+    def initialize(self, apiserver) -> None:
+        self.api = apiserver
+        self.queue: Deque[str] = deque()
+        apiserver.watch("queues", self._on_queue)
+        apiserver.watch("podgroups", self._on_podgroup)
+        apiserver.watch("commands", self._on_command)
+
+    def _on_queue(self, event, queue, old) -> None:
+        self.queue.append(queue.name)
+
+    def _on_podgroup(self, event, pg, old) -> None:
+        if pg.queue:
+            self.queue.append(pg.queue)
+
+    def _on_command(self, event, cmd, old) -> None:
+        if event != "added" or cmd.target_kind != "Queue":
+            return
+        if cmd.action not in (BusAction.OPEN_QUEUE, BusAction.CLOSE_QUEUE):
+            return
+        self.api.delete("commands", self.api._key(cmd))
+        queue = self.api.get("queues", cmd.target_name)
+        if queue is None:
+            return
+        if cmd.action == BusAction.OPEN_QUEUE:
+            queue.state = QueueState.OPEN
+        else:
+            queue.state = (QueueState.CLOSING if self._live_podgroups(queue.name)
+                           else QueueState.CLOSED)
+        self.queue.append(queue.name)
+
+    def _live_podgroups(self, queue_name: str) -> int:
+        return len(self.api.list(
+            "podgroups",
+            lambda pg: pg.queue == queue_name
+            and pg.phase in (PodGroupPhase.PENDING, PodGroupPhase.INQUEUE,
+                             PodGroupPhase.RUNNING, PodGroupPhase.UNKNOWN)))
+
+    def process_all(self) -> None:
+        seen = set()
+        while self.queue:
+            name = self.queue.popleft()
+            if name in seen:
+                continue
+            seen.add(name)
+            self.sync_queue(name)
+
+    def sync_queue(self, name: str) -> None:
+        queue: QueueInfo = self.api.get("queues", name)
+        if queue is None:
+            return
+        # Closing -> Closed once no live podgroups remain
+        if queue.state == QueueState.CLOSING and not self._live_podgroups(name):
+            queue.state = QueueState.CLOSED
+        # tally podgroup phases into annotations (stand-in for QueueStatus)
+        counts = {p.value: 0 for p in PodGroupPhase}
+        for pg in self.api.list("podgroups", lambda pg: pg.queue == name):
+            counts[pg.phase.value] = counts.get(pg.phase.value, 0) + 1
+        for phase, n in counts.items():
+            queue.annotations[f"status.{phase.lower()}"] = str(n)
+
+
+register_controller(QueueController)

@@ -185,9 +185,7 @@ class Session:
         """Run the enqueue pass; promote admitted jobs Pending -> Inqueue.
         Returns the number admitted."""
         fn = _enqueue_fn(self.enqueue_config())
-        extras = self.allocate_extras()
-        admitted = np.asarray(fn(self.snap, extras.queue_deserved,
-                                 self.sla_waiting_flags()))
+        admitted = np.asarray(fn(self.snap, self.sla_waiting_flags()))
         count = 0
         from ..api import PodGroupPhase
         for uid, ji in self.maps.job_index.items():
@@ -304,6 +302,12 @@ class Session:
         task_node = np.asarray(result.task_node)
         task_mode = np.asarray(result.task_mode)
         job_ready = np.asarray(result.job_ready)
+        # ready gangs' PodGroups move to Running (scheduler status updater,
+        # session.go:173 jobStatus)
+        from ..api import PodGroupPhase
+        for uid, ji in self.maps.job_index.items():
+            if bool(job_ready[ji]):
+                self.phase_updates[uid] = PodGroupPhase.RUNNING
         for uid, ti in self.maps.task_index.items():
             mode = int(task_mode[ti])
             if mode == 0:

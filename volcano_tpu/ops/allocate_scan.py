@@ -180,8 +180,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
         def eligible(st):
             # Overused queues are skipped (proportion.Overused,
-            # proportion.go:240-253): allocated >= deserved on every dim.
-            overused = jnp.all(st["queue_allocated"] >= queue_deserved - 1e-6,
+            # proportion.go:240-253): NOT allocated.LessEqual(deserved),
+            # i.e. any dim where allocated exceeds deserved.
+            overused = jnp.any(st["queue_allocated"] > queue_deserved + 1e-6,
                                axis=-1)
             job_overused = overused[jobs.queue]
             return (jobs.valid & jobs.schedulable & ~st["job_done"]

@@ -1,9 +1,10 @@
 """Enqueue pass: gate Pending PodGroups into Inqueue phase.
 
 TPU re-design of the enqueue action (pkg/scheduler/actions/enqueue/
-enqueue.go:43-102) and its JobEnqueueable voters: proportion's
-deserved-minus-allocated-minus-inqueue capacity test
-(proportion.go:254-280), overcommit's cluster-factor test
+enqueue.go:43-102) and its JobEnqueueable voters: proportion's queue-quota
+test — permit iff ``minResources + allocated + inqueue <= capability``,
+always permit when the queue declares no capability
+(proportion.go:254-280) — overcommit's cluster-factor test
 (pkg/scheduler/plugins/overcommit/overcommit.go:28-124), and sla's
 waiting-deadline override (pkg/scheduler/plugins/sla/sla.go:146-148).
 
@@ -35,11 +36,11 @@ class EnqueueConfig:
 
 
 def make_enqueue_pass(cfg: EnqueueConfig):
-    """Returns enqueue(snap, queue_deserved, sla_waiting) -> bool[J] newly
-    admitted (Pending -> Inqueue) jobs. ``sla_waiting`` bool[J] marks jobs
-    past their SLA waiting deadline."""
+    """Returns enqueue(snap, sla_waiting) -> bool[J] newly admitted
+    (Pending -> Inqueue) jobs. ``sla_waiting`` bool[J] marks jobs past their
+    SLA waiting deadline."""
 
-    def enqueue(snap: SnapshotArrays, queue_deserved: jax.Array,
+    def enqueue(snap: SnapshotArrays,
                 sla_waiting: jax.Array) -> jax.Array:
         snap = jax.tree.map(jnp.asarray, snap)
         jobs, queues, nodes = snap.jobs, snap.queues, snap.nodes
@@ -67,12 +68,11 @@ def make_enqueue_pass(cfg: EnqueueConfig):
 
             permit = jnp.bool_(True)
             if cfg.enable_proportion_gate:
-                headroom = (queue_deserved[qi] - queues.allocated[qi]
-                            - q_inqueue[qi])
-                fits = jnp.all(
-                    jnp.where(jnp.isfinite(queue_deserved[qi]),
-                              minres <= headroom + _EPS, True))
-                permit &= fits
+                # permit iff minReq + allocated + inqueue <= capability;
+                # unset capability dims are +inf -> always permit
+                # (proportion.go:254-280)
+                used = minres + queues.allocated[qi] + q_inqueue[qi]
+                permit &= jnp.all(used <= queues.capability[qi] + _EPS)
             if cfg.enable_overcommit_gate:
                 head = (total_alloc * cfg.overcommit_factor
                         - (total_alloc - total_idle) - cluster_inqueue)

@@ -1,0 +1,119 @@
+"""In-memory API server: the object store + watch bus every component talks to.
+
+Reference architecture: the Kubernetes API server is Volcano's sole
+communication backbone (SURVEY.md section 1) — controllers and scheduler
+coordinate exclusively through watches and status updates on shared objects.
+This class provides the same seam: typed object stores, admission hooks on
+writes (the webhook interception point), and synchronous watch callbacks
+(the informer event-handler seam, cache.go:337-429).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from ..api.batch import Command, Job
+from ..api.core import Pod, PodGroup
+from ..api.node_info import NodeInfo
+from ..api.queue_info import QueueInfo
+
+KINDS = ("jobs", "pods", "podgroups", "queues", "nodes", "commands",
+         "pvcs", "secrets", "services", "configmaps")
+
+
+class APIServer:
+    def __init__(self):
+        self.stores: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
+        self.watchers: Dict[str, List[Callable]] = defaultdict(list)
+        self._rv = 0          # resourceVersion counter (picklable)
+        self.admission_enabled = True
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _key(obj) -> str:
+        ns = getattr(obj, "namespace", "")
+        name = getattr(obj, "name", "")
+        return f"{ns}/{name}" if ns else name
+
+    def watch(self, kind: str, callback: Callable[[str, object, Optional[object]], None]) -> None:
+        """Register callback(event, obj, old) for 'added'/'updated'/'deleted'."""
+        self.watchers[kind].append(callback)
+
+    def _notify(self, kind: str, event: str, obj, old=None) -> None:
+        for cb in self.watchers[kind]:
+            cb(event, obj, old)
+
+    def _admit(self, kind: str, obj, old=None) -> None:
+        if not self.admission_enabled:
+            return
+        from ..webhooks import (mutate_job, mutate_podgroup, mutate_queue,
+                                validate_job_create, validate_job_update,
+                                validate_queue)
+        if kind == "jobs":
+            if old is None:
+                mutate_job(obj)
+                validate_job_create(obj, queues=self.stores["queues"])
+            else:
+                validate_job_update(old, obj)
+        elif kind == "queues":
+            mutate_queue(obj)
+            validate_queue(obj)
+        elif kind == "podgroups":
+            mutate_podgroup(obj) if hasattr(obj, "queue") else None
+
+    # ---------------------------------------------------------------- CRUD
+    def create(self, kind: str, obj) -> object:
+        key = self._key(obj)
+        if key in self.stores[kind]:
+            raise KeyError(f"{kind}/{key} already exists")
+        self._admit(kind, obj)
+        self.stores[kind][key] = obj
+        self._notify(kind, "added", obj)
+        return obj
+
+    def update(self, kind: str, obj) -> object:
+        key = self._key(obj)
+        old = self.stores[kind].get(key)
+        if old is None:
+            raise KeyError(f"{kind}/{key} not found")
+        if old is not obj:
+            self._admit(kind, obj, old)
+        self.stores[kind][key] = obj
+        self._notify(kind, "updated", obj, old)
+        return obj
+
+    def delete(self, kind: str, key: str) -> Optional[object]:
+        obj = self.stores[kind].pop(key, None)
+        if obj is not None:
+            from ..webhooks import validate_queue_delete
+            if kind == "queues" and self.admission_enabled:
+                try:
+                    validate_queue_delete(obj)
+                except Exception:
+                    self.stores[kind][key] = obj
+                    raise
+            self._notify(kind, "deleted", obj)
+        return obj
+
+    def get(self, kind: str, key: str):
+        return self.stores[kind].get(key)
+
+    def list(self, kind: str, selector: Optional[Callable] = None) -> List:
+        objs = list(self.stores[kind].values())
+        if selector:
+            objs = [o for o in objs if selector(o)]
+        return objs
+
+    # --------------------------------------------------------- conveniences
+    def pods_of_job(self, job_key: str) -> List[Pod]:
+        ns, name = job_key.split("/", 1)
+        from ..api.core import JOB_NAME_LABEL
+        return self.list("pods", lambda p: p.namespace == ns
+                         and p.labels.get(JOB_NAME_LABEL) == name)
+
+    def podgroup_of_job(self, job_key: str) -> Optional[PodGroup]:
+        for pg in self.stores["podgroups"].values():
+            if pg.owner_job == job_key:
+                return pg
+        return None

@@ -1,0 +1,128 @@
+"""Scheduler cache: project API-server objects into a ClusterInfo snapshot.
+
+Reference: pkg/scheduler/cache/cache.go:71-917 + event_handlers.go:43-740 —
+the informer-fed mirror whose Snapshot() the session consumes. Here the
+projection is rebuilt from the store each cycle (the store IS the local
+cache; a deep-copy clone per cycle matches the reference's snapshot
+semantics), and bind/evict write back to pods exactly like the
+defaultBinder/defaultEvictor REST calls (cache.go:123-175).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, Resource,
+                   TaskInfo, TaskStatus)
+from ..api.core import Pod, PodGroup, PodPhase
+from ..api.queue_info import NamespaceInfo
+from ..api.types import DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME, QueueState
+from ..framework.session import BindIntent, EvictIntent
+from .apiserver import APIServer
+
+_POD_PHASE_TO_STATUS = {
+    PodPhase.PENDING: TaskStatus.PENDING,
+    PodPhase.RUNNING: TaskStatus.RUNNING,
+    PodPhase.SUCCEEDED: TaskStatus.SUCCEEDED,
+    PodPhase.FAILED: TaskStatus.FAILED,
+    PodPhase.UNKNOWN: TaskStatus.UNKNOWN,
+}
+
+
+class SchedulerCache:
+    """The scheduler's view of the store, plus the bind/evict seam."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self.binds: List[Tuple[str, str]] = []
+        self.evictions: List[str] = []
+        self._ensure_default_queue()
+
+    def _ensure_default_queue(self) -> None:
+        """The cache creates the default queue at startup (cache.go:448-455)."""
+        if self.api.get("queues", DEFAULT_QUEUE) is None:
+            self.api.admission_enabled = False
+            try:
+                self.api.create("queues", QueueInfo(DEFAULT_QUEUE, weight=1))
+            finally:
+                self.api.admission_enabled = True
+
+    # ------------------------------------------------------------- snapshot
+    def snapshot(self) -> ClusterInfo:
+        ci = ClusterInfo()
+        for node in self.api.stores["nodes"].values():
+            ci.add_node(node.clone())
+        for queue in self.api.stores["queues"].values():
+            ci.add_queue(queue.clone())
+
+        for pg in self.api.stores["podgroups"].values():
+            job = JobInfo(
+                uid=pg.key, name=pg.name, namespace=pg.namespace,
+                queue=pg.queue or DEFAULT_QUEUE,
+                min_available=pg.min_member,
+                min_resources=pg.min_resources_res(),
+                creation_timestamp=pg.creation_timestamp,
+                pod_group_phase=pg.phase)
+            ci.add_job(job)
+
+        for pod in self.api.stores["pods"].values():
+            if pod.scheduler_name != DEFAULT_SCHEDULER_NAME:
+                continue
+            pg_name = pod.pod_group
+            if not pg_name:
+                continue
+            job = ci.jobs.get(f"{pod.namespace}/{pg_name}")
+            if job is None:
+                continue
+            status = _POD_PHASE_TO_STATUS.get(pod.phase, TaskStatus.UNKNOWN)
+            if pod.deletion_timestamp and status == TaskStatus.RUNNING:
+                status = TaskStatus.RELEASING
+            if status == TaskStatus.PENDING and pod.node_name:
+                status = TaskStatus.BOUND
+            task = TaskInfo(
+                uid=pod.key, name=pod.name, namespace=pod.namespace,
+                task_role=pod.task_role, resreq=pod.resreq(),
+                status=status, priority=pod.priority,
+                node_selector=dict(pod.node_selector),
+                tolerations=list(pod.tolerations))
+            task.node_name = pod.node_name
+            job.add_task(task)
+            if pod.node_name and pod.node_name in ci.nodes and status not in (
+                    TaskStatus.SUCCEEDED, TaskStatus.FAILED,
+                    TaskStatus.UNKNOWN):
+                ci.nodes[pod.node_name].add_task(task)
+        return ci
+
+    # ----------------------------------------------------------- bind/evict
+    def bind(self, intent: BindIntent) -> bool:
+        pod: Optional[Pod] = self.api.get("pods", intent.task_uid)
+        node = self.api.get("nodes", intent.node_name)
+        if pod is None or node is None:
+            return False
+        pod.node_name = intent.node_name
+        self.api.update("pods", pod)
+        self.binds.append((intent.task_uid, intent.node_name))
+        return True
+
+    def evict(self, intent: EvictIntent) -> bool:
+        pod: Optional[Pod] = self.api.get("pods", intent.task_uid)
+        if pod is None:
+            return False
+        # the evictor deletes the pod; the job controller recreates it
+        # pending (cache.go:145-175). A truthy deletion timestamp is what
+        # classifies the transition as PodEvicted rather than PodFailed.
+        import time
+        pod.phase = PodPhase.FAILED
+        pod.deletion_timestamp = pod.deletion_timestamp or time.time()
+        self.api.update("pods", pod)
+        self.api.delete("pods", pod.key)
+        self.evictions.append(intent.task_uid)
+        return True
+
+    # ------------------------------------------------- status write-back
+    def update_podgroup_phases(self, phase_updates: Dict[str, object]) -> None:
+        for uid, phase in phase_updates.items():
+            pg = self.api.get("podgroups", uid)
+            if pg is not None:
+                pg.phase = phase
+                self.api.update("podgroups", pg)

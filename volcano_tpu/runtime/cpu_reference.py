@@ -193,7 +193,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     nodes_np = _as_np(nodes)
 
     while True:
-        overused = np.all(queue_allocated >= queue_deserved - 1e-6, axis=-1)
+        overused = np.any(queue_allocated > queue_deserved + 1e-6, axis=-1)
         elig = jvalid & ~job_done & (n_pending > 0) & ~overused[jqueue]
         if not elig.any():
             break

@@ -66,6 +66,12 @@ class FakeCluster:
         self.evictions.append(intent.task_uid)
         return True
 
+    def update_podgroup_phases(self, phase_updates) -> None:
+        for uid, phase in phase_updates.items():
+            job = self.ci.jobs.get(uid)
+            if job is not None:
+                job.pod_group_phase = phase
+
     # --------------------------------------------------- lifecycle helpers
     def run_task(self, task_uid: str) -> None:
         """Kubelet-style transition Bound -> Running."""

@@ -72,10 +72,7 @@ class Scheduler:
 
         # PodGroup status write-back at session close (the jobUpdater's
         # parallel UpdatePodGroup flush, framework/job_updater.go:66-108)
-        for uid, phase in ssn.phase_updates.items():
-            job = self.cluster.ci.jobs.get(uid)
-            if job is not None:
-                job.pod_group_phase = phase
+        self.cluster.update_podgroup_phases(ssn.phase_updates)
 
         for intent in ssn.evictions:
             self.cluster.evict(intent)

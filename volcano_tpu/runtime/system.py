@@ -1,0 +1,116 @@
+"""The assembled control plane: API server + controllers + scheduler + a
+kubelet simulator — the single-process equivalent of the reference's three
+binaries (vc-scheduler, vc-controller-manager, vc-webhook-manager) against
+one API server (SURVEY.md section 1 layer map), used for full-stack e2e
+tests the way the reference uses a kind cluster (hack/run-e2e-kind.sh).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..api.batch import Command, Job
+from ..api.core import Pod, PodPhase
+from ..api.node_info import NodeInfo
+from ..api.resource import Resource
+from ..api.types import BusAction
+from ..controllers import build_controllers
+from ..framework.conf import SchedulerConfiguration, parse_conf
+from ..framework.session import Session
+from .apiserver import APIServer
+from .cache import SchedulerCache
+
+
+class VolcanoSystem:
+    def __init__(self, conf: Optional[SchedulerConfiguration] = None):
+        from .scheduler import Scheduler
+        self.api = APIServer()
+        self.controllers = build_controllers(self.api)
+        self.cache = SchedulerCache(self.api)
+        self.conf = conf or parse_conf()
+        self.scheduler = Scheduler(self.cache, conf=self.conf)
+
+    # ------------------------------------------------------------ cluster
+    def add_node(self, name: str, cpu="8", memory="16Gi", pods="110",
+                 **kw) -> NodeInfo:
+        node = NodeInfo(name, allocatable=Resource.from_resource_list(
+            {"cpu": cpu, "memory": memory, "pods": pods}), **kw)
+        self.api.create("nodes", node)
+        return node
+
+    # --------------------------------------------------------------- user
+    def submit_job(self, job: Job) -> Job:
+        """vcctl job run -> POST Job (admission webhooks run in create)."""
+        return self.api.create("jobs", job)
+
+    def submit_command(self, command: Command) -> None:
+        self.api.create("commands", command)
+
+    def suspend_job(self, name: str, namespace: str = "default") -> None:
+        """vcctl job suspend -> bus Command AbortJob (pkg/cli/job/suspend.go)."""
+        self.submit_command(Command(name=f"suspend-{name}-{time.time()}",
+                                    namespace=namespace,
+                                    action=BusAction.ABORT_JOB,
+                                    target_name=name))
+
+    def resume_job(self, name: str, namespace: str = "default") -> None:
+        self.submit_command(Command(name=f"resume-{name}-{time.time()}",
+                                    namespace=namespace,
+                                    action=BusAction.RESUME_JOB,
+                                    target_name=name))
+
+    # ------------------------------------------------------------- engine
+    def reconcile(self, rounds: int = 4) -> None:
+        """Drain controller queues (events cascade, so a few sweeps)."""
+        for _ in range(rounds):
+            busy = False
+            for c in self.controllers:
+                before = len(getattr(c, "queue", []) or [])
+                c.process_all()
+                busy = busy or before > 0
+            if not busy:
+                break
+
+    @property
+    def cycles(self) -> int:
+        return self.scheduler.cycles
+
+    def schedule_once(self) -> Session:
+        """One scheduler cycle against the live store (runOnce)."""
+        return self.scheduler.run_once()
+
+    def kubelet_tick(self) -> int:
+        """Bound pods start running (the kubelet's job)."""
+        started = 0
+        for pod in list(self.api.stores["pods"].values()):
+            if pod.node_name and pod.phase == PodPhase.PENDING:
+                pod.phase = PodPhase.RUNNING
+                self.api.update("pods", pod)
+                started += 1
+        return started
+
+    def finish_pod(self, pod_key: str, exit_code: int = 0) -> None:
+        """Workload finishes: Succeeded on 0, Failed otherwise."""
+        pod = self.api.get("pods", pod_key)
+        if pod is None:
+            return
+        pod.exit_code = exit_code
+        pod.phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
+        self.api.update("pods", pod)
+
+    def tick(self) -> Session:
+        """One full control-plane step: reconcile, schedule, kubelet,
+        reconcile."""
+        self.reconcile()
+        ssn = self.schedule_once()
+        self.kubelet_tick()
+        self.reconcile()
+        return ssn
+
+    # -------------------------------------------------------------- views
+    def job(self, name: str, namespace: str = "default") -> Optional[Job]:
+        return self.api.get("jobs", f"{namespace}/{name}")
+
+    def pods_of(self, name: str, namespace: str = "default") -> List[Pod]:
+        return self.api.pods_of_job(f"{namespace}/{name}")
