@@ -66,3 +66,81 @@ def place_running(ci: ClusterInfo, job: JobInfo, task: TaskInfo,
     task.status = TaskStatus.RUNNING
     job.add_task(task)
     ci.nodes[node].add_task(task)
+
+
+def make_cluster() -> ClusterInfo:
+    """A deliberately messy cluster exercising every packed encoding:
+    labels, taints, tolerations, selectors, affinity, hierarchy queues,
+    namespace weights, mixed statuses, unknown queues, scalar resources."""
+    from volcano_tpu.api.job_info import Taint, Toleration
+    from volcano_tpu.api.cluster_info import NamespaceInfo
+    from volcano_tpu.api import QueueState
+
+    ci = ClusterInfo()
+    ci.add_node(build_node("n0", cpu="8", memory="16Gi",
+                           labels={"zone": "a", "disk": "ssd"}))
+    n1 = build_node("n1", cpu="4", memory="8Gi", labels={"zone": "b"},
+                    scalars={"nvidia.com/gpu": "2"})
+    n1.taints = [Taint(key="dedicated", value="batch", effect="NoSchedule"),
+                 Taint(key="flaky", value="", effect="PreferNoSchedule")]
+    ci.add_node(n1)
+    n2 = build_node("n2", cpu="2", memory="4Gi", max_pods=3)
+    n2.unschedulable = True
+    ci.add_node(n2)
+    n3 = build_node("n3", cpu="16", memory="32Gi")
+    n3.ready = False
+    ci.add_node(n3)
+
+    ci.add_queue(QueueInfo("default", weight=1))
+    ci.add_queue(QueueInfo("root", weight=1, hierarchy="/root",
+                           hierarchy_weights="1"))
+    ci.add_queue(QueueInfo("sci", weight=2, hierarchy="/root/sci",
+                           hierarchy_weights="1/2",
+                           capability=res(cpu=6, memory="12Gi")))
+    ci.add_queue(QueueInfo("closed", weight=3, state=QueueState.CLOSED))
+
+    j0 = build_job("default/j0", queue="default", min_available=2, priority=5,
+                   creation_timestamp=10.0)
+    j0.add_task(build_task("j0-a", cpu="1", memory="1Gi", priority=2))
+    t = build_task("j0-b", cpu="2", memory="2Gi", priority=7)
+    t.node_selector = {"zone": "a"}
+    t.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                value="batch", effect="NoSchedule"),
+                     Toleration(key="flaky", operator="Exists"),
+                     Toleration(key="", operator="Exists")]
+    j0.add_task(t)
+    run = build_task("j0-c", cpu="1", memory="1Gi",
+                     status=TaskStatus.RUNNING, node_name="n0")
+    j0.add_task(run)
+    ci.nodes["n0"].add_task(run)
+    ci.add_job(j0)
+
+    j1 = build_job("team/j1", queue="sci", min_available=1,
+                   namespace="team", creation_timestamp=3.0)
+    t = build_task("j1-a", cpu="500m", memory="512Mi", namespace="team")
+    t.affinity_required = [{"disk": "ssd"}]
+    j1.add_task(t)
+    j1.add_task(build_task("j1-gpu", cpu="1", memory="1Gi", namespace="team",
+                           scalars={"nvidia.com/gpu": "1"}))
+    ci.add_job(j1)
+
+    # best-effort task, job in an unknown queue, and a gang-invalid job
+    j2 = build_job("default/j2", queue="ghost", min_available=1,
+                   creation_timestamp=3.0)
+    j2.add_task(build_task("j2-a", cpu=0, memory=0))
+    ci.add_job(j2)
+    j3 = build_job("default/j3", queue="default", min_available=5,
+                   creation_timestamp=1.0)  # 5 > 1 task: gang-invalid
+    j3.add_task(build_task("j3-a", cpu="1", memory="1Gi"))
+    ci.add_job(j3)
+    j4 = build_job("default/j4", queue="closed", min_available=1,
+                   pod_group_phase=PodGroupPhase.PENDING,
+                   creation_timestamp=2.0, preemptable=True)
+    t = build_task("j4-a", cpu="1", memory="1Gi", preemptable=True,
+                   status=TaskStatus.BOUND, node_name="n1")
+    j4.add_task(t)
+    j4.add_task(build_task("j4-b", cpu="1", memory="1Gi", preemptable=True))
+    ci.add_job(j4)
+
+    ci.namespaces["team"] = NamespaceInfo("team", weight=4)
+    return ci

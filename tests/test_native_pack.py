@@ -1,0 +1,102 @@
+"""Native (C++) packer equivalence: wire -> vc_pack arrays == arrays/pack.py.
+
+The pure-Python packer is the oracle; every field of every array must match
+bit-for-bit on clusters exercising labels, taints, tolerations, selectors,
+hierarchy queues, mixed task statuses, unknown queues, and empty corners.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from volcano_tpu import native
+from volcano_tpu.arrays.pack import pack
+from volcano_tpu.native.wire import serialize
+
+from fixtures import make_cluster  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native packer unavailable: {native.build_error()}")
+
+
+def assert_snapshots_equal(a, b):
+    flat_a = dataclasses.asdict(a)
+    flat_b = dataclasses.asdict(b)
+
+    def walk(pa, pb, path):
+        if isinstance(pa, dict):
+            assert set(pa) == set(pb), path
+            for k in pa:
+                walk(pa[k], pb[k], f"{path}.{k}")
+            return
+        pa, pb = np.asarray(pa), np.asarray(pb)
+        assert pa.shape == pb.shape, f"{path}: {pa.shape} vs {pb.shape}"
+        assert pa.dtype == pb.dtype, f"{path}: {pa.dtype} vs {pb.dtype}"
+        np.testing.assert_array_equal(pa, pb, err_msg=path)
+
+    walk(flat_a, flat_b, "snap")
+
+
+def assert_maps_equal(ma, mb):
+    assert ma.node_names == mb.node_names
+    assert ma.task_uids == mb.task_uids
+    assert ma.job_uids == mb.job_uids
+    assert ma.queue_names == mb.queue_names
+    assert ma.namespace_names == mb.namespace_names
+    assert ma.resource_names == mb.resource_names
+    assert ma.node_index == mb.node_index
+    assert ma.task_index == mb.task_index
+
+
+def test_native_matches_python_on_rich_cluster():
+    ci = make_cluster()
+    snap_py, maps_py = pack(ci)
+    snap_cc, maps_cc = native.pack_native(ci)
+    assert_snapshots_equal(snap_py, snap_cc)
+    assert_maps_equal(maps_py, maps_cc)
+
+
+def test_native_matches_python_on_synthetic_scale():
+    from __graft_entry__ import _synthetic_cluster
+    ci = _synthetic_cluster(n_nodes=64, n_jobs=24, tasks_per_job=5)
+    snap_py, _ = pack(ci)
+    snap_cc, _ = native.pack_native(ci)
+    assert_snapshots_equal(snap_py, snap_cc)
+
+
+def test_native_matches_python_on_empty_cluster():
+    from volcano_tpu.api import ClusterInfo
+    ci = ClusterInfo()
+    snap_py, _ = pack(ci)
+    snap_cc, _ = native.pack_native(ci)
+    assert_snapshots_equal(snap_py, snap_cc)
+
+
+def test_wire_rejects_garbage():
+    with pytest.raises(ValueError):
+        native.pack_wire(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        # valid magic, truncated body
+        buf, _ = serialize(make_cluster())
+        native.pack_wire(buf[: len(buf) // 2])
+
+
+def test_wire_rejects_crafted_huge_counts():
+    # valid magic + counts far beyond the buffer must raise, not abort the
+    # process with bad_alloc / heap corruption
+    import struct
+    hdr = struct.pack("<7I", 0x31534356, 1, 0, 0, 0, 0, 0xFFFFFFFF)
+    with pytest.raises(ValueError):
+        native.pack_wire(hdr + b"\x00" * 256)
+    hdr = struct.pack("<7I", 0x31534356, 1024, 2**31, 0, 2**31, 0, 2**31)
+    with pytest.raises(ValueError):
+        native.pack_wire(hdr + b"\x00" * 1024)
+
+
+def test_pack_best_effort_runs():
+    ci = make_cluster()
+    snap, maps = native.pack_best_effort(ci)
+    assert snap.nodes.idle.ndim == 2
+    assert maps.resource_names[0] == "cpu"

@@ -50,6 +50,36 @@ def _vec(res, dims: List[str]) -> np.ndarray:
     return np.array([res.get(d) for d in dims], dtype=np.float32)
 
 
+def queue_capability_row(q, dims: List[str]) -> np.ndarray:
+    """Queue capability vector with +inf for undeclared dims (proportion.go
+    clamps by capability only where declared)."""
+    inf = np.float32(np.inf)
+    if not q.capability.quantities:
+        return np.full(len(dims), inf, np.float32)
+    cap = _vec(q.capability, dims)
+    declared = np.array([d in q.capability.quantities for d in dims])
+    return np.where(declared, cap, inf).astype(np.float32)
+
+
+def queue_parent_depth(ci: ClusterInfo,
+                       queue_names: List[str]) -> Tuple[List[int], List[int]]:
+    """Hierarchy parent pointers + depths from the fork's hdrf path
+    annotations: parent is the queue whose path is path[:-1], else root."""
+    path_of = {n: ci.queues[n].hierarchy_path() for n in queue_names}
+    parents, depths = [], []
+    for name in queue_names:
+        path = path_of[name]
+        depths.append(max(len(path) - 1, 0))
+        parent = -1
+        if len(path) > 1:
+            for j, other in enumerate(queue_names):
+                if path_of[other] == path[:-1]:
+                    parent = j
+                    break
+        parents.append(parent)
+    return parents, depths
+
+
 def _toleration_rows(tols: List[Toleration]) -> Tuple[List[int], List[int], List[int]]:
     hashes, effects, modes = [], [], []
     for t in tols:
@@ -89,29 +119,16 @@ def pack(ci: ClusterInfo,
     for i, name in enumerate(queue_names):
         q = ci.queues[name]
         q_weight[i] = max(q.weight, 0)
-        if q.capability.quantities:
-            cap = _vec(q.capability, dims)
-            # unset dims stay unbounded (proportion.go clamps by capability
-            # only where declared)
-            declared = np.array([d in q.capability.quantities for d in dims])
-            q_cap[i] = np.where(declared, cap, inf)
+        q_cap[i] = queue_capability_row(q, dims)
         q_reclaimable[i] = q.reclaimable
         q_open[i] = q.state == QueueState.OPEN
 
     # hierarchy tree (fork's hdrf): build parent pointers from paths
     q_parent = np.full(Q, -1, np.int32)
     q_depth = np.zeros(Q, np.int32)
-    path_of = {name: ci.queues[name].hierarchy_path() for name in queue_names}
-    for i, name in enumerate(queue_names):
-        path = path_of[name]
-        q_depth[i] = max(len(path) - 1, 0)
-        if len(path) > 1:
-            # parent is the queue whose path is path[:-1]; if none exists the
-            # queue is treated as a root child
-            for j, other in enumerate(queue_names):
-                if path_of[other] == path[:-1]:
-                    q_parent[i] = j
-                    break
+    parents, depths = queue_parent_depth(ci, queue_names)
+    q_parent[: len(parents)] = parents
+    q_depth[: len(depths)] = depths
 
     # ------------------------------------------------------------ namespaces
     ns_names = sorted(ci.namespaces) or ["default"]

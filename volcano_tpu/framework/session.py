@@ -105,8 +105,19 @@ class Session:
 
     # ------------------------------------------------------------- packing
     def repack(self) -> None:
-        """Re-flatten the cluster into device arrays (cache.Snapshot seam)."""
-        self.snap, self.maps = pack(self.cluster)
+        """Re-flatten the cluster into device arrays (cache.Snapshot seam).
+
+        Uses the native (C++) packer when the library is buildable — the
+        host-side hot path at scale — and the pure-Python packer otherwise
+        (they are equivalence-tested in tests/test_native_pack.py).  Set
+        VOLCANO_TPU_NO_NATIVE=1 to force the Python path.
+        """
+        import os
+        if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
+            self.snap, self.maps = pack(self.cluster)
+            return
+        from .. import native
+        self.snap, self.maps = native.pack_best_effort(self.cluster)
 
     def plugin(self, name: str):
         for p in self.plugins:

@@ -1,0 +1,554 @@
+// Native snapshot packer: VCS1 wire buffer -> dense scheduling arrays.
+//
+// This is the framework's native runtime component: the host-side hot path
+// that turns a serialized cluster snapshot (the payload that crosses the
+// API-layer boundary, SURVEY.md section 5.8) into the struct-of-array tensors
+// consumed by the compiled TPU cycle.  It mirrors, loop for loop, the
+// semantics of volcano_tpu/arrays/pack.py (which remains the pure-Python
+// fallback and the equivalence oracle in tests/test_native_pack.py); the
+// reference's equivalent moment is SchedulerCache.Snapshot deep-copying the
+// cluster mirror (pkg/scheduler/cache/cache.go:712-811).
+//
+// Wire format VCS1 (little-endian; see volcano_tpu/native/wire.py):
+//   u32 magic 'VCS1' (0x31534356), u32 R, nq, ns, nn, nj, nt
+//   R   x string            resource dimension names (informational)
+//   nq  x queue record      (sorted by name)
+//   ns  x namespace record  (sorted by name)
+//   nn  x node record       (sorted by name)
+//   nj  x job record        (sorted by uid)
+//   nt  x task record       (job-major, insertion order within job)
+// Strings are u32 length + UTF-8 bytes.  Label/taint/selector/toleration
+// sets are carried as precomputed 31-bit hashes (arrays/labels.py encoding).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <numeric>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31534356u;  // "VCS1"
+
+// TaskStatus codes (volcano_tpu/api/types.py:14-36; reference
+// pkg/scheduler/api/types.go:29-96).
+constexpr int32_t kStatusPending = 0;
+inline bool CountsForRequest(int32_t status) {
+  // Pending or AllocatedStatus (Allocated/Binding/Bound/Running).
+  return status == 0 || status == 1 || status == 3 || status == 4 ||
+         status == 5;
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  bool Need(size_t n) {
+    if (!ok || static_cast<size_t>(end - p) < n) {
+      ok = false;
+      return false;
+    }
+    return true;
+  }
+  uint32_t U32() {
+    if (!Need(4)) return 0;
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  int32_t I32() { return static_cast<int32_t>(U32()); }
+  uint8_t U8() {
+    if (!Need(1)) return 0;
+    return *p++;
+  }
+  float F32() {
+    if (!Need(4)) return 0;
+    float v;
+    std::memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  double F64() {
+    if (!Need(8)) return 0;
+    double v;
+    std::memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  void Skip(size_t n) {
+    if (Need(n)) p += n;
+  }
+  void SkipString() { Skip(U32()); }
+  void F32Vec(float* dst, uint32_t n) {
+    if (!Need(4ull * n)) return;
+    std::memcpy(dst, p, 4ull * n);
+    p += 4ull * n;
+  }
+  void I32Vec(int32_t* dst, uint32_t n) {
+    if (!Need(4ull * n)) return;
+    std::memcpy(dst, p, 4ull * n);
+    p += 4ull * n;
+  }
+};
+
+int32_t Bucket(int64_t n, int32_t minimum) {
+  int64_t b = minimum;
+  while (b < n) b *= 2;
+  return static_cast<int32_t>(b);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pointers are malloc'd by vc_pack and released by vc_free.  Row-major.
+struct VCArrays {
+  // Bucketed dims and real counts.
+  int32_t R, Q, S, N, J, T, M, L, E, K, O;
+  int32_t nq, ns, nn, nj, nt;
+  // Queues.
+  float* q_weight;
+  float* q_cap;
+  uint8_t* q_reclaimable;
+  uint8_t* q_open;
+  float* q_allocated;
+  float* q_request;
+  float* q_inqueue_minres;
+  int32_t* q_parent;
+  int32_t* q_depth;
+  uint8_t* q_valid;
+  float* ns_weight;
+  // Nodes.
+  float* n_idle;
+  float* n_used;
+  float* n_releasing;
+  float* n_pipelined;
+  float* n_allocatable;
+  float* n_capability;
+  int32_t* n_labels;
+  int32_t* n_taint_kv;
+  int32_t* n_taint_key;
+  int32_t* n_taint_effect;
+  int32_t* n_pod_count;
+  int32_t* n_max_pods;
+  uint8_t* n_schedulable;
+  uint8_t* n_valid;
+  // Tasks.
+  float* t_resreq;
+  int32_t* t_job;
+  int32_t* t_status;
+  int32_t* t_priority;
+  int32_t* t_node;
+  int32_t* t_selector;
+  int32_t* t_tol_hash;
+  int32_t* t_tol_effect;
+  int32_t* t_tol_mode;
+  uint8_t* t_best_effort;
+  uint8_t* t_preemptable;
+  uint8_t* t_valid;
+  // Jobs.
+  int32_t* j_min_available;
+  int32_t* j_queue;
+  int32_t* j_namespace;
+  int32_t* j_priority;
+  int32_t* j_creation_rank;
+  int32_t* j_ready_num;
+  float* j_allocated;
+  float* j_total_request;
+  float* j_min_resources;
+  int32_t* j_task_table;
+  int32_t* j_n_pending;
+  uint8_t* j_schedulable;
+  uint8_t* j_inqueue;
+  uint8_t* j_pending_phase;
+  uint8_t* j_preemptable;
+  uint8_t* j_valid;
+  float* cluster_capacity;
+  const char* error;  // static string; NULL on success
+};
+
+void vc_free(VCArrays* a) {
+  if (!a) return;
+  float** fptrs[] = {&a->q_weight,        &a->q_cap,
+                     &a->q_allocated,     &a->q_request,
+                     &a->q_inqueue_minres, &a->ns_weight,
+                     &a->n_idle,          &a->n_used,
+                     &a->n_releasing,     &a->n_pipelined,
+                     &a->n_allocatable,   &a->n_capability,
+                     &a->t_resreq,        &a->j_allocated,
+                     &a->j_total_request, &a->j_min_resources,
+                     &a->cluster_capacity};
+  for (auto** f : fptrs) {
+    std::free(*f);
+    *f = nullptr;
+  }
+  int32_t** iptrs[] = {&a->q_parent,    &a->q_depth,       &a->n_labels,
+                       &a->n_taint_kv,  &a->n_taint_key,   &a->n_taint_effect,
+                       &a->n_pod_count, &a->n_max_pods,    &a->t_job,
+                       &a->t_status,    &a->t_priority,    &a->t_node,
+                       &a->t_selector,  &a->t_tol_hash,    &a->t_tol_effect,
+                       &a->t_tol_mode,  &a->j_min_available, &a->j_queue,
+                       &a->j_namespace, &a->j_priority,    &a->j_creation_rank,
+                       &a->j_ready_num, &a->j_task_table,  &a->j_n_pending};
+  for (auto** i : iptrs) {
+    std::free(*i);
+    *i = nullptr;
+  }
+  uint8_t** bptrs[] = {&a->q_reclaimable, &a->q_open,        &a->q_valid,
+                       &a->n_schedulable, &a->n_valid,       &a->t_best_effort,
+                       &a->t_preemptable, &a->t_valid,       &a->j_schedulable,
+                       &a->j_inqueue,     &a->j_pending_phase,
+                       &a->j_preemptable, &a->j_valid};
+  for (auto** b : bptrs) {
+    std::free(*b);
+    *b = nullptr;
+  }
+}
+
+int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
+  std::memset(a, 0, sizeof(*a));
+  Reader r{buf, buf + len};
+  if (r.U32() != kMagic) {
+    a->error = "bad magic (not a VCS1 buffer)";
+    return 1;
+  }
+  const uint32_t R = r.U32();
+  const uint32_t nq = r.U32(), ns = r.U32(), nn = r.U32(), nj = r.U32(),
+                 nt = r.U32();
+  if (!r.ok || R == 0 || R > 1024) {
+    a->error = "corrupt header";
+    return 1;
+  }
+  // Sanity-bound every count against the bytes actually present before any
+  // allocation sized by it: a crafted header must fail as ValueError on the
+  // Python side, never as bad_alloc/OOM in here.  Minimum record sizes:
+  // queue 4+4+4R+2+8, namespace 4+4, node 4+24R+8+1+8, job 4+16+8+4+8R+3,
+  // task 4+4+4R+12+2+8.
+  const uint64_t remaining = static_cast<uint64_t>(r.end - r.p);
+  const uint64_t min_bytes = uint64_t(nq) * (18 + 4ull * R) + uint64_t(ns) * 8 +
+                             uint64_t(nn) * (13 + 24ull * R) +
+                             uint64_t(nj) * (35 + 8ull * R) +
+                             uint64_t(nt) * (30 + 4ull * R);
+  if (min_bytes > remaining) {
+    a->error = "corrupt header: counts exceed buffer size";
+    return 1;
+  }
+  for (uint32_t i = 0; i < R; ++i) r.SkipString();
+
+  const float inf = std::numeric_limits<float>::infinity();
+  const int32_t Q = Bucket(std::max<int64_t>(nq, 1), 4);
+  const int32_t S = Bucket(std::max<int64_t>(ns, 1), 4);
+  const int32_t N = Bucket(std::max<int64_t>(nn, 1), 8);
+  const int32_t J = Bucket(std::max<int64_t>(nj, 1), 4);
+  const int32_t T = Bucket(std::max<int64_t>(nt, 1), 8);
+
+  bool oom = false;
+  auto fmalloc = [&oom](int64_t n) {
+    auto* p = static_cast<float*>(std::calloc(std::max<int64_t>(n, 1), 4));
+    if (!p) oom = true;
+    return p;
+  };
+  auto imalloc = [&oom](int64_t n) {
+    auto* p = static_cast<int32_t*>(std::calloc(std::max<int64_t>(n, 1), 4));
+    if (!p) oom = true;
+    return p;
+  };
+  auto bmalloc = [&oom](int64_t n) {
+    auto* p = static_cast<uint8_t*>(std::calloc(std::max<int64_t>(n, 1), 1));
+    if (!p) oom = true;
+    return p;
+  };
+#define VC_CHECK_ALLOC()            \
+  if (oom) {                        \
+    a->error = "allocation failed"; \
+    return 1;                       \
+  }
+
+  a->R = R;
+  a->Q = Q;
+  a->S = S;
+  a->N = N;
+  a->J = J;
+  a->T = T;
+  a->nq = nq;
+  a->ns = ns;
+  a->nn = nn;
+  a->nj = nj;
+  a->nt = nt;
+
+  // ------------------------------------------------------------- queues
+  a->q_weight = fmalloc(Q);
+  a->q_cap = fmalloc(int64_t(Q) * R);
+  for (int64_t i = 0; i < int64_t(Q) * R; ++i) a->q_cap[i] = inf;
+  a->q_reclaimable = bmalloc(Q);
+  a->q_open = bmalloc(Q);
+  a->q_allocated = fmalloc(int64_t(Q) * R);
+  a->q_request = fmalloc(int64_t(Q) * R);
+  a->q_inqueue_minres = fmalloc(int64_t(Q) * R);
+  a->q_parent = imalloc(Q);
+  a->q_depth = imalloc(Q);
+  a->q_valid = bmalloc(Q);
+  VC_CHECK_ALLOC();
+  for (int32_t i = 0; i < Q; ++i) a->q_parent[i] = -1;
+  for (uint32_t i = 0; i < nq; ++i) {
+    r.SkipString();
+    a->q_weight[i] = std::max(r.F32(), 0.0f);
+    r.F32Vec(a->q_cap + int64_t(i) * R, R);
+    a->q_reclaimable[i] = r.U8();
+    a->q_open[i] = r.U8();
+    a->q_parent[i] = r.I32();
+    a->q_depth[i] = r.I32();
+    a->q_valid[i] = 1;
+  }
+
+  // --------------------------------------------------------- namespaces
+  a->ns_weight = fmalloc(S);
+  for (int32_t i = 0; i < S; ++i) a->ns_weight[i] = 1.0f;
+  for (uint32_t i = 0; i < ns; ++i) {
+    r.SkipString();
+    a->ns_weight[i] = std::max(r.F32(), 1.0f);
+  }
+
+  // -------------------------------------------------------------- nodes
+  a->n_idle = fmalloc(int64_t(N) * R);
+  a->n_used = fmalloc(int64_t(N) * R);
+  a->n_releasing = fmalloc(int64_t(N) * R);
+  a->n_pipelined = fmalloc(int64_t(N) * R);
+  a->n_allocatable = fmalloc(int64_t(N) * R);
+  a->n_capability = fmalloc(int64_t(N) * R);
+  a->n_pod_count = imalloc(N);
+  a->n_max_pods = imalloc(N);
+  a->n_schedulable = bmalloc(N);
+  a->n_valid = bmalloc(N);
+  VC_CHECK_ALLOC();
+  // Two passes over variable-width label/taint sets would complicate the
+  // reader; instead collect into vectors, then pad to the max width.
+  std::vector<std::vector<int32_t>> labels(nn), tkv(nn), tkey(nn), teff(nn);
+  for (uint32_t i = 0; i < nn; ++i) {
+    r.SkipString();
+    r.F32Vec(a->n_idle + int64_t(i) * R, R);
+    r.F32Vec(a->n_used + int64_t(i) * R, R);
+    r.F32Vec(a->n_releasing + int64_t(i) * R, R);
+    r.F32Vec(a->n_pipelined + int64_t(i) * R, R);
+    r.F32Vec(a->n_allocatable + int64_t(i) * R, R);
+    r.F32Vec(a->n_capability + int64_t(i) * R, R);
+    a->n_pod_count[i] = r.I32();
+    a->n_max_pods[i] = r.I32();
+    a->n_schedulable[i] = r.U8();
+    a->n_valid[i] = 1;
+    uint32_t nl = r.U32();
+    if (!r.Need(4ull * nl)) break;
+    labels[i].resize(nl);
+    r.I32Vec(labels[i].data(), nl);
+    uint32_t ntn = r.U32();
+    if (!r.Need(12ull * ntn)) break;
+    tkv[i].resize(ntn);
+    tkey[i].resize(ntn);
+    teff[i].resize(ntn);
+    for (uint32_t t = 0; t < ntn; ++t) {
+      tkv[i][t] = r.I32();
+      tkey[i][t] = r.I32();
+      teff[i][t] = r.I32();
+    }
+  }
+  size_t maxl = 0, maxe = 0;
+  for (auto& v : labels) maxl = std::max(maxl, v.size());
+  for (auto& v : tkv) maxe = std::max(maxe, v.size());
+  const int32_t L = std::max<int32_t>(static_cast<int32_t>(maxl), 1);
+  const int32_t E = std::max<int32_t>(static_cast<int32_t>(maxe), 1);
+  a->L = L;
+  a->E = E;
+  a->n_labels = imalloc(int64_t(N) * L);
+  a->n_taint_kv = imalloc(int64_t(N) * E);
+  a->n_taint_key = imalloc(int64_t(N) * E);
+  a->n_taint_effect = imalloc(int64_t(N) * E);
+  VC_CHECK_ALLOC();
+  for (uint32_t i = 0; i < nn; ++i) {
+    std::copy(labels[i].begin(), labels[i].end(), a->n_labels + int64_t(i) * L);
+    std::copy(tkv[i].begin(), tkv[i].end(), a->n_taint_kv + int64_t(i) * E);
+    std::copy(tkey[i].begin(), tkey[i].end(), a->n_taint_key + int64_t(i) * E);
+    std::copy(teff[i].begin(), teff[i].end(),
+              a->n_taint_effect + int64_t(i) * E);
+  }
+
+  // --------------------------------------------------------------- jobs
+  a->j_min_available = imalloc(J);
+  a->j_queue = imalloc(J);
+  a->j_namespace = imalloc(J);
+  a->j_priority = imalloc(J);
+  a->j_creation_rank = imalloc(J);
+  a->j_ready_num = imalloc(J);
+  a->j_allocated = fmalloc(int64_t(J) * R);
+  a->j_total_request = fmalloc(int64_t(J) * R);
+  a->j_min_resources = fmalloc(int64_t(J) * R);
+  a->j_n_pending = imalloc(J);
+  a->j_schedulable = bmalloc(J);
+  a->j_inqueue = bmalloc(J);
+  a->j_pending_phase = bmalloc(J);
+  a->j_preemptable = bmalloc(J);
+  a->j_valid = bmalloc(J);
+  VC_CHECK_ALLOC();
+  std::vector<int32_t> job_queue_raw(nj, -1);
+  std::vector<double> job_ts(nj, 0.0);
+  std::vector<uint8_t> job_gang_valid(nj, 0);
+  for (uint32_t i = 0; i < nj; ++i) {
+    r.SkipString();
+    a->j_min_available[i] = r.I32();
+    job_queue_raw[i] = r.I32();
+    a->j_namespace[i] = r.I32();
+    a->j_priority[i] = r.I32();
+    job_ts[i] = r.F64();
+    a->j_ready_num[i] = r.I32();
+    r.F32Vec(a->j_allocated + int64_t(i) * R, R);
+    r.F32Vec(a->j_min_resources + int64_t(i) * R, R);
+    a->j_pending_phase[i] = r.U8();
+    job_gang_valid[i] = r.U8();
+    a->j_preemptable[i] = r.U8();
+    a->j_valid[i] = 1;
+    a->j_queue[i] = std::max(job_queue_raw[i], 0);
+    a->j_inqueue[i] = !a->j_pending_phase[i];
+    bool queue_open = job_queue_raw[i] >= 0 &&
+                      job_queue_raw[i] < static_cast<int32_t>(nq) &&
+                      a->q_open[job_queue_raw[i]];
+    a->j_schedulable[i] = job_gang_valid[i] && queue_open && a->j_inqueue[i];
+  }
+  // creation_rank: stable sort of uid-sorted jobs by creation timestamp
+  // (arrays/pack.py:239-240).
+  {
+    std::vector<int32_t> idx(nj);
+    std::iota(idx.begin(), idx.end(), 0);
+    std::stable_sort(idx.begin(), idx.end(), [&](int32_t x, int32_t y) {
+      return job_ts[x] < job_ts[y];
+    });
+    for (uint32_t rk = 0; rk < nj; ++rk) a->j_creation_rank[idx[rk]] = rk;
+  }
+
+  // -------------------------------------------------------------- tasks
+  a->t_resreq = fmalloc(int64_t(T) * R);
+  a->t_job = imalloc(T);
+  a->t_status = imalloc(T);
+  a->t_priority = imalloc(T);
+  a->t_node = imalloc(T);
+  a->t_best_effort = bmalloc(T);
+  a->t_preemptable = bmalloc(T);
+  a->t_valid = bmalloc(T);
+  VC_CHECK_ALLOC();
+  for (int32_t i = 0; i < T; ++i) {
+    a->t_job[i] = -1;
+    a->t_node[i] = -1;
+  }
+  std::vector<std::vector<int32_t>> sel(nt), tolh(nt), tole(nt), tolm(nt);
+  std::vector<std::vector<int32_t>> pending(nj);
+  for (uint32_t i = 0; i < nt; ++i) {
+    r.SkipString();
+    a->t_job[i] = r.I32();
+    r.F32Vec(a->t_resreq + int64_t(i) * R, R);
+    a->t_status[i] = r.I32();
+    a->t_priority[i] = r.I32();
+    a->t_node[i] = r.I32();
+    a->t_best_effort[i] = r.U8();
+    a->t_preemptable[i] = r.U8();
+    a->t_valid[i] = 1;
+    uint32_t nsel = r.U32();
+    if (!r.Need(4ull * nsel)) break;
+    sel[i].resize(nsel);
+    r.I32Vec(sel[i].data(), nsel);
+    uint32_t ntol = r.U32();
+    if (!r.Need(12ull * ntol)) break;
+    tolh[i].resize(ntol);
+    tole[i].resize(ntol);
+    tolm[i].resize(ntol);
+    for (uint32_t t = 0; t < ntol; ++t) {
+      tolh[i][t] = r.I32();
+      tole[i][t] = r.I32();
+      tolm[i][t] = r.I32();
+    }
+    const int32_t ji = a->t_job[i];
+    if (ji >= 0 && ji < static_cast<int32_t>(nj)) {
+      if (a->t_status[i] == kStatusPending) pending[ji].push_back(i);
+      if (CountsForRequest(a->t_status[i])) {
+        float* req = a->j_total_request + int64_t(ji) * R;
+        const float* res = a->t_resreq + int64_t(i) * R;
+        for (uint32_t d = 0; d < R; ++d) req[d] += res[d];
+      }
+    }
+  }
+  if (!r.ok) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  size_t maxk = 0, maxo = 0;
+  for (auto& v : sel) maxk = std::max(maxk, v.size());
+  for (auto& v : tolh) maxo = std::max(maxo, v.size());
+  const int32_t K = std::max<int32_t>(static_cast<int32_t>(maxk), 1);
+  const int32_t O = std::max<int32_t>(static_cast<int32_t>(maxo), 1);
+  a->K = K;
+  a->O = O;
+  a->t_selector = imalloc(int64_t(T) * K);
+  a->t_tol_hash = imalloc(int64_t(T) * O);
+  a->t_tol_effect = imalloc(int64_t(T) * O);
+  a->t_tol_mode = imalloc(int64_t(T) * O);
+  VC_CHECK_ALLOC();
+  for (uint32_t i = 0; i < nt; ++i) {
+    std::copy(sel[i].begin(), sel[i].end(), a->t_selector + int64_t(i) * K);
+    std::copy(tolh[i].begin(), tolh[i].end(), a->t_tol_hash + int64_t(i) * O);
+    std::copy(tole[i].begin(), tole[i].end(),
+              a->t_tol_effect + int64_t(i) * O);
+    std::copy(tolm[i].begin(), tolm[i].end(), a->t_tol_mode + int64_t(i) * O);
+  }
+
+  // Pending-task tables: task order = priority desc, insertion order
+  // (arrays/pack.py:262-265; reference priority plugin TaskOrderFn).
+  size_t maxp = 0;
+  for (auto& p : pending) maxp = std::max(maxp, p.size());
+  const int32_t M = Bucket(static_cast<int64_t>(std::max<size_t>(maxp, 0)), 4);
+  a->M = M;
+  a->j_task_table = imalloc(int64_t(J) * M);
+  VC_CHECK_ALLOC();
+  for (int64_t i = 0; i < int64_t(J) * M; ++i) a->j_task_table[i] = -1;
+  for (uint32_t ji = 0; ji < nj; ++ji) {
+    auto& p = pending[ji];
+    std::stable_sort(p.begin(), p.end(), [&](int32_t x, int32_t y) {
+      if (a->t_priority[x] != a->t_priority[y])
+        return a->t_priority[x] > a->t_priority[y];
+      return x < y;
+    });
+    a->j_n_pending[ji] = static_cast<int32_t>(p.size());
+    std::copy(p.begin(), p.end(), a->j_task_table + int64_t(ji) * M);
+  }
+
+  // Queue aggregates over member jobs (arrays/pack.py:291-303; reference
+  // proportion.OnSessionOpen, proportion.go:95-139).  Jobs whose queue was
+  // unknown to the serializer (raw index -1) are skipped.
+  for (uint32_t ji = 0; ji < nj; ++ji) {
+    const int32_t qi = job_queue_raw[ji];
+    if (qi < 0 || qi >= static_cast<int32_t>(nq)) continue;
+    for (uint32_t d = 0; d < R; ++d) {
+      a->q_allocated[int64_t(qi) * R + d] += a->j_allocated[int64_t(ji) * R + d];
+      a->q_request[int64_t(qi) * R + d] +=
+          a->j_total_request[int64_t(ji) * R + d];
+      if (a->j_inqueue[ji])
+        a->q_inqueue_minres[int64_t(qi) * R + d] +=
+            a->j_min_resources[int64_t(ji) * R + d];
+    }
+  }
+
+  a->cluster_capacity = fmalloc(R);
+  VC_CHECK_ALLOC();
+  for (uint32_t i = 0; i < nn; ++i)
+    for (uint32_t d = 0; d < R; ++d)
+      a->cluster_capacity[d] += a->n_allocatable[int64_t(i) * R + d];
+
+  if (!r.ok) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,154 @@
+"""VCS1 binary snapshot wire format — serializer side.
+
+The snapshot payload that crosses the API-layer boundary (SURVEY.md
+section 5.8: cluster state serialized to the scheduling sidecar, decisions
+returned).  ``serialize(ci)`` flattens a :class:`ClusterInfo` into one
+little-endian buffer that the native packer (packer.cc) turns into dense
+arrays; the layout keeps every derived encoding decision (resource-dimension
+order, label/taint/toleration hash encodings, queue-hierarchy parent
+pointers) on the producer side so consumers are dumb and fast.
+
+Record layouts are documented at the top of packer.cc; this module is the
+single source of truth for producing them.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from ..api import ClusterInfo, PodGroupPhase, QueueState
+from ..arrays import labels as L
+from ..arrays.pack import (_toleration_rows, _vec, queue_capability_row,
+                           queue_parent_depth, resource_dims)
+from ..arrays.schema import IndexMaps
+
+MAGIC = 0x31534356  # "VCS1"
+
+_u32 = struct.Struct("<I").pack
+_i32 = struct.Struct("<i").pack
+_f32 = struct.Struct("<f").pack
+_f64 = struct.Struct("<d").pack
+
+
+def _s(out: List[bytes], s: str) -> None:
+    b = s.encode("utf-8")
+    out.append(_u32(len(b)))
+    out.append(b)
+
+
+def _fvec(out: List[bytes], vec) -> None:
+    out.append(vec.astype("<f4").tobytes())
+
+
+def _ivec(out: List[bytes], vals) -> None:
+    out.append(struct.pack(f"<{len(vals)}i", *vals) if vals else b"")
+
+
+def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
+    """ClusterInfo -> (VCS1 buffer, host-side decode maps)."""
+    dims = resource_dims(ci)
+    R = len(dims)
+    maps = IndexMaps(resource_names=dims)
+
+    queue_names = sorted(ci.queues)
+    node_names = sorted(ci.nodes)
+    job_uids = sorted(ci.jobs)
+    ns_names = sorted(ci.namespaces) or ["default"]
+    maps.queue_names = queue_names
+    maps.node_names = node_names
+    maps.job_uids = job_uids
+    maps.namespace_names = ns_names
+    maps.queue_index = {n: i for i, n in enumerate(queue_names)}
+    maps.node_index = {n: i for i, n in enumerate(node_names)}
+    maps.job_index = {u: i for i, u in enumerate(job_uids)}
+    ns_index = {n: i for i, n in enumerate(ns_names)}
+
+    task_count = sum(len(ci.jobs[u].tasks) for u in job_uids)
+
+    out: List[bytes] = [
+        _u32(MAGIC), _u32(R), _u32(len(queue_names)), _u32(len(ns_names)),
+        _u32(len(node_names)), _u32(len(job_uids)), _u32(task_count),
+    ]
+    for d in dims:
+        _s(out, d)
+
+    parents, depths = queue_parent_depth(ci, queue_names)
+    for i, name in enumerate(queue_names):
+        q = ci.queues[name]
+        _s(out, name)
+        out.append(_f32(max(q.weight, 0)))
+        _fvec(out, queue_capability_row(q, dims))
+        out.append(bytes([1 if q.reclaimable else 0,
+                          1 if q.state == QueueState.OPEN else 0]))
+        out.append(_i32(parents[i]))
+        out.append(_i32(depths[i]))
+
+    for name in ns_names:
+        _s(out, name)
+        w = ci.namespaces[name].weight if name in ci.namespaces else 1
+        out.append(_f32(max(w, 1)))
+
+    for name in node_names:
+        node = ci.nodes[name]
+        _s(out, name)
+        for res in (node.idle, node.used, node.releasing, node.pipelined,
+                    node.allocatable, node.capability):
+            _fvec(out, _vec(res, dims))
+        out.append(_i32(node.pod_count()))
+        out.append(_i32(node.max_pods))
+        out.append(bytes([1 if (node.ready and not node.unschedulable) else 0]))
+        lh = L.label_hashes(node.labels)
+        out.append(_u32(len(lh)))
+        _ivec(out, lh)
+        out.append(_u32(len(node.taints)))
+        for t in node.taints:
+            _ivec(out, [L.stable_hash(f"{t.key}={t.value}"),
+                        L.stable_hash(t.key), L.effect_code(t.effect)])
+
+    for uid in job_uids:
+        job = ci.jobs[uid]
+        _s(out, uid)
+        out.append(_i32(job.min_available))
+        out.append(_i32(maps.queue_index.get(job.queue, -1)))
+        out.append(_i32(ns_index.get(job.namespace, 0)))
+        out.append(_i32(job.priority))
+        out.append(_f64(job.creation_timestamp))
+        out.append(_i32(job.ready_task_num()))
+        _fvec(out, _vec(job.allocated, dims))
+        _fvec(out, _vec(job.min_resources, dims))
+        gang_valid, _ = job.is_valid()
+        out.append(bytes([
+            1 if job.pod_group_phase == PodGroupPhase.PENDING else 0,
+            1 if gang_valid else 0,
+            1 if job.preemptable else 0,
+        ]))
+
+    maps.task_uids = []
+    for ji, uid in enumerate(job_uids):
+        for task in ci.jobs[uid].tasks.values():
+            ti = len(maps.task_uids)
+            maps.task_uids.append(task.uid)
+            maps.task_index[task.uid] = ti
+            _s(out, task.uid)
+            out.append(_i32(ji))
+            _fvec(out, _vec(task.resreq, dims))
+            out.append(_i32(int(task.status)))
+            out.append(_i32(task.priority))
+            out.append(_i32(maps.node_index.get(task.node_name, -1)))
+            out.append(bytes([1 if task.best_effort else 0,
+                              1 if task.preemptable else 0]))
+            required = dict(task.node_selector)
+            for term in task.affinity_required:
+                required.update(term)
+            sel = sorted(L.stable_hash(f"{k}={v}") for k, v in required.items())
+            out.append(_u32(len(sel)))
+            _ivec(out, sel)
+            h, e, m = _toleration_rows(task.tolerations)
+            out.append(_u32(len(h)))
+            for hh, ee, mm in zip(h, e, m):
+                _ivec(out, [hh, ee, mm])
+
+    return b"".join(out), maps
