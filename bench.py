@@ -1,22 +1,26 @@
 #!/usr/bin/env python
 """Benchmark: compiled TPU scheduling cycle vs the sequential CPU reference.
 
-Measures the allocate pass (predicates + binpack/spread scoring + gang
-commit) on a synthetic snapshot shaped like BASELINE.md config #2
-(1k nodes / 10k tasks), and reports ONE JSON line:
+Measures the allocate pass (predicates + binpack scoring + gang commit) at
+the BASELINE.json north-star scale (10k nodes / 100k pending tasks) and
+reports ONE JSON line:
 
     {"metric": ..., "value": <tpu cycle ms>, "unit": "ms", "vs_baseline": <speedup>}
 
-vs_baseline is the speedup over the CPU path on the same snapshot with
-verified-identical bind decisions. The reference publishes no numbers
-(BASELINE.md) and no Go toolchain exists in this image, so the CPU baseline
-is runtime/cpu_reference.py — the same sequential predicate->score->argmax
-loop the Go scheduler runs per task (allocate.go:43-281), in vectorized
-numpy (one vector op over the node axis per predicate/score term, i.e. at
-least as fast as the Go loop's per-node work).
+vs_baseline is the speedup over the CPU path on the same snapshot. The
+reference publishes no numbers (BASELINE.md) and no Go toolchain exists in
+this image, so the CPU baseline is runtime/cpu_reference.py — the same
+sequential predicate->score->argmax loop the Go scheduler runs per task
+(allocate.go:43-281), vectorized over the node axis with numpy (at least as
+fast as the Go loop's per-node work).  The full-scale CPU run takes ~6.6
+minutes, so it was measured once and recorded in BENCH_BASELINE.json (with
+TPU decisions verified bit-identical at full scale at measurement time);
+every bench run still measures the CPU path live AND re-verifies decision
+equality at a 1k-node/10k-task sub-scale, reported in the stderr extras.
 
 Env knobs: BENCH_NODES, BENCH_JOBS, BENCH_TASKS_PER_JOB, BENCH_REPS,
-BENCH_SKIP_CPU=1 (report cached baseline ratio instead of measuring).
+BENCH_LIVE_CPU=1 (measure the CPU baseline at full scale instead of using
+BENCH_BASELINE.json), BENCH_SKIP_CHECK=1 (skip the sub-scale equality check).
 """
 
 from __future__ import annotations
@@ -30,12 +34,44 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+_BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BENCH_BASELINE.json")
+
+
+def _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs):
+    from __graft_entry__ import _synthetic_cluster
+    from volcano_tpu.arrays import pack
+    from volcano_tpu.ops.allocate_scan import AllocateConfig, AllocateExtras
+
+    ci = _synthetic_cluster(n_nodes=n_nodes, n_jobs=n_jobs,
+                            tasks_per_job=tasks_per_job)
+    snap, _maps = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    cfg = AllocateConfig(**cfg_kwargs)
+    return snap, extras, cfg
+
+
+def _time_tpu(fn, snap, extras, reps):
+    t0 = time.time()
+    result = fn(snap, extras)
+    result.task_node.block_until_ready()
+    compile_s = time.time() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        result = fn(snap, extras)
+        result.task_node.block_until_ready()
+        times.append(time.time() - t0)
+    return result, min(times) * 1000, compile_s
+
 
 def main():
-    n_nodes = int(os.environ.get("BENCH_NODES", 1024))
-    n_jobs = int(os.environ.get("BENCH_JOBS", 640))
+    n_nodes = int(os.environ.get("BENCH_NODES", 10000))
+    n_jobs = int(os.environ.get("BENCH_JOBS", 6250))
     tasks_per_job = int(os.environ.get("BENCH_TASKS_PER_JOB", 16))
     reps = int(os.environ.get("BENCH_REPS", 3))
+    cfg_kwargs = dict(binpack_weight=1.0, least_allocated_weight=0.0,
+                      balanced_weight=0.0, taint_prefer_weight=0.0)
 
     import jax
     # persistent compile cache: the cycle compiles once per shape bucket and
@@ -47,46 +83,52 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
-    from __graft_entry__ import _synthetic_cluster
-    from volcano_tpu.arrays import pack
-    from volcano_tpu.ops.allocate_scan import (AllocateConfig, AllocateExtras,
-                                               make_allocate_cycle)
+    from volcano_tpu.ops.allocate_scan import make_allocate_cycle
     from volcano_tpu.runtime.cpu_reference import allocate_cpu
 
-    ci = _synthetic_cluster(n_nodes=n_nodes, n_jobs=n_jobs,
-                            tasks_per_job=tasks_per_job)
-    snap, _maps = pack(ci)
-    extras = AllocateExtras.neutral(snap)
-    cfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
-                         balanced_weight=0.0, taint_prefer_weight=0.0)
-
+    snap, extras, cfg = _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs)
     fn = jax.jit(make_allocate_cycle(cfg))
-    t0 = time.time()
-    result = fn(snap, extras)
-    result.task_node.block_until_ready()
-    compile_s = time.time() - t0
-
-    times = []
-    for _ in range(reps):
-        t0 = time.time()
-        result = fn(snap, extras)
-        result.task_node.block_until_ready()
-        times.append(time.time() - t0)
-    tpu_ms = min(times) * 1000
-
+    result, tpu_ms, compile_s = _time_tpu(fn, snap, extras, reps)
     n_tasks = n_jobs * tasks_per_job
     placed = int(np.asarray(result.task_mode > 0).sum())
 
-    if os.environ.get("BENCH_SKIP_CPU"):
-        cpu_ms = float(os.environ.get("BENCH_CPU_MS", 0)) or tpu_ms
-        equal = None
-    else:
+    # ---- CPU baseline ----------------------------------------------------
+    recorded = None
+    if os.path.exists(_BASELINE_PATH):
+        with open(_BASELINE_PATH) as f:
+            recorded = json.load(f)
+    matches_recorded = bool(
+        recorded
+        and recorded["config"] == {"nodes": n_nodes, "jobs": n_jobs,
+                                   "tasks_per_job": tasks_per_job,
+                                   "binpack_weight": 1.0})
+    if os.environ.get("BENCH_LIVE_CPU") or not matches_recorded:
         t0 = time.time()
         cpu = allocate_cpu(snap, extras, cfg)
         cpu_ms = (time.time() - t0) * 1000
-        equal = bool(
+        equal_full = bool(
             np.array_equal(np.asarray(result.task_node), cpu["task_node"])
             and np.array_equal(np.asarray(result.task_mode), cpu["task_mode"]))
+        cpu_source = "measured"
+    else:
+        cpu_ms = float(recorded["cpu_ms"])
+        equal_full = None  # verified at measurement time; see sub-scale check
+        cpu_source = f"recorded {recorded['measured']} (BENCH_BASELINE.json)"
+
+    # ---- live sub-scale decision-equality + speedup check ----------------
+    equal_sub = sub_speedup = None
+    if not os.environ.get("BENCH_SKIP_CHECK"):
+        ssnap, sextras, scfg = _build(1024, 640, 16, cfg_kwargs)
+        sfn = jax.jit(make_allocate_cycle(scfg))
+        sresult, stpu_ms, _ = _time_tpu(sfn, ssnap, sextras, 3)
+        t0 = time.time()
+        scpu = allocate_cpu(ssnap, sextras, scfg)
+        scpu_ms = (time.time() - t0) * 1000
+        equal_sub = bool(
+            np.array_equal(np.asarray(sresult.task_node), scpu["task_node"])
+            and np.array_equal(np.asarray(sresult.task_mode),
+                               scpu["task_mode"]))
+        sub_speedup = round(scpu_ms / stpu_ms, 1)
 
     out = {
         "metric": f"schedule_cycle_ms_{n_nodes}nodes_{n_tasks}tasks",
@@ -96,9 +138,14 @@ def main():
     }
     extra = {
         "cpu_ms": round(cpu_ms, 1),
+        "cpu_source": cpu_source,
         "compile_s": round(compile_s, 1),
         "placed_tasks": placed,
-        "decisions_equal_cpu": equal,
+        "decisions_equal_cpu_full_scale": equal_full,
+        "decisions_equal_cpu_1024n_10240t": equal_sub,
+        "speedup_1024n_10240t": sub_speedup,
+        "sub_tpu_ms": round(stpu_ms, 3) if sub_speedup else None,
+        "sub_cpu_ms": round(scpu_ms, 1) if sub_speedup else None,
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))
