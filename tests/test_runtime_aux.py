@@ -1,0 +1,164 @@
+"""Leader election, dashboard, and standalone v* CLI binaries.
+
+Reference seams: client-go leaderelection (cmd/scheduler/app/server.go:
+100-148), cmd/dashboard/app/server.go:59-233, cmd/cli/v* entrypoints.
+"""
+
+import json
+import urllib.request
+
+from volcano_tpu.cli import vbin
+from volcano_tpu.runtime.dashboard import Dashboard, build_page, render_html
+from volcano_tpu.runtime.leader import Lease, LeaderElector
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def _system_with_job(tmp_path):
+    system = VolcanoSystem()
+    system.add_node("n0", cpu="8", memory="16Gi")
+    manifest = tmp_path / "job.yaml"
+    manifest.write_text("""
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata: {name: demo, namespace: default}
+spec:
+  minAvailable: 2
+  tasks:
+    - replicas: 2
+      name: worker
+      template:
+        spec:
+          containers:
+            - name: c
+              resources: {requests: {cpu: "1", memory: 1Gi}}
+""")
+    return system, manifest
+
+
+# ------------------------------------------------------------ leader election
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_leader_election_single_winner_and_failover():
+    api = VolcanoSystem().api
+    clock = FakeClock()
+    events = []
+    a = LeaderElector(api, identity="a", clock=clock,
+                      on_started_leading=lambda: events.append("a+"),
+                      on_stopped_leading=lambda: events.append("a-"))
+    b = LeaderElector(api, identity="b", clock=clock,
+                      on_started_leading=lambda: events.append("b+"))
+    assert a.tick() and a.is_leader
+    assert not b.tick() and not b.is_leader   # live lease blocks b
+    clock.now += 5
+    assert a.tick()                            # renew
+    # a dies; lease expires after lease_duration since last renew
+    clock.now += a.lease_duration + 0.1
+    assert b.tick() and b.is_leader            # failover
+    lease = api.get("leases", "volcano-system/vc-scheduler")
+    assert lease.holder == "b" and lease.transitions == 1
+    # a comes back, sees b's live lease, steps down
+    clock.now += 1
+    assert not a.tick() and not a.is_leader
+    assert events == ["a+", "b+", "a-"]
+
+
+def test_leader_release_hands_over_immediately():
+    api = VolcanoSystem().api
+    clock = FakeClock()
+    a = LeaderElector(api, identity="a", clock=clock)
+    b = LeaderElector(api, identity="b", clock=clock)
+    assert a.tick()
+    a.release()
+    assert not a.is_leader
+    assert b.tick() and b.is_leader            # no wait for expiry
+
+
+def test_lease_expiry_math():
+    lease = Lease(name="x", holder="a", renew_time=100.0, lease_duration=15.0)
+    assert not lease.expired(110.0)
+    assert lease.expired(115.0)
+
+
+# ----------------------------------------------------------------- dashboard
+def test_build_page_tables(tmp_path):
+    system, manifest = _system_with_job(tmp_path)
+    assert vbin.vsub(["-f", str(manifest)], system=system) == 0
+    system.tick()
+    page = build_page(system)
+    assert [r[1] for r in page.tables["jobs"]["rows"]] == ["demo"]
+    assert page.tables["jobs"]["headers"][0] == "Namespace"
+    assert len(page.tables["pods"]["rows"]) == 2
+    assert len(page.tables["nodes"]["rows"]) == 1
+    assert page.tables["podgroups"]["rows"][0][4] == 2  # MinMember
+    html = render_html(page)
+    assert "demo" in html and "<table>" in html
+
+
+def test_dashboard_page_cache_ttl(tmp_path):
+    system, manifest = _system_with_job(tmp_path)
+    dash = Dashboard(system, refresh_seconds=10)
+    p1 = dash.page(now=1000.0)
+    vbin.vsub(["-f", str(manifest)], system=system)
+    assert dash.page(now=1005.0) is p1          # cached
+    p2 = dash.page(now=1010.0)                  # TTL expired -> rebuilt
+    assert p2 is not p1
+    assert len(p2.tables["jobs"]["rows"]) == 1
+
+
+def test_dashboard_http_endpoints(tmp_path):
+    system, manifest = _system_with_job(tmp_path)
+    vbin.vsub(["-f", str(manifest)], system=system)
+    system.tick()
+    dash = Dashboard(system)
+    port = dash.serve(port=0)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+        page = json.loads(urllib.request.urlopen(f"{base}/api/page").read())
+        assert page["tables"]["jobs"]["rows"][0][1] == "demo"
+        html = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "volcano_tpu" in html
+        metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "volcano" in metrics
+        assert urllib.request.urlopen(f"{base}/nope").status == 404
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        dash.shutdown()
+
+
+# -------------------------------------------------------------- v* binaries
+def test_v_binaries_full_flow(tmp_path, capsys):
+    system, manifest = _system_with_job(tmp_path)
+    assert vbin.vsub(["-f", str(manifest)], system=system) == 0
+    system.tick()
+    assert vbin.vjobs([], system=system) == 0
+    out = capsys.readouterr().out
+    assert "demo" in out and "Running" in out
+    assert vbin.vqueues([], system=system) == 0
+    assert "default" in capsys.readouterr().out
+    assert vbin.vsuspend(["-N", "demo"], system=system) == 0
+    system.reconcile()
+    job = system.job("demo")
+    assert job.status.state.phase.value in ("Aborting", "Aborted")
+    system.reconcile()
+    assert vbin.vresume(["-N", "demo"], system=system) == 0
+    system.reconcile()
+    assert vbin.vcancel(["-N", "demo"], system=system) == 0
+    assert system.job("demo") is None
+    assert vbin.vcancel(["-N", "demo"], system=system) == 1  # already gone
+
+
+def test_v_binaries_state_file_mode(tmp_path):
+    state = tmp_path / "vc.pkl"
+    # First call creates the system; no nodes yet, so just reconcile.
+    _, manifest = _system_with_job(tmp_path)
+    assert vbin.vsub(["--state", str(state), "-f", str(manifest)]) == 0
+    assert state.exists()
+    assert vbin.vjobs(["--state", str(state)]) == 0

@@ -1,0 +1,97 @@
+"""Standalone single-purpose CLI binaries.
+
+Reference: cmd/cli/{vsub,vcancel,vjobs,vqueues,vsuspend,vresume}/main.go —
+thin entrypoints that each wrap one vcctl command so batch users get the
+familiar qsub-style verbs.  Each maps argv onto the corresponding vcctl
+subcommand and delegates to :func:`volcano_tpu.cli.vcctl.main`.
+
+Run as modules: ``python -m volcano_tpu.cli.vsub --state /tmp/vc.pkl -f job.yaml``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from . import vcctl
+
+
+def _run(argv_for_vcctl: List[str], system=None) -> int:
+    from ..webhooks import AdmissionError
+    try:
+        print(vcctl.main(argv_for_vcctl, system=system))
+        return 0
+    except (vcctl.VcctlError, AdmissionError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def _base_parser(prog: str, desc: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog, description=desc)
+    p.add_argument("--state", help="pickled VolcanoSystem state file")
+    return p
+
+
+def _state_args(args) -> List[str]:
+    return ["--state", args.state] if args.state else []
+
+
+def vsub(argv: Optional[List[str]] = None, system=None) -> int:
+    """Submit a job from a YAML manifest (reference cmd/cli/vsub)."""
+    p = _base_parser("vsub", "submit a volcano job")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("-q", "--queue", default="")
+    a = p.parse_args(argv)
+    cmd = _state_args(a) + ["job", "run", "-f", a.filename]
+    if a.queue:
+        cmd += ["-q", a.queue]
+    return _run(cmd, system)
+
+
+def vcancel(argv: Optional[List[str]] = None, system=None) -> int:
+    """Delete a job (reference cmd/cli/vcancel)."""
+    p = _base_parser("vcancel", "cancel (delete) a volcano job")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    a = p.parse_args(argv)
+    return _run(_state_args(a) + ["job", "delete", "-N", a.name,
+                                  "-n", a.namespace], system)
+
+
+def vjobs(argv: Optional[List[str]] = None, system=None) -> int:
+    """List jobs (reference cmd/cli/vjobs)."""
+    p = _base_parser("vjobs", "list volcano jobs")
+    p.add_argument("-n", "--namespace", default="")
+    a = p.parse_args(argv)
+    cmd = _state_args(a) + ["job", "list"]
+    if a.namespace:
+        cmd += ["-n", a.namespace]
+    return _run(cmd, system)
+
+
+def vqueues(argv: Optional[List[str]] = None, system=None) -> int:
+    """List queues (reference cmd/cli/vqueues)."""
+    p = _base_parser("vqueues", "list volcano queues")
+    a = p.parse_args(argv)
+    return _run(_state_args(a) + ["queue", "list"], system)
+
+
+def vsuspend(argv: Optional[List[str]] = None, system=None) -> int:
+    """Suspend a job via a bus AbortJob Command (reference cmd/cli/vsuspend)."""
+    p = _base_parser("vsuspend", "suspend a volcano job")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    a = p.parse_args(argv)
+    return _run(_state_args(a) + ["job", "suspend", "-N", a.name,
+                                  "-n", a.namespace], system)
+
+
+def vresume(argv: Optional[List[str]] = None, system=None) -> int:
+    """Resume a suspended job (reference cmd/cli/vresume)."""
+    p = _base_parser("vresume", "resume a volcano job")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    a = p.parse_args(argv)
+    return _run(_state_args(a) + ["job", "resume", "-N", a.name,
+                                  "-n", a.namespace], system)

@@ -1,0 +1,6 @@
+"""python -m volcano_tpu.cli.vcancel — see vbin.vcancel."""
+import sys
+from .vbin import vcancel
+
+if __name__ == "__main__":
+    sys.exit(vcancel())

@@ -1,0 +1,6 @@
+"""python -m volcano_tpu.cli.vjobs — see vbin.vjobs."""
+import sys
+from .vbin import vjobs
+
+if __name__ == "__main__":
+    sys.exit(vjobs())

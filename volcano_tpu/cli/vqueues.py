@@ -1,0 +1,6 @@
+"""python -m volcano_tpu.cli.vqueues — see vbin.vqueues."""
+import sys
+from .vbin import vqueues
+
+if __name__ == "__main__":
+    sys.exit(vqueues())

@@ -1,0 +1,6 @@
+"""python -m volcano_tpu.cli.vresume — see vbin.vresume."""
+import sys
+from .vbin import vresume
+
+if __name__ == "__main__":
+    sys.exit(vresume())

@@ -1,0 +1,6 @@
+"""python -m volcano_tpu.cli.vsub — see vbin.vsub."""
+import sys
+from .vbin import vsub
+
+if __name__ == "__main__":
+    sys.exit(vsub())

@@ -1,0 +1,6 @@
+"""python -m volcano_tpu.cli.vsuspend — see vbin.vsuspend."""
+import sys
+from .vbin import vsuspend
+
+if __name__ == "__main__":
+    sys.exit(vsuspend())

@@ -19,7 +19,8 @@ from ..api.node_info import NodeInfo
 from ..api.queue_info import QueueInfo
 
 KINDS = ("jobs", "pods", "podgroups", "queues", "nodes", "commands",
-         "pvcs", "secrets", "services", "configmaps")
+         "pvcs", "secrets", "services", "configmaps", "leases",
+         "numatopologies")
 
 
 class APIServer:

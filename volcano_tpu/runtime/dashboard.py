@@ -1,0 +1,174 @@
+"""Dashboard: HTTP UI over the control plane's object stores.
+
+Reference: cmd/dashboard/app/server.go:59-233 — an HTTP server that
+periodically polls cluster + volcano objects into a cached ``Page`` of
+tables (jobs, podgroups, queues, pods) behind a static frontend.  Here the
+page is built straight from the in-memory API server, cached with a TTL
+(the reference's poll interval), and served as server-rendered HTML plus a
+JSON API (``/api/page``), a Prometheus exposition passthrough
+(``/metrics``), and ``/healthz``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..metrics import METRICS
+
+DEFAULT_REFRESH_SECONDS = 5.0
+
+
+@dataclass
+class Page:
+    """One consistent snapshot of every dashboard table."""
+
+    built_at: float = 0.0
+    tables: Dict[str, Dict[str, List]] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps({"built_at": self.built_at, "tables": self.tables})
+
+
+def build_page(system, now: Optional[float] = None) -> Page:
+    """Poll the API server's stores into display tables."""
+    api = system.api
+    page = Page(built_at=now if now is not None else time.time())
+
+    jobs = []
+    for job in sorted(api.list("jobs"), key=lambda j: j.key):
+        s = job.status
+        jobs.append([job.namespace, job.name, job.queue,
+                     s.state.phase.value, job.min_available, s.pending,
+                     s.running, s.succeeded, s.failed, s.retry_count])
+    page.tables["jobs"] = {
+        "headers": ["Namespace", "Name", "Queue", "Phase", "MinAvailable",
+                    "Pending", "Running", "Succeeded", "Failed", "Retries"],
+        "rows": jobs}
+
+    pgs = []
+    for pg in sorted(api.list("podgroups"), key=lambda g: (g.namespace, g.name)):
+        pgs.append([pg.namespace, pg.name, pg.queue, pg.phase.value,
+                    pg.min_member, pg.running, pg.succeeded, pg.failed])
+    page.tables["podgroups"] = {
+        "headers": ["Namespace", "Name", "Queue", "Phase", "MinMember",
+                    "Running", "Succeeded", "Failed"],
+        "rows": pgs}
+
+    queues = []
+    for q in sorted(api.list("queues"), key=lambda q: q.name):
+        counts = {k.replace("status.", ""): v for k, v in q.annotations.items()
+                  if k.startswith("status.")}
+        queues.append([q.name, q.weight, q.state.value, q.reclaimable,
+                       json.dumps(counts) if counts else "-"])
+    page.tables["queues"] = {
+        "headers": ["Name", "Weight", "State", "Reclaimable", "PodGroups"],
+        "rows": queues}
+
+    pods = []
+    for p in sorted(api.list("pods"), key=lambda p: (p.namespace, p.name)):
+        pods.append([p.namespace, p.name, str(p.phase), p.node_name or "-"])
+    page.tables["pods"] = {
+        "headers": ["Namespace", "Name", "Phase", "Node"],
+        "rows": pods}
+
+    nodes = []
+    for n in sorted(api.list("nodes"), key=lambda n: n.name):
+        nodes.append([n.name,
+                      f"{n.idle.get('cpu') / 1000:g}/{n.allocatable.get('cpu') / 1000:g}",
+                      f"{n.idle.get('memory') / 2**30:.1f}Gi/"
+                      f"{n.allocatable.get('memory') / 2**30:.1f}Gi",
+                      len(n.tasks), "Ready" if n.ready else "NotReady"])
+    page.tables["nodes"] = {
+        "headers": ["Name", "CPU idle/alloc", "Mem idle/alloc", "Pods",
+                    "Status"],
+        "rows": nodes}
+    return page
+
+
+def render_html(page: Page) -> str:
+    parts = ["<!doctype html><html><head><title>volcano_tpu dashboard</title>",
+             "<style>body{font-family:sans-serif;margin:2em}"
+             "table{border-collapse:collapse;margin-bottom:2em}"
+             "th,td{border:1px solid #999;padding:4px 10px;text-align:left}"
+             "th{background:#eee}h2{margin-bottom:.3em}</style></head><body>",
+             "<h1>volcano_tpu</h1>",
+             f"<p>page built {time.strftime('%H:%M:%S', time.localtime(page.built_at))}"
+             " &middot; auto-refresh 5s <script>setTimeout(()=>location.reload(),5000)"
+             "</script></p>"]
+    for name, tbl in page.tables.items():
+        parts.append(f"<h2>{name}</h2><table><tr>")
+        parts.extend(f"<th>{h}</th>" for h in tbl["headers"])
+        parts.append("</tr>")
+        for row in tbl["rows"]:
+            parts.append("<tr>" + "".join(f"<td>{c}</td>" for c in row)
+                         + "</tr>")
+        parts.append("</table>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+class Dashboard:
+    """Cached-page dashboard server over a VolcanoSystem."""
+
+    def __init__(self, system, refresh_seconds: float = DEFAULT_REFRESH_SECONDS):
+        self.system = system
+        self.refresh_seconds = refresh_seconds
+        self._page: Optional[Page] = None
+        self._lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def page(self, now: Optional[float] = None) -> Page:
+        """The cached page, rebuilt when older than refresh_seconds."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            if (self._page is None
+                    or now - self._page.built_at >= self.refresh_seconds):
+                self._page = build_page(self.system, now=now)
+            return self._page
+
+    # ------------------------------------------------------------- serving
+    def serve(self, host: str = "127.0.0.1", port: int = 8080) -> int:
+        """Start serving in a daemon thread; returns the bound port."""
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, body: str, ctype: str, code: int = 200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send("ok", "text/plain")
+                elif self.path == "/metrics":
+                    self._send(METRICS.exposition(), "text/plain")
+                elif self.path == "/api/page":
+                    self._send(dashboard.page().to_json(), "application/json")
+                elif self.path in ("/", "/index.html"):
+                    self._send(render_html(dashboard.page()), "text/html")
+                else:
+                    self._send("not found", "text/plain", 404)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._server.server_address[1]
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

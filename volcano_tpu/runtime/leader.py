@@ -1,0 +1,129 @@
+"""Lease-based leader election for HA scheduler / controller-manager.
+
+Reference: both vc-scheduler and vc-controller-manager run leader-elected
+against a coordination lease so only one replica acts at a time
+(cmd/scheduler/app/server.go:100-148, cmd/controller-manager/app/
+server.go:78-120, client-go leaderelection).  Here the lock object lives in
+the in-memory API server's ``leases`` store; replicas call :meth:`tick`
+periodically (the retry loop) and consult :attr:`is_leader` before running
+their cycle.  Timing is injectable so tests drive expiry deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+#: client-go defaults used by the reference binaries.
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 5.0
+
+
+@dataclass
+class Lease:
+    """A coordination.k8s.io/Lease-shaped lock record."""
+
+    name: str
+    namespace: str = "volcano-system"
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    transitions: int = 0
+
+    def expired(self, now: float) -> bool:
+        return now >= self.renew_time + self.lease_duration
+
+
+@dataclass
+class LeaderElector:
+    """One replica's view of an election.
+
+    Usage::
+
+        el = LeaderElector(api, identity="scheduler-0", lock_name="vc-scheduler")
+        while True:
+            el.tick()
+            if el.is_leader:
+                run_cycle()
+            sleep(el.retry_period)
+    """
+
+    api: object
+    identity: str
+    lock_name: str = "vc-scheduler"
+    namespace: str = "volcano-system"
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    renew_deadline: float = DEFAULT_RENEW_DEADLINE
+    retry_period: float = DEFAULT_RETRY_PERIOD
+    on_started_leading: Optional[Callable[[], None]] = None
+    on_stopped_leading: Optional[Callable[[], None]] = None
+    clock: Callable[[], float] = time.time
+    is_leader: bool = field(default=False, init=False)
+    _last_renew: float = field(default=0.0, init=False)
+
+    @property
+    def _key(self) -> str:
+        return f"{self.namespace}/{self.lock_name}"
+
+    def _lease(self) -> Optional[Lease]:
+        return self.api.get("leases", self._key)
+
+    def tick(self) -> bool:
+        """Try to acquire or renew the lease; returns is_leader."""
+        now = self.clock()
+        lease = self._lease()
+        if lease is None:
+            lease = Lease(name=self.lock_name, namespace=self.namespace,
+                          holder=self.identity, acquire_time=now,
+                          renew_time=now, lease_duration=self.lease_duration)
+            self.api.create("leases", lease)
+            self._become_leader(now)
+            return True
+        if lease.holder == self.identity:
+            # Renew; if we could not renew within renew_deadline we must
+            # step down even though no one else took the lock yet.
+            if self.is_leader and now - self._last_renew > self.renew_deadline:
+                self._step_down()
+                return False
+            lease.renew_time = now
+            self.api.update("leases", lease)
+            if not self.is_leader:
+                self._become_leader(now)
+            self._last_renew = now
+            return True
+        if lease.expired(now):
+            lease.holder = self.identity
+            lease.acquire_time = now
+            lease.renew_time = now
+            lease.transitions += 1
+            self.api.update("leases", lease)
+            self._become_leader(now)
+            return True
+        if self.is_leader:
+            # someone else holds a live lease (we lost it)
+            self._step_down()
+        return False
+
+    def release(self) -> None:
+        """Voluntary step-down (graceful shutdown releases the lock)."""
+        lease = self._lease()
+        if lease is not None and lease.holder == self.identity:
+            lease.holder = ""
+            lease.renew_time = 0.0
+            self.api.update("leases", lease)
+        if self.is_leader:
+            self._step_down()
+
+    def _become_leader(self, now: float) -> None:
+        self.is_leader = True
+        self._last_renew = now
+        if self.on_started_leading:
+            self.on_started_leading()
+
+    def _step_down(self) -> None:
+        self.is_leader = False
+        if self.on_stopped_leading:
+            self.on_stopped_leading()
