@@ -1,0 +1,204 @@
+"""Shared-GPU scheduling tests.
+
+Reference semantics under test: GPUDevice accounting (pkg/scheduler/api/
+device_info.go:24-62, node_info.go:171-195,365-415) and the GPU-sharing
+predicate — a task requesting `volcano.sh/gpu-memory` must fit on ONE card,
+not in the node's aggregate GPU memory (pkg/scheduler/plugins/predicates/
+gpu.go:27-56).
+"""
+
+import numpy as np
+import jax
+
+from volcano_tpu.api import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE,
+                             QueueInfo, TaskStatus)
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops import AllocateConfig, MODE_ALLOCATED, make_allocate_cycle
+from volcano_tpu.ops import predicates as P
+from volcano_tpu.ops.allocate_scan import AllocateExtras
+from volcano_tpu.runtime.cpu_reference import allocate_cpu
+
+from fixtures import build_job, build_node, build_task, simple_cluster
+
+
+def gpu_node(name, cards=2, mem_per_card=8, cpu="16", memory="64Gi"):
+    return build_node(name, cpu=cpu, memory=memory,
+                      scalars={GPU_MEMORY_RESOURCE: cards * mem_per_card,
+                               GPU_NUMBER_RESOURCE: cards})
+
+
+def gpu_task(name, gpu_mem, cpu="1", memory="1Gi"):
+    return build_task(name, cpu=cpu, memory=memory,
+                      scalars={GPU_MEMORY_RESOURCE: gpu_mem})
+
+
+class TestGPUDeviceModel:
+    def test_devices_built_from_capacity(self):
+        """setNodeGPUInfo splits total memory evenly across cards
+        (node_info.go:171-195)."""
+        n = gpu_node("g0", cards=4, mem_per_card=8)
+        assert len(n.gpu_devices) == 4
+        assert all(d.memory == 8 for d in n.gpu_devices)
+
+    def test_add_remove_task_charges_card(self):
+        n = gpu_node("g0", cards=2, mem_per_card=8)
+        t = gpu_task("t0", gpu_mem=6)
+        t.gpu_index = 1
+        t.status = TaskStatus.RUNNING
+        n.add_task(t)
+        assert n.gpu_devices[1].used_memory() == 6
+        assert n.idle_gpu_memory() == [8, 2]
+        n.remove_task(t)
+        assert n.idle_gpu_memory() == [8, 8]
+
+    def test_predicate_gpu_picks_lowest_fitting_card(self):
+        n = gpu_node("g0", cards=2, mem_per_card=8)
+        filler = gpu_task("f", gpu_mem=5)
+        filler.gpu_index = 0
+        filler.status = TaskStatus.RUNNING
+        n.add_task(filler)
+        assert n.predicate_gpu(gpu_task("a", gpu_mem=3)) == 0   # still fits 0
+        assert n.predicate_gpu(gpu_task("b", gpu_mem=4)) == 1   # spills to 1
+        assert n.predicate_gpu(gpu_task("c", gpu_mem=9)) == -1  # fits nowhere
+
+
+class TestGPUFitKernel:
+    def test_single_card_constraint(self):
+        """Aggregate GPU memory fits but no single card does -> infeasible
+        (the whole point of gpu.go:41-56)."""
+        ci = simple_cluster(n_nodes=0)
+        ci.add_node(gpu_node("g0", cards=2, mem_per_card=8))
+        job = build_job("default/j1")
+        job.add_task(gpu_task("t0", gpu_mem=10))  # 16 total, 8 per card
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        mask = P.gpu_fit(snap.tasks.gpu_request[0], snap.nodes)
+        assert not bool(np.asarray(mask)[0])
+
+    def test_non_gpu_task_unaffected(self):
+        ci = simple_cluster(n_nodes=0)
+        ci.add_node(gpu_node("g0"))
+        job = build_job("default/j1")
+        job.add_task(build_task("t0", cpu="1"))
+        ci.add_job(job)
+        snap, _ = pack(ci)
+        mask = P.gpu_fit(snap.tasks.gpu_request[0], snap.nodes)
+        assert bool(np.asarray(mask)[0])
+
+    def test_pick_gpu_lowest_first(self):
+        ci = simple_cluster(n_nodes=0)
+        node = gpu_node("g0", cards=2, mem_per_card=8)
+        filler = gpu_task("f", gpu_mem=5)
+        filler.gpu_index = 0
+        filler.status = TaskStatus.RUNNING
+        node.add_task(filler)
+        ci.add_node(node)
+        job = build_job("default/j1")
+        job.add_task(gpu_task("t0", gpu_mem=4))
+        ci.add_job(job)
+        snap, _ = pack(ci)
+        card = P.pick_gpu(snap.tasks.gpu_request[0], snap.nodes)
+        assert int(np.asarray(card)[0]) == 1  # card 0 only has 3 left
+
+
+class TestGPUAllocate:
+    def _run(self, ci, cfg=AllocateConfig()):
+        snap, maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        tpu = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        cpu = allocate_cpu(snap, extras, cfg)
+        return snap, maps, tpu, cpu
+
+    def test_two_tasks_spread_across_cards(self):
+        """Two 6GB tasks on one node with 2x8GB cards: first fills card 0,
+        second must take card 1 (in-cycle device accounting)."""
+        ci = simple_cluster(n_nodes=0)
+        ci.add_node(gpu_node("g0", cards=2, mem_per_card=8))
+        job = build_job("default/j1", min_available=2)
+        job.add_task(gpu_task("t0", gpu_mem=6))
+        job.add_task(gpu_task("t1", gpu_mem=6))
+        ci.add_job(job)
+        snap, maps, tpu, cpu = self._run(ci)
+        gpus = sorted(int(g) for g in np.asarray(tpu.task_gpu)[:2])
+        assert gpus == [0, 1]
+        assert np.asarray(tpu.task_mode)[:2].tolist() == [MODE_ALLOCATED] * 2
+
+    def test_gang_discard_frees_gpu(self):
+        """A 2-task gang whose second GPU task cannot fit discards, leaving
+        the card free for a following job (statement Discard semantics)."""
+        ci = simple_cluster(n_nodes=0)
+        ci.add_node(gpu_node("g0", cards=1, mem_per_card=8))
+        big = build_job("default/big", min_available=2)
+        big.add_task(gpu_task("b0", gpu_mem=6))
+        big.add_task(gpu_task("b1", gpu_mem=6))   # won't fit after b0
+        ci.add_job(big)
+        small = build_job("default/small", min_available=1)
+        small.add_task(gpu_task("s0", gpu_mem=8))
+        ci.add_job(small)
+        snap, maps, tpu, cpu = self._run(ci)
+        task_mode = np.asarray(tpu.task_mode)
+        s0 = maps.task_index["default/s0"]
+        b0 = maps.task_index["default/b0"]
+        assert int(task_mode[s0]) == MODE_ALLOCATED  # got the whole card
+        assert int(task_mode[b0]) == 0               # gang discarded
+
+    def test_cpu_tpu_equivalence_with_gpus(self):
+        rng = np.random.RandomState(7)
+        ci = simple_cluster(n_nodes=0)
+        for i in range(4):
+            ci.add_node(gpu_node(f"g{i}", cards=2, mem_per_card=8))
+        ci.add_queue(QueueInfo("default", weight=1))
+        for j in range(6):
+            job = build_job(f"default/j{j}", min_available=2)
+            for t in range(2):
+                job.add_task(gpu_task(f"j{j}-t{t}",
+                                      gpu_mem=int(rng.randint(1, 9))))
+            ci.add_job(job)
+        snap, maps, tpu, cpu = self._run(ci)
+        np.testing.assert_array_equal(np.asarray(tpu.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(tpu.task_mode),
+                                      cpu["task_mode"])
+        np.testing.assert_array_equal(np.asarray(tpu.task_gpu),
+                                      cpu["task_gpu"])
+
+
+class TestGPUWireFormat:
+    def test_native_pack_carries_gpu_arrays(self):
+        from volcano_tpu.native import available, pack_native
+        if not available():
+            import pytest
+            pytest.skip("native packer unavailable")
+        ci = simple_cluster(n_nodes=1)
+        node = gpu_node("g0", cards=2, mem_per_card=8)
+        filler = gpu_task("f", gpu_mem=5)
+        filler.gpu_index = 1
+        filler.status = TaskStatus.RUNNING
+        node.add_task(filler)
+        ci.add_node(node)
+        job = build_job("default/j1")
+        job.add_task(gpu_task("t0", gpu_mem=4))
+        ci.add_job(job)
+        py_snap, _ = pack(ci)
+        nat_snap, _ = pack_native(ci)
+        np.testing.assert_allclose(np.asarray(py_snap.nodes.gpu_memory),
+                                   np.asarray(nat_snap.nodes.gpu_memory))
+        np.testing.assert_allclose(np.asarray(py_snap.nodes.gpu_used),
+                                   np.asarray(nat_snap.nodes.gpu_used))
+        np.testing.assert_allclose(np.asarray(py_snap.tasks.gpu_request),
+                                   np.asarray(nat_snap.tasks.gpu_request))
+
+
+class TestNumatopology:
+    def test_crd_stored_in_apiserver(self):
+        """Numatopology is a cluster-scoped object per node
+        (numatopo_types.go:70-88); types-only parity with the reference."""
+        from volcano_tpu.api import CPUInfo, Numatopology, NumatopoSpec
+        from volcano_tpu.runtime.apiserver import APIServer
+        api = APIServer()
+        topo = Numatopology("n0", NumatopoSpec(
+            policies={"CPUManagerPolicy": "static"},
+            cpu_detail={"0": CPUInfo(numa_node_id=0, socket_id=0, core_id=0)}))
+        api.create("numatopologies", topo)
+        assert api.get("numatopologies", "n0").spec.policies[
+            "CPUManagerPolicy"] == "static"

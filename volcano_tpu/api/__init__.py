@@ -2,7 +2,10 @@
 
 from .cluster_info import ClusterInfo
 from .job_info import FitError, FitErrors, JobInfo, Taint, TaskInfo, Toleration
-from .node_info import NodeInfo
+from .node_info import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE, GPUDevice,
+                        NodeInfo, gpu_request_of)
+from .numa import (CPU_MANAGER_POLICY, TOPOLOGY_MANAGER_POLICY, CPUInfo,
+                   Numatopology, NumatopoSpec, ResourceInfo)
 from .queue_info import (DEFAULT_NAMESPACE_WEIGHT, HIERARCHY_ANNOTATION,
                          HIERARCHY_WEIGHTS_ANNOTATION, NamespaceInfo, QueueInfo)
 from .resource import (CPU, MEMORY, MIN_RESOURCE, PODS, Resource,
@@ -13,7 +16,10 @@ from .types import (ALLOCATED_STATUSES, DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME,
 
 __all__ = [
     "ClusterInfo", "FitError", "FitErrors", "JobInfo", "Taint", "TaskInfo",
-    "Toleration", "NodeInfo", "NamespaceInfo", "QueueInfo", "Resource",
+    "Toleration", "NodeInfo", "GPUDevice", "GPU_MEMORY_RESOURCE",
+    "GPU_NUMBER_RESOURCE", "gpu_request_of", "NamespaceInfo", "QueueInfo",
+    "Resource", "Numatopology", "NumatopoSpec", "CPUInfo", "ResourceInfo",
+    "CPU_MANAGER_POLICY", "TOPOLOGY_MANAGER_POLICY",
     "build_resource_list", "parse_quantity", "CPU", "MEMORY", "PODS",
     "MIN_RESOURCE", "ALLOCATED_STATUSES", "DEFAULT_QUEUE",
     "DEFAULT_SCHEDULER_NAME", "DEFAULT_NAMESPACE_WEIGHT",

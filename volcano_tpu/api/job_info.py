@@ -59,6 +59,8 @@ class TaskInfo:
     status: TaskStatus = TaskStatus.PENDING
     priority: int = 0
     node_name: str = ""                 # assigned node ("" = unassigned)
+    gpu_index: int = -1                 # assigned shared-GPU card (GPUIndex
+    #                                     annotation, well_known_labels.go:28)
     preemptable: bool = False
     best_effort: bool = False
     revocable_zone: str = ""
@@ -85,6 +87,7 @@ class TaskInfo:
             task_role=self.task_role, resreq=self.resreq.clone(),
             init_resreq=self.init_resreq.clone(), status=self.status,
             priority=self.priority, node_name=self.node_name,
+            gpu_index=self.gpu_index,
             preemptable=self.preemptable, revocable_zone=self.revocable_zone,
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations), labels=dict(self.labels),

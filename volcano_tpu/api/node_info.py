@@ -15,6 +15,38 @@ from .job_info import Taint, TaskInfo
 from .resource import Resource
 from .types import TaskStatus, is_allocated_status
 
+#: Extended resource name for shared-GPU memory requests.
+#: Reference: VolcanoGPUResource, pkg/scheduler/api/well_known_labels.go:22.
+GPU_MEMORY_RESOURCE = "volcano.sh/gpu-memory"
+#: Extended resource name declaring the virtual GPU card count of a node.
+#: Reference: VolcanoGPUNumber, well_known_labels.go:24.
+GPU_NUMBER_RESOURCE = "volcano.sh/gpu-number"
+
+
+@dataclass
+class GPUDevice:
+    """One shareable GPU card: id, memory capacity, and per-task usage.
+
+    Reference: GPUDevice, pkg/scheduler/api/device_info.go:24-53 (PodMap of
+    sharing pods -> here a task_uid -> requested-memory map).
+    """
+
+    id: int
+    memory: float
+    used_by: Dict[str, float] = field(default_factory=dict)
+
+    def used_memory(self) -> float:
+        """Reference: getUsedGPUMemory, device_info.go:42-53."""
+        return sum(self.used_by.values())
+
+    def idle_memory(self) -> float:
+        return self.memory - self.used_memory()
+
+
+def gpu_request_of(resreq: Resource) -> float:
+    """GPU memory requested by a task (GetGPUResourceOfPod, device_info.go:56-62)."""
+    return resreq.get(GPU_MEMORY_RESOURCE)
+
 
 @dataclass
 class NodeInfo:
@@ -37,6 +69,16 @@ class NodeInfo:
         self.releasing = Resource()
         self.pipelined = Resource()
         self.tasks: Dict[str, TaskInfo] = {}
+        # GPU cards from the node's declared gpu-memory / gpu-number capacity
+        # (setNodeGPUInfo, node_info.go:171-195): memory is split evenly.
+        self.gpu_devices: List[GPUDevice] = []
+        total_mem = self.capability.get(GPU_MEMORY_RESOURCE) or \
+            self.allocatable.get(GPU_MEMORY_RESOURCE)
+        n_cards = int(self.capability.get(GPU_NUMBER_RESOURCE) or
+                      self.allocatable.get(GPU_NUMBER_RESOURCE))
+        if total_mem > 0 and n_cards > 0:
+            per_card = total_mem / n_cards
+            self.gpu_devices = [GPUDevice(i, per_card) for i in range(n_cards)]
 
     # ----------------------------------------------------------------- state
     def future_idle(self) -> Resource:
@@ -60,7 +102,11 @@ class NodeInfo:
         elif is_allocated_status(task.status):
             self.used.add(task.resreq)
             self.idle.sub(task.resreq)
-        # terminal statuses (Succeeded/Failed) occupy nothing
+        # terminal statuses (Succeeded/Failed) occupy nothing — including GPU
+        # cards (getUsedGPUMemory skips Succeeded/Failed pods,
+        # device_info.go:42-53)
+        if task.status == TaskStatus.RELEASING or is_allocated_status(task.status):
+            self.add_gpu_resource(task)
         task.node_name = self.name
         self.tasks[task.uid] = task
 
@@ -78,6 +124,35 @@ class NodeInfo:
         elif is_allocated_status(stored.status):
             self.used.sub_floored(stored.resreq)
             self.idle.add(stored.resreq)
+        self.sub_gpu_resource(stored)
+
+    # ----------------------------------------------------------- gpu sharing
+    def add_gpu_resource(self, task: TaskInfo) -> None:
+        """Charge the task's GPU memory to its assigned card
+        (AddGPUResource, node_info.go:395-404)."""
+        req = gpu_request_of(task.resreq)
+        if req > 0 and 0 <= task.gpu_index < len(self.gpu_devices):
+            self.gpu_devices[task.gpu_index].used_by[task.uid] = req
+
+    def sub_gpu_resource(self, task: TaskInfo) -> None:
+        """Reference: SubGPUResource, node_info.go:406-415."""
+        if 0 <= task.gpu_index < len(self.gpu_devices):
+            self.gpu_devices[task.gpu_index].used_by.pop(task.uid, None)
+
+    def idle_gpu_memory(self) -> List[float]:
+        """Per-card idle memory (GetDevicesIdleGPUMemory, node_info.go:365-377)."""
+        return [d.idle_memory() for d in self.gpu_devices]
+
+    def predicate_gpu(self, task: TaskInfo) -> int:
+        """Lowest card id whose idle memory fits the task's request, or -1
+        (predicateGPU, plugins/predicates/gpu.go:41-56)."""
+        req = gpu_request_of(task.resreq)
+        if req <= 0:
+            return -1
+        for dev in self.gpu_devices:
+            if dev.idle_memory() >= req:
+                return dev.id
+        return -1
 
     def update_task(self, task: TaskInfo) -> None:
         """Reference: UpdateTask, node_info.go:328-340."""

@@ -1,0 +1,58 @@
+"""Numatopology CRD types (nodeinfo.volcano.sh/v1alpha1).
+
+Reference: vendor/volcano.sh/apis/pkg/apis/nodeinfo/v1alpha1/
+numatopo_types.go:25-88. In the reference snapshot these are **types only** —
+no scheduler consumer exists yet — so the parity obligation here is the data
+model plus API-server storage (a cluster-scoped object per node), mirrored by
+the "numatopologies" kind in runtime/apiserver.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+#: Manager policy names (numatopo_types.go:40-46).
+CPU_MANAGER_POLICY = "CPUManagerPolicy"
+TOPOLOGY_MANAGER_POLICY = "TopologyManagerPolicy"
+
+
+@dataclass
+class ResourceInfo:
+    """Capacity/allocatable of one resource on a NUMA node
+    (numatopo_types.go:26-29)."""
+
+    allocatable: str = ""
+    capacity: int = 0
+
+
+@dataclass
+class CPUInfo:
+    """Topology detail of one logical CPU (numatopo_types.go:32-37)."""
+
+    numa_node_id: int = 0
+    socket_id: int = 0
+    core_id: int = 0
+
+
+@dataclass
+class NumatopoSpec:
+    """Reference: NumatopoSpec, numatopo_types.go:49-68."""
+
+    policies: Dict[str, str] = field(default_factory=dict)
+    res_reserved: Dict[str, str] = field(default_factory=dict)
+    numa_res_map: Dict[str, ResourceInfo] = field(default_factory=dict)
+    cpu_detail: Dict[str, CPUInfo] = field(default_factory=dict)
+
+
+@dataclass
+class Numatopology:
+    """Cluster-scoped CRD, one per node, named after the node
+    (numatopo_types.go:70-88)."""
+
+    name: str
+    spec: NumatopoSpec = field(default_factory=NumatopoSpec)
+
+    @property
+    def key(self) -> str:
+        return self.name

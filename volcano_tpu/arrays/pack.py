@@ -20,7 +20,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import (CPU, MEMORY, ClusterInfo, JobInfo, PodGroupPhase,
-                   QueueState, TaskStatus, is_allocated_status)
+                   QueueState, TaskStatus, gpu_request_of, is_allocated_status)
 from ..api.job_info import Toleration
 from . import labels as L
 from .schema import (IndexMaps, JobArrays, NodeArrays, QueueArrays,
@@ -155,6 +155,11 @@ def pack(ci: ClusterInfo,
     n_maxpods = np.zeros(N, np.int32)
     n_sched = np.zeros(N, bool)
     n_valid = np.zeros(N, bool)
+    # shared-GPU cards (GPUDevices, node_info.go:54; device_info.go:24-53)
+    G = bucket(max((len(ci.nodes[n].gpu_devices) for n in node_names),
+                   default=1) or 1, buckets.get("G", 1))
+    n_gpu_mem = np.zeros((N, G), np.float32)
+    n_gpu_used = np.zeros((N, G), np.float32)
     label_rows, taint_kv_rows, taint_key_rows, taint_eff_rows = [], [], [], []
     for i, name in enumerate(node_names):
         node = ci.nodes[name]
@@ -168,6 +173,9 @@ def pack(ci: ClusterInfo,
         n_maxpods[i] = node.max_pods
         n_sched[i] = node.ready and not node.unschedulable
         n_valid[i] = True
+        for dev in node.gpu_devices[:G]:
+            n_gpu_mem[i, dev.id] = dev.memory
+            n_gpu_used[i, dev.id] = dev.used_memory()
         label_rows.append(L.label_hashes(node.labels))
         taint_kv_rows.append([L.stable_hash(f"{t.key}={t.value}") for t in node.taints])
         taint_key_rows.append([L.stable_hash(t.key) for t in node.taints])
@@ -181,7 +189,8 @@ def pack(ci: ClusterInfo,
         idle=n_idle, used=n_used, releasing=n_rel, pipelined=n_pip,
         allocatable=n_alloc, capability=n_capab, labels=n_labels,
         taint_kv=n_taint_kv, taint_key=n_taint_key, taint_effect=n_taint_eff,
-        pod_count=n_podcount, max_pods=n_maxpods, schedulable=n_sched,
+        pod_count=n_podcount, max_pods=n_maxpods,
+        gpu_memory=n_gpu_mem, gpu_used=n_gpu_used, schedulable=n_sched,
         valid=n_valid)
 
     # ------------------------------------------------------- jobs and tasks
@@ -204,6 +213,7 @@ def pack(ci: ClusterInfo,
     t_priority = np.zeros(T, np.int32)
     t_node = np.full(T, -1, np.int32)
     t_best_effort = np.zeros(T, bool)
+    t_gpu_req = np.zeros(T, np.float32)
     t_preempt = np.zeros(T, bool)
     t_valid = np.zeros(T, bool)
     sel_rows, tolh_rows, tole_rows, tolm_rows = [], [], [], []
@@ -217,6 +227,7 @@ def pack(ci: ClusterInfo,
         t_priority[ti] = task.priority
         t_node[ti] = maps.node_index.get(task.node_name, -1)
         t_best_effort[ti] = task.best_effort
+        t_gpu_req[ti] = gpu_request_of(task.resreq)
         t_preempt[ti] = task.preemptable
         t_valid[ti] = True
         required = dict(task.node_selector)
@@ -235,7 +246,7 @@ def pack(ci: ClusterInfo,
         resreq=t_resreq, job=t_job, status=t_status, priority=t_priority,
         node=t_node, selector=t_selector, tol_hash=t_tol_hash,
         tol_effect=t_tol_eff, tol_mode=t_tol_mode, best_effort=t_best_effort,
-        preemptable=t_preempt, valid=t_valid)
+        gpu_request=t_gpu_req, preemptable=t_preempt, valid=t_valid)
 
     j_minavail = np.zeros(J, np.int32)
     j_queue = np.zeros(J, np.int32)

@@ -8,7 +8,7 @@ masks. All shapes are static per bucket so XLA compiles the cycle once per
 
 Axis legend: N nodes, T tasks, J jobs, Q queues, S namespaces, R resource dims,
 L label slots, K selector slots, E taint slots, O toleration slots, M max
-pending tasks per job.
+pending tasks per job, G GPU cards per node (shared-GPU predicate).
 """
 
 from __future__ import annotations
@@ -45,6 +45,8 @@ class NodeArrays:
     taint_effect: jax.Array  # i32[N, E]  effect codes (labels.EFFECT_*)
     pod_count: jax.Array     # i32[N]
     max_pods: jax.Array      # i32[N]
+    gpu_memory: jax.Array    # f32[N, G]  per-card memory, 0 = no card
+    gpu_used: jax.Array      # f32[N, G]  per-card used memory
     schedulable: jax.Array   # bool[N]  ready && !unschedulable
     valid: jax.Array         # bool[N]
 
@@ -73,6 +75,7 @@ class TaskArrays:
     tol_effect: jax.Array    # i32[T, O] effect codes (0 = all effects)
     tol_mode: jax.Array      # i32[T, O] labels.TOL_* modes
     best_effort: jax.Array   # bool[T] empty resreq (backfill targets)
+    gpu_request: jax.Array   # f32[T] single-card GPU memory request
     preemptable: jax.Array   # bool[T]
     valid: jax.Array         # bool[T]
 

@@ -40,6 +40,7 @@ class BindIntent:
     task_uid: str
     job_uid: str
     node_name: str
+    gpu_index: int = -1  # shared-GPU card (AddGPUIndexPatch, pod_info.go:154)
 
 
 @dataclasses.dataclass
@@ -297,21 +298,24 @@ class Session:
                 return job, task
         return None, None
 
-    def _bind_task(self, task_uid: str, node_name: str) -> None:
+    def _bind_task(self, task_uid: str, node_name: str,
+                   gpu_index: int = -1) -> None:
         """Session dispatch: mark Binding, account on the node, queue the
         bind intent (session.go:264-355 Allocate -> dispatch -> cache.Bind)."""
         job, task = self._find_task(task_uid)
         if task is None:
             return
         job.update_task_status(task, TaskStatus.BINDING)
+        task.gpu_index = gpu_index
         node = self.cluster.nodes.get(node_name)
         if node is not None and task.uid not in node.tasks:
             node.add_task(task)
-        self.binds.append(BindIntent(task_uid, job.uid, node_name))
+        self.binds.append(BindIntent(task_uid, job.uid, node_name, gpu_index))
 
     def apply_allocate(self, result: AllocateResult) -> None:
         task_node = np.asarray(result.task_node)
         task_mode = np.asarray(result.task_mode)
+        task_gpu = np.asarray(result.task_gpu)
         job_ready = np.asarray(result.job_ready)
         # ready gangs' PodGroups move to Running (scheduler status updater,
         # session.go:173 jobStatus)
@@ -326,7 +330,7 @@ class Session:
             ji = int(np.asarray(self.snap.tasks.job)[ti])
             node_name = self.maps.node_names[int(task_node[ti])]
             if mode == MODE_ALLOCATED and bool(job_ready[ji]):
-                self._bind_task(uid, node_name)
+                self._bind_task(uid, node_name, int(task_gpu[ti]))
             else:
                 # held in-session only (pipelined or allocated-but-unready):
                 # no cache flush, like an uncommitted Statement

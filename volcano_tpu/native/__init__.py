@@ -39,7 +39,7 @@ _build_error: Optional[str] = None
 class _VCArrays(ctypes.Structure):
     _fields_ = (
         [(n, ctypes.c_int32) for n in
-         ("R", "Q", "S", "N", "J", "T", "M", "L", "E", "K", "O",
+         ("R", "Q", "S", "N", "J", "T", "M", "L", "E", "K", "O", "G",
           "nq", "ns", "nn", "nj", "nt")]
         + [(n, ctypes.POINTER(ctypes.c_float)) for n in ("q_weight", "q_cap")]
         + [(n, ctypes.POINTER(ctypes.c_uint8))
@@ -55,14 +55,18 @@ class _VCArrays(ctypes.Structure):
         + [(n, ctypes.POINTER(ctypes.c_int32))
            for n in ("n_labels", "n_taint_kv", "n_taint_key", "n_taint_effect",
                      "n_pod_count", "n_max_pods")]
+        + [(n, ctypes.POINTER(ctypes.c_float))
+           for n in ("n_gpu_memory", "n_gpu_used")]
         + [(n, ctypes.POINTER(ctypes.c_uint8))
            for n in ("n_schedulable", "n_valid")]
         + [("t_resreq", ctypes.POINTER(ctypes.c_float))]
         + [(n, ctypes.POINTER(ctypes.c_int32))
            for n in ("t_job", "t_status", "t_priority", "t_node", "t_selector",
                      "t_tol_hash", "t_tol_effect", "t_tol_mode")]
+        + [("t_best_effort", ctypes.POINTER(ctypes.c_uint8)),
+           ("t_gpu_request", ctypes.POINTER(ctypes.c_float))]
         + [(n, ctypes.POINTER(ctypes.c_uint8))
-           for n in ("t_best_effort", "t_preemptable", "t_valid")]
+           for n in ("t_preemptable", "t_valid")]
         + [(n, ctypes.POINTER(ctypes.c_int32))
            for n in ("j_min_available", "j_queue", "j_namespace", "j_priority",
                      "j_creation_rank", "j_ready_num")]
@@ -167,7 +171,7 @@ def pack_wire(buf: bytes) -> SnapshotArrays:
             raise ValueError(
                 f"vc_pack failed: {(out.error or b'?').decode()}")
         R, Q, S, N, J, T = out.R, out.Q, out.S, out.N, out.J, out.T
-        M, L, E, K, O = out.M, out.L, out.E, out.K, out.O
+        M, L, E, K, O, G = out.M, out.L, out.E, out.K, out.O, out.G
         b = np.bool_
         nodes = NodeArrays(
             idle=_np(out.n_idle, (N, R), np.float32),
@@ -182,6 +186,8 @@ def pack_wire(buf: bytes) -> SnapshotArrays:
             taint_effect=_np(out.n_taint_effect, (N, E), np.int32),
             pod_count=_np(out.n_pod_count, (N,), np.int32),
             max_pods=_np(out.n_max_pods, (N,), np.int32),
+            gpu_memory=_np(out.n_gpu_memory, (N, G), np.float32),
+            gpu_used=_np(out.n_gpu_used, (N, G), np.float32),
             schedulable=_np(out.n_schedulable, (N,), np.uint8).astype(b),
             valid=_np(out.n_valid, (N,), np.uint8).astype(b))
         tasks = TaskArrays(
@@ -195,6 +201,7 @@ def pack_wire(buf: bytes) -> SnapshotArrays:
             tol_effect=_np(out.t_tol_effect, (T, O), np.int32),
             tol_mode=_np(out.t_tol_mode, (T, O), np.int32),
             best_effort=_np(out.t_best_effort, (T,), np.uint8).astype(b),
+            gpu_request=_np(out.t_gpu_request, (T,), np.float32),
             preemptable=_np(out.t_preemptable, (T,), np.uint8).astype(b),
             valid=_np(out.t_valid, (T,), np.uint8).astype(b))
         jobs = JobArrays(

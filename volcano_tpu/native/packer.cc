@@ -109,7 +109,7 @@ extern "C" {
 // Pointers are malloc'd by vc_pack and released by vc_free.  Row-major.
 struct VCArrays {
   // Bucketed dims and real counts.
-  int32_t R, Q, S, N, J, T, M, L, E, K, O;
+  int32_t R, Q, S, N, J, T, M, L, E, K, O, G;
   int32_t nq, ns, nn, nj, nt;
   // Queues.
   float* q_weight;
@@ -136,6 +136,8 @@ struct VCArrays {
   int32_t* n_taint_effect;
   int32_t* n_pod_count;
   int32_t* n_max_pods;
+  float* n_gpu_memory;  // [N, G] per shared-GPU card
+  float* n_gpu_used;    // [N, G]
   uint8_t* n_schedulable;
   uint8_t* n_valid;
   // Tasks.
@@ -149,6 +151,7 @@ struct VCArrays {
   int32_t* t_tol_effect;
   int32_t* t_tol_mode;
   uint8_t* t_best_effort;
+  float* t_gpu_request;
   uint8_t* t_preemptable;
   uint8_t* t_valid;
   // Jobs.
@@ -180,7 +183,9 @@ void vc_free(VCArrays* a) {
                      &a->n_idle,          &a->n_used,
                      &a->n_releasing,     &a->n_pipelined,
                      &a->n_allocatable,   &a->n_capability,
-                     &a->t_resreq,        &a->j_allocated,
+                     &a->t_resreq,        &a->t_gpu_request,
+                     &a->n_gpu_memory,    &a->n_gpu_used,
+                     &a->j_allocated,
                      &a->j_total_request, &a->j_min_resources,
                      &a->cluster_capacity};
   for (auto** f : fptrs) {
@@ -227,13 +232,13 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   // Sanity-bound every count against the bytes actually present before any
   // allocation sized by it: a crafted header must fail as ValueError on the
   // Python side, never as bad_alloc/OOM in here.  Minimum record sizes:
-  // queue 4+4+4R+2+8, namespace 4+4, node 4+24R+8+1+8, job 4+16+8+4+8R+3,
-  // task 4+4+4R+12+2+8.
+  // queue 4+4+4R+2+8, namespace 4+4, node 4+24R+8+1+4+8, job 4+16+8+4+8R+3,
+  // task 4+4+4R+12+2+4+8.
   const uint64_t remaining = static_cast<uint64_t>(r.end - r.p);
   const uint64_t min_bytes = uint64_t(nq) * (18 + 4ull * R) + uint64_t(ns) * 8 +
-                             uint64_t(nn) * (13 + 24ull * R) +
+                             uint64_t(nn) * (17 + 24ull * R) +
                              uint64_t(nj) * (35 + 8ull * R) +
-                             uint64_t(nt) * (30 + 4ull * R);
+                             uint64_t(nt) * (34 + 4ull * R);
   if (min_bytes > remaining) {
     a->error = "corrupt header: counts exceed buffer size";
     return 1;
@@ -329,6 +334,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   // Two passes over variable-width label/taint sets would complicate the
   // reader; instead collect into vectors, then pad to the max width.
   std::vector<std::vector<int32_t>> labels(nn), tkv(nn), tkey(nn), teff(nn);
+  std::vector<std::vector<float>> gmem(nn), gused(nn);
   for (uint32_t i = 0; i < nn; ++i) {
     r.SkipString();
     r.F32Vec(a->n_idle + int64_t(i) * R, R);
@@ -341,6 +347,15 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     a->n_max_pods[i] = r.I32();
     a->n_schedulable[i] = r.U8();
     a->n_valid[i] = 1;
+    // shared-GPU cards (device_info.go:24-53): G x (memory, used)
+    uint32_t ng = r.U32();
+    if (!r.Need(8ull * ng)) break;
+    gmem[i].resize(ng);
+    gused[i].resize(ng);
+    for (uint32_t g = 0; g < ng; ++g) {
+      gmem[i][g] = r.F32();
+      gused[i][g] = r.F32();
+    }
     uint32_t nl = r.U32();
     if (!r.Need(4ull * nl)) break;
     labels[i].resize(nl);
@@ -356,17 +371,23 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
       teff[i][t] = r.I32();
     }
   }
-  size_t maxl = 0, maxe = 0;
+  size_t maxl = 0, maxe = 0, maxg = 0;
   for (auto& v : labels) maxl = std::max(maxl, v.size());
   for (auto& v : tkv) maxe = std::max(maxe, v.size());
+  for (auto& v : gmem) maxg = std::max(maxg, v.size());
   const int32_t L = std::max<int32_t>(static_cast<int32_t>(maxl), 1);
   const int32_t E = std::max<int32_t>(static_cast<int32_t>(maxe), 1);
+  // Power-of-two bucketed like arrays/pack.py (buckets.get("G", 1)).
+  const int32_t G = Bucket(std::max<int64_t>(static_cast<int64_t>(maxg), 1), 1);
   a->L = L;
   a->E = E;
+  a->G = G;
   a->n_labels = imalloc(int64_t(N) * L);
   a->n_taint_kv = imalloc(int64_t(N) * E);
   a->n_taint_key = imalloc(int64_t(N) * E);
   a->n_taint_effect = imalloc(int64_t(N) * E);
+  a->n_gpu_memory = fmalloc(int64_t(N) * G);
+  a->n_gpu_used = fmalloc(int64_t(N) * G);
   VC_CHECK_ALLOC();
   for (uint32_t i = 0; i < nn; ++i) {
     std::copy(labels[i].begin(), labels[i].end(), a->n_labels + int64_t(i) * L);
@@ -374,6 +395,10 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     std::copy(tkey[i].begin(), tkey[i].end(), a->n_taint_key + int64_t(i) * E);
     std::copy(teff[i].begin(), teff[i].end(),
               a->n_taint_effect + int64_t(i) * E);
+    std::copy(gmem[i].begin(), gmem[i].end(),
+              a->n_gpu_memory + int64_t(i) * G);
+    std::copy(gused[i].begin(), gused[i].end(),
+              a->n_gpu_used + int64_t(i) * G);
   }
 
   // --------------------------------------------------------------- jobs
@@ -435,6 +460,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   a->t_priority = imalloc(T);
   a->t_node = imalloc(T);
   a->t_best_effort = bmalloc(T);
+  a->t_gpu_request = fmalloc(T);
   a->t_preemptable = bmalloc(T);
   a->t_valid = bmalloc(T);
   VC_CHECK_ALLOC();
@@ -453,6 +479,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     a->t_node[i] = r.I32();
     a->t_best_effort[i] = r.U8();
     a->t_preemptable[i] = r.U8();
+    a->t_gpu_request[i] = r.F32();
     a->t_valid[i] = 1;
     uint32_t nsel = r.U32();
     if (!r.Need(4ull * nsel)) break;

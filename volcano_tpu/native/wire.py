@@ -19,7 +19,7 @@ from typing import List, Tuple
 
 import numpy as np
 
-from ..api import ClusterInfo, PodGroupPhase, QueueState
+from ..api import ClusterInfo, PodGroupPhase, QueueState, gpu_request_of
 from ..arrays import labels as L
 from ..arrays.pack import (_toleration_rows, _vec, queue_capability_row,
                            queue_parent_depth, resource_dims)
@@ -100,6 +100,10 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         out.append(_i32(node.pod_count()))
         out.append(_i32(node.max_pods))
         out.append(bytes([1 if (node.ready and not node.unschedulable) else 0]))
+        out.append(_u32(len(node.gpu_devices)))
+        for dev in node.gpu_devices:
+            out.append(_f32(dev.memory))
+            out.append(_f32(dev.used_memory()))
         lh = L.label_hashes(node.labels)
         out.append(_u32(len(lh)))
         _ivec(out, lh)
@@ -140,6 +144,7 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
             out.append(_i32(maps.node_index.get(task.node_name, -1)))
             out.append(bytes([1 if task.best_effort else 0,
                               1 if task.preemptable else 0]))
+            out.append(_f32(gpu_request_of(task.resreq)))
             required = dict(task.node_selector)
             for term in task.affinity_required:
                 required.update(term)

@@ -105,6 +105,7 @@ class AllocateExtras:
 class AllocateResult:
     task_node: jax.Array       # i32[T] node index or -1
     task_mode: jax.Array       # i32[T] MODE_*
+    task_gpu: jax.Array        # i32[T] assigned GPU card or -1 (gpu.go:41-56)
     job_ready: jax.Array       # bool[J] gang became ready (binds emitted)
     job_pipelined: jax.Array   # bool[J] gang holds capacity, no binds
     job_attempted: jax.Array   # bool[J] job was popped this cycle
@@ -160,15 +161,19 @@ def make_allocate_cycle(cfg: AllocateConfig):
         T = tasks.resreq.shape[0]
         J, M = jobs.task_table.shape
 
+        G = nodes.gpu_memory.shape[1]
         init = dict(
             idle=nodes.idle,
             pipe_extra=jnp.zeros((N, R), jnp.float32),
             pods_extra=jnp.zeros(N, jnp.int32),
+            gpu_extra=jnp.zeros((N, G), jnp.float32),
             saved_idle=nodes.idle,
             saved_pipe=jnp.zeros((N, R), jnp.float32),
             saved_pods=jnp.zeros(N, jnp.int32),
+            saved_gpu=jnp.zeros((N, G), jnp.float32),
             task_node=jnp.full(T, -1, jnp.int32),
             task_mode=jnp.zeros(T, jnp.int32),
+            task_gpu=jnp.full(T, -1, jnp.int32),
             job_done=jnp.zeros(J, bool),
             job_ready=jnp.zeros(J, bool),
             job_pipelined=jnp.zeros(J, bool),
@@ -223,10 +228,12 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
             # ---- inner scan: try every pending task of the job ------------
             def task_step(carry, t_idx):
-                idle, pipe_extra, pods_extra, t_node, t_mode, n_alloc, n_pipe = carry
+                (idle, pipe_extra, pods_extra, gpu_extra,
+                 t_node, t_mode, t_gpu, n_alloc, n_pipe) = carry
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
                 t = jnp.maximum(t_idx, 0)
                 resreq = tasks.resreq[t]
+                gpu_req = tasks.gpu_request[t]
                 sel = tasks.selector[t]
                 th, te, tm = tasks.tol_hash[t], tasks.tol_effect[t], tasks.tol_mode[t]
 
@@ -238,9 +245,11 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 node_ok = (~(extras.block_nonpreempt & ~tasks.preemptable[t])
                            & (~extras.node_locked | (ji == extras.target_job)))
                 feas_now = node_ok & P.feasible(nodes, resreq, sel, th, te, tm,
-                                                idle, pods_extra)
+                                                idle, pods_extra,
+                                                gpu_req, gpu_extra)
                 feas_fut = node_ok & P.feasible(nodes, resreq, sel, th, te, tm,
-                                                future, pods_extra)
+                                                future, pods_extra,
+                                                gpu_req, gpu_extra)
                 score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
                 # task-topology bucket preference (topology.go:344)
                 score += S.node_preference_score(extras.task_pref_node[t],
@@ -253,6 +262,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
                 do_alloc = can_now
                 do_pipe = ~can_now & can_fut
+                placed = do_alloc | do_pipe
                 node = jnp.where(do_alloc, n_now, n_fut)
 
                 delta = jnp.where(do_alloc, 1.0, 0.0) * resreq
@@ -260,21 +270,29 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 pipe_delta = jnp.where(do_pipe, 1.0, 0.0) * resreq
                 pipe_extra = pipe_extra.at[node].add(pipe_delta)
                 pods_extra = pods_extra.at[node].add(
-                    jnp.where(do_alloc | do_pipe, 1, 0))
+                    jnp.where(placed, 1, 0))
+                # shared-GPU card assignment: lowest fitting card on the chosen
+                # node (predicateGPU, gpu.go:41-56), charged for the cycle
+                card = P.pick_gpu_row(gpu_req, nodes.gpu_memory[node],
+                                      nodes.gpu_used[node], gpu_extra[node])
+                charge = placed & (card >= 0)
+                gpu_extra = gpu_extra.at[node, jnp.maximum(card, 0)].add(
+                    jnp.where(charge, gpu_req, 0.0))
+                t_gpu = t_gpu.at[t].set(jnp.where(charge, card, t_gpu[t]))
                 t_node = t_node.at[t].set(
-                    jnp.where(do_alloc | do_pipe, node, t_node[t]))
+                    jnp.where(placed, node, t_node[t]))
                 t_mode = t_mode.at[t].set(
                     jnp.where(do_alloc, MODE_ALLOCATED,
                               jnp.where(do_pipe, MODE_PIPELINED, t_mode[t])))
                 n_alloc += jnp.where(do_alloc, 1, 0)
                 n_pipe += jnp.where(do_pipe, 1, 0)
-                return (idle, pipe_extra, pods_extra, t_node, t_mode,
-                        n_alloc, n_pipe), None
+                return (idle, pipe_extra, pods_extra, gpu_extra,
+                        t_node, t_mode, t_gpu, n_alloc, n_pipe), None
 
             carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
-                      st["task_node"], st["task_mode"],
-                      jnp.int32(0), jnp.int32(0))
-            (idle, pipe_extra, pods_extra, t_node, t_mode,
+                      st["gpu_extra"], st["task_node"], st["task_mode"],
+                      st["task_gpu"], jnp.int32(0), jnp.int32(0))
+            (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode, t_gpu,
              n_alloc, n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
@@ -290,10 +308,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
             idle = jnp.where(keep, idle, st["saved_idle"])
             pipe_extra = jnp.where(keep, pipe_extra, st["saved_pipe"])
             pods_extra = jnp.where(keep, pods_extra, st["saved_pods"])
+            gpu_extra = jnp.where(keep, gpu_extra, st["saved_gpu"])
             t_node = jnp.where(keep | ~job_tasks, t_node,
                                jnp.full_like(t_node, -1))
             t_mode = jnp.where(keep | ~job_tasks, t_mode,
                                jnp.zeros_like(t_mode))
+            t_gpu = jnp.where(keep | ~job_tasks, t_gpu,
+                              jnp.full_like(t_gpu, -1))
             # A kept-but-unready gang holds capacity without binding: demote
             # its Allocated placements to Pipelined so MODE_ALLOCATED always
             # means "bind now" (the reference only dispatches binds on Commit
@@ -306,6 +327,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
             saved_idle = jnp.where(keep, idle, st["saved_idle"])
             saved_pipe = jnp.where(keep, pipe_extra, st["saved_pipe"])
             saved_pods = jnp.where(keep, pods_extra, st["saved_pods"])
+            saved_gpu = jnp.where(keep, gpu_extra, st["saved_gpu"])
 
             # queue accounting for the share ordering (proportion event
             # handlers on Allocate, proportion.go:281-325)
@@ -318,8 +340,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
             return dict(
                 idle=idle, pipe_extra=pipe_extra, pods_extra=pods_extra,
+                gpu_extra=gpu_extra,
                 saved_idle=saved_idle, saved_pipe=saved_pipe,
-                saved_pods=saved_pods, task_node=t_node, task_mode=t_mode,
+                saved_pods=saved_pods, saved_gpu=saved_gpu,
+                task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
                 job_done=st["job_done"].at[ji].set(True),
                 job_ready=st["job_ready"].at[ji].set(ready),
                 job_pipelined=st["job_pipelined"].at[ji].set(
@@ -332,6 +356,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
         return AllocateResult(
             task_node=final["task_node"],
             task_mode=final["task_mode"],
+            task_gpu=final["task_gpu"],
             job_ready=final["job_ready"],
             job_pipelined=final["job_pipelined"],
             job_attempted=final["job_done"],

@@ -85,18 +85,63 @@ def pod_count_fit(nodes: NodeArrays, extra: jax.Array | None = None) -> jax.Arra
     return count < nodes.max_pods
 
 
+def gpu_fit(gpu_request: jax.Array, nodes: NodeArrays,
+            gpu_extra: jax.Array | None = None) -> jax.Array:
+    """bool[N]: some single GPU card has enough idle memory for the request.
+
+    Kernel form of the GPU-sharing predicate (checkNodeGPUSharingPredicate +
+    predicateGPU, pkg/scheduler/plugins/predicates/gpu.go:27-56): a shared-GPU
+    task must fit on ONE card, not in the node's aggregate GPU memory.
+    ``gpu_extra`` f32[N, G] adds in-cycle placements.
+    """
+    idle = nodes.gpu_memory - nodes.gpu_used
+    if gpu_extra is not None:
+        idle = idle - gpu_extra
+    return (gpu_request <= 0) | jnp.any(idle >= gpu_request - _EPS, axis=-1)
+
+
+def pick_gpu(gpu_request: jax.Array, nodes: NodeArrays,
+             gpu_extra: jax.Array | None = None) -> jax.Array:
+    """i32[N]: per node, the lowest card id fitting the request, -1 if none
+    (or no GPU requested). Reference: predicateGPU scans devID ascending
+    (gpu.go:46-55)."""
+    idle = nodes.gpu_memory - nodes.gpu_used
+    if gpu_extra is not None:
+        idle = idle - gpu_extra
+    fits = idle >= gpu_request - _EPS
+    first = jnp.argmax(fits, axis=-1).astype(jnp.int32)
+    ok = jnp.any(fits, axis=-1) & (gpu_request > 0)
+    return jnp.where(ok, first, -1)
+
+
+def pick_gpu_row(gpu_request: jax.Array, mem_row: jax.Array,
+                 used_row: jax.Array, extra_row: jax.Array) -> jax.Array:
+    """i32 scalar: lowest fitting card on ONE node's card row (O(G), for the
+    allocate inner scan where only the chosen node's pick is needed)."""
+    idle = mem_row - used_row - extra_row
+    fits = idle >= gpu_request - _EPS
+    first = jnp.argmax(fits).astype(jnp.int32)
+    ok = jnp.any(fits) & (gpu_request > 0)
+    return jnp.where(ok, first, -1)
+
+
 def feasible(nodes: NodeArrays, resreq: jax.Array, selector: jax.Array,
              tol_hash: jax.Array, tol_effect: jax.Array, tol_mode: jax.Array,
-             avail: jax.Array, extra_pods: jax.Array | None = None) -> jax.Array:
+             avail: jax.Array, extra_pods: jax.Array | None = None,
+             gpu_request: jax.Array | None = None,
+             gpu_extra: jax.Array | None = None) -> jax.Array:
     """bool[N]: full predicate conjunction for one task against every node.
 
     ``avail`` chooses the capacity view: current idle for immediate
     allocation, future idle for pipelining (allocate.go:200-240 candidate
     split vs Idle/FutureIdle).
     """
-    return (nodes.valid
+    mask = (nodes.valid
             & nodes.schedulable
             & pod_count_fit(nodes, extra_pods)
             & resource_fit(resreq, avail)
             & selector_match(selector, nodes.labels)
             & taints_tolerated(tol_hash, tol_effect, tol_mode, nodes))
+    if gpu_request is not None:
+        mask &= gpu_fit(gpu_request, nodes, gpu_extra)
+    return mask

@@ -172,10 +172,14 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
                 t = jnp.maximum(t_idx, 0)
                 resreq = tasks.resreq[t]
+                # GPU predicate runs with current card usage like the other
+                # predicates do in the reference's preempt PredicateNodes
+                # (preempt.go:216 -> ssn.PredicateFn -> gpu.go:27-56).
                 base = P.feasible(
                     nodes, jnp.zeros_like(resreq), tasks.selector[t],
                     tasks.tol_hash[t], tasks.tol_effect[t], tasks.tol_mode[t],
-                    future0 + extra_idle, None)
+                    future0 + extra_idle, None,
+                    gpu_request=tasks.gpu_request[t])
 
                 vok = victim_ok(evicted, surplus)
                 evictable = jax.ops.segment_sum(

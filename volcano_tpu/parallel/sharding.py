@@ -50,7 +50,8 @@ def node_sharding_specs(mesh: Mesh, snap: SnapshotArrays):
     node_shardings = NodeArrays(
         idle=row, used=row, releasing=row, pipelined=row, allocatable=row,
         capability=row, labels=row, taint_kv=row, taint_key=row,
-        taint_effect=row, pod_count=row, max_pods=row, schedulable=row,
+        taint_effect=row, pod_count=row, max_pods=row,
+        gpu_memory=row, gpu_used=row, schedulable=row,
         valid=row)
     snap_shardings = SnapshotArrays(
         nodes=node_shardings,

@@ -42,14 +42,22 @@ def _as_np(nodes):
         labels=np.asarray(nodes.labels), taint_kv=np.asarray(nodes.taint_kv),
         taint_key=np.asarray(nodes.taint_key),
         taint_effect=np.asarray(nodes.taint_effect),
-        allocatable=np.asarray(nodes.allocatable))
+        allocatable=np.asarray(nodes.allocatable),
+        gpu_memory=np.asarray(nodes.gpu_memory),
+        gpu_used=np.asarray(nodes.gpu_used))
 
 
-def _feasible_one(nodes, resreq, sel, th, te, tm, avail, pods_extra):
+def _feasible_one(nodes, resreq, sel, th, te, tm, avail, pods_extra,
+                  gpu_req=0.0, gpu_extra=None):
     N = avail.shape[0]
     ok = nodes.valid & nodes.schedulable
     ok &= (nodes.pod_count + pods_extra) < nodes.max_pods
     ok &= np.all(resreq[None, :] <= avail + _EPS, axis=-1)
+    if gpu_req > 0:
+        gidle = nodes.gpu_memory - nodes.gpu_used
+        if gpu_extra is not None:
+            gidle = gidle - gpu_extra
+        ok &= np.any(gidle >= gpu_req - _EPS, axis=-1)
     labels = nodes.labels
     for s in sel:
         if s != 0:
@@ -164,9 +172,12 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     idle = np.array(nodes.idle, dtype=np.float64).copy()
     pipe_extra = np.zeros((N, R))
     pods_extra = np.zeros(N, np.int64)
+    G = np.array(nodes.gpu_memory).shape[1]
+    gpu_extra = np.zeros((N, G))
     queue_allocated = np.array(queues.allocated, dtype=np.float64).copy()
     task_node = np.full(T, -1, np.int64)
     task_mode = np.zeros(T, np.int64)
+    task_gpu = np.full(T, -1, np.int64)
     job_done = np.zeros(J, bool)
     job_ready = np.zeros(J, bool)
     job_pipelined = np.zeros(J, bool)
@@ -190,7 +201,19 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     t_tol_effect = np.array(tasks.tol_effect)
     t_tol_mode = np.array(tasks.tol_mode)
     t_preemptable = np.array(tasks.preemptable)
+    t_gpu_req = np.array(tasks.gpu_request, dtype=np.float64)
     nodes_np = _as_np(nodes)
+
+    def _pick_gpu(node, req):
+        """Lowest fitting card on the node (predicateGPU, gpu.go:41-56)."""
+        if req <= 0:
+            return -1
+        gidle = (nodes_np.gpu_memory[node] - nodes_np.gpu_used[node]
+                 - gpu_extra[node])
+        for g in range(G):
+            if gidle[g] >= req - _EPS:
+                return g
+        return -1
 
     while True:
         overused = np.any(queue_allocated > queue_deserved + 1e-6, axis=-1)
@@ -216,7 +239,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 best_key, best_ji = k, ji
         ji = best_ji
 
-        saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy())
+        saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy(),
+                 gpu_extra.copy())
         placed: List[int] = []
         n_alloc = n_pipe = 0
         for slot in range(M):
@@ -228,10 +252,12 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             te = t_tol_effect[t]
             tm = t_tol_mode[t]
             req = resreq[t]
+            greq = t_gpu_req[t]
             node_ok = (~(block_nonpreempt & ~t_preemptable[t])
                        & (~node_locked | (ji == target_job)))
             feas_now = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm,
-                                               idle, pods_extra)
+                                               idle, pods_extra,
+                                               greq, gpu_extra)
             score = _score_one(cfg, nodes_np, req, idle, th, te, tm)
             if task_pref_node[t] >= 0:
                 score = score + 100.0 * (np.arange(len(score)) == task_pref_node[t])
@@ -239,6 +265,10 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 node = int(np.argmax(np.where(feas_now, score, -np.inf)))
                 idle[node] -= req
                 pods_extra[node] += 1
+                card = _pick_gpu(node, greq)
+                if card >= 0:
+                    gpu_extra[node, card] += greq
+                    task_gpu[t] = card
                 task_node[t] = node
                 task_mode[t] = MODE_ALLOCATED
                 placed.append(t)
@@ -246,11 +276,15 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             elif cfg.enable_pipelining:
                 future = np.maximum(idle + releasing - pipelined0 - pipe_extra, 0)
                 feas_fut = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm, future,
-                                         pods_extra)
+                                         pods_extra, greq, gpu_extra)
                 if feas_fut.any():
                     node = int(np.argmax(np.where(feas_fut, score, -np.inf)))
                     pipe_extra[node] += req
                     pods_extra[node] += 1
+                    card = _pick_gpu(node, greq)
+                    if card >= 0:
+                        gpu_extra[node, card] += greq
+                        task_gpu[t] = card
                     task_node[t] = node
                     task_mode[t] = MODE_PIPELINED
                     placed.append(t)
@@ -269,12 +303,14 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 for t in placed:
                     task_mode[t] = MODE_PIPELINED
         else:
-            idle, pipe_extra, pods_extra = saved
+            idle, pipe_extra, pods_extra, gpu_extra = saved
             for t in placed:
                 task_node[t] = -1
                 task_mode[t] = MODE_NONE
+                task_gpu[t] = -1
         job_done[ji] = True
 
-    return dict(task_node=task_node, task_mode=task_mode, job_ready=job_ready,
+    return dict(task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
+                job_ready=job_ready,
                 job_pipelined=job_pipelined, idle=idle,
                 queue_allocated=queue_allocated)

@@ -45,6 +45,9 @@ class FakeCluster:
         if task.uid in self.ci.nodes.get(task.node_name, node).tasks:
             self.ci.nodes[task.node_name].remove_task(task)
         job.update_task_status(task, TaskStatus.BOUND)
+        # apply the shared-GPU card chosen by the cycle before accounting,
+        # like the GPU-index pod patch ahead of AddPod (predicates.go:140-151)
+        task.gpu_index = intent.gpu_index
         node.add_task(task)
         self.binds.append((intent.task_uid, intent.node_name))
         return True
