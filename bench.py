@@ -51,17 +51,38 @@ def _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs):
     return snap, extras, cfg
 
 
-def _time_tpu(fn, snap, extras, reps):
+def _drain(result):
+    """Force true completion: fetch the decision outputs to host.
+
+    On the axon TPU platform block_until_ready() can return before the
+    computation finishes (observed: 0.5 ms "latency" for a 350 ms cycle), so
+    timing must include a host readback of the arrays the scheduler runtime
+    actually consumes — which is also exactly what a real cycle pays.
+    """
+    import jax
+    jax.block_until_ready(result)
+    for leaf in (result.task_node, result.task_mode, result.task_gpu,
+                 result.job_ready, result.job_pipelined):
+        np.asarray(leaf)
+
+
+def _time_tpu(cycle_fn, snap, extras, reps):
+    """Times snapshot-in -> decisions-on-host-out, the full cycle a real
+    scheduler pays: upload (numpy inputs), compute, ONE packed readback
+    (AllocateResult.packed_decisions; the tunnel charges per fetch)."""
+    import jax
+    packed_fn = jax.jit(lambda s, e: cycle_fn(s, e).packed_decisions())
     t0 = time.time()
-    result = fn(snap, extras)
-    result.task_node.block_until_ready()
+    np.asarray(packed_fn(snap, extras))
     compile_s = time.time() - t0
     times = []
     for _ in range(reps):
         t0 = time.time()
-        result = fn(snap, extras)
-        result.task_node.block_until_ready()
+        packed = np.asarray(packed_fn(snap, extras))
         times.append(time.time() - t0)
+    # full result (for equality checks), outside the timed region
+    result = cycle_fn(snap, extras)
+    _drain(result)
     return result, min(times) * 1000, compile_s
 
 

@@ -193,3 +193,47 @@ class TestSelect:
         mask = jnp.array([True, True, True, False])
         order = SEL.sort_order([k1, k2], mask)
         assert list(order[:3]) == [2, 1, 0]
+
+
+class TestPredicateTemplates:
+    """The predicate-cache analog (plugins/predicates/cache.go:42-90):
+    tasks with identical selector/toleration rows share a template id and
+    one static-feasibility mask row."""
+
+    def test_template_dedupe(self):
+        ci = simple_cluster(n_nodes=2)
+        job = build_job("default/j1", min_available=1)
+        for i in range(3):
+            job.add_task(build_task(f"same{i}", cpu="1",
+                                    node_selector={"zone": "a"}))
+        job.add_task(build_task("diff", cpu="1",
+                                node_selector={"zone": "b"}))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        tmpl = np.asarray(snap.tasks.template)
+        ids = {maps.task_index[f"default/same{i}"] for i in range(3)}
+        assert len({int(tmpl[t]) for t in ids}) == 1
+        assert int(tmpl[maps.task_index["default/diff"]]) not in \
+            {int(tmpl[t]) for t in ids}
+        reps = np.asarray(snap.template_rep)
+        n_templates = int((reps >= 0).sum())
+        assert n_templates == 2
+
+    def test_template_masks_match_per_task_feasible(self):
+        import jax
+        ci = simple_cluster(n_nodes=3)
+        ci.nodes["n1"].labels["zone"] = "a"
+        job = build_job("default/j1", min_available=1)
+        job.add_task(build_task("t0", cpu="1", node_selector={"zone": "a"}))
+        job.add_task(build_task("t1", cpu="1"))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        masks = np.asarray(P.template_masks(snap.nodes, snap.tasks,
+                                            snap.template_rep))
+        tmpl = np.asarray(snap.tasks.template)
+        for uid in ("default/t0", "default/t1"):
+            ti = maps.task_index[uid]
+            direct = np.asarray(P.static_feasible(
+                snap.nodes, snap.tasks.selector[ti], snap.tasks.tol_hash[ti],
+                snap.tasks.tol_effect[ti], snap.tasks.tol_mode[ti]))
+            np.testing.assert_array_equal(masks[int(tmpl[ti])], direct)

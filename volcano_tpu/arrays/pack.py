@@ -242,11 +242,32 @@ def pack(ci: ClusterInfo,
     t_tol_eff = pad_rows(L.pack_hash_rows(tole_rows or [[]]), T)
     t_tol_mode = pad_rows(L.pack_hash_rows(tolm_rows or [[]]), T)
 
+    # predicate templates: tasks with identical selector/toleration rows share
+    # the static (capacity-independent) predicate result; the kernel computes
+    # one mask row per template instead of per task (the TLRU predicate-cache
+    # analog, plugins/predicates/cache.go:42-90, keyed per pod template).
+    t_template = np.zeros(T, np.int32)
+    template_of: Dict[tuple, int] = {}
+    rep_tasks: List[int] = []
+    for ti in range(nt):
+        sig = (tuple(sel_rows[ti]), tuple(tolh_rows[ti]),
+               tuple(tole_rows[ti]), tuple(tolm_rows[ti]))
+        tid = template_of.get(sig)
+        if tid is None:
+            tid = len(rep_tasks)
+            template_of[sig] = tid
+            rep_tasks.append(ti)
+        t_template[ti] = tid
+    P = bucket(max(len(rep_tasks), 1), buckets.get("P", 4))
+    template_rep = np.full(P, -1, np.int32)
+    template_rep[: len(rep_tasks)] = rep_tasks
+
     tasks = TaskArrays(
         resreq=t_resreq, job=t_job, status=t_status, priority=t_priority,
         node=t_node, selector=t_selector, tol_hash=t_tol_hash,
         tol_effect=t_tol_eff, tol_mode=t_tol_mode, best_effort=t_best_effort,
-        gpu_request=t_gpu_req, preemptable=t_preempt, valid=t_valid)
+        gpu_request=t_gpu_req, template=t_template, preemptable=t_preempt,
+        valid=t_valid)
 
     j_minavail = np.zeros(J, np.int32)
     j_queue = np.zeros(J, np.int32)
@@ -342,5 +363,6 @@ def pack(ci: ClusterInfo,
         nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,
         namespace_weight=ns_weight,
         cluster_capacity=n_alloc[:nn].sum(axis=0) if nn else np.zeros(R, np.float32),
+        template_rep=template_rep,
     )
     return snap, maps

@@ -76,6 +76,9 @@ class TaskArrays:
     tol_mode: jax.Array      # i32[T, O] labels.TOL_* modes
     best_effort: jax.Array   # bool[T] empty resreq (backfill targets)
     gpu_request: jax.Array   # f32[T] single-card GPU memory request
+    template: jax.Array      # i32[T] predicate-template id (tasks with equal
+    #                          selector/toleration rows share one; the
+    #                          predicate-cache key, predicates/cache.go:42-67)
     preemptable: jax.Array   # bool[T]
     valid: jax.Array         # bool[T]
 
@@ -150,6 +153,8 @@ class SnapshotArrays:
     queues: QueueArrays
     namespace_weight: jax.Array   # f32[S]
     cluster_capacity: jax.Array   # f32[R] sum of node allocatable
+    template_rep: jax.Array       # i32[P] representative task per predicate
+    #                               template, -1 pad (cache.go analog)
 
 
 @dataclass

@@ -39,7 +39,7 @@ _build_error: Optional[str] = None
 class _VCArrays(ctypes.Structure):
     _fields_ = (
         [(n, ctypes.c_int32) for n in
-         ("R", "Q", "S", "N", "J", "T", "M", "L", "E", "K", "O", "G",
+         ("R", "Q", "S", "N", "J", "T", "M", "L", "E", "K", "O", "G", "P",
           "nq", "ns", "nn", "nj", "nt")]
         + [(n, ctypes.POINTER(ctypes.c_float)) for n in ("q_weight", "q_cap")]
         + [(n, ctypes.POINTER(ctypes.c_uint8))
@@ -62,7 +62,8 @@ class _VCArrays(ctypes.Structure):
         + [("t_resreq", ctypes.POINTER(ctypes.c_float))]
         + [(n, ctypes.POINTER(ctypes.c_int32))
            for n in ("t_job", "t_status", "t_priority", "t_node", "t_selector",
-                     "t_tol_hash", "t_tol_effect", "t_tol_mode")]
+                     "t_tol_hash", "t_tol_effect", "t_tol_mode", "t_template",
+                     "template_rep")]
         + [("t_best_effort", ctypes.POINTER(ctypes.c_uint8)),
            ("t_gpu_request", ctypes.POINTER(ctypes.c_float))]
         + [(n, ctypes.POINTER(ctypes.c_uint8))
@@ -200,6 +201,7 @@ def pack_wire(buf: bytes) -> SnapshotArrays:
             tol_hash=_np(out.t_tol_hash, (T, O), np.int32),
             tol_effect=_np(out.t_tol_effect, (T, O), np.int32),
             tol_mode=_np(out.t_tol_mode, (T, O), np.int32),
+            template=_np(out.t_template, (T,), np.int32),
             best_effort=_np(out.t_best_effort, (T,), np.uint8).astype(b),
             gpu_request=_np(out.t_gpu_request, (T,), np.float32),
             preemptable=_np(out.t_preemptable, (T,), np.uint8).astype(b),
@@ -235,7 +237,8 @@ def pack_wire(buf: bytes) -> SnapshotArrays:
         return SnapshotArrays(
             nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,
             namespace_weight=_np(out.ns_weight, (S,), np.float32),
-            cluster_capacity=_np(out.cluster_capacity, (R,), np.float32))
+            cluster_capacity=_np(out.cluster_capacity, (R,), np.float32),
+            template_rep=_np(out.template_rep, (out.P,), np.int32))
     finally:
         lib.vc_free(ctypes.byref(out))
 

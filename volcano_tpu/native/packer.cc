@@ -22,6 +22,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <map>
 #include <cstdlib>
 #include <cstring>
 #include <limits>
@@ -109,7 +110,7 @@ extern "C" {
 // Pointers are malloc'd by vc_pack and released by vc_free.  Row-major.
 struct VCArrays {
   // Bucketed dims and real counts.
-  int32_t R, Q, S, N, J, T, M, L, E, K, O, G;
+  int32_t R, Q, S, N, J, T, M, L, E, K, O, G, P;
   int32_t nq, ns, nn, nj, nt;
   // Queues.
   float* q_weight;
@@ -150,6 +151,8 @@ struct VCArrays {
   int32_t* t_tol_hash;
   int32_t* t_tol_effect;
   int32_t* t_tol_mode;
+  int32_t* t_template;      // predicate-template id (cache.go analog)
+  int32_t* template_rep;    // [P] representative task per template, -1 pad
   uint8_t* t_best_effort;
   float* t_gpu_request;
   uint8_t* t_preemptable;
@@ -197,7 +200,8 @@ void vc_free(VCArrays* a) {
                        &a->n_pod_count, &a->n_max_pods,    &a->t_job,
                        &a->t_status,    &a->t_priority,    &a->t_node,
                        &a->t_selector,  &a->t_tol_hash,    &a->t_tol_effect,
-                       &a->t_tol_mode,  &a->j_min_available, &a->j_queue,
+                       &a->t_tol_mode,  &a->t_template,    &a->template_rep,
+                       &a->j_min_available, &a->j_queue,
                        &a->j_namespace, &a->j_priority,    &a->j_creation_rank,
                        &a->j_ready_num, &a->j_task_table,  &a->j_n_pending};
   for (auto** i : iptrs) {
@@ -527,6 +531,44 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     std::copy(tole[i].begin(), tole[i].end(),
               a->t_tol_effect + int64_t(i) * O);
     std::copy(tolm[i].begin(), tolm[i].end(), a->t_tol_mode + int64_t(i) * O);
+  }
+
+  // Predicate templates: tasks with identical selector/toleration rows share
+  // one id, first-occurrence order (arrays/pack.py template dedupe; the
+  // predicate-cache key of plugins/predicates/cache.go:42-67).
+  a->t_template = imalloc(T);
+  VC_CHECK_ALLOC();
+  {
+    std::map<std::vector<int32_t>, int32_t> template_of;
+    std::vector<int32_t> reps;
+    for (uint32_t i = 0; i < nt; ++i) {
+      std::vector<int32_t> key;
+      key.reserve(sel[i].size() + 3 * tolh[i].size() + 4);
+      key.insert(key.end(), sel[i].begin(), sel[i].end());
+      key.push_back(std::numeric_limits<int32_t>::min());
+      key.insert(key.end(), tolh[i].begin(), tolh[i].end());
+      key.push_back(std::numeric_limits<int32_t>::min());
+      key.insert(key.end(), tole[i].begin(), tole[i].end());
+      key.push_back(std::numeric_limits<int32_t>::min());
+      key.insert(key.end(), tolm[i].begin(), tolm[i].end());
+      auto it = template_of.find(key);
+      int32_t tid;
+      if (it == template_of.end()) {
+        tid = static_cast<int32_t>(reps.size());
+        template_of.emplace(std::move(key), tid);
+        reps.push_back(static_cast<int32_t>(i));
+      } else {
+        tid = it->second;
+      }
+      a->t_template[i] = tid;
+    }
+    const int32_t P =
+        Bucket(std::max<int64_t>(static_cast<int64_t>(reps.size()), 1), 4);
+    a->P = P;
+    a->template_rep = imalloc(P);
+    VC_CHECK_ALLOC();
+    for (int32_t i = 0; i < P; ++i) a->template_rep[i] = -1;
+    std::copy(reps.begin(), reps.end(), a->template_rep);
   }
 
   // Pending-task tables: task order = priority desc, insertion order

@@ -106,11 +106,33 @@ class AllocateResult:
     task_node: jax.Array       # i32[T] node index or -1
     task_mode: jax.Array       # i32[T] MODE_*
     task_gpu: jax.Array        # i32[T] assigned GPU card or -1 (gpu.go:41-56)
+
+    def packed_decisions(self) -> jax.Array:
+        """i32[3T + 2J]: all decision outputs in ONE array so the host pays a
+        single device->host fetch per cycle (the axon tunnel charges ~tens of
+        ms per readback regardless of size). Decode with
+        :func:`unpack_decisions`."""
+        return jnp.concatenate([
+            self.task_node, self.task_mode, self.task_gpu,
+            self.job_ready.astype(jnp.int32),
+            self.job_pipelined.astype(jnp.int32)])
     job_ready: jax.Array       # bool[J] gang became ready (binds emitted)
     job_pipelined: jax.Array   # bool[J] gang holds capacity, no binds
     job_attempted: jax.Array   # bool[J] job was popped this cycle
     idle: jax.Array            # f32[N, R] remaining idle after the pass
     queue_allocated: jax.Array  # f32[Q, R] post-pass queue usage
+
+
+def unpack_decisions(packed, T: int, J: int):
+    """Inverse of AllocateResult.packed_decisions on a host numpy array."""
+    import numpy as np
+    packed = np.asarray(packed)
+    task_node = packed[:T]
+    task_mode = packed[T:2 * T]
+    task_gpu = packed[2 * T:3 * T]
+    job_ready = packed[3 * T:3 * T + J].astype(bool)
+    job_pipelined = packed[3 * T + J:3 * T + 2 * J].astype(bool)
+    return task_node, task_mode, task_gpu, job_ready, job_pipelined
 
 
 def _score_fn(cfg: AllocateConfig, snap: SnapshotArrays, resreq, idle,
@@ -183,6 +205,11 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
         max_rounds = J if cfg.max_rounds is None else cfg.max_rounds
 
+        # static predicate rows per template, computed once per cycle (the
+        # predicate-cache analog, predicates/cache.go:42-90; see
+        # P.template_masks). bool[P, N].
+        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+
         def eligible(st):
             # Overused queues are skipped (proportion.Overused,
             # proportion.go:240-253): NOT allocated.LessEqual(deserved),
@@ -243,13 +270,17 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 # admit preemptable tasks (tdm.go:295); reservation: locked
                 # nodes only admit the elected target job (reserve.go:43-77).
                 node_ok = (~(extras.block_nonpreempt & ~tasks.preemptable[t])
-                           & (~extras.node_locked | (ji == extras.target_job)))
-                feas_now = node_ok & P.feasible(nodes, resreq, sel, th, te, tm,
-                                                idle, pods_extra,
-                                                gpu_req, gpu_extra)
-                feas_fut = node_ok & P.feasible(nodes, resreq, sel, th, te, tm,
-                                                future, pods_extra,
-                                                gpu_req, gpu_extra)
+                           & (~extras.node_locked | (ji == extras.target_job))
+                           & tmpl_static[tasks.template[t]])
+                # shared (capacity-view-independent) terms computed once, the
+                # idle/future resource fit fused into one stacked comparison
+                shared = node_ok & P.pod_count_fit(nodes, pods_extra)
+                shared &= P.gpu_fit(gpu_req, nodes, gpu_extra)
+                fit2 = jnp.all(
+                    resreq[None, None, :]
+                    <= jnp.stack([idle, future]) + 1e-5, axis=-1)
+                feas_now = shared & fit2[0]
+                feas_fut = shared & fit2[1]
                 score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
                 # task-topology bucket preference (topology.go:344)
                 score += S.node_preference_score(extras.task_pref_node[t],
@@ -293,7 +324,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                       st["gpu_extra"], st["task_node"], st["task_mode"],
                       st["task_gpu"], jnp.int32(0), jnp.int32(0))
             (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode, t_gpu,
-             n_alloc, n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
+             n_alloc, n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids,
+                                                unroll=min(int(M), 16))
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
             ready = (ready0 + n_alloc) >= min_avail

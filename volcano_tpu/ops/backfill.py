@@ -31,11 +31,14 @@ def make_backfill_pass():
                      & jobs.schedulable[jnp.maximum(tasks.job, 0)]
                      & (tasks.job >= 0))
 
+        # per-template static predicate rows (predicate-cache analog)
+        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+
         def step(carry, t):
             pods_extra, t_node, placed = carry
-            feas = P.feasible(nodes, tasks.resreq[t], tasks.selector[t],
-                              tasks.tol_hash[t], tasks.tol_effect[t],
-                              tasks.tol_mode[t], nodes.idle, pods_extra)
+            feas = (tmpl_static[tasks.template[t]]
+                    & P.capacity_feasible(nodes, tasks.resreq[t], nodes.idle,
+                                          pods_extra))
             node = jnp.argmax(feas).astype(jnp.int32)  # lowest feasible index
             ok = candidate[t] & jnp.any(feas)
             pods_extra = pods_extra.at[node].add(jnp.where(ok, 1, 0))

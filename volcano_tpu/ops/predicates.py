@@ -114,6 +114,54 @@ def pick_gpu(gpu_request: jax.Array, nodes: NodeArrays,
     return jnp.where(ok, first, -1)
 
 
+def static_feasible(nodes: NodeArrays, selector: jax.Array,
+                    tol_hash: jax.Array, tol_effect: jax.Array,
+                    tol_mode: jax.Array) -> jax.Array:
+    """bool[N]: the capacity-independent predicate conjunction for one
+    selector/toleration signature — everything in :func:`feasible` that does
+    not depend on in-cycle idle/pod-count/GPU state."""
+    return (nodes.valid
+            & nodes.schedulable
+            & selector_match(selector, nodes.labels)
+            & taints_tolerated(tol_hash, tol_effect, tol_mode, nodes))
+
+
+def template_masks(nodes: NodeArrays, tasks, template_rep: jax.Array) -> jax.Array:
+    """bool[P, N]: static feasibility per predicate template, computed once
+    per cycle.
+
+    The TPU analog of the reference's predicate cache (plugins/predicates/
+    cache.go:42-90): tasks sharing a pod template share the static predicate
+    result; here the "cache fill" is one vmapped pass over template
+    representatives and the "cache hit" is a row gather in the allocate scan.
+    Unlike the reference's never-invalidated map, this recomputes from the
+    fresh snapshot every cycle, so it cannot go stale.
+    """
+    rep = jnp.maximum(jnp.asarray(template_rep), 0)
+    sel = jnp.asarray(tasks.selector)
+    th = jnp.asarray(tasks.tol_hash)
+    te = jnp.asarray(tasks.tol_effect)
+    tm = jnp.asarray(tasks.tol_mode)
+
+    def one(ti):
+        return static_feasible(nodes, sel[ti], th[ti], te[ti], tm[ti])
+
+    return jax.vmap(one)(rep)
+
+
+def capacity_feasible(nodes: NodeArrays, resreq: jax.Array, avail: jax.Array,
+                      extra_pods: jax.Array | None = None,
+                      gpu_request: jax.Array | None = None,
+                      gpu_extra: jax.Array | None = None) -> jax.Array:
+    """bool[N]: the capacity-dependent half of :func:`feasible` (resource
+    fit, pod slots, single-card GPU fit) — AND with a template_masks row to
+    reconstruct the full conjunction."""
+    mask = pod_count_fit(nodes, extra_pods) & resource_fit(resreq, avail)
+    if gpu_request is not None:
+        mask &= gpu_fit(gpu_request, nodes, gpu_extra)
+    return mask
+
+
 def pick_gpu_row(gpu_request: jax.Array, mem_row: jax.Array,
                  used_row: jax.Array, extra_row: jax.Array) -> jax.Array:
     """i32 scalar: lowest fitting card on ONE node's card row (O(G), for the

@@ -105,6 +105,10 @@ def make_preempt_cycle(cfg: PreemptConfig):
 
         future0 = nodes.future_idle()
 
+        # static predicate rows per template (predicate-cache analog,
+        # predicates/cache.go:42-90)
+        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+
         init = dict(
             extra_idle=jnp.zeros((N, R), jnp.float32),   # from evictions
             pipe_extra=jnp.zeros((N, R), jnp.float32),   # new pipelines
@@ -174,12 +178,13 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 resreq = tasks.resreq[t]
                 # GPU predicate runs with current card usage like the other
                 # predicates do in the reference's preempt PredicateNodes
-                # (preempt.go:216 -> ssn.PredicateFn -> gpu.go:27-56).
-                base = P.feasible(
-                    nodes, jnp.zeros_like(resreq), tasks.selector[t],
-                    tasks.tol_hash[t], tasks.tol_effect[t], tasks.tol_mode[t],
-                    future0 + extra_idle, None,
-                    gpu_request=tasks.gpu_request[t])
+                # (preempt.go:216 -> ssn.PredicateFn -> gpu.go:27-56); the
+                # static half comes from the per-template mask rows.
+                base = (tmpl_static[tasks.template[t]]
+                        & P.capacity_feasible(
+                            nodes, jnp.zeros_like(resreq),
+                            future0 + extra_idle, None,
+                            gpu_request=tasks.gpu_request[t]))
 
                 vok = victim_ok(evicted, surplus)
                 evictable = jax.ops.segment_sum(

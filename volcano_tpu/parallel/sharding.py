@@ -60,6 +60,7 @@ def node_sharding_specs(mesh: Mesh, snap: SnapshotArrays):
         queues=jax.tree.map(lambda _: rep, snap.queues),
         namespace_weight=rep,
         cluster_capacity=rep,
+        template_rep=rep,
     )
     return snap_shardings, rep
 
