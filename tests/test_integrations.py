@@ -1,0 +1,148 @@
+"""Distributed-framework integration e2e tests through the full stack.
+
+The analog of the reference's kind-cluster e2e suites for real MPI and
+TensorFlow jobs (test/e2e/jobseq/mpi.go, test/e2e/jobseq/tensorflow.go):
+an MPI-shaped gang (master + workers, ssh/svc/env plugins, CompleteJob on
+TaskCompleted) and a TF-shaped gang (ps + workers, svc plugin) submitted to
+the assembled control plane (runtime/system.VolcanoSystem), asserting gang
+placement, plugin artifacts, and lifecycle-policy-driven completion.
+"""
+
+from volcano_tpu.api.batch import Job, LifecyclePolicy, PodTemplate, TaskSpec
+from volcano_tpu.api.core import PodPhase
+from volcano_tpu.api.types import BusAction, BusEvent, JobPhase
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def make_system(n_nodes=3, cpu="8", memory="16Gi"):
+    sys_ = VolcanoSystem()
+    for i in range(n_nodes):
+        sys_.add_node(f"n{i}", cpu=cpu, memory=memory)
+    return sys_
+
+
+def mpi_job(name="mpi", workers=2):
+    """The e2e MPI job shape (mpi.go:40-100): 1 master + N workers, gang of
+    all, ssh/svc/env plugins, CompleteJob when the master task completes."""
+    return Job(
+        name=name,
+        min_available=1 + workers,
+        plugins={"ssh": [], "svc": [], "env": []},
+        policies=[LifecyclePolicy(action=BusAction.COMPLETE_JOB,
+                                  event=BusEvent.TASK_COMPLETED)],
+        tasks=[
+            TaskSpec(name="mpimaster", replicas=1,
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+            TaskSpec(name="mpiworker", replicas=workers,
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+        ])
+
+
+def tf_job(name="tensorflow-dist-mnist", workers=2):
+    """The e2e TF job shape (tensorflow.go:40-120): 1 ps + N workers, svc
+    plugin for host files, CompleteJob when the worker task completes."""
+    return Job(
+        name=name,
+        min_available=1 + workers,
+        plugins={"svc": [], "env": []},
+        tasks=[
+            TaskSpec(name="ps", replicas=1,
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+            TaskSpec(name="worker", replicas=workers,
+                     policies=[LifecyclePolicy(
+                         action=BusAction.COMPLETE_JOB,
+                         event=BusEvent.TASK_COMPLETED)],
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+        ])
+
+
+class TestMPIIntegration:
+    def test_runs_and_completes(self):
+        sys_ = make_system()
+        sys_.submit_job(mpi_job())
+        for _ in range(3):
+            sys_.tick()
+
+        # gang placed atomically: all 3 pods running
+        pods = sys_.pods_of("mpi")
+        assert len(pods) == 3
+        assert all(p.phase == PodPhase.RUNNING for p in pods)
+        assert sys_.job("mpi").status.state.phase == JobPhase.RUNNING
+
+        # ssh plugin: keypair secret mounted into every pod (ssh.go:64-238)
+        secret = sys_.api.get("secrets", "default/mpi-ssh")
+        assert secret is not None
+        assert "id_rsa" in secret.data and "authorized_keys" in secret.data
+        assert all("mpi-ssh" in p.volumes for p in pods)
+
+        # svc plugin: the hostfile mpiexec reads (mpi.go command uses
+        # /etc/volcano/mpiworker.host)
+        cm = sys_.api.get("configmaps", "default/mpi-svc")
+        assert cm.data["mpiworker.host"].splitlines() == [
+            "mpi-mpiworker-0.mpi", "mpi-mpiworker-1.mpi"]
+        assert "mpi-mpimaster-0.mpi" in cm.data["hosts"]
+
+        # env plugin: indices for rank assignment
+        by_name = {p.name: p for p in pods}
+        assert by_name["mpi-mpiworker-1"].env["VC_TASK_INDEX"] == "1"
+
+        # master finishes -> TaskCompleted -> CompleteJob policy: remaining
+        # workers are cleaned up and the job completes (mpi.go:44-49)
+        sys_.finish_pod("default/mpi-mpimaster-0", exit_code=0)
+        for _ in range(4):
+            sys_.tick()
+        assert sys_.job("mpi").status.state.phase == JobPhase.COMPLETED
+
+    def test_gang_blocks_partial_mpi(self):
+        """Workers alone can't start: gang needs master + all workers."""
+        sys_ = make_system(n_nodes=1, cpu="2")   # room for 2 of 3 pods
+        sys_.submit_job(mpi_job())
+        for _ in range(3):
+            sys_.tick()
+        pods = sys_.pods_of("mpi")
+        assert all(p.phase == PodPhase.PENDING for p in pods)
+        # scale up -> whole gang schedules
+        sys_.add_node("n-late", cpu="8", memory="16Gi")
+        for _ in range(3):
+            sys_.tick()
+        assert all(p.phase == PodPhase.RUNNING for p in sys_.pods_of("mpi"))
+
+
+class TestTensorFlowIntegration:
+    def test_runs_and_completes(self):
+        sys_ = make_system()
+        sys_.submit_job(tf_job())
+        for _ in range(3):
+            sys_.tick()
+
+        pods = sys_.pods_of("tensorflow-dist-mnist")
+        assert len(pods) == 3
+        assert all(p.phase == PodPhase.RUNNING for p in pods)
+
+        # host files for TF_CONFIG construction (tensorflow.go commands read
+        # /etc/volcano/ps.host and worker.host)
+        cm = sys_.api.get("configmaps", "default/tensorflow-dist-mnist-svc")
+        assert cm.data["ps.host"] == "tensorflow-dist-mnist-ps-0.tensorflow-dist-mnist"
+        assert len(cm.data["worker.host"].splitlines()) == 2
+
+        # VC_<TASK>_HOSTS env lets pods build cluster specs without mounts
+        ps_pod = next(p for p in pods if "ps" in p.name)
+        assert "tensorflow-dist-mnist-worker-1.tensorflow-dist-mnist" in \
+            ps_pod.env["VC_WORKER_HOSTS"]
+        assert ps_pod.env["VK_TASK_INDEX"] == "0"
+
+        # all workers complete -> TaskCompleted on the worker task ->
+        # CompleteJob (task-level policy beats job default)
+        sys_.finish_pod("default/tensorflow-dist-mnist-worker-0", 0)
+        sys_.tick()
+        assert sys_.job("tensorflow-dist-mnist").status.state.phase == \
+            JobPhase.RUNNING   # only 1 of 2 workers done: not yet complete
+        sys_.finish_pod("default/tensorflow-dist-mnist-worker-1", 0)
+        for _ in range(4):
+            sys_.tick()
+        assert sys_.job("tensorflow-dist-mnist").status.state.phase == \
+            JobPhase.COMPLETED

@@ -71,11 +71,18 @@ class SvcPlugin(JobPlugin):
     name = "svc"
 
     def _hosts(self, job: Job) -> Dict[str, str]:
+        """All-hosts file plus one ``<task>.host`` file per role, the files
+        MPI/TF launch commands read from /etc/volcano (reference svc plugin
+        configmap, svc.go:76-200; e.g. mpiworker.host in e2e mpi.go)."""
         lines: List[str] = []
+        data: Dict[str, str] = {}
         for task in job.tasks:
-            for i in range(task.replicas):
-                lines.append(f"{job.name}-{task.name}-{i}.{job.name}")
-        return {"hosts": "\n".join(lines)}
+            task_lines = [f"{job.name}-{task.name}-{i}.{job.name}"
+                          for i in range(task.replicas)]
+            data[f"{task.name}.host"] = "\n".join(task_lines)
+            lines.extend(task_lines)
+        data["hosts"] = "\n".join(lines)
+        return data
 
     def on_job_add(self, job, apiserver):
         svc = ServiceObject(name=job.name, namespace=job.namespace,
