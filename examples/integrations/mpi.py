@@ -1,0 +1,58 @@
+"""MPI gang job through the assembled control plane.
+
+The single-process analog of the reference's MPI integration
+(example/integrations + test/e2e/jobseq/mpi.go): a master + workers gang with
+the ssh/svc/env job plugins, so the master can `mpiexec --hostfile
+/etc/volcano/mpiworker.host` over password-less ssh once every member runs.
+
+Run: python examples/integrations/mpi.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from volcano_tpu.api.batch import Job, LifecyclePolicy, PodTemplate, TaskSpec
+from volcano_tpu.api.types import BusAction, BusEvent
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def main():
+    sys_ = VolcanoSystem()
+    for i in range(3):
+        sys_.add_node(f"node-{i}", cpu="8", memory="16Gi")
+
+    job = Job(
+        name="mpi",
+        min_available=3,
+        plugins={"ssh": [], "svc": [], "env": []},
+        policies=[LifecyclePolicy(action=BusAction.COMPLETE_JOB,
+                                  event=BusEvent.TASK_COMPLETED)],
+        tasks=[
+            TaskSpec(name="mpimaster", replicas=1,
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+            TaskSpec(name="mpiworker", replicas=2,
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+        ])
+    sys_.submit_job(job)
+    for _ in range(3):
+        sys_.tick()
+
+    pods = sys_.pods_of("mpi")
+    print("pods:", [(p.name, p.phase, p.node_name) for p in pods])
+    cm = sys_.api.get("configmaps", "default/mpi-svc")
+    print("mpiworker.host:")
+    print(cm.data["mpiworker.host"])
+
+    # the master's mpiexec finishes -> the whole job completes
+    sys_.finish_pod("default/mpi-mpimaster-0", exit_code=0)
+    for _ in range(4):
+        sys_.tick()
+    print("job phase:", sys_.job("mpi").status.state.phase)
+
+
+if __name__ == "__main__":
+    main()

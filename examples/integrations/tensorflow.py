@@ -1,0 +1,58 @@
+"""Distributed-TensorFlow gang job (ps + workers) through the control plane.
+
+Analog of the reference's TF integration (test/e2e/jobseq/tensorflow.go):
+the svc plugin publishes ps.host / worker.host files and VC_*_HOSTS env so
+each member can assemble TF_CONFIG; gang scheduling guarantees ps and all
+workers start together or not at all.
+
+Run: python examples/integrations/tensorflow.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from volcano_tpu.api.batch import Job, LifecyclePolicy, PodTemplate, TaskSpec
+from volcano_tpu.api.types import BusAction, BusEvent
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def main():
+    sys_ = VolcanoSystem()
+    for i in range(3):
+        sys_.add_node(f"node-{i}", cpu="8", memory="16Gi")
+
+    job = Job(
+        name="tf-dist-mnist",
+        min_available=3,
+        plugins={"svc": [], "env": []},
+        tasks=[
+            TaskSpec(name="ps", replicas=1,
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+            TaskSpec(name="worker", replicas=2,
+                     policies=[LifecyclePolicy(
+                         action=BusAction.COMPLETE_JOB,
+                         event=BusEvent.TASK_COMPLETED)],
+                     template=PodTemplate(resources={"cpu": "1",
+                                                     "memory": "1Gi"})),
+        ])
+    sys_.submit_job(job)
+    for _ in range(3):
+        sys_.tick()
+
+    pods = sys_.pods_of("tf-dist-mnist")
+    print("pods:", [(p.name, p.phase, p.node_name) for p in pods])
+    ps_pod = next(p for p in pods if "-ps-" in p.name)
+    print("VC_WORKER_HOSTS:", ps_pod.env["VC_WORKER_HOSTS"])
+
+    for i in range(2):
+        sys_.finish_pod(f"default/tf-dist-mnist-worker-{i}", exit_code=0)
+    for _ in range(4):
+        sys_.tick()
+    print("job phase:", sys_.job("tf-dist-mnist").status.state.phase)
+
+
+if __name__ == "__main__":
+    main()
