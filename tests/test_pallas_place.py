@@ -1,0 +1,184 @@
+"""Pallas fused-round placer vs the lax.scan path: bit-identical decisions.
+
+Runs the kernel through the pallas interpreter (tests force a CPU mesh), so
+this validates the kernel logic; the TPU lowering is exercised by bench.py
+and the driver's real-chip runs.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from volcano_tpu.api import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE,
+                             QueueInfo, Taint, Toleration)
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops import AllocateConfig, make_allocate_cycle
+from volcano_tpu.ops.allocate_scan import AllocateExtras
+
+from fixtures import build_job, build_node, build_task, simple_cluster
+
+
+def run_both_paths(ci, cfg=AllocateConfig(), extras_fn=None):
+    snap, maps = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    if extras_fn:
+        extras = extras_fn(snap, maps, extras)
+    scan_cfg = dataclasses.replace(cfg, use_pallas=False)
+    pallas_cfg = dataclasses.replace(cfg, use_pallas="interpret")
+    scan = jax.jit(make_allocate_cycle(scan_cfg))(snap, extras)
+    pls = jax.jit(make_allocate_cycle(pallas_cfg))(snap, extras)
+    return snap, maps, scan, pls
+
+
+def assert_equal(scan, pls):
+    np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                  np.asarray(pls.task_node))
+    np.testing.assert_array_equal(np.asarray(scan.task_mode),
+                                  np.asarray(pls.task_mode))
+    np.testing.assert_array_equal(np.asarray(scan.task_gpu),
+                                  np.asarray(pls.task_gpu))
+    np.testing.assert_array_equal(np.asarray(scan.job_ready),
+                                  np.asarray(pls.job_ready))
+    np.testing.assert_allclose(np.asarray(scan.idle), np.asarray(pls.idle),
+                               atol=1e-5)
+
+
+def random_cluster(seed, n_nodes=6, n_jobs=5, gpus=False, taints=False):
+    rng = np.random.RandomState(seed)
+    ci = simple_cluster(n_nodes=0)
+    for i in range(n_nodes):
+        scalars = {}
+        if gpus and i % 2 == 0:
+            scalars = {GPU_MEMORY_RESOURCE: 16, GPU_NUMBER_RESOURCE: 2}
+        node = build_node(f"n{i}", cpu=str(2 + int(rng.randint(4))),
+                          memory="8Gi", scalars=scalars)
+        if taints and i % 3 == 0:
+            node.taints.append(Taint("dedicated", "batch", "PreferNoSchedule"))
+        ci.add_node(node)
+    ci.add_queue(QueueInfo("batch", weight=2))
+    for j in range(n_jobs):
+        queue = "default" if j % 2 == 0 else "batch"
+        n_tasks = 1 + int(rng.randint(3))
+        job = build_job(f"default/j{j}", queue=queue,
+                        min_available=max(1, n_tasks - 1),
+                        priority=int(rng.randint(3)))
+        for t in range(n_tasks):
+            scalars = {}
+            if gpus and rng.rand() < 0.5:
+                scalars = {GPU_MEMORY_RESOURCE: int(rng.randint(1, 10))}
+            task = build_task(f"j{j}-t{t}",
+                              cpu=f"{int(rng.randint(1, 4)) * 500}m",
+                              memory="1Gi", priority=int(rng.randint(2)),
+                              scalars=scalars)
+            if taints and rng.rand() < 0.3:
+                task.tolerations.append(Toleration(
+                    key="dedicated", operator="Equal", value="batch",
+                    effect=""))
+            job.add_task(task)
+        ci.add_job(job)
+    return ci
+
+
+class TestPallasEquivalence:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_snapshots(self, seed):
+        ci = random_cluster(seed)
+        _, _, scan, pls = run_both_paths(ci)
+        assert_equal(scan, pls)
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_with_gpus(self, seed):
+        ci = random_cluster(seed, gpus=True)
+        _, _, scan, pls = run_both_paths(ci)
+        assert_equal(scan, pls)
+
+    def test_with_taint_scoring_and_all_weights(self):
+        ci = random_cluster(7, taints=True)
+        cfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=1.0,
+                             most_allocated_weight=0.5, balanced_weight=1.0,
+                             taint_prefer_weight=1.0)
+        _, _, scan, pls = run_both_paths(ci, cfg)
+        assert_equal(scan, pls)
+
+    def test_gang_discard(self):
+        ci = simple_cluster(n_nodes=1, node_cpu="2")
+        big = build_job("default/big", min_available=3)
+        for t in range(3):
+            big.add_task(build_task(f"b{t}", cpu="1"))
+        ci.add_job(big)
+        small = build_job("default/small", min_available=1)
+        small.add_task(build_task("s0", cpu="2"))
+        ci.add_job(small)
+        _, maps, scan, pls = run_both_paths(ci)
+        assert_equal(scan, pls)
+        assert bool(np.asarray(pls.job_ready)[maps.job_index["default/small"]])
+
+    def test_matches_cpu_oracle(self):
+        from volcano_tpu.runtime.cpu_reference import allocate_cpu
+        ci = random_cluster(11, gpus=True)
+        snap, maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        cfg = AllocateConfig(binpack_weight=1.0, use_pallas="interpret")
+        pls = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        cpu = allocate_cpu(snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(pls.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(pls.task_mode),
+                                      cpu["task_mode"])
+        np.testing.assert_array_equal(np.asarray(pls.task_gpu),
+                                      cpu["task_gpu"])
+
+
+class TestPallasPipelining:
+    def test_pipelined_placement_on_releasing_capacity(self):
+        """A node whose idle is exhausted but whose releasing capacity covers
+        the request: the scan path pipelines the task (MODE_PIPELINED on
+        FutureIdle); the kernel's do_pipe branch must match exactly."""
+        from volcano_tpu.api import TaskStatus
+        from volcano_tpu.ops import MODE_PIPELINED
+        ci = simple_cluster(n_nodes=1, node_cpu="4")
+        # a releasing task occupies the whole node -> idle 0, releasing 4
+        holder = build_job("default/holder", min_available=1)
+        t = build_task("h0", cpu="4", status=TaskStatus.RELEASING)
+        holder.add_task(t)
+        ci.add_job(holder)
+        ci.nodes["n0"].add_task(t)
+        waiter = build_job("default/waiter", min_available=1)
+        waiter.add_task(build_task("w0", cpu="2"))
+        ci.add_job(waiter)
+        _, maps, scan, pls = run_both_paths(ci)
+        assert_equal(scan, pls)
+        wi = maps.task_index["default/w0"]
+        assert int(np.asarray(pls.task_mode)[wi]) == MODE_PIPELINED
+
+    def test_pipelined_gpu_charge_on_releasing_capacity(self):
+        """Same, with a GPU request: the pipelined placement must charge the
+        card chosen for the in-flight cycle state identically in both paths."""
+        from volcano_tpu.api import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE,
+                                     TaskStatus)
+        from volcano_tpu.ops import MODE_PIPELINED
+        ci = simple_cluster(n_nodes=0)
+        node = build_node("g0", cpu="4", memory="8Gi",
+                          scalars={GPU_MEMORY_RESOURCE: 16,
+                                   GPU_NUMBER_RESOURCE: 2})
+        holder = build_job("default/holder", min_available=1)
+        t = build_task("h0", cpu="4", status=TaskStatus.RELEASING)
+        holder.add_task(t)
+        ci.add_job(holder)
+        node.add_task(t)
+        ci.add_node(node)
+        waiter = build_job("default/waiter", min_available=2)
+        for i in range(2):
+            waiter.add_task(build_task(f"w{i}", cpu="2",
+                                       scalars={GPU_MEMORY_RESOURCE: 6}))
+        ci.add_job(waiter)
+        _, maps, scan, pls = run_both_paths(ci)
+        assert_equal(scan, pls)
+        modes = np.asarray(pls.task_mode)
+        gpus = sorted(int(np.asarray(pls.task_gpu)[maps.task_index[f"default/w{i}"]])
+                      for i in range(2))
+        assert all(int(modes[maps.task_index[f"default/w{i}"]]) ==
+                   MODE_PIPELINED for i in range(2))
+        assert gpus == [0, 1]   # in-cycle card accounting on pipelined tasks

@@ -60,6 +60,10 @@ class AllocateConfig:
     enable_pipelining: bool = True       # allow placement on FutureIdle
     enable_gang: bool = True             # gang all-or-nothing semantics
     max_rounds: Optional[int] = None     # cap on outer job iterations
+    #: Fused pallas round placer (ops/pallas_place.py): None = auto (TPU
+    #: backend, lane-aligned N, fits VMEM), True/False = force,
+    #: "interpret" = pallas interpreter (for CPU tests).
+    use_pallas: Optional[object] = None
 
 
 @jax.tree_util.register_dataclass
@@ -184,15 +188,44 @@ def make_allocate_cycle(cfg: AllocateConfig):
         J, M = jobs.task_table.shape
 
         G = nodes.gpu_memory.shape[1]
+
+        # ---- fused pallas round placer (ops/pallas_place.py) -------------
+        if cfg.use_pallas == "interpret":
+            use_pallas, interp = True, True
+        elif cfg.use_pallas is None:
+            from .pallas_place import vmem_estimate_bytes
+            use_pallas = (jax.default_backend() == "tpu" and N % 128 == 0
+                          and vmem_estimate_bytes(M, N, R, G) < 12 * 2 ** 20)
+            interp = False
+        else:
+            use_pallas, interp = bool(cfg.use_pallas), False
+
+        if use_pallas:
+            # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
+            # the node axis is the TPU lane dimension inside the kernel; the
+            # gang-finalize wheres below are layout-agnostic.
+            init_cap = dict(
+                idle=nodes.idle.T,
+                pipe_extra=jnp.zeros((R, N), jnp.float32),
+                pods_extra=jnp.zeros((1, N), jnp.float32),
+                gpu_extra=jnp.zeros((G, N), jnp.float32),
+                saved_idle=nodes.idle.T,
+                saved_pipe=jnp.zeros((R, N), jnp.float32),
+                saved_pods=jnp.zeros((1, N), jnp.float32),
+                saved_gpu=jnp.zeros((G, N), jnp.float32),
+            )
+        else:
+            init_cap = dict(
+                idle=nodes.idle,
+                pipe_extra=jnp.zeros((N, R), jnp.float32),
+                pods_extra=jnp.zeros(N, jnp.int32),
+                gpu_extra=jnp.zeros((N, G), jnp.float32),
+                saved_idle=nodes.idle,
+                saved_pipe=jnp.zeros((N, R), jnp.float32),
+                saved_pods=jnp.zeros(N, jnp.int32),
+                saved_gpu=jnp.zeros((N, G), jnp.float32),
+            )
         init = dict(
-            idle=nodes.idle,
-            pipe_extra=jnp.zeros((N, R), jnp.float32),
-            pods_extra=jnp.zeros(N, jnp.int32),
-            gpu_extra=jnp.zeros((N, G), jnp.float32),
-            saved_idle=nodes.idle,
-            saved_pipe=jnp.zeros((N, R), jnp.float32),
-            saved_pods=jnp.zeros(N, jnp.int32),
-            saved_gpu=jnp.zeros((N, G), jnp.float32),
             task_node=jnp.full(T, -1, jnp.int32),
             task_mode=jnp.zeros(T, jnp.int32),
             task_gpu=jnp.full(T, -1, jnp.int32),
@@ -201,6 +234,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
             job_pipelined=jnp.zeros(J, bool),
             queue_allocated=queues.allocated,
             rounds=jnp.int32(0),
+            **init_cap,
         )
 
         max_rounds = J if cfg.max_rounds is None else cfg.max_rounds
@@ -209,6 +243,23 @@ def make_allocate_cycle(cfg: AllocateConfig):
         # predicate-cache analog, predicates/cache.go:42-90; see
         # P.template_masks). bool[P, N].
         tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+
+        if use_pallas:
+            from .pallas_place import make_round_placer
+            placer = make_round_placer(cfg, M, N, R, G, interpret=interp)
+            relmp_t = (nodes.releasing - nodes.pipelined).T
+            alloc_t = nodes.allocatable.T
+            cnt_row = nodes.pod_count.astype(jnp.float32)[None, :]
+            maxp_row = nodes.max_pods.astype(jnp.float32)[None, :]
+            gidle0_t = (nodes.gpu_memory - nodes.gpu_used).T
+            if cfg.taint_prefer_weight:
+                rep = jnp.maximum(snap.template_rep, 0)
+                tp_static = cfg.taint_prefer_weight * jax.vmap(
+                    lambda ti: S.taint_prefer_score(
+                        tasks.tol_hash[ti], tasks.tol_effect[ti],
+                        tasks.tol_mode[ti], nodes))(rep)
+            else:
+                tp_static = jnp.zeros((tmpl_static.shape[0], N), jnp.float32)
 
         def eligible(st):
             # Overused queues are skipped (proportion.Overused,
@@ -253,7 +304,41 @@ def make_allocate_cycle(cfg: AllocateConfig):
             min_avail = jobs.min_available[ji]
             ready0 = jobs.ready_num[ji]
 
-            # ---- inner scan: try every pending task of the job ------------
+            # ---- inner placement: try every pending task of the job ------
+            def pallas_round():
+                """One fused kernel launch for the whole round
+                (ops/pallas_place.py) instead of the M-step scan."""
+                tcl = jnp.maximum(task_ids, 0)
+                tmpl_ids = tasks.template[tcl]
+                node_ok = (~(extras.block_nonpreempt[None, :]
+                             & ~tasks.preemptable[tcl][:, None])
+                           & (~extras.node_locked
+                              | (ji == extras.target_job))[None, :])
+                sfeas = (tmpl_static[tmpl_ids] & node_ok).astype(jnp.float32)
+                sscore = tp_static[tmpl_ids]
+                resreq_t = tasks.resreq[tcl].T
+                gpu_req_row = tasks.gpu_request[tcl][None, :]
+                active_row = ((task_ids >= 0)
+                              & ~tasks.best_effort[tcl])[None, :].astype(
+                                  jnp.int32)
+                pref_row = extras.task_pref_node[tcl][None, :]
+                (node_s, mode_s, gpu_s, idle, pipe_extra, pods_extra,
+                 gpu_extra) = placer(
+                    resreq_t, gpu_req_row, active_row, pref_row, sfeas,
+                    sscore, relmp_t, alloc_t, cnt_row, maxp_row, gidle0_t,
+                    st["idle"], st["pipe_extra"], st["pods_extra"],
+                    st["gpu_extra"])
+                tidx = jnp.where(task_ids >= 0, task_ids, T)
+                t_node = st["task_node"].at[tidx].set(node_s, mode="drop")
+                t_mode = st["task_mode"].at[tidx].set(mode_s, mode="drop")
+                t_gpu = st["task_gpu"].at[tidx].set(gpu_s, mode="drop")
+                real = task_ids >= 0
+                n_alloc = jnp.sum((mode_s == MODE_ALLOCATED) & real)
+                n_pipe = jnp.sum((mode_s == MODE_PIPELINED) & real)
+                return (idle, pipe_extra, pods_extra, gpu_extra,
+                        t_node, t_mode, t_gpu,
+                        n_alloc.astype(jnp.int32), n_pipe.astype(jnp.int32))
+
             def task_step(carry, t_idx):
                 (idle, pipe_extra, pods_extra, gpu_extra,
                  t_node, t_mode, t_gpu, n_alloc, n_pipe) = carry
@@ -320,12 +405,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 return (idle, pipe_extra, pods_extra, gpu_extra,
                         t_node, t_mode, t_gpu, n_alloc, n_pipe), None
 
-            carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
-                      st["gpu_extra"], st["task_node"], st["task_mode"],
-                      st["task_gpu"], jnp.int32(0), jnp.int32(0))
-            (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode, t_gpu,
-             n_alloc, n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids,
-                                                unroll=min(int(M), 16))
+            if use_pallas:
+                (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
+                 t_gpu, n_alloc, n_pipe) = pallas_round()
+            else:
+                carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
+                          st["gpu_extra"], st["task_node"], st["task_mode"],
+                          st["task_gpu"], jnp.int32(0), jnp.int32(0))
+                (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
+                 t_gpu, n_alloc, n_pipe), _ = jax.lax.scan(
+                    task_step, carry0, task_ids, unroll=min(int(M), 16))
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
             ready = (ready0 + n_alloc) >= min_avail
@@ -385,6 +474,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             )
 
         final = jax.lax.while_loop(cond, body, init)
+        if use_pallas:
+            final["idle"] = final["idle"].T
         return AllocateResult(
             task_node=final["task_node"],
             task_mode=final["task_mode"],

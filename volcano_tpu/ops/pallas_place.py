@@ -1,0 +1,233 @@
+"""Pallas TPU kernel: one fused placement round of the allocate pass.
+
+The hot inner loop of the cycle places the M pending tasks of the selected
+gang one by one (capacity feedback between placements is what makes the pass
+exact, SURVEY.md section 7 hard part 1). The pure-XLA path runs it as a
+``lax.scan`` whose every step issues ~40 small HLO ops over [N]-shaped
+arrays; this kernel fuses the WHOLE round into one ``pl.pallas_call`` with
+the capacity state (idle, pipelined-extra, pod counts, per-GPU-card usage)
+resident in VMEM across all M placements — one kernel launch per round
+instead of M x ~40.
+
+Layout: node-axis tensors are transposed to [R, N] / [G, N] so the node axis
+is the 128-lane dimension (R/G are tiny; [N, R] would waste 32x lanes).
+
+Semantics are bit-identical to the scan path in allocate_scan.task_step
+(asserted by tests/test_pallas_place.py): same feasibility conjunction, same
+score formulas (ops/scoring.py), same lowest-index argmax tie-break
+(ops/select.py best_node), same lowest-fitting-card GPU pick
+(ops/predicates.py pick_gpu_row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_EPS_FIT = 1e-5     # predicates._EPS
+_EPS_DIV = 1e-9     # scoring._EPS
+NEG = -1e30         # select.NEG
+
+
+def _dyn_score(cfg, idle, alloc_t, rr_col):
+    """Idle-dependent score terms in [R, N] layout — transposed but
+    float-op-for-float-op identical to ops/scoring.py (reductions run over
+    the same R elements in the same order, so f32 results match bitwise)."""
+    used = alloc_t - idle
+    N = idle.shape[1]
+    score = jnp.zeros((1, N), jnp.float32)
+    if cfg.binpack_weight:
+        applicable = (rr_col > 0) & (alloc_t > 0)   # weights all-ones
+        frac = jnp.where(applicable,
+                         (used + rr_col) / jnp.maximum(alloc_t, _EPS_DIV), 0.0)
+        over = frac > 1.0 + 1e-6
+        w = 1.0 * applicable
+        wsum = jnp.sum(w, axis=0, keepdims=True)
+        raw = jnp.sum(frac * w, axis=0, keepdims=True) \
+            / jnp.maximum(wsum, _EPS_DIV)
+        raw = jnp.where(jnp.any(over, axis=0, keepdims=True), 0.0, raw)
+        score += cfg.binpack_weight * raw * 100.0
+    if cfg.least_allocated_weight:
+        cap = jnp.maximum(alloc_t, _EPS_DIV)
+        free_frac = (alloc_t - used - rr_col) / cap
+        counted = alloc_t > 0
+        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True), 1)
+        score += cfg.least_allocated_weight * (
+            jnp.sum(jnp.clip(free_frac, 0.0, 1.0) * counted, axis=0,
+                    keepdims=True) / n * 100.0)
+    if cfg.most_allocated_weight:
+        cap = jnp.maximum(alloc_t, _EPS_DIV)
+        used_frac = (used + rr_col) / cap
+        counted = alloc_t > 0
+        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True), 1)
+        score += cfg.most_allocated_weight * (
+            jnp.sum(jnp.clip(used_frac, 0.0, 1.0) * counted, axis=0,
+                    keepdims=True) / n * 100.0)
+    if cfg.balanced_weight:
+        cap = jnp.maximum(alloc_t, _EPS_DIV)
+        frac = jnp.clip((used + rr_col) / cap, 0.0, 1.0)
+        counted = (alloc_t > 0).astype(frac.dtype)
+        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True), 1.0)
+        mean = jnp.sum(frac * counted, axis=0, keepdims=True) / n
+        var = jnp.sum(((frac - mean) ** 2) * counted, axis=0,
+                      keepdims=True) / n
+        score += cfg.balanced_weight * (1.0 - jnp.sqrt(var)) * 100.0
+    return score
+
+
+def _round_kernel(cfg, M, N, R, G,
+                  # inputs
+                  resreq_t_ref, gpu_req_ref, active_ref, pref_ref, sfeas_ref,
+                  sscore_ref, relmp_ref, alloc_t_ref, cnt_ref, maxp_ref,
+                  gidle0_ref, idle_ref, pipe_ref, podsx_ref, gpux_ref,
+                  # outputs
+                  node_ref, mode_ref, gpu_ref,
+                  idle_o_ref, pipe_o_ref, podsx_o_ref, gpux_o_ref):
+    relmp = relmp_ref[:]
+    alloc_t = alloc_t_ref[:]
+    cnt = cnt_ref[:]
+    maxp = maxp_ref[:]
+    gidle0 = gidle0_ref[:]
+    resreq_t = resreq_t_ref[:]      # [R, M]
+    gpu_req = gpu_req_ref[:]        # [1, M]
+    active_v = active_ref[:]        # [1, M] int32
+    pref_v = pref_ref[:]            # [1, M] int32
+    sfeas = sfeas_ref[:]            # [M, N] f32 0/1
+    sscore = sscore_ref[:]          # [M, N]
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+    iota_m = jax.lax.broadcasted_iota(jnp.int32, (1, M), 1)
+    iota_m_col = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)
+
+    def body(m, carry):
+        # mosaic has no dynamic lane/sublane indexing, so the per-task row
+        # selections are one-hot reductions
+        idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v = carry
+        sel_m = (iota_m == m).astype(jnp.float32)            # [1,M]
+        sel_col = (iota_m_col == m).astype(jnp.float32)      # [M,1]
+        rr_col = jnp.sum(resreq_t * sel_m, axis=1, keepdims=True)   # [R,1]
+        gr = jnp.sum(gpu_req * sel_m, axis=1, keepdims=True)        # [1,1]
+        act = jnp.sum(active_v * sel_m.astype(jnp.int32), axis=1,
+                      keepdims=True)                                # [1,1]
+        pref = jnp.sum(pref_v * sel_m.astype(jnp.int32), axis=1,
+                       keepdims=True)                               # [1,1]
+        sfeas_m = jnp.sum(sfeas * sel_col, axis=0, keepdims=True)   # [1,N]
+        sscore_m = jnp.sum(sscore * sel_col, axis=0, keepdims=True)
+
+        future = jnp.maximum(idle + relmp - pipe, 0.0)
+        pods_ok = (cnt + podsx) < maxp
+        gidle = gidle0 - gpux
+        gpu_ok = (gr <= 0) | jnp.any(gidle >= gr - _EPS_FIT, axis=0,
+                                     keepdims=True)
+        shared = (sfeas_m > 0) & pods_ok & gpu_ok
+        fit_now = jnp.all(rr_col <= idle + _EPS_FIT, axis=0, keepdims=True)
+        fit_fut = jnp.all(rr_col <= future + _EPS_FIT, axis=0, keepdims=True)
+        feas_now = shared & fit_now
+        feas_fut = shared & fit_fut
+
+        # addition order matches allocate_scan exactly (float associativity):
+        # dyn terms (binpack..balanced), then taint-static, then preference
+        score = _dyn_score(cfg, idle, alloc_t, rr_col)
+        score = score + sscore_m
+        score = score + jnp.where((pref >= 0) & (iota_n == pref),
+                                  100.0, 0.0)
+
+        def pick(feas):
+            # scalar reductions go through int32 (mosaic cannot squeeze
+            # bool arrays to scalars)
+            masked = jnp.where(feas, score, NEG)
+            best = jnp.max(masked)
+            idx = jnp.min(jnp.where(masked == best, iota_n, N))
+            found = jnp.max(feas.astype(jnp.int32)) > 0
+            return idx, found
+
+        n_now, found_now = pick(feas_now)
+        n_fut, found_fut = pick(feas_fut)
+        active = act[0, 0] > 0          # act is int32 [1,1]
+        can_now = found_now & active
+        can_fut = found_fut & active & bool(cfg.enable_pipelining)
+        do_alloc = can_now
+        do_pipe = (~can_now) & can_fut
+        placed = do_alloc | do_pipe
+        node = jnp.where(do_alloc, n_now, n_fut)
+
+        onehot = (iota_n == node).astype(jnp.float32)               # [1,N]
+        idle = idle - jnp.where(do_alloc, 1.0, 0.0) * rr_col * onehot
+        pipe = pipe + jnp.where(do_pipe, 1.0, 0.0) * rr_col * onehot
+        podsx = podsx + jnp.where(placed, 1.0, 0.0) * onehot
+
+        # lowest fitting card on the chosen node (pick_gpu_row)
+        gcol = jnp.sum(gidle * onehot, axis=1, keepdims=True)       # [G,1]
+        gfits = gcol >= gr - _EPS_FIT
+        card = jnp.min(jnp.where(gfits, iota_g, G))
+        gpu_ok_pick = (jnp.max(gfits.astype(jnp.int32)) > 0) & (gr[0, 0] > 0)
+        card = jnp.where(gpu_ok_pick, card, -1)
+        charge = placed & (card >= 0)
+        gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
+                       * (iota_g == jnp.maximum(card, 0)) * onehot)
+
+        mode = jnp.where(do_alloc, 1, jnp.where(do_pipe, 2, 0))
+        is_m = iota_m == m
+        node_v = jnp.where(is_m, jnp.where(placed, node, -1), node_v)
+        mode_v = jnp.where(is_m, mode, mode_v)
+        gpuc_v = jnp.where(is_m, jnp.where(charge, card, -1), gpuc_v)
+        return idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v
+
+    neg1 = jnp.full((1, M), -1, jnp.int32)
+    idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v = jax.lax.fori_loop(
+        0, M, body,
+        (idle_ref[:], pipe_ref[:], podsx_ref[:], gpux_ref[:],
+         neg1, jnp.zeros((1, M), jnp.int32), neg1))
+    node_ref[:] = node_v
+    mode_ref[:] = mode_v
+    gpu_ref[:] = gpuc_v
+    idle_o_ref[:] = idle
+    pipe_o_ref[:] = pipe
+    podsx_o_ref[:] = podsx
+    gpux_o_ref[:] = gpux
+
+
+def make_round_placer(cfg, M: int, N: int, R: int, G: int,
+                      interpret: bool = False):
+    """Build the fused round placer.
+
+    Returns place(resreq_t [R,M], gpu_req [1,M], active [1,M], pref [1,M],
+    sfeas [M,N], sscore [M,N] (taint-static), relmp [R,N], alloc_t [R,N],
+    cnt [1,N], maxp [1,N], gidle0 [G,N], idle [R,N], pipe [R,N],
+    podsx [1,N], gpux [G,N])
+    -> (node [M], mode [M], gpu [M], idle', pipe', podsx', gpux').
+    """
+    kernel = functools.partial(_round_kernel, cfg, M, N, R, G)
+    f32 = jnp.float32
+
+    def place(resreq_t, gpu_req, active, pref, sfeas, sscore, relmp, alloc_t,
+              cnt, maxp, gidle0, idle, pipe, podsx, gpux):
+        outs = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((1, M), jnp.int32),   # node
+                jax.ShapeDtypeStruct((1, M), jnp.int32),   # mode
+                jax.ShapeDtypeStruct((1, M), jnp.int32),   # gpu
+                jax.ShapeDtypeStruct((R, N), f32),         # idle'
+                jax.ShapeDtypeStruct((R, N), f32),         # pipe'
+                jax.ShapeDtypeStruct((1, N), f32),         # podsx'
+                jax.ShapeDtypeStruct((G, N), f32),         # gpux'
+            ),
+            interpret=interpret,
+        )(resreq_t, gpu_req, active, pref, sfeas, sscore, relmp, alloc_t,
+          cnt, maxp, gidle0, idle, pipe, podsx, gpux)
+        node, mode, gpu, idle2, pipe2, podsx2, gpux2 = outs
+        return (node[0], mode[0], gpu[0], idle2, pipe2, podsx2, gpux2)
+
+    return place
+
+
+def vmem_estimate_bytes(M: int, N: int, R: int, G: int) -> int:
+    """Rough VMEM footprint of the kernel's live values."""
+    per_n = (4 * R * 6 + 4 * G * 3 + 4 * 4) * N     # [R,N]/[G,N]/[1,N] f32
+    per_mn = (4 + 4) * M * N                        # sfeas + sscore
+    return per_n + per_mn

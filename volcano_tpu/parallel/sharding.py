@@ -67,7 +67,15 @@ def node_sharding_specs(mesh: Mesh, snap: SnapshotArrays):
 
 def make_sharded_allocate(cfg: AllocateConfig, mesh: Mesh,
                           snap: SnapshotArrays):
-    """jit the allocate cycle with the node axis sharded over ``mesh``."""
+    """jit the allocate cycle with the node axis sharded over ``mesh``.
+
+    Forces the pure-XLA scan path: GSPMD has no partitioning rule for the
+    pallas custom call, so letting use_pallas auto-enable here would at best
+    replicate the full node axis on every device (defeating the sharding)
+    and at worst fail to compile.
+    """
+    import dataclasses
+    cfg = dataclasses.replace(cfg, use_pallas=False)
     snap_shardings, rep = node_sharding_specs(mesh, snap)
     extras_rep = None  # let GSPMD replicate extras by default
     fn = make_allocate_cycle(cfg)
