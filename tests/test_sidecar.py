@@ -1,0 +1,88 @@
+"""Sidecar service tests: snapshot-in/decisions-out over a real socket
+(SURVEY.md section 5.8 distributed backbone), decisions identical to an
+in-process cycle."""
+
+import numpy as np
+import jax
+import pytest
+
+from volcano_tpu import native
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops import AllocateConfig, make_allocate_cycle
+from volcano_tpu.ops.allocate_scan import AllocateExtras
+from volcano_tpu.runtime.sidecar import SidecarClient, SidecarServer
+
+from fixtures import build_job, build_task, simple_cluster
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native packer unavailable: {native.build_error()}")
+
+
+def cluster():
+    ci = simple_cluster(n_nodes=3)
+    for j in range(3):
+        job = build_job(f"default/j{j}", min_available=2)
+        for t in range(2):
+            job.add_task(build_task(f"j{j}-t{t}", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+    return ci
+
+
+class TestSidecar:
+    def test_round_trip_matches_local(self):
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            client = SidecarClient(*server.address)
+            ci = cluster()
+            out = client.schedule(ci)
+            # local oracle on the same snapshot
+            snap, maps = pack(ci)
+            local = jax.jit(make_allocate_cycle(
+                AllocateConfig(binpack_weight=1.0)))(
+                    snap, AllocateExtras.neutral(snap))
+            np.testing.assert_array_equal(out["task_node"],
+                                          np.asarray(local.task_node))
+            np.testing.assert_array_equal(out["task_mode"],
+                                          np.asarray(local.task_mode))
+            assert len(out["binds"]) == 6
+            assert all(node.startswith("n") for node, _ in
+                       out["binds"].values())
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_multiple_cycles_one_connection(self):
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            client = SidecarClient(*server.address)
+            first = client.schedule(cluster())
+            second = client.schedule(cluster())
+            np.testing.assert_array_equal(first["task_node"],
+                                          second["task_node"])
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_error_reply_keeps_connection(self):
+        import socket, struct
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            sock = socket.create_connection(server.address, timeout=30)
+            garbage = b"nonsense"
+            sock.sendall(struct.pack("<I", len(garbage)) + garbage)
+            status = struct.unpack("<I", sock.recv(4))[0]
+            assert status == 1
+            n = struct.unpack("<I", sock.recv(4))[0]
+            sock.recv(n)
+            # connection still usable for a real request
+            client = SidecarClient(*server.address)
+            out = client.schedule(cluster())
+            assert len(out["binds"]) == 6
+            client.close()
+            sock.close()
+        finally:
+            server.shutdown()

@@ -2,5 +2,6 @@
 
 from .fake_cluster import FakeCluster
 from .scheduler import Scheduler
+from .sidecar import SidecarClient, SidecarServer
 
-__all__ = ["FakeCluster", "Scheduler"]
+__all__ = ["FakeCluster", "Scheduler", "SidecarClient", "SidecarServer"]

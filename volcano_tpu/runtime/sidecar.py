@@ -1,0 +1,187 @@
+"""The TPU scheduling sidecar: snapshot-in / decisions-out over a socket.
+
+SURVEY.md section 5.8's distributed backbone for the north star: the
+API-layer process (the Go-equivalent control plane) serializes its cluster
+snapshot to this sidecar over the host network; the sidecar packs it with the
+native C++ packer (native/packer.cc, VCS1 wire format), runs the compiled
+TPU cycle, and streams the decision arrays back on the same connection. The
+reference needs no such component because its scheduler computes in-process
+(pkg/scheduler/scheduler.go:91 runOnce); here the compute lives on the TPU
+host, so the cycle boundary is a wire protocol.
+
+Framing (little-endian):
+    request:  u32 len | VCS1 snapshot buffer (native/wire.py serialize)
+    response: u32 status (0 ok) | u32 len | payload
+        ok payload: u32 magic 'VCD1' | u32 T | u32 J |
+                    i32[T] task_node | i32[T] task_mode | i32[T] task_gpu |
+                    u8[J] job_ready | u8[J] job_pipelined
+        error payload: UTF-8 message
+
+One request per connection round; connections persist for many cycles.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.allocate_scan import AllocateConfig, AllocateExtras
+
+DECISION_MAGIC = 0x31444356  # "VCD1"
+_u32 = struct.Struct("<I")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, status: int, payload: bytes) -> None:
+    sock.sendall(_u32.pack(status) + _u32.pack(len(payload)) + payload)
+
+
+class SchedulerSidecar:
+    """Owns the jitted cycle; one instance per TPU process."""
+
+    def __init__(self, cfg: Optional[AllocateConfig] = None):
+        import jax
+        from ..ops.allocate_scan import make_allocate_cycle
+        self.cfg = cfg or AllocateConfig(binpack_weight=1.0)
+        cycle = make_allocate_cycle(self.cfg)
+        self._fn = jax.jit(lambda s, e: cycle(s, e).packed_decisions())
+
+    def schedule_buffer(self, buf: bytes) -> bytes:
+        """VCS1 snapshot buffer -> VCD1 decision payload."""
+        from ..native import available, pack_wire
+        if available():
+            snap = pack_wire(buf)
+        else:  # pure-Python fallback keeps the sidecar usable without g++
+            raise RuntimeError("native packer unavailable on this host")
+        T = int(np.asarray(snap.tasks.status).shape[0])
+        J = int(np.asarray(snap.jobs.min_available).shape[0])
+        extras = AllocateExtras.neutral(snap)
+        packed = np.asarray(self._fn(snap, extras), dtype=np.int32)
+        task_node = packed[:T]
+        task_mode = packed[T:2 * T]
+        task_gpu = packed[2 * T:3 * T]
+        job_ready = packed[3 * T:3 * T + J].astype(np.uint8)
+        job_pipelined = packed[3 * T + J:3 * T + 2 * J].astype(np.uint8)
+        return b"".join([
+            _u32.pack(DECISION_MAGIC), _u32.pack(T), _u32.pack(J),
+            task_node.astype("<i4").tobytes(),
+            task_mode.astype("<i4").tobytes(),
+            task_gpu.astype("<i4").tobytes(),
+            job_ready.tobytes(), job_pipelined.tobytes(),
+        ])
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                (n,) = _u32.unpack(_recv_exact(self.request, 4))
+            except ConnectionError:
+                return
+            try:
+                buf = _recv_exact(self.request, n)
+                payload = self.server.sidecar.schedule_buffer(buf)
+                _send_frame(self.request, 0, payload)
+            except ConnectionError:
+                return
+            except Exception as e:  # report, keep serving
+                _send_frame(self.request, 1, str(e).encode())
+
+
+class SidecarServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 cfg: Optional[AllocateConfig] = None):
+        self.sidecar = SchedulerSidecar(cfg)
+        super().__init__((host, port), _Handler)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server_address[:2]
+
+    def serve_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+
+class SidecarClient:
+    """The API-layer half: ships ClusterInfo snapshots, maps decisions back
+    to task/job uids (the Binder seam's input)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def schedule(self, ci) -> Dict[str, object]:
+        from ..native.wire import serialize
+        buf, maps = serialize(ci)
+        self.sock.sendall(_u32.pack(len(buf)) + buf)
+        (status,) = _u32.unpack(_recv_exact(self.sock, 4))
+        (n,) = _u32.unpack(_recv_exact(self.sock, 4))
+        payload = _recv_exact(self.sock, n)
+        if status != 0:
+            raise RuntimeError(f"sidecar error: {payload.decode()}")
+        (magic,) = _u32.unpack(payload[:4])
+        if magic != DECISION_MAGIC:
+            raise ValueError("bad decision magic")
+        T, J = struct.unpack("<II", payload[4:12])
+        off = 12
+        task_node = np.frombuffer(payload, "<i4", T, off); off += 4 * T
+        task_mode = np.frombuffer(payload, "<i4", T, off); off += 4 * T
+        task_gpu = np.frombuffer(payload, "<i4", T, off); off += 4 * T
+        job_ready = np.frombuffer(payload, "u1", J, off).astype(bool)
+        off += J
+        job_pipelined = np.frombuffer(payload, "u1", J, off).astype(bool)
+        binds = {}
+        for uid, ti in maps.task_index.items():
+            if task_mode[ti] == 1:
+                binds[uid] = (maps.node_names[task_node[ti]],
+                              int(task_gpu[ti]))
+        return {
+            "binds": binds,
+            "task_node": task_node, "task_mode": task_mode,
+            "task_gpu": task_gpu, "job_ready": job_ready,
+            "job_pipelined": job_pipelined, "maps": maps,
+        }
+
+
+def main(argv=None) -> int:
+    """`python -m volcano_tpu.runtime.sidecar` — the standalone binary the
+    API layer points its scheduling cycle at."""
+    import argparse
+    parser = argparse.ArgumentParser(description="TPU scheduling sidecar")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9099)
+    parser.add_argument("--binpack-weight", type=float, default=1.0)
+    args = parser.parse_args(argv)
+    server = SidecarServer(args.host, args.port,
+                           AllocateConfig(binpack_weight=args.binpack_weight))
+    print(f"sidecar listening on {server.address[0]}:{server.address[1]}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
