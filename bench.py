@@ -68,17 +68,19 @@ def _drain(result):
 
 def _time_tpu(cycle_fn, snap, extras, reps):
     """Times snapshot-in -> decisions-on-host-out, the full cycle a real
-    scheduler pays: upload (numpy inputs), compute, ONE packed readback
-    (AllocateResult.packed_decisions; the tunnel charges per fetch)."""
-    import jax
-    packed_fn = jax.jit(lambda s, e: cycle_fn(s, e).packed_decisions())
+    scheduler pays: host fuse + 3-buffer upload (ops/fused_io; the tunnel
+    charges per transfer), compute, ONE packed readback
+    (AllocateResult.packed_decisions)."""
+    from volcano_tpu.ops.fused_io import make_fused_cycle
+    inner = getattr(cycle_fn, "__wrapped__", cycle_fn)
+    fn, fuse = make_fused_cycle(inner, (snap, extras))
     t0 = time.time()
-    np.asarray(packed_fn(snap, extras))
+    np.asarray(fn(*fuse((snap, extras))))
     compile_s = time.time() - t0
     times = []
     for _ in range(reps):
         t0 = time.time()
-        packed = np.asarray(packed_fn(snap, extras))
+        packed = np.asarray(fn(*fuse((snap, extras))))
         times.append(time.time() - t0)
     # full result (for equality checks), outside the timed region
     result = cycle_fn(snap, extras)

@@ -162,3 +162,77 @@ def test_v_binaries_state_file_mode(tmp_path):
     assert vbin.vsub(["--state", str(state), "-f", str(manifest)]) == 0
     assert state.exists()
     assert vbin.vjobs(["--state", str(state)]) == 0
+
+
+class TestSnapshotNodeGating:
+    """Snapshot node filters (cache.go:712-750): NotReady/OutOfSync nodes,
+    nodes with in-flight binding tasks, and the dedicated-node label gate."""
+
+    def _system(self, n=2):
+        from volcano_tpu.runtime.system import VolcanoSystem
+        sys_ = VolcanoSystem()
+        for i in range(n):
+            sys_.add_node(f"n{i}", cpu="4", memory="8Gi")
+        return sys_
+
+    def test_binding_node_skipped(self):
+        sys_ = self._system()
+        sys_.api.get("nodes", "n0").add_binding_task("default/in-flight")
+        ci = sys_.cache.snapshot()
+        assert "n0" not in ci.nodes and "n1" in ci.nodes
+        sys_.api.get("nodes", "n0").remove_binding_task("default/in-flight")
+        assert "n0" in sys_.cache.snapshot().nodes
+
+    def test_out_of_sync_node_skipped(self):
+        """A node whose declared allocatable shrinks below its accounted
+        pods goes OutOfSync and leaves the pool (setNodeState,
+        node_info.go:143-149)."""
+        from volcano_tpu.api.core import Pod
+        from volcano_tpu.api.resource import Resource
+        sys_ = self._system()
+        from volcano_tpu.api.core import POD_GROUP_ANNOTATION
+        pod = Pod(name="big", resources={"cpu": "4", "memory": "1Gi"},
+                  node_name="n0", phase="Running",
+                  annotations={POD_GROUP_ANNOTATION: "pg-big"})
+        sys_.api.create("pods", pod)
+        from volcano_tpu.api.core import PodGroup
+        sys_.api.create("podgroups", PodGroup(name="pg-big", min_member=1))
+        node = sys_.api.get("nodes", "n0")
+        node.allocatable = Resource.from_resource_list(
+            {"cpu": "2", "memory": "8Gi"})   # shrank below the running pod
+        ci = sys_.cache.snapshot()
+        assert "n0" not in ci.nodes and "n1" in ci.nodes
+
+    def test_dedicated_label_gates_pool(self):
+        from volcano_tpu.runtime.cache import DEDICATED_NODE_LABEL
+        sys_ = self._system(3)
+        sys_.api.get("nodes", "n1").labels[DEDICATED_NODE_LABEL] = "true"
+        ci = sys_.cache.snapshot()
+        assert set(ci.nodes) == {"n1"}
+
+    def test_gpu_index_round_trips_through_store(self):
+        """A bound GPU pod's card assignment survives into later snapshots
+        (the GPUIndex patch, pod_info.go:154-160)."""
+        from volcano_tpu.api import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE,
+                                     PodGroupPhase)
+        from volcano_tpu.api.batch import Job, PodTemplate, TaskSpec
+        from volcano_tpu.runtime.system import VolcanoSystem
+        sys_ = VolcanoSystem()
+        from volcano_tpu.api.node_info import NodeInfo
+        from volcano_tpu.api.resource import Resource
+        sys_.api.create("nodes", NodeInfo(
+            "g0", allocatable=Resource.from_resource_list(
+                {"cpu": "8", "memory": "16Gi",
+                 GPU_MEMORY_RESOURCE: 16, GPU_NUMBER_RESOURCE: 2})))
+        job = Job(name="trainer", min_available=1, tasks=[
+            TaskSpec(name="t", replicas=1, template=PodTemplate(
+                resources={"cpu": "1", "memory": "1Gi",
+                           GPU_MEMORY_RESOURCE: 6}))])
+        sys_.submit_job(job)
+        for _ in range(2):
+            sys_.tick()
+        pod = sys_.pods_of("trainer")[0]
+        assert pod.gpu_index == 0
+        ci = sys_.cache.snapshot()
+        node = ci.nodes["g0"]
+        assert node.gpu_devices[0].used_memory() == 6

@@ -50,6 +50,8 @@ class Pod:
 
     phase: str = PodPhase.PENDING
     node_name: str = ""
+    gpu_index: int = -1   # assigned shared-GPU card (the GPUIndex
+    #                       annotation patch, pod_info.go:154-160)
     exit_code: Optional[int] = None
     deletion_timestamp: Optional[float] = None
 

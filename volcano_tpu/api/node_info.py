@@ -79,6 +79,11 @@ class NodeInfo:
         if total_mem > 0 and n_cards > 0:
             per_card = total_mem / n_cards
             self.gpu_devices = [GPUDevice(i, per_card) for i in range(n_cards)]
+        # tasks with an in-flight bind RPC (fork feature: such nodes are
+        # skipped by Snapshot until the bind lands; node_info.go:54-56,
+        # cache.go:735-738)
+        self.binding_tasks: set = set()
+        self.state_reason: str = ""
 
     # ----------------------------------------------------------------- state
     def future_idle(self) -> Resource:
@@ -89,19 +94,31 @@ class NodeInfo:
         return len(self.tasks)
 
     # -------------------------------------------------------------- mutation
-    def add_task(self, task: TaskInfo) -> None:
-        """Reference: AddTask, node_info.go:247-292."""
+    def add_task(self, task: TaskInfo, force: bool = False) -> None:
+        """Reference: AddTask, node_info.go:247-292. Raises without mutating
+        when the task cannot fit current idle (allocateIdleResource,
+        node_info.go:235-242). ``force`` is for cache ingestion of
+        already-running pods: usage is accounted even past allocatable, and
+        :meth:`sync_state` then flags the node OutOfSync — the reference
+        reaches the same state by keeping stale tasks across SetNode
+        (setNodeState, node_info.go:143-149)."""
         if task.uid in self.tasks:
             raise ValueError(f"task {task.uid} already on node {self.name}")
+        occupies = (task.status == TaskStatus.RELEASING
+                    or is_allocated_status(task.status))
+        if occupies and not force and not task.resreq.less_equal(self.idle):
+            raise ValueError(
+                f"selected node NotReady: {task.uid} does not fit idle of "
+                f"{self.name}")
         if task.status == TaskStatus.RELEASING:
             self.used.add(task.resreq)
             self.releasing.add(task.resreq)
-            self.idle.sub(task.resreq)
+            self.idle.sub_floored(task.resreq)
         elif task.status == TaskStatus.PIPELINED:
             self.pipelined.add(task.resreq)
         elif is_allocated_status(task.status):
             self.used.add(task.resreq)
-            self.idle.sub(task.resreq)
+            self.idle.sub_floored(task.resreq)
         # terminal statuses (Succeeded/Failed) occupy nothing — including GPU
         # cards (getUsedGPUMemory skips Succeeded/Failed pods,
         # device_info.go:42-53)
@@ -159,12 +176,36 @@ class NodeInfo:
         self.remove_task(task)
         self.add_task(task)
 
+    # ------------------------------------------------------- binding tasks
+    def add_binding_task(self, task_uid: str) -> None:
+        """Reference: AddBindingTask, node_info.go:429-432."""
+        self.binding_tasks.add(task_uid)
+
+    def remove_binding_task(self, task_uid: str) -> None:
+        """Reference: RemoveBindingTask, node_info.go:434-437."""
+        self.binding_tasks.discard(task_uid)
+
+    # ------------------------------------------------------- state machine
+    def sync_state(self) -> None:
+        """Recompute the Ready/NotReady state (setNodeState,
+        node_info.go:133-170): a node whose accounted usage exceeds its
+        declared allocatable is OutOfSync and leaves the schedulable pool
+        until the accounts reconcile."""
+        if not self.used.less_equal(self.allocatable):
+            self.ready = False
+            self.state_reason = "OutOfSync"
+        elif self.state_reason == "OutOfSync":
+            self.ready = True
+            self.state_reason = ""
+
     def clone(self) -> "NodeInfo":
         n = NodeInfo(self.name, self.allocatable.clone(), self.capability.clone(),
                      dict(self.labels), list(self.taints), self.unschedulable,
                      self.ready, self.max_pods)
         for task in self.tasks.values():
-            n.add_task(task.clone())
+            n.add_task(task.clone(), force=True)
+        n.binding_tasks = set(self.binding_tasks)
+        n.state_reason = self.state_reason
         return n
 
     def __repr__(self) -> str:

@@ -1,0 +1,93 @@
+"""Fused host->device snapshot transfer.
+
+The axon TPU tunnel charges per-transfer latency, and a (snap, extras) pytree
+is ~67 leaves — uploading them individually costs more than the bytes do.
+This module flattens the pytree host-side into one buffer per dtype family
+(f32 / i32 / bool), so a cycle pays 3 uploads, and rebuilds the tree with
+static slices inside the jitted program (free: XLA sees constant offsets).
+
+Used by bench.py and the sidecar for the production cycle path; the
+per-bucket slice spec is static, so jit caches one program per shape bucket
+exactly as before.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_GROUPS = ("f", "i", "b")
+
+
+def _group_of(dtype) -> str:
+    kind = np.dtype(dtype).kind
+    if kind == "f":
+        return "f"
+    if kind in ("i", "u"):
+        return "i"
+    if kind == "b":
+        return "b"
+    raise TypeError(f"unsupported dtype {dtype}")
+
+
+def fuse_spec(tree) -> Tuple[Any, List[Tuple[str, int, tuple, Any]]]:
+    """(treedef, per-leaf (group, offset, shape, dtype)) for a pytree of
+    arrays. Offsets are in elements within the group buffer."""
+    leaves, treedef = jax.tree.flatten(tree)
+    offsets = {g: 0 for g in _GROUPS}
+    spec = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        g = _group_of(arr.dtype)
+        spec.append((g, offsets[g], arr.shape, arr.dtype))
+        offsets[g] += arr.size
+    return treedef, spec
+
+
+def fuse(tree) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side: pytree -> (f32 buffer, i32 buffer, bool buffer)."""
+    leaves = jax.tree.leaves(tree)
+    groups = {"f": [], "i": [], "b": []}
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        g = _group_of(arr.dtype)
+        target = {"f": np.float32, "i": np.int32, "b": np.bool_}[g]
+        groups[g].append(np.ravel(arr).astype(target, copy=False))
+    out = []
+    for g in _GROUPS:
+        out.append(np.concatenate(groups[g]) if groups[g]
+                   else np.zeros(0, {"f": np.float32, "i": np.int32,
+                                     "b": np.bool_}[g]))
+    return tuple(out)
+
+
+def make_unfuse(treedef, spec) -> Callable:
+    """Device-side: (fbuf, ibuf, bbuf) -> pytree, via static slices."""
+
+    def unfuse(fbuf, ibuf, bbuf):
+        bufs = {"f": fbuf, "i": ibuf, "b": bbuf}
+        leaves = []
+        for g, off, shape, dtype in spec:
+            size = int(np.prod(shape)) if shape else 1
+            leaf = bufs[g][off:off + size].reshape(shape).astype(dtype)
+            leaves.append(leaf)
+        return jax.tree.unflatten(treedef, leaves)
+
+    return unfuse
+
+
+def make_fused_cycle(cycle_fn, example_tree):
+    """Wrap a cycle over (snap, extras) into fn(fbuf, ibuf, bbuf) with the
+    tree rebuilt on device. Returns (jitted_fn, fuse_inputs)."""
+    treedef, spec = fuse_spec(example_tree)
+    unfuse = make_unfuse(treedef, spec)
+
+    @jax.jit
+    def fn(fbuf, ibuf, bbuf):
+        snap, extras = unfuse(fbuf, ibuf, bbuf)
+        return cycle_fn(snap, extras).packed_decisions()
+
+    return fn, fuse

@@ -20,6 +20,10 @@ from ..api.types import DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME, QueueState
 from ..framework.session import BindIntent, EvictIntent
 from .apiserver import APIServer
 
+#: Fork feature: when any node carries this label with value "true", the
+#: snapshot only includes dedicated nodes (cache.go:719-745).
+DEDICATED_NODE_LABEL = "volcano.sh/dedicated-node"
+
 _POD_PHASE_TO_STATUS = {
     PodPhase.PENDING: TaskStatus.PENDING,
     PodPhase.RUNNING: TaskStatus.RUNNING,
@@ -83,6 +87,7 @@ class SchedulerCache:
                 uid=pod.key, name=pod.name, namespace=pod.namespace,
                 task_role=pod.task_role, resreq=pod.resreq(),
                 status=status, priority=pod.priority,
+                gpu_index=pod.gpu_index,
                 node_selector=dict(pod.node_selector),
                 tolerations=list(pod.tolerations))
             task.node_name = pod.node_name
@@ -90,7 +95,27 @@ class SchedulerCache:
             if pod.node_name and pod.node_name in ci.nodes and status not in (
                     TaskStatus.SUCCEEDED, TaskStatus.FAILED,
                     TaskStatus.UNKNOWN):
-                ci.nodes[pod.node_name].add_task(task)
+                # forced ingestion: running pods are accounted even if the
+                # node shrank; sync_state below then flags it OutOfSync
+                ci.nodes[pod.node_name].add_task(task, force=True)
+
+        # Node gating (Snapshot, cache.go:712-750): drop nodes that are
+        # NotReady/OutOfSync, nodes with in-flight binding tasks (fork:
+        # cache.go:735-738), and — when any node carries the dedicated label
+        # — every non-dedicated node.
+        has_dedicated = any(
+            n.labels.get(DEDICATED_NODE_LABEL) == "true"
+            for n in ci.nodes.values())
+        for name in list(ci.nodes):
+            node = ci.nodes[name]
+            node.sync_state()
+            if not node.ready:
+                del ci.nodes[name]
+            elif node.binding_tasks:
+                del ci.nodes[name]
+            elif has_dedicated and \
+                    node.labels.get(DEDICATED_NODE_LABEL) != "true":
+                del ci.nodes[name]
         return ci
 
     # ----------------------------------------------------------- bind/evict
@@ -99,8 +124,17 @@ class SchedulerCache:
         node = self.api.get("nodes", intent.node_name)
         if pod is None or node is None:
             return False
-        pod.node_name = intent.node_name
-        self.api.update("pods", pod)
+        # mark the in-flight bind so concurrent snapshots skip this node
+        # (cache.go:585-595); cleared once the pod write lands. With this
+        # synchronous store the window closes immediately, but async
+        # backends inherit the seam.
+        node.add_binding_task(intent.task_uid)
+        try:
+            pod.node_name = intent.node_name
+            pod.gpu_index = intent.gpu_index
+            self.api.update("pods", pod)
+        finally:
+            node.remove_binding_task(intent.task_uid)
         self.binds.append((intent.task_uid, intent.node_name))
         return True
 
