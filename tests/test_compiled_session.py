@@ -1,0 +1,84 @@
+"""The compiled session (framework/compiled_session.py): one jittable
+program covering kernel + array-level plugin extras must make the same
+decisions as the object Session pipeline under the same policy."""
+
+import numpy as np
+import jax
+import pytest
+
+from volcano_tpu.arrays import pack
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.framework.compiled_session import (
+    allocate_config_from_conf, make_conf_cycle)
+from volcano_tpu.runtime import FakeCluster, Scheduler
+
+from fixtures import build_job, build_task, simple_cluster
+from volcano_tpu.api import QueueInfo
+
+DEFAULT_CONF = open("conf/volcano-scheduler.conf").read()
+
+
+def contended_cluster():
+    """Two queues with different weights, more demand than capacity, so
+    proportion's deserved and drf's shares actually matter."""
+    ci = simple_cluster(n_nodes=2, node_cpu="4")
+    ci.add_queue(QueueInfo("batch", weight=3))
+    for j in range(4):
+        queue = "default" if j % 2 == 0 else "batch"
+        job = build_job(f"default/j{j}", queue=queue, min_available=2)
+        for t in range(2):
+            job.add_task(build_task(f"j{j}-t{t}", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+    return ci
+
+
+class TestCompiledSession:
+    def test_config_derived_from_conf(self):
+        cfg = allocate_config_from_conf(parse_conf(DEFAULT_CONF))
+        assert cfg.enable_gang
+        assert cfg.binpack_weight == 1.0          # binpack plugin default
+        assert cfg.least_allocated_weight == 1.0  # nodeorder default
+
+    def test_matches_session_pipeline(self):
+        ci = contended_cluster()
+        # object-session path: full Scheduler allocate under the default conf
+        sched = Scheduler(FakeCluster(ci.clone()),
+                          conf=parse_conf(DEFAULT_CONF))
+        ssn = sched.run_once()
+        session_binds = dict(sched.cluster.binds)
+
+        # compiled path: same conf, one program
+        snap, maps = pack(ci)
+        result = jax.jit(make_conf_cycle(DEFAULT_CONF))(snap)
+        compiled_binds = {}
+        task_mode = np.asarray(result.task_mode)
+        task_node = np.asarray(result.task_node)
+        for uid, ti in maps.task_index.items():
+            if task_mode[ti] == 1:
+                compiled_binds[uid] = maps.node_names[task_node[ti]]
+        assert compiled_binds == session_binds
+
+    def test_hdrf_conf_compiles(self):
+        conf = open("conf/volcano-scheduler-dap.conf").read()
+        ci = contended_cluster()
+        snap, maps = pack(ci)
+        result = jax.jit(make_conf_cycle(conf))(snap)
+        assert int(np.asarray(result.task_mode > 0).sum()) > 0
+
+    def test_sidecar_serves_conf_policy(self):
+        from volcano_tpu import native
+        if not native.available():
+            pytest.skip("native packer unavailable")
+        from volcano_tpu.runtime.sidecar import SidecarClient, SidecarServer
+        server = SidecarServer(conf=DEFAULT_CONF)
+        server.serve_in_thread()
+        try:
+            client = SidecarClient(*server.address)
+            out = client.schedule(contended_cluster())
+            sched = Scheduler(FakeCluster(contended_cluster()),
+                              conf=parse_conf(DEFAULT_CONF))
+            sched.run_once()
+            assert out["binds"].keys() == dict(sched.cluster.binds).keys()
+            client.close()
+        finally:
+            server.shutdown()

@@ -116,12 +116,16 @@ def pack(ci: ClusterInfo,
     q_cap = np.full((Q, R), inf, np.float32)
     q_reclaimable = np.zeros(Q, bool)
     q_open = np.zeros(Q, bool)
+    q_hier_w = np.ones(Q, np.float32)
     for i, name in enumerate(queue_names):
         q = ci.queues[name]
         q_weight[i] = max(q.weight, 0)
         q_cap[i] = queue_capability_row(q, dims)
         q_reclaimable[i] = q.reclaimable
         q_open[i] = q.state == QueueState.OPEN
+        hw = q.hierarchy_weight_values()
+        if hw:
+            q_hier_w[i] = hw[-1]
 
     # hierarchy tree (fork's hdrf): build parent pointers from paths
     q_parent = np.full(Q, -1, np.int32)
@@ -357,7 +361,7 @@ def pack(ci: ClusterInfo,
         weight=q_weight, capability=q_cap, reclaimable=q_reclaimable,
         open=q_open, allocated=q_allocated, request=q_request,
         inqueue_minres=q_inqueue_minres, parent=q_parent, depth=q_depth,
-        valid=q_valid)
+        hier_weight=q_hier_w, valid=q_valid)
 
     snap = SnapshotArrays(
         nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,

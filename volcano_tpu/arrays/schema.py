@@ -135,6 +135,8 @@ class QueueArrays:
     # Hierarchical fairness (fork's hdrf): parent pointer tree, root = self.
     parent: jax.Array       # i32[Q] parent queue index (-1 for roots)
     depth: jax.Array        # i32[Q]
+    hier_weight: jax.Array  # f32[Q] leaf weight from volcano.sh/
+    #                         hierarchy-weights (drf.go hdrf), 1 when unset
     valid: jax.Array        # bool[Q]
 
     @property

@@ -1,0 +1,96 @@
+"""The whole session policy as ONE jittable program: snapshot -> decisions.
+
+``make_conf_cycle(conf)`` composes the allocate kernel AND the array-level
+plugin contributions (proportion's deserved water-filling, drf's job/
+namespace shares, hdrf's hierarchical keys) into a single function of the
+snapshot, so a TPU process needs nothing but arrays — this is what the
+scheduling sidecar serves, and what the reference does across
+OpenSession -> plugin OnSessionOpen -> action Execute
+(framework.go:29-54, proportion.go:95-197, drf.go:104-360) in Go callbacks.
+
+Plugins that need object-level inputs (tdm's revocable-zone windows,
+task-topology's bucket assignments, reservation's elect state) stay on the
+session path: their contributions arrive via AllocateExtras, and the
+in-process Session remains the full-fidelity driver. The compiled path
+covers the shipped conf presets (conf/*.conf), none of which enable those
+three.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..arrays.schema import SnapshotArrays
+from ..ops.allocate_scan import (AllocateConfig, AllocateExtras,
+                                 make_allocate_cycle)
+from ..ops.fairshare import (drf_job_shares, hierarchical_shares,
+                             namespace_shares, proportion_deserved)
+from .conf import SchedulerConfiguration, parse_conf
+
+
+def _plugin_options(sc: SchedulerConfiguration):
+    return [opt for tier in sc.tiers for opt in tier.plugins]
+
+
+def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
+    """Derive the kernel-composition config from a policy file alone —
+    mirrors Session.allocate_config (score_weights read only plugin args)."""
+    from ..plugins.factory import build_plugin
+    weights = dict(binpack_weight=0.0, least_allocated_weight=0.0,
+                   most_allocated_weight=0.0, balanced_weight=0.0,
+                   taint_prefer_weight=0.0)
+    any_scorer = False
+    has_gang = False
+    for opt in _plugin_options(sc):
+        if opt.name == "gang":
+            has_gang = True
+        plugin = build_plugin(opt)
+        w = plugin.score_weights(None)
+        if w:
+            any_scorer = True
+            for k, v in w.items():
+                weights[k] = weights.get(k, 0.0) + v
+    if not any_scorer:
+        weights.update(least_allocated_weight=1.0, balanced_weight=1.0)
+    return AllocateConfig(enable_gang=has_gang, **weights)
+
+
+def make_conf_cycle(conf: Optional[object] = None):
+    """conf (SchedulerConfiguration | YAML text | None) -> jittable
+    cycle(snap) -> AllocateResult with in-graph plugin extras."""
+    if conf is None or isinstance(conf, str):
+        sc = parse_conf(conf)
+    else:
+        sc = conf
+    options = {opt.name: opt for opt in _plugin_options(sc)}
+    cfg = allocate_config_from_conf(sc)
+    allocate = make_allocate_cycle(cfg)
+    proportion_on = "proportion" in options
+    drf_opt = options.get("drf")
+    drf_job_order = drf_opt is not None and drf_opt.enabled_job_order
+    drf_ns_order = drf_opt is not None and drf_opt.enabled_namespace_order
+    hdrf_on = drf_opt is not None and drf_opt.enabled_hierarchy
+
+    def cycle(snap: SnapshotArrays):
+        snap = jax.tree.map(jnp.asarray, snap)
+        extras = jax.tree.map(jnp.asarray, AllocateExtras.neutral(snap))
+        total = snap.cluster_capacity
+        if proportion_on:
+            extras.queue_deserved = proportion_deserved(snap.queues, total)
+        if drf_job_order:
+            # drf JobOrderFn share (drf.go:454-472)
+            extras.job_share = drf_job_shares(
+                snap.jobs.allocated, total, snap.jobs.valid)
+        if drf_ns_order:
+            extras.ns_share = namespace_shares(
+                snap.jobs.allocated, snap.jobs.namespace, snap.jobs.valid,
+                snap.namespace_weight, total)
+        if hdrf_on:
+            extras.queue_share_extra = hierarchical_shares(
+                snap.queues, total, snap.queues.hier_weight)
+        return allocate(snap, extras)
+
+    return cycle

@@ -47,7 +47,8 @@ class _VCArrays(ctypes.Structure):
         + [(n, ctypes.POINTER(ctypes.c_float))
            for n in ("q_allocated", "q_request", "q_inqueue_minres")]
         + [(n, ctypes.POINTER(ctypes.c_int32)) for n in ("q_parent", "q_depth")]
-        + [("q_valid", ctypes.POINTER(ctypes.c_uint8)),
+        + [("q_hier_weight", ctypes.POINTER(ctypes.c_float)),
+           ("q_valid", ctypes.POINTER(ctypes.c_uint8)),
            ("ns_weight", ctypes.POINTER(ctypes.c_float))]
         + [(n, ctypes.POINTER(ctypes.c_float))
            for n in ("n_idle", "n_used", "n_releasing", "n_pipelined",
@@ -233,6 +234,7 @@ def pack_wire(buf: bytes) -> SnapshotArrays:
             inqueue_minres=_np(out.q_inqueue_minres, (Q, R), np.float32),
             parent=_np(out.q_parent, (Q,), np.int32),
             depth=_np(out.q_depth, (Q,), np.int32),
+            hier_weight=_np(out.q_hier_weight, (Q,), np.float32),
             valid=_np(out.q_valid, (Q,), np.uint8).astype(b))
         return SnapshotArrays(
             nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,

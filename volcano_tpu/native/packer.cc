@@ -122,6 +122,7 @@ struct VCArrays {
   float* q_inqueue_minres;
   int32_t* q_parent;
   int32_t* q_depth;
+  float* q_hier_weight;
   uint8_t* q_valid;
   float* ns_weight;
   // Nodes.
@@ -182,7 +183,8 @@ void vc_free(VCArrays* a) {
   if (!a) return;
   float** fptrs[] = {&a->q_weight,        &a->q_cap,
                      &a->q_allocated,     &a->q_request,
-                     &a->q_inqueue_minres, &a->ns_weight,
+                     &a->q_inqueue_minres, &a->q_hier_weight,
+                     &a->ns_weight,
                      &a->n_idle,          &a->n_used,
                      &a->n_releasing,     &a->n_pipelined,
                      &a->n_allocatable,   &a->n_capability,
@@ -236,10 +238,10 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   // Sanity-bound every count against the bytes actually present before any
   // allocation sized by it: a crafted header must fail as ValueError on the
   // Python side, never as bad_alloc/OOM in here.  Minimum record sizes:
-  // queue 4+4+4R+2+8, namespace 4+4, node 4+24R+8+1+4+8, job 4+16+8+4+8R+3,
+  // queue 4+4+4R+2+8+4, namespace 4+4, node 4+24R+8+1+4+8, job 4+16+8+4+8R+3,
   // task 4+4+4R+12+2+4+8.
   const uint64_t remaining = static_cast<uint64_t>(r.end - r.p);
-  const uint64_t min_bytes = uint64_t(nq) * (18 + 4ull * R) + uint64_t(ns) * 8 +
+  const uint64_t min_bytes = uint64_t(nq) * (22 + 4ull * R) + uint64_t(ns) * 8 +
                              uint64_t(nn) * (17 + 24ull * R) +
                              uint64_t(nj) * (35 + 8ull * R) +
                              uint64_t(nt) * (34 + 4ull * R);
@@ -301,9 +303,13 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   a->q_inqueue_minres = fmalloc(int64_t(Q) * R);
   a->q_parent = imalloc(Q);
   a->q_depth = imalloc(Q);
+  a->q_hier_weight = fmalloc(Q);
   a->q_valid = bmalloc(Q);
   VC_CHECK_ALLOC();
-  for (int32_t i = 0; i < Q; ++i) a->q_parent[i] = -1;
+  for (int32_t i = 0; i < Q; ++i) {
+    a->q_parent[i] = -1;
+    a->q_hier_weight[i] = 1.0f;
+  }
   for (uint32_t i = 0; i < nq; ++i) {
     r.SkipString();
     a->q_weight[i] = std::max(r.F32(), 0.0f);
@@ -312,6 +318,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     a->q_open[i] = r.U8();
     a->q_parent[i] = r.I32();
     a->q_depth[i] = r.I32();
+    a->q_hier_weight[i] = r.F32();
     a->q_valid[i] = 1;
   }
 

@@ -85,6 +85,8 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
                           1 if q.state == QueueState.OPEN else 0]))
         out.append(_i32(parents[i]))
         out.append(_i32(depths[i]))
+        hw = q.hierarchy_weight_values()
+        out.append(_f32(hw[-1] if hw else 1.0))
 
     for name in ns_names:
         _s(out, name)

@@ -87,11 +87,12 @@ class AllocateExtras:
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
         import numpy as np
-        J = np.asarray(snap.jobs.min_available).shape[0]
-        Q, R = np.asarray(snap.queues.allocated).shape
-        S = np.asarray(snap.namespace_weight).shape[0]
-        N = np.asarray(snap.nodes.pod_count).shape[0]
-        T = np.asarray(snap.tasks.status).shape[0]
+        # .shape works on numpy arrays and tracers alike (trace-safe)
+        J = snap.jobs.min_available.shape[0]
+        Q, R = snap.queues.allocated.shape
+        S = snap.namespace_weight.shape[0]
+        N = snap.nodes.pod_count.shape[0]
+        T = snap.tasks.status.shape[0]
         return cls(
             job_share=np.zeros(J, np.float32),
             queue_deserved=np.full((Q, R), np.inf, np.float32),

@@ -45,17 +45,9 @@ class DRFPlugin(Plugin):
         """f32[Q] hdrf ordering key; only when enableHierarchy is set."""
         if not self.option.enabled_hierarchy:
             return None
+        import jax
         import jax.numpy as jnp
         from ..ops.fairshare import hierarchical_shares
-        q = ssn.snap.queues
-        hw = np.ones(np.asarray(q.weight).shape[0], np.float32)
-        for name, qi in ssn.maps.queue_index.items():
-            queue = ssn.cluster.queues.get(name)
-            if queue is not None:
-                weights = queue.hierarchy_weight_values()
-                if weights:
-                    hw[qi] = weights[-1]
-        import jax
+        q = jax.tree.map(jnp.asarray, ssn.snap.queues)
         return np.asarray(hierarchical_shares(
-            jax.tree.map(jnp.asarray, q),
-            jnp.asarray(ssn.snap.cluster_capacity), jnp.asarray(hw)))
+            q, jnp.asarray(ssn.snap.cluster_capacity), q.hier_weight))

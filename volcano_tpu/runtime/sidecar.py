@@ -51,14 +51,27 @@ def _send_frame(sock: socket.socket, status: int, payload: bytes) -> None:
 
 
 class SchedulerSidecar:
-    """Owns the jitted cycle; one instance per TPU process."""
+    """Owns the jitted cycle; one instance per TPU process.
 
-    def __init__(self, cfg: Optional[AllocateConfig] = None):
+    With ``conf`` (a scheduler policy YAML, see conf/*.conf) the whole
+    session policy — proportion/drf/hdrf extras included — compiles into the
+    served program (framework/compiled_session.py); otherwise a bare
+    allocate cycle with neutral extras runs under ``cfg``.
+    """
+
+    def __init__(self, cfg: Optional[AllocateConfig] = None,
+                 conf: Optional[str] = None):
         import jax
-        from ..ops.allocate_scan import make_allocate_cycle
-        self.cfg = cfg or AllocateConfig(binpack_weight=1.0)
-        cycle = make_allocate_cycle(self.cfg)
-        self._fn = jax.jit(lambda s, e: cycle(s, e).packed_decisions())
+        if conf is not None:
+            from ..framework.compiled_session import make_conf_cycle
+            cycle2 = make_conf_cycle(conf)
+            self._fn = jax.jit(
+                lambda s, e: cycle2(s).packed_decisions())
+        else:
+            from ..ops.allocate_scan import make_allocate_cycle
+            self.cfg = cfg or AllocateConfig(binpack_weight=1.0)
+            cycle = make_allocate_cycle(self.cfg)
+            self._fn = jax.jit(lambda s, e: cycle(s, e).packed_decisions())
 
     def schedule_buffer(self, buf: bytes) -> bytes:
         """VCS1 snapshot buffer -> VCD1 decision payload."""
@@ -107,8 +120,9 @@ class SidecarServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 cfg: Optional[AllocateConfig] = None):
-        self.sidecar = SchedulerSidecar(cfg)
+                 cfg: Optional[AllocateConfig] = None,
+                 conf: Optional[str] = None):
+        self.sidecar = SchedulerSidecar(cfg, conf=conf)
         super().__init__((host, port), _Handler)
 
     @property
@@ -172,9 +186,17 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=9099)
     parser.add_argument("--binpack-weight", type=float, default=1.0)
+    parser.add_argument("--scheduler-conf", default=None,
+                        help="policy YAML (conf/*.conf); compiles the full "
+                             "session policy into the served program")
     args = parser.parse_args(argv)
+    conf_text = None
+    if args.scheduler_conf:
+        with open(args.scheduler_conf) as f:
+            conf_text = f.read()
     server = SidecarServer(args.host, args.port,
-                           AllocateConfig(binpack_weight=args.binpack_weight))
+                           AllocateConfig(binpack_weight=args.binpack_weight),
+                           conf=conf_text)
     print(f"sidecar listening on {server.address[0]}:{server.address[1]}")
     try:
         server.serve_forever()
