@@ -1,0 +1,131 @@
+"""Job admission validation matrix — mirrors the case families of the
+reference's admit_job_test.go:1-1351 (policy event/action allowlists,
+duplicates, exit codes, the AnyEvent-exclusivity rule, update immutability)."""
+
+import pytest
+
+from volcano_tpu.api.batch import (Job, LifecyclePolicy, PodTemplate,
+                                   TaskSpec, VolumeSpec)
+from volcano_tpu.api.types import BusAction, BusEvent
+from volcano_tpu.webhooks import AdmissionError
+from volcano_tpu.webhooks.jobs import (mutate_job, validate_job_create,
+                                       validate_job_update)
+
+
+def job(policies=None, tasks=None, **kw):
+    return Job(name="j", tasks=tasks or [
+        TaskSpec(name="w", replicas=2,
+                 template=PodTemplate(resources={"cpu": "1"}))],
+        policies=policies or [], **kw)
+
+
+def ok(j):
+    validate_job_create(j)
+
+
+def bad(j, fragment):
+    with pytest.raises(AdmissionError) as e:
+        validate_job_create(j)
+    assert fragment in str(e.value)
+
+
+class TestPolicyMatrix:
+    def test_valid_external_events(self):
+        for ev in (BusEvent.POD_FAILED, BusEvent.POD_EVICTED,
+                   BusEvent.TASK_COMPLETED, BusEvent.JOB_UNKNOWN):
+            ok(job(policies=[LifecyclePolicy(action=BusAction.RESTART_JOB,
+                                             event=ev)]))
+
+    def test_internal_events_rejected(self):
+        for ev in (BusEvent.OUT_OF_SYNC, BusEvent.COMMAND_ISSUED):
+            bad(job(policies=[LifecyclePolicy(action=BusAction.RESTART_JOB,
+                                              event=ev)]),
+                "invalid policy event")
+
+    def test_internal_actions_rejected(self):
+        for act in (BusAction.SYNC_JOB, BusAction.ENQUEUE_JOB):
+            bad(job(policies=[LifecyclePolicy(action=act,
+                                              event=BusEvent.POD_FAILED)]),
+                "invalid policy action")
+
+    def test_event_and_exit_code_mutually_exclusive(self):
+        bad(job(policies=[LifecyclePolicy(action=BusAction.ABORT_JOB,
+                                          event=BusEvent.POD_FAILED,
+                                          exit_code=1)]),
+            "simultaneously")
+
+    def test_neither_event_nor_exit_code(self):
+        bad(job(policies=[LifecyclePolicy(action=BusAction.ABORT_JOB)]),
+            "either event or exitCode")
+
+    def test_zero_exit_code(self):
+        bad(job(policies=[LifecyclePolicy(action=BusAction.ABORT_JOB,
+                                          exit_code=0)]),
+            "0 is not a valid error code")
+
+    def test_duplicate_exit_code(self):
+        bad(job(policies=[
+            LifecyclePolicy(action=BusAction.ABORT_JOB, exit_code=3),
+            LifecyclePolicy(action=BusAction.RESTART_JOB, exit_code=3)]),
+            "duplicate exitCode")
+
+    def test_duplicate_event_across_policies(self):
+        bad(job(policies=[
+            LifecyclePolicy(action=BusAction.ABORT_JOB,
+                            event=BusEvent.POD_FAILED),
+            LifecyclePolicy(action=BusAction.RESTART_JOB,
+                            event=BusEvent.POD_FAILED)]),
+            "duplicate event")
+
+    def test_any_event_must_be_alone(self):
+        bad(job(policies=[
+            LifecyclePolicy(action=BusAction.ABORT_JOB, event=BusEvent.ANY),
+            LifecyclePolicy(action=BusAction.RESTART_JOB,
+                            event=BusEvent.POD_EVICTED)]),
+            "no other policy")
+
+    def test_task_level_policies_validated(self):
+        t = TaskSpec(name="w", replicas=1,
+                     policies=[LifecyclePolicy(action=BusAction.SYNC_JOB,
+                                               event=BusEvent.POD_FAILED)],
+                     template=PodTemplate(resources={"cpu": "1"}))
+        bad(job(tasks=[t]), "invalid policy action")
+
+
+class TestSpecRules:
+    def test_min_available_exceeds_replicas(self):
+        bad(job(min_available=5), "minAvailable")
+
+    def test_duplicate_task_names(self):
+        tasks = [TaskSpec(name="w", replicas=1,
+                          template=PodTemplate(resources={"cpu": "1"})),
+                 TaskSpec(name="w", replicas=1,
+                          template=PodTemplate(resources={"cpu": "1"}))]
+        bad(job(tasks=tasks), "duplicated task name")
+
+    def test_bad_dns_name(self):
+        tasks = [TaskSpec(name="Not_DNS", replicas=1,
+                          template=PodTemplate(resources={"cpu": "1"}))]
+        bad(job(tasks=tasks), "DNS-1123")
+
+    def test_duplicate_mount_path(self):
+        j = job()
+        j.volumes = [VolumeSpec(mount_path="/data", storage="1Gi"),
+                     VolumeSpec(mount_path="/data", storage="1Gi")]
+        bad(j, "duplicated mountPath")
+
+    def test_update_immutability(self):
+        old = mutate_job(job())
+        new = mutate_job(job())
+        new.queue = "other"
+        with pytest.raises(AdmissionError):
+            validate_job_update(old, new)
+
+    def test_update_replicas_allowed(self):
+        old = mutate_job(job())
+        new = mutate_job(job())
+        new.tasks[0].replicas = 4
+        new.min_available = 4
+        for t in new.tasks:
+            t.min_available = None
+        validate_job_update(old, new)

@@ -16,11 +16,18 @@ from ..api.types import BusAction, BusEvent, DEFAULT_QUEUE, DEFAULT_SCHEDULER_NA
 
 _DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 
-#: Actions a policy may attach to (admit_job.go policy validation).
+#: Events a policy may react to (policyEventMap, validate/util.go:32-41:
+#: OutOfSync and CommandIssued are internal-only and rejected).
+_VALID_POLICY_EVENTS = {
+    BusEvent.ANY, BusEvent.POD_FAILED, BusEvent.POD_EVICTED,
+    BusEvent.JOB_UNKNOWN, BusEvent.TASK_COMPLETED,
+}
+
+#: Actions a policy may request (policyActionMap, validate/util.go:43-52:
+#: SyncJob and Enqueue are internal-only and rejected).
 _VALID_POLICY_ACTIONS = {
     BusAction.ABORT_JOB, BusAction.RESTART_JOB, BusAction.RESTART_TASK,
     BusAction.TERMINATE_JOB, BusAction.COMPLETE_JOB, BusAction.RESUME_JOB,
-    BusAction.SYNC_JOB,
 }
 
 
@@ -29,8 +36,10 @@ class AdmissionError(ValueError):
 
 
 def _validate_policies(policies: List[LifecyclePolicy], where: str) -> List[str]:
+    """Reference: validatePolicies, validate/util.go:54-116."""
     errs = []
     seen_events = set()
+    seen_exit_codes = set()
     for p in policies:
         events = set(p.events)
         if p.event is not None:
@@ -39,16 +48,29 @@ def _validate_policies(policies: List[LifecyclePolicy], where: str) -> List[str]
             errs.append(f"{where}: must not specify event and exitCode simultaneously")
         if not events and p.exit_code is None:
             errs.append(f"{where}: either event or exitCode must be specified")
-        if p.exit_code == 0:
-            errs.append(f"{where}: 0 is not a valid error code")
-        if p.action not in _VALID_POLICY_ACTIONS:
-            errs.append(f"{where}: invalid policy action {p.action}")
-        for e in events:
-            if e in seen_events and e != BusEvent.ANY:
-                errs.append(f"{where}: duplicate event {e.value}")
-            seen_events.add(e)
+        if events:
+            for e in events:
+                if e not in _VALID_POLICY_EVENTS:
+                    errs.append(f"{where}: invalid policy event {e.value}")
+                elif p.action not in _VALID_POLICY_ACTIONS:
+                    errs.append(f"{where}: invalid policy action {p.action}")
+                elif e in seen_events:
+                    errs.append(f"{where}: duplicate event {e.value} across "
+                                "different policy")
+                else:
+                    seen_events.add(e)
+        elif p.exit_code is not None:
+            if p.exit_code == 0:
+                errs.append(f"{where}: 0 is not a valid error code")
+            elif p.exit_code in seen_exit_codes:
+                errs.append(f"{where}: duplicate exitCode {p.exit_code}")
+            else:
+                seen_exit_codes.add(p.exit_code)
         if p.timeout_seconds is not None and p.timeout_seconds <= 0:
             errs.append(f"{where}: policy timeout must be positive")
+    # "if there's * here, no other policy should be here" (util.go:111-113)
+    if BusEvent.ANY in seen_events and len(seen_events) > 1:
+        errs.append(f"{where}: if there's * here, no other policy should be here")
     return errs
 
 
