@@ -1,0 +1,158 @@
+"""Session-level tests for the tdm and task-topology plugins.
+
+Reference behaviors: revocable-zone windows gate non-preemptable placement
+and sweep preemptable victims outside the window (tdm.go:295-340); task
+topology steers bucket-mates onto the same node (topology.go:344)."""
+
+import datetime
+import time
+
+import numpy as np
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.plugins.tdm import REVOCABLE_ZONE_LABEL
+from volcano_tpu.runtime import FakeCluster, Scheduler
+
+from fixtures import build_job, build_node, build_task, simple_cluster
+
+
+def window(offset_start_min: int, offset_end_min: int) -> str:
+    """A daily window positioned relative to now."""
+    t = datetime.datetime.fromtimestamp(time.time())
+    lo = (t.hour * 60 + t.minute + offset_start_min) % 1440
+    hi = (t.hour * 60 + t.minute + offset_end_min) % 1440
+    return f"{lo // 60:02d}:{lo % 60:02d}-{hi // 60:02d}:{hi % 60:02d}"
+
+
+def tdm_conf(win: str) -> str:
+    return f"""
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+  - name: tdm
+    arguments:
+      tdm.revocable-zone.z1: "{win}"
+"""
+
+
+class TestTDM:
+    def _cluster(self):
+        ci = simple_cluster(n_nodes=1, node_cpu="4")
+        revocable = build_node("rev0", cpu="4", memory="8Gi",
+                               labels={REVOCABLE_ZONE_LABEL: "z1"})
+        ci.add_node(revocable)
+        return ci
+
+    def test_revocable_node_blocks_nonpreemptable(self):
+        """During an active window, a revocable node only admits preemptable
+        tasks (tdm.go:295): the non-preemptable job must land on n0 even
+        when rev0 is emptier."""
+        ci = self._cluster()
+        job = build_job("default/plain", min_available=1)
+        job.add_task(build_task("p0", cpu="1"))
+        ci.add_job(job)
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(tdm_conf(window(-60, 60))))
+        sched.run_once()
+        binds = dict(sched.cluster.binds)
+        assert binds["default/p0"] == "n0"
+
+    def test_preemptable_task_admitted_on_revocable_node(self):
+        ci = self._cluster()
+        # fill the normal node so the preemptable task must use rev0
+        filler = build_job("default/filler", min_available=1)
+        filler.add_task(build_task("f0", cpu="4"))
+        ci.add_job(filler)
+        job = build_job("default/cheap", min_available=1, preemptable=True)
+        t = build_task("c0", cpu="1", preemptable=True)
+        job.add_task(t)
+        ci.add_job(job)
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(tdm_conf(window(-60, 60))))
+        sched.run_once()
+        binds = dict(sched.cluster.binds)
+        assert binds["default/c0"] == "rev0"
+
+    def test_victims_swept_outside_window(self):
+        """Preemptable tasks on revocable nodes are eviction victims once
+        the window closes (tdm victimsFn, tdm.go:298-340)."""
+        ci = self._cluster()
+        job = build_job("default/cheap", min_available=1, preemptable=True)
+        t = build_task("c0", cpu="1", preemptable=True,
+                       status=TaskStatus.RUNNING)
+        job.add_task(t)
+        ci.add_job(job)
+        ci.nodes["rev0"].add_task(t)
+        conf = tdm_conf(window(120, 180)).replace(
+            'actions: "enqueue, allocate, backfill"',
+            'actions: "enqueue, allocate, backfill, preempt"')
+        sched = Scheduler(FakeCluster(ci), conf=parse_conf(conf))
+        ssn = sched.run_once()
+        assert "default/c0" in sched.cluster.evictions
+
+
+class TestTaskTopology:
+    def test_bucket_mate_prefers_same_node(self):
+        """A pending worker whose affine ps-mate already runs on a node gets
+        steered there (topology.go:344 node-order bonus)."""
+        conf = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: task-topology
+    arguments:
+      task-topology.affinity: "ps,worker"
+"""
+        ci = simple_cluster(n_nodes=3, node_cpu="8")
+        job = build_job("default/tf", min_available=1)
+        ps = build_task("ps-0", cpu="1", role="ps", status=TaskStatus.RUNNING,
+                        node_name="n2")
+        job.add_task(ps)
+        worker = build_task("worker-0", cpu="1", role="worker")
+        job.add_task(worker)
+        ci.add_job(job)
+        ci.nodes["n2"].add_task(ps)
+        sched = Scheduler(FakeCluster(ci), conf=parse_conf(conf))
+        sched.run_once()
+        binds = dict(sched.cluster.binds)
+        assert binds["default/worker-0"] == "n2"
+
+
+class TestReservation:
+    def test_elect_reserve_protects_target(self):
+        """elect picks the starving high-priority job; reserve locks the
+        emptiest node each cycle; other jobs cannot take locked nodes, so
+        the target eventually fits (elect.go:29-50, reserve.go:43-77)."""
+        conf = parse_conf("""
+actions: "enqueue, elect, reserve, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+  - name: reservation
+""")
+        ci = simple_cluster(n_nodes=2, node_cpu="4")
+        # the target needs a whole empty node's worth of cpu
+        target = build_job("default/big", min_available=1, priority=10,
+                           creation_timestamp=1.0)
+        target.add_task(build_task("b0", cpu="4"))
+        ci.add_job(target)
+        # a stream of small jobs would otherwise nibble every node
+        for i in range(2):
+            small = build_job(f"default/s{i}", min_available=1, priority=0,
+                              creation_timestamp=2.0 + i)
+            small.add_task(build_task(f"s{i}-0", cpu="3"))
+            ci.add_job(small)
+        sched = Scheduler(FakeCluster(ci), conf=conf)
+        for _ in range(3):
+            sched.run_once()
+        binds = dict(sched.cluster.binds)
+        # the target got a node; the two small jobs could not both squeeze
+        # in (one node was locked for the target)
+        assert binds["default/b0"] in ("n0", "n1")
+        placed_small = [k for k in binds if k.startswith("default/s")]
+        assert len(placed_small) <= 1
