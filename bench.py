@@ -18,15 +18,26 @@ TPU decisions verified bit-identical at full scale at measurement time);
 every bench run still measures the CPU path live AND re-verifies decision
 equality at a 1k-node/10k-task sub-scale, reported in the stderr extras.
 
+Fail-soft contract (VERDICT round 1, item 1): this script exits 0 with one
+valid JSON line in EVERY outcome.  The TPU backend is probed in a
+subprocess with a hard timeout first (a dead axon tunnel can make backend
+init hang, not just raise); if the chip is unreachable the whole
+measurement re-runs on the CPU backend at a reduced scale and the record
+carries "tpu_unavailable": true.  A mid-run TPU failure re-execs into the
+CPU path in a clean process.
+
 Env knobs: BENCH_NODES, BENCH_JOBS, BENCH_TASKS_PER_JOB, BENCH_REPS,
 BENCH_LIVE_CPU=1 (measure the CPU baseline at full scale instead of using
-BENCH_BASELINE.json), BENCH_SKIP_CHECK=1 (skip the sub-scale equality check).
+BENCH_BASELINE.json), BENCH_SKIP_CHECK=1 (skip the sub-scale equality
+check), BENCH_FORCE_CPU=1 (skip the TPU probe, run the degraded CPU path),
+BENCH_PROBE_TIMEOUT (seconds, default 150).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -36,6 +47,44 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 _BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_BASELINE.json")
+
+
+def _tpu_alive(timeout_s: float) -> bool:
+    """Probe TPU backend init in a subprocess with a hard timeout.
+
+    A dead axon tunnel makes jax backend init HANG in-process (observed:
+    >120s with no exception), so the probe must be a killable child. The
+    child runs one tiny computation end-to-end so a half-up backend that
+    inits but cannot execute also counts as dead.
+    """
+    code = ("import jax, jax.numpy as jnp; "
+            "print(int(jnp.ones((8, 8)).sum()))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, text=True)
+        return proc.returncode == 0 and "64" in proc.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _reexec_cpu(reason: str) -> "NoReturn":
+    """Re-exec this script with the CPU backend forced, in a clean process.
+
+    After a failed axon init, backend state in this process is poisoned;
+    a fresh interpreter with jax_platforms=cpu (set before any backend
+    initializes, mirroring tests/conftest.py) is the only reliable reset.
+    """
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_CPU_REASON"] = reason
+    # Scale pinned for a TPU run must not carry into the degraded CPU run:
+    # the XLA scan at full 10k/100k scale on CPU is unboundedly slow, which
+    # would defeat the fail-soft contract. The reduced CPU defaults apply;
+    # re-pin explicitly with BENCH_FORCE_CPU=1 to override.
+    for k in ("BENCH_NODES", "BENCH_JOBS", "BENCH_TASKS_PER_JOB"):
+        env.pop(k, None)
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
 def _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs):
@@ -66,7 +115,7 @@ def _drain(result):
         np.asarray(leaf)
 
 
-def _time_tpu(cycle_fn, snap, extras, reps):
+def _time_device(cycle_fn, snap, extras, reps):
     """Times snapshot-in -> decisions-on-host-out, the full cycle a real
     scheduler pays: host fuse + 3-buffer upload (ops/fused_io; the tunnel
     charges per transfer), compute, ONE packed readback
@@ -88,15 +137,27 @@ def _time_tpu(cycle_fn, snap, extras, reps):
     return result, min(times) * 1000, compile_s
 
 
-def main():
-    n_nodes = int(os.environ.get("BENCH_NODES", 10000))
-    n_jobs = int(os.environ.get("BENCH_JOBS", 6250))
+def _run(force_cpu: bool):
+    if force_cpu:
+        # Degraded mode: the jitted cycle runs on the CPU backend. The
+        # XLA-compiled scan at full 10k/100k scale is too slow for a
+        # bounded bench run, so scale down (overridable via env).
+        n_nodes = int(os.environ.get("BENCH_NODES", 2048))
+        n_jobs = int(os.environ.get("BENCH_JOBS", 1280))
+    else:
+        n_nodes = int(os.environ.get("BENCH_NODES", 10000))
+        n_jobs = int(os.environ.get("BENCH_JOBS", 6250))
     tasks_per_job = int(os.environ.get("BENCH_TASKS_PER_JOB", 16))
     reps = int(os.environ.get("BENCH_REPS", 3))
     cfg_kwargs = dict(binpack_weight=1.0, least_allocated_weight=0.0,
                       balanced_weight=0.0, taint_prefer_weight=0.0)
 
     import jax
+    if force_cpu:
+        # Same mechanism as tests/conftest.py: the config API overrides
+        # the axon site hook's jax_platforms=axon, as long as it runs
+        # before any backend initializes.
+        jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: the cycle compiles once per shape bucket and
     # every later bench/driver run reuses it
     cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
@@ -111,7 +172,7 @@ def main():
 
     snap, extras, cfg = _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs)
     fn = jax.jit(make_allocate_cycle(cfg))
-    result, tpu_ms, compile_s = _time_tpu(fn, snap, extras, reps)
+    result, dev_ms, compile_s = _time_device(fn, snap, extras, reps)
     n_tasks = n_jobs * tasks_per_job
     placed = int(np.asarray(result.task_mode > 0).sum())
 
@@ -139,11 +200,11 @@ def main():
         cpu_source = f"recorded {recorded['measured']} (BENCH_BASELINE.json)"
 
     # ---- live sub-scale decision-equality + speedup check ----------------
-    equal_sub = sub_speedup = None
+    equal_sub = sub_speedup = stpu_ms = scpu_ms = None
     if not os.environ.get("BENCH_SKIP_CHECK"):
         ssnap, sextras, scfg = _build(1024, 640, 16, cfg_kwargs)
         sfn = jax.jit(make_allocate_cycle(scfg))
-        sresult, stpu_ms, _ = _time_tpu(sfn, ssnap, sextras, 3)
+        sresult, stpu_ms, _ = _time_device(sfn, ssnap, sextras, 3)
         t0 = time.time()
         scpu = allocate_cpu(ssnap, sextras, scfg)
         scpu_ms = (time.time() - t0) * 1000
@@ -155,10 +216,15 @@ def main():
 
     out = {
         "metric": f"schedule_cycle_ms_{n_nodes}nodes_{n_tasks}tasks",
-        "value": round(tpu_ms, 3),
+        "value": round(dev_ms, 3),
         "unit": "ms",
-        "vs_baseline": round(cpu_ms / tpu_ms, 2),
+        "vs_baseline": round(cpu_ms / dev_ms, 2),
     }
+    if force_cpu:
+        out["tpu_unavailable"] = True
+        out["note"] = ("TPU backend unreachable (%s); compiled-cycle timing "
+                       "on the CPU backend at reduced scale" %
+                       os.environ.get("BENCH_CPU_REASON", "probe failed"))
     extra = {
         "cpu_ms": round(cpu_ms, 1),
         "cpu_source": cpu_source,
@@ -167,12 +233,38 @@ def main():
         "decisions_equal_cpu_full_scale": equal_full,
         "decisions_equal_cpu_1024n_10240t": equal_sub,
         "speedup_1024n_10240t": sub_speedup,
-        "sub_tpu_ms": round(stpu_ms, 3) if sub_speedup else None,
-        "sub_cpu_ms": round(scpu_ms, 1) if sub_speedup else None,
+        "sub_tpu_ms": round(stpu_ms, 3) if sub_speedup is not None else None,
+        "sub_cpu_ms": round(scpu_ms, 1) if sub_speedup is not None else None,
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))
     print(json.dumps(extra), file=sys.stderr)
+
+
+def main():
+    force_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
+    if not force_cpu:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+        if not _tpu_alive(timeout_s):
+            _reexec_cpu("backend probe failed/timed out after %gs" % timeout_s)
+    try:
+        _run(force_cpu)
+    except Exception as e:  # noqa: BLE001 — fail-soft contract
+        if not force_cpu:
+            # a mid-run TPU failure (flaky tunnel): clean-process retry on CPU
+            _reexec_cpu("mid-run failure: %s: %s" % (type(e).__name__, e))
+        # even the CPU path failed — emit a degraded-but-valid record
+        print(json.dumps({
+            "metric": "schedule_cycle_ms_error",
+            "value": -1,
+            "unit": "ms",
+            "vs_baseline": 0,
+            "tpu_unavailable": True,
+            "note": "bench failed on both TPU and CPU paths: %s: %s"
+                    % (type(e).__name__, e),
+        }))
+        import traceback
+        traceback.print_exc(file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -100,3 +100,37 @@ def test_pack_best_effort_runs():
     snap, maps = native.pack_best_effort(ci)
     assert snap.nodes.idle.ndim == 2
     assert maps.resource_names[0] == "cpu"
+
+
+# ---------------------------------------------------------------- pywire
+# The pure-Python VCS1 parser (native/pywire.py) is the sidecar's fallback
+# when g++ is unavailable; it must match the C++ packer bit-for-bit.
+
+def test_pywire_matches_native_on_rich_cluster():
+    from volcano_tpu.native.pywire import pack_wire_py
+    buf, _ = serialize(make_cluster())
+    assert_snapshots_equal(native.pack_wire(buf), pack_wire_py(buf))
+
+
+def test_pywire_matches_native_on_synthetic_scale():
+    from __graft_entry__ import _synthetic_cluster
+    from volcano_tpu.native.pywire import pack_wire_py
+    ci = _synthetic_cluster(n_nodes=64, n_jobs=24, tasks_per_job=5)
+    buf, _ = serialize(ci)
+    assert_snapshots_equal(native.pack_wire(buf), pack_wire_py(buf))
+
+
+def test_pywire_matches_native_on_empty_cluster():
+    from volcano_tpu.api import ClusterInfo
+    from volcano_tpu.native.pywire import pack_wire_py
+    buf, _ = serialize(ClusterInfo())
+    assert_snapshots_equal(native.pack_wire(buf), pack_wire_py(buf))
+
+
+def test_pywire_rejects_garbage():
+    from volcano_tpu.native.pywire import pack_wire_py
+    with pytest.raises(ValueError):
+        pack_wire_py(b"\x00" * 64)
+    with pytest.raises(ValueError):
+        buf, _ = serialize(make_cluster())
+        pack_wire_py(buf[: len(buf) // 2])

@@ -242,3 +242,43 @@ tiers:
         text = METRICS.exposition()
         assert "volcano_schedule_attempts" in text
         assert "e2e_scheduling_latency_milliseconds" in text
+
+
+class TestBindSeamTolerance:
+    """ADVICE r1 (medium): the device cycle admits with float32 1e-5 slack;
+    the host Resource algebra checks float64 1e-9. A boundary exact-fit that
+    passes on-device but fails host-side must degrade to a recorded bind
+    error (reference: dispatch returns the AddTask error and continues,
+    session.go:330-355), never crash apply_allocate mid-way."""
+
+    def test_session_bind_overflow_reverts_to_pending(self):
+        from volcano_tpu.framework import Session
+        ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="1Gi")
+        job = build_job("default/j1", min_available=1)
+        job.add_task(build_task("t0", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        ssn = Session(ci)
+        task = next(iter(ci.jobs["default/j1"].tasks.values()))
+        # host-side view: make the node too small AFTER packing, so the
+        # bind seam sees a fit failure the kernel did not
+        node = ci.nodes["n0"]
+        node.idle.sub_floored(res(cpu="500m"))
+        ssn._bind_task(task.uid, "n0")
+        assert ssn.binds == []
+        assert len(ssn.bind_errors) == 1
+        assert task.status == TaskStatus.PENDING
+        assert task.gpu_index == -1
+
+    def test_fake_cluster_bind_overflow_returns_false(self):
+        ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="1Gi")
+        job = build_job("default/j1", min_available=1)
+        job.add_task(build_task("t0", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        cluster = FakeCluster(ci)
+        from volcano_tpu.framework.session import BindIntent
+        task = next(iter(cluster.ci.jobs["default/j1"].tasks.values()))
+        cluster.ci.nodes["n0"].idle.sub_floored(res(cpu="500m"))
+        ok = cluster.bind(BindIntent(task.uid, "default/j1", "n0", -1))
+        assert not ok
+        assert cluster.binds == []
+        assert task.status == TaskStatus.PENDING

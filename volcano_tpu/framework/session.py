@@ -94,6 +94,7 @@ class Session:
 
         self.binds: List[BindIntent] = []
         self.evictions: List[EvictIntent] = []
+        self.bind_errors: List[tuple] = []      # (task uid, node, error)
         self.pipelined: Dict[str, str] = {}     # task uid -> node name
         self.conditions: Dict[str, str] = {}    # job uid -> condition type
         self.phase_updates: Dict[str, object] = {}  # job uid -> new PG phase
@@ -309,7 +310,19 @@ class Session:
         task.gpu_index = gpu_index
         node = self.cluster.nodes.get(node_name)
         if node is not None and task.uid not in node.tasks:
-            node.add_task(task)
+            try:
+                node.add_task(task)
+            except ValueError as e:
+                # The device cycle admits with float32 1e-5 slack while the
+                # host Resource algebra checks float64 1e-9, so a boundary
+                # exact-fit can pass on-device and fail here. The reference
+                # returns the AddTask error from dispatch and continues
+                # (session.go:330-355); mirror that: revert to pending and
+                # record the fit error instead of crashing apply_allocate.
+                job.update_task_status(task, TaskStatus.PENDING)
+                task.gpu_index = -1
+                self.bind_errors.append((task_uid, node_name, str(e)))
+                return
         self.binds.append(BindIntent(task_uid, job.uid, node_name, gpu_index))
 
     def apply_allocate(self, result: AllocateResult) -> None:
@@ -320,9 +333,6 @@ class Session:
         # ready gangs' PodGroups move to Running (scheduler status updater,
         # session.go:173 jobStatus)
         from ..api import PodGroupPhase
-        for uid, ji in self.maps.job_index.items():
-            if bool(job_ready[ji]):
-                self.phase_updates[uid] = PodGroupPhase.RUNNING
         for uid, ti in self.maps.task_index.items():
             mode = int(task_mode[ti])
             if mode == 0:
@@ -335,6 +345,18 @@ class Session:
                 # held in-session only (pipelined or allocated-but-unready):
                 # no cache flush, like an uncommitted Statement
                 self.pipelined[uid] = node_name
+        # ready gangs' PodGroups move to Running (scheduler status updater,
+        # session.go:173 jobStatus) — AFTER the bind loop so a job whose
+        # bind degraded to a recorded error is not marked Running with
+        # fewer bound tasks than minAvailable
+        failed_jobs = set()
+        for task_uid, _node, _err in self.bind_errors:
+            _job, _task = self._find_task(task_uid)
+            if _job is not None:
+                failed_jobs.add(_job.uid)
+        for uid, ji in self.maps.job_index.items():
+            if bool(job_ready[ji]) and uid not in failed_jobs:
+                self.phase_updates[uid] = PodGroupPhase.RUNNING
 
     # --------------------------------------------------------------- close
     def close(self) -> None:

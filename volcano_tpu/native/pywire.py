@@ -1,0 +1,343 @@
+"""Pure-Python VCS1 parser: wire buffer -> SnapshotArrays.
+
+The fallback half of the native packing runtime (packer.cc is the fast
+path): keeps the scheduling sidecar usable on hosts without g++, and acts
+as a second, independent implementation of the wire contract for parity
+tests. Mirrors packer.cc record-for-record — bucket sizes, derived
+aggregates (job request/queue allocated, predicate templates, pending-task
+tables, creation ranks), padding and defaults all match so the two paths
+produce bit-identical SnapshotArrays.
+
+Reference moment: SchedulerCache.Snapshot building the cluster mirror
+(pkg/scheduler/cache/cache.go:712-811); wire layout doc at the top of
+packer.cc / native/wire.py.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..arrays.schema import (JobArrays, NodeArrays, QueueArrays,
+                             SnapshotArrays, TaskArrays)
+
+MAGIC = 0x31534356  # "VCS1"
+
+# TaskStatus codes (volcano_tpu/api/types.py; pkg/scheduler/api/types.go:29-96)
+_STATUS_PENDING = 0
+_COUNTS_FOR_REQUEST = frozenset((0, 1, 3, 4, 5))
+
+
+def _bucket(n: int, minimum: int) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class _Reader:
+    __slots__ = ("buf", "off")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.off = 0
+
+    def u32(self) -> int:
+        (v,) = struct.unpack_from("<I", self.buf, self.off)
+        self.off += 4
+        return v
+
+    def i32(self) -> int:
+        (v,) = struct.unpack_from("<i", self.buf, self.off)
+        self.off += 4
+        return v
+
+    def u8(self) -> int:
+        v = self.buf[self.off]
+        self.off += 1
+        return v
+
+    def f32(self) -> float:
+        (v,) = struct.unpack_from("<f", self.buf, self.off)
+        self.off += 4
+        return v
+
+    def f64(self) -> float:
+        (v,) = struct.unpack_from("<d", self.buf, self.off)
+        self.off += 8
+        return v
+
+    def skip_string(self) -> None:
+        n = self.u32()
+        self.off += n
+
+    def f32vec(self, n: int) -> np.ndarray:
+        v = np.frombuffer(self.buf, "<f4", n, self.off)
+        self.off += 4 * n
+        return v
+
+    def i32vec(self, n: int) -> np.ndarray:
+        v = np.frombuffer(self.buf, "<i4", n, self.off)
+        self.off += 4 * n
+        return v
+
+
+def pack_wire_py(buf: bytes) -> SnapshotArrays:
+    """Parse a VCS1 buffer into SnapshotArrays (pure Python/numpy)."""
+    try:
+        return _parse(buf)
+    except (struct.error, IndexError) as e:
+        raise ValueError(f"truncated or corrupt VCS1 buffer: {e}") from None
+
+
+def _parse(buf: bytes) -> SnapshotArrays:
+    r = _Reader(buf)
+    if r.u32() != MAGIC:
+        raise ValueError("bad magic (not a VCS1 buffer)")
+    R = r.u32()
+    nq, ns, nn, nj, nt = (r.u32() for _ in range(5))
+    if R == 0 or R > 1024:
+        raise ValueError("corrupt header")
+    for _ in range(R):
+        r.skip_string()
+
+    Q = _bucket(max(nq, 1), 4)
+    S = _bucket(max(ns, 1), 4)
+    N = _bucket(max(nn, 1), 8)
+    J = _bucket(max(nj, 1), 4)
+    T = _bucket(max(nt, 1), 8)
+    f32, i32 = np.float32, np.int32
+
+    # ------------------------------------------------------------- queues
+    q_weight = np.zeros(Q, f32)
+    q_cap = np.full((Q, R), np.inf, f32)
+    q_reclaimable = np.zeros(Q, bool)
+    q_open = np.zeros(Q, bool)
+    q_parent = np.full(Q, -1, i32)
+    q_depth = np.zeros(Q, i32)
+    q_hier_weight = np.ones(Q, f32)
+    q_valid = np.zeros(Q, bool)
+    for i in range(nq):
+        r.skip_string()
+        q_weight[i] = max(r.f32(), 0.0)
+        q_cap[i] = r.f32vec(R)
+        q_reclaimable[i] = bool(r.u8())
+        q_open[i] = bool(r.u8())
+        q_parent[i] = r.i32()
+        q_depth[i] = r.i32()
+        q_hier_weight[i] = r.f32()
+        q_valid[i] = True
+
+    # --------------------------------------------------------- namespaces
+    ns_weight = np.ones(S, f32)
+    for i in range(ns):
+        r.skip_string()
+        ns_weight[i] = max(r.f32(), 1.0)
+
+    # -------------------------------------------------------------- nodes
+    n_res = np.zeros((6, N, R), f32)  # idle/used/releasing/pipelined/alloc/cap
+    n_pod_count = np.zeros(N, i32)
+    n_max_pods = np.zeros(N, i32)
+    n_schedulable = np.zeros(N, bool)
+    n_valid = np.zeros(N, bool)
+    labels, tkv, tkey, teff, gmem, gused = ([], [], [], [], [], [])
+    for i in range(nn):
+        r.skip_string()
+        for k in range(6):
+            n_res[k, i] = r.f32vec(R)
+        n_pod_count[i] = r.i32()
+        n_max_pods[i] = r.i32()
+        n_schedulable[i] = bool(r.u8())
+        n_valid[i] = True
+        ng = r.u32()
+        gm = np.zeros(ng, f32)
+        gu = np.zeros(ng, f32)
+        for g in range(ng):
+            gm[g] = r.f32()
+            gu[g] = r.f32()
+        gmem.append(gm)
+        gused.append(gu)
+        nl = r.u32()
+        labels.append(r.i32vec(nl))
+        ntn = r.u32()
+        trow = r.i32vec(3 * ntn).reshape(ntn, 3) if ntn else np.zeros((0, 3), i32)
+        tkv.append(trow[:, 0])
+        tkey.append(trow[:, 1])
+        teff.append(trow[:, 2])
+
+    L = max(max((len(v) for v in labels), default=0), 1)
+    E = max(max((len(v) for v in tkv), default=0), 1)
+    G = _bucket(max(max((len(v) for v in gmem), default=0), 1), 1)
+
+    def _pad_rows(rows, width, dtype, total):
+        out = np.zeros((total, width), dtype)
+        for i, v in enumerate(rows):
+            out[i, :len(v)] = v
+        return out
+
+    n_labels = _pad_rows(labels, L, i32, N)
+    n_taint_kv = _pad_rows(tkv, E, i32, N)
+    n_taint_key = _pad_rows(tkey, E, i32, N)
+    n_taint_effect = _pad_rows(teff, E, i32, N)
+    n_gpu_memory = _pad_rows(gmem, G, f32, N)
+    n_gpu_used = _pad_rows(gused, G, f32, N)
+
+    # --------------------------------------------------------------- jobs
+    j_min_available = np.zeros(J, i32)
+    j_queue = np.zeros(J, i32)
+    j_namespace = np.zeros(J, i32)
+    j_priority = np.zeros(J, i32)
+    j_creation_rank = np.zeros(J, i32)
+    j_ready_num = np.zeros(J, i32)
+    j_allocated = np.zeros((J, R), f32)
+    j_total_request = np.zeros((J, R), f32)
+    j_min_resources = np.zeros((J, R), f32)
+    j_schedulable = np.zeros(J, bool)
+    j_inqueue = np.zeros(J, bool)
+    j_pending_phase = np.zeros(J, bool)
+    j_preemptable = np.zeros(J, bool)
+    j_valid = np.zeros(J, bool)
+    job_queue_raw = np.full(nj, -1, i32)
+    job_ts = np.zeros(nj, np.float64)
+    for i in range(nj):
+        r.skip_string()
+        j_min_available[i] = r.i32()
+        job_queue_raw[i] = r.i32()
+        j_namespace[i] = r.i32()
+        j_priority[i] = r.i32()
+        job_ts[i] = r.f64()
+        j_ready_num[i] = r.i32()
+        j_allocated[i] = r.f32vec(R)
+        j_min_resources[i] = r.f32vec(R)
+        j_pending_phase[i] = bool(r.u8())
+        gang_valid = bool(r.u8())
+        j_preemptable[i] = bool(r.u8())
+        j_valid[i] = True
+        j_queue[i] = max(int(job_queue_raw[i]), 0)
+        j_inqueue[i] = not j_pending_phase[i]
+        queue_open = (0 <= job_queue_raw[i] < nq
+                      and bool(q_open[job_queue_raw[i]]))
+        j_schedulable[i] = gang_valid and queue_open and j_inqueue[i]
+    # creation_rank: stable sort of uid-sorted jobs by creation timestamp
+    order = np.argsort(job_ts[:nj], kind="stable")
+    j_creation_rank[order] = np.arange(nj, dtype=i32)
+
+    # -------------------------------------------------------------- tasks
+    t_resreq = np.zeros((T, R), f32)
+    t_job = np.full(T, -1, i32)
+    t_status = np.zeros(T, i32)
+    t_priority = np.zeros(T, i32)
+    t_node = np.full(T, -1, i32)
+    t_best_effort = np.zeros(T, bool)
+    t_gpu_request = np.zeros(T, f32)
+    t_preemptable = np.zeros(T, bool)
+    t_valid = np.zeros(T, bool)
+    sel, tolh, tole, tolm = [], [], [], []
+    pending = [[] for _ in range(nj)]
+    for i in range(nt):
+        r.skip_string()
+        t_job[i] = r.i32()
+        t_resreq[i] = r.f32vec(R)
+        t_status[i] = r.i32()
+        t_priority[i] = r.i32()
+        t_node[i] = r.i32()
+        t_best_effort[i] = bool(r.u8())
+        t_preemptable[i] = bool(r.u8())
+        t_gpu_request[i] = r.f32()
+        t_valid[i] = True
+        nsel = r.u32()
+        sel.append(r.i32vec(nsel))
+        ntol = r.u32()
+        trow = r.i32vec(3 * ntol).reshape(ntol, 3) if ntol else np.zeros((0, 3), i32)
+        tolh.append(trow[:, 0])
+        tole.append(trow[:, 1])
+        tolm.append(trow[:, 2])
+        ji = int(t_job[i])
+        if 0 <= ji < nj:
+            if int(t_status[i]) == _STATUS_PENDING:
+                pending[ji].append(i)
+            if int(t_status[i]) in _COUNTS_FOR_REQUEST:
+                j_total_request[ji] += t_resreq[i]
+
+    K = max(max((len(v) for v in sel), default=0), 1)
+    O = max(max((len(v) for v in tolh), default=0), 1)
+    t_selector = _pad_rows(sel, K, i32, T)
+    t_tol_hash = _pad_rows(tolh, O, i32, T)
+    t_tol_effect = _pad_rows(tole, O, i32, T)
+    t_tol_mode = _pad_rows(tolm, O, i32, T)
+
+    # Predicate templates: identical selector/toleration rows share one id,
+    # first-occurrence order (packer.cc:543-579; predicates/cache.go:42-67).
+    t_template = np.zeros(T, i32)
+    template_of = {}
+    reps = []
+    for i in range(nt):
+        key = (tuple(sel[i]), tuple(tolh[i]), tuple(tole[i]), tuple(tolm[i]))
+        tid = template_of.get(key)
+        if tid is None:
+            tid = len(reps)
+            template_of[key] = tid
+            reps.append(i)
+        t_template[i] = tid
+    P = _bucket(max(len(reps), 1), 4)
+    template_rep = np.full(P, -1, i32)
+    template_rep[:len(reps)] = reps
+
+    # Pending-task tables: priority desc, insertion order within priority.
+    maxp = max((len(p) for p in pending), default=0)
+    M = _bucket(maxp, 4)
+    j_task_table = np.full((J, M), -1, i32)
+    j_n_pending = np.zeros(J, i32)
+    for ji, p in enumerate(pending):
+        p = sorted(p, key=lambda t: (-int(t_priority[t]), t))
+        j_n_pending[ji] = len(p)
+        j_task_table[ji, :len(p)] = p
+
+    # Queue aggregates over member jobs (packer.cc:601-615).
+    q_allocated = np.zeros((Q, R), f32)
+    q_request = np.zeros((Q, R), f32)
+    q_inqueue_minres = np.zeros((Q, R), f32)
+    for ji in range(nj):
+        qi = int(job_queue_raw[ji])
+        if not (0 <= qi < nq):
+            continue
+        q_allocated[qi] += j_allocated[ji]
+        q_request[qi] += j_total_request[ji]
+        if j_inqueue[ji]:
+            q_inqueue_minres[qi] += j_min_resources[ji]
+
+    cluster_capacity = n_res[4, :nn].sum(axis=0).astype(f32) if nn else \
+        np.zeros(R, f32)
+
+    nodes = NodeArrays(
+        idle=n_res[0], used=n_res[1], releasing=n_res[2], pipelined=n_res[3],
+        allocatable=n_res[4], capability=n_res[5],
+        labels=n_labels, taint_kv=n_taint_kv, taint_key=n_taint_key,
+        taint_effect=n_taint_effect, pod_count=n_pod_count,
+        max_pods=n_max_pods, gpu_memory=n_gpu_memory, gpu_used=n_gpu_used,
+        schedulable=n_schedulable, valid=n_valid)
+    tasks = TaskArrays(
+        resreq=t_resreq, job=t_job, status=t_status, priority=t_priority,
+        node=t_node, selector=t_selector, tol_hash=t_tol_hash,
+        tol_effect=t_tol_effect, tol_mode=t_tol_mode, template=t_template,
+        best_effort=t_best_effort, gpu_request=t_gpu_request,
+        preemptable=t_preemptable, valid=t_valid)
+    jobs = JobArrays(
+        min_available=j_min_available, queue=j_queue, namespace=j_namespace,
+        priority=j_priority, creation_rank=j_creation_rank,
+        ready_num=j_ready_num, allocated=j_allocated,
+        total_request=j_total_request, min_resources=j_min_resources,
+        task_table=j_task_table, n_pending=j_n_pending,
+        schedulable=j_schedulable, inqueue=j_inqueue,
+        pending_phase=j_pending_phase, preemptable=j_preemptable,
+        valid=j_valid)
+    queues = QueueArrays(
+        weight=q_weight, capability=q_cap, reclaimable=q_reclaimable,
+        open=q_open, allocated=q_allocated, request=q_request,
+        inqueue_minres=q_inqueue_minres, parent=q_parent, depth=q_depth,
+        hier_weight=q_hier_weight, valid=q_valid)
+    return SnapshotArrays(
+        nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,
+        namespace_weight=ns_weight, cluster_capacity=cluster_capacity,
+        template_rep=template_rep)

@@ -195,7 +195,15 @@ def make_allocate_cycle(cfg: AllocateConfig):
             use_pallas, interp = True, True
         elif cfg.use_pallas is None:
             from .pallas_place import vmem_estimate_bytes
-            use_pallas = (jax.default_backend() == "tpu" and N % 128 == 0
+            # Backend probe must never take down the cycle: when the TPU
+            # plugin fails to initialize (dead tunnel and the like),
+            # jax.default_backend() raises — fall back to the XLA scan
+            # path, which runs on whatever backend jit resolves to.
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = "unavailable"
+            use_pallas = (backend in ("tpu", "axon") and N % 128 == 0
                           and vmem_estimate_bytes(M, N, R, G) < 12 * 2 ** 20)
             interp = False
         else:

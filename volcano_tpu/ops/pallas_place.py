@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from .allocate_scan import MODE_ALLOCATED, MODE_NONE, MODE_PIPELINED
+
 _EPS_FIT = 1e-5     # predicates._EPS
 _EPS_DIV = 1e-9     # scoring._EPS
 NEG = -1e30         # select.NEG
@@ -170,7 +172,8 @@ def _round_kernel(cfg, M, N, R, G,
         gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
                        * (iota_g == jnp.maximum(card, 0)) * onehot)
 
-        mode = jnp.where(do_alloc, 1, jnp.where(do_pipe, 2, 0))
+        mode = jnp.where(do_alloc, MODE_ALLOCATED,
+                         jnp.where(do_pipe, MODE_PIPELINED, MODE_NONE))
         is_m = iota_m == m
         node_v = jnp.where(is_m, jnp.where(placed, node, -1), node_v)
         mode_v = jnp.where(is_m, mode, mode_v)

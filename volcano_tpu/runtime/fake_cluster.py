@@ -42,13 +42,30 @@ class FakeCluster:
         task = job.tasks.get(intent.task_uid)
         if task is None:
             return False
+        old_status, old_gpu = task.status, task.gpu_index
+        removed_from = None
         if task.uid in self.ci.nodes.get(task.node_name, node).tasks:
-            self.ci.nodes[task.node_name].remove_task(task)
+            removed_from = self.ci.nodes[task.node_name]
+            removed_from.remove_task(task)
         job.update_task_status(task, TaskStatus.BOUND)
         # apply the shared-GPU card chosen by the cycle before accounting,
         # like the GPU-index pod patch ahead of AddPod (predicates.go:140-151)
         task.gpu_index = intent.gpu_index
-        node.add_task(task)
+        try:
+            node.add_task(task)
+        except ValueError:
+            # boundary exact-fit rejected by the host float64 check (the
+            # device admits with float32 slack): a failed bind, like
+            # defaultBinder.Bind returning an error (cache.go:123-143) —
+            # the caller's resync path retries it. Restore the prior
+            # placement exactly: same status, same node accounting.
+            job.update_task_status(task, old_status)
+            task.gpu_index = old_gpu
+            if removed_from is not None:
+                removed_from.add_task(task, force=True)
+            else:
+                task.node_name = ""
+            return False
         self.binds.append((intent.task_uid, intent.node_name))
         return True
 

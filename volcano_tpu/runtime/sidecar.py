@@ -30,7 +30,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from ..ops.allocate_scan import AllocateConfig, AllocateExtras
+from ..ops.allocate_scan import MODE_ALLOCATED, AllocateConfig, AllocateExtras
 
 DECISION_MAGIC = 0x31444356  # "VCD1"
 _u32 = struct.Struct("<I")
@@ -62,6 +62,11 @@ class SchedulerSidecar:
     def __init__(self, cfg: Optional[AllocateConfig] = None,
                  conf: Optional[str] = None):
         import jax
+        if cfg is not None and conf is not None:
+            raise ValueError(
+                "pass either cfg (bare allocate cycle) or conf (full "
+                "compiled session policy), not both — conf carries its own "
+                "action configuration")
         if conf is not None:
             from ..framework.compiled_session import make_conf_cycle
             cycle2 = make_conf_cycle(conf)
@@ -79,7 +84,8 @@ class SchedulerSidecar:
         if available():
             snap = pack_wire(buf)
         else:  # pure-Python fallback keeps the sidecar usable without g++
-            raise RuntimeError("native packer unavailable on this host")
+            from ..native.pywire import pack_wire_py
+            snap = pack_wire_py(buf)
         T = int(np.asarray(snap.tasks.status).shape[0])
         J = int(np.asarray(snap.jobs.min_available).shape[0])
         extras = AllocateExtras.neutral(snap)
@@ -167,7 +173,7 @@ class SidecarClient:
         job_pipelined = np.frombuffer(payload, "u1", J, off).astype(bool)
         binds = {}
         for uid, ti in maps.task_index.items():
-            if task_mode[ti] == 1:
+            if task_mode[ti] == MODE_ALLOCATED:
                 binds[uid] = (maps.node_names[task_node[ti]],
                               int(task_gpu[ti]))
         return {
@@ -194,9 +200,11 @@ def main(argv=None) -> int:
     if args.scheduler_conf:
         with open(args.scheduler_conf) as f:
             conf_text = f.read()
-    server = SidecarServer(args.host, args.port,
-                           AllocateConfig(binpack_weight=args.binpack_weight),
-                           conf=conf_text)
+    # conf carries the whole policy, so --binpack-weight only applies to the
+    # bare-cycle mode (passing both would silently drop the flag otherwise)
+    cfg = (None if conf_text is not None
+           else AllocateConfig(binpack_weight=args.binpack_weight))
+    server = SidecarServer(args.host, args.port, cfg, conf=conf_text)
     print(f"sidecar listening on {server.address[0]}:{server.address[1]}")
     try:
         server.serve_forever()
