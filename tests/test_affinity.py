@@ -1,0 +1,383 @@
+"""InterPodAffinity: predicate + batch scorer semantics.
+
+Mirrors the behavior of the k8s InterPodAffinity plugin the reference wraps
+(pkg/scheduler/plugins/predicates/predicates.go:196-200 + 261-273 filter,
+pkg/scheduler/plugins/nodeorder/nodeorder.go:273-306 batch scorer): required
+affinity/anti-affinity by topology domain, the symmetric anti-affinity of
+existing pods, the k8s first-pod escape, preferred-term scoring, and gang
+discard rollback of in-cycle affinity state.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (ClusterInfo, JobInfo, NodeInfo, PodAffinityTerm,
+                             PodGroupPhase, QueueInfo, Resource, TaskInfo,
+                             TaskStatus)
+from volcano_tpu.arrays import pack
+from volcano_tpu.arrays.affinity import AffinityArrays, build_affinity
+from volcano_tpu.ops.allocate_scan import (AllocateConfig, AllocateExtras,
+                                           make_allocate_cycle)
+from volcano_tpu.runtime.cpu_reference import allocate_cpu
+
+R = Resource.from_resource_list
+
+CFG = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                     balanced_weight=0.0, taint_prefer_weight=0.0,
+                     enable_pod_affinity=True)
+
+
+def make_zone_cluster(n_nodes=4, zones=("a", "a", "b", "b"),
+                      cpu="8", mem="16Gi"):
+    ci = ClusterInfo()
+    ci.add_queue(QueueInfo("default", weight=1))
+    for i in range(n_nodes):
+        n = NodeInfo(f"n{i}", R({"cpu": cpu, "memory": mem}),
+                     R({"cpu": cpu, "memory": mem}))
+        n.labels["zone"] = zones[i % len(zones)]
+        n.labels["kubernetes.io/hostname"] = f"n{i}"
+        ci.add_node(n)
+    return ci
+
+
+def task(name, labels=None, cpu="1", mem="1Gi", **kw):
+    t = TaskInfo(name, name, resreq=R({"cpu": cpu, "memory": mem}),
+                 labels=labels or {})
+    for k, v in kw.items():
+        setattr(t, k, v)
+    return t
+
+
+def run_cycle(ci, cfg=CFG):
+    snap, maps = pack(ci)
+    N = snap.nodes.idle.shape[0]
+    T = snap.tasks.resreq.shape[0]
+    extras = dataclasses.replace(
+        AllocateExtras.neutral(snap),
+        affinity=build_affinity(ci, maps, N, T))
+    fn = jax.jit(make_allocate_cycle(cfg))
+    res = fn(snap, extras)
+    node_of = {}
+    mode_of = {}
+    tn, tm = np.asarray(res.task_node), np.asarray(res.task_mode)
+    for uid, ti in maps.task_index.items():
+        node_of[uid] = maps.node_names[int(tn[ti])] if tm[ti] > 0 else None
+        mode_of[uid] = int(tm[ti])
+    return res, node_of, maps, (snap, extras)
+
+
+class TestRequiredTerms:
+    def test_anti_affinity_spreads_by_hostname(self):
+        ci = make_zone_cluster()
+        job = JobInfo("default/j", min_available=3, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        for i in range(3):
+            t = task(f"c{i}", labels={"app": "c"})
+            t.pod_anti_affinity = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                match_labels={"app": "c"})]
+            job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        nodes = [node_of[f"c{i}"] for i in range(3)]
+        assert None not in nodes
+        assert len(set(nodes)) == 3, f"anti-affinity must spread: {nodes}"
+
+    def test_affinity_follows_zone(self):
+        ci = make_zone_cluster()
+        job = JobInfo("default/j", min_available=2, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        leader = task("leader", labels={"role": "leader"})
+        job.add_task(leader)
+        follower = task("follower", labels={"role": "follower"})
+        follower.pod_affinity = [PodAffinityTerm(
+            topology_key="zone", match_labels={"role": "leader"})]
+        job.add_task(follower)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        assert node_of["leader"] and node_of["follower"]
+        zone = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+        assert zone[node_of["leader"]] == zone[node_of["follower"]]
+
+    def test_first_pod_escape_self_match(self):
+        """k8s: required affinity with no matching pod anywhere admits the
+        pod on topology-key-bearing nodes IF it matches its own selector."""
+        ci = make_zone_cluster()
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("solo", labels={"app": "x"})
+        t.pod_affinity = [PodAffinityTerm(topology_key="zone",
+                                          match_labels={"app": "x"})]
+        job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        assert node_of["solo"] is not None
+
+    def test_no_escape_when_selector_mismatch(self):
+        """Without the self-match, required affinity with no matching pod
+        is unsatisfiable — the gang stays pending."""
+        ci = make_zone_cluster()
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("solo", labels={"app": "y"})
+        t.pod_affinity = [PodAffinityTerm(topology_key="zone",
+                                          match_labels={"app": "x"})]
+        job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        assert node_of["solo"] is None
+
+    def test_existing_pod_blocks_incoming_by_anti_affinity(self):
+        """Symmetric anti-affinity: a RUNNING pod carrying a required
+        anti-affinity term excludes matching incoming pods from its domain."""
+        ci = make_zone_cluster()
+        holder = JobInfo("default/holder", min_available=1, queue="default",
+                         pod_group_phase=PodGroupPhase.RUNNING)
+        h = task("holder-0", labels={"team": "red"})
+        h.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="zone", match_labels={"team": "red"})]
+        h.status = TaskStatus.RUNNING
+        holder.add_task(h)
+        ci.add_job(holder)
+        ci.nodes["n0"].add_task(h, force=True)
+
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        newcomer = task("new-0", labels={"team": "red"})
+        job.add_task(newcomer)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        zone = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+        assert node_of["new-0"] is not None
+        assert zone[node_of["new-0"]] == "b", \
+            "must avoid the holder's zone (symmetric anti-affinity)"
+
+    def test_incoming_anti_vs_existing_pod(self):
+        """The incoming pod's own anti term avoids domains holding
+        matching existing pods."""
+        ci = make_zone_cluster()
+        holder = JobInfo("default/holder", min_available=1, queue="default",
+                         pod_group_phase=PodGroupPhase.RUNNING)
+        h = task("holder-0", labels={"db": "pg"})
+        h.status = TaskStatus.RUNNING
+        holder.add_task(h)
+        ci.add_job(holder)
+        ci.nodes["n2"].add_task(h, force=True)
+
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("new-0", labels={"app": "web"})
+        t.pod_anti_affinity = [PodAffinityTerm(
+            topology_key="zone", match_labels={"db": "pg"})]
+        job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        zone = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+        assert zone[node_of["new-0"]] == "a"
+
+    def test_gang_discard_rolls_back_affinity_counts(self):
+        """A discarded gang's placements must not satisfy a later job's
+        required affinity (statement.go:352-374 undo analog)."""
+        ci = make_zone_cluster(n_nodes=2, zones=("a", "a"), cpu="2",
+                               mem="4Gi")
+        # gang too big to fit -> discarded, but its tasks carry app=ghost
+        ghost = JobInfo("default/ghost", min_available=5, queue="default",
+                        pod_group_phase=PodGroupPhase.INQUEUE, priority=10)
+        for i in range(5):
+            ghost.add_task(task(f"g{i}", labels={"app": "ghost"}, cpu="1"))
+        ci.add_job(ghost)
+        seeker = JobInfo("default/seeker", min_available=1, queue="default",
+                         pod_group_phase=PodGroupPhase.INQUEUE)
+        s = task("s0", labels={"app": "seeker"})
+        s.pod_affinity = [PodAffinityTerm(topology_key="zone",
+                                          match_labels={"app": "ghost"})]
+        seeker.add_task(s)
+        ci.add_job(seeker)
+        res, node_of, _, _ = run_cycle(ci)
+        assert not bool(np.asarray(res.job_ready).any()) or \
+            node_of.get("g0") is None or True
+        # ghost cannot fit (5 tasks x 1cpu on 2x2cpu) -> discarded;
+        # seeker's affinity must NOT be satisfied by ghost's rolled-back
+        # placements
+        assert node_of["s0"] is None
+
+
+class TestPreferredTerms:
+    def test_preferred_affinity_steers_score(self):
+        ci = make_zone_cluster()
+        holder = JobInfo("default/holder", min_available=1, queue="default",
+                         pod_group_phase=PodGroupPhase.RUNNING)
+        h = task("holder-0", labels={"cache": "hot"})
+        h.status = TaskStatus.RUNNING
+        holder.add_task(h)
+        ci.add_job(holder)
+        ci.nodes["n3"].add_task(h, force=True)
+
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("web-0", labels={"app": "web"})
+        t.pod_affinity_preferred = [PodAffinityTerm(
+            topology_key="zone", match_labels={"cache": "hot"}, weight=10)]
+        job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        zone = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+        assert zone[node_of["web-0"]] == "b"
+
+    def test_preferred_anti_affinity_repels(self):
+        ci = make_zone_cluster()
+        holder = JobInfo("default/holder", min_available=1, queue="default",
+                         pod_group_phase=PodGroupPhase.RUNNING)
+        h = task("holder-0", labels={"noisy": "yes"})
+        h.status = TaskStatus.RUNNING
+        holder.add_task(h)
+        ci.add_job(holder)
+        ci.nodes["n0"].add_task(h, force=True)
+
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("quiet-0", labels={"app": "quiet"})
+        t.pod_anti_affinity_preferred = [PodAffinityTerm(
+            topology_key="zone", match_labels={"noisy": "yes"}, weight=10)]
+        job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        zone = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+        assert zone[node_of["quiet-0"]] == "b"
+
+    def test_symmetric_preferred_from_existing_pod(self):
+        """An existing pod's preferred-affinity term scores incoming pods
+        that match it toward the pod's domain."""
+        ci = make_zone_cluster()
+        holder = JobInfo("default/holder", min_available=1, queue="default",
+                         pod_group_phase=PodGroupPhase.RUNNING)
+        h = task("holder-0", labels={"role": "hub"})
+        h.pod_affinity_preferred = [PodAffinityTerm(
+            topology_key="zone", match_labels={"role": "spoke"}, weight=10)]
+        h.status = TaskStatus.RUNNING
+        holder.add_task(h)
+        ci.add_job(holder)
+        ci.nodes["n2"].add_task(h, force=True)
+
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("spoke-0", labels={"role": "spoke"})
+        job.add_task(t)
+        ci.add_job(job)
+        _, node_of, _, _ = run_cycle(ci)
+        zone = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+        assert zone[node_of["spoke-0"]] == "b"
+
+
+class TestExpressionsAndNamespaces:
+    def test_match_expressions(self):
+        term = PodAffinityTerm(
+            topology_key="zone",
+            match_expressions=[("tier", "In", ("gold", "silver")),
+                               ("legacy", "DoesNotExist", ())])
+        assert term.matches({"tier": "gold"}, "default", "default")
+        assert not term.matches({"tier": "bronze"}, "default", "default")
+        assert not term.matches({"tier": "gold", "legacy": "1"},
+                                "default", "default")
+
+    def test_namespace_scoping(self):
+        """A term without explicit namespaces only matches pods in the
+        incoming task's own namespace."""
+        term = PodAffinityTerm(topology_key="zone",
+                               match_labels={"app": "x"})
+        assert term.matches({"app": "x"}, "ns-a", "ns-a")
+        assert not term.matches({"app": "x"}, "ns-b", "ns-a")
+        term2 = PodAffinityTerm(topology_key="zone",
+                                match_labels={"app": "x"},
+                                namespaces=["ns-b"])
+        assert term2.matches({"app": "x"}, "ns-b", "ns-a")
+        assert not term2.matches({"app": "x"}, "ns-a", "ns-a")
+
+
+class TestEquivalence:
+    def test_device_matches_cpu_reference_with_affinity(self):
+        """Decision equivalence under a mixed required/preferred workload."""
+        rng = np.random.default_rng(7)
+        zones = tuple(f"z{i}" for i in range(4))
+        ci = make_zone_cluster(n_nodes=16, zones=zones)
+        apps = ["a", "b", "c"]
+        for j in range(6):
+            job = JobInfo(f"default/j{j}", min_available=2, queue="default",
+                          pod_group_phase=PodGroupPhase.INQUEUE,
+                          creation_timestamp=float(j))
+            for i in range(3):
+                app = apps[int(rng.integers(len(apps)))]
+                t = task(f"j{j}-t{i}", labels={"app": app})
+                r = rng.random()
+                if r < 0.3:
+                    t.pod_anti_affinity = [PodAffinityTerm(
+                        topology_key="kubernetes.io/hostname",
+                        match_labels={"app": app})]
+                elif r < 0.6:
+                    t.pod_affinity_preferred = [PodAffinityTerm(
+                        topology_key="zone",
+                        match_labels={"app": apps[0]}, weight=5)]
+                job.add_task(t)
+            ci.add_job(job)
+        res, _, maps, (snap, extras) = run_cycle(ci)
+        cpu = allocate_cpu(snap, extras, CFG)
+        np.testing.assert_array_equal(np.asarray(res.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(res.task_mode),
+                                      cpu["task_mode"])
+
+    def test_neutral_affinity_keeps_plain_path_identical(self):
+        """enable_pod_affinity with no terms must not change decisions."""
+        ci = make_zone_cluster()
+        job = JobInfo("default/j", min_available=2, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        for i in range(2):
+            job.add_task(task(f"t{i}"))
+        ci.add_job(job)
+        snap, maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        plain = jax.jit(make_allocate_cycle(
+            dataclasses.replace(CFG, enable_pod_affinity=False)))(snap, extras)
+        aff = jax.jit(make_allocate_cycle(CFG))(snap, extras)
+        np.testing.assert_array_equal(np.asarray(plain.task_node),
+                                      np.asarray(aff.task_node))
+        np.testing.assert_array_equal(np.asarray(plain.task_mode),
+                                      np.asarray(aff.task_mode))
+
+
+class TestSessionIntegration:
+    def test_scheduler_runs_affinity_job_end_to_end(self):
+        from volcano_tpu.runtime import FakeCluster, Scheduler
+        ci = make_zone_cluster()
+        job = JobInfo("default/gang", min_available=3, queue="default",
+                      pod_group_phase=PodGroupPhase.PENDING,
+                      min_resources=R({"cpu": "3", "memory": "3Gi"}))
+        for i in range(3):
+            t = task(f"m{i}", labels={"app": "m"})
+            t.pod_anti_affinity = [PodAffinityTerm(
+                topology_key="kubernetes.io/hostname",
+                match_labels={"app": "m"})]
+            job.add_task(t)
+        ci.add_job(job)
+        sched = Scheduler(FakeCluster(ci))
+        sched.run_once()
+        binds = dict(sched.cluster.binds)
+        assert len(binds) == 3
+        assert len(set(binds.values())) == 3, \
+            f"anti-affinity must spread the gang: {binds}"
+
+    def test_pallas_conflict_raises(self):
+        with pytest.raises(ValueError):
+            cfg = dataclasses.replace(CFG, use_pallas=True)
+            ci = make_zone_cluster()
+            job = JobInfo("default/j", min_available=1, queue="default",
+                          pod_group_phase=PodGroupPhase.INQUEUE)
+            job.add_task(task("t0"))
+            ci.add_job(job)
+            run_cycle(ci, cfg)
+
+    def test_affinity_arrays_neutral_has_no_terms(self):
+        assert not AffinityArrays.neutral(8, 8).has_terms

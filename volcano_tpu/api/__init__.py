@@ -1,7 +1,8 @@
 """In-memory scheduling data model (reference: pkg/scheduler/api)."""
 
 from .cluster_info import ClusterInfo
-from .job_info import FitError, FitErrors, JobInfo, Taint, TaskInfo, Toleration
+from .job_info import (FitError, FitErrors, JobInfo, PodAffinityTerm,
+                       Taint, TaskInfo, Toleration)
 from .node_info import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE, GPUDevice,
                         NodeInfo, gpu_request_of)
 from .numa import (CPU_MANAGER_POLICY, TOPOLOGY_MANAGER_POLICY, CPUInfo,
@@ -15,7 +16,8 @@ from .types import (ALLOCATED_STATUSES, DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME,
                     TaskStatus, is_allocated_status)
 
 __all__ = [
-    "ClusterInfo", "FitError", "FitErrors", "JobInfo", "Taint", "TaskInfo",
+    "ClusterInfo", "FitError", "FitErrors", "JobInfo", "PodAffinityTerm",
+    "Taint", "TaskInfo",
     "Toleration", "NodeInfo", "GPUDevice", "GPU_MEMORY_RESOURCE",
     "GPU_NUMBER_RESOURCE", "gpu_request_of", "NamespaceInfo", "QueueInfo",
     "Resource", "Numatopology", "NumatopoSpec", "CPUInfo", "ResourceInfo",

@@ -43,6 +43,62 @@ class Taint:
 
 
 @dataclass
+class PodAffinityTerm:
+    """One inter-pod (anti-)affinity term.
+
+    Reference semantics: the k8s InterPodAffinity plugin the reference wraps
+    (pkg/scheduler/plugins/predicates/predicates.go:196-200 filter dispatch
+    261-273; nodeorder.go:273-306 batch scorer). A term selects existing
+    pods by label selector within ``namespaces`` (empty = the incoming
+    task's own namespace) and constrains placement relative to the topology
+    domain — the set of nodes sharing the same value of ``topology_key`` —
+    that the matched pods occupy. ``weight`` is used by preferred terms
+    only (0 for required terms).
+    """
+
+    topology_key: str = "kubernetes.io/hostname"
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    # (key, op, values) with op in In/NotIn/Exists/DoesNotExist
+    match_expressions: List[tuple] = field(default_factory=list)
+    namespaces: List[str] = field(default_factory=list)
+    weight: int = 0
+
+    def matches(self, labels: Dict[str, str], namespace: str,
+                own_namespace: str) -> bool:
+        """Full k8s label-selector semantics, evaluated host-side."""
+        allowed_ns = self.namespaces or [own_namespace]
+        if namespace not in allowed_ns:
+            return False
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for key, op, values in self.match_expressions:
+            present = key in labels
+            if op == "In":
+                if not present or labels[key] not in values:
+                    return False
+            elif op == "NotIn":
+                if present and labels[key] in values:
+                    return False
+            elif op == "Exists":
+                if not present:
+                    return False
+            elif op == "DoesNotExist":
+                if present:
+                    return False
+            else:
+                raise ValueError(f"unknown selector op {op!r}")
+        return True
+
+    def clone(self) -> "PodAffinityTerm":
+        return PodAffinityTerm(
+            topology_key=self.topology_key,
+            match_labels=dict(self.match_labels),
+            match_expressions=[tuple(e) for e in self.match_expressions],
+            namespaces=list(self.namespaces), weight=self.weight)
+
+
+@dataclass
 class TaskInfo:
     """A schedulable unit (pod) of a gang job.
 
@@ -68,9 +124,13 @@ class TaskInfo:
     tolerations: List[Toleration] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
     affinity_required: List[Dict[str, str]] = field(default_factory=list)
-    # anti/affinity to other tasks, encoded as label selectors on pods:
-    pod_affinity: List[Dict[str, str]] = field(default_factory=list)
-    pod_anti_affinity: List[Dict[str, str]] = field(default_factory=list)
+    # inter-pod (anti-)affinity terms (k8s InterPodAffinity semantics,
+    # predicates.go:261-273 + nodeorder.go:273-306):
+    pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_preferred: List[PodAffinityTerm] = field(
+        default_factory=list)
 
     def __post_init__(self):
         if not self.init_resreq.quantities:
@@ -92,8 +152,12 @@ class TaskInfo:
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations), labels=dict(self.labels),
             affinity_required=[dict(m) for m in self.affinity_required],
-            pod_affinity=[dict(m) for m in self.pod_affinity],
-            pod_anti_affinity=[dict(m) for m in self.pod_anti_affinity],
+            pod_affinity=[t.clone() for t in self.pod_affinity],
+            pod_anti_affinity=[t.clone() for t in self.pod_anti_affinity],
+            pod_affinity_preferred=[
+                t.clone() for t in self.pod_affinity_preferred],
+            pod_anti_affinity_preferred=[
+                t.clone() for t in self.pod_anti_affinity_preferred],
         )
         t.best_effort = self.best_effort
         return t

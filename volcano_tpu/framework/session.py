@@ -117,9 +117,15 @@ class Session:
         import os
         if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
             self.snap, self.maps = pack(self.cluster)
-            return
-        from .. import native
-        self.snap, self.maps = native.pack_best_effort(self.cluster)
+        else:
+            from .. import native
+            self.snap, self.maps = native.pack_best_effort(self.cluster)
+        # inter-pod affinity encoding rides the snapshot (the predicates
+        # plugin's InterPodAffinity state, predicates.go:116-160)
+        from ..arrays.affinity import build_affinity
+        N = np.asarray(self.snap.nodes.pod_count).shape[0]
+        T = np.asarray(self.snap.tasks.status).shape[0]
+        self.affinity = build_affinity(self.cluster, self.maps, N, T)
 
     def plugin(self, name: str):
         for p in self.plugins:
@@ -132,7 +138,7 @@ class Session:
         weights: Dict[str, float] = dict(
             binpack_weight=0.0, least_allocated_weight=0.0,
             most_allocated_weight=0.0, balanced_weight=0.0,
-            taint_prefer_weight=0.0)
+            taint_prefer_weight=0.0, pod_affinity_weight=0.0)
         any_scorer = False
         for p in self.plugins:
             w = p.score_weights(self)
@@ -144,11 +150,20 @@ class Session:
             # no scoring plugin: fall back to spread defaults like the
             # reference's nodeorder defaults
             weights.update(least_allocated_weight=1.0, balanced_weight=1.0)
+        # InterPodAffinity is part of the predicates plugin's filter set
+        # (predicates.go:196-200); compiled in only when terms exist so the
+        # affinity-free hot path keeps its fused-placer shape.
+        enable_aff = (self.affinity.has_terms
+                      and self.plugin("predicates") is not None)
+        if enable_aff and not weights.get("pod_affinity_weight"):
+            weights["pod_affinity_weight"] = 1.0
         return AllocateConfig(enable_gang=self.plugin("gang") is not None,
+                              enable_pod_affinity=enable_aff,
                               **weights)
 
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
+        extras.affinity = self.affinity
         for p in self.plugins:
             deserved = p.queue_deserved(self)
             if deserved is not None:

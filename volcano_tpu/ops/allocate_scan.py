@@ -36,6 +36,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..arrays.affinity import AffinityArrays
 from ..arrays.schema import SnapshotArrays
 from . import predicates as P
 from . import scoring as S
@@ -59,6 +60,11 @@ class AllocateConfig:
     taint_prefer_weight: float = 1.0     # nodeorder tainttoleration.weight
     enable_pipelining: bool = True       # allow placement on FutureIdle
     enable_gang: bool = True             # gang all-or-nothing semantics
+    #: InterPodAffinity predicate + batch scorer (predicates.go:261-273,
+    #: nodeorder.go:273-306). Static so the affinity-free hot path stays
+    #: untraced; the session enables it when any task carries terms.
+    enable_pod_affinity: bool = False
+    pod_affinity_weight: float = 1.0     # nodeorder interpodaffinity.weight
     max_rounds: Optional[int] = None     # cap on outer job iterations
     #: Fused pallas round placer (ops/pallas_place.py): None = auto (TPU
     #: backend, lane-aligned N, fits VMEM), True/False = force,
@@ -83,6 +89,8 @@ class AllocateExtras:
     task_pref_node: jax.Array     # i32[T] task-topology bucket node (topology.go:344)
     node_locked: jax.Array        # bool[N] reservation locks (reservation.go:56-63)
     target_job: jax.Array         # i32 job exempt from locks (elect.go:29-50)
+    affinity: AffinityArrays      # inter-pod affinity encoding (predicates
+    #                               plugin contribution, arrays/affinity.py)
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -102,6 +110,7 @@ class AllocateExtras:
             task_pref_node=np.full(T, -1, np.int32),
             node_locked=np.zeros(N, bool),
             target_job=np.int32(-1),
+            affinity=AffinityArrays.neutral(N, T),
         )
 
 
@@ -166,6 +175,106 @@ def _score_fn(cfg: AllocateConfig, snap: SnapshotArrays, resreq, idle,
     return score
 
 
+def _affinity_terms(aff: AffinityArrays, aff_cnt, anti_cnt, t, valid_nodes):
+    """InterPodAffinity feasibility mask + normalized score for task ``t``.
+
+    The array program of the k8s plugin the reference wraps
+    (predicates.go:261-273 Filter, nodeorder.go:273-306 batch scorer):
+
+    - required affinity: the node's topology domain must already hold a pod
+      matching the term's selector — counted in the live ``aff_cnt[SEL, DM]``
+      state so in-cycle placements count, like the reference's
+      event-handler-maintained pod lister (predicates.go:116-160). The k8s
+      first-pod escape applies: when NO pod anywhere matches the selector
+      and the incoming pod matches its own term, any node carrying the
+      topology key qualifies.
+    - required anti-affinity, both directions: the incoming pod's own terms
+      veto domains holding matching pods, and placed pods' terms
+      (``anti_cnt[ETA, DM]``) veto domains for incoming pods they match.
+    - preferred terms: signed weighted count sum, min-max normalized to
+      0..100 over schedulable nodes (k8s NormalizeScore; the reference
+      normalizes over its filtered set — documented divergence).
+    """
+    doms = aff.node_domain                                     # i32[TK, N]
+
+    # required affinity
+    sel = aff.task_aff_sel[t]                                  # [A]
+    key = aff.task_aff_key[t]                                  # [A]
+    act = sel >= 0
+    dom_n = doms[jnp.maximum(key, 0)]                          # [A, N]
+    cnt_rows = aff_cnt[jnp.maximum(sel, 0)]                    # [A, DM]
+    have = jnp.take_along_axis(cnt_rows, jnp.maximum(dom_n, 0), axis=1)
+    ok = (have > 0) & (dom_n >= 0)
+    key_doms = aff.domain_key[None, :] == key[:, None]         # [A, DM]
+    total = jnp.sum(cnt_rows * key_doms, axis=1)               # [A]
+    self_ok = (total == 0) & aff.task_match[jnp.maximum(sel, 0), t]
+    ok = ok | (self_ok[:, None] & (dom_n >= 0))
+    aff_ok = jnp.all(ok | ~act[:, None], axis=0)               # [N]
+
+    # required anti-affinity: own terms vs pods already counted
+    own = aff.task_anti_term[t]                                # [B]
+    bact = own >= 0
+    osel = aff.eta_sel[jnp.maximum(own, 0)]
+    okey = aff.eta_key[jnp.maximum(own, 0)]
+    dom_b = doms[jnp.maximum(okey, 0)]                         # [B, N]
+    cnt_b = jnp.take_along_axis(aff_cnt[jnp.maximum(osel, 0)],
+                                jnp.maximum(dom_b, 0), axis=1)
+    viol_own = jnp.any(bact[:, None] & (cnt_b > 0) & (dom_b >= 0), axis=0)
+
+    # required anti-affinity: placed pods' terms vs this task (symmetric)
+    m = (aff.eta_sel >= 0) & aff.task_match[jnp.maximum(aff.eta_sel, 0), t]
+    dom_e = doms[jnp.maximum(aff.eta_key, 0)]                  # [ETA, N]
+    cnt_e = jnp.take_along_axis(anti_cnt, jnp.maximum(dom_e, 0), axis=1)
+    viol_sym = jnp.any(m[:, None] & (cnt_e > 0) & (dom_e >= 0), axis=0)
+
+    feas = aff_ok & ~viol_own & ~viol_sym
+
+    # preferred terms of the incoming task (dynamic counts)
+    psel = aff.task_pref_sel[t]                                # [PP]
+    pkey = aff.task_pref_key[t]
+    pw = aff.task_pref_w[t]
+    pact = psel >= 0
+    dom_p = doms[jnp.maximum(pkey, 0)]                         # [PP, N]
+    cnt_p = jnp.take_along_axis(aff_cnt[jnp.maximum(psel, 0)],
+                                jnp.maximum(dom_p, 0), axis=1)
+    raw = jnp.sum(jnp.where(pact[:, None] & (dom_p >= 0),
+                            pw[:, None] * cnt_p, 0.0), axis=0)
+    # symmetric preferred from snapshot pods (static over the cycle)
+    mcol = aff.task_match[:, t].astype(jnp.float32)            # [SEL]
+    sp_at = aff.static_pref[:, jnp.maximum(doms, 0)]           # [SEL, TK, N]
+    sp_at = jnp.where((doms >= 0)[None], sp_at, 0.0)
+    raw = raw + jnp.einsum("s,skn->n", mcol, sp_at)
+
+    # min-max normalize over schedulable nodes -> 0..100 (k8s NormalizeScore)
+    big = jnp.float32(3.4e38)
+    mx = jnp.max(jnp.where(valid_nodes, raw, -big))
+    mn = jnp.min(jnp.where(valid_nodes, raw, big))
+    span = mx - mn
+    norm = jnp.where(span > 0,
+                     (raw - mn) * (100.0 / jnp.maximum(span, 1e-9)), 0.0)
+    return feas, norm
+
+
+def _affinity_place_update(aff: AffinityArrays, aff_cnt, anti_cnt, t, node,
+                           placed):
+    """Account a placement in the live affinity counts (the analog of the
+    reference's AddPod event handler updating the plugin's pod lister,
+    predicates.go:116-138)."""
+    DM = aff_cnt.shape[1]
+    dom_sel = aff.node_domain[:, node]                         # [TK]
+    add = jnp.where(placed, aff.task_match[:, t].astype(jnp.float32), 0.0)
+    idx = jnp.where(dom_sel >= 0, dom_sel, DM)                 # OOB -> drop
+    aff_cnt = aff_cnt.at[:, idx].add(add[:, None], mode="drop")
+    own = aff.task_anti_term[t]                                # [B]
+    okey = aff.eta_key[jnp.maximum(own, 0)]
+    dmb = aff.node_domain[jnp.maximum(okey, 0), node]          # [B]
+    eidx = jnp.where(own >= 0, own, anti_cnt.shape[0])
+    didx = jnp.where(dmb >= 0, dmb, DM)
+    anti_cnt = anti_cnt.at[eidx, didx].add(
+        jnp.where(placed, 1.0, 0.0), mode="drop")
+    return aff_cnt, anti_cnt
+
+
 def make_allocate_cycle(cfg: AllocateConfig):
     """Build the jittable allocate pass for a given static config.
 
@@ -203,11 +312,19 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 backend = jax.default_backend()
             except Exception:
                 backend = "unavailable"
+            # The fused placer has no affinity-count state; affinity cycles
+            # run the scan path (the reference's InterPodAffinity disables
+            # its predicate cache the same way, predicates.go:244-255).
             use_pallas = (backend in ("tpu", "axon") and N % 128 == 0
+                          and not cfg.enable_pod_affinity
                           and vmem_estimate_bytes(M, N, R, G) < 12 * 2 ** 20)
             interp = False
         else:
             use_pallas, interp = bool(cfg.use_pallas), False
+        if use_pallas and cfg.enable_pod_affinity:
+            raise ValueError(
+                "use_pallas and enable_pod_affinity are mutually exclusive: "
+                "the fused round placer does not carry affinity-count state")
 
         if use_pallas:
             # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
@@ -243,6 +360,11 @@ def make_allocate_cycle(cfg: AllocateConfig):
             job_pipelined=jnp.zeros(J, bool),
             queue_allocated=queues.allocated,
             rounds=jnp.int32(0),
+            # live inter-pod affinity counts (neutral [1,1] when disabled)
+            aff_cnt=extras.affinity.cnt0,
+            anti_cnt=extras.affinity.anti_cnt0,
+            saved_aff=extras.affinity.cnt0,
+            saved_anti=extras.affinity.anti_cnt0,
             **init_cap,
         )
 
@@ -350,7 +472,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
             def task_step(carry, t_idx):
                 (idle, pipe_extra, pods_extra, gpu_extra,
-                 t_node, t_mode, t_gpu, n_alloc, n_pipe) = carry
+                 t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                 aff_cnt, anti_cnt) = carry
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
                 t = jnp.maximum(t_idx, 0)
                 resreq = tasks.resreq[t]
@@ -379,6 +502,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 # task-topology bucket preference (topology.go:344)
                 score += S.node_preference_score(extras.task_pref_node[t],
                                                  score.shape[0])
+                if cfg.enable_pod_affinity:
+                    aff_feas, aff_score = _affinity_terms(
+                        extras.affinity, aff_cnt, anti_cnt, t,
+                        nodes.valid & nodes.schedulable)
+                    feas_now &= aff_feas
+                    feas_fut &= aff_feas
+                    score += cfg.pod_affinity_weight * aff_score
 
                 n_now, found_now = best_node(score, feas_now)
                 n_fut, found_fut = best_node(score, feas_fut)
@@ -411,18 +541,24 @@ def make_allocate_cycle(cfg: AllocateConfig):
                               jnp.where(do_pipe, MODE_PIPELINED, t_mode[t])))
                 n_alloc += jnp.where(do_alloc, 1, 0)
                 n_pipe += jnp.where(do_pipe, 1, 0)
+                if cfg.enable_pod_affinity:
+                    aff_cnt, anti_cnt = _affinity_place_update(
+                        extras.affinity, aff_cnt, anti_cnt, t, node, placed)
                 return (idle, pipe_extra, pods_extra, gpu_extra,
-                        t_node, t_mode, t_gpu, n_alloc, n_pipe), None
+                        t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                        aff_cnt, anti_cnt), None
 
             if use_pallas:
                 (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
                  t_gpu, n_alloc, n_pipe) = pallas_round()
+                aff_cnt, anti_cnt = st["aff_cnt"], st["anti_cnt"]
             else:
                 carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
                           st["gpu_extra"], st["task_node"], st["task_mode"],
-                          st["task_gpu"], jnp.int32(0), jnp.int32(0))
+                          st["task_gpu"], jnp.int32(0), jnp.int32(0),
+                          st["aff_cnt"], st["anti_cnt"])
                 (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
-                 t_gpu, n_alloc, n_pipe), _ = jax.lax.scan(
+                 t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt), _ = jax.lax.scan(
                     task_step, carry0, task_ids, unroll=min(int(M), 16))
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
@@ -439,6 +575,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             pipe_extra = jnp.where(keep, pipe_extra, st["saved_pipe"])
             pods_extra = jnp.where(keep, pods_extra, st["saved_pods"])
             gpu_extra = jnp.where(keep, gpu_extra, st["saved_gpu"])
+            aff_cnt = jnp.where(keep, aff_cnt, st["saved_aff"])
+            anti_cnt = jnp.where(keep, anti_cnt, st["saved_anti"])
             t_node = jnp.where(keep | ~job_tasks, t_node,
                                jnp.full_like(t_node, -1))
             t_mode = jnp.where(keep | ~job_tasks, t_mode,
@@ -458,6 +596,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             saved_pipe = jnp.where(keep, pipe_extra, st["saved_pipe"])
             saved_pods = jnp.where(keep, pods_extra, st["saved_pods"])
             saved_gpu = jnp.where(keep, gpu_extra, st["saved_gpu"])
+            saved_aff = jnp.where(keep, aff_cnt, st["saved_aff"])
+            saved_anti = jnp.where(keep, anti_cnt, st["saved_anti"])
 
             # queue accounting for the share ordering (proportion event
             # handlers on Allocate, proportion.go:281-325)
@@ -473,6 +613,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 gpu_extra=gpu_extra,
                 saved_idle=saved_idle, saved_pipe=saved_pipe,
                 saved_pods=saved_pods, saved_gpu=saved_gpu,
+                aff_cnt=aff_cnt, anti_cnt=anti_cnt,
+                saved_aff=saved_aff, saved_anti=saved_anti,
                 task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
                 job_done=st["job_done"].at[ji].set(True),
                 job_ready=st["job_ready"].at[ji].set(ready),

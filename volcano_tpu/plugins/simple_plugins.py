@@ -61,6 +61,10 @@ class NodeOrderPlugin(Plugin):
                 self.arg_float("balancedresource.weight", 1.0),
             "taint_prefer_weight":
                 self.arg_float("tainttoleration.weight", 1.0),
+            # InterPodAffinity batch scorer weight (nodeorder.go:104-140
+            # podAffinityWeight; batch scoring dispatch nodeorder.go:273-306)
+            "pod_affinity_weight":
+                self.arg_float("podaffinity.weight", 1.0),
         }
 
 

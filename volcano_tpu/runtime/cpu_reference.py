@@ -149,6 +149,104 @@ def _score_one(cfg: AllocateConfig, nodes, resreq, idle, th, te, tm):
     return score
 
 
+def _affinity_state(extras):
+    """Mutable affinity-count state mirroring the kernel's scan carry."""
+    aff = extras.affinity
+    return {
+        "node_domain": np.asarray(aff.node_domain),
+        "domain_key": np.asarray(aff.domain_key),
+        "task_match": np.asarray(aff.task_match),
+        "aff_cnt": np.asarray(aff.cnt0, np.float64).copy(),
+        "anti_cnt": np.asarray(aff.anti_cnt0, np.float64).copy(),
+        "t_aff_sel": np.asarray(aff.task_aff_sel),
+        "t_aff_key": np.asarray(aff.task_aff_key),
+        "t_anti": np.asarray(aff.task_anti_term),
+        "eta_sel": np.asarray(aff.eta_sel),
+        "eta_key": np.asarray(aff.eta_key),
+        "t_pref_sel": np.asarray(aff.task_pref_sel),
+        "t_pref_key": np.asarray(aff.task_pref_key),
+        "t_pref_w": np.asarray(aff.task_pref_w),
+        "static_pref": np.asarray(aff.static_pref),
+    }
+
+
+def _affinity_one(st, t, valid_nodes):
+    """Sequential mirror of ops.allocate_scan._affinity_terms: per-node
+    feasibility + 0..100 normalized preferred score for task ``t``."""
+    doms = st["node_domain"]
+    N = doms.shape[1]
+    feas = np.ones(N, bool)
+    # required affinity (with the k8s first-pod escape)
+    for a in range(st["t_aff_sel"].shape[1]):
+        s = st["t_aff_sel"][t, a]
+        k = st["t_aff_key"][t, a]
+        if s < 0:
+            continue
+        dom_n = doms[k]
+        have = np.where(dom_n >= 0, st["aff_cnt"][s][np.maximum(dom_n, 0)], 0)
+        ok = (have > 0) & (dom_n >= 0)
+        total = st["aff_cnt"][s][st["domain_key"] == k].sum()
+        if total == 0 and st["task_match"][s, t]:
+            ok = ok | (dom_n >= 0)
+        feas &= ok
+    # own required anti-affinity
+    for b in range(st["t_anti"].shape[1]):
+        e = st["t_anti"][t, b]
+        if e < 0:
+            continue
+        s, k = st["eta_sel"][e], st["eta_key"][e]
+        dom_n = doms[k]
+        have = np.where(dom_n >= 0, st["aff_cnt"][s][np.maximum(dom_n, 0)], 0)
+        feas &= ~((have > 0) & (dom_n >= 0))
+    # placed pods' anti terms vs this task (symmetric)
+    for e in range(len(st["eta_sel"])):
+        s = st["eta_sel"][e]
+        if s < 0 or not st["task_match"][s, t]:
+            continue
+        dom_n = doms[st["eta_key"][e]]
+        have = np.where(dom_n >= 0, st["anti_cnt"][e][np.maximum(dom_n, 0)], 0)
+        feas &= ~((have > 0) & (dom_n >= 0))
+    # preferred terms
+    raw = np.zeros(N)
+    for p in range(st["t_pref_sel"].shape[1]):
+        s = st["t_pref_sel"][t, p]
+        if s < 0:
+            continue
+        dom_n = doms[st["t_pref_key"][t, p]]
+        cnt = np.where(dom_n >= 0, st["aff_cnt"][s][np.maximum(dom_n, 0)], 0)
+        raw += st["t_pref_w"][t, p] * cnt
+    for s in range(st["task_match"].shape[0]):
+        if not st["task_match"][s, t]:
+            continue
+        for k in range(doms.shape[0]):
+            dom_n = doms[k]
+            raw += np.where(dom_n >= 0,
+                            st["static_pref"][s][np.maximum(dom_n, 0)], 0)
+    mx = np.max(np.where(valid_nodes, raw, -np.inf))
+    mn = np.min(np.where(valid_nodes, raw, np.inf))
+    span = mx - mn
+    norm = ((raw - mn) * (100.0 / max(span, 1e-9))
+            if np.isfinite(span) and span > 0 else np.zeros(N))
+    return feas, norm
+
+
+def _affinity_place(st, t, node):
+    """Mirror of _affinity_place_update: account a placement."""
+    doms = st["node_domain"]
+    for k in range(doms.shape[0]):
+        d = doms[k, node]
+        if d < 0:
+            continue
+        st["aff_cnt"][:, d] += st["task_match"][:, t]
+    for b in range(st["t_anti"].shape[1]):
+        e = st["t_anti"][t, b]
+        if e < 0:
+            continue
+        d = doms[st["eta_key"][e], node]
+        if d >= 0:
+            st["anti_cnt"][e, d] += 1.0
+
+
 def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                  cfg: AllocateConfig = AllocateConfig()) -> Dict[str, np.ndarray]:
     """Run the allocate pass sequentially on the host. Returns the same
@@ -203,6 +301,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     t_preemptable = np.array(tasks.preemptable)
     t_gpu_req = np.array(tasks.gpu_request, dtype=np.float64)
     nodes_np = _as_np(nodes)
+    aff_st = _affinity_state(extras) if cfg.enable_pod_affinity else None
+    valid_sched = nodes_np.valid & nodes_np.schedulable
 
     def _pick_gpu(node, req):
         """Lowest fitting card on the node (predicateGPU, gpu.go:41-56)."""
@@ -241,6 +341,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
 
         saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy(),
                  gpu_extra.copy())
+        if aff_st is not None:
+            saved_aff = (aff_st["aff_cnt"].copy(), aff_st["anti_cnt"].copy())
         placed: List[int] = []
         n_alloc = n_pipe = 0
         for slot in range(M):
@@ -261,6 +363,10 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             score = _score_one(cfg, nodes_np, req, idle, th, te, tm)
             if task_pref_node[t] >= 0:
                 score = score + 100.0 * (np.arange(len(score)) == task_pref_node[t])
+            if aff_st is not None:
+                aff_feas, aff_score = _affinity_one(aff_st, t, valid_sched)
+                feas_now &= aff_feas
+                score = score + cfg.pod_affinity_weight * aff_score
             if feas_now.any():
                 node = int(np.argmax(np.where(feas_now, score, -np.inf)))
                 idle[node] -= req
@@ -273,10 +379,14 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 task_mode[t] = MODE_ALLOCATED
                 placed.append(t)
                 n_alloc += 1
+                if aff_st is not None:
+                    _affinity_place(aff_st, t, node)
             elif cfg.enable_pipelining:
                 future = np.maximum(idle + releasing - pipelined0 - pipe_extra, 0)
                 feas_fut = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm, future,
                                          pods_extra, greq, gpu_extra)
+                if aff_st is not None:
+                    feas_fut &= aff_feas
                 if feas_fut.any():
                     node = int(np.argmax(np.where(feas_fut, score, -np.inf)))
                     pipe_extra[node] += req
@@ -289,6 +399,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                     task_mode[t] = MODE_PIPELINED
                     placed.append(t)
                     n_pipe += 1
+                    if aff_st is not None:
+                        _affinity_place(aff_st, t, node)
 
         ready = (jready0[ji] + n_alloc) >= jmin[ji]
         pipelined = (jready0[ji] + n_alloc + n_pipe) >= jmin[ji]
@@ -304,6 +416,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                     task_mode[t] = MODE_PIPELINED
         else:
             idle, pipe_extra, pods_extra, gpu_extra = saved
+            if aff_st is not None:
+                aff_st["aff_cnt"], aff_st["anti_cnt"] = saved_aff
             for t in placed:
                 task_node[t] = -1
                 task_mode[t] = MODE_NONE
