@@ -63,7 +63,8 @@ def _tpu_alive(timeout_s: float) -> bool:
         proc = subprocess.run(
             [sys.executable, "-c", code], timeout=timeout_s,
             capture_output=True, text=True)
-        return proc.returncode == 0 and "64" in proc.stdout
+        out = proc.stdout.strip().splitlines()
+        return proc.returncode == 0 and bool(out) and out[-1] == "64"
     except (subprocess.TimeoutExpired, OSError):
         return False
 

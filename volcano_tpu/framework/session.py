@@ -139,6 +139,7 @@ class Session:
             binpack_weight=0.0, least_allocated_weight=0.0,
             most_allocated_weight=0.0, balanced_weight=0.0,
             taint_prefer_weight=0.0, pod_affinity_weight=0.0)
+        provided = set()
         any_scorer = False
         for p in self.plugins:
             w = p.score_weights(self)
@@ -146,6 +147,7 @@ class Session:
                 any_scorer = True
                 for k, v in w.items():
                     weights[k] = weights.get(k, 0.0) + v
+                    provided.add(k)
         if not any_scorer:
             # no scoring plugin: fall back to spread defaults like the
             # reference's nodeorder defaults
@@ -155,7 +157,10 @@ class Session:
         # affinity-free hot path keeps its fused-placer shape.
         enable_aff = (self.affinity.has_terms
                       and self.plugin("predicates") is not None)
-        if enable_aff and not weights.get("pod_affinity_weight"):
+        # Default the scoring weight to 1.0 only when no nodeorder plugin
+        # supplied a value; an explicit ``podaffinity.weight: 0`` stays 0
+        # (nodeorder.go:104-140 priorityWeight defaults).
+        if enable_aff and "pod_affinity_weight" not in provided:
             weights["pod_affinity_weight"] = 1.0
         return AllocateConfig(enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,

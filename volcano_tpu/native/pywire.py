@@ -99,6 +99,12 @@ def _parse(buf: bytes) -> SnapshotArrays:
     nq, ns, nn, nj, nt = (r.u32() for _ in range(5))
     if R == 0 or R > 1024:
         raise ValueError("corrupt header")
+    # Sanity-bound the entity counts against the buffer size before any
+    # allocation: every queue/node/job/task record is at least a few bytes,
+    # so a corrupt header with valid magic fails fast with ValueError
+    # instead of driving a huge np.zeros into MemoryError.
+    if max(nq, ns, nn, nj, nt) > len(buf):
+        raise ValueError("corrupt header (entity count exceeds buffer size)")
     for _ in range(R):
         r.skip_string()
 
