@@ -1,0 +1,301 @@
+"""Exact hierarchical-DRF solver tests.
+
+Validates ops.fairshare.hdrf_tree_state / hdrf_level_keys against a direct
+recursive transliteration of the fork's tree update and queue comparator
+(pkg/scheduler/plugins/drf/drf.go:90-103 resourceSaturated, 693-767
+updateHierarchicalShare, 182-218 compareQueues), plus the allocation-outcome
+scenarios of drf/hdrf_test.go:48-196 (in test_actions-level suites once the
+allocate path consumes the tree).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+from volcano_tpu.arrays.hierarchy import HierarchyArrays, build_hierarchy
+from volcano_tpu.ops.fairshare import hdrf_level_keys, hdrf_tree_state
+
+_EPS = 1e-9
+
+
+# ---------------------------------------------------------------------------
+# recursive Go-mirror (hierarchicalNode semantics, dict-tree structured)
+# ---------------------------------------------------------------------------
+
+def _share(alloc, total):
+    frac = [alloc[r] / total[r] for r in range(len(total)) if total[r] > 0]
+    return max(frac) if frac else 0.0
+
+
+def go_hdrf(parent, depth, weight, valid, job_leaf, job_alloc, job_req,
+            job_valid, total):
+    """Returns (share[H], saturated[H]) per tree node the way drf.go does."""
+    H = len(parent)
+    children = {i: [] for i in range(H)}
+    for i in range(H):
+        if valid[i] and parent[i] >= 0:
+            children[parent[i]].append(i)
+    jobs_at = {i: [] for i in range(H)}
+    J = len(job_leaf)
+    total_alloc = np.zeros(len(total))
+    for j in range(J):
+        if job_valid[j] and job_leaf[j] >= 0:
+            jobs_at[job_leaf[j]].append(j)
+            total_alloc += job_alloc[j]
+    demanding = total_alloc < np.asarray(total)
+
+    def job_saturated(j):
+        # resourceSaturated, drf.go:90-103
+        for r in range(len(total)):
+            a, q = job_alloc[j][r], job_req[j][r]
+            if a > _EPS and q > _EPS and a >= q - 1e-9:
+                return True
+            if not demanding[r] and q > _EPS:
+                return True
+        return False
+
+    share = np.zeros(H)
+    sat = np.ones(H, bool)
+    alloc = np.zeros((H, len(total)))
+
+    def update(node):
+        # children = subtree nodes + job leaves (updateHierarchicalShare)
+        kids = []
+        for c in children[node]:
+            update(c)
+            kids.append((share[c], sat[c], alloc[c]))
+        for j in jobs_at[node]:
+            kids.append((_share(job_alloc[j], total), job_saturated(j),
+                         np.asarray(job_alloc[j], float)))
+        mdr = 1.0
+        for s, st, _a in kids:
+            if s != 0 and not st and s < mdr:
+                mdr = s
+        total_a = np.zeros(len(total))
+        all_sat = True
+        for s, st, a in kids:
+            if not st:
+                all_sat = False
+            if s != 0:
+                total_a += a if st else a * (mdr / s)
+        share[node] = _share(total_a, total)
+        sat[node] = all_sat
+        alloc[node] = total_a
+
+    roots = [i for i in range(H) if valid[i] and parent[i] < 0]
+    for r in roots:
+        update(r)
+    return share, sat
+
+
+def go_compare(lpath, rpath, share, sat, weight):
+    """compareQueues (drf.go:182-218) over node-index paths."""
+    d = min(len(lpath), len(rpath))
+    for i in range(d):
+        ln, rn = lpath[i], rpath[i]
+        if not sat[ln] and sat[rn]:
+            return -1
+        if sat[ln] and not sat[rn]:
+            return 1
+        ls, rs = share[ln] / weight[ln], share[rn] / weight[rn]
+        if ls != rs:
+            return -1 if ls < rs else 1
+    return 0
+
+
+def _rand_tree(rng, max_depth=3, max_queues=6, max_jobs=8, R=2):
+    """Random HierarchyArrays + job arrays (numpy, unbucketed)."""
+    n_q = rng.integers(1, max_queues + 1)
+    parent, depth, weight = [-1], [0], [1.0]
+    queue_paths = []
+    for _ in range(n_q):
+        d = rng.integers(0, max_depth + 1)
+        path = [0]
+        node = 0
+        for lvl in range(1, d + 1):
+            # either reuse an existing child of `node` or create one
+            existing = [i for i in range(len(parent))
+                        if parent[i] == node and depth[i] == lvl]
+            if existing and rng.random() < 0.5:
+                node = int(rng.choice(existing))
+            else:
+                parent.append(node)
+                depth.append(lvl)
+                weight.append(float(rng.integers(1, 5)))
+                node = len(parent) - 1
+            path.append(node)
+        queue_paths.append(path)
+    H = len(parent)
+    D = max(len(p) for p in queue_paths)
+    D = max(D, 2)
+    qp = np.full((n_q, D), -1, np.int32)
+    for qi, p in enumerate(queue_paths):
+        qp[qi, :len(p)] = p
+    n_j = rng.integers(1, max_jobs + 1)
+    job_leaf = np.array([queue_paths[rng.integers(0, n_q)][-1]
+                         for _ in range(n_j)], np.int32)
+    total = rng.uniform(5, 20, R).astype(np.float32)
+    job_alloc = (rng.uniform(0, 4, (n_j, R))
+                 * (rng.random((n_j, R)) < 0.7)).astype(np.float32)
+    job_req = np.maximum(job_alloc * rng.uniform(0.5, 2.0, (n_j, R)),
+                         rng.uniform(0, 3, (n_j, R))).astype(np.float32)
+    job_valid = rng.random(n_j) < 0.9
+    hier = HierarchyArrays(
+        parent=np.asarray(parent, np.int32), depth=np.asarray(depth, np.int32),
+        weight=np.asarray(weight, np.float32), valid=np.ones(H, bool),
+        queue_path=qp, job_leaf=job_leaf)
+    return hier, queue_paths, job_alloc, job_req, job_valid, total
+
+
+class TestTreeState:
+    def test_fuzz_matches_go_recursion(self):
+        rng = np.random.default_rng(7)
+        for trial in range(60):
+            hier, qpaths, ja, jr, jv, total = _rand_tree(rng)
+            share, sat, _ = hdrf_tree_state(
+                hier, jnp.asarray(ja), jnp.asarray(jr), jnp.asarray(jv),
+                jnp.asarray(total))
+            share, sat = np.asarray(share), np.asarray(sat)
+            gshare, gsat = go_hdrf(
+                np.asarray(hier.parent), np.asarray(hier.depth),
+                np.asarray(hier.weight), np.asarray(hier.valid),
+                np.asarray(hier.job_leaf), ja, jr, jv, total)
+            assert np.allclose(share, gshare, atol=1e-4), trial
+            assert (sat == gsat).all(), trial
+
+    def test_fuzz_queue_order_matches_compare_queues(self):
+        rng = np.random.default_rng(11)
+        for trial in range(60):
+            hier, qpaths, ja, jr, jv, total = _rand_tree(rng)
+            keys = np.asarray(hdrf_level_keys(
+                hier, jnp.asarray(ja), jnp.asarray(jr), jnp.asarray(jv),
+                jnp.asarray(total)))
+            gshare, gsat = go_hdrf(
+                np.asarray(hier.parent), np.asarray(hier.depth),
+                np.asarray(hier.weight), np.asarray(hier.valid),
+                np.asarray(hier.job_leaf), ja, jr, jv, total)
+            w = np.asarray(hier.weight)
+            nq = len(qpaths)
+            for a in range(nq):
+                for b in range(nq):
+                    g = go_compare(qpaths[a], qpaths[b], gshare, gsat, w)
+                    if g == 0:
+                        continue  # reference falls to heap order on ties
+                    ka, kb = tuple(keys[a]), tuple(keys[b])
+                    got = -1 if ka < kb else (1 if ka > kb else 0)
+                    # the lexicographic keys may only disagree with the
+                    # comparator when the walk ended at differing depths
+                    # past a tied common prefix (documented -1 padding)
+                    common = min(len(qpaths[a]), len(qpaths[b]))
+                    tied_prefix = all(
+                        go_compare(qpaths[a][:i + 1], qpaths[b][:i + 1],
+                                   gshare, gsat, w) == 0
+                        for i in range(common))
+                    if not tied_prefix:
+                        assert got == g, (trial, a, b)
+
+    def test_rescaling_scenario_tree(self):
+        """hdrf_test.go 'rescaling test' tree at its expected final
+        allocation: pg1=5c+5G under root/sci, pg21=5c under root/eng/dev,
+        pg22=5G under root/eng/prod; 10c/10G cluster. All leaves saturated
+        (cluster fully allocated in both dims), every level share balanced."""
+        # nodes: 0 root, 1 sci, 2 eng, 3 dev, 4 prod
+        hier = HierarchyArrays(
+            parent=np.asarray([-1, 0, 0, 2, 2], np.int32),
+            depth=np.asarray([0, 1, 1, 2, 2], np.int32),
+            weight=np.asarray([1, 50, 50, 50, 50], np.float32),
+            valid=np.ones(5, bool),
+            queue_path=np.asarray([[0, 1, -1], [0, 2, 3], [0, 2, 4]],
+                                  np.int32),
+            job_leaf=np.asarray([1, 3, 4], np.int32))
+        total = np.asarray([10.0, 10.0], np.float32)
+        ja = np.asarray([[5, 5], [5, 0], [0, 5]], np.float32)
+        jr = np.asarray([[10, 10], [10, 0], [0, 10]], np.float32)
+        share, sat, _ = hdrf_tree_state(
+            hier, jnp.asarray(ja), jnp.asarray(jr),
+            jnp.ones(3, bool), jnp.asarray(total))
+        share, sat = np.asarray(share), np.asarray(sat)
+        # nothing is demanding anymore -> every job and node saturated
+        assert sat.all()
+        # sci holds 5/10 on both dims; eng aggregates dev+prod to 5c+5G
+        assert abs(share[1] - 0.5) < 1e-5
+        assert abs(share[2] - 0.5) < 1e-5
+        # balanced shares at every level -> queue order is a three-way tie
+        keys = np.asarray(hdrf_level_keys(
+            hier, jnp.asarray(ja), jnp.asarray(jr), jnp.ones(3, bool),
+            jnp.asarray(total)))
+        assert np.allclose(keys[1][:4], keys[2][:4])
+
+    def test_unsaturated_beats_saturated(self):
+        """A queue whose subtree still demands resources pops before one
+        whose jobs are saturated (compareQueues, drf.go:200-206)."""
+        hier = HierarchyArrays(
+            parent=np.asarray([-1, 0, 0, -1], np.int32),
+            depth=np.asarray([0, 1, 1, 0], np.int32),
+            weight=np.asarray([1, 1, 1, 1], np.float32),
+            valid=np.asarray([True, True, True, False]),
+            queue_path=np.asarray([[0, 1], [0, 2]], np.int32),
+            job_leaf=np.asarray([1, 2], np.int32))
+        total = np.asarray([10.0], np.float32)
+        # job0 fully met (sat), job1 still wants more (unsat)
+        ja = np.asarray([[4.0], [2.0]], np.float32)
+        jr = np.asarray([[4.0], [6.0]], np.float32)
+        keys = np.asarray(hdrf_level_keys(
+            hier, jnp.asarray(ja), jnp.asarray(jr), jnp.ones(2, bool),
+            jnp.asarray(total)))
+        assert tuple(keys[1]) < tuple(keys[0])
+
+
+class TestBuildHierarchy:
+    def test_materializes_intermediate_nodes(self):
+        from volcano_tpu.api import QueueInfo
+        from volcano_tpu.arrays import pack
+        from fixtures import build_job, build_task, simple_cluster
+        ci = simple_cluster(n_nodes=1)
+        del ci.queues["default"]
+        ci.add_queue(QueueInfo("root-sci", hierarchy="root/sci",
+                               hierarchy_weights="100/50"))
+        ci.add_queue(QueueInfo("root-eng-dev", hierarchy="root/eng/dev",
+                               hierarchy_weights="100/50/50"))
+        ci.add_queue(QueueInfo("root-eng-prod", hierarchy="root/eng/prod",
+                               hierarchy_weights="100/50/50"))
+        j = build_job("default/j1", queue="root-eng-dev")
+        j.add_task(build_task("t0"))
+        ci.add_job(j)
+        snap, maps = pack(ci)
+        Q = np.asarray(snap.queues.weight).shape[0]
+        J = np.asarray(snap.jobs.valid).shape[0]
+        h = build_hierarchy(ci, maps, Q, J)
+        valid = np.asarray(h.valid)
+        depth = np.asarray(h.depth)
+        # root + sci + eng + dev + prod = 5 nodes, "eng" materialized even
+        # though no queue is named root/eng
+        assert int(valid.sum()) == 5
+        assert sorted(depth[valid].tolist()) == [0, 1, 1, 2, 2]
+        qp = np.asarray(h.queue_path)
+        dev = maps.queue_index["root-eng-dev"]
+        prod = maps.queue_index["root-eng-prod"]
+        sci = maps.queue_index["root-sci"]
+        # dev and prod share the depth-1 "eng" node; sci does not
+        assert qp[dev, 1] == qp[prod, 1]
+        assert qp[sci, 1] != qp[dev, 1]
+        assert qp[sci, 2] == -1
+        # the job attaches under dev's leaf
+        ji = maps.job_index["default/j1"]
+        assert int(np.asarray(h.job_leaf)[ji]) == qp[dev, 2]
+        # weights floored at 1, first declarer wins
+        assert np.asarray(h.weight)[qp[dev, 1]] == 50.0
+
+    def test_no_annotation_attaches_under_root(self):
+        from volcano_tpu.arrays import pack
+        from fixtures import build_job, build_task, simple_cluster
+        ci = simple_cluster(n_nodes=1)
+        j = build_job("default/j1")
+        j.add_task(build_task("t0"))
+        ci.add_job(j)
+        snap, maps = pack(ci)
+        Q = np.asarray(snap.queues.weight).shape[0]
+        J = np.asarray(snap.jobs.valid).shape[0]
+        h = build_hierarchy(ci, maps, Q, J)
+        assert int(np.asarray(h.valid).sum()) == 1
+        ji = maps.job_index["default/j1"]
+        assert int(np.asarray(h.job_leaf)[ji]) == 0

@@ -1,0 +1,149 @@
+"""Packed hdrf hierarchy tree: queue paths + job leaves as dense arrays.
+
+The fork's hierarchical DRF builds an explicit tree from each queue's
+``volcano.sh/hierarchy`` annotation — root, one node per path component, and
+one leaf per JOB attached under its queue's final path node
+(pkg/scheduler/plugins/drf/drf.go:641-690 buildHierarchy). The repo's
+QueueArrays parent pointers cannot express this: intermediate path
+components that are not themselves declared queues ("eng" in
+"root/eng/dev") vanish, and job leaves do not exist at all.
+
+This module materializes the full tree host-side as static arrays that ride
+:class:`~volcano_tpu.ops.allocate_scan.AllocateExtras` (the tree shape only
+changes when queues change, never during a cycle):
+
+- one tree node per unique path prefix across all queues (root included),
+- ``queue_path[q, d]`` = the tree node at depth ``d`` along queue ``q``'s
+  path (-1 beyond the path end), which is exactly the walk
+  ``compareQueues`` performs (drf.go:182-218),
+- ``job_leaf[j]`` = the node under which job ``j``'s drf attribute hangs.
+
+Node weights come from ``volcano.sh/hierarchy-weights`` with the reference's
+rules: parsed per level, floored at 1, first declaring queue wins
+(drf.go:648-674); the root keeps weight 1 (drf.go:141-147). A queue with no
+hierarchy annotation attaches its jobs directly under root, matching
+``strings.Split("", "/")`` producing a single-element path in Go.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from .schema import IndexMaps, bucket
+
+
+def _register(cls):
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+    return cls
+
+
+@_register
+@dataclass
+class HierarchyArrays:
+    """Static hdrf tree topology (H tree nodes, D depth levels)."""
+
+    parent: jax.Array      # i32[H] parent node, -1 for root
+    depth: jax.Array       # i32[H] root = 0
+    weight: jax.Array      # f32[H] hierarchy weight, >= 1
+    valid: jax.Array       # bool[H]
+    queue_path: jax.Array  # i32[Q, D] node at each depth along the queue's
+    #                        path, -1 past the end (compareQueues walk)
+    job_leaf: jax.Array    # i32[J] attach node per job, -1 = not in tree
+
+    @property
+    def h(self) -> int:
+        return self.parent.shape[0]
+
+    @property
+    def d(self) -> int:
+        return self.queue_path.shape[1]
+
+    @classmethod
+    def neutral(cls, Q: int, J: int) -> "HierarchyArrays":
+        """Root-only tree: every queue sits at root, no job leaves."""
+        path = np.full((Q, 2), -1, np.int32)
+        path[:, 0] = 0
+        return cls(
+            parent=np.array([-1] + [-1] * 3, np.int32),
+            depth=np.zeros(4, np.int32),
+            weight=np.ones(4, np.float32),
+            valid=np.array([True, False, False, False]),
+            queue_path=path,
+            job_leaf=np.full(J, -1, np.int32),
+        )
+
+
+def build_hierarchy(ci, maps: IndexMaps, Q: int, J: int) -> HierarchyArrays:
+    """ClusterInfo -> HierarchyArrays on the packed queue/job index maps.
+
+    ``Q``/``J`` are the bucketed dims of the snapshot so the result composes
+    with the same compiled cycle.
+    """
+    queue_names = maps.queue_names
+    # path per queue: [root, comp1, comp2, ...]; no annotation -> [root]
+    paths: Dict[str, List[str]] = {}
+    weights: Dict[str, List[float]] = {}
+    for name in queue_names:
+        q = ci.queues[name]
+        p = q.hierarchy_path()
+        paths[name] = p[1:] if p else []          # components after root
+        w = q.hierarchy_weight_values()
+        weights[name] = w[1:] if len(w) > 1 else []
+
+    # materialize nodes: root + every unique prefix, in sorted-queue order so
+    # the first declaring queue's weight wins (buildHierarchy first-create,
+    # drf.go:648-674)
+    node_of: Dict[Tuple[str, ...], int] = {(): 0}
+    node_parent = [-1]
+    node_depth = [0]
+    node_weight = [1.0]                            # root weight (drf.go:146)
+    for name in queue_names:
+        comps = paths[name]
+        wvals = weights[name]
+        for i in range(len(comps)):
+            key = tuple(comps[: i + 1])
+            if key in node_of:
+                continue
+            w = wvals[i] if i < len(wvals) else 1.0
+            node_of[key] = len(node_parent)
+            node_parent.append(node_of[tuple(comps[:i])])
+            node_depth.append(i + 1)
+            node_weight.append(max(w, 1.0))
+
+    nH = len(node_parent)
+    H = bucket(nH, 4)
+    parent = np.full(H, -1, np.int32)
+    depth = np.zeros(H, np.int32)
+    weight = np.ones(H, np.float32)
+    valid = np.zeros(H, bool)
+    parent[:nH] = node_parent
+    depth[:nH] = node_depth
+    weight[:nH] = node_weight
+    valid[:nH] = True
+
+    D = max((len(paths[n]) for n in queue_names), default=0) + 1
+    D = max(D, 2)
+    queue_path = np.full((Q, D), -1, np.int32)
+    leaf_of_queue = np.full(Q, -1, np.int32)
+    for qi, name in enumerate(queue_names):
+        comps = paths[name]
+        queue_path[qi, 0] = 0
+        for i in range(len(comps)):
+            queue_path[qi, i + 1] = node_of[tuple(comps[: i + 1])]
+        leaf_of_queue[qi] = queue_path[qi, len(comps)]
+
+    job_leaf = np.full(J, -1, np.int32)
+    for uid, ji in maps.job_index.items():
+        qi = maps.queue_index.get(ci.jobs[uid].queue, -1)
+        if qi >= 0:
+            job_leaf[ji] = leaf_of_queue[qi]
+
+    return HierarchyArrays(parent=parent, depth=depth, weight=weight,
+                           valid=valid, queue_path=queue_path,
+                           job_leaf=job_leaf)

@@ -92,32 +92,108 @@ def namespace_shares(job_allocated: jax.Array, job_namespace: jax.Array,
     return dominant_share(ns_alloc, total) / jnp.maximum(ns_weight, 1.0)
 
 
-def hierarchical_shares(queues: QueueArrays, total: jax.Array,
-                        hierarchy_weight: jax.Array,
-                        max_depth: int = 8) -> jax.Array:
-    """f32[Q]: hdrf-style queue ordering key over the parent-pointer tree.
+def _seg_sum(vals, idx, mask, num):
+    """Masked segment sum: masked-out rows are dropped (index -> num)."""
+    if vals.ndim > mask.ndim:
+        sel = jnp.where(mask[..., None], vals, 0.0)
+    else:
+        sel = jnp.where(mask, vals, 0.0)
+    return jax.ops.segment_sum(sel, jnp.where(mask, idx, num),
+                               num_segments=num + 1)[:num]
 
-    The fork's hdrf (drf.go:230-360) water-fills dominant shares level by
-    level down the queue hierarchy. Here each queue's key is the maximum
-    weighted dominant share along its ancestor chain — a queue whose subtree
-    (or any ancestor's subtree) is over-served sorts later. Subtree
-    allocations are accumulated by propagating ``allocated`` up ``max_depth``
-    parent steps.
+
+def _seg_min(vals, idx, mask, num):
+    sel = jnp.where(mask, vals, jnp.inf)
+    return jax.ops.segment_min(sel, jnp.where(mask, idx, num),
+                               num_segments=num + 1)[:num]
+
+
+def hdrf_tree_state(hier, job_alloc: jax.Array, job_request: jax.Array,
+                    job_valid: jax.Array, total: jax.Array):
+    """Exact bottom-up hdrf tree update (drf.go:693-767).
+
+    Level-synchronous re-design of ``updateHierarchicalShare``: for each
+    depth from the deepest up, every internal node rescales its unsaturated
+    children's allocations to the minimum dominant share among them
+    (``mdr / child.share``, drf.go:704-745), sums them, and recomputes its
+    own dominant share; a node is saturated when ALL its children are.
+    Job leaves saturate per ``resourceSaturated`` (drf.go:90-103): any
+    resource where the job's allocation meets its request, or where it
+    requests a resource the cluster has fully allocated.
+
+    Inputs: ``hier`` HierarchyArrays (arrays/hierarchy.py), per-job live
+    allocation/request ([J, R]), validity, cluster totals f32[R].
+    Returns (share f32[H], saturated bool[H], allocated f32[H, R]).
     """
-    Q = queues.allocated.shape[0]
-    parent = queues.parent
+    H = hier.parent.shape[0]
+    D = hier.queue_path.shape[1]
+    jmask = job_valid & (hier.job_leaf >= 0)
+    leaf = jnp.maximum(hier.job_leaf, 0)
+    job_share = dominant_share(job_alloc, total)
+    total_alloc = jnp.sum(jnp.where(jmask[:, None], job_alloc, 0.0), axis=0)
+    demanding = total_alloc < total                       # bool[R]
+    job_sat = jnp.any(
+        ((job_alloc > _EPS) & (job_request > _EPS)
+         & (job_alloc >= job_request - _EPS))
+        | (~demanding[None, :] & (job_request > _EPS)), axis=-1)
+    job_depth = hier.depth[leaf]
 
-    def step(carry, _):
-        subtree, cursor = carry
-        has_anc = cursor >= 0
-        idx = jnp.where(has_anc, cursor, 0)
-        contrib = jnp.where(has_anc[:, None], queues.allocated, 0.0)
-        subtree = subtree + jax.ops.segment_sum(contrib, idx, num_segments=Q)
-        cursor = jnp.where(has_anc, parent[idx], -1)
-        return (subtree, cursor), None
+    share = jnp.zeros(H, jnp.float32)
+    sat = jnp.ones(H, bool)
+    alloc = jnp.zeros((H, total.shape[0]), jnp.float32)
+    parent = jnp.maximum(hier.parent, 0)
 
-    (subtree, _), _ = jax.lax.scan(step, (queues.allocated, parent),
-                                   None, length=max_depth)
-    # subtree[q] = own allocation + all descendants' (within max_depth);
-    # a queue orders by the worst weighted share along its own subtree.
-    return dominant_share(subtree, total) / jnp.maximum(hierarchy_weight, 1.0)
+    for d in reversed(range(D)):
+        child = hier.valid & (hier.depth == d + 1)
+        jat = jmask & (job_depth == d)
+        # minimum dominant share over contributing (non-empty, unsaturated)
+        # children (drf.go:704-719)
+        mdr = jnp.minimum(
+            _seg_min(share, parent, child & (share > _EPS) & ~sat, H),
+            _seg_min(job_share, leaf, jat & (job_share > _EPS) & ~job_sat, H))
+        mdr = jnp.minimum(mdr, 1.0)
+        # rescaled allocation sum: saturated children unscaled, unsaturated
+        # scaled by mdr/share, empty children skipped (drf.go:724-743)
+        c_scale = jnp.where(share > _EPS,
+                            jnp.where(sat, 1.0,
+                                      mdr[parent] / jnp.maximum(share, _EPS)),
+                            0.0)
+        j_scale = jnp.where(job_share > _EPS,
+                            jnp.where(job_sat, 1.0,
+                                      mdr[leaf] / jnp.maximum(job_share, _EPS)),
+                            0.0)
+        new_alloc = (_seg_sum(alloc * c_scale[:, None], parent, child, H)
+                     + _seg_sum(job_alloc * j_scale[:, None], leaf, jat, H))
+        unsat = (_seg_sum((~sat).astype(jnp.float32), parent, child, H)
+                 + _seg_sum((~job_sat).astype(jnp.float32), leaf, jat, H))
+        at_d = hier.valid & (hier.depth == d)
+        share = jnp.where(at_d, dominant_share(new_alloc, total), share)
+        sat = jnp.where(at_d, unsat == 0, sat)
+        alloc = jnp.where(at_d[:, None], new_alloc, alloc)
+    return share, sat, alloc
+
+
+def hdrf_level_keys(hier, job_alloc: jax.Array, job_request: jax.Array,
+                    job_valid: jax.Array, total: jax.Array) -> jax.Array:
+    """f32[Q, 2D]: per-queue lexicographic hdrf ordering key columns.
+
+    ``compareQueues`` (drf.go:182-218) walks both queues' paths from root:
+    at each level an unsaturated node beats a saturated one, then the lower
+    ``share/weight`` wins, ties descend. That is a lexicographic compare
+    over per-level (saturated, share/weight) pairs — emitted here as
+    interleaved columns for :func:`~volcano_tpu.ops.select.lex_argmin`.
+    Levels past a queue's path end emit -1 (the reference treats exhausted
+    common prefixes as a tie and falls back to heap order; -1 keeps shorter
+    paths first on full-prefix ties — documented divergence).
+    """
+    share, sat, _ = hdrf_tree_state(hier, job_alloc, job_request, job_valid,
+                                    total)
+    D = hier.queue_path.shape[1]
+    path = hier.queue_path                                 # [Q, D]
+    on_path = path >= 0
+    node = jnp.maximum(path, 0)
+    sat_col = jnp.where(on_path, sat[node].astype(jnp.float32), -1.0)
+    share_col = jnp.where(
+        on_path, share[node] / jnp.maximum(hier.weight[node], 1.0), -1.0)
+    cols = jnp.stack([sat_col, share_col], axis=-1)        # [Q, D, 2]
+    return cols.reshape(path.shape[0], 2 * D)
