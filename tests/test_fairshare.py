@@ -10,7 +10,7 @@ from volcano_tpu.arrays import pack
 from volcano_tpu.ops.enqueue import EnqueueConfig, make_enqueue_pass
 from volcano_tpu.ops.backfill import make_backfill_pass
 from volcano_tpu.ops.fairshare import (dominant_share, drf_job_shares,
-                                       hierarchical_shares, namespace_shares,
+                                       hdrf_level_keys, namespace_shares,
                                        proportion_deserved)
 
 from fixtures import build_job, build_task, res, simple_cluster
@@ -107,10 +107,10 @@ class TestDRF:
 
 
 class TestHDRF:
-    def test_subtree_accumulation(self):
+    def test_weighted_level_keys_favor_heavier_queue(self):
+        from volcano_tpu.arrays.hierarchy import build_hierarchy
         ci = simple_cluster(n_nodes=1, node_cpu="10")
         del ci.queues["default"]
-        ci.add_queue(QueueInfo("root", hierarchy="root", hierarchy_weights="1"))
         ci.add_queue(QueueInfo("root.a", hierarchy="root/a",
                                hierarchy_weights="1/1"))
         ci.add_queue(QueueInfo("root.b", hierarchy="root/b",
@@ -122,18 +122,18 @@ class TestHDRF:
             job.add_task(t)
             ci.add_job(job)
         snap, maps = pack(ci)
-        q = jax.tree.map(jnp.asarray, snap.queues)
-        hw = jnp.asarray(
-            [ci.queues[n].hierarchy_weight_values()[-1] if n in ci.queues
-             and ci.queues[n].hierarchy_weight_values() else 1.0
-             for n in maps.queue_names] + [1.0] * (q.weight.shape[0] - len(maps.queue_names)),
-            dtype=jnp.float32)
-        s = np.array(hierarchical_shares(q, jnp.asarray(snap.cluster_capacity), hw))
+        Q = np.asarray(snap.queues.weight).shape[0]
+        J = np.asarray(snap.jobs.valid).shape[0]
+        hier = build_hierarchy(ci, maps, Q, J)
+        keys = np.asarray(hdrf_level_keys(
+            hier, jnp.asarray(snap.jobs.allocated),
+            jnp.asarray(snap.jobs.total_request),
+            jnp.asarray(snap.jobs.valid),
+            jnp.asarray(snap.cluster_capacity)))
         ia, ib = maps.queue_index["root.a"], maps.queue_index["root.b"]
-        # same usage; b has 3x hierarchy weight -> lower share -> favored
-        assert s[ib] < s[ia]
-        # root aggregates both children
-        assert s[maps.queue_index["root"]] >= s[ia]
+        # same usage; b has 3x hierarchy weight -> lower weighted share at
+        # its level -> sorts first (compareQueues, drf.go:208-215)
+        assert tuple(keys[ib]) < tuple(keys[ia])
 
 
 class TestEnqueue:

@@ -299,3 +299,125 @@ class TestBuildHierarchy:
         assert int(np.asarray(h.valid).sum()) == 1
         ji = maps.job_index["default/j1"]
         assert int(np.asarray(h.job_leaf)[ji]) == 0
+
+
+# ---------------------------------------------------------------------------
+# allocation-outcome port of drf/hdrf_test.go:48-196 through the full cycle
+# ---------------------------------------------------------------------------
+
+def _hdrf_cluster(node_cpu, node_mem, queue_specs, pg_specs):
+    """queue_specs: (name, hierarchy, weights); pg_specs: (pg, queue,
+    n_tasks, cpu, mem_bytes)."""
+    from volcano_tpu.api import QueueInfo
+    from fixtures import build_job, build_node, build_task, simple_cluster
+    ci = simple_cluster(n_nodes=0)
+    ci.add_node(build_node("n", cpu=node_cpu, memory=node_mem))
+    del ci.queues["default"]
+    for name, hierarchy, weights in queue_specs:
+        ci.add_queue(QueueInfo(name, weight=1, hierarchy=hierarchy,
+                               hierarchy_weights=weights))
+    for pg, queue, n_tasks, cpu, mem in pg_specs:
+        # PodGroups in hdrf_test.go carry no MinMember -> always JobReady,
+        # so each pop yields after one placement and queues interleave.
+        # Memory quantities are powers of two (exact in f32) — hdrf_test.go
+        # uses 1G=1e9 under float64; the outcome split is unit-independent
+        job = build_job(f"default/{pg}", queue=queue, min_available=0)
+        for i in range(n_tasks):
+            job.add_task(build_task(f"{pg}-p{i}", cpu=cpu, memory=mem))
+        ci.add_job(job)
+    return ci
+
+
+def _run_hdrf(ci, use_pallas=False):
+    import jax
+    from volcano_tpu.arrays import pack
+    from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                               AllocateExtras,
+                                               make_allocate_cycle)
+    snap, maps = pack(ci)
+    Q = np.asarray(snap.queues.weight).shape[0]
+    J = np.asarray(snap.jobs.valid).shape[0]
+    extras = AllocateExtras.neutral(snap)
+    extras.hierarchy = build_hierarchy(ci, maps, Q, J)
+    # the hdrf_test.go session: drf only (hierarchy+job order), no gang
+    cfg = AllocateConfig(enable_gang=False, enable_hdrf=True,
+                         drf_job_order=True,
+                         use_pallas="interpret" if use_pallas else False)
+    result = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+    return snap, maps, extras, cfg, result
+
+
+def _job_placed(snap, maps, result):
+    """job name -> summed resreq vector of its placed tasks."""
+    node = np.asarray(result.task_node)
+    tjob = np.asarray(snap.tasks.job)
+    rr = np.asarray(snap.tasks.resreq)
+    out = {}
+    for uid, ji in maps.job_index.items():
+        mask = (tjob == ji) & (node >= 0)
+        out[uid.split("/")[-1]] = rr[mask].sum(axis=0) if mask.any() \
+            else np.zeros(rr.shape[1])
+    return out
+
+
+class TestHDRFOutcomes:
+    """The two outcome scenarios of drf/hdrf_test.go with their expected
+    per-job allocations (hdrf_test.go:113-117, 188-194)."""
+
+    def _rescaling_cluster(self):
+        return _hdrf_cluster(
+            "10", str(10 * 2 ** 30),
+            [("root-sci", "root/sci", "100/50"),
+             ("root-eng-dev", "root/eng/dev", "100/50/50"),
+             ("root-eng-prod", "root/eng/prod", "100/50/50")],
+            [("pg1", "root-sci", 10, "1", 2 ** 30),
+             ("pg21", "root-eng-dev", 10, "1", 0),
+             ("pg22", "root-eng-prod", 10, "0", 2 ** 30)])
+
+    def test_rescaling(self):
+        snap, maps, extras, cfg, result = _run_hdrf(self._rescaling_cluster())
+        got = _job_placed(snap, maps, result)
+        cpu, mem = 0, 1
+        assert got["pg1"][cpu] == 5000 and got["pg1"][mem] == 5 * 2 ** 30, got
+        assert got["pg21"][cpu] == 5000 and got["pg21"][mem] == 0, got
+        assert got["pg22"][cpu] == 0 and got["pg22"][mem] == 5 * 2 ** 30, got
+
+    def test_rescaling_pallas_parity(self):
+        ci = self._rescaling_cluster()
+        _, _, _, _, scan = _run_hdrf(ci)
+        _, _, _, _, pls = _run_hdrf(ci, use_pallas=True)
+        np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                      np.asarray(pls.task_node))
+        np.testing.assert_array_equal(np.asarray(scan.task_mode),
+                                      np.asarray(pls.task_mode))
+
+    def test_rescaling_cpu_oracle_parity(self):
+        from volcano_tpu.runtime.cpu_reference import allocate_cpu
+        snap, maps, extras, cfg, result = _run_hdrf(self._rescaling_cluster())
+        ref = allocate_cpu(snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(result.task_node),
+                                      ref["task_node"])
+        np.testing.assert_array_equal(np.asarray(result.task_mode),
+                                      ref["task_mode"])
+
+    def test_blocking_nodes(self):
+        ci = _hdrf_cluster(
+            "30", str(30 * 2 ** 30),
+            [("root-pg1", "root/pg1", "100/25"),
+             ("root-pg2", "root/pg2", "100/25"),
+             ("root-pg3-pg31", "root/pg3/pg31", "100/25/50"),
+             ("root-pg3-pg32", "root/pg3/pg32", "100/25/50"),
+             ("root-pg4", "root/pg4", "100/25")],
+            [("pg1", "root-pg1", 30, "1", 0),
+             ("pg2", "root-pg2", 30, "1", 0),
+             ("pg31", "root-pg3-pg31", 30, "1", 0),
+             ("pg32", "root-pg3-pg32", 30, "0", 2 ** 30),
+             ("pg4", "root-pg4", 30, "0", 2 ** 30)])
+        snap, maps, extras, cfg, result = _run_hdrf(ci)
+        got = _job_placed(snap, maps, result)
+        cpu, mem = 0, 1
+        assert got["pg1"][cpu] == 10000, got
+        assert got["pg2"][cpu] == 10000, got
+        assert got["pg31"][cpu] == 10000, got
+        assert got["pg32"][mem] == 15 * 2 ** 30, got
+        assert got["pg4"][mem] == 15 * 2 ** 30, got

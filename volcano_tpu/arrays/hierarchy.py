@@ -79,33 +79,36 @@ class HierarchyArrays:
         )
 
 
-def build_hierarchy(ci, maps: IndexMaps, Q: int, J: int) -> HierarchyArrays:
-    """ClusterInfo -> HierarchyArrays on the packed queue/job index maps.
+def build_from_specs(specs: List[Tuple[str, str]], Q: int,
+                     job_queue: np.ndarray,
+                     job_in_tree: np.ndarray) -> HierarchyArrays:
+    """(hierarchy, weights) annotation strings per queue -> HierarchyArrays.
 
-    ``Q``/``J`` are the bucketed dims of the snapshot so the result composes
-    with the same compiled cycle.
+    ``specs`` is ordered like the packed queue axis; ``job_queue`` is the
+    packed i32[J] queue index per job and ``job_in_tree`` masks jobs whose
+    queue is real (others get leaf -1). This is the core builder shared by
+    the in-process session (from ClusterInfo) and the sidecar's wire
+    decoder (native/pywire.py), which only has the raw strings.
     """
-    queue_names = maps.queue_names
-    # path per queue: [root, comp1, comp2, ...]; no annotation -> [root]
-    paths: Dict[str, List[str]] = {}
-    weights: Dict[str, List[float]] = {}
-    for name in queue_names:
-        q = ci.queues[name]
-        p = q.hierarchy_path()
-        paths[name] = p[1:] if p else []          # components after root
-        w = q.hierarchy_weight_values()
-        weights[name] = w[1:] if len(w) > 1 else []
+    paths: List[List[str]] = []
+    weights: List[List[float]] = []
+    for hierarchy, wstr in specs:
+        p = [c for c in hierarchy.split("/") if c]
+        paths.append(p[1:] if p else [])          # components after root
+        try:
+            w = [float(x) for x in wstr.split("/") if x]
+        except ValueError:
+            w = []
+        weights.append(w[1:] if len(w) > 1 else [])
 
-    # materialize nodes: root + every unique prefix, in sorted-queue order so
-    # the first declaring queue's weight wins (buildHierarchy first-create,
+    # materialize nodes: root + every unique prefix, in queue order so the
+    # first declaring queue's weight wins (buildHierarchy first-create,
     # drf.go:648-674)
     node_of: Dict[Tuple[str, ...], int] = {(): 0}
     node_parent = [-1]
     node_depth = [0]
     node_weight = [1.0]                            # root weight (drf.go:146)
-    for name in queue_names:
-        comps = paths[name]
-        wvals = weights[name]
+    for comps, wvals in zip(paths, weights):
         for i in range(len(comps)):
             key = tuple(comps[: i + 1])
             if key in node_of:
@@ -127,23 +130,40 @@ def build_hierarchy(ci, maps: IndexMaps, Q: int, J: int) -> HierarchyArrays:
     weight[:nH] = node_weight
     valid[:nH] = True
 
-    D = max((len(paths[n]) for n in queue_names), default=0) + 1
+    D = max((len(p) for p in paths), default=0) + 1
     D = max(D, 2)
     queue_path = np.full((Q, D), -1, np.int32)
-    leaf_of_queue = np.full(Q, -1, np.int32)
-    for qi, name in enumerate(queue_names):
-        comps = paths[name]
+    leaf_of_queue = np.full(Q, 0, np.int32)
+    for qi, comps in enumerate(paths):
         queue_path[qi, 0] = 0
         for i in range(len(comps)):
             queue_path[qi, i + 1] = node_of[tuple(comps[: i + 1])]
         leaf_of_queue[qi] = queue_path[qi, len(comps)]
 
+    J = job_queue.shape[0]
     job_leaf = np.full(J, -1, np.int32)
-    for uid, ji in maps.job_index.items():
-        qi = maps.queue_index.get(ci.jobs[uid].queue, -1)
-        if qi >= 0:
-            job_leaf[ji] = leaf_of_queue[qi]
+    sel = np.asarray(job_in_tree, bool)
+    job_leaf[sel] = leaf_of_queue[np.clip(job_queue[sel], 0, Q - 1)]
 
     return HierarchyArrays(parent=parent, depth=depth, weight=weight,
                            valid=valid, queue_path=queue_path,
                            job_leaf=job_leaf)
+
+
+def build_hierarchy(ci, maps: IndexMaps, Q: int, J: int) -> HierarchyArrays:
+    """ClusterInfo -> HierarchyArrays on the packed queue/job index maps.
+
+    ``Q``/``J`` are the bucketed dims of the snapshot so the result composes
+    with the same compiled cycle.
+    """
+    specs = [(ci.queues[n].hierarchy, ci.queues[n].hierarchy_weights)
+             for n in maps.queue_names]
+    specs += [("", "")] * (Q - len(specs))
+    job_queue = np.zeros(J, np.int32)
+    job_in_tree = np.zeros(J, bool)
+    for uid, ji in maps.job_index.items():
+        qi = maps.queue_index.get(ci.jobs[uid].queue, -1)
+        if qi >= 0:
+            job_queue[ji] = qi
+            job_in_tree[ji] = True
+    return build_from_specs(specs, Q, job_queue, job_in_tree)

@@ -26,8 +26,7 @@ import jax.numpy as jnp
 from ..arrays.schema import SnapshotArrays
 from ..ops.allocate_scan import (AllocateConfig, AllocateExtras,
                                  make_allocate_cycle)
-from ..ops.fairshare import (drf_job_shares, hierarchical_shares,
-                             namespace_shares, proportion_deserved)
+from ..ops.fairshare import proportion_deserved
 from .conf import SchedulerConfiguration, parse_conf
 
 
@@ -44,9 +43,12 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
                    taint_prefer_weight=0.0)
     any_scorer = False
     has_gang = False
+    drf_opt = None
     for opt in _plugin_options(sc):
         if opt.name == "gang":
             has_gang = True
+        if opt.name == "drf":
+            drf_opt = opt
         plugin = build_plugin(opt)
         w = plugin.score_weights(None)
         if w:
@@ -55,12 +57,24 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
                 weights[k] = weights.get(k, 0.0) + v
     if not any_scorer:
         weights.update(least_allocated_weight=1.0, balanced_weight=1.0)
-    return AllocateConfig(enable_gang=has_gang, **weights)
+    return AllocateConfig(
+        enable_gang=has_gang,
+        enable_hdrf=drf_opt is not None and drf_opt.enabled_hierarchy,
+        drf_job_order=drf_opt is not None and drf_opt.enabled_job_order,
+        drf_ns_order=drf_opt is not None and drf_opt.enabled_namespace_order,
+        **weights)
 
 
-def make_conf_cycle(conf: Optional[object] = None):
+def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):
     """conf (SchedulerConfiguration | YAML text | None) -> jittable
-    cycle(snap) -> AllocateResult with in-graph plugin extras."""
+    cycle(snap, hierarchy=None) -> AllocateResult with in-graph plugin
+    extras.
+
+    ``hierarchy`` (arrays/hierarchy.HierarchyArrays) supplies the hdrf tree
+    topology when the conf enables drf hierarchy — either baked here or
+    passed per call (the sidecar rebuilds it from the VCS2 wire's queue
+    annotations via native/pywire.decode_hierarchy). An hdrf conf with no
+    tree warns and degrades to a root-only tree (neutral queue keys)."""
     if conf is None or isinstance(conf, str):
         sc = parse_conf(conf)
     else:
@@ -69,28 +83,27 @@ def make_conf_cycle(conf: Optional[object] = None):
     cfg = allocate_config_from_conf(sc)
     allocate = make_allocate_cycle(cfg)
     proportion_on = "proportion" in options
-    drf_opt = options.get("drf")
-    drf_job_order = drf_opt is not None and drf_opt.enabled_job_order
-    drf_ns_order = drf_opt is not None and drf_opt.enabled_namespace_order
-    hdrf_on = drf_opt is not None and drf_opt.enabled_hierarchy
+    baked_hierarchy = hierarchy
 
-    def cycle(snap: SnapshotArrays):
+    def cycle(snap: SnapshotArrays, hierarchy=None):
         snap = jax.tree.map(jnp.asarray, snap)
         extras = jax.tree.map(jnp.asarray, AllocateExtras.neutral(snap))
+        tree = hierarchy if hierarchy is not None else baked_hierarchy
+        if tree is not None:
+            extras.hierarchy = jax.tree.map(jnp.asarray, tree)
+        elif cfg.enable_hdrf:
+            import warnings
+            warnings.warn(
+                "conf enables drf hierarchy but no HierarchyArrays were "
+                "supplied; hdrf queue ordering degrades to neutral keys",
+                stacklevel=2)
         total = snap.cluster_capacity
         if proportion_on:
             extras.queue_deserved = proportion_deserved(snap.queues, total)
-        if drf_job_order:
-            # drf JobOrderFn share (drf.go:454-472)
-            extras.job_share = drf_job_shares(
-                snap.jobs.allocated, total, snap.jobs.valid)
-        if drf_ns_order:
-            extras.ns_share = namespace_shares(
-                snap.jobs.allocated, snap.jobs.namespace, snap.jobs.valid,
-                snap.namespace_weight, total)
-        if hdrf_on:
-            extras.queue_share_extra = hierarchical_shares(
-                snap.queues, total, snap.queues.hier_weight)
+        # drf job/namespace shares and the hdrf queue keys are computed
+        # in-kernel from the live allocations (cfg.drf_job_order /
+        # drf_ns_order / enable_hdrf), matching the reference's
+        # event-updated attrs rather than a per-cycle snapshot
         return allocate(snap, extras)
 
     return cycle

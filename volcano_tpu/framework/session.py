@@ -126,6 +126,63 @@ class Session:
         N = np.asarray(self.snap.nodes.pod_count).shape[0]
         T = np.asarray(self.snap.tasks.status).shape[0]
         self.affinity = build_affinity(self.cluster, self.maps, N, T)
+        # hdrf tree topology (the drf plugin's hierarchicalRoot,
+        # drf.go:128-147) — static per snapshot, consumed in-kernel
+        from ..arrays.hierarchy import build_hierarchy
+        Q = np.asarray(self.snap.queues.weight).shape[0]
+        J = np.asarray(self.snap.jobs.valid).shape[0]
+        self.hierarchy = build_hierarchy(self.cluster, self.maps, Q, J)
+        self._scale_allocatables()
+
+    def _scale_allocatables(self) -> None:
+        """Apply the conf's ScaleAllocatable factors to the packed node
+        allocatable/idle (OpenSession -> ScaleAllocatables,
+        framework.go:33 + session.go:448-468). Session-scoped: operates on
+        the snapshot arrays only, never the ClusterInfo.
+
+        Per node: allocatable scales in place (ScaleResource keys
+        millicpu/memory/maxtasknum, resource_info.go:55-75); the removed
+        amount comes out of idle when idle covers it, otherwise idle's
+        cpu+memory zero out (session.go:455-464)."""
+        import dataclasses as _dc
+        for c in self.conf.configurations:
+            if c.name.lower() != "scaleallocatable":
+                continue
+            dims = self.maps.resource_names
+            alloc = np.asarray(self.snap.nodes.allocatable).copy()
+            idle = np.asarray(self.snap.nodes.idle).copy()
+            max_pods = np.asarray(self.snap.nodes.max_pods).copy()
+            old_alloc = alloc.copy()
+            key_to_dim = {"millicpu": "cpu", "memory": "memory"}
+            for key, factor in c.arguments.items():
+                try:
+                    f = float(factor)
+                except (TypeError, ValueError):
+                    continue
+                if key.lower() == "maxtasknum":
+                    max_pods = (max_pods * f).astype(max_pods.dtype)
+                    continue
+                dim = key_to_dim.get(key.lower())
+                if dim in dims:
+                    alloc[:, dims.index(dim)] *= f
+            unavailable = old_alloc - alloc
+            covered = np.all(unavailable <= idle + 1e-9, axis=-1)
+            new_idle = np.where(covered[:, None], idle - unavailable, idle)
+            if "cpu" in dims:
+                new_idle[:, dims.index("cpu")] *= covered
+            if "memory" in dims:
+                new_idle[:, dims.index("memory")] *= covered
+            valid = np.asarray(self.snap.nodes.valid)
+            self.snap = _dc.replace(
+                self.snap, nodes=_dc.replace(
+                    self.snap.nodes,
+                    allocatable=alloc.astype(np.float32),
+                    idle=new_idle.astype(np.float32),
+                    max_pods=max_pods),
+                # plugins sum allocatable AFTER scaling (framework.go:33
+                # runs before OnSessionOpen)
+                cluster_capacity=np.where(valid[:, None], alloc, 0.0)
+                .sum(axis=0).astype(np.float32))
 
     def plugin(self, name: str):
         for p in self.plugins:
@@ -162,13 +219,21 @@ class Session:
         # (nodeorder.go:104-140 priorityWeight defaults).
         if enable_aff and "pod_affinity_weight" not in provided:
             weights["pod_affinity_weight"] = 1.0
+        drf = self.plugin("drf")
         return AllocateConfig(enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,
+                              enable_hdrf=(drf is not None
+                                           and drf.option.enabled_hierarchy),
+                              drf_job_order=(drf is not None
+                                             and drf.option.enabled_job_order),
+                              drf_ns_order=(drf is not None
+                                            and drf.option.enabled_namespace_order),
                               **weights)
 
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
         extras.affinity = self.affinity
+        extras.hierarchy = self.hierarchy
         for p in self.plugins:
             deserved = p.queue_deserved(self)
             if deserved is not None:
@@ -179,12 +244,10 @@ class Session:
             ns = p.namespace_share(self)
             if ns is not None:
                 extras.ns_share = np.asarray(ns, np.float32)
-            if hasattr(p, "hierarchical_queue_share"):
-                h = p.hierarchical_queue_share(self)
-                if h is not None:
-                    extras.queue_share_extra = np.asarray(h, np.float32)
             if hasattr(p, "block_nonpreempt"):
                 extras.block_nonpreempt = np.asarray(p.block_nonpreempt(self))
+            if hasattr(p, "revocable_node_mask"):
+                extras.revocable_node = np.asarray(p.revocable_node_mask(self))
             if hasattr(p, "task_pref_node"):
                 extras.task_pref_node = np.asarray(
                     p.task_pref_node(self), np.int32)
@@ -248,8 +311,9 @@ class Session:
         return count
 
     def victim_veto_mask(self) -> np.ndarray:
-        """Union of plugin vetoes (tiered victim intersection,
-        session_plugins.go:131-215: a veto in any tier removes the victim)."""
+        """Host-computed conformance veto consumed by the kernel's tiered
+        victim dispatch as the "conformance" rule (conformance.go:45-63);
+        unioned across host plugins that veto."""
         T = np.asarray(self.snap.tasks.status).shape[0]
         veto = np.zeros(T, bool)
         for p in self.plugins:
@@ -267,18 +331,47 @@ class Session:
                 victims |= np.asarray(p.victim_tasks(self), bool)
         return victims
 
+    #: plugins registering a victim fn per mode, mirroring the reference's
+    #: AddPreemptableFn / AddReclaimableFn call sites (tdm.go:297,
+    #: priority.go:114, gang.go:106-107, drf.go:360+450,
+    #: conformance.go:64-65, proportion.go:213)
+    _VICTIM_REGISTRANTS = {
+        "preempt": ("tdm", "priority", "gang", "drf", "conformance"),
+        "reclaim": ("gang", "proportion", "drf", "conformance"),
+    }
+
+    def victim_tiers(self, mode: str):
+        """Conf tiers -> per-tier victim-rule names for the kernel's tiered
+        intersection dispatch (session_plugins.go:131-215)."""
+        tiers = []
+        for tier in self.conf.tiers:
+            names = []
+            for opt in tier.plugins:
+                if opt.name not in self._VICTIM_REGISTRANTS[mode]:
+                    continue
+                enabled = (opt.enabled_preemptable if mode == "preempt"
+                           else opt.enabled_reclaimable)
+                if not enabled:
+                    continue
+                if opt.name == "drf" and mode == "reclaim":
+                    # drf registers a Reclaimable fn only under hierarchy
+                    # (drf.go:362-450)
+                    if opt.enabled_hierarchy:
+                        names.append("drf_hdrf")
+                    continue
+                names.append(opt.name)
+            tiers.append(tuple(names))
+        return tuple(tiers)
+
     def run_preempt(self, mode: str = "preempt"):
         from ..ops.preempt import PreemptConfig
-        # the priority and drf victim filters are Preemptable fns only; the
-        # reference's priority plugin registers no Reclaimable fn
-        # (priority.go:114 vs reclaim's gang/conformance/proportion voters)
+        tdm = self.plugin("tdm")
         cfg = PreemptConfig(
             mode=mode,
             scoring=self.allocate_config(),
-            enable_priority_rule=(mode == "preempt"
-                                  and self.plugin("priority") is not None),
-            enable_drf_rule=(mode == "preempt"
-                             and self.plugin("drf") is not None))
+            tiers=self.victim_tiers(mode),
+            tdm_starving=(mode == "preempt" and tdm is not None
+                          and tdm.option.enabled_job_starving))
         result = _preempt_fn(cfg)(self.snap, self.allocate_extras(),
                                   self.victim_veto_mask())
         self.apply_preempt(result, mode)

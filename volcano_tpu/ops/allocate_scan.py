@@ -21,7 +21,15 @@ commit/discard transaction, framework/statement.go:27-395):
 Semantics preserved: a task allocates when it fits current idle, pipelines
 when it fits future idle (idle + releasing - pipelined, allocate.go:200-240);
 gang all-or-nothing per PodGroup minAvailable; overused queues are skipped
-(proportion Overused, proportion.go:240-253).
+(proportion Overused, proportion.go:240-253). Pop semantics follow
+allocate.go:205-278 exactly: a popped job places tasks until it either
+exhausts its queue, hits a task no node can take (PredicateNodes empty ->
+the job breaks for the cycle), or becomes ready with tasks still queued —
+in which case it YIELDS and re-enters the job queue, so ready jobs place
+one task per pop and interleave with other queues under the dynamically
+updated fairness keys (the mechanism behind drf/hdrf convergence; per-job
+cursor state persists across pops like the action's pendingTasks map,
+allocate.go:184-198).
 
 Documented divergence: score ties break to the lowest node index instead of
 rand.Intn (scheduler_helper.go:227) — the reference is nondeterministic there.
@@ -37,9 +45,11 @@ import jax
 import jax.numpy as jnp
 
 from ..arrays.affinity import AffinityArrays
+from ..arrays.hierarchy import HierarchyArrays
 from ..arrays.schema import SnapshotArrays
 from . import predicates as P
 from . import scoring as S
+from .fairshare import drf_job_shares, hdrf_level_keys, namespace_shares
 from .select import best_node, lex_argmin
 
 #: task placement modes in the result arrays
@@ -65,6 +75,15 @@ class AllocateConfig:
     #: untraced; the session enables it when any task carries terms.
     enable_pod_affinity: bool = False
     pod_affinity_weight: float = 1.0     # nodeorder interpodaffinity.weight
+    #: Exact hierarchical DRF queue ordering: per-round tree update over
+    #: extras.hierarchy with dynamic job allocations (drf.go:230-360).
+    enable_hdrf: bool = False
+    #: drf JobOrderFn / NamespaceOrderFn with event-updated shares
+    #: (drf.go:454-507 + AllocateFunc, drf.go:511-536): recompute the share
+    #: keys from the live in-cycle job allocations instead of the static
+    #: extras snapshot.
+    drf_job_order: bool = False
+    drf_ns_order: bool = False
     max_rounds: Optional[int] = None     # cap on outer job iterations
     #: Fused pallas round placer (ops/pallas_place.py): None = auto (TPU
     #: backend, lane-aligned N, fits VMEM), True/False = force,
@@ -86,11 +105,16 @@ class AllocateExtras:
     ns_share: jax.Array         # f32[S] drf namespace fairness (drf.go:474-507)
     queue_share_extra: jax.Array  # f32[Q] hdrf hierarchical key (drf.go:363-374)
     block_nonpreempt: jax.Array   # bool[N] tdm revocable-zone gate (tdm.go:295)
+    revocable_node: jax.Array     # bool[N] node carries a revocable zone at
+    #                               all (window-independent; the tdm victim
+    #                               rule's node filter, tdm.go:210-214)
     task_pref_node: jax.Array     # i32[T] task-topology bucket node (topology.go:344)
     node_locked: jax.Array        # bool[N] reservation locks (reservation.go:56-63)
     target_job: jax.Array         # i32 job exempt from locks (elect.go:29-50)
     affinity: AffinityArrays      # inter-pod affinity encoding (predicates
     #                               plugin contribution, arrays/affinity.py)
+    hierarchy: HierarchyArrays    # hdrf tree topology (drf plugin
+    #                               contribution, arrays/hierarchy.py)
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -107,10 +131,12 @@ class AllocateExtras:
             ns_share=np.zeros(S, np.float32),
             queue_share_extra=np.zeros(Q, np.float32),
             block_nonpreempt=np.zeros(N, bool),
+            revocable_node=np.zeros(N, bool),
             task_pref_node=np.full(T, -1, np.int32),
             node_locked=np.zeros(N, bool),
             target_job=np.int32(-1),
             affinity=AffinityArrays.neutral(N, T),
+            hierarchy=HierarchyArrays.neutral(Q, J),
         )
 
 
@@ -356,9 +382,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
             task_mode=jnp.zeros(T, jnp.int32),
             task_gpu=jnp.full(T, -1, jnp.int32),
             job_done=jnp.zeros(J, bool),
+            job_popped=jnp.zeros(J, bool),
             job_ready=jnp.zeros(J, bool),
             job_pipelined=jnp.zeros(J, bool),
             queue_allocated=queues.allocated,
+            # per-job pop state: consumed task-table slots, committed
+            # allocations (the dynamic ReadyTaskNum), live drf allocation
+            # (event-handler analog, drf.go:511-536)
+            job_cursor=jnp.zeros(J, jnp.int32),
+            job_alloc_count=jnp.zeros(J, jnp.int32),
+            job_alloc_dyn=jobs.allocated,
             rounds=jnp.int32(0),
             # live inter-pod affinity counts (neutral [1,1] when disabled)
             aff_cnt=extras.affinity.cnt0,
@@ -368,7 +401,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
             **init_cap,
         )
 
-        max_rounds = J if cfg.max_rounds is None else cfg.max_rounds
+        # a ready job yields after each placement and re-enters the queue
+        # (allocate.go:262-265), so pops are bounded by J + total tasks
+        max_rounds = J + T if cfg.max_rounds is None else cfg.max_rounds
+        total_cap = snap.cluster_capacity
 
         # static predicate rows per template, computed once per cycle (the
         # predicate-cache analog, predicates/cache.go:42-90; see
@@ -400,7 +436,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
                                axis=-1)
             job_overused = overused[jobs.queue]
             return (jobs.valid & jobs.schedulable & ~st["job_done"]
-                    & (jobs.n_pending > 0) & ~job_overused)
+                    & (st["job_cursor"] < jobs.n_pending) & ~job_overused)
 
         def cond(st):
             return jnp.any(eligible(st)) & (st["rounds"] < max_rounds)
@@ -418,24 +454,56 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 axis=-1) + extras.queue_share_extra
             job_q = jobs.queue
             job_ns = jobs.namespace
-            ready_now = (jobs.ready_num >= jobs.min_available) & (jobs.min_available > 0)
+            # drf keys recomputed from live allocations when the plugin's
+            # event handlers would have updated them (drf.go:511-536)
+            if cfg.drf_ns_order:
+                ns_share_k = namespace_shares(
+                    st["job_alloc_dyn"], job_ns, jobs.valid,
+                    snap.namespace_weight, total_cap)
+            else:
+                ns_share_k = ns_share
+            if cfg.drf_job_order:
+                job_share_k = drf_job_shares(st["job_alloc_dyn"], total_cap,
+                                             jobs.valid)
+            else:
+                job_share_k = job_share
+            ready_dyn = jobs.ready_num + st["job_alloc_count"]
+            ready_now = (ready_dyn >= jobs.min_available) & (jobs.min_available > 0)
             keys = [
-                ns_share[job_ns],                    # namespace order (drf ns fairness)
+                ns_share_k[job_ns],                  # namespace order (drf ns fairness)
                 job_ns.astype(jnp.float32),          # namespace tie-break (by name)
                 qshare[job_q],                       # queue order (proportion)
+            ]
+            if cfg.enable_hdrf:
+                # hdrf compareQueues walk as lexicographic level columns,
+                # recomputed per pop from the live tree (drf.go:182-218)
+                hcols = hdrf_level_keys(
+                    extras.hierarchy, st["job_alloc_dyn"],
+                    jobs.total_request, jobs.valid, total_cap)
+                for c in range(int(hcols.shape[1])):
+                    keys.append(hcols[:, c][job_q])
+            keys += [
                 job_q.astype(jnp.float32),           # queue tie-break
                 -jobs.priority.astype(jnp.float32),  # priority plugin JobOrderFn
                 ready_now.astype(jnp.float32),       # gang: ready jobs last
-                job_share,                           # drf JobOrderFn
+                job_share_k,                         # drf JobOrderFn
                 jobs.creation_rank.astype(jnp.float32),  # FIFO fallback
             ]
             ji, _found = lex_argmin(keys, elig)
 
             task_ids = jobs.task_table[ji]           # i32[M]
             min_avail = jobs.min_available[ji]
-            ready0 = jobs.ready_num[ji]
+            ready0 = jobs.ready_num[ji] + st["job_alloc_count"][ji]
+            cur = st["job_cursor"][ji]
+            slots = jnp.arange(M, dtype=jnp.int32)
+            open_slot = (task_ids >= 0) & (slots >= cur)
+            nb_row = open_slot & ~tasks.best_effort[jnp.maximum(task_ids, 0)]
+            # real tasks remaining in the job's queue strictly after slot m
+            # (the !tasks.Empty() side of the yield check, allocate.go:262)
+            rc = jnp.cumsum(nb_row[::-1].astype(jnp.int32))[::-1]
+            suffix_after = rc - nb_row.astype(jnp.int32)
 
-            # ---- inner placement: try every pending task of the job ------
+            # ---- inner placement: pop tasks until yield/break/exhausted ---
             def pallas_round():
                 """One fused kernel launch for the whole round
                 (ops/pallas_place.py) instead of the M-step scan."""
@@ -449,32 +517,61 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 sscore = tp_static[tmpl_ids]
                 resreq_t = tasks.resreq[tcl].T
                 gpu_req_row = tasks.gpu_request[tcl][None, :]
-                active_row = ((task_ids >= 0)
-                              & ~tasks.best_effort[tcl])[None, :].astype(
-                                  jnp.int32)
+                active_row = nb_row[None, :].astype(jnp.int32)
                 pref_row = extras.task_pref_node[tcl][None, :]
+                suffix_row = suffix_after[None, :]
+                meta_row = jnp.zeros((1, M), jnp.int32)
+                meta_row = meta_row.at[0, 0].set(ready0)
+                meta_row = meta_row.at[0, 1].set(min_avail)
                 (node_s, mode_s, gpu_s, idle, pipe_extra, pods_extra,
                  gpu_extra) = placer(
-                    resreq_t, gpu_req_row, active_row, pref_row, sfeas,
-                    sscore, relmp_t, alloc_t, cnt_row, maxp_row, gidle0_t,
-                    st["idle"], st["pipe_extra"], st["pods_extra"],
-                    st["gpu_extra"])
-                tidx = jnp.where(task_ids >= 0, task_ids, T)
-                t_node = st["task_node"].at[tidx].set(node_s, mode="drop")
-                t_mode = st["task_mode"].at[tidx].set(mode_s, mode="drop")
-                t_gpu = st["task_gpu"].at[tidx].set(gpu_s, mode="drop")
-                real = task_ids >= 0
-                n_alloc = jnp.sum((mode_s == MODE_ALLOCATED) & real)
-                n_pipe = jnp.sum((mode_s == MODE_PIPELINED) & real)
+                    resreq_t, gpu_req_row, active_row, pref_row, suffix_row,
+                    meta_row, sfeas, sscore, relmp_t, alloc_t, cnt_row,
+                    maxp_row, gidle0_t, st["idle"], st["pipe_extra"],
+                    st["pods_extra"], st["gpu_extra"])
+                # write back only this round's placements — earlier pops of
+                # a yielded job already own their slots' decisions
+                placed_m = mode_s != MODE_NONE
+                widx = jnp.where((task_ids >= 0) & placed_m, task_ids, T)
+                t_node = st["task_node"].at[widx].set(node_s, mode="drop")
+                t_mode = st["task_mode"].at[widx].set(mode_s, mode="drop")
+                t_gpu = st["task_gpu"].at[widx].set(gpu_s, mode="drop")
+                n_alloc = jnp.sum(mode_s == MODE_ALLOCATED).astype(jnp.int32)
+                n_pipe = jnp.sum(mode_s == MODE_PIPELINED).astype(jnp.int32)
+                # replay the kernel's yield/break events from the mode row:
+                # first stop event (placed & ready & queue non-empty) vs
+                # first break event (attempted & unplaced)
+                alloc_cum = jnp.cumsum((mode_s == MODE_ALLOCATED)
+                                       .astype(jnp.int32))
+                if cfg.enable_gang:
+                    ready_aft = (ready0 + alloc_cum) >= min_avail
+                else:
+                    ready_aft = jnp.ones(M, bool)
+                stop_evt = nb_row & placed_m & ready_aft & (suffix_after > 0)
+                broke_evt = nb_row & ~placed_m
+                first_stop = jnp.min(jnp.where(stop_evt, slots, M))
+                first_broke = jnp.min(jnp.where(broke_evt, slots, M))
+                stopped = first_stop < first_broke
+                broke = (~stopped) & (first_broke < M)
+                boundary = jnp.where(stopped | broke,
+                                     jnp.minimum(first_stop, first_broke),
+                                     M - 1)
+                n_adv = jnp.sum(open_slot & (slots <= boundary)
+                                ).astype(jnp.int32)
+                placed_sum = jnp.sum(
+                    jnp.where(placed_m[:, None], tasks.resreq[tcl], 0.0),
+                    axis=0)
                 return (idle, pipe_extra, pods_extra, gpu_extra,
-                        t_node, t_mode, t_gpu,
-                        n_alloc.astype(jnp.int32), n_pipe.astype(jnp.int32))
+                        t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                        placed_sum, n_adv, stopped, broke)
 
-            def task_step(carry, t_idx):
+            def task_step(carry, xs):
                 (idle, pipe_extra, pods_extra, gpu_extra,
                  t_node, t_mode, t_gpu, n_alloc, n_pipe,
-                 aff_cnt, anti_cnt) = carry
-                active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
+                 aff_cnt, anti_cnt, placed_sum, n_adv, stopped, broke) = carry
+                t_idx, slot, suffix = xs
+                can_run = ((t_idx >= 0) & (slot >= cur) & ~stopped & ~broke)
+                active = can_run & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
                 t = jnp.maximum(t_idx, 0)
                 resreq = tasks.resreq[t]
                 gpu_req = tasks.gpu_request[t]
@@ -541,25 +638,43 @@ def make_allocate_cycle(cfg: AllocateConfig):
                               jnp.where(do_pipe, MODE_PIPELINED, t_mode[t])))
                 n_alloc += jnp.where(do_alloc, 1, 0)
                 n_pipe += jnp.where(do_pipe, 1, 0)
+                placed_sum = placed_sum + jnp.where(placed, 1.0, 0.0) * resreq
+                n_adv += jnp.where(can_run, 1, 0)
+                # yield: a ready job with tasks still queued re-enters the
+                # job queue after each placement (allocate.go:262-265);
+                # break: a task no node can take fails the whole job
+                # (allocate.go:210-214 PredicateNodes empty)
+                if cfg.enable_gang:
+                    ready_aft = (ready0 + n_alloc) >= min_avail
+                else:
+                    ready_aft = jnp.bool_(True)
+                stopped |= active & placed & ready_aft & (suffix > 0)
+                broke |= active & ~placed
                 if cfg.enable_pod_affinity:
                     aff_cnt, anti_cnt = _affinity_place_update(
                         extras.affinity, aff_cnt, anti_cnt, t, node, placed)
                 return (idle, pipe_extra, pods_extra, gpu_extra,
                         t_node, t_mode, t_gpu, n_alloc, n_pipe,
-                        aff_cnt, anti_cnt), None
+                        aff_cnt, anti_cnt, placed_sum, n_adv,
+                        stopped, broke), None
 
             if use_pallas:
                 (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
-                 t_gpu, n_alloc, n_pipe) = pallas_round()
+                 t_gpu, n_alloc, n_pipe, placed_sum, n_adv, stopped,
+                 broke) = pallas_round()
                 aff_cnt, anti_cnt = st["aff_cnt"], st["anti_cnt"]
             else:
                 carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
                           st["gpu_extra"], st["task_node"], st["task_mode"],
                           st["task_gpu"], jnp.int32(0), jnp.int32(0),
-                          st["aff_cnt"], st["anti_cnt"])
+                          st["aff_cnt"], st["anti_cnt"],
+                          jnp.zeros(R, jnp.float32), jnp.int32(0),
+                          jnp.bool_(False), jnp.bool_(False))
                 (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
-                 t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt), _ = jax.lax.scan(
-                    task_step, carry0, task_ids, unroll=min(int(M), 16))
+                 t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt, placed_sum,
+                 n_adv, stopped, broke), _ = jax.lax.scan(
+                    task_step, carry0, (task_ids, slots, suffix_after),
+                    unroll=min(int(M), 16))
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
             ready = (ready0 + n_alloc) >= min_avail
@@ -599,14 +714,12 @@ def make_allocate_cycle(cfg: AllocateConfig):
             saved_aff = jnp.where(keep, aff_cnt, st["saved_aff"])
             saved_anti = jnp.where(keep, anti_cnt, st["saved_anti"])
 
-            # queue accounting for the share ordering (proportion event
-            # handlers on Allocate, proportion.go:281-325)
-            placed_mask = job_tasks & (t_mode != MODE_NONE)
-            placed_res = jnp.sum(
-                jnp.where(placed_mask[:, None], tasks.resreq, 0.0), axis=0)
+            # queue + drf accounting for the ordering keys (event handlers
+            # on Allocate/Pipeline, proportion.go:281-325, drf.go:511-536);
+            # only this pop's placements count, and only when kept
             qi = jobs.queue[ji]
-            queue_allocated = st["queue_allocated"].at[qi].add(
-                jnp.where(keep, 1.0, 0.0) * placed_res)
+            committed = jnp.where(keep, 1.0, 0.0) * placed_sum
+            queue_allocated = st["queue_allocated"].at[qi].add(committed)
 
             return dict(
                 idle=idle, pipe_extra=pipe_extra, pods_extra=pods_extra,
@@ -616,10 +729,20 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 aff_cnt=aff_cnt, anti_cnt=anti_cnt,
                 saved_aff=saved_aff, saved_anti=saved_anti,
                 task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
-                job_done=st["job_done"].at[ji].set(True),
+                # a yielded (ready, queue non-empty) job is re-pushed; any
+                # other outcome finishes it for the cycle
+                job_done=st["job_done"].at[ji].set(~stopped),
+                # attempted = popped at least once this cycle, even if a
+                # later overused-queue gate or round cap cuts the job off
+                # while job_done is still False (yield re-push pending)
+                job_popped=st["job_popped"].at[ji].set(True),
                 job_ready=st["job_ready"].at[ji].set(ready),
                 job_pipelined=st["job_pipelined"].at[ji].set(
                     pipelined & ~ready),
+                job_cursor=st["job_cursor"].at[ji].add(n_adv),
+                job_alloc_count=st["job_alloc_count"].at[ji].add(
+                    jnp.where(keep, n_alloc, 0)),
+                job_alloc_dyn=st["job_alloc_dyn"].at[ji].add(committed),
                 queue_allocated=queue_allocated,
                 rounds=st["rounds"] + 1,
             )
@@ -633,7 +756,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
             task_gpu=final["task_gpu"],
             job_ready=final["job_ready"],
             job_pipelined=final["job_pipelined"],
-            job_attempted=final["job_done"],
+            job_attempted=final["job_popped"],
             idle=final["idle"],
             queue_allocated=final["queue_allocated"],
         )

@@ -83,7 +83,8 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
 
 def _round_kernel(cfg, M, N, R, G,
                   # inputs
-                  resreq_t_ref, gpu_req_ref, active_ref, pref_ref, sfeas_ref,
+                  resreq_t_ref, gpu_req_ref, active_ref, pref_ref,
+                  suffix_ref, meta_ref, sfeas_ref,
                   sscore_ref, relmp_ref, alloc_t_ref, cnt_ref, maxp_ref,
                   gidle0_ref, idle_ref, pipe_ref, podsx_ref, gpux_ref,
                   # outputs
@@ -98,17 +99,22 @@ def _round_kernel(cfg, M, N, R, G,
     gpu_req = gpu_req_ref[:]        # [1, M]
     active_v = active_ref[:]        # [1, M] int32
     pref_v = pref_ref[:]            # [1, M] int32
+    suffix_v = suffix_ref[:]        # [1, M] i32 queued tasks after slot m
+    meta_v = meta_ref[:]            # [1, M] i32: [0]=ready0, [1]=min_avail
     sfeas = sfeas_ref[:]            # [M, N] f32 0/1
     sscore = sscore_ref[:]          # [M, N]
     iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
     iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
     iota_m = jax.lax.broadcasted_iota(jnp.int32, (1, M), 1)
     iota_m_col = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)
+    ready0 = jnp.sum(jnp.where(iota_m == 0, meta_v, 0))
+    min_avail = jnp.sum(jnp.where(iota_m == 1, meta_v, 0))
 
     def body(m, carry):
         # mosaic has no dynamic lane/sublane indexing, so the per-task row
         # selections are one-hot reductions
-        idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v = carry
+        (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
+         n_allocs, stopped, broke) = carry
         sel_m = (iota_m == m).astype(jnp.float32)            # [1,M]
         sel_col = (iota_m_col == m).astype(jnp.float32)      # [M,1]
         rr_col = jnp.sum(resreq_t * sel_m, axis=1, keepdims=True)   # [R,1]
@@ -117,6 +123,7 @@ def _round_kernel(cfg, M, N, R, G,
                       keepdims=True)                                # [1,1]
         pref = jnp.sum(pref_v * sel_m.astype(jnp.int32), axis=1,
                        keepdims=True)                               # [1,1]
+        suffix = jnp.sum(jnp.where(iota_m == m, suffix_v, 0))       # scalar
         sfeas_m = jnp.sum(sfeas * sel_col, axis=0, keepdims=True)   # [1,N]
         sscore_m = jnp.sum(sscore * sel_col, axis=0, keepdims=True)
 
@@ -149,7 +156,9 @@ def _round_kernel(cfg, M, N, R, G,
 
         n_now, found_now = pick(feas_now)
         n_fut, found_fut = pick(feas_fut)
-        active = act[0, 0] > 0          # act is int32 [1,1]
+        # yield/break state gates the attempt (allocate.go:205-266): after a
+        # ready-job yield or an unplaceable task, remaining slots are no-ops
+        active = (act[0, 0] > 0) & ~stopped & ~broke
         can_now = found_now & active
         can_fut = found_fut & active & bool(cfg.enable_pipelining)
         do_alloc = can_now
@@ -178,13 +187,23 @@ def _round_kernel(cfg, M, N, R, G,
         node_v = jnp.where(is_m, jnp.where(placed, node, -1), node_v)
         mode_v = jnp.where(is_m, mode, mode_v)
         gpuc_v = jnp.where(is_m, jnp.where(charge, card, -1), gpuc_v)
-        return idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v
+        n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
+        if cfg.enable_gang:
+            ready_aft = (ready0 + n_allocs) >= min_avail
+        else:
+            ready_aft = True
+        stopped = stopped | (placed & ready_aft & (suffix > 0))
+        broke = broke | (active & ~placed)
+        return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
+                n_allocs, stopped, broke)
 
     neg1 = jnp.full((1, M), -1, jnp.int32)
-    idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v = jax.lax.fori_loop(
+    (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
+     _n_allocs, _stopped, _broke) = jax.lax.fori_loop(
         0, M, body,
         (idle_ref[:], pipe_ref[:], podsx_ref[:], gpux_ref[:],
-         neg1, jnp.zeros((1, M), jnp.int32), neg1))
+         neg1, jnp.zeros((1, M), jnp.int32), neg1,
+         jnp.int32(0), jnp.bool_(False), jnp.bool_(False)))
     node_ref[:] = node_v
     mode_ref[:] = mode_v
     gpu_ref[:] = gpuc_v
@@ -199,16 +218,17 @@ def make_round_placer(cfg, M: int, N: int, R: int, G: int,
     """Build the fused round placer.
 
     Returns place(resreq_t [R,M], gpu_req [1,M], active [1,M], pref [1,M],
-    sfeas [M,N], sscore [M,N] (taint-static), relmp [R,N], alloc_t [R,N],
-    cnt [1,N], maxp [1,N], gidle0 [G,N], idle [R,N], pipe [R,N],
-    podsx [1,N], gpux [G,N])
+    suffix [1,M] (queued tasks after each slot), meta [1,M] ([0]=ready
+    count, [1]=minAvailable), sfeas [M,N], sscore [M,N] (taint-static),
+    relmp [R,N], alloc_t [R,N], cnt [1,N], maxp [1,N], gidle0 [G,N],
+    idle [R,N], pipe [R,N], podsx [1,N], gpux [G,N])
     -> (node [M], mode [M], gpu [M], idle', pipe', podsx', gpux').
     """
     kernel = functools.partial(_round_kernel, cfg, M, N, R, G)
     f32 = jnp.float32
 
-    def place(resreq_t, gpu_req, active, pref, sfeas, sscore, relmp, alloc_t,
-              cnt, maxp, gidle0, idle, pipe, podsx, gpux):
+    def place(resreq_t, gpu_req, active, pref, suffix, meta, sfeas, sscore,
+              relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx, gpux):
         outs = pl.pallas_call(
             kernel,
             out_shape=(
@@ -221,8 +241,8 @@ def make_round_placer(cfg, M: int, N: int, R: int, G: int,
                 jax.ShapeDtypeStruct((G, N), f32),         # gpux'
             ),
             interpret=interpret,
-        )(resreq_t, gpu_req, active, pref, sfeas, sscore, relmp, alloc_t,
-          cnt, maxp, gidle0, idle, pipe, podsx, gpux)
+        )(resreq_t, gpu_req, active, pref, suffix, meta, sfeas, sscore,
+          relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx, gpux)
         node, mode, gpu, idle2, pipe2, podsx2, gpux2 = outs
         return (node[0], mode[0], gpu[0], idle2, pipe2, podsx2, gpux2)
 

@@ -41,13 +41,7 @@ class DRFPlugin(Plugin):
         return (share / np.maximum(np.asarray(ssn.snap.namespace_weight), 1.0)
                 ).astype(np.float32)
 
-    def hierarchical_queue_share(self, ssn) -> np.ndarray:
-        """f32[Q] hdrf ordering key; only when enableHierarchy is set."""
-        if not self.option.enabled_hierarchy:
-            return None
-        import jax
-        import jax.numpy as jnp
-        from ..ops.fairshare import hierarchical_shares
-        q = jax.tree.map(jnp.asarray, ssn.snap.queues)
-        return np.asarray(hierarchical_shares(
-            q, jnp.asarray(ssn.snap.cluster_capacity), q.hier_weight))
+    # hdrf: the hierarchical queue ordering is computed in-kernel from
+    # AllocateExtras.hierarchy (arrays/hierarchy.py) when the option's
+    # enabled_hierarchy sets AllocateConfig.enable_hdrf — see
+    # ops/fairshare.hdrf_level_keys for the exact drf.go:182-218 walk.

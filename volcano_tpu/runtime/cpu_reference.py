@@ -247,6 +247,19 @@ def _affinity_place(st, t, node):
             st["anti_cnt"][e, d] += 1.0
 
 
+def _hdrf_keys(hier, job_alloc, job_req, job_valid, total):
+    """Per-queue hdrf level keys for the current live job allocations.
+
+    Delegates to ops.fairshare.hdrf_level_keys (run on host arrays) so the
+    oracle's ordering keys are BIT-identical to the kernel's — the key
+    VALUES are independently validated against a recursive transliteration
+    of drf.go in tests/test_hdrf.py; what this oracle checks is the pop
+    loop's mechanics around them."""
+    from ..ops.fairshare import hdrf_level_keys
+    return np.asarray(hdrf_level_keys(
+        hier, np.asarray(job_alloc, np.float32), job_req, job_valid, total))
+
+
 def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                  cfg: AllocateConfig = AllocateConfig()) -> Dict[str, np.ndarray]:
     """Run the allocate pass sequentially on the host. Returns the same
@@ -281,7 +294,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     job_pipelined = np.zeros(J, bool)
 
     jns = np.array(jobs.namespace)
-    jvalid = np.array(jobs.valid) & np.array(jobs.schedulable)
+    jvalid_all = np.array(jobs.valid)
+    jvalid = jvalid_all & np.array(jobs.schedulable)
     n_pending = np.array(jobs.n_pending)
     jqueue = np.array(jobs.queue)
     jprio = np.array(jobs.priority)
@@ -289,6 +303,15 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     jready0 = np.array(jobs.ready_num)
     jmin = np.array(jobs.min_available)
     table = np.array(jobs.task_table)
+    jreq32 = np.array(jobs.total_request, np.float32)
+    total_cap = np.array(snap.cluster_capacity, np.float32)
+    resreq32 = np.array(tasks.resreq, np.float32)
+    ns_weight = np.array(snap.namespace_weight, np.float32)
+    # live drf state (event-handler analog): committed allocations +
+    # ReadyTaskNum, float32 accumulated in kernel order for bit-equality
+    job_cursor = np.zeros(J, np.int64)
+    job_alloc_count = np.zeros(J, np.int64)
+    job_alloc_dyn = np.array(jobs.allocated, np.float32).copy()
     releasing = np.array(nodes.releasing)
     pipelined0 = np.array(nodes.pipelined)
     resreq = np.array(tasks.resreq, dtype=np.float64)
@@ -317,19 +340,40 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
 
     while True:
         overused = np.any(queue_allocated > queue_deserved + 1e-6, axis=-1)
-        elig = jvalid & ~job_done & (n_pending > 0) & ~overused[jqueue]
+        elig = jvalid & ~job_done & (job_cursor < n_pending) & ~overused[jqueue]
         if not elig.any():
             break
         qshare = np.max(
             np.where(np.isfinite(queue_deserved) & (queue_deserved > 0),
                      queue_allocated / np.maximum(queue_deserved, 1e-9), 0.0),
             axis=-1) + queue_share_extra
-        ready_now = (jready0 >= jmin) & (jmin > 0)
-        keys = np.stack([
-            np.asarray(ns_share, float)[jns], jns.astype(float),
-            qshare[jqueue], jqueue.astype(float), -jprio.astype(float),
-            ready_now.astype(float), np.asarray(job_share, float),
-            jrank.astype(float)])
+        # drf keys from live allocations (event-handler analog,
+        # drf.go:511-536) — delegated to ops.fairshare on host arrays so
+        # the oracle's keys stay BIT-identical to the kernel's (same
+        # delegation rationale as _hdrf_keys above)
+        if cfg.drf_ns_order:
+            from ..ops.fairshare import namespace_shares
+            ns_share_k = np.asarray(namespace_shares(
+                job_alloc_dyn, jns, jvalid_all, ns_weight, total_cap))
+        else:
+            ns_share_k = np.asarray(ns_share, float)
+        if cfg.drf_job_order:
+            from ..ops.fairshare import drf_job_shares
+            job_share_k = np.asarray(drf_job_shares(
+                job_alloc_dyn, total_cap, jvalid_all))
+        else:
+            job_share_k = np.asarray(job_share, float)
+        ready_dyn = jready0 + job_alloc_count
+        ready_now = (ready_dyn >= jmin) & (jmin > 0)
+        key_rows = [ns_share_k[jns], jns.astype(float), qshare[jqueue]]
+        if cfg.enable_hdrf:
+            hcols = _hdrf_keys(extras.hierarchy, job_alloc_dyn, jreq32,
+                               jvalid_all, total_cap)
+            key_rows += [hcols[jqueue, c] for c in range(hcols.shape[1])]
+        key_rows += [jqueue.astype(float), -jprio.astype(float),
+                     ready_now.astype(float), job_share_k,
+                     jrank.astype(float)]
+        keys = np.stack(key_rows)
         best_ji, best_key = -1, None
         for ji in range(J):
             if not elig[ji]:
@@ -344,11 +388,18 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
         if aff_st is not None:
             saved_aff = (aff_st["aff_cnt"].copy(), aff_st["anti_cnt"].copy())
         placed: List[int] = []
+        placed_sum32 = np.zeros(len(total_cap), np.float32)
         n_alloc = n_pipe = 0
-        for slot in range(M):
+        ready0_dyn = int(jready0[ji] + job_alloc_count[ji])
+        stopped = False
+        slot = int(job_cursor[ji])
+        while slot < M:
             t = table[ji, slot]
-            if t < 0 or best_effort[t]:
-                continue
+            if t < 0:
+                break               # past the row's real entries
+            slot += 1               # the task is popped (consumed)
+            if best_effort[t]:
+                continue            # never queued (allocate.go:186-195)
             sel = t_selector[t]
             th = t_tol_hash[t]
             te = t_tol_effect[t]
@@ -367,6 +418,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 aff_feas, aff_score = _affinity_one(aff_st, t, valid_sched)
                 feas_now &= aff_feas
                 score = score + cfg.pod_affinity_weight * aff_score
+            did_place = False
             if feas_now.any():
                 node = int(np.argmax(np.where(feas_now, score, -np.inf)))
                 idle[node] -= req
@@ -378,7 +430,9 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 task_node[t] = node
                 task_mode[t] = MODE_ALLOCATED
                 placed.append(t)
+                placed_sum32 = placed_sum32 + resreq32[t]
                 n_alloc += 1
+                did_place = True
                 if aff_st is not None:
                     _affinity_place(aff_st, t, node)
             elif cfg.enable_pipelining:
@@ -398,16 +452,34 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                     task_node[t] = node
                     task_mode[t] = MODE_PIPELINED
                     placed.append(t)
+                    placed_sum32 = placed_sum32 + resreq32[t]
                     n_pipe += 1
+                    did_place = True
                     if aff_st is not None:
                         _affinity_place(aff_st, t, node)
+            if not did_place:
+                # no node can take the task at all -> the job breaks
+                # (allocate.go:210-214 PredicateNodes empty)
+                break
+            # yield: a ready job with tasks still queued re-enters the
+            # job queue (allocate.go:262-265)
+            ready_aft = (not cfg.enable_gang
+                         or (ready0_dyn + n_alloc) >= jmin[ji])
+            remaining = any(table[ji, s] >= 0 and not best_effort[table[ji, s]]
+                            for s in range(slot, M))
+            if ready_aft and remaining:
+                stopped = True
+                break
+        job_cursor[ji] = slot
 
-        ready = (jready0[ji] + n_alloc) >= jmin[ji]
-        pipelined = (jready0[ji] + n_alloc + n_pipe) >= jmin[ji]
+        ready = (ready0_dyn + n_alloc) >= jmin[ji]
+        pipelined = (ready0_dyn + n_alloc + n_pipe) >= jmin[ji]
         if not cfg.enable_gang:
             ready = True
         if ready or pipelined:
             queue_allocated[jqueue[ji]] += resreq[placed].sum(axis=0) if placed else 0
+            job_alloc_dyn[ji] = job_alloc_dyn[ji] + placed_sum32
+            job_alloc_count[ji] += n_alloc
             job_ready[ji] = bool(ready)
             job_pipelined[ji] = bool(pipelined and not ready)
             if not ready:
@@ -422,7 +494,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 task_node[t] = -1
                 task_mode[t] = MODE_NONE
                 task_gpu[t] = -1
-        job_done[ji] = True
+        job_done[ji] = not stopped
 
     return dict(task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
                 job_ready=job_ready,
