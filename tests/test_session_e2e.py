@@ -121,9 +121,10 @@ tiers:
         assert all(uid.startswith("default/lo") for uid in sched.cluster.evictions)
         assert "default/hi-0" in ssn.pipelined
 
-    def test_gang_protects_min_available(self):
-        """Victims stop once the low-priority gang hits its minAvailable
-        (gang.go:83-107 veto)."""
+    def test_priority_victims_cross_gang_min_available(self):
+        """This fork's gang preemptableFn is a plain job-priority rule
+        (gang.go:83-103) — it does NOT stop victims at the low gang's
+        minAvailable, so a higher-priority gang takes what it needs."""
         ci = simple_cluster(n_nodes=1, node_cpu="3", node_mem="6Gi")
         lo = build_job("default/lo", min_available=2, priority=1)
         for i in range(3):
@@ -137,10 +138,13 @@ tiers:
             hi.add_task(build_task(f"hi-{i}", cpu="1", memory="1Gi"))
         ci.add_job(hi)
         sched = make_scheduler(ci, self.conf())
-        sched.run_once()
-        # only 1 surplus task may be evicted (3 running - minAvailable 2);
-        # hi needs 2 slots -> cannot be satisfied -> gang discard, no evictions
-        assert len(sched.cluster.evictions) == 0
+        ssn = sched.run_once()
+        # hi needs 2 slots on the full node -> 2 lo victims (even though lo
+        # then falls below its minAvailable), and hi holds the capacity
+        assert len(sched.cluster.evictions) == 2
+        assert all(uid.startswith("default/lo")
+                   for uid in sched.cluster.evictions)
+        assert {"default/hi-0", "default/hi-1"} <= set(ssn.pipelined)
 
     def test_no_preemption_across_equal_priority(self):
         ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="2Gi")
@@ -175,21 +179,25 @@ tiers:
 
     def test_underserved_queue_reclaims(self):
         """q2's starving job reclaims capacity from q1 which is over its
-        deserved share (reclaim.go:40-191)."""
+        deserved share (reclaim.go:40-191). Tasks request cpu only: the
+        proportion victim rule is a per-dim what-if — the donor queue must
+        stay at-or-above deserved on EVERY dim after the eviction
+        (proportion.go:217-236), so an uncontended-memory queue whose
+        deserved memory equals its full request would never donate."""
         ci = simple_cluster(n_nodes=1, node_cpu="4", node_mem="8Gi")
         ci.add_queue(QueueInfo("q1", weight=1, reclaimable=True))
         ci.add_queue(QueueInfo("q2", weight=1))
         greedy = build_job("default/greedy", queue="q1", min_available=1,
                            priority=1)
         for i in range(4):
-            t = build_task(f"gr-{i}", cpu="1", memory="1Gi")
+            t = build_task(f"gr-{i}", cpu="1", memory=0)
             t.status = TaskStatus.RUNNING
             greedy.add_task(t)
             ci.nodes["n0"].add_task(t)
         ci.add_job(greedy)
         starv = build_job("default/starv", queue="q2", min_available=1,
                           priority=1)
-        starv.add_task(build_task("st-0", cpu="1", memory="1Gi"))
+        starv.add_task(build_task("st-0", cpu="1", memory=0))
         ci.add_job(starv)
         sched = make_scheduler(ci, self.conf())
         ssn = sched.run_once()
@@ -282,3 +290,147 @@ class TestBindSeamTolerance:
         assert not ok
         assert cluster.binds == []
         assert task.status == TaskStatus.PENDING
+
+
+class TestScaleAllocatables:
+    """ScaleAllocatable configurations shrink node allocatable + idle at
+    session open (framework.go:33 -> session.go:448-468)."""
+
+    CONF = """
+actions: "allocate"
+configurations:
+  - name: ScaleAllocatable
+    arguments:
+      millicpu: 0.5
+tiers:
+- plugins:
+  - name: nodeorder
+"""
+
+    def test_scaling_changes_placement(self):
+        import numpy as np
+        from volcano_tpu.framework.conf import parse_conf
+        from volcano_tpu.framework.session import Session
+        ci = simple_cluster(n_nodes=1, node_cpu="4", node_mem="8Gi")
+        job = build_job("default/j", min_available=0)
+        for i in range(4):
+            job.add_task(build_task(f"t-{i}", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        # unscaled: all 4 tasks fit
+        plain = Session(ci, parse_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: nodeorder
+"""))
+        plain.run_allocate()
+        assert len(plain.binds) == 4
+        # scaled to 2 cpu: only 2 place
+        ci2 = simple_cluster(n_nodes=1, node_cpu="4", node_mem="8Gi")
+        job2 = build_job("default/j", min_available=0)
+        for i in range(4):
+            job2.add_task(build_task(f"t-{i}", cpu="1", memory="1Gi"))
+        ci2.add_job(job2)
+        ssn = Session(ci2, parse_conf(self.CONF))
+        alloc = np.asarray(ssn.snap.nodes.allocatable)
+        assert alloc[0, 0] == 2000.0      # 4 cpu * 0.5
+        assert np.asarray(ssn.snap.nodes.idle)[0, 0] == 2000.0
+        assert np.asarray(ssn.snap.cluster_capacity)[0] == 2000.0
+        ssn.run_allocate()
+        assert len(ssn.binds) == 2
+
+    def test_scaling_below_used_zeroes_idle(self):
+        """When the removed allocatable exceeds idle, idle cpu+memory zero
+        out instead of going negative (session.go:459-463)."""
+        import numpy as np
+        from volcano_tpu.framework.conf import parse_conf
+        from volcano_tpu.framework.session import Session
+        ci = simple_cluster(n_nodes=1, node_cpu="4", node_mem="8Gi")
+        job = build_job("default/j", min_available=1)
+        t = build_task("r-0", cpu="3", memory="1Gi")
+        t.status = TaskStatus.RUNNING
+        job.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(job)
+        # scale to 2 cpu: unavailable (2 cpu) > idle (1 cpu) -> idle zeroed
+        ssn = Session(ci, parse_conf(self.CONF))
+        idle = np.asarray(ssn.snap.nodes.idle)
+        assert idle[0, 0] == 0.0
+        assert idle[0, 1] == 0.0
+
+
+class TestResyncRetry:
+    """Failed bind/evict dispatches retry from the rate-limited resync
+    queue (cache.go:687-709) without a fresh allocate decision."""
+
+    def test_failed_bind_retries_and_binds_later(self):
+        ci = simple_cluster(n_nodes=1)
+        job = build_job("default/j", min_available=1)
+        job.add_task(build_task("t-0", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        sched = make_scheduler(ci)
+        # first bind attempt fails once, then the backend accepts
+        sched.cluster.bind_failures["default/t-0"] = 1
+        sched.run_once(now=100.0)
+        assert sched.cluster.binds == []
+        # the task holds Binding on its decided node so later cycles do not
+        # re-decide it
+        held = sched.cluster.ci.jobs["default/j"].tasks["default/t-0"]
+        assert held.status == TaskStatus.BINDING
+        assert len(sched.resync) == 1
+        # next cycle: the retry (not a fresh decision) lands the bind
+        ssn = sched.run_once(now=101.0)
+        assert sched.cluster.binds == [("default/t-0", "n0")]
+        assert ssn.binds == []   # the session itself decided nothing new
+        assert held.status == TaskStatus.BOUND
+
+    def test_exhausted_retries_resync_then_fresh_decision(self):
+        from volcano_tpu.metrics import METRICS
+        ci = simple_cluster(n_nodes=1)
+        job = build_job("default/j", min_available=1)
+        job.add_task(build_task("t-0", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        sched = make_scheduler(ci)
+        sched.resync.max_attempts = 3
+        sched.cluster.bind_failures["default/t-0"] = "node gone"   # forever
+        dropped0 = METRICS.counters["resync_dropped"]
+        sched.run_once(now=100.0)
+        task = sched.cluster.ci.jobs["default/j"].tasks["default/t-0"]
+        assert task.status == TaskStatus.BINDING
+        for i in range(3):
+            sched.run_once(now=200.0 + 100.0 * i)
+        # retries exhausted -> the drop resyncs the task to Pending (the
+        # syncTask give-up, cache.go:690-709) and the SAME cycle's fresh
+        # session re-decides it, restarting the retry ladder at attempt 1
+        assert METRICS.counters["resync_dropped"] == dropped0 + 1
+        assert len(sched.resync) == 1
+        assert sched.resync.entries[0]["attempts"] == 1
+        # once the backend recovers, the retry path completes the bind
+        del sched.cluster.bind_failures["default/t-0"]
+        sched.run_once(now=1000.0)
+        assert task.status == TaskStatus.BOUND
+        assert len(sched.resync) == 0
+
+    def test_backoff_rate_limits_retries(self):
+        from volcano_tpu.runtime.scheduler import ResyncQueue
+        from volcano_tpu.framework.session import BindIntent
+        q = ResyncQueue(base_delay=1.0, max_delay=8.0, max_attempts=5)
+
+        class Never:
+            def __init__(self):
+                self.calls = 0
+
+            def bind(self, intent):
+                self.calls += 1
+                return False
+
+            def resync_task(self, uid):
+                pass
+
+        c = Never()
+        q.add(BindIntent("t", "j", "n"), "bind", now=0.0)
+        assert q.process(c, now=0.5) == dict(retried=0, succeeded=0, dropped=0)
+        assert q.process(c, now=1.0)["retried"] == 1      # after base delay
+        # second attempt backs off exponentially (2s, not 1s)
+        assert q.process(c, now=2.0)["retried"] == 0
+        assert q.process(c, now=3.5)["retried"] == 1

@@ -2,27 +2,53 @@
 
 TPU re-design of pkg/scheduler/actions/preempt/preempt.go:42-291 (intra-queue
 preemption for starving gangs) and pkg/scheduler/actions/reclaim/
-reclaim.go:40-191 (cross-queue reclaim for underserved queues). The tiered
-Preemptable/Reclaimable victim intersection (framework/session_plugins.go:
-131-215) becomes a conjunction of victim-eligibility masks:
+reclaim.go:40-191 (cross-queue reclaim for underserved queues).
 
-- gang: a job may only lose tasks above its minAvailable surplus
-  (gang.go:83-107),
-- priority: victims' job priority must be lower than the preemptor's
-  (priority.go:114),
-- drf: the victim job's dominant share must stay >= the preemptor's
-  (drf.go:330-360; evaluated statically per cycle — documented approximation),
-- conformance / tdm: host-supplied veto mask (conformance.go:30-68).
+Victim dispatch implements the reference's TIERED intersection exactly
+(framework/session_plugins.go:131-215): within a tier, each enabled plugin
+with a registered victim fn contributes a candidate set and the sets
+intersect; the FIRST tier whose intersection is non-empty decides. Because
+the reference calls Preemptable/Reclaimable once per (preemptor, node), the
+winning tier is chosen PER NODE, and the resulting victim set is frozen for
+that preemptor's eviction loop (preempt.go:218-258).
+
+Per-plugin victim rules (all evaluated against LIVE in-cycle allocations,
+the event-handler analog):
+
+- priority: victim's job priority < preemptor's (priority.go:85-113),
+- gang: same comparison in this fork (gang.go:83-103),
+- drf: the victim job's dominant share after removal must stay >= the
+  preemptor job's share after adding the preemptor task, within shareDelta
+  (drf.go:336-358); shares recompute per eviction via the tracked
+  job_alloc_dyn (AllocateFunc/DeallocateFunc, drf.go:511-561),
+- conformance: host-supplied veto mask (critical pods / kube-system,
+  conformance.go:45-63),
+- tdm (preempt): a preemptable (or revocable-zone) preemptor gets an EMPTY
+  set — poisoning its whole tier; otherwise candidates are preemptable
+  Running tasks on non-revocable nodes (tdm.go:193-229; the per-job
+  maxVictims batching is applied host-side in the victimTasks sweep),
+- proportion (reclaim): what-if queue arithmetic — victim only if its
+  queue's allocation after removal still covers the queue's deserved share
+  (proportion.go:213-239), against the live queue_alloc_dyn,
+- drf hierarchy (reclaim): clone-tree what-if — add the reclaimer's
+  request, subtract the candidate's, and keep the candidate only if the
+  reclaimer's queue still orders strictly before the victim's in the hdrf
+  comparison (drf.go:377-449).
 
 ValidateVictims' capacity check (util/scheduler_helper.go:240-255) is the
-``future idle + evictable >= request`` test; the lowest-priority-first victim
-eviction is a bounded inner while-loop; gang commit/discard works exactly as
-in the allocate kernel (keep iff JobPipelined).
+``future idle + evictable >= request`` test; victims evict lowest task
+priority first (the inverted TaskOrderFn queue, preempt.go:228-233) until
+the preemptor fits FutureIdle, then the preemptor pipelines. Documented
+divergences: node ties break to the lowest index (reference walks nodes in
+sorted-score order with unstable ties); the intra-job second preemption
+phase (preempt.go:145-186) and drf's namespace-order pre-stage
+(drf.go:285-334) are not modeled.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,19 +57,24 @@ from ..api.types import TaskStatus
 from ..arrays.schema import SnapshotArrays
 from . import predicates as P
 from .allocate_scan import MODE_PIPELINED, AllocateConfig, AllocateExtras, _score_fn
+from .fairshare import dominant_share, hdrf_level_keys
 from .select import NEG, lex_argmin
 
-_OCCUPYING = (int(TaskStatus.ALLOCATED), int(TaskStatus.BINDING),
-              int(TaskStatus.BOUND), int(TaskStatus.RUNNING))
+_DELTA = 1e-6  # drf shareDelta (drf.go:37)
 
 
 @dataclass(frozen=True)
 class PreemptConfig:
     mode: str = "preempt"               # "preempt" | "reclaim"
     scoring: AllocateConfig = AllocateConfig()
-    enable_priority_rule: bool = True   # priority plugin victim filter
-    enable_drf_rule: bool = False       # drf share victim filter
-    max_victims_per_task: int = 16      # bound on the eviction loop
+    #: victim-rule tiers (session_plugins.go:131-215): per tier, the names
+    #: of plugins whose victim fn is registered AND enabled for this mode.
+    #: Names: "priority", "gang", "drf", "conformance", "tdm" (preempt);
+    #: "gang", "proportion", "drf_hdrf", "conformance" (reclaim).
+    tiers: Tuple[Tuple[str, ...], ...] = (("priority", "gang"), ("drf",))
+    #: tdm JobStarvingFn: preemptable jobs never preempt (tdm.go:292-298)
+    tdm_starving: bool = False
+    max_victims_per_task: int = 16
 
 
 @jax.tree_util.register_dataclass
@@ -56,13 +87,24 @@ class PreemptResult:
     job_attempted: jax.Array  # bool[J]
 
 
+def _lex_row_less(kl: jax.Array, kr: jax.Array) -> jax.Array:
+    """bool: key row kl orders strictly before kr (first differing column
+    decides — the compareQueues walk over level keys)."""
+    neq = kl != kr
+    first = jnp.argmax(neq)
+    return jnp.any(neq) & (kl[first] < kr[first])
+
+
 def make_preempt_cycle(cfg: PreemptConfig):
     """Build the jittable preempt/reclaim pass.
 
     Signature: fn(snap, extras, victim_veto bool[T]) -> PreemptResult.
-    ``extras`` reuses the allocate inputs (job/ns/queue shares, deserved).
+    ``extras`` reuses the allocate inputs (deserved shares, tdm masks, hdrf
+    tree); ``victim_veto`` is the conformance rule's host-computed veto.
     """
     reclaim = cfg.mode == "reclaim"
+    rule_names = [r for tier in cfg.tiers for r in tier]
+    use_hdrf_rule = "drf_hdrf" in rule_names
 
     def preempt(snap: SnapshotArrays, extras: AllocateExtras,
                 victim_veto: jax.Array) -> PreemptResult:
@@ -74,34 +116,38 @@ def make_preempt_cycle(cfg: PreemptConfig):
         T = tasks.resreq.shape[0]
         J, M = jobs.task_table.shape
         queue_deserved = extras.queue_deserved
+        total_cap = snap.cluster_capacity
+        vjob = jnp.maximum(tasks.job, 0)
+        vqueue = jobs.queue[vjob]
 
-        occupying = jnp.zeros(T, bool)
-        for s in _OCCUPYING:
-            occupying |= tasks.status == s
-        occupying &= tasks.valid & (tasks.node >= 0)
-
-        # gang surplus: occupying count above minAvailable per job
-        occ_per_job = jax.ops.segment_sum(
-            occupying.astype(jnp.int32), jnp.maximum(tasks.job, 0),
-            num_segments=J)
-        surplus0 = jnp.maximum(occ_per_job - jobs.min_available, 0)
+        # victims must be Running with a real request (preempt.go:116-123,
+        # reclaim.go:129-136)
+        running = (tasks.status == int(TaskStatus.RUNNING)) & tasks.valid \
+            & (tasks.node >= 0) & ~tasks.best_effort
 
         waiting0 = jax.ops.segment_sum(
             (tasks.status == int(TaskStatus.PIPELINED)).astype(jnp.int32),
-            jnp.maximum(tasks.job, 0), num_segments=J)
+            vjob, num_segments=J)
 
-        # starving gangs are the preemptors (gang JobStarving, gang.go:150-155)
-        starving = (jobs.valid & jobs.schedulable
-                    & (jobs.ready_num + waiting0 < jobs.min_available)
-                    & (jobs.n_pending > 0))
-
-        # reclaim only serves underserved queues (reclaim.go:80-100)
         qshare = jnp.max(
             jnp.where(jnp.isfinite(queue_deserved) & (queue_deserved > 0),
                       queues.allocated / jnp.maximum(queue_deserved, 1e-9),
                       0.0), axis=-1)
+        overused = jnp.any(queues.allocated > queue_deserved + 1e-6, axis=-1)
+
         if reclaim:
-            starving &= qshare[jobs.queue] < 1.0 - 1e-6
+            # reclaim serves jobs with pending tasks in non-overused queues
+            # (reclaim.go:72-81, 94-97)
+            starving = (jobs.valid & jobs.schedulable & (jobs.n_pending > 0)
+                        & ~overused[jobs.queue])
+        else:
+            # gang JobStarving (gang.go:150-155)
+            starving = (jobs.valid & jobs.schedulable
+                        & (jobs.ready_num + waiting0 < jobs.min_available)
+                        & (jobs.n_pending > 0))
+            if cfg.tdm_starving:
+                # tdm JobStarvingFn: preemptable jobs never preempt
+                starving &= ~jobs.preemptable
 
         future0 = nodes.future_idle()
 
@@ -113,16 +159,20 @@ def make_preempt_cycle(cfg: PreemptConfig):
             extra_idle=jnp.zeros((N, R), jnp.float32),   # from evictions
             pipe_extra=jnp.zeros((N, R), jnp.float32),   # new pipelines
             evicted=jnp.zeros(T, bool),
-            surplus=surplus0,
             task_node=jnp.full(T, -1, jnp.int32),
             task_mode=jnp.zeros(T, jnp.int32),
             job_done=jnp.zeros(J, bool),
             job_pipelined=jnp.zeros(J, bool),
+            # live drf/proportion state (event handlers, drf.go:511-561,
+            # proportion.go:281-325)
+            job_alloc_dyn=jobs.allocated,
+            queue_alloc_dyn=queues.allocated,
             saved=None,  # replaced below
             rounds=jnp.int32(0),
         )
-        saved_keys = ("extra_idle", "pipe_extra", "evicted", "surplus",
-                      "task_node", "task_mode")
+        saved_keys = ("extra_idle", "pipe_extra", "evicted",
+                      "task_node", "task_mode", "job_alloc_dyn",
+                      "queue_alloc_dyn")
         init["saved"] = {k: init[k] for k in saved_keys}
 
         def eligible(st):
@@ -130,6 +180,103 @@ def make_preempt_cycle(cfg: PreemptConfig):
 
         def cond(st):
             return jnp.any(eligible(st)) & (st["rounds"] < J)
+
+        def victim_rule(name, t, ji, evicted, job_alloc_dyn, queue_alloc_dyn):
+            """bool[T] candidate mask of one plugin's victim fn."""
+            pprio = jobs.priority[ji]
+            if name in ("priority", "gang"):
+                return jobs.priority[vjob] < pprio
+            if name == "conformance":
+                return ~victim_veto
+            if name == "tdm":
+                # preemptable preemptors never preempt via tdm
+                # (tdm.go:193-197); victims are preemptable Running tasks
+                # on non-revocable nodes (tdm.go:199-218)
+                abstain = tasks.preemptable[t]
+                mask = (tasks.preemptable
+                        & ~extras.revocable_node[jnp.maximum(tasks.node, 0)])
+                return mask & ~abstain
+            if name == "drf":
+                ls = dominant_share(
+                    job_alloc_dyn[ji] + tasks.resreq[t], total_cap)
+                rs = dominant_share(
+                    job_alloc_dyn[vjob] - tasks.resreq, total_cap)
+                return (ls < rs) | (jnp.abs(ls - rs) <= _DELTA)
+            if name == "proportion":
+                # queue what-if (proportion.go:217-236): enough allocation
+                # to subtract, and deserved still covered afterwards
+                q_alloc = queue_alloc_dyn[vqueue]
+                des = queue_deserved[vqueue]
+                after = q_alloc - tasks.resreq
+                has = ~jnp.all(q_alloc < tasks.resreq, axis=-1)
+                covered = jnp.all(
+                    jnp.where(jnp.isfinite(des), des <= after + 1e-6, True),
+                    axis=-1)
+                return has & covered
+            raise ValueError(f"unknown victim rule {name!r}")
+
+        def hdrf_rule(t, ji, job_alloc_dyn, pre):
+            """drf_hdrf: clone-tree what-if (drf.go:377-449) — reclaimer
+            added, candidate removed, reclaimer's queue must order strictly
+            first in the hdrf comparison. Each what-if is a full tree
+            solve, so it runs LAST in its tier and only for the first
+            ``K`` candidates surviving the cheaper rules, in eviction-
+            preference (task priority) order — exact whenever a node holds
+            at most K candidates (bounded divergence, documented)."""
+            K = min(64, T)
+            base_alloc = job_alloc_dyn.at[ji].add(tasks.resreq[t])
+            lq = jobs.queue[ji]
+            order = jnp.argsort(
+                jnp.where(pre, tasks.priority.astype(jnp.float32), jnp.inf))
+            idx = order[:K]
+
+            def what_if(v):
+                alloc_v = base_alloc.at[tasks.job[v]].add(-tasks.resreq[v])
+                keys = hdrf_level_keys(
+                    extras.hierarchy, alloc_v, jobs.total_request,
+                    jobs.valid, total_cap)
+                return _lex_row_less(keys[lq], keys[vqueue[v]])
+
+            ok = jax.vmap(what_if)(idx) & pre[idx]
+            return jnp.zeros(T, bool).at[idx].set(ok)
+
+        def victim_mask_for(t, ji, evicted, job_alloc_dyn, queue_alloc_dyn):
+            """Frozen victim set for one preemptor task: tiered
+            intersection with per-node first-non-empty-tier-wins."""
+            base = running & ~evicted
+            if reclaim:
+                base &= (vqueue != jobs.queue[ji]) & queues.reclaimable[vqueue]
+            else:
+                base &= (vqueue == jobs.queue[ji]) & (tasks.job != ji)
+            if not any(len(tier) for tier in cfg.tiers):
+                # no plugin registered a victim fn: the reference dispatch
+                # returns nil -> no victims at all (session_plugins.go:131)
+                return jnp.zeros_like(base)
+            tier_masks = []
+            for tier in cfg.tiers:
+                if not tier:
+                    continue
+                m = base
+                for name in tier:
+                    if name == "drf_hdrf":
+                        continue     # expensive rule intersects last
+                    m &= victim_rule(name, t, ji, evicted, job_alloc_dyn,
+                                     queue_alloc_dyn)
+                if "drf_hdrf" in tier:
+                    m = hdrf_rule(t, ji, job_alloc_dyn, m)
+                tier_masks.append(m)
+            stacked = jnp.stack(tier_masks)                    # [K, T]
+            node_idx = jnp.where(stacked, tasks.node[None, :], N)
+            node_any = jnp.zeros((len(tier_masks), N + 1), bool)
+            node_any = node_any.at[
+                jnp.arange(len(tier_masks))[:, None], node_idx].set(
+                    True)[:, :N]                               # [K, N]
+            first_tier = jnp.argmax(node_any, axis=0)          # [N]
+            has_tier = jnp.any(node_any, axis=0)
+            pick = first_tier[jnp.maximum(tasks.node, 0)]      # [T]
+            chosen = jnp.take_along_axis(
+                stacked, pick[None, :], axis=0)[0]
+            return chosen & has_tier[jnp.maximum(tasks.node, 0)]
 
         def body(st):
             elig = eligible(st)
@@ -144,36 +291,18 @@ def make_preempt_cycle(cfg: PreemptConfig):
             ]
             ji, _ = lex_argmin(keys, elig)
             task_ids = jobs.task_table[ji]
-            preemptor_prio = jobs.priority[ji]
-            preemptor_share = extras.job_share[ji]
-            preemptor_queue = jobs.queue[ji]
-
-            def victim_ok(evicted, surplus):
-                ok = occupying & ~evicted & ~victim_veto
-                ok &= surplus[jnp.maximum(tasks.job, 0)] > 0
-                if reclaim:
-                    # cross-queue, victim queue reclaimable and overused
-                    # (proportion Reclaimable, proportion.go:213-239)
-                    vq = jobs.queue[jnp.maximum(tasks.job, 0)]
-                    ok &= vq != preemptor_queue
-                    ok &= queues.reclaimable[vq]
-                    overused = jnp.any(
-                        queues.allocated > queue_deserved + 1e-6, axis=-1)
-                    ok &= overused[vq]
-                else:
-                    ok &= jobs.queue[jnp.maximum(tasks.job, 0)] == preemptor_queue
-                    ok &= tasks.job != ji
-                if cfg.enable_priority_rule:
-                    ok &= jobs.priority[jnp.maximum(tasks.job, 0)] < preemptor_prio
-                if cfg.enable_drf_rule:
-                    ok &= extras.job_share[jnp.maximum(tasks.job, 0)] \
-                        >= preemptor_share
-                return ok
 
             def task_step(carry, t_idx):
-                (extra_idle, pipe_extra, evicted, surplus,
-                 t_node, t_mode, n_pipe) = carry
+                (extra_idle, pipe_extra, evicted, t_node, t_mode,
+                 job_alloc_dyn, queue_alloc_dyn, n_pipe) = carry
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
+                if not reclaim:
+                    # the preemptor loop stops once the job is no longer
+                    # starving (preempt.go:99-101): pipelined tasks count
+                    # toward the gang's waiting number
+                    still_starving = (jobs.ready_num[ji] + waiting0[ji]
+                                      + n_pipe < jobs.min_available[ji])
+                    active &= still_starving
                 t = jnp.maximum(t_idx, 0)
                 resreq = tasks.resreq[t]
                 # GPU predicate runs with current card usage like the other
@@ -186,7 +315,10 @@ def make_preempt_cycle(cfg: PreemptConfig):
                             future0 + extra_idle, None,
                             gpu_request=tasks.gpu_request[t]))
 
-                vok = victim_ok(evicted, surplus)
+                # the victim set is FROZEN for this preemptor's eviction
+                # loop (preempt.go:218-233 builds it once per node)
+                vok = victim_mask_for(t, ji, evicted, job_alloc_dyn,
+                                      queue_alloc_dyn)
                 evictable = jax.ops.segment_sum(
                     jnp.where(vok[:, None], tasks.resreq, 0.0),
                     jnp.where(vok, tasks.node, N), num_segments=N + 1)[:N]
@@ -201,59 +333,71 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 node = jnp.argmax(jnp.where(feas, score, NEG)).astype(jnp.int32)
                 found = jnp.any(feas)
 
-                # evict victims on `node`, lowest job/task priority first,
-                # until the task fits future idle (preempt.go:240-278)
+                # evict victims on `node`, lowest task priority first (the
+                # inverted TaskOrderFn queue, preempt.go:228-233), until
+                # the preemptor fits future idle
                 def evict_cond(ec):
-                    extra_idle, _evicted, _surplus, k = ec
+                    extra_idle, _e, _ja, _qa, k = ec
                     fits = jnp.all(
                         resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
                     return found & ~fits & (k < cfg.max_victims_per_task)
 
                 def evict_body(ec):
-                    extra_idle, evicted, surplus, k = ec
-                    vok_now = victim_ok(evicted, surplus) & (tasks.node == node)
+                    extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn, k = ec
+                    vok_now = vok & ~evicted & (tasks.node == node)
                     vkeys = [
-                        jobs.priority[jnp.maximum(tasks.job, 0)].astype(jnp.float32),
                         tasks.priority.astype(jnp.float32),
                     ]
                     vt, vfound = lex_argmin(vkeys, vok_now)
                     doit = vfound
-                    extra_idle = extra_idle.at[node].add(
-                        jnp.where(doit, 1.0, 0.0) * tasks.resreq[vt])
+                    dres = jnp.where(doit, 1.0, 0.0) * tasks.resreq[vt]
+                    extra_idle = extra_idle.at[node].add(dres)
                     evicted = evicted.at[vt].set(evicted[vt] | doit)
-                    surplus = surplus.at[jnp.maximum(tasks.job[vt], 0)].add(
-                        jnp.where(doit, -1, 0))
-                    return (extra_idle, evicted, surplus,
+                    # DeallocateFunc analog: live shares drop with the
+                    # eviction (drf.go:537-561, proportion.go:300-325)
+                    job_alloc_dyn = job_alloc_dyn.at[tasks.job[vt]].add(-dres)
+                    queue_alloc_dyn = queue_alloc_dyn.at[vqueue[vt]].add(-dres)
+                    return (extra_idle, evicted, job_alloc_dyn,
+                            queue_alloc_dyn,
                             jnp.where(doit, k + 1, cfg.max_victims_per_task))
 
-                extra_idle, evicted, surplus, _ = jax.lax.while_loop(
+                (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
+                 _) = jax.lax.while_loop(
                     evict_cond, evict_body,
-                    (extra_idle, evicted, surplus, jnp.int32(0)))
+                    (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
+                     jnp.int32(0)))
 
                 fits = found & jnp.all(
                     resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
                 pipe_extra = pipe_extra.at[node].add(
                     jnp.where(fits, 1.0, 0.0) * resreq)
+                # AllocateFunc analog for the pipelined preemptor
+                pres = jnp.where(fits, 1.0, 0.0) * resreq
+                job_alloc_dyn = job_alloc_dyn.at[ji].add(pres)
+                queue_alloc_dyn = queue_alloc_dyn.at[jobs.queue[ji]].add(pres)
                 t_node = t_node.at[t].set(jnp.where(fits, node, t_node[t]))
                 t_mode = t_mode.at[t].set(
                     jnp.where(fits, MODE_PIPELINED, t_mode[t]))
                 n_pipe += jnp.where(fits, 1, 0)
-                return (extra_idle, pipe_extra, evicted, surplus,
-                        t_node, t_mode, n_pipe), None
+                return (extra_idle, pipe_extra, evicted, t_node, t_mode,
+                        job_alloc_dyn, queue_alloc_dyn, n_pipe), None
 
             carry0 = (st["extra_idle"], st["pipe_extra"], st["evicted"],
-                      st["surplus"], st["task_node"], st["task_mode"],
+                      st["task_node"], st["task_mode"],
+                      st["job_alloc_dyn"], st["queue_alloc_dyn"],
                       jnp.int32(0))
-            (extra_idle, pipe_extra, evicted, surplus, t_node, t_mode,
-             n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
+            (extra_idle, pipe_extra, evicted, t_node, t_mode,
+             job_alloc_dyn, queue_alloc_dyn, n_pipe), _ = jax.lax.scan(
+                task_step, carry0, task_ids)
 
             pipelined = (jobs.ready_num[ji] + waiting0[ji] + n_pipe
                          >= jobs.min_available[ji])
             keep = pipelined
 
             new = dict(extra_idle=extra_idle, pipe_extra=pipe_extra,
-                       evicted=evicted, surplus=surplus, task_node=t_node,
-                       task_mode=t_mode)
+                       evicted=evicted, task_node=t_node, task_mode=t_mode,
+                       job_alloc_dyn=job_alloc_dyn,
+                       queue_alloc_dyn=queue_alloc_dyn)
             saved = st["saved"]
             job_tasks = tasks.job == ji
             merged = {}

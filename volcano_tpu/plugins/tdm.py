@@ -50,6 +50,16 @@ class TDMPlugin(Plugin):
         node = ssn.cluster.nodes.get(name)
         return (node.labels.get(REVOCABLE_ZONE_LABEL, "") if node else "")
 
+    def revocable_node_mask(self, ssn) -> np.ndarray:
+        """bool[N]: node carries a revocable zone (window-independent) —
+        the tdm victim rule's node filter (tdm.go:210-214)."""
+        N = np.asarray(ssn.snap.nodes.pod_count).shape[0]
+        mask = np.zeros(N, bool)
+        for name, ni in ssn.maps.node_index.items():
+            if self.node_zone(ssn, name):
+                mask[ni] = True
+        return mask
+
     def block_nonpreempt(self, ssn) -> np.ndarray:
         """bool[N]: revocable nodes (active window) admit only preemptable
         tasks; outside the window they admit nothing new (tdm.go:295)."""
