@@ -86,3 +86,44 @@ class TestSidecar:
             sock.close()
         finally:
             server.shutdown()
+
+
+class TestSidecarHDRF:
+    def test_wire_carries_hierarchy_tree(self):
+        """A conf-mode sidecar serving an hdrf policy rebuilds the exact
+        hierarchy tree from the VCS2 queue annotations and reproduces the
+        reference's rescaling split (drf/hdrf_test.go:68-118) over the
+        wire."""
+        import numpy as np
+        from test_hdrf import _hdrf_cluster
+        from volcano_tpu.runtime.sidecar import SidecarClient, SidecarServer
+        ci = _hdrf_cluster(
+            "10", str(10 * 2 ** 30),
+            [("root-sci", "root/sci", "100/50"),
+             ("root-eng-dev", "root/eng/dev", "100/50/50"),
+             ("root-eng-prod", "root/eng/prod", "100/50/50")],
+            [("pg1", "root-sci", 10, "1", 2 ** 30),
+             ("pg21", "root-eng-dev", 10, "1", 0),
+             ("pg22", "root-eng-prod", 10, "0", 2 ** 30)])
+        conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: drf
+    enableHierarchy: true
+"""
+        server = SidecarServer(conf=conf)
+        server.serve_in_thread()
+        try:
+            client = SidecarClient(*server.address)
+            out = client.schedule(ci)
+            client.close()
+        finally:
+            server.shutdown()
+        placed = {}
+        maps = out["maps"]
+        for uid, ti in maps.task_index.items():
+            job = uid.split("/")[-1].rsplit("-", 1)[0]
+            if out["task_mode"][ti] != 0:
+                placed[job] = placed.get(job, 0) + 1
+        assert placed == {"pg1": 5, "pg21": 5, "pg22": 5}, placed

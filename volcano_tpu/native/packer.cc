@@ -1,4 +1,4 @@
-// Native snapshot packer: VCS1 wire buffer -> dense scheduling arrays.
+// Native snapshot packer: VCS2 wire buffer -> dense scheduling arrays.
 //
 // This is the framework's native runtime component: the host-side hot path
 // that turns a serialized cluster snapshot (the payload that crosses the
@@ -9,8 +9,8 @@
 // reference's equivalent moment is SchedulerCache.Snapshot deep-copying the
 // cluster mirror (pkg/scheduler/cache/cache.go:712-811).
 //
-// Wire format VCS1 (little-endian; see volcano_tpu/native/wire.py):
-//   u32 magic 'VCS1' (0x31534356), u32 R, nq, ns, nn, nj, nt
+// Wire format VCS2 (little-endian; see volcano_tpu/native/wire.py):
+//   u32 magic 'VCS2' (0x32534356), u32 R, nq, ns, nn, nj, nt
 //   R   x string            resource dimension names (informational)
 //   nq  x queue record      (sorted by name)
 //   ns  x namespace record  (sorted by name)
@@ -32,7 +32,7 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x31534356u;  // "VCS1"
+constexpr uint32_t kMagic = 0x32534356u;  // "VCS2"
 
 // TaskStatus codes (volcano_tpu/api/types.py:14-36; reference
 // pkg/scheduler/api/types.go:29-96).
@@ -225,7 +225,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   std::memset(a, 0, sizeof(*a));
   Reader r{buf, buf + len};
   if (r.U32() != kMagic) {
-    a->error = "bad magic (not a VCS1 buffer)";
+    a->error = "bad magic (not a VCS2 buffer)";
     return 1;
   }
   const uint32_t R = r.U32();
@@ -238,10 +238,10 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   // Sanity-bound every count against the bytes actually present before any
   // allocation sized by it: a crafted header must fail as ValueError on the
   // Python side, never as bad_alloc/OOM in here.  Minimum record sizes:
-  // queue 4+4+4R+2+8+4, namespace 4+4, node 4+24R+8+1+4+8, job 4+16+8+4+8R+3,
+  // queue 4+4+4R+2+8+4+8, namespace 4+4, node 4+24R+8+1+4+8, job 4+16+8+4+8R+3,
   // task 4+4+4R+12+2+4+8.
   const uint64_t remaining = static_cast<uint64_t>(r.end - r.p);
-  const uint64_t min_bytes = uint64_t(nq) * (22 + 4ull * R) + uint64_t(ns) * 8 +
+  const uint64_t min_bytes = uint64_t(nq) * (30 + 4ull * R) + uint64_t(ns) * 8 +
                              uint64_t(nn) * (17 + 24ull * R) +
                              uint64_t(nj) * (35 + 8ull * R) +
                              uint64_t(nt) * (34 + 4ull * R);
@@ -319,6 +319,8 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     a->q_parent[i] = r.I32();
     a->q_depth[i] = r.I32();
     a->q_hier_weight[i] = r.F32();
+    r.SkipString();  // hierarchy annotation (decoded python-side, pywire)
+    r.SkipString();  // hierarchy weights annotation
     a->q_valid[i] = 1;
   }
 

@@ -1,4 +1,4 @@
-"""Pure-Python VCS1 parser: wire buffer -> SnapshotArrays.
+"""Pure-Python VCS2 parser: wire buffer -> SnapshotArrays.
 
 The fallback half of the native packing runtime (packer.cc is the fast
 path): keeps the scheduling sidecar usable on hosts without g++, and acts
@@ -22,7 +22,7 @@ import numpy as np
 from ..arrays.schema import (JobArrays, NodeArrays, QueueArrays,
                              SnapshotArrays, TaskArrays)
 
-MAGIC = 0x31534356  # "VCS1"
+MAGIC = 0x32534356  # "VCS2"
 
 # TaskStatus codes (volcano_tpu/api/types.py; pkg/scheduler/api/types.go:29-96)
 _STATUS_PENDING = 0
@@ -72,6 +72,12 @@ class _Reader:
         n = self.u32()
         self.off += n
 
+    def string(self) -> str:
+        n = self.u32()
+        v = self.buf[self.off:self.off + n].decode("utf-8", "replace")
+        self.off += n
+        return v
+
     def f32vec(self, n: int) -> np.ndarray:
         v = np.frombuffer(self.buf, "<f4", n, self.off)
         self.off += 4 * n
@@ -84,17 +90,17 @@ class _Reader:
 
 
 def pack_wire_py(buf: bytes) -> SnapshotArrays:
-    """Parse a VCS1 buffer into SnapshotArrays (pure Python/numpy)."""
+    """Parse a VCS2 buffer into SnapshotArrays (pure Python/numpy)."""
     try:
         return _parse(buf)
     except (struct.error, IndexError) as e:
-        raise ValueError(f"truncated or corrupt VCS1 buffer: {e}") from None
+        raise ValueError(f"truncated or corrupt VCS2 buffer: {e}") from None
 
 
 def _parse(buf: bytes) -> SnapshotArrays:
     r = _Reader(buf)
     if r.u32() != MAGIC:
-        raise ValueError("bad magic (not a VCS1 buffer)")
+        raise ValueError("bad magic (not a VCS2 buffer)")
     R = r.u32()
     nq, ns, nn, nj, nt = (r.u32() for _ in range(5))
     if R == 0 or R > 1024:
@@ -133,6 +139,8 @@ def _parse(buf: bytes) -> SnapshotArrays:
         q_parent[i] = r.i32()
         q_depth[i] = r.i32()
         q_hier_weight[i] = r.f32()
+        r.skip_string()   # hierarchy annotation (decode_hierarchy reads it)
+        r.skip_string()   # hierarchy weights annotation
         q_valid[i] = True
 
     # --------------------------------------------------------- namespaces
@@ -347,3 +355,36 @@ def _parse(buf: bytes) -> SnapshotArrays:
         nodes=nodes, tasks=tasks, jobs=jobs, queues=queues,
         namespace_weight=ns_weight, cluster_capacity=cluster_capacity,
         template_rep=template_rep)
+
+
+def decode_hierarchy(buf: bytes, job_queue, job_valid):
+    """VCS2 buffer -> HierarchyArrays, parsing only the (early) header and
+    queue records. ``job_queue``/``job_valid`` come from the already-decoded
+    SnapshotArrays (the job section sits late in the buffer; its queue
+    indices are all the tree needs for job leaves)."""
+    from ..arrays.hierarchy import build_from_specs
+    r = _Reader(buf)
+    if r.u32() != MAGIC:
+        raise ValueError("bad magic (not a VCS2 buffer)")
+    R = r.u32()
+    nq = r.u32()
+    for _ in range(4):
+        r.u32()
+    for _ in range(R):
+        r.skip_string()
+    specs = []
+    for _ in range(nq):
+        r.skip_string()                  # name
+        r.f32()                          # weight
+        r.off += 4 * R                   # capability vector
+        r.off += 2                       # reclaimable, open
+        r.off += 8                       # parent, depth
+        r.f32()                          # leaf hier weight
+        hierarchy = r.string()
+        weights = r.string()
+        specs.append((hierarchy, weights))
+    Q = _bucket(max(nq, 1), 4)
+    specs += [("", "")] * (Q - len(specs))
+    jq = np.asarray(job_queue, np.int32)
+    jv = np.asarray(job_valid, bool)
+    return build_from_specs(specs, Q, jq, jv & (jq >= 0))

@@ -1,4 +1,4 @@
-"""VCS1 binary snapshot wire format — serializer side.
+"""VCS2 binary snapshot wire format — serializer side.
 
 The snapshot payload that crosses the API-layer boundary (SURVEY.md
 section 5.8: cluster state serialized to the scheduling sidecar, decisions
@@ -25,7 +25,7 @@ from ..arrays.pack import (_toleration_rows, _vec, queue_capability_row,
                            queue_parent_depth, resource_dims)
 from ..arrays.schema import IndexMaps
 
-MAGIC = 0x31534356  # "VCS1"
+MAGIC = 0x32534356  # "VCS2"
 
 _u32 = struct.Struct("<I").pack
 _i32 = struct.Struct("<i").pack
@@ -48,7 +48,7 @@ def _ivec(out: List[bytes], vals) -> None:
 
 
 def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
-    """ClusterInfo -> (VCS1 buffer, host-side decode maps)."""
+    """ClusterInfo -> (VCS2 buffer, host-side decode maps)."""
     dims = resource_dims(ci)
     R = len(dims)
     maps = IndexMaps(resource_names=dims)
@@ -87,6 +87,10 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         out.append(_i32(depths[i]))
         hw = q.hierarchy_weight_values()
         out.append(_f32(hw[-1] if hw else 1.0))
+        # full hdrf annotations (VCS2): the receiver rebuilds the exact
+        # hierarchy tree (arrays/hierarchy.build_from_specs) from these
+        _s(out, q.hierarchy)
+        _s(out, q.hierarchy_weights)
 
     for name in ns_names:
         _s(out, name)

@@ -32,9 +32,18 @@ class FakeCluster:
     # ----------------------------------------------------------- bind/evict
     def bind(self, intent: BindIntent) -> bool:
         """Apply a bind: task becomes Bound on the node (defaultBinder.Bind,
-        cache.go:123-143). Injectable failures exercise the resync path."""
-        if intent.task_uid in self.bind_failures:
-            return False
+        cache.go:123-143). Injectable failures exercise the resync path: a
+        string value fails every attempt, an int value fails that many
+        attempts then succeeds."""
+        fail = self.bind_failures.get(intent.task_uid)
+        if fail is not None:
+            if isinstance(fail, int):
+                if fail > 0:
+                    self.bind_failures[intent.task_uid] = fail - 1
+                    return False
+                del self.bind_failures[intent.task_uid]
+            else:
+                return False
         job = self.ci.jobs.get(intent.job_uid)
         node = self.ci.nodes.get(intent.node_name)
         if job is None or node is None:
@@ -85,6 +94,43 @@ class FakeCluster:
         job.update_task_status(task, TaskStatus.PENDING)
         self.evictions.append(intent.task_uid)
         return True
+
+    def hold_binding(self, intent: BindIntent) -> None:
+        """After a failed bind dispatch the cache keeps the task in Binding
+        holding its decided node (the session's UpdateTaskStatus persists
+        until syncTask resets it, cache.go:549-560 + 687-709), so later
+        cycles do not re-decide it while the retry queue works."""
+        job = self.ci.jobs.get(intent.job_uid)
+        node = self.ci.nodes.get(intent.node_name)
+        if job is None or node is None:
+            return
+        task = job.tasks.get(intent.task_uid)
+        if task is None or task.status != TaskStatus.PENDING:
+            return
+        job.update_task_status(task, TaskStatus.BINDING)
+        task.gpu_index = intent.gpu_index
+        try:
+            node.add_task(task)
+        except ValueError:
+            job.update_task_status(task, TaskStatus.PENDING)
+            task.gpu_index = -1
+
+    def resync_task(self, task_uid: str) -> None:
+        """Give-up resync: reset a Binding task to Pending off-node — the
+        syncTask refetch discovering the pod never scheduled
+        (cache.go:690-709)."""
+        for job in self.ci.jobs.values():
+            task = job.tasks.get(task_uid)
+            if task is None:
+                continue
+            if task.status == TaskStatus.BINDING:
+                node = self.ci.nodes.get(task.node_name)
+                if node is not None and task.uid in node.tasks:
+                    node.remove_task(task)
+                task.node_name = ""
+                task.gpu_index = -1
+                job.update_task_status(task, TaskStatus.PENDING)
+            return
 
     def update_podgroup_phases(self, phase_updates) -> None:
         for uid, phase in phase_updates.items():

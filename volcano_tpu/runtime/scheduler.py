@@ -19,6 +19,50 @@ from ..metrics import METRICS
 from .fake_cluster import FakeCluster
 
 
+class ResyncQueue:
+    """Rate-limited retry queue for failed bind/evict dispatches.
+
+    The errTasks workqueue analog (cache.go:687-709): per-item exponential
+    backoff (AddRateLimited's ItemExponentialFailureRateLimiter shape),
+    retries the SAME intent on later cycles without a fresh scheduling
+    decision, and after ``max_attempts`` gives up and resyncs the task back
+    to Pending (the syncTask refetch discovering the pod never bound)."""
+
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 10.0,
+                 max_attempts: int = 15):
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.max_attempts = max_attempts
+        self.entries: List[dict] = []
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def add(self, intent, kind: str, now: float, attempts: int = 1) -> None:
+        delay = min(self.base_delay * (2 ** (attempts - 1)), self.max_delay)
+        self.entries.append(dict(intent=intent, kind=kind, attempts=attempts,
+                                 next_try=now + delay))
+
+    def process(self, cluster, now: float) -> Dict[str, int]:
+        """Retry every due entry against the cluster. Returns counters."""
+        due = [e for e in self.entries if e["next_try"] <= now]
+        self.entries = [e for e in self.entries if e["next_try"] > now]
+        stats = dict(retried=0, succeeded=0, dropped=0)
+        for e in due:
+            stats["retried"] += 1
+            ok = (cluster.bind(e["intent"]) if e["kind"] == "bind"
+                  else cluster.evict(e["intent"]))
+            if ok:
+                stats["succeeded"] += 1
+            elif e["attempts"] >= self.max_attempts:
+                stats["dropped"] += 1
+                if e["kind"] == "bind":
+                    cluster.resync_task(e["intent"].task_uid)
+            else:
+                self.add(e["intent"], e["kind"], now, e["attempts"] + 1)
+        return stats
+
+
 class Scheduler:
     def __init__(self, cluster: FakeCluster,
                  conf: Optional[SchedulerConfiguration] = None,
@@ -31,6 +75,7 @@ class Scheduler:
         self.schedule_period = schedule_period
         self._plugin_state: Dict[str, object] = {}
         self.cycles = 0
+        self.resync = ResyncQueue()
 
     def _load_conf(self) -> Optional[SchedulerConfiguration]:
         """Conf hot-reload (fsnotify watcher, scheduler.go:146-171 — here a
@@ -61,6 +106,15 @@ class Scheduler:
         if reloaded is not None:
             self.conf = reloaded
         t0 = time.time()
+        wall = now if now is not None else t0
+        # drain due resync retries BEFORE snapshotting so the cycle sees
+        # their outcomes (the errTasks worker runs alongside the loop,
+        # cache.go:687-709)
+        if len(self.resync):
+            rs = self.resync.process(self.cluster, wall)
+            METRICS.inc("resync_retried", rs["retried"])
+            METRICS.inc("resync_succeeded", rs["succeeded"])
+            METRICS.inc("resync_dropped", rs["dropped"])
         ssn = Session(self.cluster.snapshot(), self.conf, now=now,
                       plugin_overrides=self._persistent_plugins())
         from ..actions import get_action
@@ -75,11 +129,16 @@ class Scheduler:
         self.cluster.update_podgroup_phases(ssn.phase_updates)
 
         for intent in ssn.evictions:
-            self.cluster.evict(intent)
-        for intent in ssn.binds:
-            ok = self.cluster.bind(intent)
-            if not ok:
+            if not self.cluster.evict(intent):
                 METRICS.inc("resync_tasks")
+                self.resync.add(intent, "evict", wall)
+        for intent in ssn.binds:
+            if not self.cluster.bind(intent):
+                METRICS.inc("resync_tasks")
+                # hold the Binding state so later cycles don't re-decide
+                # while the rate-limited retry works (cache.go:549-560)
+                self.cluster.hold_binding(intent)
+                self.resync.add(intent, "bind", wall)
         METRICS.observe_cycle(time.time() - t0)
         METRICS.inc("schedule_attempts")
         self.cycles += 1

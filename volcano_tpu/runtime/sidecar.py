@@ -3,14 +3,14 @@
 SURVEY.md section 5.8's distributed backbone for the north star: the
 API-layer process (the Go-equivalent control plane) serializes its cluster
 snapshot to this sidecar over the host network; the sidecar packs it with the
-native C++ packer (native/packer.cc, VCS1 wire format), runs the compiled
+native C++ packer (native/packer.cc, VCS2 wire format), runs the compiled
 TPU cycle, and streams the decision arrays back on the same connection. The
 reference needs no such component because its scheduler computes in-process
 (pkg/scheduler/scheduler.go:91 runOnce); here the compute lives on the TPU
 host, so the cycle boundary is a wire protocol.
 
 Framing (little-endian):
-    request:  u32 len | VCS1 snapshot buffer (native/wire.py serialize)
+    request:  u32 len | VCS2 snapshot buffer (native/wire.py serialize)
     response: u32 status (0 ok) | u32 len | payload
         ok payload: u32 magic 'VCD1' | u32 T | u32 J |
                     i32[T] task_node | i32[T] task_mode | i32[T] task_gpu |
@@ -67,11 +67,12 @@ class SchedulerSidecar:
                 "pass either cfg (bare allocate cycle) or conf (full "
                 "compiled session policy), not both — conf carries its own "
                 "action configuration")
+        self._conf_mode = conf is not None
         if conf is not None:
             from ..framework.compiled_session import make_conf_cycle
             cycle2 = make_conf_cycle(conf)
             self._fn = jax.jit(
-                lambda s, e: cycle2(s).packed_decisions())
+                lambda s, h: cycle2(s, h).packed_decisions())
         else:
             from ..ops.allocate_scan import make_allocate_cycle
             self.cfg = cfg or AllocateConfig(binpack_weight=1.0)
@@ -79,7 +80,7 @@ class SchedulerSidecar:
             self._fn = jax.jit(lambda s, e: cycle(s, e).packed_decisions())
 
     def schedule_buffer(self, buf: bytes) -> bytes:
-        """VCS1 snapshot buffer -> VCD1 decision payload."""
+        """VCS2 snapshot buffer -> VCD1 decision payload."""
         from ..native import available, pack_wire
         if available():
             snap = pack_wire(buf)
@@ -88,8 +89,15 @@ class SchedulerSidecar:
             snap = pack_wire_py(buf)
         T = int(np.asarray(snap.tasks.status).shape[0])
         J = int(np.asarray(snap.jobs.min_available).shape[0])
-        extras = AllocateExtras.neutral(snap)
-        packed = np.asarray(self._fn(snap, extras), dtype=np.int32)
+        if self._conf_mode:
+            # hdrf tree from the wire's queue annotations (tiny, early in
+            # the buffer) — jobs attach via the decoded queue indices
+            from ..native.pywire import decode_hierarchy
+            second = decode_hierarchy(buf, np.asarray(snap.jobs.queue),
+                                      np.asarray(snap.jobs.valid))
+        else:
+            second = AllocateExtras.neutral(snap)
+        packed = np.asarray(self._fn(snap, second), dtype=np.int32)
         task_node = packed[:T]
         task_mode = packed[T:2 * T]
         task_gpu = packed[2 * T:3 * T]
