@@ -156,3 +156,134 @@ tiers:
         assert binds["default/b0"] in ("n0", "n1")
         placed_small = [k for k in binds if k.startswith("default/s")]
         assert len(placed_small) <= 1
+
+
+class TestTDMFidelity:
+    """tdm_test.go case families: inactive-window total block, node-order
+    bonus, budget-capped victim batching, evict-period rate limiting."""
+
+    def _cluster(self):
+        ci = simple_cluster(n_nodes=1, node_cpu="4")
+        revocable = build_node("rev0", cpu="4", memory="8Gi",
+                               labels={REVOCABLE_ZONE_LABEL: "z1"})
+        ci.add_node(revocable)
+        return ci
+
+    def test_inactive_window_blocks_even_preemptable(self):
+        """Outside the window a revocable node admits NOTHING new —
+        including preemptable tasks (tdm.go:149-156 predicate error)."""
+        ci = self._cluster()
+        filler = build_job("default/filler", min_available=1)
+        filler.add_task(build_task("f0", cpu="4"))
+        ci.add_job(filler)
+        job = build_job("default/cheap", min_available=1, preemptable=True)
+        job.add_task(build_task("c0", cpu="1", preemptable=True))
+        ci.add_job(job)
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(tdm_conf(window(120, 180))))
+        sched.run_once()
+        binds = dict(sched.cluster.binds)
+        assert "default/c0" not in binds   # rev0 closed, n0 full
+
+    def test_active_window_bonus_steers_revocable_task(self):
+        """A revocable task lands on the active revocable node even when a
+        plain node has room (MaxNodeScore bonus, tdm.go:170-191)."""
+        ci = self._cluster()
+        job = build_job("default/cheap", min_available=1, preemptable=True)
+        job.add_task(build_task("c0", cpu="1", preemptable=True))
+        ci.add_job(job)
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(tdm_conf(window(-60, 60))))
+        sched.run_once()
+        binds = dict(sched.cluster.binds)
+        assert binds["default/c0"] == "rev0"
+
+    def _sweep_cluster(self, n_tasks=4, **job_kw):
+        ci = self._cluster()
+        job = build_job("default/cheap", min_available=1, preemptable=True,
+                        **job_kw)
+        for i in range(n_tasks):
+            t = build_task(f"c{i}", cpu="1", preemptable=True,
+                           status=TaskStatus.RUNNING)
+            job.add_task(t)
+            ci.nodes["rev0"].add_task(t)
+        ci.add_job(job)
+        return ci
+
+    def _sweep_conf(self, win, extra_args=""):
+        return f"""
+actions: "enqueue, allocate, backfill, preempt"
+tiers:
+- plugins:
+  - name: gang
+  - name: tdm
+    arguments:
+      tdm.revocable-zone.z1: "{win}"{extra_args}
+"""
+
+    def test_sweep_caps_victims_at_default_budget(self):
+        """Without a budget annotation at most defaultPodEvictNum=1 task
+        per job is swept per run (tdm.go:330-340)."""
+        ci = self._sweep_cluster(n_tasks=4)
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(self._sweep_conf(window(120, 180))))
+        sched.run_once()
+        assert len(sched.cluster.evictions) == 1
+
+    def test_sweep_respects_max_unavailable_budget(self):
+        """volcano.sh/max-unavailable bounds the batch (tdm.go:318-330)."""
+        ci = self._sweep_cluster(n_tasks=4, budget_max_unavailable="50%")
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(self._sweep_conf(window(120, 180))))
+        sched.run_once()
+        assert len(sched.cluster.evictions) == 2   # ceil(50% of 4)
+
+    def test_sweep_respects_min_available_budget(self):
+        """volcano.sh/min-available keeps that many running (tdm.go:331-336)."""
+        ci = self._sweep_cluster(n_tasks=4, budget_min_available="3")
+        sched = Scheduler(FakeCluster(ci),
+                          conf=parse_conf(self._sweep_conf(window(120, 180))))
+        sched.run_once()
+        assert len(sched.cluster.evictions) == 1   # 4 running - 3 min
+
+    def test_sweep_rate_limited_by_evict_period(self):
+        """The sweep fires at most once per tdm.evict-period
+        (tdm.go:233-236); the next period releases another batch."""
+        ci = self._sweep_cluster(n_tasks=4)
+        conf = self._sweep_conf(
+            window(120, 180), '\n      tdm.evict-period: "1m"')
+        sched = Scheduler(FakeCluster(ci), conf=parse_conf(conf))
+        t0 = time.time()
+        sched.run_once(now=t0)
+        assert len(sched.cluster.evictions) == 1
+        sched.run_once(now=t0 + 10)     # within the period: no new sweep
+        assert len(sched.cluster.evictions) == 1
+        sched.run_once(now=t0 + 61)     # period elapsed: next batch
+        assert len(sched.cluster.evictions) == 2
+
+    def test_preemptable_job_never_preempts(self):
+        """tdm JobStarvingFn: a preemptable job cannot be a preemptor
+        (tdm.go:292-298)."""
+        ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="2Gi")
+        lo = build_job("default/lo", min_available=1, priority=1)
+        t = build_task("lo-0", cpu="1", memory="1Gi",
+                       status=TaskStatus.RUNNING)
+        lo.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(lo)
+        hi = build_job("default/hi", min_available=1, priority=10,
+                       preemptable=True)
+        hi.add_task(build_task("hi-0", cpu="1", memory="1Gi",
+                               preemptable=True))
+        ci.add_job(hi)
+        conf = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: tdm
+"""
+        sched = Scheduler(FakeCluster(ci), conf=parse_conf(conf))
+        sched.run_once()
+        assert sched.cluster.evictions == []

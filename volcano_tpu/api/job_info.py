@@ -136,6 +136,11 @@ class TaskInfo:
         if not self.init_resreq.quantities:
             self.init_resreq = self.resreq.clone()
         self.best_effort = self.resreq.is_empty()
+        # a preemptable pod may use every revocable zone unless it pins one
+        # (GetPodRevocableZone: preemptable=true -> "*", job_info.go:340-358;
+        # only ""/"*" are supported values in this fork)
+        if not self.revocable_zone and self.preemptable:
+            self.revocable_zone = "*"
 
     @property
     def key(self) -> str:
@@ -201,7 +206,9 @@ class JobInfo:
                  min_resources: Optional[Resource] = None,
                  creation_timestamp: float = 0.0,
                  pod_group_phase: PodGroupPhase = PodGroupPhase.PENDING,
-                 preemptable: bool = False):
+                 preemptable: bool = False,
+                 budget_min_available: str = "",
+                 budget_max_unavailable: str = ""):
         self.uid = uid
         self.name = name or uid.split("/")[-1]
         self.namespace = namespace
@@ -213,6 +220,10 @@ class JobInfo:
         self.creation_timestamp = creation_timestamp or time.time()
         self.pod_group_phase = pod_group_phase
         self.preemptable = preemptable
+        # DisruptionBudget from the PodGroup's JDB annotations (int or
+        # percentage strings; job_info.go:38-52 + extractBudget :361-372)
+        self.budget_min_available = budget_min_available
+        self.budget_max_unavailable = budget_max_unavailable
 
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
@@ -338,7 +349,8 @@ class JobInfo:
         j = JobInfo(self.uid, self.name, self.namespace, self.queue,
                     self.priority, self.min_available, self.task_min_available,
                     self.min_resources.clone(), self.creation_timestamp,
-                    self.pod_group_phase, self.preemptable)
+                    self.pod_group_phase, self.preemptable,
+                    self.budget_min_available, self.budget_max_unavailable)
         for task in self.tasks.values():
             j.add_task(task.clone())
         return j

@@ -220,6 +220,7 @@ class Session:
         if enable_aff and "pod_affinity_weight" not in provided:
             weights["pod_affinity_weight"] = 1.0
         drf = self.plugin("drf")
+        tdm = self.plugin("tdm")
         return AllocateConfig(enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,
                               enable_hdrf=(drf is not None
@@ -228,6 +229,8 @@ class Session:
                                              and drf.option.enabled_job_order),
                               drf_ns_order=(drf is not None
                                             and drf.option.enabled_namespace_order),
+                              tdm_job_order=(tdm is not None
+                                             and tdm.option.enabled_job_order),
                               **weights)
 
     def allocate_extras(self) -> AllocateExtras:
@@ -244,8 +247,13 @@ class Session:
             ns = p.namespace_share(self)
             if ns is not None:
                 extras.ns_share = np.asarray(ns, np.float32)
-            if hasattr(p, "block_nonpreempt"):
-                extras.block_nonpreempt = np.asarray(p.block_nonpreempt(self))
+            if hasattr(p, "block_nonrevocable"):
+                extras.block_nonrevocable = np.asarray(
+                    p.block_nonrevocable(self))
+                extras.block_all = np.asarray(p.block_all_mask(self))
+                extras.task_revocable = np.asarray(
+                    p.task_revocable_mask(self))
+                extras.tdm_bonus = np.asarray(p.tdm_bonus_mask(self))
             if hasattr(p, "revocable_node_mask"):
                 extras.revocable_node = np.asarray(p.revocable_node_mask(self))
             if hasattr(p, "task_pref_node"):

@@ -84,6 +84,8 @@ class AllocateConfig:
     #: extras snapshot.
     drf_job_order: bool = False
     drf_ns_order: bool = False
+    #: tdm JobOrderFn: non-preemptable jobs schedule first (tdm.go:261-273)
+    tdm_job_order: bool = False
     max_rounds: Optional[int] = None     # cap on outer job iterations
     #: Fused pallas round placer (ops/pallas_place.py): None = auto (TPU
     #: backend, lane-aligned N, fits VMEM), True/False = force,
@@ -104,7 +106,17 @@ class AllocateExtras:
     queue_deserved: jax.Array   # f32[Q,R] proportion deserved (proportion.go:140-197)
     ns_share: jax.Array         # f32[S] drf namespace fairness (drf.go:474-507)
     queue_share_extra: jax.Array  # f32[Q] hdrf hierarchical key (drf.go:363-374)
-    block_nonpreempt: jax.Array   # bool[N] tdm revocable-zone gate (tdm.go:295)
+    #: tdm predicate gates (tdm.go:149-167): an ACTIVE-window revocable node
+    #: admits only tasks that may use revocable zones; an INACTIVE-window
+    #: revocable node admits nothing new at all.
+    block_nonrevocable: jax.Array  # bool[N] active-window revocable nodes
+    block_all: jax.Array           # bool[N] inactive-window revocable nodes
+    task_revocable: jax.Array      # bool[T] task may use revocable nodes
+    #                                (volcano.sh/revocable-zone "*",
+    #                                job_info.go:88-92)
+    tdm_bonus: jax.Array           # f32[N] active-window node-order bonus for
+    #                                revocable tasks (MaxNodeScore,
+    #                                tdm.go:170-191)
     revocable_node: jax.Array     # bool[N] node carries a revocable zone at
     #                               all (window-independent; the tdm victim
     #                               rule's node filter, tdm.go:210-214)
@@ -130,7 +142,10 @@ class AllocateExtras:
             queue_deserved=np.full((Q, R), np.inf, np.float32),
             ns_share=np.zeros(S, np.float32),
             queue_share_extra=np.zeros(Q, np.float32),
-            block_nonpreempt=np.zeros(N, bool),
+            block_nonrevocable=np.zeros(N, bool),
+            block_all=np.zeros(N, bool),
+            task_revocable=np.zeros(T, bool),
+            tdm_bonus=np.zeros(N, np.float32),
             revocable_node=np.zeros(N, bool),
             task_pref_node=np.full(T, -1, np.int32),
             node_locked=np.zeros(N, bool),
@@ -485,6 +500,11 @@ def make_allocate_cycle(cfg: AllocateConfig):
             keys += [
                 job_q.astype(jnp.float32),           # queue tie-break
                 -jobs.priority.astype(jnp.float32),  # priority plugin JobOrderFn
+            ]
+            if cfg.tdm_job_order:
+                # tdm JobOrderFn: preemptable jobs sort later (tdm.go:261-273)
+                keys.append(jobs.preemptable.astype(jnp.float32))
+            keys += [
                 ready_now.astype(jnp.float32),       # gang: ready jobs last
                 job_share_k,                         # drf JobOrderFn
                 jobs.creation_rank.astype(jnp.float32),  # FIFO fallback
@@ -509,12 +529,15 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 (ops/pallas_place.py) instead of the M-step scan."""
                 tcl = jnp.maximum(task_ids, 0)
                 tmpl_ids = tasks.template[tcl]
-                node_ok = (~(extras.block_nonpreempt[None, :]
-                             & ~tasks.preemptable[tcl][:, None])
+                node_ok = (~(extras.block_nonrevocable[None, :]
+                             & ~extras.task_revocable[tcl][:, None])
+                           & ~extras.block_all[None, :]
                            & (~extras.node_locked
                               | (ji == extras.target_job))[None, :])
                 sfeas = (tmpl_static[tmpl_ids] & node_ok).astype(jnp.float32)
-                sscore = tp_static[tmpl_ids]
+                sscore = (tp_static[tmpl_ids]
+                          + extras.task_revocable[tcl][:, None]
+                          * extras.tdm_bonus[None, :])
                 resreq_t = tasks.resreq[tcl].T
                 gpu_req_row = tasks.gpu_request[tcl][None, :]
                 active_row = nb_row[None, :].astype(jnp.int32)
@@ -580,10 +603,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
                 future = jnp.maximum(
                     idle + nodes.releasing - nodes.pipelined - pipe_extra, 0.0)
-                # tdm: during an active revocable window, revocable nodes only
-                # admit preemptable tasks (tdm.go:295); reservation: locked
-                # nodes only admit the elected target job (reserve.go:43-77).
-                node_ok = (~(extras.block_nonpreempt & ~tasks.preemptable[t])
+                # tdm: active-window revocable nodes only admit tasks with a
+                # revocable zone; inactive-window revocable nodes admit
+                # nothing new (tdm.go:149-167); reservation: locked nodes
+                # only admit the elected target job (reserve.go:43-77).
+                node_ok = (~(extras.block_nonrevocable
+                             & ~extras.task_revocable[t])
+                           & ~extras.block_all
                            & (~extras.node_locked | (ji == extras.target_job))
                            & tmpl_static[tasks.template[t]])
                 # shared (capacity-view-independent) terms computed once, the
@@ -599,6 +625,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 # task-topology bucket preference (topology.go:344)
                 score += S.node_preference_score(extras.task_pref_node[t],
                                                  score.shape[0])
+                # tdm steers revocable tasks onto active-window revocable
+                # nodes (MaxNodeScore bonus, tdm.go:170-191)
+                score += jnp.where(extras.task_revocable[t],
+                                   extras.tdm_bonus, 0.0)
                 if cfg.enable_pod_affinity:
                     aff_feas, aff_score = _affinity_terms(
                         extras.affinity, aff_cnt, anti_cnt, t,

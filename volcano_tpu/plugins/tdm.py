@@ -1,11 +1,22 @@
 """TDM (time-division multiplexing) plugin: revocable-zone scheduling windows.
 
-Reference: pkg/scheduler/plugins/tdm/tdm.go:58-372 — nodes annotated with a
-revocable zone only admit preemptable tasks while the zone's configured
-daily window (``tdm.revocable-zone.<zone>: "hh:mm-hh:mm"``) is active; a
-score bonus steers preemptable tasks there during the window; outside the
-window, preemptable tasks on revocable nodes become victims (evicted in
-max-step batches by the victimsFn, tdm.go:298).
+Reference: pkg/scheduler/plugins/tdm/tdm.go:58-372 — nodes labeled with a
+revocable zone follow a configured daily window
+(``tdm.revocable-zone.<zone>: "hh:mm-hh:mm"``):
+
+- predicate (tdm.go:149-167): during the window a revocable node admits
+  ONLY tasks that may use revocable zones (``volcano.sh/revocable-zone``
+  "*", job_info.go:88-92); outside the window it admits nothing new,
+- node order (tdm.go:170-191): MaxNodeScore bonus steering revocable tasks
+  onto active-window revocable nodes,
+- preemptable (tdm.go:193-229): kernel victim rule — preemptable Running
+  tasks on NON-revocable nodes, with preemptable preemptors abstaining,
+- victimsFn (tdm.go:232-260): periodic sweep evicting preemptable tasks
+  from revocable nodes whose window closed, batched per job by the
+  disruption budget (maxVictims, tdm.go:312-340), at most once per
+  ``tdm.evict-period`` (default 1m),
+- job order / pipelined / starving (tdm.go:261-298): non-preemptable jobs
+  first; preemptable jobs never preempt.
 """
 
 from __future__ import annotations
@@ -19,6 +30,9 @@ from .base import Plugin
 
 REVOCABLE_ZONE_LABEL = "volcano.sh/revocable-zone"
 
+#: victimsFn fallback cap when no budget annotation is set (tdm.go:42)
+DEFAULT_POD_EVICT_NUM = 1
+
 
 def _parse_window(spec: str) -> Tuple[int, int]:
     start, end = spec.strip().split("-")
@@ -27,8 +41,36 @@ def _parse_window(spec: str) -> Tuple[int, int]:
     return h1 * 60 + m1, h2 * 60 + m2
 
 
+def _parse_duration(spec: str) -> float:
+    """'1m' / '30s' / '2h' -> seconds (time.ParseDuration subset)."""
+    spec = str(spec).strip()
+    units = {"s": 1.0, "m": 60.0, "h": 3600.0}
+    if spec and spec[-1] in units:
+        return float(spec[:-1]) * units[spec[-1]]
+    return float(spec)
+
+
+def _parse_int_or_percent(value: str, total: int) -> int:
+    """intstr.GetValueFromIntOrPercent with round-up (tdm.go:343-358)."""
+    s = str(value).strip()
+    if s.endswith("%"):
+        pct = float(s[:-1])
+        return int(-(-pct * total // 100))      # ceil
+    try:
+        return int(s)
+    except ValueError:
+        return 0
+
+
 class TDMPlugin(Plugin):
     name = "tdm"
+
+    def __init__(self, option):
+        super().__init__(option)
+        self.evict_period = _parse_duration(
+            option.arguments.get("tdm.evict-period", "1m"))
+        self._last_evict_at = float("-inf")   # persists across cycles when
+        #                                       the plugin instance does
 
     def _zones(self) -> Dict[str, Tuple[int, int]]:
         zones = {}
@@ -50,41 +92,96 @@ class TDMPlugin(Plugin):
         node = ssn.cluster.nodes.get(name)
         return (node.labels.get(REVOCABLE_ZONE_LABEL, "") if node else "")
 
+    def _node_masks(self, ssn):
+        """(revocable bool[N], active bool[N]) per packed node."""
+        N = np.asarray(ssn.snap.nodes.pod_count).shape[0]
+        revocable = np.zeros(N, bool)
+        active = np.zeros(N, bool)
+        for name, ni in ssn.maps.node_index.items():
+            zone = self.node_zone(ssn, name)
+            if zone:
+                revocable[ni] = True
+                active[ni] = self._zone_active(zone, ssn.now)
+        return revocable, active
+
     def revocable_node_mask(self, ssn) -> np.ndarray:
         """bool[N]: node carries a revocable zone (window-independent) —
         the tdm victim rule's node filter (tdm.go:210-214)."""
-        N = np.asarray(ssn.snap.nodes.pod_count).shape[0]
-        mask = np.zeros(N, bool)
-        for name, ni in ssn.maps.node_index.items():
-            if self.node_zone(ssn, name):
-                mask[ni] = True
+        return self._node_masks(ssn)[0]
+
+    def block_nonrevocable(self, ssn) -> np.ndarray:
+        """bool[N]: ACTIVE-window revocable nodes — admit only tasks with a
+        revocable zone (tdm.go:158-165)."""
+        revocable, active = self._node_masks(ssn)
+        return revocable & active
+
+    def block_all_mask(self, ssn) -> np.ndarray:
+        """bool[N]: INACTIVE-window revocable nodes — admit nothing new
+        (tdm.go:152-156 predicate error for every task)."""
+        revocable, active = self._node_masks(ssn)
+        return revocable & ~active
+
+    def task_revocable_mask(self, ssn) -> np.ndarray:
+        """bool[T]: tasks allowed onto revocable nodes (revocable_zone
+        '*', job_info.go:88-92)."""
+        T = np.asarray(ssn.snap.tasks.status).shape[0]
+        mask = np.zeros(T, bool)
+        for job in ssn.cluster.jobs.values():
+            for uid, task in job.tasks.items():
+                ti = ssn.maps.task_index.get(uid)
+                if ti is not None and task.revocable_zone:
+                    mask[ti] = True
         return mask
 
-    def block_nonpreempt(self, ssn) -> np.ndarray:
-        """bool[N]: revocable nodes (active window) admit only preemptable
-        tasks; outside the window they admit nothing new (tdm.go:295)."""
-        N = np.asarray(ssn.snap.nodes.pod_count).shape[0]
-        block = np.zeros(N, bool)
-        for name, ni in ssn.maps.node_index.items():
-            if self.node_zone(ssn, name):
-                block[ni] = True
-        return block
+    def tdm_bonus_mask(self, ssn) -> np.ndarray:
+        """f32[N]: MaxNodeScore on active-window revocable nodes — the
+        nodeOrderFn bonus for revocable tasks (tdm.go:170-191)."""
+        revocable, active = self._node_masks(ssn)
+        return np.where(revocable & active, 100.0, 0.0).astype(np.float32)
+
+    def _max_evict(self, job) -> int:
+        """Per-job victim cap from the disruption budget
+        (getMaxPodEvictNum, tdm.go:312-340)."""
+        from ..api import TaskStatus
+        tasks = list(job.tasks.values())
+        n = len(tasks)
+        running = sum(1 for t in tasks if t.status == TaskStatus.RUNNING)
+        if job.budget_max_unavailable:
+            max_unavail = _parse_int_or_percent(job.budget_max_unavailable, n)
+            final = sum(1 for t in tasks
+                        if t.status in (TaskStatus.SUCCEEDED,
+                                        TaskStatus.FAILED))
+            real_unavail = n - final - running
+            if real_unavail >= max_unavail:
+                return 0
+            return max_unavail - real_unavail
+        if job.budget_min_available:
+            min_avail = _parse_int_or_percent(job.budget_min_available, n)
+            return max(running - min_avail, 0)
+        return DEFAULT_POD_EVICT_NUM
 
     def victim_tasks(self, ssn) -> np.ndarray:
-        """bool[T]: preemptable tasks sitting on revocable nodes whose window
-        is closed — the periodic eviction sweep (tdm.go:298-340)."""
+        """bool[T]: preemptable tasks on closed-window revocable nodes —
+        the periodic sweep (tdm.go:232-260), per-job maxVictims batching
+        (tdm.go:312-318), rate-limited to one run per evict period
+        (tdm.go:233-236)."""
         T = np.asarray(ssn.snap.tasks.status).shape[0]
         victims = np.zeros(T, bool)
-        preemptable = np.asarray(ssn.snap.tasks.preemptable)
-        for uid, ti in ssn.maps.task_index.items():
-            task = None
-            for job in ssn.cluster.jobs.values():
-                task = job.tasks.get(uid)
-                if task is not None:
-                    break
-            if task is None or not task.node_name:
-                continue
-            zone = self.node_zone(ssn, task.node_name)
-            if zone and preemptable[ti] and not self._zone_active(zone, ssn.now):
-                victims[ti] = True
+        if ssn.now - self._last_evict_at < self.evict_period:
+            return victims
+        self._last_evict_at = ssn.now
+        per_job: Dict[str, list] = {}
+        for job in ssn.cluster.jobs.values():
+            for uid, task in job.tasks.items():
+                if not task.preemptable or not task.node_name:
+                    continue
+                zone = self.node_zone(ssn, task.node_name)
+                if zone and not self._zone_active(zone, ssn.now):
+                    per_job.setdefault(job.uid, []).append(uid)
+        for juid, uids in per_job.items():
+            cap = self._max_evict(ssn.cluster.jobs[juid])
+            for uid in sorted(uids)[:cap]:
+                ti = ssn.maps.task_index.get(uid)
+                if ti is not None:
+                    victims[ti] = True
         return victims

@@ -271,7 +271,10 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     queue_deserved = np.asarray(extras.queue_deserved)
     ns_share = np.asarray(extras.ns_share)
     queue_share_extra = np.asarray(extras.queue_share_extra)
-    block_nonpreempt = np.asarray(extras.block_nonpreempt)
+    block_nonrevocable = np.asarray(extras.block_nonrevocable)
+    block_all = np.asarray(extras.block_all)
+    task_revocable = np.asarray(extras.task_revocable)
+    tdm_bonus = np.asarray(extras.tdm_bonus)
     task_pref_node = np.asarray(extras.task_pref_node)
     node_locked = np.asarray(extras.node_locked)
     target_job = int(extras.target_job)
@@ -370,8 +373,10 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             hcols = _hdrf_keys(extras.hierarchy, job_alloc_dyn, jreq32,
                                jvalid_all, total_cap)
             key_rows += [hcols[jqueue, c] for c in range(hcols.shape[1])]
-        key_rows += [jqueue.astype(float), -jprio.astype(float),
-                     ready_now.astype(float), job_share_k,
+        key_rows += [jqueue.astype(float), -jprio.astype(float)]
+        if cfg.tdm_job_order:
+            key_rows.append(np.array(jobs.preemptable).astype(float))
+        key_rows += [ready_now.astype(float), job_share_k,
                      jrank.astype(float)]
         keys = np.stack(key_rows)
         best_ji, best_key = -1, None
@@ -406,7 +411,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             tm = t_tol_mode[t]
             req = resreq[t]
             greq = t_gpu_req[t]
-            node_ok = (~(block_nonpreempt & ~t_preemptable[t])
+            node_ok = (~(block_nonrevocable & ~task_revocable[t])
+                       & ~block_all
                        & (~node_locked | (ji == target_job)))
             feas_now = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm,
                                                idle, pods_extra,
@@ -414,6 +420,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             score = _score_one(cfg, nodes_np, req, idle, th, te, tm)
             if task_pref_node[t] >= 0:
                 score = score + 100.0 * (np.arange(len(score)) == task_pref_node[t])
+            if task_revocable[t]:
+                score = score + tdm_bonus
             if aff_st is not None:
                 aff_feas, aff_score = _affinity_one(aff_st, t, valid_sched)
                 feas_now &= aff_feas

@@ -90,14 +90,18 @@ class Scheduler:
             return parse_conf(f.read())
 
     def _persistent_plugins(self) -> Dict[str, object]:
-        """Plugins with cross-cycle state (the reservation singleton)."""
+        """Plugins with cross-cycle state: the reservation singleton and
+        tdm's lastEvictAt rate limiter (tdm.go:232-236)."""
         from ..plugins.reservation import ReservationPlugin
+        from ..plugins.tdm import TDMPlugin
         overrides = {}
-        if self.conf.plugin_option("reservation") is not None:
-            if "reservation" not in self._plugin_state:
-                self._plugin_state["reservation"] = ReservationPlugin(
-                    self.conf.plugin_option("reservation"))
-            overrides["reservation"] = self._plugin_state["reservation"]
+        for name, cls in (("reservation", ReservationPlugin),
+                          ("tdm", TDMPlugin)):
+            if self.conf.plugin_option(name) is not None:
+                if name not in self._plugin_state:
+                    self._plugin_state[name] = cls(
+                        self.conf.plugin_option(name))
+                overrides[name] = self._plugin_state[name]
         return overrides
 
     def run_once(self, now: Optional[float] = None) -> Session:
