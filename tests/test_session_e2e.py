@@ -434,3 +434,151 @@ class TestResyncRetry:
         # second attempt backs off exponentially (2s, not 1s)
         assert q.process(c, now=2.0)["retried"] == 0
         assert q.process(c, now=3.5)["retried"] == 1
+
+
+class TestConformanceMatrix:
+    """conformance.go:45-63 evictableFn skip rules: kube-system namespace,
+    system-cluster-critical, system-node-critical are never victims."""
+
+    def _run(self, namespace="default", priority_class=""):
+        ci = ClusterInfo()
+        ci.add_node(build_node("n0", cpu="1", memory="2Gi"))
+        ci.add_queue(QueueInfo("default", weight=1))
+        lo = build_job(f"{namespace}/lo", min_available=1, priority=1,
+                       namespace=namespace)
+        t = build_task("lo-0", cpu="1", memory="1Gi", namespace=namespace,
+                       status=TaskStatus.RUNNING)
+        t.priority_class = priority_class
+        lo.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(lo)
+        hi = build_job("default/hi", min_available=1, priority=10)
+        hi.add_task(build_task("hi-0", cpu="1", memory="1Gi"))
+        ci.add_job(hi)
+        conf = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+"""
+        sched = make_scheduler(ci, conf)
+        sched.run_once()
+        return sched.cluster.evictions
+
+    def test_normal_pod_evictable(self):
+        assert len(self._run()) == 1
+
+    def test_kube_system_protected(self):
+        assert self._run(namespace="kube-system") == []
+
+    def test_cluster_critical_protected(self):
+        assert self._run(priority_class="system-cluster-critical") == []
+
+    def test_node_critical_protected(self):
+        assert self._run(priority_class="system-node-critical") == []
+
+    def test_other_priority_class_evictable(self):
+        assert len(self._run(priority_class="high-priority")) == 1
+
+
+class TestSLAMatrix:
+    """sla.go behavior matrix: per-job annotation overrides the global
+    argument (readJobWaitingTime :57-66), the enqueue gate permits overdue
+    jobs (:133-145), and job order runs earliest-deadline-first
+    (:104-131)."""
+
+    def _conf(self, global_jwt=None):
+        args = (f"\n    arguments:\n      sla-waiting-time: {global_jwt}"
+                if global_jwt else "")
+        return f"""
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: sla{args}
+  - name: proportion
+"""
+
+    def test_job_annotation_overrides_global(self):
+        """Global SLA 1h would not admit yet, but the job's own 10s
+        annotation does."""
+        from volcano_tpu.api import PodGroupPhase
+        now = 1_000_000.0
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        q = ci.queues["default"]
+        q.capability = res(cpu="1")
+        # the queue is full, so only an SLA override admits the job
+        running = build_job("default/holder", min_available=1)
+        t = build_task("h-0", cpu="1", memory=0, status=TaskStatus.RUNNING)
+        running.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(running)
+        j = build_job("default/slow", min_available=1,
+                      pod_group_phase=PodGroupPhase.PENDING,
+                      min_resources=res(cpu="1"),
+                      creation_timestamp=now - 60)
+        j.add_task(build_task("s-0", cpu="1", memory=0))
+        j.sla_waiting_time = "10s"
+        ci.add_job(j)
+        sched = make_scheduler(ci, self._conf(global_jwt="1h"))
+        ssn = sched.run_once(now=now)
+        assert ssn.stats.get("enqueued") == 1
+
+    def test_global_only_not_yet_due(self):
+        from volcano_tpu.api import PodGroupPhase
+        now = 1_000_000.0
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        ci.queues["default"].capability = res(cpu="1")
+        running = build_job("default/holder", min_available=1)
+        t = build_task("h-0", cpu="1", memory=0, status=TaskStatus.RUNNING)
+        running.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(running)
+        j = build_job("default/slow", min_available=1,
+                      pod_group_phase=PodGroupPhase.PENDING,
+                      min_resources=res(cpu="1"),
+                      creation_timestamp=now - 60)
+        j.add_task(build_task("s-0", cpu="1", memory=0))
+        ci.add_job(j)
+        sched = make_scheduler(ci, self._conf(global_jwt="1h"))
+        ssn = sched.run_once(now=now)
+        assert ssn.stats.get("enqueued") == 0
+
+    def test_deadline_orders_jobs(self):
+        """Two jobs, one slot: the one with the EARLIER creation+jwt
+        deadline places first even though the other is older."""
+        now = 1_000_000.0
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        old = build_job("default/old", min_available=1,
+                        creation_timestamp=now - 100)
+        old.add_task(build_task("o-0", cpu="1", memory=0))
+        old.sla_waiting_time = "1h"        # deadline now+3500
+        ci.add_job(old)
+        urgent = build_job("default/urgent", min_available=1,
+                           creation_timestamp=now - 10)
+        urgent.add_task(build_task("u-0", cpu="1", memory=0))
+        urgent.sla_waiting_time = "30s"    # deadline now+20
+        ci.add_job(urgent)
+        sched = make_scheduler(ci, self._conf())
+        sched.run_once(now=now)
+        binds = dict(sched.cluster.binds)
+        assert binds.get("default/u-0") == "n0"
+        assert "default/o-0" not in binds
+
+    def test_no_sla_jobs_sort_last(self):
+        now = 1_000_000.0
+        ci = simple_cluster(n_nodes=1, node_cpu="1")
+        plain = build_job("default/plain", min_available=1,
+                          creation_timestamp=now - 1000)
+        plain.add_task(build_task("p-0", cpu="1", memory=0))
+        ci.add_job(plain)
+        sla = build_job("default/sla", min_available=1,
+                        creation_timestamp=now - 10)
+        sla.add_task(build_task("s-0", cpu="1", memory=0))
+        sla.sla_waiting_time = "1h"
+        ci.add_job(sla)
+        sched = make_scheduler(ci, self._conf())
+        sched.run_once(now=now)
+        binds = dict(sched.cluster.binds)
+        assert binds.get("default/s-0") == "n0"

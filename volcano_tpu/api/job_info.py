@@ -120,6 +120,9 @@ class TaskInfo:
     preemptable: bool = False
     best_effort: bool = False
     revocable_zone: str = ""
+    priority_class: str = ""            # Pod.Spec.PriorityClassName (the
+    #                                     conformance veto input,
+    #                                     conformance.go:48-55)
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
@@ -154,6 +157,7 @@ class TaskInfo:
             priority=self.priority, node_name=self.node_name,
             gpu_index=self.gpu_index,
             preemptable=self.preemptable, revocable_zone=self.revocable_zone,
+            priority_class=self.priority_class,
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations), labels=dict(self.labels),
             affinity_required=[dict(m) for m in self.affinity_required],
@@ -208,7 +212,8 @@ class JobInfo:
                  pod_group_phase: PodGroupPhase = PodGroupPhase.PENDING,
                  preemptable: bool = False,
                  budget_min_available: str = "",
-                 budget_max_unavailable: str = ""):
+                 budget_max_unavailable: str = "",
+                 sla_waiting_time: str = ""):
         self.uid = uid
         self.name = name or uid.split("/")[-1]
         self.namespace = namespace
@@ -224,6 +229,8 @@ class JobInfo:
         # percentage strings; job_info.go:38-52 + extractBudget :361-372)
         self.budget_min_available = budget_min_available
         self.budget_max_unavailable = budget_max_unavailable
+        # per-job SLA annotation (sla-waiting-time, sla.go:79-82)
+        self.sla_waiting_time = sla_waiting_time
 
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
@@ -350,7 +357,8 @@ class JobInfo:
                     self.priority, self.min_available, self.task_min_available,
                     self.min_resources.clone(), self.creation_timestamp,
                     self.pod_group_phase, self.preemptable,
-                    self.budget_min_available, self.budget_max_unavailable)
+                    self.budget_min_available, self.budget_max_unavailable,
+                    self.sla_waiting_time)
         for task in self.tasks.values():
             j.add_task(task.clone())
         return j

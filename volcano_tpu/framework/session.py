@@ -231,6 +231,9 @@ class Session:
                                             and drf.option.enabled_namespace_order),
                               tdm_job_order=(tdm is not None
                                              and tdm.option.enabled_job_order),
+                              sla_job_order=(self.plugin("sla") is not None
+                                             and self.plugin("sla")
+                                             .option.enabled_job_order),
                               **weights)
 
     def allocate_extras(self) -> AllocateExtras:
@@ -247,6 +250,9 @@ class Session:
             ns = p.namespace_share(self)
             if ns is not None:
                 extras.ns_share = np.asarray(ns, np.float32)
+            if hasattr(p, "job_deadline"):
+                extras.job_deadline = np.asarray(p.job_deadline(self),
+                                                 np.float32)
             if hasattr(p, "block_nonrevocable"):
                 extras.block_nonrevocable = np.asarray(
                     p.block_nonrevocable(self))

@@ -86,6 +86,9 @@ class AllocateConfig:
     drf_ns_order: bool = False
     #: tdm JobOrderFn: non-preemptable jobs schedule first (tdm.go:261-273)
     tdm_job_order: bool = False
+    #: sla JobOrderFn: earliest creation+waiting-time deadline first, jobs
+    #: without an SLA last (sla.go:104-131); key via extras.job_deadline
+    sla_job_order: bool = False
     max_rounds: Optional[int] = None     # cap on outer job iterations
     #: Fused pallas round placer (ops/pallas_place.py): None = auto (TPU
     #: backend, lane-aligned N, fits VMEM), True/False = force,
@@ -103,6 +106,8 @@ class AllocateExtras:
     """
 
     job_share: jax.Array        # f32[J] drf JobOrderFn key (drf.go:454-472)
+    job_deadline: jax.Array     # f32[J] sla deadline key, +inf = no SLA
+    #                             (relative seconds; sla.go:104-131)
     queue_deserved: jax.Array   # f32[Q,R] proportion deserved (proportion.go:140-197)
     ns_share: jax.Array         # f32[S] drf namespace fairness (drf.go:474-507)
     queue_share_extra: jax.Array  # f32[Q] hdrf hierarchical key (drf.go:363-374)
@@ -139,6 +144,7 @@ class AllocateExtras:
         T = snap.tasks.status.shape[0]
         return cls(
             job_share=np.zeros(J, np.float32),
+            job_deadline=np.full(J, np.inf, np.float32),
             queue_deserved=np.full((Q, R), np.inf, np.float32),
             ns_share=np.zeros(S, np.float32),
             queue_share_extra=np.zeros(Q, np.float32),
@@ -504,6 +510,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
             if cfg.tdm_job_order:
                 # tdm JobOrderFn: preemptable jobs sort later (tdm.go:261-273)
                 keys.append(jobs.preemptable.astype(jnp.float32))
+            if cfg.sla_job_order:
+                # sla JobOrderFn: earliest deadline first (sla.go:104-131)
+                keys.append(extras.job_deadline)
             keys += [
                 ready_now.astype(jnp.float32),       # gang: ready jobs last
                 job_share_k,                         # drf JobOrderFn

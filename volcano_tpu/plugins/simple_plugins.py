@@ -68,18 +68,27 @@ class NodeOrderPlugin(Plugin):
         }
 
 
+#: k8s system priority classes (scheduling.SystemClusterCritical /
+#: SystemNodeCritical, conformance.go:49-51)
+SYSTEM_PRIORITY_CLASSES = ("system-cluster-critical", "system-node-critical")
+
+
 class ConformancePlugin(Plugin):
     name = "conformance"
 
     def victim_veto(self, ssn) -> np.ndarray:
-        """bool[T]: never evict kube-system or critical-priority tasks
-        (conformance.go:30-68)."""
+        """bool[T]: never evict kube-system tasks or pods in a system
+        priority class (conformance.go:45-63 evictableFn skip rules)."""
         T = np.asarray(ssn.snap.tasks.status).shape[0]
         veto = np.zeros(T, bool)
-        for uid, ti in ssn.maps.task_index.items():
-            ns = uid.split("/")[0]
-            if ns == "kube-system":
-                veto[ti] = True
+        for job in ssn.cluster.jobs.values():
+            for uid, task in job.tasks.items():
+                ti = ssn.maps.task_index.get(uid)
+                if ti is None:
+                    continue
+                if (task.namespace == "kube-system"
+                        or task.priority_class in SYSTEM_PRIORITY_CLASSES):
+                    veto[ti] = True
         return veto
 
 
@@ -108,18 +117,50 @@ def parse_duration(s: str) -> float:
 class SLAPlugin(Plugin):
     name = "sla"
 
-    def sla_waiting(self, ssn) -> np.ndarray:
-        """bool[J]: jobs waiting longer than the global sla-waiting-time
-        (sla.go:129-148; per-job annotation override TODO)."""
-        J = np.asarray(ssn.snap.jobs.valid).shape[0]
-        waiting = np.zeros(J, bool)
+    def _job_waiting_time(self, job):
+        """Per-job sla-waiting-time annotation overrides the plugin's
+        global argument (readJobWaitingTime, sla.go:57-66); None = no SLA
+        for the job at all."""
+        if job.sla_waiting_time:
+            try:
+                return parse_duration(str(job.sla_waiting_time))
+            except ValueError:
+                pass
         arg = self.arg("sla-waiting-time")
         if arg is None:
-            return waiting
-        threshold = parse_duration(str(arg))
+            return None
+        try:
+            return parse_duration(str(arg))
+        except ValueError:
+            return None
+
+    def sla_waiting(self, ssn) -> np.ndarray:
+        """bool[J]: jobs waiting past their SLA (the JobEnqueueableFn
+        Permit, sla.go:133-145)."""
+        J = np.asarray(ssn.snap.jobs.valid).shape[0]
+        waiting = np.zeros(J, bool)
         now = ssn.now
         for uid, ji in ssn.maps.job_index.items():
             job = ssn.cluster.jobs.get(uid)
-            if job is not None and now - job.creation_timestamp > threshold:
+            if job is None:
+                continue
+            jwt = self._job_waiting_time(job)
+            if jwt is not None and now - job.creation_timestamp >= jwt:
                 waiting[ji] = True
         return waiting
+
+    def job_deadline(self, ssn) -> np.ndarray:
+        """f32[J] jobOrderFn key (sla.go:104-131): jobs WITH a waiting time
+        sort first, earliest creation+jwt deadline wins. Encoded relative
+        to now (f32 seconds); no-SLA jobs get +inf."""
+        J = np.asarray(ssn.snap.jobs.valid).shape[0]
+        deadline = np.full(J, np.inf, np.float32)
+        for uid, ji in ssn.maps.job_index.items():
+            job = ssn.cluster.jobs.get(uid)
+            if job is None:
+                continue
+            jwt = self._job_waiting_time(job)
+            if jwt is not None:
+                deadline[ji] = np.float32(
+                    job.creation_timestamp + jwt - ssn.now)
+        return deadline

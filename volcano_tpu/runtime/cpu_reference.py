@@ -376,6 +376,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
         key_rows += [jqueue.astype(float), -jprio.astype(float)]
         if cfg.tdm_job_order:
             key_rows.append(np.array(jobs.preemptable).astype(float))
+        if cfg.sla_job_order:
+            key_rows.append(np.asarray(extras.job_deadline, float))
         key_rows += [ready_now.astype(float), job_share_k,
                      jrank.astype(float)]
         keys = np.stack(key_rows)
