@@ -1,0 +1,193 @@
+"""NodePorts predicate + volume-binding seam tests.
+
+Reference: the k8s NodePorts filter wrapped by the predicates plugin
+(predicates.go:191) and the defaultVolumeBinder seam at allocate/bind
+(cache.go:240-272, session.go:264-338)."""
+
+import numpy as np
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.api.cluster_info import PersistentVolumeClaim
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.framework.session import Session
+from volcano_tpu.runtime import FakeCluster, Scheduler
+
+from fixtures import build_job, build_node, build_task, simple_cluster
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def run_cycle(ci):
+    sched = Scheduler(FakeCluster(ci), conf=parse_conf(CONF))
+    sched.run_once()
+    return sched
+
+
+class TestNodePorts:
+    def test_static_conflict_with_resident_pod(self):
+        """A pending task sharing a hostPort with a pod already on n0 must
+        land on n1."""
+        ci = simple_cluster(n_nodes=2)
+        holder = build_job("default/holder", min_available=1)
+        t = build_task("h-0", cpu="1", memory="1Gi",
+                       status=TaskStatus.RUNNING, node_name="n0")
+        t.host_ports = [8080]
+        holder.add_task(t)
+        ci.nodes["n0"].add_task(t)
+        ci.add_job(holder)
+        j = build_job("default/web", min_available=1)
+        w = build_task("w-0", cpu="1", memory="1Gi")
+        w.host_ports = [8080]
+        j.add_task(w)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert dict(sched.cluster.binds)["default/w-0"] == "n1"
+
+    def test_in_cycle_conflict_spreads_tasks(self):
+        """Two pending tasks with the same hostPort placed in ONE cycle end
+        up on different nodes (the dynamic placement state)."""
+        ci = simple_cluster(n_nodes=2)
+        j = build_job("default/web", min_available=2)
+        for i in range(2):
+            t = build_task(f"w-{i}", cpu="1", memory="1Gi")
+            t.host_ports = [9090]
+            j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        binds = dict(sched.cluster.binds)
+        assert len(binds) == 2
+        assert binds["default/w-0"] != binds["default/w-1"]
+
+    def test_port_saturation_blocks(self):
+        """One node, two same-port tasks: only one places; the 2-gang
+        discards (no node can take the second -> job breaks)."""
+        ci = simple_cluster(n_nodes=1)
+        j = build_job("default/web", min_available=2)
+        for i in range(2):
+            t = build_task(f"w-{i}", cpu="1", memory="1Gi")
+            t.host_ports = [9090]
+            j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert sched.cluster.binds == []
+
+    def test_different_ports_share_node(self):
+        ci = simple_cluster(n_nodes=1)
+        j = build_job("default/web", min_available=2)
+        for i in range(2):
+            t = build_task(f"w-{i}", cpu="1", memory="1Gi")
+            t.host_ports = [9090 + i]
+            j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert len(sched.cluster.binds) == 2
+
+    def test_cpu_oracle_parity_with_ports(self):
+        from volcano_tpu.runtime.cpu_reference import allocate_cpu
+        ci = simple_cluster(n_nodes=3)
+        rng = np.random.RandomState(3)
+        for jid in range(4):
+            j = build_job(f"default/j{jid}", min_available=1)
+            for i in range(2):
+                t = build_task(f"j{jid}-t{i}", cpu="500m", memory="1Gi")
+                if rng.rand() < 0.7:
+                    t.host_ports = [int(rng.choice([80, 443, 9090]))]
+                j.add_task(t)
+            ci.add_job(j)
+        ssn = Session(ci, parse_conf(CONF))
+        cfg = ssn.allocate_config()
+        assert cfg.enable_host_ports
+        extras = ssn.allocate_extras()
+        import jax
+        from volcano_tpu.ops.allocate_scan import make_allocate_cycle
+        result = jax.jit(make_allocate_cycle(cfg))(ssn.snap, extras)
+        ref = allocate_cpu(ssn.snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(result.task_node),
+                                      ref["task_node"])
+        np.testing.assert_array_equal(np.asarray(result.task_mode),
+                                      ref["task_mode"])
+
+
+class TestVolumeBinding:
+    def test_unbindable_pvc_blocks_placement(self):
+        """FindPodVolumes failing everywhere -> the task never places
+        (cache.go:255-262 GetPodVolumes error at allocate)."""
+        ci = simple_cluster(n_nodes=2)
+        ci.pvcs["data"] = PersistentVolumeClaim("data", bindable=False)
+        j = build_job("default/db", min_available=1)
+        t = build_task("db-0", cpu="1", memory="1Gi")
+        t.pvcs = ["data"]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert sched.cluster.binds == []
+
+    def test_missing_pvc_blocks_placement(self):
+        ci = simple_cluster(n_nodes=2)
+        j = build_job("default/db", min_available=1)
+        t = build_task("db-0", cpu="1", memory="1Gi")
+        t.pvcs = ["ghost"]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert sched.cluster.binds == []
+
+    def test_local_pv_pins_to_node(self):
+        """A claim with local-PV node affinity pins the task to that node
+        even when another node scores better."""
+        ci = simple_cluster(n_nodes=2)
+        # n0 is busier, so nodeorder would prefer n1
+        filler = build_job("default/filler", min_available=1)
+        f = build_task("f-0", cpu="2", memory="2Gi",
+                       status=TaskStatus.RUNNING, node_name="n0")
+        filler.add_task(f)
+        ci.nodes["n0"].add_task(f)
+        ci.add_job(filler)
+        ci.pvcs["local-data"] = PersistentVolumeClaim(
+            "local-data", node_name="n0")
+        j = build_job("default/db", min_available=1)
+        t = build_task("db-0", cpu="1", memory="1Gi")
+        t.pvcs = ["local-data"]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert dict(sched.cluster.binds)["default/db-0"] == "n0"
+
+    def test_bind_marks_claims_bound(self):
+        ci = simple_cluster(n_nodes=1)
+        ci.pvcs["data"] = PersistentVolumeClaim("data")
+        j = build_job("default/db", min_available=1)
+        t = build_task("db-0", cpu="1", memory="1Gi")
+        t.pvcs = ["data"]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert dict(sched.cluster.binds)["default/db-0"] == "n0"
+        assert sched.cluster.ci.pvcs["data"].bound
+
+    def test_claim_turning_unbindable_fails_bind_into_resync(self):
+        """The scheduler decided a placement, but BindVolumes fails at
+        dispatch -> the bind lands in the retry queue, and succeeds once
+        the claim becomes bindable again."""
+        ci = simple_cluster(n_nodes=1)
+        ci.pvcs["data"] = PersistentVolumeClaim("data")
+        j = build_job("default/db", min_available=1)
+        t = build_task("db-0", cpu="1", memory="1Gi")
+        t.pvcs = ["data"]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = Scheduler(FakeCluster(ci), conf=parse_conf(CONF))
+        sched.cluster.volume_bind_failures.add("data")
+        sched.run_once(now=100.0)
+        assert sched.cluster.binds == []
+        assert len(sched.resync) == 1
+        sched.cluster.volume_bind_failures.clear()
+        sched.run_once(now=101.0)
+        assert dict(sched.cluster.binds)["default/db-0"] == "n0"

@@ -15,11 +15,32 @@ from .queue_info import NamespaceInfo, QueueInfo
 
 
 @dataclass
+class PersistentVolumeClaim:
+    """Scheduler-side PVC view — the volume-binding seam's input
+    (defaultVolumeBinder.GetPodVolumes/AllocateVolumes, cache.go:240-272).
+
+    ``bindable=False`` models FindPodVolumes failing everywhere (no
+    matching PV / unbound claim with no provisioner); ``node_name`` models
+    a local-PV node affinity pinning the claim (and every pod using it) to
+    one node."""
+
+    name: str
+    bound: bool = False
+    bindable: bool = True
+    node_name: str = ""
+
+    def clone(self) -> "PersistentVolumeClaim":
+        return PersistentVolumeClaim(self.name, self.bound, self.bindable,
+                                     self.node_name)
+
+
+@dataclass
 class ClusterInfo:
     jobs: Dict[str, JobInfo] = field(default_factory=dict)
     nodes: Dict[str, NodeInfo] = field(default_factory=dict)
     queues: Dict[str, QueueInfo] = field(default_factory=dict)
     namespaces: Dict[str, NamespaceInfo] = field(default_factory=dict)
+    pvcs: Dict[str, PersistentVolumeClaim] = field(default_factory=dict)
 
     def add_job(self, job: JobInfo) -> None:
         self.jobs[job.uid] = job
@@ -48,4 +69,5 @@ class ClusterInfo:
             nodes={k: n.clone() for k, n in self.nodes.items()},
             queues={k: q.clone() for k, q in self.queues.items()},
             namespaces={k: ns.clone() for k, ns in self.namespaces.items()},
+            pvcs={k: p.clone() for k, p in self.pvcs.items()},
         )

@@ -123,6 +123,12 @@ class TaskInfo:
     priority_class: str = ""            # Pod.Spec.PriorityClassName (the
     #                                     conformance veto input,
     #                                     conformance.go:48-55)
+    host_ports: List[int] = field(default_factory=list)  # container
+    #                                     hostPorts (the k8s NodePorts
+    #                                     filter input, predicates.go:191)
+    pvcs: List[str] = field(default_factory=list)  # claim names (the
+    #                                     volume-binding seam input,
+    #                                     cache.go:240-272)
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
@@ -158,6 +164,7 @@ class TaskInfo:
             gpu_index=self.gpu_index,
             preemptable=self.preemptable, revocable_zone=self.revocable_zone,
             priority_class=self.priority_class,
+            host_ports=list(self.host_ports), pvcs=list(self.pvcs),
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations), labels=dict(self.labels),
             affinity_required=[dict(m) for m in self.affinity_required],

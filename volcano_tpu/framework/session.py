@@ -214,6 +214,12 @@ class Session:
         # affinity-free hot path keeps its fused-placer shape.
         enable_aff = (self.affinity.has_terms
                       and self.plugin("predicates") is not None)
+        # NodePorts likewise (predicates.go:191): only when a pending task
+        # actually declares hostPorts
+        enable_ports = (self.plugin("predicates") is not None
+                        and any(t.host_ports
+                                for job in self.cluster.jobs.values()
+                                for t in job.tasks.values()))
         # Default the scoring weight to 1.0 only when no nodeorder plugin
         # supplied a value; an explicit ``podaffinity.weight: 0`` stays 0
         # (nodeorder.go:104-140 priorityWeight defaults).
@@ -223,6 +229,7 @@ class Session:
         tdm = self.plugin("tdm")
         return AllocateConfig(enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,
+                              enable_host_ports=enable_ports,
                               enable_hdrf=(drf is not None
                                            and drf.option.enabled_hierarchy),
                               drf_job_order=(drf is not None
@@ -236,10 +243,65 @@ class Session:
                                              .option.enabled_job_order),
                               **weights)
 
+    def _port_volume_extras(self, extras: AllocateExtras) -> None:
+        """Host-side NodePorts + volume-binding inputs (the predicates
+        plugin's nodePortFilter, predicates.go:191, and the
+        defaultVolumeBinder seam, cache.go:240-272)."""
+        from ..arrays.schema import bucket
+        N = np.asarray(self.snap.nodes.pod_count).shape[0]
+        T = np.asarray(self.snap.tasks.status).shape[0]
+        task_ports: Dict[int, list] = {}
+        node_ports: Dict[int, set] = {}
+        vol_ok = np.ones(T, bool)
+        vol_node = np.full(T, -1, np.int32)
+        n_pending_ports = 0
+        for job in self.cluster.jobs.values():
+            for uid, task in job.tasks.items():
+                ti = self.maps.task_index.get(uid)
+                if ti is None:
+                    continue
+                if task.host_ports:
+                    if task.node_name in self.maps.node_index:
+                        node_ports.setdefault(
+                            self.maps.node_index[task.node_name],
+                            set()).update(task.host_ports)
+                    else:
+                        task_ports[ti] = list(task.host_ports)
+                        n_pending_ports += len(task.host_ports)
+                for claim in task.pvcs:
+                    pvc = self.cluster.pvcs.get(claim)
+                    if pvc is None or not pvc.bindable:
+                        vol_ok[ti] = False
+                    elif pvc.node_name:
+                        ni = self.maps.node_index.get(pvc.node_name, -1)
+                        if ni < 0:
+                            vol_ok[ti] = False
+                        elif vol_node[ti] >= 0 and vol_node[ti] != ni:
+                            vol_ok[ti] = False   # claims pin to two nodes
+                        else:
+                            vol_node[ti] = ni
+        HP = bucket(max((len(p) for p in task_ports.values()), default=1), 1)
+        PS = bucket(max((len(p) for p in node_ports.values()), default=1), 1)
+        tp = np.zeros((T, HP), np.int32)
+        for ti, ports in task_ports.items():
+            tp[ti, :len(ports)] = sorted(ports)[:HP]
+        npo = np.zeros((N, PS), np.int32)
+        for ni, ports in node_ports.items():
+            npo[ni, :len(ports)] = sorted(ports)[:PS]
+        PE = bucket(max(n_pending_ports, 1), 8)
+        extras.task_ports = tp
+        extras.node_ports = npo
+        extras.pe_node0 = np.full(PE, -1, np.int32)
+        extras.pe_port0 = np.zeros(PE, np.int32)
+        extras.task_volume_ok = vol_ok
+        extras.task_volume_node = vol_node
+
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
         extras.affinity = self.affinity
         extras.hierarchy = self.hierarchy
+        if self.plugin("predicates") is not None:
+            self._port_volume_extras(extras)
         for p in self.plugins:
             deserved = p.queue_deserved(self)
             if deserved is not None:

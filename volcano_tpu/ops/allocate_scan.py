@@ -74,6 +74,11 @@ class AllocateConfig:
     #: nodeorder.go:273-306). Static so the affinity-free hot path stays
     #: untraced; the session enables it when any task carries terms.
     enable_pod_affinity: bool = False
+    #: k8s NodePorts filter (predicates.go:191 wrapping nodeports.New):
+    #: hostPort conflicts against node-resident pods AND in-cycle
+    #: placements. Static so the port-free hot path carries no port state;
+    #: the session enables it when any pending task declares hostPorts.
+    enable_host_ports: bool = False
     pod_affinity_weight: float = 1.0     # nodeorder interpodaffinity.weight
     #: Exact hierarchical DRF queue ordering: per-round tree update over
     #: extras.hierarchy with dynamic job allocations (drf.go:230-360).
@@ -132,6 +137,17 @@ class AllocateExtras:
     #                               plugin contribution, arrays/affinity.py)
     hierarchy: HierarchyArrays    # hdrf tree topology (drf plugin
     #                               contribution, arrays/hierarchy.py)
+    #: NodePorts filter inputs (predicates.go:191): per-task hostPorts and
+    #: per-node ports already used by resident pods (0 = empty slot);
+    #: pe_*0 sizes the in-cycle placement port buffer.
+    task_ports: jax.Array         # i32[T, HP]
+    node_ports: jax.Array         # i32[N, PS]
+    pe_node0: jax.Array           # i32[PE] init -1
+    pe_port0: jax.Array           # i32[PE] init 0
+    #: volume-binding seam (defaultVolumeBinder, cache.go:240-272):
+    #: unbindable claims block a task everywhere; a local-PV claim pins it
+    task_volume_ok: jax.Array     # bool[T]
+    task_volume_node: jax.Array   # i32[T] pinned node, -1 = any
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -158,6 +174,12 @@ class AllocateExtras:
             target_job=np.int32(-1),
             affinity=AffinityArrays.neutral(N, T),
             hierarchy=HierarchyArrays.neutral(Q, J),
+            task_ports=np.zeros((T, 1), np.int32),
+            node_ports=np.zeros((N, 1), np.int32),
+            pe_node0=np.full(1, -1, np.int32),
+            pe_port0=np.zeros(1, np.int32),
+            task_volume_ok=np.ones(T, bool),
+            task_volume_node=np.full(T, -1, np.int32),
         )
 
 
@@ -364,14 +386,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
             # its predicate cache the same way, predicates.go:244-255).
             use_pallas = (backend in ("tpu", "axon") and N % 128 == 0
                           and not cfg.enable_pod_affinity
+                          and not cfg.enable_host_ports
                           and vmem_estimate_bytes(M, N, R, G) < 12 * 2 ** 20)
             interp = False
         else:
             use_pallas, interp = bool(cfg.use_pallas), False
-        if use_pallas and cfg.enable_pod_affinity:
+        if use_pallas and (cfg.enable_pod_affinity or cfg.enable_host_ports):
             raise ValueError(
-                "use_pallas and enable_pod_affinity are mutually exclusive: "
-                "the fused round placer does not carry affinity-count state")
+                "use_pallas excludes enable_pod_affinity/enable_host_ports: "
+                "the fused round placer carries no affinity-count or "
+                "host-port state")
 
         if use_pallas:
             # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
@@ -419,6 +443,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
             anti_cnt=extras.affinity.anti_cnt0,
             saved_aff=extras.affinity.cnt0,
             saved_anti=extras.affinity.anti_cnt0,
+            # in-cycle hostPort placements (neutral [1] when disabled)
+            pe_node=extras.pe_node0,
+            pe_port=extras.pe_port0,
+            pe_cnt=jnp.int32(0),
+            saved_pe_node=extras.pe_node0,
+            saved_pe_port=extras.pe_port0,
+            saved_pe_cnt=jnp.int32(0),
             **init_cap,
         )
 
@@ -538,9 +569,15 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 (ops/pallas_place.py) instead of the M-step scan."""
                 tcl = jnp.maximum(task_ids, 0)
                 tmpl_ids = tasks.template[tcl]
+                vol_node = extras.task_volume_node[tcl]
                 node_ok = (~(extras.block_nonrevocable[None, :]
                              & ~extras.task_revocable[tcl][:, None])
                            & ~extras.block_all[None, :]
+                           # volume-binding seam: unbindable claims block,
+                           # local-PV claims pin (cache.go:240-272)
+                           & extras.task_volume_ok[tcl][:, None]
+                           & ((vol_node < 0)[:, None]
+                              | (jnp.arange(N)[None, :] == vol_node[:, None]))
                            & (~extras.node_locked
                               | (ji == extras.target_job))[None, :])
                 sfeas = (tmpl_static[tmpl_ids] & node_ok).astype(jnp.float32)
@@ -600,7 +637,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             def task_step(carry, xs):
                 (idle, pipe_extra, pods_extra, gpu_extra,
                  t_node, t_mode, t_gpu, n_alloc, n_pipe,
-                 aff_cnt, anti_cnt, placed_sum, n_adv, stopped, broke) = carry
+                 aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
+                 placed_sum, n_adv, stopped, broke) = carry
                 t_idx, slot, suffix = xs
                 can_run = ((t_idx >= 0) & (slot >= cur) & ~stopped & ~broke)
                 active = can_run & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
@@ -619,8 +657,26 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 node_ok = (~(extras.block_nonrevocable
                              & ~extras.task_revocable[t])
                            & ~extras.block_all
+                           # volume-binding seam (cache.go:240-272)
+                           & extras.task_volume_ok[t]
+                           & ((extras.task_volume_node[t] < 0)
+                              | (jnp.arange(N) == extras.task_volume_node[t]))
                            & (~extras.node_locked | (ji == extras.target_job))
                            & tmpl_static[tasks.template[t]])
+                if cfg.enable_host_ports:
+                    # k8s NodePorts filter: conflicts against resident pods
+                    # (static) and this cycle's placements (pe_* state)
+                    tp = extras.task_ports[t]                    # [HP]
+                    act_p = tp > 0
+                    stat_conf = jnp.any(
+                        (extras.node_ports[:, :, None] == tp[None, None, :])
+                        & act_p[None, None, :]
+                        & (extras.node_ports > 0)[:, :, None], axis=(1, 2))
+                    km = jnp.any((pe_port[:, None] == tp[None, :])
+                                 & act_p[None, :], axis=1) & (pe_node >= 0)
+                    dyn_conf = jnp.zeros(N, bool).at[
+                        jnp.where(km, pe_node, N)].max(km, mode="drop")
+                    node_ok &= ~(stat_conf | dyn_conf)
                 # shared (capacity-view-independent) terms computed once, the
                 # idle/future resource fit fused into one stacked comparison
                 shared = node_ok & P.pod_count_fit(nodes, pods_extra)
@@ -692,25 +748,39 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 if cfg.enable_pod_affinity:
                     aff_cnt, anti_cnt = _affinity_place_update(
                         extras.affinity, aff_cnt, anti_cnt, t, node, placed)
+                if cfg.enable_host_ports:
+                    # account the placed task's hostPorts (the AddPod event
+                    # handler updating UsedPorts, predicates.go:224-239)
+                    off = jnp.cumsum(act_p.astype(jnp.int32)) - act_p
+                    widx = jnp.where(placed & act_p, pe_cnt + off,
+                                     pe_node.shape[0])
+                    pe_node = pe_node.at[widx].set(node, mode="drop")
+                    pe_port = pe_port.at[widx].set(tp, mode="drop")
+                    pe_cnt = pe_cnt + jnp.where(
+                        placed, jnp.sum(act_p.astype(jnp.int32)), 0)
                 return (idle, pipe_extra, pods_extra, gpu_extra,
                         t_node, t_mode, t_gpu, n_alloc, n_pipe,
-                        aff_cnt, anti_cnt, placed_sum, n_adv,
-                        stopped, broke), None
+                        aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
+                        placed_sum, n_adv, stopped, broke), None
 
             if use_pallas:
                 (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
                  t_gpu, n_alloc, n_pipe, placed_sum, n_adv, stopped,
                  broke) = pallas_round()
                 aff_cnt, anti_cnt = st["aff_cnt"], st["anti_cnt"]
+                pe_node, pe_port, pe_cnt = (st["pe_node"], st["pe_port"],
+                                            st["pe_cnt"])
             else:
                 carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
                           st["gpu_extra"], st["task_node"], st["task_mode"],
                           st["task_gpu"], jnp.int32(0), jnp.int32(0),
                           st["aff_cnt"], st["anti_cnt"],
+                          st["pe_node"], st["pe_port"], st["pe_cnt"],
                           jnp.zeros(R, jnp.float32), jnp.int32(0),
                           jnp.bool_(False), jnp.bool_(False))
                 (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
-                 t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt, placed_sum,
+                 t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt,
+                 pe_node, pe_port, pe_cnt, placed_sum,
                  n_adv, stopped, broke), _ = jax.lax.scan(
                     task_step, carry0, (task_ids, slots, suffix_after),
                     unroll=min(int(M), 16))
@@ -731,6 +801,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
             gpu_extra = jnp.where(keep, gpu_extra, st["saved_gpu"])
             aff_cnt = jnp.where(keep, aff_cnt, st["saved_aff"])
             anti_cnt = jnp.where(keep, anti_cnt, st["saved_anti"])
+            pe_node = jnp.where(keep, pe_node, st["saved_pe_node"])
+            pe_port = jnp.where(keep, pe_port, st["saved_pe_port"])
+            pe_cnt = jnp.where(keep, pe_cnt, st["saved_pe_cnt"])
             t_node = jnp.where(keep | ~job_tasks, t_node,
                                jnp.full_like(t_node, -1))
             t_mode = jnp.where(keep | ~job_tasks, t_mode,
@@ -752,6 +825,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
             saved_gpu = jnp.where(keep, gpu_extra, st["saved_gpu"])
             saved_aff = jnp.where(keep, aff_cnt, st["saved_aff"])
             saved_anti = jnp.where(keep, anti_cnt, st["saved_anti"])
+            saved_pe_node = jnp.where(keep, pe_node, st["saved_pe_node"])
+            saved_pe_port = jnp.where(keep, pe_port, st["saved_pe_port"])
+            saved_pe_cnt = jnp.where(keep, pe_cnt, st["saved_pe_cnt"])
 
             # queue + drf accounting for the ordering keys (event handlers
             # on Allocate/Pipeline, proportion.go:281-325, drf.go:511-536);
@@ -767,6 +843,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 saved_pods=saved_pods, saved_gpu=saved_gpu,
                 aff_cnt=aff_cnt, anti_cnt=anti_cnt,
                 saved_aff=saved_aff, saved_anti=saved_anti,
+                pe_node=pe_node, pe_port=pe_port, pe_cnt=pe_cnt,
+                saved_pe_node=saved_pe_node, saved_pe_port=saved_pe_port,
+                saved_pe_cnt=saved_pe_cnt,
                 task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
                 # a yielded (ready, queue non-empty) job is re-pushed; any
                 # other outcome finishes it for the cycle

@@ -2,10 +2,13 @@
 
 TPU re-design of the reference's predicate plugins
 (pkg/scheduler/plugins/predicates/predicates.go:181-288 wrapping the k8s
-filters NodeUnschedulable, NodeAffinity, NodePorts, TaintToleration + pod
-count) and of the parallel PredicateNodes helper
+filters NodeUnschedulable, NodeAffinity, TaintToleration + pod count) and
+of the parallel PredicateNodes helper
 (pkg/scheduler/util/scheduler_helper.go:74-130): the 16-goroutine fan-out
-becomes a single masked vector op over the node axis.
+becomes a single masked vector op over the node axis. The NodePorts filter
+(predicates.go:191) and the volume-binding seam live in the allocate
+kernel itself (ops/allocate_scan.py) because both need in-cycle placement
+state; InterPodAffinity is the affinity encoding (arrays/affinity.py).
 
 All functions are shape-polymorphic jittable JAX; none contain Python control
 flow on traced values.

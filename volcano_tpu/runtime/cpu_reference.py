@@ -275,6 +275,11 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     block_all = np.asarray(extras.block_all)
     task_revocable = np.asarray(extras.task_revocable)
     tdm_bonus = np.asarray(extras.tdm_bonus)
+    task_ports_a = np.asarray(extras.task_ports)
+    node_ports_a = np.asarray(extras.node_ports)
+    vol_ok = np.asarray(extras.task_volume_ok)
+    vol_node = np.asarray(extras.task_volume_node)
+    ports_placed: List[Tuple[int, int]] = []    # (node, port) this cycle
     task_pref_node = np.asarray(extras.task_pref_node)
     node_locked = np.asarray(extras.node_locked)
     target_job = int(extras.target_job)
@@ -392,6 +397,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
 
         saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy(),
                  gpu_extra.copy())
+        saved_ports = list(ports_placed)
         if aff_st is not None:
             saved_aff = (aff_st["aff_cnt"].copy(), aff_st["anti_cnt"].copy())
         placed: List[int] = []
@@ -415,7 +421,20 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             greq = t_gpu_req[t]
             node_ok = (~(block_nonrevocable & ~task_revocable[t])
                        & ~block_all
+                       & vol_ok[t]
+                       & ((vol_node[t] < 0)
+                          | (np.arange(N) == vol_node[t]))
                        & (~node_locked | (ji == target_job)))
+            if cfg.enable_host_ports:
+                tports = [p for p in task_ports_a[t] if p > 0]
+                if tports:
+                    conf_mask = np.zeros(N, bool)
+                    for p in tports:
+                        conf_mask |= (node_ports_a == p).any(axis=-1)
+                    for pn, pp in ports_placed:
+                        if pp in tports:
+                            conf_mask[pn] = True
+                    node_ok &= ~conf_mask
             feas_now = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm,
                                                idle, pods_extra,
                                                greq, gpu_extra)
@@ -445,6 +464,9 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 did_place = True
                 if aff_st is not None:
                     _affinity_place(aff_st, t, node)
+                if cfg.enable_host_ports:
+                    ports_placed.extend(
+                        (node, p) for p in task_ports_a[t] if p > 0)
             elif cfg.enable_pipelining:
                 future = np.maximum(idle + releasing - pipelined0 - pipe_extra, 0)
                 feas_fut = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm, future,
@@ -467,6 +489,9 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                     did_place = True
                     if aff_st is not None:
                         _affinity_place(aff_st, t, node)
+                    if cfg.enable_host_ports:
+                        ports_placed.extend(
+                            (node, p) for p in task_ports_a[t] if p > 0)
             if not did_place:
                 # no node can take the task at all -> the job breaks
                 # (allocate.go:210-214 PredicateNodes empty)
@@ -500,6 +525,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             idle, pipe_extra, pods_extra, gpu_extra = saved
             if aff_st is not None:
                 aff_st["aff_cnt"], aff_st["anti_cnt"] = saved_aff
+            ports_placed = saved_ports
             for t in placed:
                 task_node[t] = -1
                 task_mode[t] = MODE_NONE

@@ -23,6 +23,8 @@ class FakeCluster:
         self.binds: List[Tuple[str, str]] = []      # (task uid, node)
         self.evictions: List[str] = []              # task uid
         self.bind_failures: Dict[str, str] = {}     # task uid -> error to inject
+        self.volume_bind_failures: set = set()      # claim names failing
+        #                                             BindVolumes at dispatch
 
     # ------------------------------------------------------------- snapshot
     def snapshot(self) -> ClusterInfo:
@@ -51,6 +53,16 @@ class FakeCluster:
         task = job.tasks.get(intent.task_uid)
         if task is None:
             return False
+        # BindVolumes precedes the pod bind (ssn.dispatch, session.go:330-338
+        # -> defaultVolumeBinder.BindVolumes, cache.go:265-272): an
+        # unbindable claim fails the whole bind into the resync path
+        for claim in task.pvcs:
+            pvc = self.ci.pvcs.get(claim)
+            if (pvc is None or not pvc.bindable
+                    or claim in self.volume_bind_failures):
+                return False
+        for claim in task.pvcs:
+            self.ci.pvcs[claim].bound = True
         old_status, old_gpu = task.status, task.gpu_index
         removed_from = None
         if task.uid in self.ci.nodes.get(task.node_name, node).tasks:
