@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Mapping, Optional
+from typing import Dict, List, Mapping, Optional, Tuple
 
 from .resource import Resource
 from .types import TaskStatus, PodGroupPhase, is_allocated_status
@@ -133,6 +133,11 @@ class TaskInfo:
     tolerations: List[Toleration] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
     affinity_required: List[Dict[str, str]] = field(default_factory=list)
+    #: preferredDuringSchedulingIgnoredDuringExecution node-affinity terms
+    #: as (match-labels, weight) pairs — the k8s NodeAffinity scorer input
+    #: (nodeorder.go:255-266)
+    affinity_preferred: List[Tuple[Dict[str, str], float]] = field(
+        default_factory=list)
     # inter-pod (anti-)affinity terms (k8s InterPodAffinity semantics,
     # predicates.go:261-273 + nodeorder.go:273-306):
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
@@ -168,6 +173,8 @@ class TaskInfo:
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations), labels=dict(self.labels),
             affinity_required=[dict(m) for m in self.affinity_required],
+            affinity_preferred=[(dict(m), w)
+                                for m, w in self.affinity_preferred],
             pod_affinity=[t.clone() for t in self.pod_affinity],
             pod_anti_affinity=[t.clone() for t in self.pod_anti_affinity],
             pod_affinity_preferred=[

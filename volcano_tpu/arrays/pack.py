@@ -9,8 +9,7 @@ Known encoding divergences from the reference (documented per SURVEY section 7
 hard part 3):
 - Node-affinity required terms are encoded as a single all-of label-hash set
   (match-labels style); multi-term OR expressions collapse to their union.
-- InterPodAffinity is approximated by the task-topology plugin's bucket
-  scoring rather than arbitrary pod label selectors.
+  (InterPodAffinity has its own exact encoding, arrays/affinity.py.)
 """
 
 from __future__ import annotations
@@ -254,8 +253,11 @@ def pack(ci: ClusterInfo,
     template_of: Dict[tuple, int] = {}
     rep_tasks: List[int] = []
     for ti in range(nt):
+        task = task_entries[ti][1]
+        na_sig = tuple(sorted((tuple(sorted(m.items())), w)
+                              for m, w in task.affinity_preferred))
         sig = (tuple(sel_rows[ti]), tuple(tolh_rows[ti]),
-               tuple(tole_rows[ti]), tuple(tolm_rows[ti]))
+               tuple(tole_rows[ti]), tuple(tolm_rows[ti]), na_sig)
         tid = template_of.get(sig)
         if tid is None:
             tid = len(rep_tasks)

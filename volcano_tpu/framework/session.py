@@ -57,6 +57,25 @@ def _allocate_fn(cfg: AllocateConfig):
     return jax.jit(make_allocate_cycle(cfg))
 
 
+#: (cfg, input-shape signature) -> (jitted fused fn, fuse) — the 3-buffer
+#: upload + one packed readback path (ops/fused_io); per-leaf uploads cost
+#: ~tens of ms EACH over the axon tunnel, which dominated the full-session
+#: time at scale
+_FUSED_CACHE: Dict[tuple, tuple] = {}
+
+
+def _fused_allocate(cfg: AllocateConfig, snap, extras):
+    leaves = jax.tree.leaves((snap, extras))
+    key = (cfg, tuple((np.asarray(l).shape, np.asarray(l).dtype.str)
+                      for l in leaves))
+    hit = _FUSED_CACHE.get(key)
+    if hit is None:
+        from ..ops.fused_io import make_fused_cycle
+        hit = make_fused_cycle(make_allocate_cycle(cfg), (snap, extras))
+        _FUSED_CACHE[key] = hit
+    return hit
+
+
 @lru_cache(maxsize=64)
 def _enqueue_fn(cfg: EnqueueConfig):
     return jax.jit(make_enqueue_pass(cfg))
@@ -115,17 +134,24 @@ class Session:
         VOLCANO_TPU_NO_NATIVE=1 to force the Python path.
         """
         import os
+        t0 = time.time()
         if os.environ.get("VOLCANO_TPU_NO_NATIVE"):
             self.snap, self.maps = pack(self.cluster)
         else:
             from .. import native
             self.snap, self.maps = native.pack_best_effort(self.cluster)
+        self.stats["pack_ms"] = (time.time() - t0) * 1000
         # inter-pod affinity encoding rides the snapshot (the predicates
         # plugin's InterPodAffinity state, predicates.go:116-160)
         from ..arrays.affinity import build_affinity
         N = np.asarray(self.snap.nodes.pod_count).shape[0]
         T = np.asarray(self.snap.tasks.status).shape[0]
         self.affinity = build_affinity(self.cluster, self.maps, N, T)
+        # uid -> (job, task) readout index (one O(T) pass per repack)
+        self._task_lookup = {
+            uid: (job, task)
+            for job in self.cluster.jobs.values()
+            for uid, task in job.tasks.items()}
         # hdrf tree topology (the drf plugin's hierarchicalRoot,
         # drf.go:128-147) — static per snapshot, consumed in-kernel
         from ..arrays.hierarchy import build_hierarchy
@@ -296,12 +322,45 @@ class Session:
         extras.task_volume_ok = vol_ok
         extras.task_volume_node = vol_node
 
+    def _node_affinity_extras(self, extras: AllocateExtras) -> None:
+        """f32[P, N] NodeAffinity preferred-terms score per predicate
+        template: sum of matched term weights x nodeaffinity.weight
+        (nodeorder.go:255-266 wrapping the k8s NodeAffinity scorer,
+        un-normalized like the reference's TODO notes)."""
+        no = self.plugin("nodeorder")
+        w = no.arg_float("nodeaffinity.weight", 1.0)
+        if not w:
+            return
+        rep = np.asarray(self.snap.template_rep)
+        N = len(self.maps.node_names)
+        node_labels = [self.cluster.nodes[n].labels
+                       for n in self.maps.node_names]
+        score = np.asarray(extras.template_na_score).copy()
+        uids = self.maps.task_uids
+        any_terms = False
+        for p, ti in enumerate(rep.tolist()):
+            if ti < 0 or ti >= len(uids):
+                continue
+            _job, task = self._task_lookup.get(uids[ti], (None, None))
+            if task is None or not task.affinity_preferred:
+                continue
+            any_terms = True
+            for match, weight in task.affinity_preferred:
+                mask = np.fromiter(
+                    (all(labels.get(k) == v for k, v in match.items())
+                     for labels in node_labels), bool, count=N)
+                score[p, :N] += np.float32(w * weight) * mask
+        if any_terms:
+            extras.template_na_score = score.astype(np.float32)
+
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
         extras.affinity = self.affinity
         extras.hierarchy = self.hierarchy
         if self.plugin("predicates") is not None:
             self._port_volume_extras(extras)
+        if self.plugin("nodeorder") is not None:
+            self._node_affinity_extras(extras)
         for p in self.plugins:
             deserved = p.queue_deserved(self)
             if deserved is not None:
@@ -369,21 +428,43 @@ class Session:
             self.repack()
         return count
 
-    def run_allocate(self) -> AllocateResult:
+    def run_allocate(self):
+        t0 = time.time()
         cfg = self.allocate_config()
-        result = _allocate_fn(cfg)(self.snap, self.allocate_extras())
+        extras = self.allocate_extras()
+        self.stats["extras_ms"] = (time.time() - t0) * 1000
+        t0 = time.time()
+        # fused 3-buffer upload + single packed readback (the per-leaf
+        # transfer cost over the axon tunnel dominated at scale)
+        fn, fuse = _fused_allocate(cfg, self.snap, extras)
+        packed = np.asarray(fn(*fuse((self.snap, extras))))
+        from ..ops.allocate_scan import unpack_decisions
+        T = np.asarray(self.snap.tasks.status).shape[0]
+        J = np.asarray(self.snap.jobs.valid).shape[0]
+        (task_node, task_mode, task_gpu, job_ready, job_pipelined,
+         job_attempted) = unpack_decisions(packed, T, J)
+        self.stats["kernel_ms"] = (time.time() - t0) * 1000
+        import types
+        result = types.SimpleNamespace(
+            task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
+            job_ready=job_ready, job_pipelined=job_pipelined,
+            job_attempted=job_attempted)
         self.last_allocate = result
-        self.apply_allocate(result)
+        t0 = time.time()
+        self.apply_allocate(
+            result, host=(task_node, task_mode, task_gpu, job_ready,
+                          job_pipelined))
+        self.stats["apply_ms"] = (time.time() - t0) * 1000
         return result
 
     def run_backfill(self) -> int:
         t_node, placed = _backfill_fn()(self.snap)
         t_node, placed = np.asarray(t_node), np.asarray(placed)
         count = 0
-        for uid, ti in self.maps.task_index.items():
-            if placed[ti]:
-                self._bind_task(uid, self.maps.node_names[int(t_node[ti])])
-                count += 1
+        uids = self.maps.task_uids
+        for ti in np.nonzero(placed)[0]:
+            self._bind_task(uids[ti], self.maps.node_names[int(t_node[ti])])
+            count += 1
         return count
 
     def victim_veto_mask(self) -> np.ndarray:
@@ -457,12 +538,12 @@ class Session:
         evicted = np.asarray(result.evicted)
         task_node = np.asarray(result.task_node)
         task_mode = np.asarray(result.task_mode)
-        for uid, ti in self.maps.task_index.items():
-            if evicted[ti]:
-                self.evict_task(uid, reason=f"{mode} victim")
-        for uid, ti in self.maps.task_index.items():
-            if int(task_mode[ti]) == MODE_PIPELINED:
-                self.pipelined[uid] = self.maps.node_names[int(task_node[ti])]
+        uids = self.maps.task_uids
+        for ti in np.nonzero(evicted)[0]:
+            self.evict_task(uids[ti], reason=f"{mode} victim")
+        for ti in np.nonzero(task_mode == MODE_PIPELINED)[0]:
+            self.pipelined[uids[ti]] = \
+                self.maps.node_names[int(task_node[ti])]
 
     def evict_task(self, task_uid: str, reason: str = "") -> None:
         """Session evict (session.go:357 -> cache.Evict, cache.go:496):
@@ -482,11 +563,10 @@ class Session:
 
     # -------------------------------------------------------- apply/readout
     def _find_task(self, uid: str):
-        for job in self.cluster.jobs.values():
-            task = job.tasks.get(uid)
-            if task is not None:
-                return job, task
-        return None, None
+        """O(1) via the uid index built at repack (the TaskStatusIndex
+        analog); the old per-call job scan was O(J) and dominated
+        apply_allocate at 100k tasks."""
+        return self._task_lookup.get(uid, (None, None))
 
     def _bind_task(self, task_uid: str, node_name: str,
                    gpu_index: int = -1) -> None:
@@ -514,26 +594,102 @@ class Session:
                 return
         self.binds.append(BindIntent(task_uid, job.uid, node_name, gpu_index))
 
-    def apply_allocate(self, result: AllocateResult) -> None:
-        task_node = np.asarray(result.task_node)
-        task_mode = np.asarray(result.task_mode)
-        task_gpu = np.asarray(result.task_gpu)
-        job_ready = np.asarray(result.job_ready)
-        # ready gangs' PodGroups move to Running (scheduler status updater,
-        # session.go:173 jobStatus)
-        from ..api import PodGroupPhase
-        for uid, ti in self.maps.task_index.items():
-            mode = int(task_mode[ti])
-            if mode == 0:
+    def _bulk_bind(self, bind_idx, task_node, task_gpu) -> None:
+        """Vectorized dispatch of many binds in one pass.
+
+        Per-task work shrinks to dict/status bookkeeping; the per-node and
+        per-job Resource arithmetic batches into one numpy segment-sum per
+        axis (the apply half of VERDICT round 3's 1 s cycle budget). The
+        per-task float64 exact-fit recheck that _bind_task performs moves
+        to the cache bind seam, where a boundary misfit fails the bind
+        into the resync path — the same place a rejected API bind lands.
+        """
+        from ..api import TaskStatus, gpu_request_of
+        from ..api.resource import Resource
+        resreq = np.asarray(self.snap.tasks.resreq, np.float64)
+        dims = self.maps.resource_names
+        uids = self.maps.task_uids
+        node_names = self.maps.node_names
+        N = len(node_names)
+        J = len(self.maps.job_uids)
+        tjob = np.asarray(self.snap.tasks.job)
+        node_sum = np.zeros((N, resreq.shape[1]))
+        job_sum = np.zeros((J, resreq.shape[1]))
+        np.add.at(node_sum, task_node[bind_idx], resreq[bind_idx])
+        np.add.at(job_sum, tjob[bind_idx], resreq[bind_idx])
+        touched_nodes = np.unique(task_node[bind_idx])
+        touched_jobs = np.unique(tjob[bind_idx])
+        # plain-python views: .tolist() python ints beat per-element numpy
+        # scalar casts ~10x in this loop
+        idx_l = bind_idx.tolist()
+        node_l = task_node[bind_idx].tolist()
+        gpu_l = task_gpu[bind_idx].tolist()
+        lookup = self._task_lookup
+        node_objs = self.cluster.nodes
+        binds_append = self.binds.append
+        binding = TaskStatus.BINDING
+        for k, ti in enumerate(idx_l):
+            job, task = lookup.get(uids[ti], (None, None))
+            if task is None:
                 continue
-            ji = int(np.asarray(self.snap.tasks.job)[ti])
-            node_name = self.maps.node_names[int(task_node[ti])]
-            if mode == MODE_ALLOCATED and bool(job_ready[ji]):
-                self._bind_task(uid, node_name, int(task_gpu[ti]))
-            else:
-                # held in-session only (pipelined or allocated-but-unready):
-                # no cache flush, like an uncommitted Statement
-                self.pipelined[uid] = node_name
+            job._unindex(task)
+            task.status = binding
+            job._index(task)
+            gi = gpu_l[k]
+            task.gpu_index = gi
+            nname = node_names[node_l[k]]
+            node = node_objs.get(nname)
+            if node is not None and task.uid not in node.tasks:
+                node.tasks[task.uid] = task
+                task.node_name = nname
+                if gi >= 0 and gpu_request_of(task.resreq) > 0:
+                    node.add_gpu_resource(task)
+            binds_append(BindIntent(task.uid, job.uid, nname, gi))
+        for ni in touched_nodes:
+            node = self.cluster.nodes.get(node_names[int(ni)])
+            if node is None:
+                continue
+            delta = Resource({d: float(node_sum[ni, k])
+                              for k, d in enumerate(dims)
+                              if node_sum[ni, k] > 0})
+            node.used.add(delta)
+            node.idle.sub_floored(delta)
+        job_uids = self.maps.job_uids
+        for ji in touched_jobs:
+            job = self.cluster.jobs.get(job_uids[int(ji)])
+            if job is None:
+                continue
+            job.allocated.add(Resource({d: float(job_sum[ji, k])
+                                        for k, d in enumerate(dims)
+                                        if job_sum[ji, k] > 0}))
+
+    def apply_allocate(self, result: AllocateResult, host=None) -> None:
+        if host is not None:
+            task_node, task_mode, task_gpu, job_ready, _ = host
+        else:
+            task_node = np.asarray(result.task_node)
+            task_mode = np.asarray(result.task_mode)
+            task_gpu = np.asarray(result.task_gpu)
+            job_ready = np.asarray(result.job_ready)
+        task_job = np.asarray(self.snap.tasks.job)
+        from ..api import PodGroupPhase
+        # touch only the decided tasks (numpy picks them; at 100k tasks the
+        # all-uids python sweep was the apply bottleneck)
+        uids = self.maps.task_uids
+        bind_mask = (task_mode == MODE_ALLOCATED) & job_ready[task_job]
+        bind_idx = np.nonzero(bind_mask)[0]
+        if len(bind_idx) >= 512:
+            self._bulk_bind(bind_idx, task_node, task_gpu)
+        else:
+            for ti in bind_idx:
+                self._bind_task(uids[ti],
+                                self.maps.node_names[int(task_node[ti])],
+                                int(task_gpu[ti]))
+        for ti in np.nonzero((task_mode != 0) & ~bind_mask)[0]:
+            # held in-session only (pipelined or allocated-but-unready):
+            # no cache flush, like an uncommitted Statement
+            self.pipelined[uids[ti]] = \
+                self.maps.node_names[int(task_node[ti])]
         # ready gangs' PodGroups move to Running (scheduler status updater,
         # session.go:173 jobStatus) — AFTER the bind loop so a job whose
         # bind degraded to a recorded error is not marked Running with

@@ -148,6 +148,10 @@ class AllocateExtras:
     #: unbindable claims block a task everywhere; a local-PV claim pins it
     task_volume_ok: jax.Array     # bool[T]
     task_volume_node: jax.Array   # i32[T] pinned node, -1 = any
+    #: k8s NodeAffinity preferred-terms score per predicate template
+    #: (weighted matched-term sums x nodeaffinity.weight,
+    #: nodeorder.go:255-266), host-computed — static over the cycle
+    template_na_score: jax.Array  # f32[P, N]
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -180,6 +184,8 @@ class AllocateExtras:
             pe_port0=np.zeros(1, np.int32),
             task_volume_ok=np.ones(T, bool),
             task_volume_node=np.full(T, -1, np.int32),
+            template_na_score=np.zeros(
+                (snap.template_rep.shape[0], N), np.float32),
         )
 
 
@@ -191,14 +197,15 @@ class AllocateResult:
     task_gpu: jax.Array        # i32[T] assigned GPU card or -1 (gpu.go:41-56)
 
     def packed_decisions(self) -> jax.Array:
-        """i32[3T + 2J]: all decision outputs in ONE array so the host pays a
+        """i32[3T + 3J]: all decision outputs in ONE array so the host pays a
         single device->host fetch per cycle (the axon tunnel charges ~tens of
         ms per readback regardless of size). Decode with
         :func:`unpack_decisions`."""
         return jnp.concatenate([
             self.task_node, self.task_mode, self.task_gpu,
             self.job_ready.astype(jnp.int32),
-            self.job_pipelined.astype(jnp.int32)])
+            self.job_pipelined.astype(jnp.int32),
+            self.job_attempted.astype(jnp.int32)])
     job_ready: jax.Array       # bool[J] gang became ready (binds emitted)
     job_pipelined: jax.Array   # bool[J] gang holds capacity, no binds
     job_attempted: jax.Array   # bool[J] job was popped this cycle
@@ -207,7 +214,8 @@ class AllocateResult:
 
 
 def unpack_decisions(packed, T: int, J: int):
-    """Inverse of AllocateResult.packed_decisions on a host numpy array."""
+    """Inverse of AllocateResult.packed_decisions on a host numpy array.
+    Accepts the pre-job_attempted 3T+2J layout too (attempted = None)."""
     import numpy as np
     packed = np.asarray(packed)
     task_node = packed[:T]
@@ -215,7 +223,12 @@ def unpack_decisions(packed, T: int, J: int):
     task_gpu = packed[2 * T:3 * T]
     job_ready = packed[3 * T:3 * T + J].astype(bool)
     job_pipelined = packed[3 * T + J:3 * T + 2 * J].astype(bool)
-    return task_node, task_mode, task_gpu, job_ready, job_pipelined
+    if packed.shape[0] >= 3 * T + 3 * J:
+        job_attempted = packed[3 * T + 2 * J:3 * T + 3 * J].astype(bool)
+    else:
+        job_attempted = None
+    return (task_node, task_mode, task_gpu, job_ready, job_pipelined,
+            job_attempted)
 
 
 def _score_fn(cfg: AllocateConfig, snap: SnapshotArrays, resreq, idle,
@@ -555,6 +568,20 @@ def make_allocate_cycle(cfg: AllocateConfig):
             min_avail = jobs.min_available[ji]
             ready0 = jobs.ready_num[ji] + st["job_alloc_count"][ji]
             cur = st["job_cursor"][ji]
+            # Exact re-pop fusion: a ready job yields so jobs with better
+            # keys get the next pop — but when every ordering key is STATIC
+            # over this job's own commits, the same job wins the very next
+            # pop, so the consecutive single-task pops collapse into one
+            # batched round with bit-identical decisions. Keys are static
+            # unless a drf/hdrf dynamic flag is on or the job's queue has a
+            # finite proportion deserved (its qshare moves with commits).
+            keys_static = not (cfg.drf_job_order or cfg.drf_ns_order
+                               or cfg.enable_hdrf)
+            if keys_static:
+                des_row = queue_deserved[jobs.queue[ji]]
+                can_batch = ~jnp.any(jnp.isfinite(des_row) & (des_row > 0))
+            else:
+                can_batch = jnp.bool_(False)
             slots = jnp.arange(M, dtype=jnp.int32)
             open_slot = (task_ids >= 0) & (slots >= cur)
             nb_row = open_slot & ~tasks.best_effort[jnp.maximum(task_ids, 0)]
@@ -581,9 +608,12 @@ def make_allocate_cycle(cfg: AllocateConfig):
                            & (~extras.node_locked
                               | (ji == extras.target_job))[None, :])
                 sfeas = (tmpl_static[tmpl_ids] & node_ok).astype(jnp.float32)
-                sscore = (tp_static[tmpl_ids]
-                          + extras.task_revocable[tcl][:, None]
-                          * extras.tdm_bonus[None, :])
+                sscore = tp_static[tmpl_ids]
+                # second static score ref keeps the scan path's f32 addition
+                # association: (dyn+taint) + (na + rev*bonus) + preference
+                sscore2 = (extras.template_na_score[tmpl_ids]
+                           + jnp.where(extras.task_revocable[tcl][:, None],
+                                       extras.tdm_bonus[None, :], 0.0))
                 resreq_t = tasks.resreq[tcl].T
                 gpu_req_row = tasks.gpu_request[tcl][None, :]
                 active_row = nb_row[None, :].astype(jnp.int32)
@@ -592,12 +622,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 meta_row = jnp.zeros((1, M), jnp.int32)
                 meta_row = meta_row.at[0, 0].set(ready0)
                 meta_row = meta_row.at[0, 1].set(min_avail)
+                meta_row = meta_row.at[0, 2].set(can_batch.astype(jnp.int32))
                 (node_s, mode_s, gpu_s, idle, pipe_extra, pods_extra,
                  gpu_extra) = placer(
                     resreq_t, gpu_req_row, active_row, pref_row, suffix_row,
-                    meta_row, sfeas, sscore, relmp_t, alloc_t, cnt_row,
-                    maxp_row, gidle0_t, st["idle"], st["pipe_extra"],
-                    st["pods_extra"], st["gpu_extra"])
+                    meta_row, sfeas, sscore, sscore2, relmp_t, alloc_t,
+                    cnt_row, maxp_row, gidle0_t, st["idle"],
+                    st["pipe_extra"], st["pods_extra"], st["gpu_extra"])
                 # write back only this round's placements — earlier pops of
                 # a yielded job already own their slots' decisions
                 placed_m = mode_s != MODE_NONE
@@ -616,7 +647,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     ready_aft = (ready0 + alloc_cum) >= min_avail
                 else:
                     ready_aft = jnp.ones(M, bool)
-                stop_evt = nb_row & placed_m & ready_aft & (suffix_after > 0)
+                stop_evt = (nb_row & placed_m & ready_aft
+                            & (suffix_after > 0) & ~can_batch)
                 broke_evt = nb_row & ~placed_m
                 first_stop = jnp.min(jnp.where(stop_evt, slots, M))
                 first_broke = jnp.min(jnp.where(broke_evt, slots, M))
@@ -687,13 +719,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 feas_now = shared & fit2[0]
                 feas_fut = shared & fit2[1]
                 score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
+                # static per-task extras in ONE addition so the pallas path
+                # can reproduce the exact f32 association: NodeAffinity
+                # preferred terms (nodeorder.go:255-266) + tdm's revocable
+                # steering bonus (tdm.go:170-191)
+                score += (extras.template_na_score[tasks.template[t]]
+                          + jnp.where(extras.task_revocable[t],
+                                      extras.tdm_bonus, 0.0))
                 # task-topology bucket preference (topology.go:344)
                 score += S.node_preference_score(extras.task_pref_node[t],
                                                  score.shape[0])
-                # tdm steers revocable tasks onto active-window revocable
-                # nodes (MaxNodeScore bonus, tdm.go:170-191)
-                score += jnp.where(extras.task_revocable[t],
-                                   extras.tdm_bonus, 0.0)
                 if cfg.enable_pod_affinity:
                     aff_feas, aff_score = _affinity_terms(
                         extras.affinity, aff_cnt, anti_cnt, t,
@@ -743,7 +778,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     ready_aft = (ready0 + n_alloc) >= min_avail
                 else:
                     ready_aft = jnp.bool_(True)
-                stopped |= active & placed & ready_aft & (suffix > 0)
+                stopped |= (active & placed & ready_aft & (suffix > 0)
+                            & ~can_batch)
                 broke |= active & ~placed
                 if cfg.enable_pod_affinity:
                     aff_cnt, anti_cnt = _affinity_place_update(

@@ -85,8 +85,9 @@ def _round_kernel(cfg, M, N, R, G,
                   # inputs
                   resreq_t_ref, gpu_req_ref, active_ref, pref_ref,
                   suffix_ref, meta_ref, sfeas_ref,
-                  sscore_ref, relmp_ref, alloc_t_ref, cnt_ref, maxp_ref,
-                  gidle0_ref, idle_ref, pipe_ref, podsx_ref, gpux_ref,
+                  sscore_ref, sscore2_ref, relmp_ref, alloc_t_ref, cnt_ref,
+                  maxp_ref, gidle0_ref, idle_ref, pipe_ref, podsx_ref,
+                  gpux_ref,
                   # outputs
                   node_ref, mode_ref, gpu_ref,
                   idle_o_ref, pipe_o_ref, podsx_o_ref, gpux_o_ref):
@@ -102,13 +103,15 @@ def _round_kernel(cfg, M, N, R, G,
     suffix_v = suffix_ref[:]        # [1, M] i32 queued tasks after slot m
     meta_v = meta_ref[:]            # [1, M] i32: [0]=ready0, [1]=min_avail
     sfeas = sfeas_ref[:]            # [M, N] f32 0/1
-    sscore = sscore_ref[:]          # [M, N]
+    sscore = sscore_ref[:]          # [M, N] taint-static
+    sscore2 = sscore2_ref[:]        # [M, N] node-affinity + tdm bonus
     iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
     iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
     iota_m = jax.lax.broadcasted_iota(jnp.int32, (1, M), 1)
     iota_m_col = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)
     ready0 = jnp.sum(jnp.where(iota_m == 0, meta_v, 0))
     min_avail = jnp.sum(jnp.where(iota_m == 1, meta_v, 0))
+    can_batch = jnp.sum(jnp.where(iota_m == 2, meta_v, 0)) > 0
 
     def body(m, carry):
         # mosaic has no dynamic lane/sublane indexing, so the per-task row
@@ -126,6 +129,7 @@ def _round_kernel(cfg, M, N, R, G,
         suffix = jnp.sum(jnp.where(iota_m == m, suffix_v, 0))       # scalar
         sfeas_m = jnp.sum(sfeas * sel_col, axis=0, keepdims=True)   # [1,N]
         sscore_m = jnp.sum(sscore * sel_col, axis=0, keepdims=True)
+        sscore2_m = jnp.sum(sscore2 * sel_col, axis=0, keepdims=True)
 
         future = jnp.maximum(idle + relmp - pipe, 0.0)
         pods_ok = (cnt + podsx) < maxp
@@ -139,9 +143,11 @@ def _round_kernel(cfg, M, N, R, G,
         feas_fut = shared & fit_fut
 
         # addition order matches allocate_scan exactly (float associativity):
-        # dyn terms (binpack..balanced), then taint-static, then preference
+        # dyn terms (binpack..balanced), then taint-static, then the
+        # combined nodeaffinity+tdm static term, then preference
         score = _dyn_score(cfg, idle, alloc_t, rr_col)
         score = score + sscore_m
+        score = score + sscore2_m
         score = score + jnp.where((pref >= 0) & (iota_n == pref),
                                   100.0, 0.0)
 
@@ -192,7 +198,7 @@ def _round_kernel(cfg, M, N, R, G,
             ready_aft = (ready0 + n_allocs) >= min_avail
         else:
             ready_aft = True
-        stopped = stopped | (placed & ready_aft & (suffix > 0))
+        stopped = stopped | (placed & ready_aft & (suffix > 0) & ~can_batch)
         broke = broke | (active & ~placed)
         return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
                 n_allocs, stopped, broke)
@@ -219,7 +225,8 @@ def make_round_placer(cfg, M: int, N: int, R: int, G: int,
 
     Returns place(resreq_t [R,M], gpu_req [1,M], active [1,M], pref [1,M],
     suffix [1,M] (queued tasks after each slot), meta [1,M] ([0]=ready
-    count, [1]=minAvailable), sfeas [M,N], sscore [M,N] (taint-static),
+    count, [1]=minAvailable, [2]=can-batch flag), sfeas [M,N],
+    sscore [M,N] (taint-static), sscore2 [M,N] (nodeaffinity+tdm static),
     relmp [R,N], alloc_t [R,N], cnt [1,N], maxp [1,N], gidle0 [G,N],
     idle [R,N], pipe [R,N], podsx [1,N], gpux [G,N])
     -> (node [M], mode [M], gpu [M], idle', pipe', podsx', gpux').
@@ -228,7 +235,8 @@ def make_round_placer(cfg, M: int, N: int, R: int, G: int,
     f32 = jnp.float32
 
     def place(resreq_t, gpu_req, active, pref, suffix, meta, sfeas, sscore,
-              relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx, gpux):
+              sscore2, relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx,
+              gpux):
         outs = pl.pallas_call(
             kernel,
             out_shape=(
@@ -242,7 +250,8 @@ def make_round_placer(cfg, M: int, N: int, R: int, G: int,
             ),
             interpret=interpret,
         )(resreq_t, gpu_req, active, pref, suffix, meta, sfeas, sscore,
-          relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx, gpux)
+          sscore2, relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx,
+          gpux)
         node, mode, gpu, idle2, pipe2, podsx2, gpux2 = outs
         return (node[0], mode[0], gpu[0], idle2, pipe2, podsx2, gpux2)
 
@@ -252,5 +261,5 @@ def make_round_placer(cfg, M: int, N: int, R: int, G: int,
 def vmem_estimate_bytes(M: int, N: int, R: int, G: int) -> int:
     """Rough VMEM footprint of the kernel's live values."""
     per_n = (4 * R * 6 + 4 * G * 3 + 4 * 4) * N     # [R,N]/[G,N]/[1,N] f32
-    per_mn = (4 + 4) * M * N                        # sfeas + sscore
+    per_mn = (4 + 4 + 4) * M * N                    # sfeas + sscore + sscore2
     return per_n + per_mn

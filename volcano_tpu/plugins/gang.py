@@ -20,15 +20,6 @@ from .base import Plugin
 class GangPlugin(Plugin):
     name = "gang"
 
-    def job_evictable_surplus(self, ssn) -> np.ndarray:
-        """i32[J]: how many occupying tasks each job can lose before dropping
-        below minAvailable — the kernel form of gang's Preemptable veto
-        (victims rejected once occupied - victims < MinAvailable)."""
-        jobs = ssn.snap.jobs
-        return np.maximum(
-            np.asarray(jobs.ready_num) - np.asarray(jobs.min_available), 0
-        ).astype(np.int32)
-
     def on_session_close(self, ssn) -> None:
         """Write Scheduled/Unschedulable conditions onto jobs that were
         attempted this cycle (gang.go:158-216)."""

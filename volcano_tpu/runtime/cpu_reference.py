@@ -275,6 +275,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     block_all = np.asarray(extras.block_all)
     task_revocable = np.asarray(extras.task_revocable)
     tdm_bonus = np.asarray(extras.tdm_bonus)
+    template_na = np.asarray(extras.template_na_score)
     task_ports_a = np.asarray(extras.task_ports)
     node_ports_a = np.asarray(extras.node_ports)
     vol_ok = np.asarray(extras.task_volume_ok)
@@ -329,6 +330,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     t_tol_hash = np.array(tasks.tol_hash)
     t_tol_effect = np.array(tasks.tol_effect)
     t_tol_mode = np.array(tasks.tol_mode)
+    t_template = np.array(tasks.template)
     t_preemptable = np.array(tasks.preemptable)
     t_gpu_req = np.array(tasks.gpu_request, dtype=np.float64)
     nodes_np = _as_np(nodes)
@@ -398,6 +400,14 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
         saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy(),
                  gpu_extra.copy())
         saved_ports = list(ports_placed)
+        # exact re-pop fusion (see ops/allocate_scan.py body): with fully
+        # static ordering keys the same ready job wins every following pop,
+        # so the single-task yields batch into one pass
+        keys_static = not (cfg.drf_job_order or cfg.drf_ns_order
+                           or cfg.enable_hdrf)
+        des_row = queue_deserved[jqueue[ji]]
+        can_batch = keys_static and not bool(
+            np.any(np.isfinite(des_row) & (des_row > 0)))
         if aff_st is not None:
             saved_aff = (aff_st["aff_cnt"].copy(), aff_st["anti_cnt"].copy())
         placed: List[int] = []
@@ -439,10 +449,11 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                                                idle, pods_extra,
                                                greq, gpu_extra)
             score = _score_one(cfg, nodes_np, req, idle, th, te, tm)
+            score = score + (template_na[t_template[t]]
+                             + (tdm_bonus if task_revocable[t]
+                                else np.float32(0.0)))
             if task_pref_node[t] >= 0:
                 score = score + 100.0 * (np.arange(len(score)) == task_pref_node[t])
-            if task_revocable[t]:
-                score = score + tdm_bonus
             if aff_st is not None:
                 aff_feas, aff_score = _affinity_one(aff_st, t, valid_sched)
                 feas_now &= aff_feas
@@ -502,7 +513,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                          or (ready0_dyn + n_alloc) >= jmin[ji])
             remaining = any(table[ji, s] >= 0 and not best_effort[table[ji, s]]
                             for s in range(slot, M))
-            if ready_aft and remaining:
+            if ready_aft and remaining and not can_batch:
                 stopped = True
                 break
         job_cursor[ji] = slot

@@ -61,8 +61,11 @@ class VolcanoSystem:
                                     target_name=name))
 
     # ------------------------------------------------------------- engine
-    def reconcile(self, rounds: int = 4) -> None:
-        """Drain controller queues (events cascade, so a few sweeps)."""
+    def reconcile(self, rounds: int = 256) -> None:
+        """Drain controller queues to empty (events cascade across
+        controllers, so sweep until a full pass finds every queue empty).
+        ``rounds`` is only a runaway-cascade backstop; hitting it warns
+        instead of silently stalling mid-cascade."""
         for _ in range(rounds):
             busy = False
             for c in self.controllers:
@@ -70,7 +73,11 @@ class VolcanoSystem:
                 c.process_all()
                 busy = busy or before > 0
             if not busy:
-                break
+                return
+        import warnings
+        warnings.warn(
+            f"reconcile: controller queues still busy after {rounds} sweeps "
+            "(event cascade did not converge)", stacklevel=2)
 
     @property
     def cycles(self) -> int:
