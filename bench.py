@@ -232,6 +232,51 @@ tiers:
         full_session_ms = (time.time() - t0) * 1000
         session_binds = len(ssn.binds)
 
+    # ---- topology-aware binpack with affinity (BASELINE.json config 5) ---
+    # 10k nodes with zone/rack labels, required + preferred inter-pod
+    # (anti-)affinity terms; runs the XLA scan path (the fused placer
+    # carries no affinity state).
+    affinity_ms = affinity_placed = None
+    if not (force_cpu or os.environ.get("BENCH_SKIP_AFFINITY")):
+        import dataclasses as _dc
+        from __graft_entry__ import _synthetic_cluster
+        from volcano_tpu.api import PodAffinityTerm
+        from volcano_tpu.arrays import pack as _pack
+        from volcano_tpu.arrays.affinity import build_affinity
+        from volcano_tpu.ops.allocate_scan import AllocateExtras as _AE
+        rng = np.random.RandomState(0)
+        aci = _synthetic_cluster(
+            n_nodes=int(os.environ.get("BENCH_AFF_NODES", 10000)),
+            n_jobs=int(os.environ.get("BENCH_AFF_JOBS", 2500)),
+            tasks_per_job=8)
+        apps = [f"app{i}" for i in range(8)]
+        for i, node in enumerate(aci.nodes.values()):
+            node.labels["zone"] = f"z{i % 16}"
+            node.labels["rack"] = f"r{i % 512}"
+        for j, job in enumerate(aci.jobs.values()):
+            app = apps[j % len(apps)]
+            for t in job.tasks.values():
+                t.labels["app"] = app
+                r = rng.rand()
+                if r < 0.10:
+                    t.pod_anti_affinity = [PodAffinityTerm(
+                        topology_key="rack", match_labels={"app": app})]
+                elif r < 0.20:
+                    t.pod_affinity_preferred = [PodAffinityTerm(
+                        topology_key="zone", match_labels={"app": app},
+                        weight=10)]
+        asnap, amaps = _pack(aci)
+        aN = asnap.nodes.idle.shape[0]
+        aT = asnap.tasks.resreq.shape[0]
+        aextras = _dc.replace(
+            _AE.neutral(asnap),
+            affinity=build_affinity(aci, amaps, aN, aT))
+        acfg = _dc.replace(cfg, enable_pod_affinity=True, use_pallas=False)
+        afn = jax.jit(make_allocate_cycle(acfg))
+        aresult, affinity_ms, _ = _time_device(afn, asnap, aextras,
+                                               min(reps, 2))
+        affinity_placed = int(np.asarray(aresult.task_mode > 0).sum())
+
     # ---- live sub-scale decision-equality + speedup check ----------------
     equal_sub = sub_speedup = stpu_ms = scpu_ms = None
     if not os.environ.get("BENCH_SKIP_CHECK"):
@@ -267,6 +312,9 @@ tiers:
                             if full_session_ms is not None else None),
         "session_binds": (session_binds
                           if full_session_ms is not None else None),
+        "affinity_cycle_ms": (round(affinity_ms, 1)
+                              if affinity_ms is not None else None),
+        "affinity_placed": affinity_placed,
         "decisions_equal_cpu_full_scale": equal_full,
         "decisions_equal_cpu_1024n_10240t": equal_sub,
         "speedup_1024n_10240t": sub_speedup,

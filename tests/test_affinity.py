@@ -381,3 +381,54 @@ class TestSessionIntegration:
 
     def test_affinity_arrays_neutral_has_no_terms(self):
         assert not AffinityArrays.neutral(8, 8).has_terms
+
+
+class TestEquivalenceAtScale:
+    @pytest.mark.parametrize("seed", [11, 13])
+    def test_device_matches_cpu_reference_256_nodes(self, seed):
+        """Randomized affinity+anti-affinity parity at 256 nodes with
+        zone/rack topology (BASELINE.json config 5 shape)."""
+        rng = np.random.default_rng(seed)
+        zones = tuple(f"z{i}" for i in range(8))
+        ci = make_zone_cluster(n_nodes=256, zones=zones, cpu="4")
+        for i, n in enumerate(ci.nodes.values()):
+            n.labels["rack"] = f"r{i % 32}"
+        apps = [f"app{i}" for i in range(5)]
+        for j in range(24):
+            job = JobInfo(f"default/j{j}", min_available=1, queue="default",
+                          pod_group_phase=PodGroupPhase.INQUEUE,
+                          creation_timestamp=float(j))
+            for i in range(int(rng.integers(1, 4))):
+                app = apps[int(rng.integers(len(apps)))]
+                t = task(f"j{j}-t{i}", labels={"app": app})
+                r = rng.random()
+                if r < 0.25:
+                    t.pod_anti_affinity = [PodAffinityTerm(
+                        topology_key="rack", match_labels={"app": app})]
+                elif r < 0.5:
+                    t.pod_affinity = [PodAffinityTerm(
+                        topology_key="zone", match_labels={"app": app})]
+                elif r < 0.75:
+                    t.pod_affinity_preferred = [PodAffinityTerm(
+                        topology_key="zone",
+                        match_labels={"app": apps[0]},
+                        weight=int(rng.integers(1, 20)))]
+                job.add_task(t)
+            ci.add_job(job)
+        # some running pods seed the static counts
+        nodes = list(ci.nodes)
+        seedjob = JobInfo("default/seed", min_available=1, queue="default",
+                          pod_group_phase=PodGroupPhase.INQUEUE)
+        for i in range(12):
+            t = task(f"s-{i}", labels={"app": apps[int(rng.integers(3))]},
+                     status=TaskStatus.RUNNING)
+            seedjob.add_task(t)
+            ci.nodes[nodes[int(rng.integers(len(nodes)))]].add_task(t)
+        ci.add_job(seedjob)
+        res, _, maps, (snap, extras) = run_cycle(ci)
+        cpu = allocate_cpu(snap, extras, CFG)
+        np.testing.assert_array_equal(np.asarray(res.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(res.task_mode),
+                                      cpu["task_mode"])
+        assert int((np.asarray(res.task_mode) > 0).sum()) > 10

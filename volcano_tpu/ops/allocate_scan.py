@@ -321,11 +321,16 @@ def _affinity_terms(aff: AffinityArrays, aff_cnt, anti_cnt, t, valid_nodes):
                                 jnp.maximum(dom_p, 0), axis=1)
     raw = jnp.sum(jnp.where(pact[:, None] & (dom_p >= 0),
                             pw[:, None] * cnt_p, 0.0), axis=0)
-    # symmetric preferred from snapshot pods (static over the cycle)
+    # symmetric preferred from snapshot pods (static over the cycle):
+    # contract over SEL first — combined[DM] = mcol @ static_pref — then
+    # gather per (TK, N); the old einsum materialized [SEL, TK, N], which
+    # at 10k nodes dominated the affinity cycle's memory traffic. The
+    # reordering is exact: the summands are integer weight-counts, exact
+    # in f32, so the sum is associativity-independent.
     mcol = aff.task_match[:, t].astype(jnp.float32)            # [SEL]
-    sp_at = aff.static_pref[:, jnp.maximum(doms, 0)]           # [SEL, TK, N]
-    sp_at = jnp.where((doms >= 0)[None], sp_at, 0.0)
-    raw = raw + jnp.einsum("s,skn->n", mcol, sp_at)
+    combined = mcol @ aff.static_pref                          # [DM]
+    contrib = jnp.where(doms >= 0, combined[jnp.maximum(doms, 0)], 0.0)
+    raw = raw + jnp.sum(contrib, axis=0)                       # [N]
 
     # min-max normalize over schedulable nodes -> 0..100 (k8s NormalizeScore)
     big = jnp.float32(3.4e38)
