@@ -1,0 +1,115 @@
+"""Webhook manager HTTP surface: AdmissionReview round trips + registration.
+
+Reference: cmd/webhook-manager/app/server.go:72-150 (HTTP serving of every
+admission path) and its self-registration of webhook configurations.
+"""
+
+import yaml
+
+from volcano_tpu.webhooks.server import (WebhookManager, apply_patch,
+                                         submit_review)
+
+JOB_MANIFEST = yaml.safe_load("""
+apiVersion: batch.volcano.sh/v1alpha1
+kind: Job
+metadata:
+  name: mpi-e2e
+  namespace: default
+spec:
+  minAvailable: 0
+  tasks:
+    - replicas: 2
+      template:
+        spec:
+          containers:
+            - name: worker
+              resources:
+                requests:
+                  cpu: "1"
+""")
+
+
+class TestWebhookHTTP:
+    def setup_method(self):
+        self.mgr = WebhookManager()
+        self.mgr.serve_in_thread()
+
+    def teardown_method(self):
+        self.mgr.shutdown()
+
+    def test_job_submission_through_http(self):
+        """The full admission flow a kube-apiserver performs: mutate (apply
+        the returned JSONPatch), then validate the patched object."""
+        out = submit_review(self.mgr.url("/jobs/mutate"), "CREATE",
+                            JOB_MANIFEST)
+        assert out["response"]["allowed"]
+        patched = apply_patch(JOB_MANIFEST, out)
+        # mutate_job defaults (mutate_job.go:49-200)
+        assert patched["spec"]["queue"] == "default"
+        assert patched["spec"]["schedulerName"] == "volcano"
+        assert patched["spec"]["maxRetry"] == 3
+        assert patched["spec"]["minAvailable"] == 2
+        assert patched["spec"]["tasks"][0]["name"] == "default0"
+        out = submit_review(self.mgr.url("/jobs/validate"), "CREATE", patched)
+        assert out["response"]["allowed"], out
+
+    def test_invalid_job_denied_with_message(self):
+        bad = dict(JOB_MANIFEST, spec=dict(JOB_MANIFEST["spec"],
+                                           minAvailable=5))
+        out = submit_review(self.mgr.url("/jobs/validate"), "CREATE", bad)
+        assert not out["response"]["allowed"]
+        assert "minAvailable" in out["response"]["status"]["message"]
+
+    def test_job_update_immutability(self):
+        old = apply_patch(JOB_MANIFEST,
+                          submit_review(self.mgr.url("/jobs/mutate"),
+                                        "CREATE", JOB_MANIFEST))
+        new = apply_patch(old, {"response": {}})
+        new["spec"]["queue"] = "other"
+        out = submit_review(self.mgr.url("/jobs/validate-update"), "UPDATE",
+                            new, old=old)
+        assert not out["response"]["allowed"]
+        assert "queue" in out["response"]["status"]["message"]
+
+    def test_queue_mutate_and_delete_protection(self):
+        queue = {"apiVersion": "scheduling.volcano.sh/v1beta1",
+                 "kind": "Queue",
+                 "metadata": {"name": "q1"},
+                 "spec": {}}
+        out = submit_review(self.mgr.url("/queues/mutate"), "CREATE", queue)
+        patched = apply_patch(queue, out)
+        assert patched["spec"]["weight"] == 1
+        assert patched["status"]["state"] == "Open"
+        # default queue can never be deleted (validate_queue.go delete path)
+        default_q = {"metadata": {"name": "default"}, "spec": {"weight": 1}}
+        out = submit_review(self.mgr.url("/queues/validate-delete"),
+                            "DELETE", None, old=default_q)
+        assert not out["response"]["allowed"]
+
+    def test_malformed_object_denied_not_crash(self):
+        out = submit_review(self.mgr.url("/jobs/validate"), "CREATE",
+                            {"spec": {"tasks": "not-a-list"}})
+        assert not out["response"]["allowed"]
+        # and the server keeps serving
+        out = submit_review(self.mgr.url("/jobs/mutate"), "CREATE",
+                            JOB_MANIFEST)
+        assert out["response"]["allowed"]
+
+    def test_unknown_path_denied(self):
+        out = submit_review(self.mgr.url("/nope"), "CREATE", {})
+        assert not out["response"]["allowed"]
+
+    def test_self_registration_records(self):
+        class Store:
+            store = {}
+        api = Store()
+        regs = self.mgr.register_webhooks()
+        self.mgr.apiserver = api
+        self.mgr.register_webhooks()
+        kinds = {r["kind"] for r in regs}
+        assert kinds == {"MutatingWebhookConfiguration",
+                         "ValidatingWebhookConfiguration"}
+        paths = {r["webhooks"][0]["clientConfig"]["url"].split(
+            str(self.mgr.address[1]))[-1] for r in regs}
+        assert "/jobs/validate" in paths and "/jobs/mutate" in paths
+        assert len(api.store["webhookconfigurations"]) == len(regs)

@@ -1,0 +1,303 @@
+"""Webhook manager: AdmissionReview-over-HTTP serving + self-registration.
+
+Reference: cmd/webhook-manager/app/server.go:72-150 — every registered
+AdmissionService path becomes an HTTP handler consuming
+``admission.k8s.io/v1 AdmissionReview`` JSON and answering with an
+AdmissionResponse (allowed / status.message / JSONPatch for mutations), and
+the manager self-registers Validating/MutatingWebhookConfiguration objects
+for its paths (registerWebhookConfig, cmd/webhook-manager/app/util.go).
+
+The in-process interception (webhooks/router.py) stays the fast path for
+the embedded runtime; this module is the NETWORK surface a real API server
+(or the e2e tests) talks to. TLS is the deployment's concern (the
+reference reads cert files from flags); the HTTP handler itself is
+transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import QueueInfo, QueueState
+from .jobs import AdmissionError
+from .router import get_service, registered_paths
+
+#: path -> (kind, operations) for the self-registration records, mirroring
+#: the reference's per-service webhook rules
+_MUTATING = {"/jobs/mutate": ("jobs", ["CREATE"]),
+             "/podgroups/mutate": ("podgroups", ["CREATE"]),
+             "/queues/mutate": ("queues", ["CREATE"])}
+_VALIDATING = {"/jobs/validate": ("jobs", ["CREATE"]),
+               "/jobs/validate-update": ("jobs", ["UPDATE"]),
+               "/queues/validate": ("queues", ["CREATE", "UPDATE"]),
+               "/queues/validate-delete": ("queues", ["DELETE"]),
+               "/pods/validate": ("pods", ["CREATE"])}
+
+
+def _queue_from_manifest(data: Dict) -> QueueInfo:
+    meta = data.get("metadata", {}) or {}
+    spec = data.get("spec", {}) or {}
+    state = (data.get("status", {}) or {}).get("state", "")
+    q = QueueInfo(
+        name=meta.get("name", ""),
+        weight=int(spec.get("weight", 0)),
+        reclaimable=bool(spec.get("reclaimable", True)),
+        annotations=dict(meta.get("annotations", {}) or {}))
+    q.state = QueueState(state) if state else ""
+    return q
+
+
+def _queue_to_patch(original: Dict, q: QueueInfo) -> List[Dict]:
+    ops = []
+    spec = original.get("spec", {}) or {}
+    if int(spec.get("weight", 0)) != q.weight:
+        ops.append({"op": "add" if "weight" not in spec else "replace",
+                    "path": "/spec/weight", "value": q.weight})
+    state = (original.get("status", {}) or {}).get("state", "")
+    if q.state and state != str(q.state.value):
+        ops.append({"op": "add", "path": "/status",
+                    "value": {"state": q.state.value}})
+    anns = (original.get("metadata", {}) or {}).get("annotations", {}) or {}
+    if q.annotations != anns:
+        ops.append({"op": "add", "path": "/metadata/annotations",
+                    "value": q.annotations})
+    return ops
+
+
+def _job_to_patch(original: Dict, job) -> List[Dict]:
+    """JSONPatch for the fields mutate_job defaults (mutate_job.go:49-200)."""
+    ops = []
+    spec = original.get("spec", {}) or {}
+
+    def spec_field(key, value):
+        ops.append({"op": "add" if key not in spec else "replace",
+                    "path": f"/spec/{key}", "value": value})
+
+    if spec.get("queue", "") != job.queue:
+        spec_field("queue", job.queue)
+    if spec.get("schedulerName", "") != job.scheduler_name:
+        spec_field("schedulerName", job.scheduler_name)
+    if int(spec.get("maxRetry", 0)) != job.max_retry:
+        spec_field("maxRetry", job.max_retry)
+    if int(spec.get("minAvailable", 0)) != job.min_available:
+        spec_field("minAvailable", job.min_available)
+    raw_tasks = spec.get("tasks", []) or []
+    for i, (raw, task) in enumerate(zip(raw_tasks, job.tasks)):
+        if raw.get("name", "") != task.name:
+            ops.append({"op": "add", "path": f"/spec/tasks/{i}/name",
+                        "value": task.name})
+        if raw.get("minAvailable") is None and task.min_available is not None:
+            ops.append({"op": "add", "path": f"/spec/tasks/{i}/minAvailable",
+                        "value": task.min_available})
+    return ops
+
+
+class _PodShim:
+    def __init__(self, data: Dict):
+        spec = data.get("spec", {}) or {}
+        self.scheduler_name = spec.get("schedulerName", "")
+        self.annotations = dict(
+            (data.get("metadata", {}) or {}).get("annotations", {}) or {})
+
+
+class _PGShim:
+    def __init__(self, data: Dict):
+        self.queue = (data.get("spec", {}) or {}).get("queue", "")
+
+
+def handle_review(path: str, review: Dict) -> Dict:
+    """AdmissionReview request dict -> AdmissionReview response dict.
+
+    The dispatch half of server.go:106-120: decode the embedded object for
+    the path's service, run it, translate AdmissionError -> denied and
+    mutations -> a base64 JSONPatch.
+    """
+    req = review.get("request", {}) or {}
+    uid = req.get("uid", "")
+    obj = req.get("object") or {}
+    old = req.get("oldObject") or {}
+
+    def respond(allowed: bool, message: str = "",
+                patch: Optional[List[Dict]] = None) -> Dict:
+        response: Dict = {"uid": uid, "allowed": allowed}
+        if message:
+            response["status"] = {"message": message}
+        if patch:
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(
+                json.dumps(patch).encode()).decode()
+        return {"apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview", "response": response}
+
+    try:
+        service = get_service(path)
+    except KeyError:
+        return respond(False, f"no admission service at {path!r}")
+
+    try:
+        if path in ("/jobs/validate", "/jobs/mutate"):
+            from ..cli.loader import job_from_dict
+            job = job_from_dict(obj)
+            if path == "/jobs/validate":
+                service(job)
+                return respond(True)
+            mutated = service(job)
+            return respond(True, patch=_job_to_patch(obj, mutated))
+        if path == "/jobs/validate-update":
+            from ..cli.loader import job_from_dict
+            service(job_from_dict(old), job_from_dict(obj))
+            return respond(True)
+        if path in ("/queues/validate", "/queues/mutate"):
+            q = _queue_from_manifest(obj)
+            if path == "/queues/validate":
+                service(q)
+                return respond(True)
+            mutated = service(copy.deepcopy(q))
+            return respond(True, patch=_queue_to_patch(obj, mutated))
+        if path == "/queues/validate-delete":
+            service(_queue_from_manifest(old or obj))
+            return respond(True)
+        if path == "/podgroups/mutate":
+            pg = service(_PGShim(obj))
+            patch = []
+            if pg.queue != ((obj.get("spec", {}) or {}).get("queue", "")):
+                patch.append({"op": "add", "path": "/spec/queue",
+                              "value": pg.queue})
+            return respond(True, patch=patch)
+        if path == "/pods/validate":
+            service(_PodShim(obj))
+            return respond(True)
+        # custom service registered via router.register: treat as a
+        # validator over the raw object dict
+        service(obj)
+        return respond(True)
+    except AdmissionError as e:
+        return respond(False, str(e))
+    except Exception as e:  # malformed object: deny, keep serving
+        return respond(False, f"{type(e).__name__}: {e}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_POST(self):  # noqa: N802 (http.server API)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            review = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            self.send_response(400)
+            self.end_headers()
+            return
+        out = json.dumps(handle_review(self.path, review)).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, fmt, *args):  # quiet test output
+        pass
+
+
+class WebhookManager:
+    """The vc-webhook-manager binary: serve + self-register.
+
+    ``apiserver`` (runtime/apiserver.APIServer-like, optional) receives the
+    webhook configuration objects the way registerWebhookConfig writes them
+    to the cluster.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 apiserver=None):
+        self.server = ThreadingHTTPServer((host, port), _Handler)
+        self.apiserver = apiserver
+        self.registrations: List[Dict] = []
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server.server_address[:2]
+
+    def url(self, path: str) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}{path}"
+
+    def register_webhooks(self) -> List[Dict]:
+        """Build (and optionally store) the self-registration records
+        (registerWebhookConfig): one webhook entry per served path."""
+        self.registrations = []
+        for kind, table in (("MutatingWebhookConfiguration", _MUTATING),
+                            ("ValidatingWebhookConfiguration", _VALIDATING)):
+            for path in registered_paths():
+                if path not in table:
+                    continue
+                resource, operations = table[path]
+                self.registrations.append({
+                    "apiVersion": "admissionregistration.k8s.io/v1",
+                    "kind": kind,
+                    "metadata": {"name": "volcano-admission-service"
+                                         + path.replace("/", "-")},
+                    "webhooks": [{
+                        "name": path.strip("/").replace("/", ".")
+                                + ".volcano.sh",
+                        "clientConfig": {"url": self.url(path)},
+                        "rules": [{"operations": operations,
+                                   "resources": [resource]}],
+                        "failurePolicy": "Fail",
+                    }],
+                })
+        if self.apiserver is not None and hasattr(self.apiserver, "store"):
+            for reg in self.registrations:
+                self.apiserver.store.setdefault(
+                    "webhookconfigurations", {})[
+                        reg["metadata"]["name"]] = reg
+        return self.registrations
+
+    def serve_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.server.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def shutdown(self) -> None:
+        self.server.shutdown()
+
+
+def submit_review(url: str, operation: str, obj: Optional[Dict] = None,
+                  old: Optional[Dict] = None, uid: str = "test-uid") -> Dict:
+    """Client helper: POST an AdmissionReview and decode the response."""
+    import urllib.request
+    review = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+              "request": {"uid": uid, "operation": operation,
+                          "object": obj, "oldObject": old}}
+    data = json.dumps(review).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def apply_patch(obj: Dict, response: Dict) -> Dict:
+    """Apply a JSONPatch from an AdmissionResponse (add/replace only — the
+    subset the mutators emit) to a manifest copy."""
+    out = copy.deepcopy(obj)
+    patch_b64 = response.get("response", {}).get("patch")
+    if not patch_b64:
+        return out
+    for op in json.loads(base64.b64decode(patch_b64)):
+        assert op["op"] in ("add", "replace"), op
+        parts = [p for p in op["path"].split("/") if p]
+        cur = out
+        for p in parts[:-1]:
+            key = int(p) if isinstance(cur, list) else p
+            if isinstance(cur, dict) and key not in cur:
+                cur[key] = {}
+            cur = cur[key]
+        last = parts[-1]
+        if isinstance(cur, list):
+            cur[int(last)] = op["value"]
+        else:
+            cur[last] = op["value"]
+    return out
