@@ -1,30 +1,28 @@
-"""Inter-pod affinity tensor encoding — the host-side half of the
-InterPodAffinity predicate and batch scorer.
+"""Inter-pod affinity encoding — node-space, gather-free.
 
-The reference wraps the k8s InterPodAffinity plugin for both filtering
-(pkg/scheduler/plugins/predicates/predicates.go:196-200, dispatch 261-273)
-and batch node scoring (pkg/scheduler/plugins/nodeorder/nodeorder.go:273-306).
-Those are pointer-chasing pod-list walks; the TPU re-design encodes the same
-semantics as dense tensors (SURVEY.md section 7 hard part 3):
+The array program of the k8s InterPodAffinity plugin the reference wraps
+(pkg/scheduler/plugins/predicates/predicates.go:196-200 filter dispatch,
+261-273; pkg/scheduler/plugins/nodeorder/nodeorder.go:273-306 batch
+scorer). Terms select existing pods by label selector and constrain
+placement relative to the topology DOMAIN (nodes sharing a label value)
+those pods occupy.
 
-- a *topology domain* is a (topology_key, node label value) pair; every node
-  maps to at most one domain per key (``node_domain[TK, N]``);
-- every distinct term selector becomes a row of a host-evaluated match
-  matrix ``task_match[SEL, T]`` (full k8s selector semantics — expressions,
-  namespaces — run in Python once per cycle, so the kernel only does
-  integer gathers);
-- cluster state becomes *counts*: ``cnt0[SEL, DM]`` = matching pods per
-  domain, ``anti_cnt0[ETA, DM]`` = placed pods carrying a given required
-  anti-affinity term per domain. The allocate kernel carries both as scan
-  state so in-cycle placements constrain later tasks exactly like the
-  reference's event-handler-updated pod lister (predicates.go:116-160),
-  and gang discard rolls them back.
+Encoding design (TPU-first): all live state is DENORMALIZED to the node
+axis. Counts live as ``cnt[SK, N]`` — "matching pods within node n's
+domain" — rather than per-domain cells, so the hot path is pure vector
+compares/adds over [.., N] rows with NO per-element gathers (TPU gathers
+serialize to ~1 element/cycle and dominated the per-task cost in the
+domain-indexed encoding). A placement update adds a domain-membership
+mask row (``sk_domain == sk_domain[:, node]``) instead of scattering into
+a domain cell. SK indexes the distinct (selector, topology-key) pairs the
+terms actually use; column N of ``cnt`` carries the cluster-wide matching
+count on keyed nodes (the k8s first-pod-escape test).
 
-Scoring: preferred terms of the incoming task are dynamic (count gathers
-against the live ``cnt`` state); preferred terms of existing pods toward
-the incoming task are folded into the static ``static_pref[SEL, DM]`` map.
-In-cycle placements therefore do not update the symmetric half — a
-documented divergence (the reference recomputes it per session only too).
+The incoming pod's PREFERRED terms read the same live counts; symmetric
+preferred contributions of already-placed pods toward the incoming task
+are folded into the static ``static_pref[SEL, N]`` map. In-cycle
+placements therefore do not update the symmetric half — a documented
+divergence (the reference recomputes it per session only too).
 """
 
 from __future__ import annotations
@@ -42,52 +40,54 @@ from .schema import IndexMaps, _register, bucket
 @_register
 @dataclass
 class AffinityArrays:
-    """Device-side inter-pod affinity encoding. Axis legend: TK topology
-    keys, DM domains, SEL selectors, ETA required anti-affinity terms,
-    A/B/PP per-task term slots."""
+    """Device-side inter-pod affinity encoding. Axis legend: SK distinct
+    (selector, topology-key) pairs, SEL selectors, ETA required
+    anti-affinity terms, A/B/PP per-task term slots, N nodes."""
 
-    node_domain: jax.Array    # i32[TK, N] domain id of node per key, -1 none
-    domain_key: jax.Array     # i32[DM] key index of each domain, -1 pad
+    sk_sel: jax.Array         # i32[SK] selector of each pair, -1 pad
+    sk_domain: jax.Array      # i32[SK, N] node's domain id under the
+    #                           pair's key, -1 = node lacks the key
+    cnt0: jax.Array           # f32[SK, N+1] snapshot matching-pod counts in
+    #                           node n's domain; column N = cluster total on
+    #                           keyed nodes (first-pod escape)
     task_match: jax.Array     # bool[SEL, T] selector matches task's labels
-    cnt0: jax.Array           # f32[SEL, DM] snapshot matching-pod counts
-    task_aff_sel: jax.Array   # i32[T, A] required affinity selector, -1 pad
-    task_aff_key: jax.Array   # i32[T, A] required affinity topo key
-    task_anti_term: jax.Array  # i32[T, B] own required anti term (eta), -1 pad
+    task_aff_sk: jax.Array    # i32[T, A] required affinity pair, -1 pad
+    task_anti_term: jax.Array  # i32[T, B] own required anti term (eta), -1
     eta_sel: jax.Array        # i32[ETA] anti term selector, -1 pad
-    eta_key: jax.Array        # i32[ETA] anti term topo key
-    anti_cnt0: jax.Array      # f32[ETA, DM] snapshot pods carrying term
-    task_pref_sel: jax.Array  # i32[T, PP] preferred term selector, -1 pad
-    task_pref_key: jax.Array  # i32[T, PP]
+    eta_sk: jax.Array         # i32[ETA] anti term (sel,key) pair id
+    eta_domain: jax.Array     # i32[ETA, N] node's domain under the term's key
+    anti_cnt0: jax.Array      # f32[ETA, N] pods carrying the term in node
+    #                           n's domain
+    task_pref_sk: jax.Array   # i32[T, PP] preferred term pair, -1 pad
     task_pref_w: jax.Array    # f32[T, PP] term weight (negative = anti)
-    static_pref: jax.Array    # f32[SEL, DM] symmetric preferred score map
+    static_pref: jax.Array    # f32[SEL, N] symmetric preferred score map
 
     @property
     def has_terms(self) -> bool:
         """Whether any task carries any term (host-side, pre-trace)."""
         return bool(
-            np.any(np.asarray(self.task_aff_sel) >= 0)
+            np.any(np.asarray(self.task_aff_sk) >= 0)
             or np.any(np.asarray(self.task_anti_term) >= 0)
             or np.any(np.asarray(self.eta_sel) >= 0)
-            or np.any(np.asarray(self.task_pref_sel) >= 0))
+            or np.any(np.asarray(self.task_pref_sk) >= 0))
 
     @classmethod
     def neutral(cls, n_nodes: int, n_tasks: int) -> "AffinityArrays":
         i32, f32 = np.int32, np.float32
         return cls(
-            node_domain=np.full((1, n_nodes), -1, i32),
-            domain_key=np.full(1, -1, i32),
+            sk_sel=np.full(1, -1, i32),
+            sk_domain=np.full((1, n_nodes), -1, i32),
+            cnt0=np.zeros((1, n_nodes + 1), f32),
             task_match=np.zeros((1, n_tasks), bool),
-            cnt0=np.zeros((1, 1), f32),
-            task_aff_sel=np.full((n_tasks, 1), -1, i32),
-            task_aff_key=np.full((n_tasks, 1), -1, i32),
+            task_aff_sk=np.full((n_tasks, 1), -1, i32),
             task_anti_term=np.full((n_tasks, 1), -1, i32),
             eta_sel=np.full(1, -1, i32),
-            eta_key=np.full(1, -1, i32),
-            anti_cnt0=np.zeros((1, 1), f32),
-            task_pref_sel=np.full((n_tasks, 1), -1, i32),
-            task_pref_key=np.full((n_tasks, 1), -1, i32),
+            eta_sk=np.full(1, -1, i32),
+            eta_domain=np.full((1, n_nodes), -1, i32),
+            anti_cnt0=np.zeros((1, n_nodes), f32),
+            task_pref_sk=np.full((n_tasks, 1), -1, i32),
             task_pref_w=np.zeros((n_tasks, 1), f32),
-            static_pref=np.zeros((1, 1), f32),
+            static_pref=np.zeros((1, n_nodes), f32),
         )
 
 
@@ -125,7 +125,6 @@ def build_affinity(ci: ClusterInfo, maps: IndexMaps,
     # ---- term tables -----------------------------------------------------
     sel_index: Dict[Tuple, int] = {}
     sel_terms: List[Tuple[PodAffinityTerm, str]] = []  # (term, own_ns)
-    key_index: Dict[str, int] = {}
 
     def sel_id(term: PodAffinityTerm, own_ns: str) -> int:
         c = _canon_term(term, own_ns)
@@ -134,125 +133,144 @@ def build_affinity(ci: ClusterInfo, maps: IndexMaps,
             sel_terms.append((term, own_ns))
         return sel_index[c]
 
-    def key_id(k: str) -> int:
-        if k not in key_index:
-            key_index[k] = len(key_index)
-        return key_index[k]
+    sk_index: Dict[Tuple[int, str], int] = {}    # (sel, key) -> sk
 
-    eta_index: Dict[Tuple[int, int], int] = {}   # (sel, key) -> eta
+    def sk_id(s: int, key: str) -> int:
+        if (s, key) not in sk_index:
+            sk_index[(s, key)] = len(sk_index)
+        return sk_index[(s, key)]
 
-    def eta_id(s: int, k: int) -> int:
-        if (s, k) not in eta_index:
-            eta_index[(s, k)] = len(eta_index)
-        return eta_index[(s, k)]
+    eta_index: Dict[Tuple[int, str], int] = {}   # (sel, key) -> eta
 
-    per_task_aff: Dict[int, List[Tuple[int, int]]] = {}
+    def eta_id(s: int, key: str) -> int:
+        if (s, key) not in eta_index:
+            eta_index[(s, key)] = len(eta_index)
+        return eta_index[(s, key)]
+
+    per_task_aff: Dict[int, List[int]] = {}
     per_task_anti: Dict[int, List[int]] = {}
-    per_task_pref: Dict[int, List[Tuple[int, int, float]]] = {}
+    per_task_pref: Dict[int, List[Tuple[int, float]]] = {}
     for ti, t in tasks:
         for term in t.pod_affinity:
             per_task_aff.setdefault(ti, []).append(
-                (sel_id(term, t.namespace), key_id(term.topology_key)))
+                sk_id(sel_id(term, t.namespace), term.topology_key))
         for term in t.pod_anti_affinity:
+            s = sel_id(term, t.namespace)
+            sk_id(s, term.topology_key)      # own-anti reads live counts too
             per_task_anti.setdefault(ti, []).append(
-                eta_id(sel_id(term, t.namespace), key_id(term.topology_key)))
+                eta_id(s, term.topology_key))
         for term in t.pod_affinity_preferred:
             per_task_pref.setdefault(ti, []).append(
-                (sel_id(term, t.namespace), key_id(term.topology_key),
+                (sk_id(sel_id(term, t.namespace), term.topology_key),
                  float(term.weight or 1)))
         for term in t.pod_anti_affinity_preferred:
             per_task_pref.setdefault(ti, []).append(
-                (sel_id(term, t.namespace), key_id(term.topology_key),
+                (sk_id(sel_id(term, t.namespace), term.topology_key),
                  -float(term.weight or 1)))
 
-    # ---- domains ---------------------------------------------------------
-    TK = bucket(max(len(key_index), 1), 1)
-    dom_index: Dict[Tuple[int, str], int] = {}
-    node_domain = np.full((TK, n_nodes), -1, np.int32)
-    for name, ni in maps.node_index.items():
-        node = ci.nodes[name]
-        for k, ki in key_index.items():
-            v = node.labels.get(k)
-            if v is None:
-                continue
-            d = dom_index.setdefault((ki, v), len(dom_index))
-            node_domain[ki, ni] = d
-    DM = bucket(max(len(dom_index), 1), 1)
-    domain_key = np.full(DM, -1, np.int32)
-    for (ki, _v), d in dom_index.items():
-        domain_key[d] = ki
+    # ---- per-key node domains (host-side only) ---------------------------
+    keys = sorted({k for (_s, k) in sk_index} | {k for (_s, k) in eta_index}
+                  | {t.topology_key
+                     for _ti, task in tasks
+                     for t in (task.pod_affinity_preferred
+                               + task.pod_anti_affinity_preferred
+                               + task.pod_affinity + task.pod_anti_affinity)})
+    dom_of_key: Dict[str, np.ndarray] = {}
+    for k in keys:
+        vals: Dict[str, int] = {}
+        row = np.full(n_nodes, -1, np.int32)
+        for name, ni in maps.node_index.items():
+            v = ci.nodes[name].labels.get(k)
+            if v is not None:
+                row[ni] = vals.setdefault(v, len(vals))
+        dom_of_key[k] = row
 
-    # ---- match matrix + snapshot counts ----------------------------------
+    # ---- match matrix ----------------------------------------------------
     SEL = bucket(max(len(sel_terms), 1), 1)
     task_match = np.zeros((SEL, n_tasks), bool)
     for s, (term, own_ns) in enumerate(sel_terms):
         for ti, t in tasks:
             task_match[s, ti] = term.matches(t.labels, t.namespace, own_ns)
 
-    cnt0 = np.zeros((SEL, DM), np.float32)
-    ETA = bucket(max(len(eta_index), 1), 1)
-    eta_sel = np.full(ETA, -1, np.int32)
-    eta_key = np.full(ETA, -1, np.int32)
-    for (s, k), e in eta_index.items():
-        eta_sel[e] = s
-        eta_key[e] = k
-    anti_cnt0 = np.zeros((ETA, DM), np.float32)
-    static_pref = np.zeros((SEL, DM), np.float32)
-
+    # ---- node-space snapshot counts --------------------------------------
+    SK = bucket(max(len(sk_index), 1), 1)
+    sk_sel = np.full(SK, -1, np.int32)
+    sk_domain = np.full((SK, n_nodes), -1, np.int32)
+    cnt0 = np.zeros((SK, n_nodes + 1), np.float32)
+    # per-selector placed-pod node lists (existing pods on nodes)
+    placed_nodes: Dict[int, List[int]] = {}
     for ti, t in tasks:
         ni = maps.node_index.get(t.node_name, -1)
         if ni < 0:
             continue
-        # a placed pod counts toward every selector it matches, in its
-        # domain under every topology key
         for s in range(len(sel_terms)):
-            if not task_match[s, ti]:
-                continue
-            for ki in key_index.values():
-                d = node_domain[ki, ni]
-                if d >= 0:
-                    cnt0[s, d] += 1.0
+            if task_match[s, ti]:
+                placed_nodes.setdefault(s, []).append(ni)
+    for (s, key), p in sk_index.items():
+        sk_sel[p] = s
+        dom = dom_of_key[key]
+        sk_domain[p] = dom
+        for ni in placed_nodes.get(s, ()):
+            d = dom[ni]
+            if d >= 0:
+                cnt0[p, :n_nodes][dom == d] += 1.0
+                cnt0[p, n_nodes] += 1.0
+
+    ETA = bucket(max(len(eta_index), 1), 1)
+    eta_sel = np.full(ETA, -1, np.int32)
+    eta_sk = np.full(ETA, -1, np.int32)
+    eta_domain = np.full((ETA, n_nodes), -1, np.int32)
+    anti_cnt0 = np.zeros((ETA, n_nodes), np.float32)
+    for (s, key), e in eta_index.items():
+        eta_sel[e] = s
+        eta_sk[e] = sk_index[(s, key)]
+        eta_domain[e] = dom_of_key[key]
+
+    static_pref = np.zeros((SEL, n_nodes), np.float32)
+    sk_rev = {p: (s, key) for (s, key), p in sk_index.items()}
+    for ti, t in tasks:
+        ni = maps.node_index.get(t.node_name, -1)
+        if ni < 0:
+            continue
         # a placed pod's own required anti-affinity terms constrain
         # incoming pods matching them (symmetric anti-affinity)
         for e in per_task_anti.get(ti, ()):
-            d = node_domain[eta_key[e], ni]
+            dom = eta_domain[e]
+            d = dom[ni]
             if d >= 0:
-                anti_cnt0[e, d] += 1.0
+                anti_cnt0[e][dom == d] += 1.0
         # a placed pod's preferred terms score incoming pods matching them
         # (symmetric preferred, static over the cycle)
-        for s, ki, w in per_task_pref.get(ti, ()):
-            d = node_domain[ki, ni]
+        for p, w in per_task_pref.get(ti, ()):
+            s, key = sk_rev[p]
+            dom = dom_of_key[key]
+            d = dom[ni]
             if d >= 0:
-                static_pref[s, d] += w
+                static_pref[s][dom == d] += w
 
     # ---- per-task slot tables --------------------------------------------
     A = bucket(max(max((len(v) for v in per_task_aff.values()), default=0), 1), 1)
     B = bucket(max(max((len(v) for v in per_task_anti.values()), default=0), 1), 1)
     PP = bucket(max(max((len(v) for v in per_task_pref.values()), default=0), 1), 1)
-    task_aff_sel = np.full((n_tasks, A), -1, np.int32)
-    task_aff_key = np.full((n_tasks, A), -1, np.int32)
+    task_aff_sk = np.full((n_tasks, A), -1, np.int32)
     task_anti_term = np.full((n_tasks, B), -1, np.int32)
-    task_pref_sel = np.full((n_tasks, PP), -1, np.int32)
-    task_pref_key = np.full((n_tasks, PP), -1, np.int32)
+    task_pref_sk = np.full((n_tasks, PP), -1, np.int32)
     task_pref_w = np.zeros((n_tasks, PP), np.float32)
     for ti, rows in per_task_aff.items():
-        for a, (s, k) in enumerate(rows):
-            task_aff_sel[ti, a] = s
-            task_aff_key[ti, a] = k
+        for a, p in enumerate(rows):
+            task_aff_sk[ti, a] = p
     for ti, rows in per_task_anti.items():
         for b, e in enumerate(rows):
             task_anti_term[ti, b] = e
     for ti, rows in per_task_pref.items():
-        for p, (s, k, w) in enumerate(rows):
-            task_pref_sel[ti, p] = s
-            task_pref_key[ti, p] = k
-            task_pref_w[ti, p] = w
+        for i, (p, w) in enumerate(rows):
+            task_pref_sk[ti, i] = p
+            task_pref_w[ti, i] = w
 
     return AffinityArrays(
-        node_domain=node_domain, domain_key=domain_key,
-        task_match=task_match, cnt0=cnt0,
-        task_aff_sel=task_aff_sel, task_aff_key=task_aff_key,
-        task_anti_term=task_anti_term, eta_sel=eta_sel, eta_key=eta_key,
-        anti_cnt0=anti_cnt0, task_pref_sel=task_pref_sel,
-        task_pref_key=task_pref_key, task_pref_w=task_pref_w,
+        sk_sel=sk_sel, sk_domain=sk_domain, cnt0=cnt0,
+        task_match=task_match, task_aff_sk=task_aff_sk,
+        task_anti_term=task_anti_term, eta_sel=eta_sel, eta_sk=eta_sk,
+        eta_domain=eta_domain, anti_cnt0=anti_cnt0,
+        task_pref_sk=task_pref_sk, task_pref_w=task_pref_w,
         static_pref=static_pref)

@@ -261,76 +261,66 @@ def _affinity_terms(aff: AffinityArrays, aff_cnt, anti_cnt, t, valid_nodes):
     """InterPodAffinity feasibility mask + normalized score for task ``t``.
 
     The array program of the k8s plugin the reference wraps
-    (predicates.go:261-273 Filter, nodeorder.go:273-306 batch scorer):
+    (predicates.go:261-273 Filter, nodeorder.go:273-306 batch scorer),
+    over the NODE-SPACE encoding (arrays/affinity.py): live counts are
+    [SK, N+1] rows, so everything here is row selects and vector compares —
+    no per-element gathers (TPU gathers serialize and dominated the
+    per-task affinity cost in the domain-indexed encoding).
 
     - required affinity: the node's topology domain must already hold a pod
-      matching the term's selector — counted in the live ``aff_cnt[SEL, DM]``
-      state so in-cycle placements count, like the reference's
-      event-handler-maintained pod lister (predicates.go:116-160). The k8s
-      first-pod escape applies: when NO pod anywhere matches the selector
-      and the incoming pod matches its own term, any node carrying the
-      topology key qualifies.
-    - required anti-affinity, both directions: the incoming pod's own terms
-      veto domains holding matching pods, and placed pods' terms
-      (``anti_cnt[ETA, DM]``) veto domains for incoming pods they match.
+      matching the term's selector (live counts, so in-cycle placements
+      count like the reference's event-handler-maintained pod lister,
+      predicates.go:116-160); the k8s first-pod escape applies via the
+      cluster-total column.
+    - required anti-affinity, both directions: the incoming pod's own
+      terms veto domains holding matching pods, and placed pods' terms
+      (``anti_cnt[ETA, N]``) veto domains for incoming pods they match.
     - preferred terms: signed weighted count sum, min-max normalized to
       0..100 over schedulable nodes (k8s NormalizeScore; the reference
       normalizes over its filtered set — documented divergence).
     """
-    doms = aff.node_domain                                     # i32[TK, N]
+    N = aff.sk_domain.shape[1]
 
     # required affinity
-    sel = aff.task_aff_sel[t]                                  # [A]
-    key = aff.task_aff_key[t]                                  # [A]
-    act = sel >= 0
-    dom_n = doms[jnp.maximum(key, 0)]                          # [A, N]
-    cnt_rows = aff_cnt[jnp.maximum(sel, 0)]                    # [A, DM]
-    have = jnp.take_along_axis(cnt_rows, jnp.maximum(dom_n, 0), axis=1)
-    ok = (have > 0) & (dom_n >= 0)
-    key_doms = aff.domain_key[None, :] == key[:, None]         # [A, DM]
-    total = jnp.sum(cnt_rows * key_doms, axis=1)               # [A]
-    self_ok = (total == 0) & aff.task_match[jnp.maximum(sel, 0), t]
-    ok = ok | (self_ok[:, None] & (dom_n >= 0))
+    sk = aff.task_aff_sk[t]                                    # [A]
+    act = sk >= 0
+    skc = jnp.maximum(sk, 0)
+    rows = aff_cnt[skc]                                        # [A, N+1]
+    have = rows[:, :N]
+    total = rows[:, N]
+    dom = aff.sk_domain[skc]                                   # [A, N]
+    ok = (have > 0) & (dom >= 0)
+    self_ok = (total == 0) & aff.task_match[aff.sk_sel[skc], t]
+    ok = ok | (self_ok[:, None] & (dom >= 0))
     aff_ok = jnp.all(ok | ~act[:, None], axis=0)               # [N]
 
     # required anti-affinity: own terms vs pods already counted
     own = aff.task_anti_term[t]                                # [B]
     bact = own >= 0
-    osel = aff.eta_sel[jnp.maximum(own, 0)]
-    okey = aff.eta_key[jnp.maximum(own, 0)]
-    dom_b = doms[jnp.maximum(okey, 0)]                         # [B, N]
-    cnt_b = jnp.take_along_axis(aff_cnt[jnp.maximum(osel, 0)],
-                                jnp.maximum(dom_b, 0), axis=1)
+    ec = jnp.maximum(own, 0)
+    cnt_b = aff_cnt[jnp.maximum(aff.eta_sk[ec], 0)][:, :N]     # [B, N]
+    dom_b = aff.eta_domain[ec]                                 # [B, N]
     viol_own = jnp.any(bact[:, None] & (cnt_b > 0) & (dom_b >= 0), axis=0)
 
     # required anti-affinity: placed pods' terms vs this task (symmetric)
     m = (aff.eta_sel >= 0) & aff.task_match[jnp.maximum(aff.eta_sel, 0), t]
-    dom_e = doms[jnp.maximum(aff.eta_key, 0)]                  # [ETA, N]
-    cnt_e = jnp.take_along_axis(anti_cnt, jnp.maximum(dom_e, 0), axis=1)
-    viol_sym = jnp.any(m[:, None] & (cnt_e > 0) & (dom_e >= 0), axis=0)
+    viol_sym = jnp.any(m[:, None] & (anti_cnt > 0)
+                       & (aff.eta_domain >= 0), axis=0)
 
     feas = aff_ok & ~viol_own & ~viol_sym
 
     # preferred terms of the incoming task (dynamic counts)
-    psel = aff.task_pref_sel[t]                                # [PP]
-    pkey = aff.task_pref_key[t]
+    psk = aff.task_pref_sk[t]                                  # [PP]
     pw = aff.task_pref_w[t]
-    pact = psel >= 0
-    dom_p = doms[jnp.maximum(pkey, 0)]                         # [PP, N]
-    cnt_p = jnp.take_along_axis(aff_cnt[jnp.maximum(psel, 0)],
-                                jnp.maximum(dom_p, 0), axis=1)
+    pact = psk >= 0
+    pskc = jnp.maximum(psk, 0)
+    cnt_p = aff_cnt[pskc][:, :N]                               # [PP, N]
+    dom_p = aff.sk_domain[pskc]
     raw = jnp.sum(jnp.where(pact[:, None] & (dom_p >= 0),
                             pw[:, None] * cnt_p, 0.0), axis=0)
-    # symmetric preferred from snapshot pods (static over the cycle):
-    # contract over SEL first — combined[DM] = mcol @ static_pref — then
-    # gather per (TK, N); the old einsum materialized [SEL, TK, N], which
-    # at 10k nodes dominated the affinity cycle's memory traffic. The
-    # reordering is exact: the summands are integer weight-counts, exact
-    # in f32, so the sum is associativity-independent.
+    # symmetric preferred from snapshot pods (node-space static map)
     mcol = aff.task_match[:, t].astype(jnp.float32)            # [SEL]
-    combined = mcol @ aff.static_pref                          # [DM]
-    contrib = jnp.where(doms >= 0, combined[jnp.maximum(doms, 0)], 0.0)
-    raw = raw + jnp.sum(contrib, axis=0)                       # [N]
+    raw = raw + mcol @ aff.static_pref                         # [N]
 
     # min-max normalize over schedulable nodes -> 0..100 (k8s NormalizeScore)
     big = jnp.float32(3.4e38)
@@ -346,19 +336,28 @@ def _affinity_place_update(aff: AffinityArrays, aff_cnt, anti_cnt, t, node,
                            placed):
     """Account a placement in the live affinity counts (the analog of the
     reference's AddPod event handler updating the plugin's pod lister,
-    predicates.go:116-138)."""
-    DM = aff_cnt.shape[1]
-    dom_sel = aff.node_domain[:, node]                         # [TK]
-    add = jnp.where(placed, aff.task_match[:, t].astype(jnp.float32), 0.0)
-    idx = jnp.where(dom_sel >= 0, dom_sel, DM)                 # OOB -> drop
-    aff_cnt = aff_cnt.at[:, idx].add(add[:, None], mode="drop")
+    predicates.go:116-138): add a domain-membership mask row per (sel,key)
+    pair the placed task matches — pure vector compare + add."""
+    N = aff.sk_domain.shape[1]
+    dom_at = aff.sk_domain[:, node]                            # [SK]
+    member = ((aff.sk_domain == dom_at[:, None])
+              & (aff.sk_domain >= 0) & (dom_at >= 0)[:, None])  # [SK, N]
+    matches = (aff.sk_sel >= 0) & aff.task_match[
+        jnp.maximum(aff.sk_sel, 0), t]
+    addsk = jnp.where(placed & matches, 1.0, 0.0)              # [SK]
+    upd = jnp.concatenate(
+        [member, (dom_at >= 0)[:, None]], axis=1).astype(jnp.float32)
+    aff_cnt = aff_cnt + upd * addsk[:, None]
+    # the task's own required anti terms mark their presence in the domain
     own = aff.task_anti_term[t]                                # [B]
-    okey = aff.eta_key[jnp.maximum(own, 0)]
-    dmb = aff.node_domain[jnp.maximum(okey, 0), node]          # [B]
-    eidx = jnp.where(own >= 0, own, anti_cnt.shape[0])
-    didx = jnp.where(dmb >= 0, dmb, DM)
-    anti_cnt = anti_cnt.at[eidx, didx].add(
-        jnp.where(placed, 1.0, 0.0), mode="drop")
+    ec = jnp.maximum(own, 0)
+    edom = aff.eta_domain[ec]                                  # [B, N]
+    edom_at = edom[:, node]                                    # [B]
+    emember = ((edom == edom_at[:, None]) & (edom >= 0)
+               & (edom_at >= 0)[:, None])
+    eidx = jnp.where((own >= 0) & placed, own, anti_cnt.shape[0])
+    anti_cnt = anti_cnt.at[eidx].add(emember.astype(jnp.float32),
+                                     mode="drop")
     return aff_cnt, anti_cnt
 
 

@@ -150,21 +150,21 @@ def _score_one(cfg: AllocateConfig, nodes, resreq, idle, th, te, tm):
 
 
 def _affinity_state(extras):
-    """Mutable affinity-count state mirroring the kernel's scan carry."""
+    """Mutable affinity-count state mirroring the kernel's scan carry
+    (node-space encoding, arrays/affinity.py)."""
     aff = extras.affinity
     return {
-        "node_domain": np.asarray(aff.node_domain),
-        "domain_key": np.asarray(aff.domain_key),
+        "sk_sel": np.asarray(aff.sk_sel),
+        "sk_domain": np.asarray(aff.sk_domain),
         "task_match": np.asarray(aff.task_match),
         "aff_cnt": np.asarray(aff.cnt0, np.float64).copy(),
         "anti_cnt": np.asarray(aff.anti_cnt0, np.float64).copy(),
-        "t_aff_sel": np.asarray(aff.task_aff_sel),
-        "t_aff_key": np.asarray(aff.task_aff_key),
+        "t_aff_sk": np.asarray(aff.task_aff_sk),
         "t_anti": np.asarray(aff.task_anti_term),
         "eta_sel": np.asarray(aff.eta_sel),
-        "eta_key": np.asarray(aff.eta_key),
-        "t_pref_sel": np.asarray(aff.task_pref_sel),
-        "t_pref_key": np.asarray(aff.task_pref_key),
+        "eta_sk": np.asarray(aff.eta_sk),
+        "eta_domain": np.asarray(aff.eta_domain),
+        "t_pref_sk": np.asarray(aff.task_pref_sk),
         "t_pref_w": np.asarray(aff.task_pref_w),
         "static_pref": np.asarray(aff.static_pref),
     }
@@ -173,55 +173,46 @@ def _affinity_state(extras):
 def _affinity_one(st, t, valid_nodes):
     """Sequential mirror of ops.allocate_scan._affinity_terms: per-node
     feasibility + 0..100 normalized preferred score for task ``t``."""
-    doms = st["node_domain"]
-    N = doms.shape[1]
+    N = st["sk_domain"].shape[1]
     feas = np.ones(N, bool)
     # required affinity (with the k8s first-pod escape)
-    for a in range(st["t_aff_sel"].shape[1]):
-        s = st["t_aff_sel"][t, a]
-        k = st["t_aff_key"][t, a]
-        if s < 0:
+    for a in range(st["t_aff_sk"].shape[1]):
+        p = st["t_aff_sk"][t, a]
+        if p < 0:
             continue
-        dom_n = doms[k]
-        have = np.where(dom_n >= 0, st["aff_cnt"][s][np.maximum(dom_n, 0)], 0)
-        ok = (have > 0) & (dom_n >= 0)
-        total = st["aff_cnt"][s][st["domain_key"] == k].sum()
-        if total == 0 and st["task_match"][s, t]:
-            ok = ok | (dom_n >= 0)
+        dom = st["sk_domain"][p]
+        have = st["aff_cnt"][p, :N]
+        ok = (have > 0) & (dom >= 0)
+        if st["aff_cnt"][p, N] == 0 and st["task_match"][st["sk_sel"][p], t]:
+            ok = ok | (dom >= 0)
         feas &= ok
     # own required anti-affinity
     for b in range(st["t_anti"].shape[1]):
         e = st["t_anti"][t, b]
         if e < 0:
             continue
-        s, k = st["eta_sel"][e], st["eta_key"][e]
-        dom_n = doms[k]
-        have = np.where(dom_n >= 0, st["aff_cnt"][s][np.maximum(dom_n, 0)], 0)
-        feas &= ~((have > 0) & (dom_n >= 0))
+        dom = st["eta_domain"][e]
+        have = st["aff_cnt"][st["eta_sk"][e], :N]
+        feas &= ~((have > 0) & (dom >= 0))
     # placed pods' anti terms vs this task (symmetric)
     for e in range(len(st["eta_sel"])):
         s = st["eta_sel"][e]
         if s < 0 or not st["task_match"][s, t]:
             continue
-        dom_n = doms[st["eta_key"][e]]
-        have = np.where(dom_n >= 0, st["anti_cnt"][e][np.maximum(dom_n, 0)], 0)
-        feas &= ~((have > 0) & (dom_n >= 0))
+        dom = st["eta_domain"][e]
+        feas &= ~((st["anti_cnt"][e] > 0) & (dom >= 0))
     # preferred terms
     raw = np.zeros(N)
-    for p in range(st["t_pref_sel"].shape[1]):
-        s = st["t_pref_sel"][t, p]
-        if s < 0:
+    for i in range(st["t_pref_sk"].shape[1]):
+        p = st["t_pref_sk"][t, i]
+        if p < 0:
             continue
-        dom_n = doms[st["t_pref_key"][t, p]]
-        cnt = np.where(dom_n >= 0, st["aff_cnt"][s][np.maximum(dom_n, 0)], 0)
-        raw += st["t_pref_w"][t, p] * cnt
+        dom = st["sk_domain"][p]
+        raw += st["t_pref_w"][t, i] * np.where(
+            dom >= 0, st["aff_cnt"][p, :N], 0)
     for s in range(st["task_match"].shape[0]):
-        if not st["task_match"][s, t]:
-            continue
-        for k in range(doms.shape[0]):
-            dom_n = doms[k]
-            raw += np.where(dom_n >= 0,
-                            st["static_pref"][s][np.maximum(dom_n, 0)], 0)
+        if st["task_match"][s, t]:
+            raw += st["static_pref"][s]
     mx = np.max(np.where(valid_nodes, raw, -np.inf))
     mn = np.min(np.where(valid_nodes, raw, np.inf))
     span = mx - mn
@@ -231,20 +222,26 @@ def _affinity_one(st, t, valid_nodes):
 
 
 def _affinity_place(st, t, node):
-    """Mirror of _affinity_place_update: account a placement."""
-    doms = st["node_domain"]
-    for k in range(doms.shape[0]):
-        d = doms[k, node]
+    """Mirror of _affinity_place_update: account a placement by adding
+    domain-membership mask rows."""
+    N = st["sk_domain"].shape[1]
+    for p in range(len(st["sk_sel"])):
+        s = st["sk_sel"][p]
+        if s < 0 or not st["task_match"][s, t]:
+            continue
+        d = st["sk_domain"][p, node]
         if d < 0:
             continue
-        st["aff_cnt"][:, d] += st["task_match"][:, t]
+        st["aff_cnt"][p, :N][st["sk_domain"][p] == d] += 1.0
+        st["aff_cnt"][p, N] += 1.0
     for b in range(st["t_anti"].shape[1]):
         e = st["t_anti"][t, b]
         if e < 0:
             continue
-        d = doms[st["eta_key"][e], node]
+        dom = st["eta_domain"][e]
+        d = dom[node]
         if d >= 0:
-            st["anti_cnt"][e, d] += 1.0
+            st["anti_cnt"][e][dom == d] += 1.0
 
 
 def _hdrf_keys(hier, job_alloc, job_req, job_valid, total):
