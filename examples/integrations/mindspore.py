@@ -1,0 +1,43 @@
+"""MindSpore CPU training gang through the control plane.
+
+The single-process analog of the reference's MindSpore example
+(example/MindSpore-example/mindspore_cpu: an 8-replica gang with
+minAvailable < replicas — an ELASTIC gang that starts at quorum).
+
+Run: python examples/integrations/mindspore.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from volcano_tpu.api.batch import Job, PodTemplate, TaskSpec
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def mindspore_job(name="mindspore-cpu", replicas=8, min_available=5):
+    return Job(
+        name=name,
+        min_available=min_available,
+        plugins={"svc": []},
+        tasks=[TaskSpec(name="pod", replicas=replicas,
+                        template=PodTemplate(
+                            resources={"cpu": "1", "memory": "512Mi"}))])
+
+
+def main():
+    sys_ = VolcanoSystem()
+    # capacity for the quorum but not all replicas: the elastic gang starts
+    for i in range(3):
+        sys_.add_node(f"node-{i}", cpu="2", memory="8Gi")
+    sys_.submit_job(mindspore_job())
+    for _ in range(3):
+        sys_.tick()
+    pods = sys_.pods_of("mindspore-cpu")
+    running = [p for p in pods if p.node_name]
+    print(f"placed {len(running)}/8 replicas (minAvailable=5)")
+
+
+if __name__ == "__main__":
+    main()

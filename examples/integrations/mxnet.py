@@ -1,0 +1,58 @@
+"""MXNet parameter-server training gang through the control plane.
+
+The single-process analog of the reference's MXNet recipe
+(example/integrations/mxnet/train/train-mnist-cpu.yaml): scheduler +
+server + worker roles as one gang (minAvailable = all), svc plugin for the
+DMLC_PS_ROOT_URI stable name, RestartJob on eviction/failure.
+
+Run: python examples/integrations/mxnet.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from volcano_tpu.api.batch import Job, LifecyclePolicy, PodTemplate, TaskSpec
+from volcano_tpu.api.types import BusAction, BusEvent
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def mxnet_job(name="mxnet-job", workers=2, servers=2):
+    res = {"cpu": "1", "memory": "1Gi"}
+    return Job(
+        name=name,
+        min_available=1 + workers + servers,
+        plugins={"svc": [], "env": []},
+        policies=[
+            LifecyclePolicy(action=BusAction.RESTART_JOB,
+                            event=BusEvent.POD_EVICTED),
+            LifecyclePolicy(action=BusAction.RESTART_JOB,
+                            event=BusEvent.POD_FAILED),
+        ],
+        tasks=[
+            TaskSpec(name="scheduler", replicas=1,
+                     template=PodTemplate(resources=res)),
+            TaskSpec(name="server", replicas=servers,
+                     template=PodTemplate(resources=res)),
+            TaskSpec(name="worker", replicas=workers,
+                     template=PodTemplate(resources=res)),
+        ])
+
+
+def main():
+    sys_ = VolcanoSystem()
+    for i in range(3):
+        sys_.add_node(f"node-{i}", cpu="8", memory="16Gi")
+    sys_.submit_job(mxnet_job())
+    for _ in range(3):
+        sys_.tick()
+    pods = sys_.pods_of("mxnet-job")
+    print("pods:", [(p.name, p.phase, p.node_name) for p in pods])
+    cm = sys_.api.get("configmaps", "default/mxnet-job-svc")
+    print("scheduler host file:")
+    print(cm.data["scheduler.host"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""PaddlePaddle CTR training through the control plane.
+
+The single-process analog of the reference's recipe
+(example/integrations/paddlepaddle/ctr-paddlepaddle-on-volcano.yaml):
+pserver + trainer roles as one gang with the svc plugin.
+
+Run: python examples/integrations/paddle.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from volcano_tpu.api.batch import Job, PodTemplate, TaskSpec
+from volcano_tpu.runtime.system import VolcanoSystem
+
+
+def paddle_job(name="ctr-volcano", pservers=2, trainers=2):
+    res = {"cpu": "1", "memory": "1Gi"}
+    return Job(
+        name=name,
+        min_available=pservers + trainers,
+        plugins={"svc": [], "env": []},
+        tasks=[
+            TaskSpec(name="pserver", replicas=pservers,
+                     template=PodTemplate(resources=res)),
+            TaskSpec(name="trainer", replicas=trainers,
+                     template=PodTemplate(resources=res)),
+        ])
+
+
+def main():
+    sys_ = VolcanoSystem()
+    for i in range(2):
+        sys_.add_node(f"node-{i}", cpu="8", memory="16Gi")
+    sys_.submit_job(paddle_job())
+    for _ in range(3):
+        sys_.tick()
+    pods = sys_.pods_of("ctr-volcano")
+    print("pods:", [(p.name, p.phase, p.node_name) for p in pods])
+
+
+if __name__ == "__main__":
+    main()

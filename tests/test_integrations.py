@@ -146,3 +146,77 @@ class TestTensorFlowIntegration:
             sys_.tick()
         assert sys_.job("tensorflow-dist-mnist").status.state.phase == \
             JobPhase.COMPLETED
+
+
+class TestMXNetShape:
+    def test_ps_gang_places_and_publishes_hosts(self):
+        import importlib.util as iu
+        import os
+        spec = iu.spec_from_file_location(
+            "mxnet_example", os.path.join(
+                os.path.dirname(__file__), "..", "examples", "integrations",
+                "mxnet.py"))
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys_ = make_system(n_nodes=3)
+        sys_.submit_job(mod.mxnet_job(workers=2, servers=2))
+        for _ in range(3):
+            sys_.tick()
+        pods = sys_.pods_of("mxnet-job")
+        assert len([p for p in pods if p.node_name]) == 5   # full gang
+        cm = sys_.api.get("configmaps", "default/mxnet-job-svc")
+        assert "scheduler.host" in cm.data
+
+
+class TestPaddleShape:
+    def test_pserver_trainer_gang(self):
+        import importlib.util as iu
+        import os
+        spec = iu.spec_from_file_location(
+            "paddle_example", os.path.join(
+                os.path.dirname(__file__), "..", "examples", "integrations",
+                "paddle.py"))
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys_ = make_system(n_nodes=2)
+        sys_.submit_job(mod.paddle_job())
+        for _ in range(3):
+            sys_.tick()
+        assert len([p for p in sys_.pods_of("ctr-volcano")
+                    if p.node_name]) == 4
+
+
+class TestMindSporeShape:
+    def test_elastic_gang_starts_at_quorum(self):
+        import importlib.util as iu
+        import os
+        spec = iu.spec_from_file_location(
+            "ms_example", os.path.join(
+                os.path.dirname(__file__), "..", "examples", "integrations",
+                "mindspore.py"))
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys_ = make_system(n_nodes=3, cpu="2", memory="8Gi")
+        sys_.submit_job(mod.mindspore_job())
+        for _ in range(3):
+            sys_.tick()
+        placed = [p for p in sys_.pods_of("mindspore-cpu") if p.node_name]
+        # elastic: at least the quorum (5) places on 6 slots, not all 8
+        assert 5 <= len(placed) <= 6
+
+
+class TestArgoWorkflow:
+    def test_dag_completion_order(self):
+        import importlib.util as iu
+        import os
+        spec = iu.spec_from_file_location(
+            "argo_example", os.path.join(
+                os.path.dirname(__file__), "..", "examples", "integrations",
+                "argo.py"))
+        mod = iu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys_ = make_system(n_nodes=1)
+        order = mod.run_workflow(sys_, mod.DAG)
+        assert order[0] == "a"
+        assert order[-1] == "d"
+        assert set(order) == {"a", "b", "c", "d"}

@@ -287,3 +287,111 @@ tiers:
         sched = Scheduler(FakeCluster(ci), conf=parse_conf(conf))
         sched.run_once()
         assert sched.cluster.evictions == []
+
+
+class TestJobManagerBuckets:
+    """JobManager fidelity (manager.go:111-318): bucket construction from
+    the pairwise matrices, anti-affinity splits, placed-node seeding, and
+    the TaskOrderFn-driven pending-table reordering."""
+
+    def _jm(self, aff="", anti="", order="", tasks=()):
+        from volcano_tpu.plugins.task_topology import (JobManager,
+                                                       _parse_groups)
+        jm = JobManager("default/j")
+        jm.apply_topology(_parse_groups(aff), _parse_groups(anti),
+                          [r for r in order.split(",") if r])
+        jm.construct_buckets(list(tasks))
+        return jm
+
+    def test_affine_roles_share_bucket(self):
+        tasks = [build_task(f"t{i}", cpu="1", role=r)
+                 for i, r in enumerate(["ps", "worker", "worker"])]
+        jm = self._jm(aff="ps,worker", tasks=tasks)
+        idx = {t.uid: jm.pod_in_bucket[t.uid] for t in tasks}
+        assert len(set(idx.values())) == 1       # one bucket holds all
+
+    def test_anti_affine_roles_split_buckets(self):
+        tasks = [build_task(f"t{i}", cpu="1", role=r)
+                 for i, r in enumerate(["a", "b", "a", "b"])]
+        jm = self._jm(anti="a,b", tasks=tasks)
+        buckets = {jm.pod_in_bucket[t.uid] for t in tasks}
+        # a and b never share a bucket
+        for b in buckets:
+            roles = set(jm.buckets[b].task_name_set)
+            assert roles in ({"a"}, {"b"})
+
+    def test_self_anti_affinity_one_per_bucket(self):
+        tasks = [build_task(f"t{i}", cpu="1", role="solo") for i in range(3)]
+        jm = self._jm(anti="solo", tasks=tasks)
+        assert len({jm.pod_in_bucket[t.uid] for t in tasks}) == 3
+
+    def test_unmanaged_roles_out_of_bucket(self):
+        from volcano_tpu.plugins.task_topology import OUT_OF_BUCKET
+        tasks = [build_task("t0", cpu="1", role="ps"),
+                 build_task("t1", cpu="1", role="other")]
+        jm = self._jm(aff="ps,worker", tasks=tasks)
+        assert jm.pod_in_bucket[tasks[1].uid] == OUT_OF_BUCKET
+
+    def test_placed_tasks_seed_node_buckets(self):
+        placed = build_task("p0", cpu="1", role="ps", node_name="n1",
+                            status=TaskStatus.RUNNING)
+        pend = build_task("t0", cpu="1", role="worker")
+        jm = self._jm(aff="ps,worker", tasks=[placed, pend])
+        b = jm.get_bucket(pend.uid)
+        assert b is not None and b.node == {"n1": 1}
+
+    def test_task_order_annotation_wins(self):
+        from volcano_tpu.plugins.task_topology import JobManager
+        jm = JobManager("j")
+        jm.apply_topology([], [], ["worker", "ps"])
+        assert jm.task_affinity_order("worker", "ps") == 1
+        assert jm.task_affinity_order("ps", "worker") == -1
+
+    def test_session_reorders_pending_table(self):
+        """Bucketed tasks schedule before out-of-bucket ones regardless of
+        packed insertion order (TaskOrderFn, topology.go:61-131)."""
+        import numpy as np
+        from volcano_tpu.framework.session import Session
+        ci = simple_cluster(n_nodes=2, node_cpu="8")
+        job = build_job("default/j", min_available=0)
+        # insertion order: loner first, then the affine pair
+        job.add_task(build_task("j-loner-0", cpu="1", role="loner"))
+        job.add_task(build_task("j-ps-0", cpu="1", role="ps"))
+        job.add_task(build_task("j-worker-0", cpu="1", role="worker"))
+        job.annotations["volcano.sh/task-topology-affinity"] = "ps,worker"
+        ci.add_job(job)
+        ssn = Session(ci, parse_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: task-topology
+"""))
+        ji = ssn.maps.job_index["default/j"]
+        row = np.asarray(ssn.snap.jobs.task_table)[ji]
+        uids = [ssn.maps.task_uids[t] for t in row if t >= 0]
+        assert uids[-1] == "default/j-loner-0"   # out-of-bucket last
+        assert set(uids[:2]) == {"default/j-ps-0", "default/j-worker-0"}
+
+    def test_bucket_steers_to_dominant_node(self):
+        """A pending bucket task is steered to the node already holding
+        most of its bucket (calcBucketScore base, topology.go:150-163)."""
+        ci = simple_cluster(n_nodes=3, node_cpu="8")
+        job = build_job("default/j", min_available=0)
+        for i, node in enumerate(["n1", "n1", "n2"]):
+            t = build_task(f"j-ps-{i}", cpu="1", role="ps",
+                           status=TaskStatus.RUNNING, node_name=node)
+            job.add_task(t)
+            ci.nodes[node].add_task(t)
+        job.add_task(build_task("j-worker-0", cpu="1", role="worker"))
+        job.annotations["volcano.sh/task-topology-affinity"] = "ps,worker"
+        ci.add_job(job)
+        sched = Scheduler(FakeCluster(ci), conf=parse_conf("""
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: task-topology
+"""))
+        sched.run_once()
+        assert dict(sched.cluster.binds)["default/j-worker-0"] == "n1"

@@ -227,7 +227,8 @@ class JobInfo:
                  preemptable: bool = False,
                  budget_min_available: str = "",
                  budget_max_unavailable: str = "",
-                 sla_waiting_time: str = ""):
+                 sla_waiting_time: str = "",
+                 annotations: Optional[Mapping[str, str]] = None):
         self.uid = uid
         self.name = name or uid.split("/")[-1]
         self.namespace = namespace
@@ -245,6 +246,8 @@ class JobInfo:
         self.budget_max_unavailable = budget_max_unavailable
         # per-job SLA annotation (sla-waiting-time, sla.go:79-82)
         self.sla_waiting_time = sla_waiting_time
+        # raw PodGroup annotations (task-topology groups, etc.)
+        self.annotations: Dict[str, str] = dict(annotations or {})
 
         self.tasks: Dict[str, TaskInfo] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = {}
@@ -372,7 +375,7 @@ class JobInfo:
                     self.min_resources.clone(), self.creation_timestamp,
                     self.pod_group_phase, self.preemptable,
                     self.budget_min_available, self.budget_max_unavailable,
-                    self.sla_waiting_time)
+                    self.sla_waiting_time, self.annotations)
         for task in self.tasks.values():
             j.add_task(task.clone())
         return j

@@ -1,65 +1,337 @@
 """Task-topology plugin: role affinity/anti-affinity within a job.
 
-Reference: pkg/scheduler/plugins/task-topology/{topology,manager,bucket}.go
-(964 LoC) — tasks of affine roles are grouped into buckets steered onto the
-same node; anti-affine roles are pushed apart. The bucket bookkeeping is
-host-side (like the reference's JobManager); the placement steer is the
-``task_pref_node`` score bonus in the allocate kernel.
+Reference: pkg/scheduler/plugins/task-topology/{topology,manager,bucket}.go —
+a per-job JobManager groups tasks of affine roles into BUCKETS (manager.go
+buildBucket greedy assignment maximizing checkTaskSetAffinity, balancing
+bucket resource scores, seeding buckets per already-placed node), orders the
+job's pending tasks so bucket-mates schedule consecutively (topology.go
+TaskOrderFn: in-bucket before out-of-bucket, larger bucket first, older
+bucket first, then the user task-order / affinity-priority comparator), and
+steers each bucket onto the node already holding most of it.
 
-Annotation format (topology.go): job annotation ``volcano.sh/task-topology``
-with arguments ``task-topology.affinity: "role1,role2;..."`` and
-``task-topology.anti-affinity`` pairs.
+Topology comes from the PodGroup annotations
+(``volcano.sh/task-topology-affinity``, ``-anti-affinity``, ``-task-order``;
+util.go:36-40, "a,b;c,d" groups) or, legacy for this framework, from plugin
+arguments applied to every job. Task roles come from
+``TaskInfo.task_role``, falling back to the pod-name segment the reference
+parses (getTaskName, util.go:69-71).
+
+The bucket bookkeeping is host-side like the reference's JobManager; the
+placement steer reaches the kernel as the ``task_pref_node`` bonus,
+pointing each bucket task at the node holding the most bucket-mates. The
+reference's per-(task,node) dynamic bucket score (topology.go
+calcBucketScore) updating within the cycle is approximated by this static
+per-cycle steer — documented divergence.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Set
+import functools
+from typing import Dict, List, Optional, Set
 
 import numpy as np
 
+from ..api.resource import Resource
 from .base import Plugin
 
 AFFINITY_ARG = "task-topology.affinity"
 ANTI_AFFINITY_ARG = "task-topology.anti-affinity"
+TASK_ORDER_ARG = "task-topology.task-order"
+
+AFFINITY_ANNOTATION = "volcano.sh/task-topology-affinity"
+ANTI_AFFINITY_ANNOTATION = "volcano.sh/task-topology-anti-affinity"
+TASK_ORDER_ANNOTATION = "volcano.sh/task-topology-task-order"
+
+OUT_OF_BUCKET = -1
+
+#: topology kind -> priority (manager.go:40-45; larger = higher)
+_PRI_SELF_ANTI, _PRI_INTER_AFF, _PRI_SELF_AFF, _PRI_INTER_ANTI = 4, 3, 2, 1
 
 
-def _parse_pairs(spec: str) -> List[Set[str]]:
+def _parse_groups(spec: str) -> List[List[str]]:
     groups = []
     for part in str(spec).split(";"):
-        roles = {r.strip() for r in part.split(",") if r.strip()}
+        roles = [r.strip() for r in part.split(",") if r.strip()]
         if roles:
             groups.append(roles)
     return groups
 
 
+def _task_role(task) -> str:
+    """TaskInfo -> role name (getTaskName, util.go:69-71: the reference
+    parses the second-to-last dash segment of the pod name)."""
+    if task.task_role:
+        return task.task_role
+    parts = task.name.split("-")
+    return parts[-2] if len(parts) >= 2 else ""
+
+
+def _req_score(req: Resource) -> float:
+    """1 milli-cpu == 1 Mi == 1 scalar unit (bucket.go CalcResReq)."""
+    score = 0.0
+    for name, v in req.quantities.items():
+        if name == "memory":
+            score += v / (1024 * 1024)
+        else:
+            score += v
+    return score
+
+
+class Bucket:
+    """bucket.go:24-109."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.tasks: Dict[str, object] = {}       # pending, by uid
+        self.task_name_set: Dict[str, int] = {}
+        self.req_score = 0.0
+        self.request = Resource()
+        self.bound_task = 0
+        self.node: Dict[str, int] = {}
+
+    def add_task(self, role: str, task) -> None:
+        self.task_name_set[role] = self.task_name_set.get(role, 0) + 1
+        if task.node_name:
+            self.node[task.node_name] = self.node.get(task.node_name, 0) + 1
+            self.bound_task += 1
+            return
+        self.tasks[task.uid] = task
+        self.req_score += _req_score(task.resreq)
+        self.request.add(task.resreq)
+
+    @property
+    def size(self) -> int:
+        return len(self.tasks) + self.bound_task
+
+
+class JobManager:
+    """manager.go:48-345 — per-job topology bookkeeping and buckets."""
+
+    def __init__(self, job_uid: str):
+        self.job_uid = job_uid
+        self.buckets: List[Bucket] = []
+        self.pod_in_bucket: Dict[str, int] = {}      # task uid -> bucket idx
+        self.task_affinity_priority: Dict[str, int] = {}
+        self.task_exist_order: Dict[str, int] = {}
+        self.inter_affinity: Dict[str, Set[str]] = {}
+        self.self_affinity: Set[str] = set()
+        self.inter_anti_affinity: Dict[str, Set[str]] = {}
+        self.self_anti_affinity: Set[str] = set()
+        self.bucket_max_size = 0
+
+    # ---------------------------------------------------------- topology
+    def _mark(self, role: str, priority: int) -> None:
+        if priority > self.task_affinity_priority.get(role, 0):
+            self.task_affinity_priority[role] = priority
+
+    def apply_topology(self, affinity: List[List[str]],
+                       anti_affinity: List[List[str]],
+                       task_order: List[str]) -> None:
+        """ApplyTaskTopology (manager.go:111-148): group lists -> pairwise
+        matrices; single-role groups mean self-(anti-)affinity."""
+        for group in affinity:
+            if len(group) == 1:
+                self.self_affinity.add(group[0])
+                self._mark(group[0], _PRI_SELF_AFF)
+                continue
+            for i, src in enumerate(group):
+                for dst in group[:i]:
+                    self.inter_affinity.setdefault(src, set()).add(dst)
+                    self.inter_affinity.setdefault(dst, set()).add(src)
+                self._mark(src, _PRI_INTER_AFF)
+        for group in anti_affinity:
+            if len(group) == 1:
+                self.self_anti_affinity.add(group[0])
+                self._mark(group[0], _PRI_SELF_ANTI)
+                continue
+            for i, src in enumerate(group):
+                for dst in group[:i]:
+                    self.inter_anti_affinity.setdefault(src, set()).add(dst)
+                    self.inter_anti_affinity.setdefault(dst, set()).add(src)
+                self._mark(src, _PRI_INTER_ANTI)
+        for i, role in enumerate(task_order):
+            self.task_exist_order[role] = len(task_order) - i
+
+    # ------------------------------------------------------------ ordering
+    def task_affinity_order(self, l_role: str, r_role: str) -> int:
+        """manager.go:168-199: user-defined order first, then topology
+        priority; 1 = l first."""
+        if l_role == r_role:
+            return 0
+        lo = self.task_exist_order.get(l_role, 0)
+        ro = self.task_exist_order.get(r_role, 0)
+        if lo != ro:
+            return 1 if lo > ro else -1
+        lp = self.task_affinity_priority.get(l_role, 0)
+        rp = self.task_affinity_priority.get(r_role, 0)
+        if lp != rp:
+            return 1 if lp > rp else -1
+        return 0
+
+    def check_task_set_affinity(self, role: str, name_set: Dict[str, int],
+                                only_anti: bool) -> int:
+        """manager.go:231-264: net affinity of ``role`` toward a bucket's
+        role multiset."""
+        score = 0
+        if not role:
+            return 0
+        for other, count in name_set.items():
+            same = other == role
+            if not only_anti:
+                aff = (role in self.self_affinity if same
+                       else other in self.inter_affinity.get(role, ()))
+                if aff:
+                    score += count
+            anti = (role in self.self_anti_affinity if same
+                    else other in self.inter_anti_affinity.get(role, ()))
+            if anti:
+                score -= count
+        return score
+
+    # ------------------------------------------------------------- buckets
+    def construct_buckets(self, tasks: List) -> None:
+        """ConstructBucket (manager.go:306-318): order tasks (placed first,
+        then the affinity comparator descending), then greedily assign each
+        to the bucket with the best net affinity, balancing resource scores
+        on ties; negative affinity opens a fresh bucket (buildBucket,
+        manager.go:266-304)."""
+        managed = []
+        for task in tasks:
+            role = _task_role(task)
+            if not role or role not in self.task_affinity_priority:
+                self.pod_in_bucket[task.uid] = OUT_OF_BUCKET
+                continue
+            managed.append((role, task))
+
+        def cmp(a, b):
+            ha, hb = bool(a[1].node_name), bool(b[1].node_name)
+            if ha != hb:
+                return -1 if ha else 1           # placed tasks first
+            return -self.task_affinity_order(a[0], b[0])
+
+        managed.sort(key=functools.cmp_to_key(cmp))
+
+        node_bucket: Dict[str, Bucket] = {}
+        for role, task in managed:
+            selected: Optional[Bucket] = None
+            max_aff = -(1 << 31)
+            if task.node_name:
+                max_aff = 0
+                selected = node_bucket.get(task.node_name)
+            else:
+                for bucket in self.buckets:
+                    aff = self.check_task_set_affinity(
+                        role, bucket.task_name_set, only_anti=False)
+                    if aff > max_aff:
+                        max_aff, selected = aff, bucket
+                    elif (aff == max_aff and selected is not None
+                          and bucket.req_score < selected.req_score):
+                        selected = bucket
+            if max_aff < 0 or selected is None:
+                selected = Bucket(len(self.buckets))
+                self.buckets.append(selected)
+                if task.node_name:
+                    node_bucket[task.node_name] = selected
+            self.pod_in_bucket[task.uid] = selected.index
+            selected.add_task(role, task)
+            self.bucket_max_size = max(self.bucket_max_size, selected.size)
+
+    def get_bucket(self, uid: str) -> Optional[Bucket]:
+        idx = self.pod_in_bucket.get(uid, OUT_OF_BUCKET)
+        return None if idx == OUT_OF_BUCKET else self.buckets[idx]
+
+
 class TaskTopologyPlugin(Plugin):
     name = "task-topology"
 
+    def _job_topology(self, job):
+        """(affinity, anti, order) groups from the job's annotations, or
+        the plugin arguments as the every-job fallback."""
+        ann = getattr(job, "annotations", {}) or {}
+        aff = ann.get(AFFINITY_ANNOTATION, self.arg(AFFINITY_ARG, ""))
+        anti = ann.get(ANTI_AFFINITY_ANNOTATION,
+                       self.arg(ANTI_AFFINITY_ARG, ""))
+        order = ann.get(TASK_ORDER_ANNOTATION, self.arg(TASK_ORDER_ARG, ""))
+        return (_parse_groups(aff or ""), _parse_groups(anti or ""),
+                [r.strip() for r in str(order or "").split(",") if r.strip()])
+
+    def managers(self, ssn) -> Dict[str, JobManager]:
+        """Per-session JobManagers (initBucket, topology.go:215-240)."""
+        cached = getattr(ssn, "_topology_managers", None)
+        if cached is not None:
+            return cached
+        managers: Dict[str, JobManager] = {}
+        for uid, job in ssn.cluster.jobs.items():
+            aff, anti, order = self._job_topology(job)
+            if not (aff or anti or order):
+                continue
+            jm = JobManager(uid)
+            jm.apply_topology(aff, anti, order)
+            jm.construct_buckets(list(job.tasks.values()))
+            managers[uid] = jm
+        ssn._topology_managers = managers
+        return managers
+
+    def on_session_open(self, ssn) -> None:
+        """Reorder each managed job's pending task table to the
+        TaskOrderFn semantics (topology.go:61-131): in-bucket before
+        out-of-bucket, larger bucket first, older bucket first, then the
+        user-order / priority comparator — ahead of the packed (priority,
+        insertion) fallback order."""
+        managers = self.managers(ssn)
+        if not managers:
+            return
+        table = np.asarray(ssn.snap.jobs.task_table).copy()
+        uids = ssn.maps.task_uids
+        changed = False
+        for juid, jm in managers.items():
+            ji = ssn.maps.job_index.get(juid)
+            if ji is None:
+                continue
+            row = table[ji]
+            real = row[row >= 0]
+            if not len(real):
+                continue
+
+            def key(ti):
+                uid = uids[int(ti)]
+                bucket = jm.get_bucket(uid)
+                if bucket is None:
+                    return (1, 0, 0, 0, 0)
+                _job, task = ssn._task_lookup.get(uid, (None, None))
+                role = _task_role(task) if task is not None else ""
+                return (0, -bucket.size, bucket.index,
+                        -jm.task_exist_order.get(role, 0),
+                        -jm.task_affinity_priority.get(role, 0))
+
+            order = sorted(range(len(real)),
+                           key=lambda i: (key(real[i]), i))
+            table[ji, :len(real)] = real[order]
+            changed = True
+        if changed:
+            import dataclasses
+            ssn.snap = dataclasses.replace(
+                ssn.snap, jobs=dataclasses.replace(
+                    ssn.snap.jobs, task_table=table))
+
     def task_pref_node(self, ssn) -> np.ndarray:
-        """i32[T]: preferred node per pending task — the node already hosting
-        a bucket-mate (affine running/bound task of the same job)."""
+        """i32[T]: preferred node per pending task — the node already
+        holding the most of its bucket (calcBucketScore's base term,
+        topology.go:150-163, as a static per-cycle steer)."""
         T = np.asarray(ssn.snap.tasks.status).shape[0]
         pref = np.full(T, -1, np.int32)
-        affinity = _parse_pairs(self.arg(AFFINITY_ARG, ""))
-        if not affinity:
-            return pref
-        for uid, job in ssn.cluster.jobs.items():
-            # node of the first placed task per role
-            role_node: Dict[str, str] = {}
-            for task in job.tasks.values():
-                if task.node_name and task.task_role:
-                    role_node.setdefault(task.task_role, task.node_name)
-            if not role_node:
+        for juid, jm in self.managers(ssn).items():
+            job = ssn.cluster.jobs.get(juid)
+            if job is None:
                 continue
             for task in job.tasks.values():
                 ti = ssn.maps.task_index.get(task.uid)
                 if ti is None or task.node_name:
                     continue
-                for group in affinity:
-                    if task.task_role in group:
-                        for other in group:
-                            node = role_node.get(other)
-                            if node and node in ssn.maps.node_index:
-                                pref[ti] = ssn.maps.node_index[node]
-                                break
+                bucket = jm.get_bucket(task.uid)
+                if bucket is None or not bucket.node:
+                    continue
+                best = max(sorted(bucket.node), key=lambda n: bucket.node[n])
+                ni = ssn.maps.node_index.get(best, -1)
+                pref[ti] = ni
         return pref
