@@ -1,4 +1,4 @@
-// Native snapshot packer: VCS2 wire buffer -> dense scheduling arrays.
+// Native snapshot packer: VCS3 wire buffer -> dense scheduling arrays.
 //
 // This is the framework's native runtime component: the host-side hot path
 // that turns a serialized cluster snapshot (the payload that crosses the
@@ -9,16 +9,19 @@
 // reference's equivalent moment is SchedulerCache.Snapshot deep-copying the
 // cluster mirror (pkg/scheduler/cache/cache.go:712-811).
 //
-// Wire format VCS2 (little-endian; see volcano_tpu/native/wire.py):
-//   u32 magic 'VCS2' (0x32534356), u32 R, nq, ns, nn, nj, nt
+// Wire format VCS3 (little-endian; see volcano_tpu/native/wire.py):
+//   u32 magic 'VCS3' (0x33534356), u32 R, nq, ns, nn, nj, nt
 //   R   x string            resource dimension names (informational)
-//   nq  x queue record      (sorted by name)
+//   nq  x queue record      (sorted by name; per-record, Q is small)
 //   ns  x namespace record  (sorted by name)
-//   nn  x node record       (sorted by name)
-//   nj  x job record        (sorted by uid)
-//   nt  x task record       (job-major, insertion order within job)
-// Strings are u32 length + UTF-8 bytes.  Label/taint/selector/toleration
-// sets are carried as precomputed 31-bit hashes (arrays/labels.py encoding).
+//   node section            COLUMNAR (sorted by name)
+//   job section             COLUMNAR (sorted by uid)
+//   task section            COLUMNAR (job-major, insertion order in job)
+// Columnar sections: a string column (u32 blob_len | u32[n] lens | blob),
+// then one array per fixed-width field ([n] or [n,R], row-major), then
+// ragged sets as u32 total | u32[n] counts | flat values.  Strings are
+// u32 length + UTF-8 bytes; label/taint/selector/toleration sets carry
+// precomputed 31-bit hashes (arrays/labels.py encoding).
 
 #include <algorithm>
 #include <cstdint>
@@ -32,7 +35,7 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x32534356u;  // "VCS2"
+constexpr uint32_t kMagic = 0x33534356u;  // "VCS3"
 
 // TaskStatus codes (volcano_tpu/api/types.py:14-36; reference
 // pkg/scheduler/api/types.go:29-96).
@@ -225,7 +228,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   std::memset(a, 0, sizeof(*a));
   Reader r{buf, buf + len};
   if (r.U32() != kMagic) {
-    a->error = "bad magic (not a VCS2 buffer)";
+    a->error = "bad magic (not a VCS3 buffer)";
     return 1;
   }
   const uint32_t R = r.U32();
@@ -344,50 +347,64 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   a->n_schedulable = bmalloc(N);
   a->n_valid = bmalloc(N);
   VC_CHECK_ALLOC();
-  // Two passes over variable-width label/taint sets would complicate the
-  // reader; instead collect into vectors, then pad to the max width.
-  std::vector<std::vector<int32_t>> labels(nn), tkv(nn), tkey(nn), teff(nn);
-  std::vector<std::vector<float>> gmem(nn), gused(nn);
-  for (uint32_t i = 0; i < nn; ++i) {
-    r.SkipString();
-    r.F32Vec(a->n_idle + int64_t(i) * R, R);
-    r.F32Vec(a->n_used + int64_t(i) * R, R);
-    r.F32Vec(a->n_releasing + int64_t(i) * R, R);
-    r.F32Vec(a->n_pipelined + int64_t(i) * R, R);
-    r.F32Vec(a->n_allocatable + int64_t(i) * R, R);
-    r.F32Vec(a->n_capability + int64_t(i) * R, R);
-    a->n_pod_count[i] = r.I32();
-    a->n_max_pods[i] = r.I32();
-    a->n_schedulable[i] = r.U8();
-    a->n_valid[i] = 1;
-    // shared-GPU cards (device_info.go:24-53): G x (memory, used)
-    uint32_t ng = r.U32();
-    if (!r.Need(8ull * ng)) break;
-    gmem[i].resize(ng);
-    gused[i].resize(ng);
-    for (uint32_t g = 0; g < ng; ++g) {
-      gmem[i][g] = r.F32();
-      gused[i][g] = r.F32();
+  // Columnar node section (VCS3): bulk memcpy reads; variable-width sets
+  // arrive as a count column + one flat array.
+  auto SkipStringColumn = [&](uint32_t n) {
+    uint32_t blob = r.U32();
+    r.Skip(4ull * n);
+    r.Skip(blob);
+  };
+  auto ReadCounts = [&](uint32_t n, std::vector<uint32_t>* counts,
+                        uint32_t* total) {
+    *total = r.U32();
+    counts->assign(n, 0);
+    if (n && r.Need(4ull * n)) {
+      std::memcpy(counts->data(), r.p, 4ull * n);
+      r.p += 4ull * n;
     }
-    uint32_t nl = r.U32();
-    if (!r.Need(4ull * nl)) break;
-    labels[i].resize(nl);
-    r.I32Vec(labels[i].data(), nl);
-    uint32_t ntn = r.U32();
-    if (!r.Need(12ull * ntn)) break;
-    tkv[i].resize(ntn);
-    tkey[i].resize(ntn);
-    teff[i].resize(ntn);
-    for (uint32_t t = 0; t < ntn; ++t) {
-      tkv[i][t] = r.I32();
-      tkey[i][t] = r.I32();
-      teff[i][t] = r.I32();
-    }
+  };
+  SkipStringColumn(nn);
+  // six [nn, R] matrices land in the first nn rows of the padded arrays
+  r.F32Vec(a->n_idle, nn * R);
+  r.F32Vec(a->n_used, nn * R);
+  r.F32Vec(a->n_releasing, nn * R);
+  r.F32Vec(a->n_pipelined, nn * R);
+  r.F32Vec(a->n_allocatable, nn * R);
+  r.F32Vec(a->n_capability, nn * R);
+  r.I32Vec(a->n_pod_count, nn);
+  r.I32Vec(a->n_max_pods, nn);
+  if (nn && r.Need(nn)) {
+    std::memcpy(a->n_schedulable, r.p, nn);
+    r.p += nn;
   }
-  size_t maxl = 0, maxe = 0, maxg = 0;
-  for (auto& v : labels) maxl = std::max(maxl, v.size());
-  for (auto& v : tkv) maxe = std::max(maxe, v.size());
-  for (auto& v : gmem) maxg = std::max(maxg, v.size());
+  for (uint32_t i = 0; i < nn; ++i) a->n_valid[i] = 1;
+  uint32_t gtotal = 0, ltotal = 0, tntotal = 0;
+  std::vector<uint32_t> gcnt, lcnt, tcnt;
+  ReadCounts(nn, &gcnt, &gtotal);
+  if (!r.Need(8ull * gtotal)) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  std::vector<float> gflat(2ull * gtotal);
+  r.F32Vec(gflat.data(), 2 * gtotal);
+  ReadCounts(nn, &lcnt, &ltotal);
+  if (!r.Need(4ull * ltotal)) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  std::vector<int32_t> lflat(ltotal);
+  r.I32Vec(lflat.data(), ltotal);
+  ReadCounts(nn, &tcnt, &tntotal);
+  if (!r.Need(12ull * tntotal)) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  std::vector<int32_t> tflat(3ull * tntotal);
+  r.I32Vec(tflat.data(), 3 * tntotal);
+  uint32_t maxl = 0, maxe = 0, maxg = 0;
+  for (auto v : lcnt) maxl = std::max(maxl, v);
+  for (auto v : tcnt) maxe = std::max(maxe, v);
+  for (auto v : gcnt) maxg = std::max(maxg, v);
   const int32_t L = std::max<int32_t>(static_cast<int32_t>(maxl), 1);
   const int32_t E = std::max<int32_t>(static_cast<int32_t>(maxe), 1);
   // Power-of-two bucketed like arrays/pack.py (buckets.get("G", 1)).
@@ -402,16 +419,21 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   a->n_gpu_memory = fmalloc(int64_t(N) * G);
   a->n_gpu_used = fmalloc(int64_t(N) * G);
   VC_CHECK_ALLOC();
-  for (uint32_t i = 0; i < nn; ++i) {
-    std::copy(labels[i].begin(), labels[i].end(), a->n_labels + int64_t(i) * L);
-    std::copy(tkv[i].begin(), tkv[i].end(), a->n_taint_kv + int64_t(i) * E);
-    std::copy(tkey[i].begin(), tkey[i].end(), a->n_taint_key + int64_t(i) * E);
-    std::copy(teff[i].begin(), teff[i].end(),
-              a->n_taint_effect + int64_t(i) * E);
-    std::copy(gmem[i].begin(), gmem[i].end(),
-              a->n_gpu_memory + int64_t(i) * G);
-    std::copy(gused[i].begin(), gused[i].end(),
-              a->n_gpu_used + int64_t(i) * G);
+  {
+    uint64_t go = 0, lo = 0, to = 0;
+    for (uint32_t i = 0; i < nn; ++i) {
+      for (uint32_t g = 0; g < gcnt[i]; ++g, ++go) {
+        a->n_gpu_memory[int64_t(i) * G + g] = gflat[2 * go];
+        a->n_gpu_used[int64_t(i) * G + g] = gflat[2 * go + 1];
+      }
+      for (uint32_t l2 = 0; l2 < lcnt[i]; ++l2, ++lo)
+        a->n_labels[int64_t(i) * L + l2] = lflat[lo];
+      for (uint32_t t = 0; t < tcnt[i]; ++t, ++to) {
+        a->n_taint_kv[int64_t(i) * E + t] = tflat[3 * to];
+        a->n_taint_key[int64_t(i) * E + t] = tflat[3 * to + 1];
+        a->n_taint_effect[int64_t(i) * E + t] = tflat[3 * to + 2];
+      }
+    }
   }
 
   // --------------------------------------------------------------- jobs
@@ -433,27 +455,34 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   VC_CHECK_ALLOC();
   std::vector<int32_t> job_queue_raw(nj, -1);
   std::vector<double> job_ts(nj, 0.0);
-  std::vector<uint8_t> job_gang_valid(nj, 0);
+  SkipStringColumn(nj);
+  r.I32Vec(a->j_min_available, nj);
+  r.I32Vec(job_queue_raw.data(), nj);
+  r.I32Vec(a->j_namespace, nj);
+  r.I32Vec(a->j_priority, nj);
+  if (nj && r.Need(8ull * nj)) {
+    std::memcpy(job_ts.data(), r.p, 8ull * nj);
+    r.p += 8ull * nj;
+  }
+  r.I32Vec(a->j_ready_num, nj);
+  r.F32Vec(a->j_allocated, nj * R);
+  r.F32Vec(a->j_min_resources, nj * R);
+  std::vector<uint8_t> jflags(3ull * nj, 0);
+  if (nj && r.Need(3ull * nj)) {
+    std::memcpy(jflags.data(), r.p, 3ull * nj);
+    r.p += 3ull * nj;
+  }
   for (uint32_t i = 0; i < nj; ++i) {
-    r.SkipString();
-    a->j_min_available[i] = r.I32();
-    job_queue_raw[i] = r.I32();
-    a->j_namespace[i] = r.I32();
-    a->j_priority[i] = r.I32();
-    job_ts[i] = r.F64();
-    a->j_ready_num[i] = r.I32();
-    r.F32Vec(a->j_allocated + int64_t(i) * R, R);
-    r.F32Vec(a->j_min_resources + int64_t(i) * R, R);
-    a->j_pending_phase[i] = r.U8();
-    job_gang_valid[i] = r.U8();
-    a->j_preemptable[i] = r.U8();
+    a->j_pending_phase[i] = jflags[3ull * i];
+    const uint8_t gang_valid = jflags[3ull * i + 1];
+    a->j_preemptable[i] = jflags[3ull * i + 2];
     a->j_valid[i] = 1;
     a->j_queue[i] = std::max(job_queue_raw[i], 0);
     a->j_inqueue[i] = !a->j_pending_phase[i];
     bool queue_open = job_queue_raw[i] >= 0 &&
                       job_queue_raw[i] < static_cast<int32_t>(nq) &&
                       a->q_open[job_queue_raw[i]];
-    a->j_schedulable[i] = job_gang_valid[i] && queue_open && a->j_inqueue[i];
+    a->j_schedulable[i] = gang_valid && queue_open && a->j_inqueue[i];
   }
   // creation_rank: stable sort of uid-sorted jobs by creation timestamp
   // (arrays/pack.py:239-240).
@@ -481,33 +510,48 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     a->t_job[i] = -1;
     a->t_node[i] = -1;
   }
-  std::vector<std::vector<int32_t>> sel(nt), tolh(nt), tole(nt), tolm(nt);
+  SkipStringColumn(nt);
+  r.I32Vec(a->t_job, nt);
+  r.F32Vec(a->t_resreq, nt * R);
+  r.I32Vec(a->t_status, nt);
+  r.I32Vec(a->t_priority, nt);
+  r.I32Vec(a->t_node, nt);
+  std::vector<uint8_t> tflags(2ull * nt, 0);
+  if (nt && r.Need(2ull * nt)) {
+    std::memcpy(tflags.data(), r.p, 2ull * nt);
+    r.p += 2ull * nt;
+  }
+  r.F32Vec(a->t_gpu_request, nt);
+  uint32_t stotal = 0, ototal = 0;
+  std::vector<uint32_t> scnt, ocnt;
+  ReadCounts(nt, &scnt, &stotal);
+  if (!r.Need(4ull * stotal)) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  std::vector<int32_t> sflat(stotal);
+  r.I32Vec(sflat.data(), stotal);
+  ReadCounts(nt, &ocnt, &ototal);
+  if (!r.Need(12ull * ototal)) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  std::vector<int32_t> oflat(3ull * ototal);
+  r.I32Vec(oflat.data(), 3 * ototal);
+  if (!r.ok) {
+    a->error = "truncated buffer";
+    return 1;
+  }
+  std::vector<uint64_t> soff(nt + 1, 0), ooff(nt + 1, 0);
+  for (uint32_t i = 0; i < nt; ++i) {
+    soff[i + 1] = soff[i] + scnt[i];
+    ooff[i + 1] = ooff[i] + ocnt[i];
+  }
   std::vector<std::vector<int32_t>> pending(nj);
   for (uint32_t i = 0; i < nt; ++i) {
-    r.SkipString();
-    a->t_job[i] = r.I32();
-    r.F32Vec(a->t_resreq + int64_t(i) * R, R);
-    a->t_status[i] = r.I32();
-    a->t_priority[i] = r.I32();
-    a->t_node[i] = r.I32();
-    a->t_best_effort[i] = r.U8();
-    a->t_preemptable[i] = r.U8();
-    a->t_gpu_request[i] = r.F32();
+    a->t_best_effort[i] = tflags[2ull * i];
+    a->t_preemptable[i] = tflags[2ull * i + 1];
     a->t_valid[i] = 1;
-    uint32_t nsel = r.U32();
-    if (!r.Need(4ull * nsel)) break;
-    sel[i].resize(nsel);
-    r.I32Vec(sel[i].data(), nsel);
-    uint32_t ntol = r.U32();
-    if (!r.Need(12ull * ntol)) break;
-    tolh[i].resize(ntol);
-    tole[i].resize(ntol);
-    tolm[i].resize(ntol);
-    for (uint32_t t = 0; t < ntol; ++t) {
-      tolh[i][t] = r.I32();
-      tole[i][t] = r.I32();
-      tolm[i][t] = r.I32();
-    }
     const int32_t ji = a->t_job[i];
     if (ji >= 0 && ji < static_cast<int32_t>(nj)) {
       if (a->t_status[i] == kStatusPending) pending[ji].push_back(i);
@@ -518,13 +562,9 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
       }
     }
   }
-  if (!r.ok) {
-    a->error = "truncated buffer";
-    return 1;
-  }
-  size_t maxk = 0, maxo = 0;
-  for (auto& v : sel) maxk = std::max(maxk, v.size());
-  for (auto& v : tolh) maxo = std::max(maxo, v.size());
+  uint32_t maxk = 0, maxo = 0;
+  for (auto v : scnt) maxk = std::max(maxk, v);
+  for (auto v : ocnt) maxo = std::max(maxo, v);
   const int32_t K = std::max<int32_t>(static_cast<int32_t>(maxk), 1);
   const int32_t O = std::max<int32_t>(static_cast<int32_t>(maxo), 1);
   a->K = K;
@@ -535,11 +575,14 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   a->t_tol_mode = imalloc(int64_t(T) * O);
   VC_CHECK_ALLOC();
   for (uint32_t i = 0; i < nt; ++i) {
-    std::copy(sel[i].begin(), sel[i].end(), a->t_selector + int64_t(i) * K);
-    std::copy(tolh[i].begin(), tolh[i].end(), a->t_tol_hash + int64_t(i) * O);
-    std::copy(tole[i].begin(), tole[i].end(),
-              a->t_tol_effect + int64_t(i) * O);
-    std::copy(tolm[i].begin(), tolm[i].end(), a->t_tol_mode + int64_t(i) * O);
+    for (uint32_t k = 0; k < scnt[i]; ++k)
+      a->t_selector[int64_t(i) * K + k] = sflat[soff[i] + k];
+    for (uint32_t o = 0; o < ocnt[i]; ++o) {
+      const uint64_t src = 3ull * (ooff[i] + o);
+      a->t_tol_hash[int64_t(i) * O + o] = oflat[src];
+      a->t_tol_effect[int64_t(i) * O + o] = oflat[src + 1];
+      a->t_tol_mode[int64_t(i) * O + o] = oflat[src + 2];
+    }
   }
 
   // Predicate templates: tasks with identical selector/toleration rows share
@@ -552,14 +595,18 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     std::vector<int32_t> reps;
     for (uint32_t i = 0; i < nt; ++i) {
       std::vector<int32_t> key;
-      key.reserve(sel[i].size() + 3 * tolh[i].size() + 4);
-      key.insert(key.end(), sel[i].begin(), sel[i].end());
+      key.reserve(scnt[i] + 3ull * ocnt[i] + 4);
+      for (uint32_t k = 0; k < scnt[i]; ++k)
+        key.push_back(sflat[soff[i] + k]);
       key.push_back(std::numeric_limits<int32_t>::min());
-      key.insert(key.end(), tolh[i].begin(), tolh[i].end());
+      for (uint32_t o = 0; o < ocnt[i]; ++o)
+        key.push_back(oflat[3ull * (ooff[i] + o)]);
       key.push_back(std::numeric_limits<int32_t>::min());
-      key.insert(key.end(), tole[i].begin(), tole[i].end());
+      for (uint32_t o = 0; o < ocnt[i]; ++o)
+        key.push_back(oflat[3ull * (ooff[i] + o) + 1]);
       key.push_back(std::numeric_limits<int32_t>::min());
-      key.insert(key.end(), tolm[i].begin(), tolm[i].end());
+      for (uint32_t o = 0; o < ocnt[i]; ++o)
+        key.push_back(oflat[3ull * (ooff[i] + o) + 2]);
       auto it = template_of.find(key);
       int32_t tid;
       if (it == template_of.end()) {

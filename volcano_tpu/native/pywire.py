@@ -1,4 +1,4 @@
-"""Pure-Python VCS2 parser: wire buffer -> SnapshotArrays.
+"""Pure-Python VCS3 parser: wire buffer -> SnapshotArrays.
 
 The fallback half of the native packing runtime (packer.cc is the fast
 path): keeps the scheduling sidecar usable on hosts without g++, and acts
@@ -22,7 +22,7 @@ import numpy as np
 from ..arrays.schema import (JobArrays, NodeArrays, QueueArrays,
                              SnapshotArrays, TaskArrays)
 
-MAGIC = 0x32534356  # "VCS2"
+MAGIC = 0x33534356  # "VCS3"
 
 # TaskStatus codes (volcano_tpu/api/types.py; pkg/scheduler/api/types.go:29-96)
 _STATUS_PENDING = 0
@@ -90,17 +90,17 @@ class _Reader:
 
 
 def pack_wire_py(buf: bytes) -> SnapshotArrays:
-    """Parse a VCS2 buffer into SnapshotArrays (pure Python/numpy)."""
+    """Parse a VCS3 buffer into SnapshotArrays (pure Python/numpy)."""
     try:
         return _parse(buf)
     except (struct.error, IndexError) as e:
-        raise ValueError(f"truncated or corrupt VCS2 buffer: {e}") from None
+        raise ValueError(f"truncated or corrupt VCS3 buffer: {e}") from None
 
 
 def _parse(buf: bytes) -> SnapshotArrays:
     r = _Reader(buf)
     if r.u32() != MAGIC:
-        raise ValueError("bad magic (not a VCS2 buffer)")
+        raise ValueError("bad magic (not a VCS3 buffer)")
     R = r.u32()
     nq, ns, nn, nj, nt = (r.u32() for _ in range(5))
     if R == 0 or R > 1024:
@@ -149,53 +149,60 @@ def _parse(buf: bytes) -> SnapshotArrays:
         r.skip_string()
         ns_weight[i] = max(r.f32(), 1.0)
 
-    # -------------------------------------------------------------- nodes
+    def skip_string_column(n):
+        blob_len = r.u32()
+        r.off += 4 * n + blob_len
+
+    def ragged(n, dtype, per=1):
+        """u32 total | u32[n] counts | dtype[total*per] -> (counts, flat)."""
+        total = r.u32()
+        counts = np.frombuffer(r.buf, "<u4", n, r.off).astype(np.int64)
+        r.off += 4 * n
+        if dtype == f32:
+            flat = r.f32vec(total * per)
+        else:
+            flat = r.i32vec(total * per)
+        return counts, flat
+
+    def pad_from_flat(counts, flat, width, total_rows, dtype):
+        out = np.zeros((total_rows, width), dtype)
+        if len(flat):
+            row_idx = np.repeat(np.arange(len(counts)), counts)
+            offs = np.concatenate(([0], np.cumsum(counts)[:-1]))
+            col_idx = np.arange(len(flat) // 1) - np.repeat(offs, counts)
+            out[row_idx, col_idx] = flat
+        return out
+
+    # ------------------------------------------------ nodes (columnar)
     n_res = np.zeros((6, N, R), f32)  # idle/used/releasing/pipelined/alloc/cap
     n_pod_count = np.zeros(N, i32)
     n_max_pods = np.zeros(N, i32)
     n_schedulable = np.zeros(N, bool)
     n_valid = np.zeros(N, bool)
-    labels, tkv, tkey, teff, gmem, gused = ([], [], [], [], [], [])
-    for i in range(nn):
-        r.skip_string()
-        for k in range(6):
-            n_res[k, i] = r.f32vec(R)
-        n_pod_count[i] = r.i32()
-        n_max_pods[i] = r.i32()
-        n_schedulable[i] = bool(r.u8())
-        n_valid[i] = True
-        ng = r.u32()
-        gm = np.zeros(ng, f32)
-        gu = np.zeros(ng, f32)
-        for g in range(ng):
-            gm[g] = r.f32()
-            gu[g] = r.f32()
-        gmem.append(gm)
-        gused.append(gu)
-        nl = r.u32()
-        labels.append(r.i32vec(nl))
-        ntn = r.u32()
-        trow = r.i32vec(3 * ntn).reshape(ntn, 3) if ntn else np.zeros((0, 3), i32)
-        tkv.append(trow[:, 0])
-        tkey.append(trow[:, 1])
-        teff.append(trow[:, 2])
+    skip_string_column(nn)
+    for k in range(6):
+        n_res[k, :nn] = r.f32vec(nn * R).reshape(nn, R)
+    n_pod_count[:nn] = r.i32vec(nn)
+    n_max_pods[:nn] = r.i32vec(nn)
+    n_schedulable[:nn] = np.frombuffer(r.buf, "u1", nn, r.off) != 0
+    r.off += nn
+    n_valid[:nn] = True
+    gcounts, gflat = ragged(nn, f32, per=2)
+    gpairs = gflat.reshape(-1, 2) if len(gflat) else np.zeros((0, 2), f32)
+    lcounts, lflat = ragged(nn, i32)
+    tcounts, tflat = ragged(nn, i32, per=3)
+    ttrip = tflat.reshape(-1, 3) if len(tflat) else np.zeros((0, 3), i32)
 
-    L = max(max((len(v) for v in labels), default=0), 1)
-    E = max(max((len(v) for v in tkv), default=0), 1)
-    G = _bucket(max(max((len(v) for v in gmem), default=0), 1), 1)
+    L = max(int(lcounts.max()) if nn else 0, 1)
+    E = max(int(tcounts.max()) if nn else 0, 1)
+    G = _bucket(max(int(gcounts.max()) if nn else 0, 1), 1)
 
-    def _pad_rows(rows, width, dtype, total):
-        out = np.zeros((total, width), dtype)
-        for i, v in enumerate(rows):
-            out[i, :len(v)] = v
-        return out
-
-    n_labels = _pad_rows(labels, L, i32, N)
-    n_taint_kv = _pad_rows(tkv, E, i32, N)
-    n_taint_key = _pad_rows(tkey, E, i32, N)
-    n_taint_effect = _pad_rows(teff, E, i32, N)
-    n_gpu_memory = _pad_rows(gmem, G, f32, N)
-    n_gpu_used = _pad_rows(gused, G, f32, N)
+    n_labels = pad_from_flat(lcounts, lflat, L, N, i32)
+    n_taint_kv = pad_from_flat(tcounts, ttrip[:, 0], E, N, i32)
+    n_taint_key = pad_from_flat(tcounts, ttrip[:, 1], E, N, i32)
+    n_taint_effect = pad_from_flat(tcounts, ttrip[:, 2], E, N, i32)
+    n_gpu_memory = pad_from_flat(gcounts, gpairs[:, 0], G, N, f32)
+    n_gpu_used = pad_from_flat(gcounts, gpairs[:, 1], G, N, f32)
 
     # --------------------------------------------------------------- jobs
     j_min_available = np.zeros(J, i32)
@@ -212,27 +219,27 @@ def _parse(buf: bytes) -> SnapshotArrays:
     j_pending_phase = np.zeros(J, bool)
     j_preemptable = np.zeros(J, bool)
     j_valid = np.zeros(J, bool)
-    job_queue_raw = np.full(nj, -1, i32)
-    job_ts = np.zeros(nj, np.float64)
-    for i in range(nj):
-        r.skip_string()
-        j_min_available[i] = r.i32()
-        job_queue_raw[i] = r.i32()
-        j_namespace[i] = r.i32()
-        j_priority[i] = r.i32()
-        job_ts[i] = r.f64()
-        j_ready_num[i] = r.i32()
-        j_allocated[i] = r.f32vec(R)
-        j_min_resources[i] = r.f32vec(R)
-        j_pending_phase[i] = bool(r.u8())
-        gang_valid = bool(r.u8())
-        j_preemptable[i] = bool(r.u8())
-        j_valid[i] = True
-        j_queue[i] = max(int(job_queue_raw[i]), 0)
-        j_inqueue[i] = not j_pending_phase[i]
-        queue_open = (0 <= job_queue_raw[i] < nq
-                      and bool(q_open[job_queue_raw[i]]))
-        j_schedulable[i] = gang_valid and queue_open and j_inqueue[i]
+    skip_string_column(nj)
+    j_min_available[:nj] = r.i32vec(nj)
+    job_queue_raw = r.i32vec(nj).copy()
+    j_namespace[:nj] = r.i32vec(nj)
+    j_priority[:nj] = r.i32vec(nj)
+    job_ts = np.frombuffer(r.buf, "<f8", nj, r.off).copy()
+    r.off += 8 * nj
+    j_ready_num[:nj] = r.i32vec(nj)
+    j_allocated[:nj] = r.f32vec(nj * R).reshape(nj, R)
+    j_min_resources[:nj] = r.f32vec(nj * R).reshape(nj, R)
+    jflags = np.frombuffer(r.buf, "u1", nj * 3, r.off).reshape(nj, 3)
+    r.off += 3 * nj
+    j_pending_phase[:nj] = jflags[:, 0] != 0
+    gang_valid = jflags[:, 1] != 0
+    j_preemptable[:nj] = jflags[:, 2] != 0
+    j_valid[:nj] = True
+    j_queue[:nj] = np.maximum(job_queue_raw, 0)
+    j_inqueue[:nj] = ~j_pending_phase[:nj]
+    queue_open = ((job_queue_raw >= 0) & (job_queue_raw < nq)
+                  & q_open[np.clip(job_queue_raw, 0, max(Q - 1, 0))])
+    j_schedulable[:nj] = gang_valid & queue_open & j_inqueue[:nj]
     # creation_rank: stable sort of uid-sorted jobs by creation timestamp
     order = np.argsort(job_ts[:nj], kind="stable")
     j_creation_rank[order] = np.arange(nj, dtype=i32)
@@ -247,66 +254,74 @@ def _parse(buf: bytes) -> SnapshotArrays:
     t_gpu_request = np.zeros(T, f32)
     t_preemptable = np.zeros(T, bool)
     t_valid = np.zeros(T, bool)
-    sel, tolh, tole, tolm = [], [], [], []
-    pending = [[] for _ in range(nj)]
-    for i in range(nt):
-        r.skip_string()
-        t_job[i] = r.i32()
-        t_resreq[i] = r.f32vec(R)
-        t_status[i] = r.i32()
-        t_priority[i] = r.i32()
-        t_node[i] = r.i32()
-        t_best_effort[i] = bool(r.u8())
-        t_preemptable[i] = bool(r.u8())
-        t_gpu_request[i] = r.f32()
-        t_valid[i] = True
-        nsel = r.u32()
-        sel.append(r.i32vec(nsel))
-        ntol = r.u32()
-        trow = r.i32vec(3 * ntol).reshape(ntol, 3) if ntol else np.zeros((0, 3), i32)
-        tolh.append(trow[:, 0])
-        tole.append(trow[:, 1])
-        tolm.append(trow[:, 2])
-        ji = int(t_job[i])
-        if 0 <= ji < nj:
-            if int(t_status[i]) == _STATUS_PENDING:
-                pending[ji].append(i)
-            if int(t_status[i]) in _COUNTS_FOR_REQUEST:
-                j_total_request[ji] += t_resreq[i]
+    skip_string_column(nt)
+    t_job[:nt] = r.i32vec(nt)
+    t_resreq[:nt] = r.f32vec(nt * R).reshape(nt, R)
+    t_status[:nt] = r.i32vec(nt)
+    t_priority[:nt] = r.i32vec(nt)
+    t_node[:nt] = r.i32vec(nt)
+    tflags2 = np.frombuffer(r.buf, "u1", nt * 2, r.off).reshape(nt, 2)
+    r.off += 2 * nt
+    t_best_effort[:nt] = tflags2[:, 0] != 0
+    t_preemptable[:nt] = tflags2[:, 1] != 0
+    t_gpu_request[:nt] = r.f32vec(nt)
+    t_valid[:nt] = True
+    scounts, sflat = ragged(nt, i32)
+    ocounts, oflat = ragged(nt, i32, per=3)
+    otrip = oflat.reshape(-1, 3) if len(oflat) else np.zeros((0, 3), i32)
 
-    K = max(max((len(v) for v in sel), default=0), 1)
-    O = max(max((len(v) for v in tolh), default=0), 1)
-    t_selector = _pad_rows(sel, K, i32, T)
-    t_tol_hash = _pad_rows(tolh, O, i32, T)
-    t_tol_effect = _pad_rows(tole, O, i32, T)
-    t_tol_mode = _pad_rows(tolm, O, i32, T)
+    K = max(int(scounts.max()) if nt else 0, 1)
+    O = max(int(ocounts.max()) if nt else 0, 1)
+    t_selector = pad_from_flat(scounts, sflat, K, T, i32)
+    t_tol_hash = pad_from_flat(ocounts, otrip[:, 0], O, T, i32)
+    t_tol_effect = pad_from_flat(ocounts, otrip[:, 1], O, T, i32)
+    t_tol_mode = pad_from_flat(ocounts, otrip[:, 2], O, T, i32)
+
+    # Job request accumulation (proportion request statuses) — np.add.at
+    # applies updates in ascending task order, matching the record loop.
+    in_job = (t_job[:nt] >= 0) & (t_job[:nt] < nj)
+    counts_mask = in_job & np.isin(t_status[:nt], list(_COUNTS_FOR_REQUEST))
+    np.add.at(j_total_request, t_job[:nt][counts_mask],
+              t_resreq[:nt][counts_mask])
 
     # Predicate templates: identical selector/toleration rows share one id,
-    # first-occurrence order (packer.cc:543-579; predicates/cache.go:42-67).
+    # first-occurrence order (packer.cc template dedupe;
+    # predicates/cache.go:42-67). Padded rows are unambiguous keys: counts
+    # differ only when a row holds trailing zero hashes, and 0 is the pad /
+    # invalid hash in this encoding.
+    sig = np.concatenate(
+        [t_selector[:nt], scounts[:, None].astype(i32),
+         t_tol_hash[:nt], t_tol_effect[:nt], t_tol_mode[:nt],
+         ocounts[:, None].astype(i32)], axis=1)
+    _u, first_idx, inv = np.unique(sig, axis=0, return_index=True,
+                                   return_inverse=True)
+    rank = np.empty(len(first_idx), i32)
+    rank[np.argsort(first_idx, kind="stable")] = np.arange(len(first_idx),
+                                                           dtype=i32)
     t_template = np.zeros(T, i32)
-    template_of = {}
-    reps = []
-    for i in range(nt):
-        key = (tuple(sel[i]), tuple(tolh[i]), tuple(tole[i]), tuple(tolm[i]))
-        tid = template_of.get(key)
-        if tid is None:
-            tid = len(reps)
-            template_of[key] = tid
-            reps.append(i)
-        t_template[i] = tid
+    t_template[:nt] = rank[inv.reshape(-1)]
+    reps = np.sort(first_idx).astype(i32)
     P = _bucket(max(len(reps), 1), 4)
     template_rep = np.full(P, -1, i32)
     template_rep[:len(reps)] = reps
 
-    # Pending-task tables: priority desc, insertion order within priority.
-    maxp = max((len(p) for p in pending), default=0)
+    # Pending-task tables: priority desc, insertion order within priority
+    # (lexsort keys are last-major: job, then -priority, then index).
+    pend_idx = np.nonzero(in_job & (t_status[:nt] == _STATUS_PENDING))[0]
+    order2 = pend_idx[np.lexsort(
+        (pend_idx, -t_priority[pend_idx], t_job[pend_idx]))]
+    per_job = np.bincount(t_job[order2], minlength=nj) if len(order2) \
+        else np.zeros(nj, np.int64)
+    maxp = int(per_job.max()) if nj else 0
     M = _bucket(maxp, 4)
     j_task_table = np.full((J, M), -1, i32)
     j_n_pending = np.zeros(J, i32)
-    for ji, p in enumerate(pending):
-        p = sorted(p, key=lambda t: (-int(t_priority[t]), t))
-        j_n_pending[ji] = len(p)
-        j_task_table[ji, :len(p)] = p
+    j_n_pending[:nj] = per_job
+    if len(order2):
+        offs = np.concatenate(([0], np.cumsum(per_job)[:-1]))
+        row_idx = t_job[order2]
+        col_idx = np.arange(len(order2)) - offs[row_idx]
+        j_task_table[row_idx, col_idx] = order2
 
     # Queue aggregates over member jobs (packer.cc:601-615).
     q_allocated = np.zeros((Q, R), f32)
@@ -358,14 +373,14 @@ def _parse(buf: bytes) -> SnapshotArrays:
 
 
 def decode_hierarchy(buf: bytes, job_queue, job_valid):
-    """VCS2 buffer -> HierarchyArrays, parsing only the (early) header and
+    """VCS3 buffer -> HierarchyArrays, parsing only the (early) header and
     queue records. ``job_queue``/``job_valid`` come from the already-decoded
     SnapshotArrays (the job section sits late in the buffer; its queue
     indices are all the tree needs for job leaves)."""
     from ..arrays.hierarchy import build_from_specs
     r = _Reader(buf)
     if r.u32() != MAGIC:
-        raise ValueError("bad magic (not a VCS2 buffer)")
+        raise ValueError("bad magic (not a VCS3 buffer)")
     R = r.u32()
     nq = r.u32()
     for _ in range(4):

