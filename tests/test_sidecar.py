@@ -91,7 +91,7 @@ class TestSidecar:
 class TestSidecarHDRF:
     def test_wire_carries_hierarchy_tree(self):
         """A conf-mode sidecar serving an hdrf policy rebuilds the exact
-        hierarchy tree from the VCS2 queue annotations and reproduces the
+        hierarchy tree from the VCS3 queue annotations and reproduces the
         reference's rescaling split (drf/hdrf_test.go:68-118) over the
         wire."""
         import numpy as np

@@ -72,7 +72,7 @@ def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):
 
     ``hierarchy`` (arrays/hierarchy.HierarchyArrays) supplies the hdrf tree
     topology when the conf enables drf hierarchy — either baked here or
-    passed per call (the sidecar rebuilds it from the VCS2 wire's queue
+    passed per call (the sidecar rebuilds it from the VCS3 wire's queue
     annotations via native/pywire.decode_hierarchy). An hdrf conf with no
     tree warns and degrades to a root-only tree (neutral queue keys)."""
     if conf is None or isinstance(conf, str):

@@ -355,13 +355,23 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
     r.Skip(blob);
   };
   auto ReadCounts = [&](uint32_t n, std::vector<uint32_t>* counts,
-                        uint32_t* total) {
+                        uint32_t* total) -> bool {
     *total = r.U32();
     counts->assign(n, 0);
     if (n && r.Need(4ull * n)) {
       std::memcpy(counts->data(), r.p, 4ull * n);
       r.p += 4ull * n;
     }
+    // the demux loops below trust the per-row counts, so a corrupt column
+    // must fail HERE: every count bounded by the total, and the counts
+    // summing exactly to it (the VCS2 reader Need()-checked per record;
+    // this is the columnar equivalent of that discipline)
+    uint64_t sum = 0;
+    for (uint32_t v : *counts) {
+      if (v > *total) return false;
+      sum += v;
+    }
+    return r.ok && sum == *total;
   };
   SkipStringColumn(nn);
   // six [nn, R] matrices land in the first nn rows of the padded arrays
@@ -380,22 +390,19 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   for (uint32_t i = 0; i < nn; ++i) a->n_valid[i] = 1;
   uint32_t gtotal = 0, ltotal = 0, tntotal = 0;
   std::vector<uint32_t> gcnt, lcnt, tcnt;
-  ReadCounts(nn, &gcnt, &gtotal);
-  if (!r.Need(8ull * gtotal)) {
+  if (!ReadCounts(nn, &gcnt, &gtotal) || !r.Need(8ull * gtotal)) {
     a->error = "truncated buffer";
     return 1;
   }
   std::vector<float> gflat(2ull * gtotal);
   r.F32Vec(gflat.data(), 2 * gtotal);
-  ReadCounts(nn, &lcnt, &ltotal);
-  if (!r.Need(4ull * ltotal)) {
+  if (!ReadCounts(nn, &lcnt, &ltotal) || !r.Need(4ull * ltotal)) {
     a->error = "truncated buffer";
     return 1;
   }
   std::vector<int32_t> lflat(ltotal);
   r.I32Vec(lflat.data(), ltotal);
-  ReadCounts(nn, &tcnt, &tntotal);
-  if (!r.Need(12ull * tntotal)) {
+  if (!ReadCounts(nn, &tcnt, &tntotal) || !r.Need(12ull * tntotal)) {
     a->error = "truncated buffer";
     return 1;
   }
@@ -524,15 +531,13 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   r.F32Vec(a->t_gpu_request, nt);
   uint32_t stotal = 0, ototal = 0;
   std::vector<uint32_t> scnt, ocnt;
-  ReadCounts(nt, &scnt, &stotal);
-  if (!r.Need(4ull * stotal)) {
+  if (!ReadCounts(nt, &scnt, &stotal) || !r.Need(4ull * stotal)) {
     a->error = "truncated buffer";
     return 1;
   }
   std::vector<int32_t> sflat(stotal);
   r.I32Vec(sflat.data(), stotal);
-  ReadCounts(nt, &ocnt, &ototal);
-  if (!r.Need(12ull * ototal)) {
+  if (!ReadCounts(nt, &ocnt, &ototal) || !r.Need(12ull * ototal)) {
     a->error = "truncated buffer";
     return 1;
   }

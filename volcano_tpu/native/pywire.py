@@ -93,7 +93,9 @@ def pack_wire_py(buf: bytes) -> SnapshotArrays:
     """Parse a VCS3 buffer into SnapshotArrays (pure Python/numpy)."""
     try:
         return _parse(buf)
-    except (struct.error, IndexError) as e:
+    except (struct.error, IndexError, ValueError) as e:
+        # columnar reads fail as numpy ValueErrors (short frombuffer,
+        # counts/flat mismatches); normalize them all
         raise ValueError(f"truncated or corrupt VCS3 buffer: {e}") from None
 
 
@@ -158,6 +160,8 @@ def _parse(buf: bytes) -> SnapshotArrays:
         total = r.u32()
         counts = np.frombuffer(r.buf, "<u4", n, r.off).astype(np.int64)
         r.off += 4 * n
+        if counts.sum() != total or (n and counts.max() > total):
+            raise ValueError("ragged column counts do not match total")
         if dtype == f32:
             flat = r.f32vec(total * per)
         else:
@@ -309,7 +313,8 @@ def _parse(buf: bytes) -> SnapshotArrays:
     # (lexsort keys are last-major: job, then -priority, then index).
     pend_idx = np.nonzero(in_job & (t_status[:nt] == _STATUS_PENDING))[0]
     order2 = pend_idx[np.lexsort(
-        (pend_idx, -t_priority[pend_idx], t_job[pend_idx]))]
+        (pend_idx, -t_priority[pend_idx].astype(np.int64),
+         t_job[pend_idx]))]
     per_job = np.bincount(t_job[order2], minlength=nj) if len(order2) \
         else np.zeros(nj, np.int64)
     maxp = int(per_job.max()) if nj else 0

@@ -62,13 +62,17 @@ def _string_column(out: List[bytes], strings: List[str]) -> None:
     out.append(blob)
 
 
-def _ragged_column(out: List[bytes], rows: List[List[int]]) -> None:
-    """u32 total | u32[n] counts | i32[total] flat values."""
-    total = sum(len(r) for r in rows)
-    out.append(_u32(total))
-    out.append(np.fromiter((len(r) for r in rows), dtype="<u4",
+def _ragged_column(out: List[bytes], rows: List[list], per: int = 1,
+                   dtype: str = "<i4") -> None:
+    """u32 total | u32[n] counts | dtype[total*per] flat values.
+
+    ``per`` is the arity of one logical entry (e.g. 3 for taint triples);
+    counts are logical entries, the flat array carries per*total values."""
+    flat_len = sum(len(r) for r in rows)
+    out.append(_u32(flat_len // per))
+    out.append(np.fromiter((len(r) // per for r in rows), dtype="<u4",
                            count=len(rows)).tobytes())
-    flat = np.empty(total, dtype="<i4")
+    flat = np.empty(flat_len, dtype=dtype)
     off = 0
     for r in rows:
         flat[off:off + len(r)] = r
@@ -164,28 +168,9 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     out.append(pod_count.tobytes())
     out.append(max_pods.tobytes())
     out.append(sched.tobytes())
-    # gpu pairs ride the ragged-i32 framing as f32 bits
-    gpu_total = sum(len(r) for r in gpu_rows) // 2
-    out.append(_u32(gpu_total))
-    out.append(np.fromiter((len(r) // 2 for r in gpu_rows), dtype="<u4",
-                           count=nn).tobytes())
-    gflat = np.empty(gpu_total * 2, dtype="<f4")
-    off = 0
-    for r in gpu_rows:
-        gflat[off:off + len(r)] = r
-        off += len(r)
-    out.append(gflat.tobytes())
+    _ragged_column(out, gpu_rows, per=2, dtype="<f4")
     _ragged_column(out, label_rows)
-    # taint counts are triples
-    out.append(_u32(sum(len(r) for r in taint_rows) // 3))
-    out.append(np.fromiter((len(r) // 3 for r in taint_rows), dtype="<u4",
-                           count=nn).tobytes())
-    tflat = np.empty(sum(len(r) for r in taint_rows), dtype="<i4")
-    off = 0
-    for r in taint_rows:
-        tflat[off:off + len(r)] = r
-        off += len(r)
-    out.append(tflat.tobytes())
+    _ragged_column(out, taint_rows, per=3)
 
     # ---- jobs (columnar) -------------------------------------------------
     j_min = np.empty(nj, dtype="<i4")
@@ -263,15 +248,6 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     for arr in (t_job, t_resreq, t_status, t_prio, t_node, t_flags, t_gpu):
         out.append(arr.tobytes())
     _ragged_column(out, sel_rows)
-    # toleration counts are triples
-    out.append(_u32(sum(len(r) for r in tol_rows) // 3))
-    out.append(np.fromiter((len(r) // 3 for r in tol_rows), dtype="<u4",
-                           count=nt).tobytes())
-    tolflat = np.empty(sum(len(r) for r in tol_rows), dtype="<i4")
-    off = 0
-    for r in tol_rows:
-        tolflat[off:off + len(r)] = r
-        off += len(r)
-    out.append(tolflat.tobytes())
+    _ragged_column(out, tol_rows, per=3)
 
     return b"".join(out), maps

@@ -3,14 +3,14 @@
 SURVEY.md section 5.8's distributed backbone for the north star: the
 API-layer process (the Go-equivalent control plane) serializes its cluster
 snapshot to this sidecar over the host network; the sidecar packs it with the
-native C++ packer (native/packer.cc, VCS2 wire format), runs the compiled
+native C++ packer (native/packer.cc, VCS3 wire format), runs the compiled
 TPU cycle, and streams the decision arrays back on the same connection. The
 reference needs no such component because its scheduler computes in-process
 (pkg/scheduler/scheduler.go:91 runOnce); here the compute lives on the TPU
 host, so the cycle boundary is a wire protocol.
 
 Framing (little-endian):
-    request:  u32 len | VCS2 snapshot buffer (native/wire.py serialize)
+    request:  u32 len | VCS3 snapshot buffer (native/wire.py serialize)
     response: u32 status (0 ok) | u32 len | payload
         ok payload: u32 magic 'VCD1' | u32 T | u32 J |
                     i32[T] task_node | i32[T] task_mode | i32[T] task_gpu |
@@ -80,7 +80,7 @@ class SchedulerSidecar:
             self._fn = jax.jit(lambda s, e: cycle(s, e).packed_decisions())
 
     def schedule_buffer(self, buf: bytes) -> bytes:
-        """VCS2 snapshot buffer -> VCD1 decision payload."""
+        """VCS3 snapshot buffer -> VCD1 decision payload."""
         from ..native import available, pack_wire
         if available():
             snap = pack_wire(buf)
