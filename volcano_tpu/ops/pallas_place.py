@@ -102,9 +102,10 @@ def _round_kernel(cfg, M, N, R, G,
     pref_v = pref_ref[:]            # [1, M] int32
     suffix_v = suffix_ref[:]        # [1, M] i32 queued tasks after slot m
     meta_v = meta_ref[:]            # [1, M] i32: [0]=ready0, [1]=min_avail
-    sfeas = sfeas_ref[:]            # [M, N] f32 0/1
-    sscore = sscore_ref[:]          # [M, N] taint-static
-    sscore2 = sscore2_ref[:]        # [M, N] node-affinity + tdm bonus
+    # sfeas/sscore/sscore2 [M, N] stay in their refs: the per-task row comes
+    # out as a dynamic SUBLANE slice below instead of a one-hot [M, N]
+    # reduction (which re-read the whole matrix every task — 3 x M x N x 4B
+    # per round of avoidable VMEM traffic)
     iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
     iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
     iota_m = jax.lax.broadcasted_iota(jnp.int32, (1, M), 1)
@@ -119,7 +120,6 @@ def _round_kernel(cfg, M, N, R, G,
         (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
          n_allocs, stopped, broke) = carry
         sel_m = (iota_m == m).astype(jnp.float32)            # [1,M]
-        sel_col = (iota_m_col == m).astype(jnp.float32)      # [M,1]
         rr_col = jnp.sum(resreq_t * sel_m, axis=1, keepdims=True)   # [R,1]
         gr = jnp.sum(gpu_req * sel_m, axis=1, keepdims=True)        # [1,1]
         act = jnp.sum(active_v * sel_m.astype(jnp.int32), axis=1,
@@ -127,9 +127,10 @@ def _round_kernel(cfg, M, N, R, G,
         pref = jnp.sum(pref_v * sel_m.astype(jnp.int32), axis=1,
                        keepdims=True)                               # [1,1]
         suffix = jnp.sum(jnp.where(iota_m == m, suffix_v, 0))       # scalar
-        sfeas_m = jnp.sum(sfeas * sel_col, axis=0, keepdims=True)   # [1,N]
-        sscore_m = jnp.sum(sscore * sel_col, axis=0, keepdims=True)
-        sscore2_m = jnp.sum(sscore2 * sel_col, axis=0, keepdims=True)
+        row = (pl.dslice(m, 1), slice(None))
+        sfeas_m = sfeas_ref[row]                                    # [1,N]
+        sscore_m = sscore_ref[row]
+        sscore2_m = sscore2_ref[row]
 
         future = jnp.maximum(idle + relmp - pipe, 0.0)
         pods_ok = (cnt + podsx) < maxp
