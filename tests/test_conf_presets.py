@@ -1,0 +1,62 @@
+"""Shipped policy presets drive full scheduler cycles end-to-end.
+
+Every conf/*.conf must parse and schedule a small workload through the
+runtime loop — the preset-is-a-silent-no-op failure mode (round-3 finding
+on the dap preset's ScaleAllocatable block) stays caught here.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import QueueInfo
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.runtime import FakeCluster, Scheduler
+
+from fixtures import build_job, build_task, simple_cluster
+
+PRESETS = sorted(glob.glob(os.path.join(
+    os.path.dirname(__file__), "..", "conf", "*.conf")))
+
+
+def preset_cluster():
+    ci = simple_cluster(n_nodes=2, node_cpu="4")
+    ci.add_queue(QueueInfo("root-sci", weight=2, hierarchy="root/sci",
+                           hierarchy_weights="1/2"))
+    for j, queue in enumerate(["default", "root-sci", "default"]):
+        job = build_job(f"default/j{j}", queue=queue, min_available=1,
+                        creation_timestamp=float(j))
+        job.add_task(build_task(f"j{j}-t0", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+    return ci
+
+
+class TestPresets:
+    @pytest.mark.parametrize("path", PRESETS,
+                             ids=[os.path.basename(p) for p in PRESETS])
+    def test_preset_schedules(self, path):
+        with open(path) as f:
+            conf = parse_conf(f.read())
+        sched = Scheduler(FakeCluster(preset_cluster()), conf=conf)
+        sched.run_once()
+        assert len(sched.cluster.binds) >= 1, path
+
+    def test_dap_preset_scales_allocatable_and_orders_hdrf(self):
+        """The dap preset's ScaleAllocatable block must actually shrink
+        capacity AND its hdrf tiers must produce hierarchy-ordered
+        placement (both were silent no-ops in earlier rounds)."""
+        from volcano_tpu.framework.session import Session
+        with open(os.path.join(os.path.dirname(__file__), "..", "conf",
+                               "volcano-scheduler-dap.conf")) as f:
+            conf = parse_conf(f.read())
+        ci = preset_cluster()
+        ssn = Session(ci, conf)
+        cfg = ssn.allocate_config()
+        assert cfg.enable_hdrf
+        alloc = np.asarray(ssn.snap.nodes.allocatable)
+        # 4 cpu * 0.8 = 3200 millicores
+        assert alloc[0, 0] == pytest.approx(3200.0)
+        # the packed hierarchy tree has the sci branch materialized
+        assert int(np.asarray(ssn.hierarchy.valid).sum()) >= 2
