@@ -176,6 +176,13 @@ def _run(force_cpu: bool):
     result, dev_ms, compile_s = _time_device(fn, snap, extras, reps)
     n_tasks = n_jobs * tasks_per_job
     placed = int(np.asarray(result.task_mode > 0).sum())
+    # decision fingerprint: detects when kernel changes invalidate the
+    # RECORDED full-scale equality/cpu_ms without paying the live CPU run
+    # (the round-3 staleness finding)
+    import hashlib
+    decisions_sha = hashlib.sha256(
+        np.asarray(result.task_node).tobytes()
+        + np.asarray(result.task_mode).tobytes()).hexdigest()[:16]
 
     # ---- CPU baseline ----------------------------------------------------
     recorded = None
@@ -197,8 +204,15 @@ def _run(force_cpu: bool):
         cpu_source = "measured"
     else:
         cpu_ms = float(recorded["cpu_ms"])
-        equal_full = None  # verified at measurement time; see sub-scale check
+        rec_sha = recorded.get("decisions_sha256")
+        if rec_sha is not None and rec_sha == decisions_sha:
+            # decisions byte-identical to the verified record
+            equal_full = True
+        else:
+            equal_full = None
         cpu_source = f"recorded {recorded['measured']} (BENCH_BASELINE.json)"
+        if rec_sha is not None and rec_sha != decisions_sha:
+            cpu_source += " [STALE: decisions changed since record]"
 
     # ---- full-session wall time (open -> allocate -> apply -> close) -----
     # The reference's cycle budget is the 1s schedule period
@@ -316,6 +330,7 @@ tiers:
                               if affinity_ms is not None else None),
         "affinity_placed": affinity_placed,
         "decisions_equal_cpu_full_scale": equal_full,
+        "decisions_sha256": decisions_sha,
         "decisions_equal_cpu_1024n_10240t": equal_sub,
         "speedup_1024n_10240t": sub_speedup,
         "sub_tpu_ms": round(stpu_ms, 3) if sub_speedup is not None else None,

@@ -127,3 +127,45 @@ class TestPerEvictionDrfRecompute:
         assert len(ssn.evictions) == 1, [e.task_uid for e in ssn.evictions]
         assert "default/p-0" in ssn.pipelined
         assert "default/p-1" not in ssn.pipelined
+
+
+class TestHDRFReclaim:
+    def test_underserved_hierarchy_branch_reclaims(self):
+        """dap-style reclaim: the drf hierarchy what-if rule (clone tree,
+        add reclaimer, subtract candidate, compare queues — drf.go:377-449)
+        lets a starving branch reclaim from an over-served one."""
+        from volcano_tpu.api import QueueInfo
+        from fixtures import build_node
+        ci = simple_cluster(n_nodes=0)
+        ci.add_node(build_node("n0", cpu="4", memory="8Gi"))
+        del ci.queues["default"]
+        ci.add_queue(QueueInfo("root-a", hierarchy="root/a",
+                               hierarchy_weights="1/1", reclaimable=True))
+        ci.add_queue(QueueInfo("root-b", hierarchy="root/b",
+                               hierarchy_weights="1/1"))
+        greedy = build_job("default/greedy", queue="root-a", min_available=1)
+        for i in range(4):
+            t = build_task(f"gr-{i}", cpu="1", memory=0)
+            t.status = TaskStatus.RUNNING
+            greedy.add_task(t)
+            ci.nodes["n0"].add_task(t)
+        ci.add_job(greedy)
+        starv = build_job("default/starv", queue="root-b", min_available=1)
+        starv.add_task(build_task("st-0", cpu="1", memory=0))
+        ci.add_job(starv)
+        conf = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: drf
+    enableHierarchy: true
+"""
+        ssn = Session(ci, parse_conf(conf))
+        assert ssn.victim_tiers("reclaim") == (("drf_hdrf",),)
+        ssn.run_preempt("reclaim")
+        evicted = [e.task_uid for e in ssn.evictions]
+        # root-b holds nothing, root-a holds everything: the what-if keeps
+        # root-b strictly first after removing a greedy task -> reclaim
+        assert len(evicted) >= 1
+        assert all(uid.startswith("default/gr") for uid in evicted)
+        assert "default/st-0" in ssn.pipelined

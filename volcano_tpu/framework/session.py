@@ -523,12 +523,15 @@ class Session:
     def run_preempt(self, mode: str = "preempt"):
         from ..ops.preempt import PreemptConfig
         tdm = self.plugin("tdm")
+        drf = self.plugin("drf")
         cfg = PreemptConfig(
             mode=mode,
             scoring=self.allocate_config(),
             tiers=self.victim_tiers(mode),
             tdm_starving=(mode == "preempt" and tdm is not None
-                          and tdm.option.enabled_job_starving))
+                          and tdm.option.enabled_job_starving),
+            enable_hdrf=(drf is not None and drf.option.enabled_hierarchy
+                         and drf.option.enabled_queue_order))
         result = _preempt_fn(cfg)(self.snap, self.allocate_extras(),
                                   self.victim_veto_mask())
         self.apply_preempt(result, mode)

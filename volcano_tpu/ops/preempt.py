@@ -74,6 +74,10 @@ class PreemptConfig:
     tiers: Tuple[Tuple[str, ...], ...] = (("priority", "gang"), ("drf",))
     #: tdm JobStarvingFn: preemptable jobs never preempt (tdm.go:292-298)
     tdm_starving: bool = False
+    #: hdrf queue ordering for the preemptor pop (the drf queueOrderFn
+    #: registered under hierarchy, drf.go:362-375), recomputed from the
+    #: live job allocations each round
+    enable_hdrf: bool = False
     max_victims_per_task: int = 16
 
 
@@ -284,6 +288,15 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 extras.ns_share[jobs.namespace],
                 jobs.namespace.astype(jnp.float32),
                 qshare[jobs.queue] + extras.queue_share_extra[jobs.queue],
+            ]
+            if cfg.enable_hdrf:
+                # hdrf compareQueues on the live tree (drf.go:362-375)
+                hcols = hdrf_level_keys(
+                    extras.hierarchy, st["job_alloc_dyn"],
+                    jobs.total_request, jobs.valid, total_cap)
+                for c in range(int(hcols.shape[1])):
+                    keys.append(hcols[:, c][jobs.queue])
+            keys += [
                 jobs.queue.astype(jnp.float32),
                 -jobs.priority.astype(jnp.float32),
                 extras.job_share,
