@@ -35,14 +35,24 @@ the event-handler analog):
   reclaimer's queue still orders strictly before the victim's in the hdrf
   comparison (drf.go:377-449).
 
+The drf rule also implements the namespace-order pre-stage when enabled
+(drf.go:285-334): cross-namespace candidates decide by weighted namespace
+shares after the what-if move (tracked live in ns_alloc_dyn), falling to
+the job rule within shareDelta.
+
+Mode "preempt_intra" is the second phase of the preempt action
+(preempt.go:145-186): each under-request job's pending tasks preempt
+lower-task-priority Running tasks OF THE SAME JOB, committing per
+preemptor task; phase-1 pipelined preemptors are excluded via the
+``skip_tasks`` input (their status already left Pending in the
+reference's session).
+
 ValidateVictims' capacity check (util/scheduler_helper.go:240-255) is the
 ``future idle + evictable >= request`` test; victims evict lowest task
 priority first (the inverted TaskOrderFn queue, preempt.go:228-233) until
 the preemptor fits FutureIdle, then the preemptor pipelines. Documented
-divergences: node ties break to the lowest index (reference walks nodes in
-sorted-score order with unstable ties); the intra-job second preemption
-phase (preempt.go:145-186) and drf's namespace-order pre-stage
-(drf.go:285-334) are not modeled.
+divergence: node ties break to the lowest index (the reference walks
+nodes in sorted-score order with unstable ties).
 """
 
 from __future__ import annotations
@@ -65,7 +75,7 @@ _DELTA = 1e-6  # drf shareDelta (drf.go:37)
 
 @dataclass(frozen=True)
 class PreemptConfig:
-    mode: str = "preempt"               # "preempt" | "reclaim"
+    mode: str = "preempt"     # "preempt" | "preempt_intra" | "reclaim"
     scoring: AllocateConfig = AllocateConfig()
     #: victim-rule tiers (session_plugins.go:131-215): per tier, the names
     #: of plugins whose victim fn is registered AND enabled for this mode.
@@ -107,11 +117,13 @@ def make_preempt_cycle(cfg: PreemptConfig):
     tree); ``victim_veto`` is the conformance rule's host-computed veto.
     """
     reclaim = cfg.mode == "reclaim"
+    intra = cfg.mode == "preempt_intra"
     rule_names = [r for tier in cfg.tiers for r in tier]
     use_hdrf_rule = "drf_hdrf" in rule_names
 
     def preempt(snap: SnapshotArrays, extras: AllocateExtras,
-                victim_veto: jax.Array) -> PreemptResult:
+                victim_veto: jax.Array,
+                skip_tasks=None) -> PreemptResult:
         snap = jax.tree.map(jnp.asarray, snap)
         extras = jax.tree.map(jnp.asarray, extras)
         victim_veto = jnp.asarray(victim_veto)
@@ -159,6 +171,11 @@ def make_preempt_cycle(cfg: PreemptConfig):
         # predicates/cache.go:42-90)
         tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
 
+        S = snap.namespace_weight.shape[0]
+        ns_alloc0 = jax.ops.segment_sum(
+            jnp.where(jobs.valid[:, None], jobs.allocated, 0.0),
+            jnp.where(jobs.valid, jobs.namespace, S),
+            num_segments=S + 1)[:S]
         init = dict(
             extra_idle=jnp.zeros((N, R), jnp.float32),   # from evictions
             pipe_extra=jnp.zeros((N, R), jnp.float32),   # new pipelines
@@ -171,12 +188,13 @@ def make_preempt_cycle(cfg: PreemptConfig):
             # proportion.go:281-325)
             job_alloc_dyn=jobs.allocated,
             queue_alloc_dyn=queues.allocated,
+            ns_alloc_dyn=ns_alloc0,
             saved=None,  # replaced below
             rounds=jnp.int32(0),
         )
         saved_keys = ("extra_idle", "pipe_extra", "evicted",
                       "task_node", "task_mode", "job_alloc_dyn",
-                      "queue_alloc_dyn")
+                      "queue_alloc_dyn", "ns_alloc_dyn")
         init["saved"] = {k: init[k] for k in saved_keys}
 
         def eligible(st):
@@ -185,7 +203,8 @@ def make_preempt_cycle(cfg: PreemptConfig):
         def cond(st):
             return jnp.any(eligible(st)) & (st["rounds"] < J)
 
-        def victim_rule(name, t, ji, evicted, job_alloc_dyn, queue_alloc_dyn):
+        def victim_rule(name, t, ji, evicted, job_alloc_dyn, queue_alloc_dyn,
+                        ns_alloc_dyn):
             """bool[T] candidate mask of one plugin's victim fn."""
             pprio = jobs.priority[ji]
             if name in ("priority", "gang"):
@@ -205,7 +224,26 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     job_alloc_dyn[ji] + tasks.resreq[t], total_cap)
                 rs = dominant_share(
                     job_alloc_dyn[vjob] - tasks.resreq, total_cap)
-                return (ls < rs) | (jnp.abs(ls - rs) <= _DELTA)
+                job_rule = (ls < rs) | (jnp.abs(ls - rs) <= _DELTA)
+                if not cfg.scoring.drf_ns_order:
+                    return job_rule
+                # namespace-share pre-stage (drf.go:285-334): cross-ns
+                # candidates decide by weighted ns shares after the what-if
+                # move; within shareDelta they fall through to the job rule
+                nsw = jnp.maximum(snap.namespace_weight, 1.0)
+                p_ns = jobs.namespace[ji]
+                lns = dominant_share(
+                    ns_alloc_dyn[p_ns] + tasks.resreq[t],
+                    total_cap) / nsw[p_ns]
+                v_ns = jobs.namespace[vjob]
+                rns = dominant_share(
+                    ns_alloc_dyn[v_ns] - tasks.resreq,
+                    total_cap) / nsw[v_ns]
+                same_ns = v_ns == p_ns
+                return jnp.where(
+                    same_ns, job_rule,
+                    (lns < rns)
+                    | (((lns - rns) <= _DELTA) & job_rule))
             if name == "proportion":
                 # queue what-if (proportion.go:217-236): enough allocation
                 # to subtract, and deserved still covered afterwards
@@ -244,7 +282,8 @@ def make_preempt_cycle(cfg: PreemptConfig):
             ok = jax.vmap(what_if)(idx) & pre[idx]
             return jnp.zeros(T, bool).at[idx].set(ok)
 
-        def victim_mask_for(t, ji, evicted, job_alloc_dyn, queue_alloc_dyn):
+        def victim_mask_for(t, ji, evicted, job_alloc_dyn, queue_alloc_dyn,
+                            ns_alloc_dyn):
             """Frozen victim set for one preemptor task: tiered
             intersection with per-node first-non-empty-tier-wins."""
             base = running & ~evicted
@@ -265,7 +304,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     if name == "drf_hdrf":
                         continue     # expensive rule intersects last
                     m &= victim_rule(name, t, ji, evicted, job_alloc_dyn,
-                                     queue_alloc_dyn)
+                                     queue_alloc_dyn, ns_alloc_dyn)
                 if "drf_hdrf" in tier:
                     m = hdrf_rule(t, ji, job_alloc_dyn, m)
                 tier_masks.append(m)
@@ -307,7 +346,8 @@ def make_preempt_cycle(cfg: PreemptConfig):
 
             def task_step(carry, t_idx):
                 (extra_idle, pipe_extra, evicted, t_node, t_mode,
-                 job_alloc_dyn, queue_alloc_dyn, n_pipe) = carry
+                 job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
+                 n_pipe) = carry
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
                 if not reclaim:
                     # the preemptor loop stops once the job is no longer
@@ -331,7 +371,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 # the victim set is FROZEN for this preemptor's eviction
                 # loop (preempt.go:218-233 builds it once per node)
                 vok = victim_mask_for(t, ji, evicted, job_alloc_dyn,
-                                      queue_alloc_dyn)
+                                      queue_alloc_dyn, ns_alloc_dyn)
                 evictable = jax.ops.segment_sum(
                     jnp.where(vok[:, None], tasks.resreq, 0.0),
                     jnp.where(vok, tasks.node, N), num_segments=N + 1)[:N]
@@ -350,13 +390,14 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 # inverted TaskOrderFn queue, preempt.go:228-233), until
                 # the preemptor fits future idle
                 def evict_cond(ec):
-                    extra_idle, _e, _ja, _qa, k = ec
+                    extra_idle, _e, _ja, _qa, _na, k = ec
                     fits = jnp.all(
                         resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
                     return found & ~fits & (k < cfg.max_victims_per_task)
 
                 def evict_body(ec):
-                    extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn, k = ec
+                    (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
+                     ns_alloc_dyn, k) = ec
                     vok_now = vok & ~evicted & (tasks.node == node)
                     vkeys = [
                         tasks.priority.astype(jnp.float32),
@@ -370,15 +411,18 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     # eviction (drf.go:537-561, proportion.go:300-325)
                     job_alloc_dyn = job_alloc_dyn.at[tasks.job[vt]].add(-dres)
                     queue_alloc_dyn = queue_alloc_dyn.at[vqueue[vt]].add(-dres)
+                    ns_alloc_dyn = ns_alloc_dyn.at[
+                        jobs.namespace[jnp.maximum(tasks.job[vt], 0)]].add(
+                            -dres)
                     return (extra_idle, evicted, job_alloc_dyn,
-                            queue_alloc_dyn,
+                            queue_alloc_dyn, ns_alloc_dyn,
                             jnp.where(doit, k + 1, cfg.max_victims_per_task))
 
                 (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
-                 _) = jax.lax.while_loop(
+                 ns_alloc_dyn, _) = jax.lax.while_loop(
                     evict_cond, evict_body,
                     (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
-                     jnp.int32(0)))
+                     ns_alloc_dyn, jnp.int32(0)))
 
                 fits = found & jnp.all(
                     resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
@@ -388,20 +432,22 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 pres = jnp.where(fits, 1.0, 0.0) * resreq
                 job_alloc_dyn = job_alloc_dyn.at[ji].add(pres)
                 queue_alloc_dyn = queue_alloc_dyn.at[jobs.queue[ji]].add(pres)
+                ns_alloc_dyn = ns_alloc_dyn.at[jobs.namespace[ji]].add(pres)
                 t_node = t_node.at[t].set(jnp.where(fits, node, t_node[t]))
                 t_mode = t_mode.at[t].set(
                     jnp.where(fits, MODE_PIPELINED, t_mode[t]))
                 n_pipe += jnp.where(fits, 1, 0)
                 return (extra_idle, pipe_extra, evicted, t_node, t_mode,
-                        job_alloc_dyn, queue_alloc_dyn, n_pipe), None
+                        job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
+                        n_pipe), None
 
             carry0 = (st["extra_idle"], st["pipe_extra"], st["evicted"],
                       st["task_node"], st["task_mode"],
                       st["job_alloc_dyn"], st["queue_alloc_dyn"],
-                      jnp.int32(0))
+                      st["ns_alloc_dyn"], jnp.int32(0))
             (extra_idle, pipe_extra, evicted, t_node, t_mode,
-             job_alloc_dyn, queue_alloc_dyn, n_pipe), _ = jax.lax.scan(
-                task_step, carry0, task_ids)
+             job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
+             n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
 
             pipelined = (jobs.ready_num[ji] + waiting0[ji] + n_pipe
                          >= jobs.min_available[ji])
@@ -410,7 +456,8 @@ def make_preempt_cycle(cfg: PreemptConfig):
             new = dict(extra_idle=extra_idle, pipe_extra=pipe_extra,
                        evicted=evicted, task_node=t_node, task_mode=t_mode,
                        job_alloc_dyn=job_alloc_dyn,
-                       queue_alloc_dyn=queue_alloc_dyn)
+                       queue_alloc_dyn=queue_alloc_dyn,
+                       ns_alloc_dyn=ns_alloc_dyn)
             saved = st["saved"]
             job_tasks = tasks.job == ji
             merged = {}
