@@ -169,3 +169,58 @@ tiers:
         assert len(evicted) >= 1
         assert all(uid.startswith("default/gr") for uid in evicted)
         assert "default/st-0" in ssn.pipelined
+
+
+class TestIntraJobPreemption:
+    def test_high_priority_task_preempts_own_jobs_low(self):
+        """Phase 2 (preempt.go:145-186): a job's pending high-priority task
+        evicts its own lower-priority Running task when no cross-job victim
+        exists. Conf has priority WITHOUT gang in the tier (gang's
+        same-job rule would empty the intersection, as in the
+        reference)."""
+        ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="2Gi")
+        # min_available=2 with one Running + one Pending -> the job is
+        # starving (underRequest), the phase-2 precondition
+        j = build_job("default/j", min_available=2, priority=5)
+        lo = build_task("lo-0", cpu="1", memory="1Gi", priority=1,
+                        status=TaskStatus.RUNNING)
+        j.add_task(lo)
+        ci.nodes["n0"].add_task(lo)
+        j.add_task(build_task("hi-0", cpu="1", memory="1Gi", priority=9))
+        ci.add_job(j)
+        conf = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+"""
+        ssn = _run_preempt(ci, conf)
+        # phase 1 finds no cross-job victims (single job); run phase 2
+        assert ssn.evictions == []
+        ssn.run_preempt("preempt_intra")
+        evicted = [e.task_uid for e in ssn.evictions]
+        assert evicted == ["default/lo-0"], evicted
+        assert "default/hi-0" in ssn.pipelined
+
+    def test_gang_in_tier_blocks_intra_preemption(self):
+        """With gang in the same tier the same-job candidates intersect to
+        nothing (gang.go:83-103 equal job priority), matching the
+        reference's no-op."""
+        ci = simple_cluster(n_nodes=1, node_cpu="1", node_mem="2Gi")
+        j = build_job("default/j", min_available=2, priority=5)
+        lo = build_task("lo-0", cpu="1", memory="1Gi", priority=1,
+                        status=TaskStatus.RUNNING)
+        j.add_task(lo)
+        ci.nodes["n0"].add_task(lo)
+        j.add_task(build_task("hi-0", cpu="1", memory="1Gi", priority=9))
+        ci.add_job(j)
+        conf = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+"""
+        ssn = _run_preempt(ci, conf)
+        ssn.run_preempt("preempt_intra")
+        assert ssn.evictions == []

@@ -21,6 +21,12 @@ class PreemptAction(Action):
         ssn.stats["preempt_evictions"] = int(
             np.asarray(result.evicted).sum()) if result is not None else 0
 
+        # phase 2: preemption between tasks within a job
+        # (preempt.go:145-186), committed per preemptor task
+        intra = ssn.run_preempt(mode="preempt_intra")
+        ssn.stats["preempt_intra_evictions"] = int(
+            np.asarray(intra.evicted).sum()) if intra is not None else 0
+
         # victimTasks sweep: unconditional evictions requested by plugins
         victims = ssn.victim_tasks_mask()
         count = 0

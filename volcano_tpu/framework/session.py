@@ -524,16 +524,26 @@ class Session:
         from ..ops.preempt import PreemptConfig
         tdm = self.plugin("tdm")
         drf = self.plugin("drf")
+        dispatch = "preempt" if mode == "preempt_intra" else mode
         cfg = PreemptConfig(
             mode=mode,
             scoring=self.allocate_config(),
-            tiers=self.victim_tiers(mode),
-            tdm_starving=(mode == "preempt" and tdm is not None
+            tiers=self.victim_tiers(dispatch),
+            tdm_starving=(dispatch == "preempt" and tdm is not None
                           and tdm.option.enabled_job_starving),
             enable_hdrf=(drf is not None and drf.option.enabled_hierarchy
                          and drf.option.enabled_queue_order))
+        # phase-2 preemptors exclude tasks phase 1 already pipelined
+        # (their status left Pending in the reference session)
+        T = np.asarray(self.snap.tasks.status).shape[0]
+        skip = np.zeros(T, bool)
+        if mode == "preempt_intra":
+            for uid in self.pipelined:
+                ti = self.maps.task_index.get(uid)
+                if ti is not None:
+                    skip[ti] = True
         result = _preempt_fn(cfg)(self.snap, self.allocate_extras(),
-                                  self.victim_veto_mask())
+                                  self.victim_veto_mask(), skip)
         self.apply_preempt(result, mode)
         return result
 

@@ -127,6 +127,10 @@ def make_preempt_cycle(cfg: PreemptConfig):
         snap = jax.tree.map(jnp.asarray, snap)
         extras = jax.tree.map(jnp.asarray, extras)
         victim_veto = jnp.asarray(victim_veto)
+        if skip_tasks is None:
+            skip = jnp.zeros(victim_veto.shape[0], bool)
+        else:
+            skip = jnp.asarray(skip_tasks)
         nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
         N, R = nodes.idle.shape
         T = tasks.resreq.shape[0]
@@ -157,6 +161,8 @@ def make_preempt_cycle(cfg: PreemptConfig):
             starving = (jobs.valid & jobs.schedulable & (jobs.n_pending > 0)
                         & ~overused[jobs.queue])
         else:
+            # preempt + preempt_intra share the underRequest criterion
+            # (preempt.go:70-81)
             # gang JobStarving (gang.go:150-155)
             starving = (jobs.valid & jobs.schedulable
                         & (jobs.ready_num + waiting0 < jobs.min_available)
@@ -207,6 +213,9 @@ def make_preempt_cycle(cfg: PreemptConfig):
                         ns_alloc_dyn):
             """bool[T] candidate mask of one plugin's victim fn."""
             pprio = jobs.priority[ji]
+            if name == "priority" and intra:
+                # same-job branch: task priorities (priority.go:99-107)
+                return tasks.priority < tasks.priority[t]
             if name in ("priority", "gang"):
                 return jobs.priority[vjob] < pprio
             if name == "conformance":
@@ -289,6 +298,10 @@ def make_preempt_cycle(cfg: PreemptConfig):
             base = running & ~evicted
             if reclaim:
                 base &= (vqueue != jobs.queue[ji]) & queues.reclaimable[vqueue]
+            elif intra:
+                # phase 2: victims within the preemptor's own job
+                # (preempt.go:168-175 filter)
+                base &= tasks.job == ji
             else:
                 base &= (vqueue == jobs.queue[ji]) & (tasks.job != ji)
             if not any(len(tier) for tier in cfg.tiers):
@@ -347,9 +360,14 @@ def make_preempt_cycle(cfg: PreemptConfig):
             def task_step(carry, t_idx):
                 (extra_idle, pipe_extra, evicted, t_node, t_mode,
                  job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
-                 n_pipe) = carry
+                 n_pipe, broke) = carry
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
-                if not reclaim:
+                active &= ~skip[jnp.maximum(t_idx, 0)]
+                if intra:
+                    # phase 2 stops the job at the first unassigned task
+                    # (preempt.go:181-184)
+                    active &= ~broke
+                if not reclaim and not intra:
                     # the preemptor loop stops once the job is no longer
                     # starving (preempt.go:99-101): pipelined tasks count
                     # toward the gang's waiting number
@@ -437,21 +455,24 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 t_mode = t_mode.at[t].set(
                     jnp.where(fits, MODE_PIPELINED, t_mode[t]))
                 n_pipe += jnp.where(fits, 1, 0)
+                broke |= active & ~fits
                 return (extra_idle, pipe_extra, evicted, t_node, t_mode,
                         job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
-                        n_pipe), None
+                        n_pipe, broke), None
 
             carry0 = (st["extra_idle"], st["pipe_extra"], st["evicted"],
                       st["task_node"], st["task_mode"],
                       st["job_alloc_dyn"], st["queue_alloc_dyn"],
-                      st["ns_alloc_dyn"], jnp.int32(0))
+                      st["ns_alloc_dyn"], jnp.int32(0), jnp.bool_(False))
             (extra_idle, pipe_extra, evicted, t_node, t_mode,
              job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
-             n_pipe), _ = jax.lax.scan(task_step, carry0, task_ids)
+             n_pipe, _broke), _ = jax.lax.scan(task_step, carry0, task_ids)
 
             pipelined = (jobs.ready_num[ji] + waiting0[ji] + n_pipe
                          >= jobs.min_available[ji])
-            keep = pipelined
+            # phase 2 commits per preemptor task unconditionally
+            # (preempt.go:177-180 stmt.Commit with no pipelined gate)
+            keep = jnp.bool_(True) if intra else pipelined
 
             new = dict(extra_idle=extra_idle, pipe_extra=pipe_extra,
                        evicted=evicted, task_node=t_node, task_mode=t_mode,
