@@ -278,3 +278,91 @@ tiers:
                                       ref["task_node"])
         np.testing.assert_array_equal(np.asarray(result.task_mode),
                                       ref["task_mode"])
+
+
+class TestNodeAffinityRequiredOrTerms:
+    """Multi-term required node affinity is OR-of-NodeSelectorTerms (k8s
+    semantics): satisfying ANY term admits the node. The old encoding
+    collapsed terms to their union (AND of everything)."""
+
+    def test_or_terms_admit_either_zone(self):
+        ci = simple_cluster(n_nodes=0)
+        from fixtures import build_node
+        ci.add_node(build_node("za", cpu="1", memory="2Gi",
+                               labels={"zone": "a"}))
+        ci.add_node(build_node("zb", cpu="4", memory="8Gi",
+                               labels={"zone": "b"}))
+        # za is nearly full; the task fits only on zb — reachable ONLY
+        # under OR semantics (the union collapse required zone=a AND
+        # zone=b, satisfiable nowhere)
+        filler = build_job("default/filler", min_available=1)
+        f = build_task("f-0", cpu="1", memory="1Gi",
+                       status=TaskStatus.RUNNING, node_name="za")
+        filler.add_task(f)
+        ci.nodes["za"].add_task(f)
+        ci.add_job(filler)
+        j = build_job("default/j", min_available=1)
+        t = build_task("t-0", cpu="1", memory="1Gi")
+        t.affinity_required = [{"zone": "a"}, {"zone": "b"}]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert dict(sched.cluster.binds)["default/t-0"] == "zb"
+
+    def test_or_terms_still_filter(self):
+        """A node matching NO term stays infeasible."""
+        ci = simple_cluster(n_nodes=1)   # unlabeled n0
+        j = build_job("default/j", min_available=1)
+        t = build_task("t-0", cpu="1", memory="1Gi")
+        t.affinity_required = [{"zone": "a"}, {"zone": "b"}]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert sched.cluster.binds == []
+
+    def test_single_term_unchanged(self):
+        ci = simple_cluster(n_nodes=0)
+        from fixtures import build_node
+        ci.add_node(build_node("plain", cpu="4", memory="8Gi"))
+        ci.add_node(build_node("ssd", cpu="4", memory="8Gi",
+                               labels={"disk": "ssd"}))
+        j = build_job("default/j", min_available=1)
+        t = build_task("t-0", cpu="1", memory="1Gi")
+        t.affinity_required = [{"disk": "ssd"}]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert dict(sched.cluster.binds)["default/t-0"] == "ssd"
+
+    def test_oracle_parity_with_or_terms(self):
+        import jax
+        from volcano_tpu.ops.allocate_scan import make_allocate_cycle
+        from volcano_tpu.runtime.cpu_reference import allocate_cpu
+        ci = simple_cluster(n_nodes=0)
+        from fixtures import build_node
+        rng = np.random.RandomState(9)
+        for i in range(6):
+            ci.add_node(build_node(f"n{i}", cpu="2", memory="4Gi",
+                                   labels={"zone": f"z{i % 3}"}))
+        for jid in range(4):
+            j = build_job(f"default/j{jid}", min_available=1)
+            for i in range(2):
+                t = build_task(f"j{jid}-t{i}", cpu="500m", memory="512Mi")
+                r = rng.rand()
+                if r < 0.4:
+                    t.affinity_required = [
+                        {"zone": f"z{int(rng.randint(3))}"},
+                        {"zone": f"z{int(rng.randint(3))}"}]
+                elif r < 0.6:
+                    t.affinity_required = [{"zone": f"z{int(rng.randint(3))}"}]
+                j.add_task(t)
+            ci.add_job(j)
+        ssn = Session(ci, parse_conf(CONF))
+        extras = ssn.allocate_extras()
+        cfg = ssn.allocate_config()
+        result = jax.jit(make_allocate_cycle(cfg))(ssn.snap, extras)
+        ref = allocate_cpu(ssn.snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(result.task_node),
+                                      ref["task_node"])
+        np.testing.assert_array_equal(np.asarray(result.task_mode),
+                                      ref["task_mode"])

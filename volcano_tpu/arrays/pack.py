@@ -7,8 +7,12 @@ the pack, and the result is what gets shipped to the device.
 
 Known encoding divergences from the reference (documented per SURVEY section 7
 hard part 3):
-- Node-affinity required terms are encoded as a single all-of label-hash set
-  (match-labels style); multi-term OR expressions collapse to their union.
+- Node-affinity required terms use match-labels semantics (hash equality;
+  expression operators are not encoded). Single-term tasks fold into the
+  packed all-of selector row; multi-term OR-of-terms ride a host-computed
+  per-template feasibility mask (extras.template_feasible,
+  Session._node_affinity_extras) — exact on the session path, permissive
+  on the extras-less sidecar path.
   (InterPodAffinity has its own exact encoding, arrays/affinity.py.)
 """
 
@@ -234,8 +238,12 @@ def pack(ci: ClusterInfo,
         t_preempt[ti] = task.preemptable
         t_valid[ti] = True
         required = dict(task.node_selector)
-        for term in task.affinity_required:
-            required.update(term)
+        if len(task.affinity_required) == 1:
+            required.update(task.affinity_required[0])
+        # multi-term required node affinity is OR-of-terms (k8s
+        # NodeSelectorTerms): the packed row keeps only the nodeSelector
+        # conjunction; the OR mask rides extras.template_feasible
+        # (host-computed, Session._node_affinity_extras)
         sel_rows.append(sorted(L.stable_hash(f"{k}={v}")
                                for k, v in required.items()))
         h, e, m = _toleration_rows(task.tolerations)
@@ -256,8 +264,13 @@ def pack(ci: ClusterInfo,
         task = task_entries[ti][1]
         na_sig = tuple(sorted((tuple(sorted(m.items())), w)
                               for m, w in task.affinity_preferred))
+        # multi-term OR affinity lives in the per-template host mask, so
+        # it must split templates the packed selector row cannot
+        or_sig = (tuple(sorted(tuple(sorted(m.items()))
+                               for m in task.affinity_required))
+                  if len(task.affinity_required) > 1 else ())
         sig = (tuple(sel_rows[ti]), tuple(tolh_rows[ti]),
-               tuple(tole_rows[ti]), tuple(tolm_rows[ti]), na_sig)
+               tuple(tole_rows[ti]), tuple(tolm_rows[ti]), na_sig, or_sig)
         tid = template_of.get(sig)
         if tid is None:
             tid = len(rep_tasks)

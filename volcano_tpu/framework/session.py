@@ -328,30 +328,47 @@ class Session:
         (nodeorder.go:255-266 wrapping the k8s NodeAffinity scorer,
         un-normalized like the reference's TODO notes)."""
         no = self.plugin("nodeorder")
-        w = no.arg_float("nodeaffinity.weight", 1.0)
-        if not w:
+        w = no.arg_float("nodeaffinity.weight", 1.0) if no is not None else 0.0
+        do_score = bool(w) and no is not None
+        do_required = self.plugin("predicates") is not None
+        if not (do_score or do_required):
             return
         rep = np.asarray(self.snap.template_rep)
         N = len(self.maps.node_names)
         node_labels = [self.cluster.nodes[n].labels
                        for n in self.maps.node_names]
         score = np.asarray(extras.template_na_score).copy()
+        feas = np.asarray(extras.template_feasible).copy()
         uids = self.maps.task_uids
-        any_terms = False
+
+        def term_mask(match):
+            return np.fromiter(
+                (all(labels.get(k) == v for k, v in match.items())
+                 for labels in node_labels), bool, count=N)
+
+        any_terms = any_or = False
         for p, ti in enumerate(rep.tolist()):
             if ti < 0 or ti >= len(uids):
                 continue
             _job, task = self._task_lookup.get(uids[ti], (None, None))
-            if task is None or not task.affinity_preferred:
+            if task is None:
                 continue
-            any_terms = True
-            for match, weight in task.affinity_preferred:
-                mask = np.fromiter(
-                    (all(labels.get(k) == v for k, v in match.items())
-                     for labels in node_labels), bool, count=N)
-                score[p, :N] += np.float32(w * weight) * mask
+            if do_score:
+                for match, weight in task.affinity_preferred:
+                    any_terms = True
+                    score[p, :N] += np.float32(w * weight) * term_mask(match)
+            if do_required and len(task.affinity_required) > 1:
+                # OR of NodeSelectorTerms (the k8s required semantics the
+                # packed all-of row cannot express; arrays/pack.py note)
+                any_or = True
+                ok = np.zeros(N, bool)
+                for match in task.affinity_required:
+                    ok |= term_mask(match)
+                feas[p, :N] &= ok
         if any_terms:
             extras.template_na_score = score.astype(np.float32)
+        if any_or:
+            extras.template_feasible = feas
 
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
@@ -359,7 +376,8 @@ class Session:
         extras.hierarchy = self.hierarchy
         if self.plugin("predicates") is not None:
             self._port_volume_extras(extras)
-        if self.plugin("nodeorder") is not None:
+        if (self.plugin("nodeorder") is not None
+                or self.plugin("predicates") is not None):
             self._node_affinity_extras(extras)
         for p in self.plugins:
             deserved = p.queue_deserved(self)

@@ -228,8 +228,10 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
             t_gpu[ti] = gpu_request_of(task.resreq)
             if task.node_selector or task.affinity_required:
                 required = dict(task.node_selector)
-                for term in task.affinity_required:
-                    required.update(term)
+                if len(task.affinity_required) == 1:
+                    required.update(task.affinity_required[0])
+                # multi-term OR affinity: see arrays/pack.py (the packed
+                # row carries the nodeSelector conjunction only)
                 sel_rows.append(sorted(
                     L.stable_hash(f"{k}={v}") for k, v in required.items()))
             else:

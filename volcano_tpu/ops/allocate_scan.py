@@ -152,6 +152,9 @@ class AllocateExtras:
     #: (weighted matched-term sums x nodeaffinity.weight,
     #: nodeorder.go:255-266), host-computed — static over the cycle
     template_na_score: jax.Array  # f32[P, N]
+    #: multi-term required node affinity (OR-of-NodeSelectorTerms) per
+    #: predicate template, host-computed (arrays/pack.py note)
+    template_feasible: jax.Array  # bool[P, N]
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -186,6 +189,8 @@ class AllocateExtras:
             task_volume_node=np.full(T, -1, np.int32),
             template_na_score=np.zeros(
                 (snap.template_rep.shape[0], N), np.float32),
+            template_feasible=np.ones(
+                (snap.template_rep.shape[0], N), bool),
         )
 
 
@@ -477,8 +482,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
         # static predicate rows per template, computed once per cycle (the
         # predicate-cache analog, predicates/cache.go:42-90; see
-        # P.template_masks). bool[P, N].
-        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+        # P.template_masks), conjoined with the host-computed OR-of-terms
+        # node-affinity mask. bool[P, N].
+        tmpl_static = (P.template_masks(nodes, tasks, snap.template_rep)
+                       & extras.template_feasible)
 
         if use_pallas:
             from .pallas_place import make_round_placer

@@ -174,8 +174,9 @@ def make_preempt_cycle(cfg: PreemptConfig):
         future0 = nodes.future_idle()
 
         # static predicate rows per template (predicate-cache analog,
-        # predicates/cache.go:42-90)
-        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+        # predicates/cache.go:42-90) + host OR-of-terms affinity mask
+        tmpl_static = (P.template_masks(nodes, tasks, snap.template_rep)
+                       & extras.template_feasible)
 
         S = snap.namespace_weight.shape[0]
         ns_alloc0 = jax.ops.segment_sum(
