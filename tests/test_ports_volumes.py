@@ -366,3 +366,40 @@ class TestNodeAffinityRequiredOrTerms:
                                       ref["task_node"])
         np.testing.assert_array_equal(np.asarray(result.task_mode),
                                       ref["task_mode"])
+
+    def test_backfill_respects_or_terms(self):
+        """Best-effort tasks go through backfill, which must honor
+        required OR-of-terms affinity too (backfill.go runs PredicateFn)."""
+        ci = simple_cluster(n_nodes=0)
+        from fixtures import build_node
+        ci.add_node(build_node("plain", cpu="4", memory="8Gi"))
+        ci.add_node(build_node("zb", cpu="4", memory="8Gi",
+                               labels={"zone": "b"}))
+        j = build_job("default/be", min_available=1)
+        t = build_task("be-0", cpu=0, memory=0)
+        t.affinity_required = [{"zone": "a"}, {"zone": "b"}]
+        j.add_task(t)
+        ci.add_job(j)
+        sched = run_cycle(ci)
+        assert dict(sched.cluster.binds).get("default/be-0") == "zb"
+
+    def test_native_pack_parity_with_multi_term_affinity(self):
+        """Python pack and the wire decoders must produce identical
+        template structure for multi-term tasks (the OR mask is per TASK,
+        so templates merge identically on both paths)."""
+        import jax
+        from volcano_tpu.arrays import pack as pack_py
+        from volcano_tpu.native.wire import serialize
+        from volcano_tpu.native.pywire import pack_wire_py
+        ci = simple_cluster(n_nodes=2)
+        j = build_job("default/j", min_available=1)
+        t0 = build_task("t-0", cpu="1", memory="1Gi")
+        t0.affinity_required = [{"zone": "a"}, {"zone": "b"}]
+        j.add_task(t0)
+        j.add_task(build_task("t-1", cpu="1", memory="1Gi"))
+        ci.add_job(j)
+        snap_p, _ = pack_py(ci)
+        buf, _ = serialize(ci)
+        snap_w = pack_wire_py(buf)
+        for a, b in zip(jax.tree.leaves(snap_p), jax.tree.leaves(snap_w)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -264,13 +264,8 @@ def pack(ci: ClusterInfo,
         task = task_entries[ti][1]
         na_sig = tuple(sorted((tuple(sorted(m.items())), w)
                               for m, w in task.affinity_preferred))
-        # multi-term OR affinity lives in the per-template host mask, so
-        # it must split templates the packed selector row cannot
-        or_sig = (tuple(sorted(tuple(sorted(m.items()))
-                               for m in task.affinity_required))
-                  if len(task.affinity_required) > 1 else ())
         sig = (tuple(sel_rows[ti]), tuple(tolh_rows[ti]),
-               tuple(tole_rows[ti]), tuple(tolm_rows[ti]), na_sig, or_sig)
+               tuple(tole_rows[ti]), tuple(tolm_rows[ti]), na_sig)
         tid = template_of.get(sig)
         if tid is None:
             tid = len(rep_tasks)

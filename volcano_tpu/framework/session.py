@@ -338,7 +338,6 @@ class Session:
         node_labels = [self.cluster.nodes[n].labels
                        for n in self.maps.node_names]
         score = np.asarray(extras.template_na_score).copy()
-        feas = np.asarray(extras.template_feasible).copy()
         uids = self.maps.task_uids
 
         def term_mask(match):
@@ -346,29 +345,58 @@ class Session:
                 (all(labels.get(k) == v for k, v in match.items())
                  for labels in node_labels), bool, count=N)
 
-        any_terms = any_or = False
-        for p, ti in enumerate(rep.tolist()):
-            if ti < 0 or ti >= len(uids):
-                continue
-            _job, task = self._task_lookup.get(uids[ti], (None, None))
-            if task is None:
-                continue
-            if do_score:
+        any_terms = False
+        if do_score:
+            for p, ti in enumerate(rep.tolist()):
+                if ti < 0 or ti >= len(uids):
+                    continue
+                _job, task = self._task_lookup.get(uids[ti], (None, None))
+                if task is None:
+                    continue
                 for match, weight in task.affinity_preferred:
                     any_terms = True
                     score[p, :N] += np.float32(w * weight) * term_mask(match)
-            if do_required and len(task.affinity_required) > 1:
-                # OR of NodeSelectorTerms (the k8s required semantics the
-                # packed all-of row cannot express; arrays/pack.py note)
-                any_or = True
-                ok = np.zeros(N, bool)
-                for match in task.affinity_required:
-                    ok |= term_mask(match)
-                feas[p, :N] &= ok
         if any_terms:
             extras.template_na_score = score.astype(np.float32)
-        if any_or:
-            extras.template_feasible = feas
+        if do_required:
+            # OR of NodeSelectorTerms (the k8s required semantics the
+            # packed all-of row cannot express) — PER TASK, grouped by
+            # distinct OR set: template identity merges across different
+            # OR sets on the native pack path, so a per-template mask
+            # would misapply (arrays/pack.py note)
+            T = np.asarray(self.snap.tasks.status).shape[0]
+            T_full = np.asarray(extras.task_or_group).shape[0]
+            group_of = {}
+            masks = []
+            task_group = np.full(T_full, -1, np.int32)
+            for job in self.cluster.jobs.values():
+                for uid, task in job.tasks.items():
+                    if len(task.affinity_required) <= 1:
+                        continue
+                    ti = self.maps.task_index.get(uid)
+                    if ti is None:
+                        continue
+                    key = tuple(sorted(tuple(sorted(m.items()))
+                                       for m in task.affinity_required))
+                    g = group_of.get(key)
+                    if g is None:
+                        g = len(masks)
+                        group_of[key] = g
+                        ok = np.zeros(N, bool)
+                        for match in task.affinity_required:
+                            ok |= term_mask(match)
+                        masks.append(ok)
+                    task_group[ti] = g
+            if masks:
+                from ..arrays.schema import bucket as _bucket
+                Nfull = np.asarray(extras.or_feasible).shape[1]
+                GR = _bucket(len(masks), 1)
+                feas = np.ones((GR, Nfull), bool)
+                for g, ok in enumerate(masks):
+                    feas[g, :N] = ok
+                    feas[g, N:] = False   # padded nodes never match a term
+                extras.task_or_group = task_group
+                extras.or_feasible = feas
 
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
@@ -476,7 +504,9 @@ class Session:
         return result
 
     def run_backfill(self) -> int:
-        t_node, placed = _backfill_fn()(self.snap)
+        extras = self.allocate_extras()
+        t_node, placed = _backfill_fn()(self.snap, extras.task_or_group,
+                                        extras.or_feasible)
         t_node, placed = np.asarray(t_node), np.asarray(placed)
         count = 0
         uids = self.maps.task_uids

@@ -152,9 +152,11 @@ class AllocateExtras:
     #: (weighted matched-term sums x nodeaffinity.weight,
     #: nodeorder.go:255-266), host-computed — static over the cycle
     template_na_score: jax.Array  # f32[P, N]
-    #: multi-term required node affinity (OR-of-NodeSelectorTerms) per
-    #: predicate template, host-computed (arrays/pack.py note)
-    template_feasible: jax.Array  # bool[P, N]
+    #: multi-term required node affinity (OR-of-NodeSelectorTerms),
+    #: host-computed per distinct OR set (arrays/pack.py note): tasks point
+    #: at their group's node mask; -1 = no multi-term affinity
+    task_or_group: jax.Array      # i32[T]
+    or_feasible: jax.Array        # bool[GR, N]
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -189,8 +191,8 @@ class AllocateExtras:
             task_volume_node=np.full(T, -1, np.int32),
             template_na_score=np.zeros(
                 (snap.template_rep.shape[0], N), np.float32),
-            template_feasible=np.ones(
-                (snap.template_rep.shape[0], N), bool),
+            task_or_group=np.full(T, -1, np.int32),
+            or_feasible=np.ones((1, N), bool),
         )
 
 
@@ -482,10 +484,15 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
         # static predicate rows per template, computed once per cycle (the
         # predicate-cache analog, predicates/cache.go:42-90; see
-        # P.template_masks), conjoined with the host-computed OR-of-terms
-        # node-affinity mask. bool[P, N].
-        tmpl_static = (P.template_masks(nodes, tasks, snap.template_rep)
-                       & extras.template_feasible)
+        # P.template_masks). bool[P, N]. The OR-of-terms node-affinity
+        # group mask is per TASK (templates merge across different OR sets
+        # on the native pack path).
+        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+
+        def or_ok_row(t):
+            grp = extras.task_or_group[t]
+            return jnp.where(grp >= 0,
+                             extras.or_feasible[jnp.maximum(grp, 0)], True)
 
         if use_pallas:
             from .pallas_place import make_round_placer
@@ -608,9 +615,14 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 tcl = jnp.maximum(task_ids, 0)
                 tmpl_ids = tasks.template[tcl]
                 vol_node = extras.task_volume_node[tcl]
+                grp = extras.task_or_group[tcl]
+                or_rows = jnp.where(
+                    (grp >= 0)[:, None],
+                    extras.or_feasible[jnp.maximum(grp, 0)], True)
                 node_ok = (~(extras.block_nonrevocable[None, :]
                              & ~extras.task_revocable[tcl][:, None])
                            & ~extras.block_all[None, :]
+                           & or_rows
                            # volume-binding seam: unbindable claims block,
                            # local-PV claims pin (cache.go:240-272)
                            & extras.task_volume_ok[tcl][:, None]
@@ -700,6 +712,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 node_ok = (~(extras.block_nonrevocable
                              & ~extras.task_revocable[t])
                            & ~extras.block_all
+                           & or_ok_row(t)
                            # volume-binding seam (cache.go:240-272)
                            & extras.task_volume_ok[t]
                            & ((extras.task_volume_node[t] < 0)

@@ -17,10 +17,21 @@ from . import predicates as P
 
 
 def make_backfill_pass():
-    """Returns backfill(snap) -> (task_node i32[T], placed bool[T])."""
+    """Returns backfill(snap, task_or_group=None, or_feasible=None) ->
+    (task_node i32[T], placed bool[T]). The optional pair is the
+    OR-of-terms node-affinity group mask (arrays/pack.py note) — required
+    affinity binds best-effort tasks too (backfill.go runs the same
+    PredicateFn)."""
 
-    def backfill(snap: SnapshotArrays):
+    def backfill(snap: SnapshotArrays, task_or_group=None, or_feasible=None):
         snap = jax.tree.map(jnp.asarray, snap)
+        if task_or_group is None:
+            task_or_group = jnp.full(snap.tasks.status.shape[0], -1,
+                                     jnp.int32)
+            or_feasible = jnp.ones((1, snap.nodes.pod_count.shape[0]), bool)
+        else:
+            task_or_group = jnp.asarray(task_or_group)
+            or_feasible = jnp.asarray(or_feasible)
         nodes, tasks, jobs = snap.nodes, snap.tasks, snap.jobs
         T = tasks.resreq.shape[0]
         N = nodes.idle.shape[0]
@@ -36,7 +47,10 @@ def make_backfill_pass():
 
         def step(carry, t):
             pods_extra, t_node, placed = carry
-            feas = (tmpl_static[tasks.template[t]]
+            grp = task_or_group[t]
+            or_ok = jnp.where(grp >= 0, or_feasible[jnp.maximum(grp, 0)],
+                              True)
+            feas = (tmpl_static[tasks.template[t]] & or_ok
                     & P.capacity_feasible(nodes, tasks.resreq[t], nodes.idle,
                                           pods_extra))
             node = jnp.argmax(feas).astype(jnp.int32)  # lowest feasible index

@@ -174,9 +174,14 @@ def make_preempt_cycle(cfg: PreemptConfig):
         future0 = nodes.future_idle()
 
         # static predicate rows per template (predicate-cache analog,
-        # predicates/cache.go:42-90) + host OR-of-terms affinity mask
-        tmpl_static = (P.template_masks(nodes, tasks, snap.template_rep)
-                       & extras.template_feasible)
+        # predicates/cache.go:42-90)
+        tmpl_static = P.template_masks(nodes, tasks, snap.template_rep)
+
+        def or_ok_row(t):
+            # per-task OR-of-terms node-affinity mask (arrays/pack.py note)
+            grp = extras.task_or_group[t]
+            return jnp.where(grp >= 0,
+                             extras.or_feasible[jnp.maximum(grp, 0)], True)
 
         S = snap.namespace_weight.shape[0]
         ns_alloc0 = jax.ops.segment_sum(
@@ -382,6 +387,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 # (preempt.go:216 -> ssn.PredicateFn -> gpu.go:27-56); the
                 # static half comes from the per-template mask rows.
                 base = (tmpl_static[tasks.template[t]]
+                        & or_ok_row(t)
                         & P.capacity_feasible(
                             nodes, jnp.zeros_like(resreq),
                             future0 + extra_idle, None,

@@ -273,7 +273,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     task_revocable = np.asarray(extras.task_revocable)
     tdm_bonus = np.asarray(extras.tdm_bonus)
     template_na = np.asarray(extras.template_na_score)
-    template_feas = np.asarray(extras.template_feasible)
+    task_or_group = np.asarray(extras.task_or_group)
+    or_feasible = np.asarray(extras.or_feasible)
     task_ports_a = np.asarray(extras.task_ports)
     node_ports_a = np.asarray(extras.node_ports)
     vol_ok = np.asarray(extras.task_volume_ok)
@@ -429,7 +430,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             greq = t_gpu_req[t]
             node_ok = (~(block_nonrevocable & ~task_revocable[t])
                        & ~block_all
-                       & template_feas[t_template[t]][:len(block_all)]
+                       & (or_feasible[task_or_group[t]][:len(block_all)]
+                          if task_or_group[t] >= 0 else True)
                        & vol_ok[t]
                        & ((vol_node[t] < 0)
                           | (np.arange(N) == vol_node[t]))
