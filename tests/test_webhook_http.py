@@ -113,3 +113,22 @@ class TestWebhookHTTP:
             str(self.mgr.address[1]))[-1] for r in regs}
         assert "/jobs/validate" in paths and "/jobs/mutate" in paths
         assert len(api.store["webhookconfigurations"]) == len(regs)
+
+
+class TestSystemIntegration:
+    def test_system_serves_webhooks_and_registers(self):
+        """The assembled control plane exposes the webhook-manager surface
+        and writes its registration records to the store."""
+        from volcano_tpu.runtime.system import VolcanoSystem
+        sys_ = VolcanoSystem()
+        mgr = sys_.start_webhook_manager()
+        try:
+            out = submit_review(mgr.url("/jobs/mutate"), "CREATE",
+                                JOB_MANIFEST)
+            assert out["response"]["allowed"]
+            regs = sys_.api.stores.get("webhookconfigurations", {})
+            assert len(regs) >= 8
+            # idempotent: starting again reuses the same manager
+            assert sys_.start_webhook_manager() is mgr
+        finally:
+            mgr.shutdown()

@@ -30,6 +30,21 @@ class VolcanoSystem:
         self.cache = SchedulerCache(self.api)
         self.conf = conf or parse_conf()
         self.scheduler = Scheduler(self.cache, conf=self.conf)
+        self._webhook_manager = None
+
+    def start_webhook_manager(self, host: str = "127.0.0.1", port: int = 0):
+        """Serve the admission webhooks over HTTP and self-register their
+        configurations into the store — the vc-webhook-manager binary
+        (cmd/webhook-manager/app/server.go:72-150). The in-process
+        interception on api.create stays active either way; this exposes
+        the NETWORK surface an external apiserver would call."""
+        from ..webhooks.server import WebhookManager
+        if self._webhook_manager is None:
+            self._webhook_manager = WebhookManager(host, port,
+                                                   apiserver=self.api)
+            self._webhook_manager.serve_in_thread()
+            self._webhook_manager.register_webhooks()
+        return self._webhook_manager
 
     # ------------------------------------------------------------ cluster
     def add_node(self, name: str, cpu="8", memory="16Gi", pods="110",

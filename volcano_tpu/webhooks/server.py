@@ -249,11 +249,19 @@ class WebhookManager:
                         "failurePolicy": "Fail",
                     }],
                 })
-        if self.apiserver is not None and hasattr(self.apiserver, "store"):
+        store = None
+        if self.apiserver is not None:
+            # runtime/apiserver.APIServer keeps per-kind stores; fall back
+            # to a flat `store` dict for simpler fakes
+            if hasattr(self.apiserver, "stores"):
+                store = self.apiserver.stores.setdefault(
+                    "webhookconfigurations", {})
+            elif hasattr(self.apiserver, "store"):
+                store = self.apiserver.store.setdefault(
+                    "webhookconfigurations", {})
+        if store is not None:
             for reg in self.registrations:
-                self.apiserver.store.setdefault(
-                    "webhookconfigurations", {})[
-                        reg["metadata"]["name"]] = reg
+                store[reg["metadata"]["name"]] = reg
         return self.registrations
 
     def serve_in_thread(self) -> threading.Thread:
