@@ -132,3 +132,26 @@ class TestSystemIntegration:
             assert sys_.start_webhook_manager() is mgr
         finally:
             mgr.shutdown()
+
+    def test_system_with_webhooks_stays_picklable(self):
+        import pickle
+        from volcano_tpu.runtime.system import VolcanoSystem
+        sys_ = VolcanoSystem()
+        mgr = sys_.start_webhook_manager()
+        try:
+            blob = pickle.dumps(sys_)
+        finally:
+            mgr.shutdown()
+        restored = pickle.loads(blob)
+        assert restored._webhook_manager is None
+
+    def test_rebind_conflict_raises(self):
+        import pytest
+        from volcano_tpu.runtime.system import VolcanoSystem
+        sys_ = VolcanoSystem()
+        mgr = sys_.start_webhook_manager()
+        try:
+            with pytest.raises(RuntimeError):
+                sys_.start_webhook_manager("0.0.0.0", 8443)
+        finally:
+            mgr.shutdown()

@@ -39,12 +39,25 @@ class VolcanoSystem:
         interception on api.create stays active either way; this exposes
         the NETWORK surface an external apiserver would call."""
         from ..webhooks.server import WebhookManager
-        if self._webhook_manager is None:
-            self._webhook_manager = WebhookManager(host, port,
-                                                   apiserver=self.api)
-            self._webhook_manager.serve_in_thread()
-            self._webhook_manager.register_webhooks()
+        if self._webhook_manager is not None:
+            bound = self._webhook_manager.address
+            if (host, port) not in ((bound[0], bound[1]),
+                                    (bound[0], 0), ("127.0.0.1", 0)):
+                raise RuntimeError(
+                    f"webhook manager already serving on {bound}; "
+                    f"cannot rebind to {(host, port)}")
+            return self._webhook_manager
+        self._webhook_manager = WebhookManager(host, port, apiserver=self.api)
+        self._webhook_manager.serve_in_thread()
+        self._webhook_manager.register_webhooks()
         return self._webhook_manager
+
+    def __getstate__(self):
+        # the live HTTP server (sockets, thread locks) must not ride the
+        # pickled state file (vcctl --state persistence)
+        state = dict(self.__dict__)
+        state["_webhook_manager"] = None
+        return state
 
     # ------------------------------------------------------------ cluster
     def add_node(self, name: str, cpu="8", memory="16Gi", pods="110",
