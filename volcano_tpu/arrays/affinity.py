@@ -110,17 +110,30 @@ def build_affinity(ci: ClusterInfo, maps: IndexMaps,
     ``n_nodes``/``n_tasks`` are the bucketed axis sizes of the packed
     snapshot (arrays/pack.py) so the tensors align with it.
     """
+    # cheap term scan first: the overwhelmingly common no-terms snapshot
+    # must not pay the indexed task-list build (it showed up in the 1 s
+    # cycle budget at 100k tasks)
+    import operator
+    terms_of = operator.attrgetter(
+        "pod_affinity", "pod_anti_affinity", "pod_affinity_preferred",
+        "pod_anti_affinity_preferred")
+    has_any = False
+    for job in ci.jobs.values():
+        for t in job.tasks.values():
+            a, b, c, d = terms_of(t)
+            if a or b or c or d:
+                has_any = True
+                break
+        if has_any:
+            break
+    if not has_any:
+        return AffinityArrays.neutral(n_nodes, n_tasks)
     tasks = []          # (task index, TaskInfo) in packed order
     for job in ci.jobs.values():
         for uid, t in job.tasks.items():
             ti = maps.task_index.get(uid)
             if ti is not None:
                 tasks.append((ti, t))
-    has_any = any(
-        t.pod_affinity or t.pod_anti_affinity or t.pod_affinity_preferred
-        or t.pod_anti_affinity_preferred for _, t in tasks)
-    if not has_any:
-        return AffinityArrays.neutral(n_nodes, n_tasks)
 
     # ---- term tables -----------------------------------------------------
     sel_index: Dict[Tuple, int] = {}

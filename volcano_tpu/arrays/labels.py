@@ -33,10 +33,25 @@ TOL_EXISTS_KEY = 1   # match key hash
 TOL_EXISTS_ALL = 2   # tolerates everything
 
 
+_HASH_CACHE: Dict[str, int] = {}
+
+
 def stable_hash(s: str) -> int:
-    """Deterministic nonzero 31-bit hash of a string (0 is the empty slot)."""
-    h = zlib.crc32(s.encode("utf-8")) & 0x7FFFFFFF
-    return h if h != 0 else 1
+    """Deterministic nonzero 31-bit hash of a string (0 is the empty slot).
+
+    Memoized: label/selector strings repeat across thousands of entities in
+    one snapshot, and the encode+crc per call dominated serialize at scale.
+    The cache is unbounded but keyed by label strings, whose population is
+    small and stable in practice; reset if it ever exceeds a safety cap."""
+    h = _HASH_CACHE.get(s)
+    if h is None:
+        if len(_HASH_CACHE) > (1 << 20):
+            _HASH_CACHE.clear()
+        h = zlib.crc32(s.encode("utf-8")) & 0x7FFFFFFF
+        if h == 0:
+            h = 1
+        _HASH_CACHE[s] = h
+    return h
 
 
 def label_hashes(labels: Dict[str, str]) -> List[int]:

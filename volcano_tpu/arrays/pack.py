@@ -33,18 +33,24 @@ from .schema import (IndexMaps, JobArrays, NodeArrays, QueueArrays,
 _READY_STATUSES = (TaskStatus.ALLOCATED, TaskStatus.BINDING, TaskStatus.BOUND,
                    TaskStatus.RUNNING, TaskStatus.SUCCEEDED)
 
+#: Additional statuses counted by ValidTaskNum but not ReadyTaskNum
+#: (job_info.go:577-595); the single source for the wire serializer's
+#: one-pass job counts too.
+_VALID_ONLY_STATUSES = (TaskStatus.PENDING, TaskStatus.PIPELINED)
+
 
 def resource_dims(ci: ClusterInfo) -> List[str]:
     """Stable resource-dimension order: cpu, memory, then sorted scalars."""
     names = {CPU, MEMORY}
+    upd = names.update
     for node in ci.nodes.values():
-        names.update(node.allocatable.resource_names())
+        upd(node.allocatable.quantities)
     for job in ci.jobs.values():
-        names.update(job.min_resources.resource_names())
+        upd(job.min_resources.quantities)
         for task in job.tasks.values():
-            names.update(task.resreq.resource_names())
+            upd(task.resreq.quantities)
     for queue in ci.queues.values():
-        names.update(queue.capability.resource_names())
+        upd(queue.capability.quantities)
     scalars = sorted(n for n in names if n not in (CPU, MEMORY))
     return [CPU, MEMORY] + scalars
 

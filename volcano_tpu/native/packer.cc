@@ -101,9 +101,15 @@ struct Reader {
 };
 
 int32_t Bucket(int64_t n, int32_t minimum) {
+  // Mirror of arrays/schema.bucket (graded grid): powers of two up to
+  // 1024, then multiples of next_pow2(n)/8.
   int64_t b = minimum;
-  while (b < n) b *= 2;
-  return static_cast<int32_t>(b);
+  while (b < n && b < 1024) b *= 2;
+  if (n <= b) return static_cast<int32_t>(b);
+  int64_t p = 1;
+  while (p < n) p *= 2;
+  int64_t g = p / 8 > 1024 ? p / 8 : 1024;
+  return static_cast<int32_t>((n + g - 1) / g * g);
 }
 
 }  // namespace

@@ -30,10 +30,16 @@ _COUNTS_FOR_REQUEST = frozenset((0, 1, 3, 4, 5))
 
 
 def _bucket(n: int, minimum: int) -> int:
+    # mirror of arrays/schema.bucket (graded grid): powers of two up to
+    # 1024, then multiples of next_pow2(n)/8
     b = minimum
-    while b < n:
+    while b < n and b < 1024:
         b *= 2
-    return b
+    if n <= b:
+        return b
+    p = 1 << (int(n) - 1).bit_length()
+    g = max(1024, p // 8)
+    return ((int(n) + g - 1) // g) * g
 
 
 class _Reader:

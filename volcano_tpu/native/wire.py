@@ -24,18 +24,26 @@ single source of truth for producing them.
 
 from __future__ import annotations
 
+import operator
 import struct
 from typing import List, Tuple
 
 import numpy as np
 
-from ..api import ClusterInfo, PodGroupPhase, QueueState, gpu_request_of
+from ..api import (GPU_MEMORY_RESOURCE, ClusterInfo, PodGroupPhase,
+                   QueueState)
 from ..arrays import labels as L
-from ..arrays.pack import (_toleration_rows, _vec, queue_capability_row,
+from ..arrays.pack import (_READY_STATUSES, _VALID_ONLY_STATUSES,
+                           _toleration_rows, queue_capability_row,
                            queue_parent_depth, resource_dims)
 from ..arrays.schema import IndexMaps
 
 MAGIC = 0x33534356  # "VCS3"
+
+#: status partitions for the single-pass job counts (job_info.go:560-600),
+#: shared with arrays/pack (the single source) as frozensets for the loop
+_READY_SET = frozenset(_READY_STATUSES)
+_VALID_ONLY_SET = frozenset(_VALID_ONLY_STATUSES)
 
 _u32 = struct.Struct("<I").pack
 _i32 = struct.Struct("<i").pack
@@ -68,15 +76,17 @@ def _ragged_column(out: List[bytes], rows: List[list], per: int = 1,
 
     ``per`` is the arity of one logical entry (e.g. 3 for taint triples);
     counts are logical entries, the flat array carries per*total values."""
-    flat_len = sum(len(r) for r in rows)
+    import itertools
+    counts = np.fromiter((len(r) for r in rows), dtype="<u4",
+                         count=len(rows))
+    flat_len = int(counts.sum())
     out.append(_u32(flat_len // per))
-    out.append(np.fromiter((len(r) // per for r in rows), dtype="<u4",
-                           count=len(rows)).tobytes())
-    flat = np.empty(flat_len, dtype=dtype)
-    off = 0
-    for r in rows:
-        flat[off:off + len(r)] = r
-        off += len(r)
+    out.append((counts // per).astype("<u4", copy=False).tobytes())
+    if flat_len:
+        flat = np.fromiter(itertools.chain.from_iterable(rows), dtype=dtype,
+                           count=flat_len)
+    else:
+        flat = np.empty(0, dtype=dtype)
     out.append(flat.tobytes())
 
 
@@ -141,13 +151,15 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     gpu_rows: List[List[float]] = []
     label_rows: List[List[int]] = []
     taint_rows: List[List[int]] = []
+    dims_t = tuple(dims)
     for i, name in enumerate(node_names):
         node = ci.nodes[name]
         for m, res in zip(res_mats,
                           (node.idle, node.used, node.releasing,
                            node.pipelined, node.allocatable,
                            node.capability)):
-            m[i] = _vec(res, dims)
+            q = res.quantities
+            m[i] = [q.get(d, 0.0) for d in dims_t]
         pod_count[i] = node.pod_count()
         max_pods[i] = node.max_pods
         sched[i] = 1 if (node.ready and not node.unschedulable) else 0
@@ -182,18 +194,35 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     j_alloc = np.empty((nj, R), dtype="<f4")
     j_minres = np.empty((nj, R), dtype="<f4")
     j_flags = np.empty((nj, 3), dtype="u1")   # pending, gang_valid, preempt
+    qidx_get = maps.queue_index.get
+    nsidx_get = ns_index.get
+    pending_phase = PodGroupPhase.PENDING
     for i, uid in enumerate(job_uids):
         job = ci.jobs[uid]
         j_min[i] = job.min_available
-        j_queue[i] = maps.queue_index.get(job.queue, -1)
-        j_ns[i] = ns_index.get(job.namespace, 0)
+        j_queue[i] = qidx_get(job.queue, -1)
+        j_ns[i] = nsidx_get(job.namespace, 0)
         j_prio[i] = job.priority
         j_ts[i] = job.creation_timestamp
-        j_ready[i] = job.ready_task_num()
-        j_alloc[i] = _vec(job.allocated, dims)
-        j_minres[i] = _vec(job.min_resources, dims)
-        gang_valid, _ = job.is_valid()
-        j_flags[i, 0] = job.pod_group_phase == PodGroupPhase.PENDING
+        # one pass over the status index instead of the ready/valid
+        # accessor pair re-walking it (ready_task_num/is_valid semantics,
+        # job_info.go:560-600 + gang.go:52-81)
+        ready = valid = 0
+        for s, tasks_of in job.task_status_index.items():
+            n = len(tasks_of)
+            if s in _READY_SET:
+                ready += n
+                valid += n
+            elif s in _VALID_ONLY_SET:
+                valid += n
+        j_ready[i] = ready
+        q = job.allocated.quantities
+        j_alloc[i] = [q.get(d, 0.0) for d in dims_t]
+        q = job.min_resources.quantities
+        j_minres[i] = [q.get(d, 0.0) for d in dims_t]
+        gang_valid = (valid >= job.min_available
+                      and job.check_task_min_available())
+        j_flags[i, 0] = job.pod_group_phase == pending_phase
         j_flags[i, 1] = gang_valid
         j_flags[i, 2] = job.preemptable
     _string_column(out, job_uids)
@@ -202,49 +231,80 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         out.append(arr.tobytes())
 
     # ---- tasks (columnar) ------------------------------------------------
+    # Column lists + one bulk numpy conversion per column: the per-task
+    # numpy scalar stores and per-task np.array(_vec) calls were the
+    # serialize bottleneck at 100k tasks (VERDICT round 3, 1 s cycle
+    # budget item).
     t_uids: List[str] = []
-    t_job = np.empty(nt, dtype="<i4")
-    t_resreq = np.empty((nt, R), dtype="<f4")
-    t_status = np.empty(nt, dtype="<i4")
-    t_prio = np.empty(nt, dtype="<i4")
-    t_node = np.empty(nt, dtype="<i4")
-    t_flags = np.empty((nt, 2), dtype="u1")   # best_effort, preemptable
-    t_gpu = np.empty(nt, dtype="<f4")
+    job_task_counts = np.fromiter(
+        (len(ci.jobs[u].tasks) for u in job_uids), dtype="<i4", count=nj)
+    resreq_rows: List[list] = []
+    status_col: List[int] = []
+    prio_col: List[int] = []
+    node_col: List[int] = []
+    flag_col: List[int] = []      # interleaved best_effort, preemptable
+    gpu_col: List[float] = []
     sel_rows: List[List[int]] = []
     tol_rows: List[List[int]] = []
+    node_index_get = maps.node_index.get
+    task_index = maps.task_index
+    gpu_dim = GPU_MEMORY_RESOURCE
+    stable_hash = L.stable_hash
+    # one C-level bulk fetch per task instead of ~10 LOAD_ATTRs
+    fields_of = operator.attrgetter(
+        "uid", "resreq.quantities", "status", "priority", "node_name",
+        "best_effort", "preemptable", "node_selector", "affinity_required",
+        "tolerations")
+    uid_append = t_uids.append
+    resreq_append = resreq_rows.append
+    status_append = status_col.append
+    prio_append = prio_col.append
+    node_append = node_col.append
+    flag_append = flag_col.append
+    gpu_append = gpu_col.append
+    sel_append = sel_rows.append
+    tol_append = tol_rows.append
+    empty: List[int] = []
     ti = 0
-    node_index = maps.node_index
-    for ji, uid in enumerate(job_uids):
+    for uid in job_uids:
         for task in ci.jobs[uid].tasks.values():
-            t_uids.append(task.uid)
-            maps.task_index[task.uid] = ti
-            t_job[ti] = ji
-            t_resreq[ti] = _vec(task.resreq, dims)
-            t_status[ti] = int(task.status)
-            t_prio[ti] = task.priority
-            t_node[ti] = node_index.get(task.node_name, -1)
-            t_flags[ti, 0] = task.best_effort
-            t_flags[ti, 1] = task.preemptable
-            t_gpu[ti] = gpu_request_of(task.resreq)
-            if task.node_selector or task.affinity_required:
-                required = dict(task.node_selector)
-                if len(task.affinity_required) == 1:
-                    required.update(task.affinity_required[0])
+            (tuid, q, status, prio, node_name, best_effort, preemptable,
+             node_selector, affinity_required, tolerations) = fields_of(task)
+            uid_append(tuid)
+            task_index[tuid] = ti
+            resreq_append([q.get(d, 0.0) for d in dims_t])
+            status_append(status)
+            prio_append(prio)
+            node_append(node_index_get(node_name, -1))
+            flag_append(best_effort)
+            flag_append(preemptable)
+            gpu_append(q.get(gpu_dim, 0.0))
+            if node_selector or affinity_required:
+                required = dict(node_selector)
+                if len(affinity_required) == 1:
+                    required.update(affinity_required[0])
                 # multi-term OR affinity: see arrays/pack.py (the packed
                 # row carries the nodeSelector conjunction only)
-                sel_rows.append(sorted(
-                    L.stable_hash(f"{k}={v}") for k, v in required.items()))
+                sel_append(sorted(
+                    stable_hash(f"{k}={v}") for k, v in required.items()))
             else:
-                sel_rows.append([])
-            if task.tolerations:
-                h, e, m = _toleration_rows(task.tolerations)
+                sel_append(empty)
+            if tolerations:
+                h, e, m = _toleration_rows(tolerations)
                 trow: List[int] = []
                 for hh, ee, mm in zip(h, e, m):
                     trow.extend((hh, ee, mm))
-                tol_rows.append(trow)
+                tol_append(trow)
             else:
-                tol_rows.append([])
+                tol_append(empty)
             ti += 1
+    t_job = np.repeat(np.arange(nj, dtype="<i4"), job_task_counts)
+    t_resreq = np.array(resreq_rows, dtype="<f4").reshape(nt, R)
+    t_status = np.fromiter(status_col, dtype="<i4", count=nt)
+    t_prio = np.fromiter(prio_col, dtype="<i4", count=nt)
+    t_node = np.fromiter(node_col, dtype="<i4", count=nt)
+    t_flags = np.fromiter(flag_col, dtype="u1", count=2 * nt).reshape(nt, 2)
+    t_gpu = np.fromiter(gpu_col, dtype="<f4", count=nt)
     maps.task_uids = t_uids
     _string_column(out, t_uids)
     for arr in (t_job, t_resreq, t_status, t_prio, t_node, t_flags, t_gpu):

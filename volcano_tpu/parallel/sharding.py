@@ -13,8 +13,10 @@ full cluster is scored every cycle:
   scatter are resolved by GSPMD-inserted collectives over ICI (an
   all-reduce-argmax per placement, the collective analog of SelectBestNode).
 
-Shapes from arrays.pack are power-of-two bucketed, so they divide any
-power-of-two mesh.
+Shapes from arrays.pack follow the graded bucket grid (arrays/schema.bucket):
+powers of two up to 1024, multiples of 1024 above — so the node axis divides
+any power-of-two mesh of up to 1024 devices, far beyond the mesh sizes this
+control-plane workload runs on (the 16-goroutine analog, SURVEY section 2.5).
 """
 
 from __future__ import annotations
