@@ -151,7 +151,11 @@ def _run(force_cpu: bool):
     tasks_per_job = int(os.environ.get("BENCH_TASKS_PER_JOB", 16))
     reps = int(os.environ.get("BENCH_REPS", 3))
     cfg_kwargs = dict(binpack_weight=1.0, least_allocated_weight=0.0,
-                      balanced_weight=0.0, taint_prefer_weight=0.0)
+                      balanced_weight=0.0, taint_prefer_weight=0.0,
+                      # batched rounds are exact here: no drf/hdrf ordering
+                      # and neutral (infinite) proportion deserved; the
+                      # snapshot carries no GPU requests
+                      batch_jobs=8, enable_gpu=False)
 
     import jax
     if force_cpu:
@@ -246,6 +250,31 @@ tiers:
         full_session_ms = (time.time() - t0) * 1000
         session_binds = len(ssn.binds)
 
+    # ---- sidecar serving cycle (SURVEY section 5.8 production path) ------
+    # The API-layer process ships a VCS3 wire snapshot; the sidecar packs it
+    # with the C++ packer and runs the compiled cycle. This measures
+    # buffer-in -> decisions-out, the recurring cost of the served cycle
+    # (client-side serialization happens in the API-layer process).
+    sidecar_ms = None
+    if not os.environ.get("BENCH_SKIP_SIDECAR"):
+        from volcano_tpu.native import available as _native_ok
+        from volcano_tpu.native.wire import serialize as _wire_ser
+        from volcano_tpu.runtime.sidecar import SchedulerSidecar
+        from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
+        if _native_ok():
+            from __graft_entry__ import _synthetic_cluster as _synth
+            wire_buf, _wm = _wire_ser(_synth(
+                n_nodes=n_nodes, n_jobs=n_jobs,
+                tasks_per_job=tasks_per_job))
+            car = SchedulerSidecar(cfg=_AC(**cfg_kwargs))
+            car.schedule_buffer(wire_buf)        # warm the jit cache
+            times = []
+            for _ in range(min(reps, 3)):
+                t0 = time.time()
+                car.schedule_buffer(wire_buf)
+                times.append(time.time() - t0)
+            sidecar_ms = min(times) * 1000
+
     # ---- topology-aware binpack with affinity (BASELINE.json config 5) ---
     # 10k nodes with zone/rack labels, required + preferred inter-pod
     # (anti-)affinity terms; runs the XLA scan path (the fused placer
@@ -326,6 +355,8 @@ tiers:
                             if full_session_ms is not None else None),
         "session_binds": (session_binds
                           if full_session_ms is not None else None),
+        "sidecar_cycle_ms": (round(sidecar_ms, 1)
+                             if sidecar_ms is not None else None),
         "affinity_cycle_ms": (round(affinity_ms, 1)
                               if affinity_ms is not None else None),
         "affinity_placed": affinity_placed,

@@ -130,6 +130,69 @@ class TestPallasEquivalence:
         np.testing.assert_array_equal(np.asarray(pls.task_gpu),
                                       cpu["task_gpu"])
 
+    @pytest.mark.parametrize("seed", [0, 5, 6])
+    @pytest.mark.parametrize("batch", [2, 4, 8])
+    def test_batched_rounds_match_sequential(self, seed, batch):
+        """K-job batched rounds (AllocateConfig.batch_jobs) are bit-exact
+        with the sequential pop order when the ordering keys are static
+        over commits (neutral deserved, no drf dynamics) — the safety
+        argument the session relies on when auto-enabling K=8."""
+        ci = random_cluster(seed, n_nodes=7, n_jobs=9, gpus=(seed % 2 == 0),
+                            taints=True)
+        cfg = AllocateConfig(binpack_weight=0.7, taint_prefer_weight=1.0)
+        _, _, scan, _ = run_both_paths(ci, cfg)
+        snap, maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        bcfg = dataclasses.replace(cfg, use_pallas="interpret",
+                                   batch_jobs=batch)
+        pls = jax.jit(make_allocate_cycle(bcfg))(snap, extras)
+        assert_equal(scan, pls)
+
+    @pytest.mark.parametrize("batch", [1, 4])
+    def test_zero_deserved_queue_blocks_batching(self, batch):
+        """A finite deserved of 0 must disqualify pop fusion AND K-job
+        batching: the first commit flips the queue overused, which the
+        sequential order re-checks before every subsequent pop
+        (proportion.go:240-253). Scan, batched pallas, and the CPU oracle
+        must all agree."""
+        from volcano_tpu.runtime.cpu_reference import allocate_cpu
+        ci = simple_cluster(n_nodes=4, node_cpu="8")
+        for j in range(3):
+            job = build_job(f"default/z{j}", min_available=1)
+            job.add_task(build_task(f"z{j}-t0", cpu="1"))
+            job.add_task(build_task(f"z{j}-t1", cpu="1"))
+            ci.add_job(job)
+        snap, maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        deserved = np.asarray(extras.queue_deserved).copy()
+        deserved[maps.queue_index["default"]] = 0.0   # zero quota
+        extras.queue_deserved = deserved
+        cfg = AllocateConfig(binpack_weight=1.0)
+        scan = jax.jit(make_allocate_cycle(
+            dataclasses.replace(cfg, use_pallas=False)))(snap, extras)
+        pls = jax.jit(make_allocate_cycle(dataclasses.replace(
+            cfg, use_pallas="interpret", batch_jobs=batch)))(snap, extras)
+        assert_equal(scan, pls)
+        cpu = allocate_cpu(snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(scan.task_mode),
+                                      cpu["task_mode"])
+
+    def test_gpu_elision_neutral(self):
+        """enable_gpu=False on a GPU-free snapshot is decision-neutral
+        (a zero gpu_request never charges a card, gpu.go:41-56)."""
+        ci = random_cluster(8, gpus=False, taints=True)
+        snap, maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        base = AllocateConfig(binpack_weight=1.0, taint_prefer_weight=1.0)
+        scan = jax.jit(make_allocate_cycle(
+            dataclasses.replace(base, use_pallas=False)))(snap, extras)
+        nog = jax.jit(make_allocate_cycle(dataclasses.replace(
+            base, use_pallas="interpret", enable_gpu=False,
+            batch_jobs=4)))(snap, extras)
+        assert_equal(scan, nog)
+
 
 class TestPallasPipelining:
     def test_pipelined_placement_on_releasing_capacity(self):

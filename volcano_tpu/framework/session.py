@@ -65,15 +65,9 @@ _FUSED_CACHE: Dict[tuple, tuple] = {}
 
 
 def _fused_allocate(cfg: AllocateConfig, snap, extras):
-    leaves = jax.tree.leaves((snap, extras))
-    key = (cfg, tuple((np.asarray(l).shape, np.asarray(l).dtype.str)
-                      for l in leaves))
-    hit = _FUSED_CACHE.get(key)
-    if hit is None:
-        from ..ops.fused_io import make_fused_cycle
-        hit = make_fused_cycle(make_allocate_cycle(cfg), (snap, extras))
-        _FUSED_CACHE[key] = hit
-    return hit
+    from ..ops.fused_io import fused_cycle_cached
+    return fused_cycle_cached(make_allocate_cycle(cfg), (snap, extras),
+                              _FUSED_CACHE, key_extra=cfg)
 
 
 @lru_cache(maxsize=64)
@@ -477,6 +471,23 @@ class Session:
         t0 = time.time()
         cfg = self.allocate_config()
         extras = self.allocate_extras()
+        # Batched pallas rounds (AllocateConfig.batch_jobs) are exact only
+        # when the job-ordering keys are static over commits: no dynamic
+        # drf/hdrf ordering AND no finite proportion deserved anywhere.
+        # Both are verifiable right here, so the session — the only
+        # auto-setter — proves the precondition it documents.
+        # ANY finite deserved (a 0 counts: zero-quota queues flip overused
+        # on the first commit) breaks the static-keys argument.
+        deserved = np.asarray(extras.queue_deserved)
+        if (cfg.batch_jobs == 1
+                and not (cfg.drf_job_order or cfg.drf_ns_order
+                         or cfg.enable_hdrf)
+                and not np.any(np.isfinite(deserved))):
+            cfg = dataclasses.replace(cfg, batch_jobs=8)
+        # GPU-free snapshots skip the per-card kernel state
+        # (decision-neutral: zero requests never charge a card)
+        if not np.any(np.asarray(self.snap.tasks.gpu_request) > 0):
+            cfg = dataclasses.replace(cfg, enable_gpu=False)
         self.stats["extras_ms"] = (time.time() - t0) * 1000
         t0 = time.time()
         # fused 3-buffer upload + single packed readback (the per-leaf
@@ -715,6 +726,21 @@ class Session:
         node_objs = self.cluster.nodes
         binds_append = self.binds.append
         binding = TaskStatus.BINDING
+        # status-index moves batched per job: bind indices are packed in
+        # job order, so the from/to buckets of job.task_status_index are
+        # fetched once per job instead of per task (the _unindex/_index
+        # pair was ~40% of the bind loop at 100k binds); empty source
+        # buckets are dropped at the job boundary, matching _unindex
+        prev_job = None
+        tsi = None
+        buckets: Dict = {}
+
+        def _flush_empties():
+            if prev_job is not None:
+                for s, b in buckets.items():
+                    if b is not None and not b and s in tsi:
+                        del tsi[s]
+
         for k, ti in enumerate(idx_l):
             if packed_objs is not None:
                 job, task = packed_objs[ti]
@@ -722,9 +748,21 @@ class Session:
                 job, task = lookup_get(uids[ti], (None, None))
             if task is None:
                 continue
-            job._unindex(task)
+            if job is not prev_job:
+                _flush_empties()
+                prev_job = job
+                tsi = job.task_status_index
+                buckets = {}
+            s = task.status
+            if s not in buckets:
+                buckets[s] = tsi.get(s)
+            src = buckets[s]
+            if src is not None:
+                src.pop(task.uid, None)
+            if buckets.get(binding) is None:
+                buckets[binding] = tsi.setdefault(binding, {})
             task.status = binding
-            job._index(task)
+            buckets[binding][task.uid] = task
             gi = gpu_l[k]
             task.gpu_index = gi
             nname = node_names[node_l[k]]
@@ -735,6 +773,7 @@ class Session:
                 if gi >= 0 and gpu_request_of(task.resreq) > 0:
                     node.add_gpu_resource(task)
             binds_append(BindIntent(task.uid, job.uid, nname, gi))
+        _flush_empties()
         for ni in touched_nodes:
             node = self.cluster.nodes.get(node_names[int(ni)])
             if node is None:

@@ -99,6 +99,19 @@ class AllocateConfig:
     #: backend, lane-aligned N, fits VMEM), True/False = force,
     #: "interpret" = pallas interpreter (for CPU tests).
     use_pallas: Optional[object] = None
+    #: Jobs per fused round (pallas path only). K > 1 runs K consecutive
+    #: job pops in ONE kernel launch with in-kernel gang commit/discard —
+    #: bit-exact with the sequential pop order ONLY when the ordering keys
+    #: are static over commits: no drf/hdrf dynamic ordering AND no finite
+    #: proportion `deserved` anywhere (else a commit moves qshare and the
+    #: next sequential pop could differ). The session auto-sets this; set
+    #: manually only when those conditions are guaranteed.
+    batch_jobs: int = 1
+    #: Shared-GPU predicate + card accounting (gpu.go:41-56). Static so
+    #: GPU-free snapshots skip the per-card kernel state entirely
+    #: (decision-neutral when no task requests GPU memory); the session
+    #: disables it when the packed gpu_request column is all zero.
+    enable_gpu: bool = True
 
 
 @jax.tree_util.register_dataclass
@@ -393,6 +406,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
         G = nodes.gpu_memory.shape[1]
 
         # ---- fused pallas round placer (ops/pallas_place.py) -------------
+        n_templates = snap.template_rep.shape[0]
+        GR = extras.or_feasible.shape[0]
+        K = max(1, int(cfg.batch_jobs))
         if cfg.use_pallas == "interpret":
             use_pallas, interp = True, True
         elif cfg.use_pallas is None:
@@ -411,7 +427,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
             use_pallas = (backend in ("tpu", "axon") and N % 128 == 0
                           and not cfg.enable_pod_affinity
                           and not cfg.enable_host_ports
-                          and vmem_estimate_bytes(M, N, R, G) < 12 * 2 ** 20)
+                          and vmem_estimate_bytes(K, M, N, R, G,
+                                                  n_templates, GR)
+                          < 12 * 2 ** 20)
             interp = False
         else:
             use_pallas, interp = bool(cfg.use_pallas), False
@@ -420,20 +438,19 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 "use_pallas excludes enable_pod_affinity/enable_host_ports: "
                 "the fused round placer carries no affinity-count or "
                 "host-port state")
+        if not use_pallas:
+            K = 1
 
         if use_pallas:
             # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
-            # the node axis is the TPU lane dimension inside the kernel; the
-            # gang-finalize wheres below are layout-agnostic.
+            # the node axis is the TPU lane dimension inside the kernel.
+            # No saved_* copies: the v2 kernel commits/discards per job
+            # section internally, so the carry IS the committed state.
             init_cap = dict(
                 idle=nodes.idle.T,
                 pipe_extra=jnp.zeros((R, N), jnp.float32),
                 pods_extra=jnp.zeros((1, N), jnp.float32),
                 gpu_extra=jnp.zeros((G, N), jnp.float32),
-                saved_idle=nodes.idle.T,
-                saved_pipe=jnp.zeros((R, N), jnp.float32),
-                saved_pods=jnp.zeros((1, N), jnp.float32),
-                saved_gpu=jnp.zeros((G, N), jnp.float32),
             )
         else:
             init_cap = dict(
@@ -496,7 +513,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
 
         if use_pallas:
             from .pallas_place import make_round_placer
-            placer = make_round_placer(cfg, M, N, R, G, interpret=interp)
+            placer = make_round_placer(cfg, K, M, N, R, G, GR,
+                                       interpret=interp)
             relmp_t = (nodes.releasing - nodes.pipelined).T
             alloc_t = nodes.allocatable.T
             cnt_row = nodes.pod_count.astype(jnp.float32)[None, :]
@@ -510,6 +528,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
                         tasks.tol_mode[ti], nodes))(rep)
             else:
                 tp_static = jnp.zeros((tmpl_static.shape[0], N), jnp.float32)
+            # static-per-cycle node maps consumed in-kernel via dynamic
+            # sublane row reads (no per-round [M, N] materialization)
+            tstat_f = tmpl_static.astype(jnp.float32)
+            na_f = extras.template_na_score.astype(jnp.float32)
+            blocknr_row = extras.block_nonrevocable.astype(
+                jnp.float32)[None, :]
+            blockall_row = extras.block_all.astype(jnp.float32)[None, :]
+            bonus_row = extras.tdm_bonus.astype(jnp.float32)[None, :]
+            locked_row = extras.node_locked.astype(jnp.float32)[None, :]
+            orfeas_f = extras.or_feasible.astype(jnp.float32)
 
         def eligible(st):
             # Overused queues are skipped (proportion.Overused,
@@ -580,12 +608,6 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 job_share_k,                         # drf JobOrderFn
                 jobs.creation_rank.astype(jnp.float32),  # FIFO fallback
             ]
-            ji, _found = lex_argmin(keys, elig)
-
-            task_ids = jobs.task_table[ji]           # i32[M]
-            min_avail = jobs.min_available[ji]
-            ready0 = jobs.ready_num[ji] + st["job_alloc_count"][ji]
-            cur = st["job_cursor"][ji]
             # Exact re-pop fusion: a ready job yields so jobs with better
             # keys get the next pop — but when every ordering key is STATIC
             # over this job's own commits, the same job wins the very next
@@ -593,101 +615,213 @@ def make_allocate_cycle(cfg: AllocateConfig):
             # batched round with bit-identical decisions. Keys are static
             # unless a drf/hdrf dynamic flag is on or the job's queue has a
             # finite proportion deserved (its qshare moves with commits).
+            # The same static-keys argument makes K-job batching exact
+            # (AllocateConfig.batch_jobs): the next K sequential pops are
+            # the K lexicographically-smallest eligible jobs right now.
             keys_static = not (cfg.drf_job_order or cfg.drf_ns_order
                                or cfg.enable_hdrf)
+            slots = jnp.arange(M, dtype=jnp.int32)
+
+            if use_pallas:
+                # ---- K batched pops, one fused kernel launch -------------
+                jis = []
+                elig_k = elig
+                jidx = jnp.arange(J, dtype=jnp.int32)
+                for _ in range(K):
+                    ji_k, found_k = lex_argmin(keys, elig_k)
+                    ji_k = jnp.where(found_k, ji_k, -1)
+                    jis.append(ji_k)
+                    elig_k = elig_k & (jidx != ji_k)
+                ji_vec = jnp.stack(jis).astype(jnp.int32)        # [K]
+                secact = ji_vec >= 0
+                jsafe = jnp.maximum(ji_vec, 0)
+                task_ids = jobs.task_table[jsafe]                # [K, M]
+                tcl = jnp.maximum(task_ids, 0)
+                curs = st["job_cursor"][jsafe]
+                open_slot = ((task_ids >= 0)
+                             & (slots[None, :] >= curs[:, None]))
+                nb = open_slot & ~tasks.best_effort[tcl]
+                rc = jnp.cumsum(nb[:, ::-1].astype(jnp.int32),
+                                axis=1)[:, ::-1]
+                suffix_after = rc - nb.astype(jnp.int32)
+                ready0_vec = (jobs.ready_num[jsafe]
+                              + st["job_alloc_count"][jsafe])
+                minav_vec = jobs.min_available[jsafe]
+                if keys_static:
+                    # ANY finite deserved (including 0) disqualifies: a
+                    # commit can flip the queue overused (allocated >
+                    # deserved + eps), which the sequential order re-checks
+                    # before every pop
+                    des_rows = queue_deserved[jobs.queue[jsafe]]  # [K, R]
+                    canb_vec = ~jnp.any(jnp.isfinite(des_rows), axis=1)
+                else:
+                    canb_vec = jnp.zeros(K, bool)
+                # Self-protection for a mis-set batch_jobs: section k runs
+                # this round only if every EARLIER section's commits are
+                # provably inert to ordering/eligibility (its can_batch
+                # holds). Deactivated sections stay eligible and pop on
+                # later rounds, restoring the exact sequential order.
+                if K > 1:
+                    prefix_ok = jnp.concatenate([
+                        jnp.ones(1, bool),
+                        jnp.cumprod(canb_vec[:-1].astype(jnp.int32)
+                                    ).astype(bool)])
+                    secact = secact & prefix_ok
+                istgt = ji_vec == extras.target_job
+
+                flat_ids = tcl.reshape(K * M)
+                args = [tasks.resreq[flat_ids].T]
+                if cfg.enable_gpu:
+                    args.append(tasks.gpu_request[flat_ids][None, :])
+                args += [
+                    nb.reshape(1, K * M).astype(jnp.int32),
+                    extras.task_pref_node[flat_ids][None, :],
+                    suffix_after.reshape(1, K * M),
+                    # clamped: padded slots carry template -1, and the
+                    # kernel reads rows with a dynamic sublane slice
+                    jnp.maximum(tasks.template[flat_ids], 0)[None, :],
+                    extras.task_or_group[flat_ids][None, :],
+                    extras.task_volume_node[flat_ids][None, :],
+                    extras.task_volume_ok[flat_ids][None, :]
+                    .astype(jnp.int32),
+                    extras.task_revocable[flat_ids][None, :]
+                    .astype(jnp.int32),
+                    ready0_vec[None, :], minav_vec[None, :],
+                    canb_vec[None, :].astype(jnp.int32),
+                    secact[None, :].astype(jnp.int32),
+                    istgt[None, :].astype(jnp.int32),
+                    tstat_f, tp_static, na_f,
+                    blocknr_row, blockall_row, bonus_row, locked_row,
+                    orfeas_f, relmp_t, alloc_t, cnt_row, maxp_row,
+                ]
+                if cfg.enable_gpu:
+                    args.append(gidle0_t)
+                args += [st["idle"], st["pipe_extra"], st["pods_extra"]]
+                if cfg.enable_gpu:
+                    args.append(st["gpu_extra"])
+                outs = placer(*args)
+                if cfg.enable_gpu:
+                    (node_s, mode_s, gpu_s, idle, pipe_extra, pods_extra,
+                     gpu_extra) = outs
+                else:
+                    (node_s, mode_s, gpu_s, idle, pipe_extra,
+                     pods_extra) = outs
+                    gpu_extra = st["gpu_extra"]
+
+                node_km = node_s.reshape(K, M)
+                mode_km = mode_s.reshape(K, M)
+                gpu_km = gpu_s.reshape(K, M)
+                placed_m = mode_km != MODE_NONE
+                n_alloc_vec = jnp.sum(mode_km == MODE_ALLOCATED,
+                                      axis=1).astype(jnp.int32)
+                n_pipe_vec = jnp.sum(mode_km == MODE_PIPELINED,
+                                     axis=1).astype(jnp.int32)
+                # gang flags from the kernel's (discard-cleared) modes:
+                # a discarded section counts zero, reproducing the XLA
+                # finalize's false flags; kept sections carry real counts
+                if cfg.enable_gang:
+                    ready_vec = (ready0_vec + n_alloc_vec) >= minav_vec
+                else:
+                    ready_vec = jnp.ones(K, bool)
+                pipelined_vec = ((ready0_vec + n_alloc_vec + n_pipe_vec)
+                                 >= minav_vec) & ~ready_vec
+                keep_vec = ready_vec | pipelined_vec
+                # kept-but-unready gangs hold capacity without binding:
+                # demote Allocated -> Pipelined (session.go:317-330)
+                demote = ((keep_vec & ~ready_vec)[:, None]
+                          & (mode_km == MODE_ALLOCATED))
+                mode_out = jnp.where(demote, MODE_PIPELINED, mode_km)
+                widx = jnp.where((task_ids >= 0) & placed_m, task_ids, T)
+                wflat = widx.reshape(K * M)
+                t_node = st["task_node"].at[wflat].set(
+                    node_km.reshape(K * M), mode="drop")
+                t_mode = st["task_mode"].at[wflat].set(
+                    mode_out.reshape(K * M), mode="drop")
+                t_gpu = st["task_gpu"].at[wflat].set(
+                    gpu_km.reshape(K * M), mode="drop")
+
+                # replay yield/break per section from the mode rows
+                # (allocate.go:205-278)
+                alloc_cum = jnp.cumsum((mode_km == MODE_ALLOCATED)
+                                       .astype(jnp.int32), axis=1)
+                if cfg.enable_gang:
+                    ready_aft = ((ready0_vec[:, None] + alloc_cum)
+                                 >= minav_vec[:, None])
+                else:
+                    ready_aft = jnp.ones((K, M), bool)
+                stop_evt = (nb & placed_m & ready_aft
+                            & (suffix_after > 0) & ~canb_vec[:, None])
+                broke_evt = nb & ~placed_m
+                first_stop = jnp.min(
+                    jnp.where(stop_evt, slots[None, :], M), axis=1)
+                first_broke = jnp.min(
+                    jnp.where(broke_evt, slots[None, :], M), axis=1)
+                stopped_vec = first_stop < first_broke
+                broke_vec = (~stopped_vec) & (first_broke < M)
+                boundary = jnp.where(stopped_vec | broke_vec,
+                                     jnp.minimum(first_stop, first_broke),
+                                     M - 1)
+                n_adv = jnp.sum(
+                    open_slot & (slots[None, :] <= boundary[:, None]),
+                    axis=1).astype(jnp.int32)
+                committed = jnp.sum(
+                    jnp.where(placed_m[:, :, None], tasks.resreq[tcl],
+                              0.0), axis=1)                       # [K, R]
+
+                jdrop = jnp.where(secact, jsafe, J)
+                Q = st["queue_allocated"].shape[0]
+                qdrop = jnp.where(secact, jobs.queue[jsafe], Q)
+                return dict(
+                    idle=idle, pipe_extra=pipe_extra,
+                    pods_extra=pods_extra, gpu_extra=gpu_extra,
+                    aff_cnt=st["aff_cnt"], anti_cnt=st["anti_cnt"],
+                    saved_aff=st["saved_aff"], saved_anti=st["saved_anti"],
+                    pe_node=st["pe_node"], pe_port=st["pe_port"],
+                    pe_cnt=st["pe_cnt"],
+                    saved_pe_node=st["saved_pe_node"],
+                    saved_pe_port=st["saved_pe_port"],
+                    saved_pe_cnt=st["saved_pe_cnt"],
+                    task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
+                    job_done=st["job_done"].at[jdrop].set(
+                        ~stopped_vec, mode="drop"),
+                    job_popped=st["job_popped"].at[jdrop].set(
+                        jnp.ones(K, bool), mode="drop"),
+                    job_ready=st["job_ready"].at[jdrop].set(
+                        ready_vec, mode="drop"),
+                    job_pipelined=st["job_pipelined"].at[jdrop].set(
+                        pipelined_vec, mode="drop"),
+                    job_cursor=st["job_cursor"].at[jdrop].add(
+                        n_adv, mode="drop"),
+                    job_alloc_count=st["job_alloc_count"].at[jdrop].add(
+                        jnp.where(keep_vec, n_alloc_vec, 0), mode="drop"),
+                    job_alloc_dyn=st["job_alloc_dyn"].at[jdrop].add(
+                        committed, mode="drop"),
+                    queue_allocated=st["queue_allocated"].at[qdrop].add(
+                        committed, mode="drop"),
+                    rounds=st["rounds"] + 1,
+                )
+
+            # ---- scan path: single pop ----------------------------------
+            ji, _found = lex_argmin(keys, elig)
+
+            task_ids = jobs.task_table[ji]           # i32[M]
+            min_avail = jobs.min_available[ji]
+            ready0 = jobs.ready_num[ji] + st["job_alloc_count"][ji]
+            cur = st["job_cursor"][ji]
             if keys_static:
+                # ANY finite deserved (including 0) disqualifies — see the
+                # batched branch above; zero-quota queues flip overused on
+                # the first commit
                 des_row = queue_deserved[jobs.queue[ji]]
-                can_batch = ~jnp.any(jnp.isfinite(des_row) & (des_row > 0))
+                can_batch = ~jnp.any(jnp.isfinite(des_row))
             else:
                 can_batch = jnp.bool_(False)
-            slots = jnp.arange(M, dtype=jnp.int32)
             open_slot = (task_ids >= 0) & (slots >= cur)
             nb_row = open_slot & ~tasks.best_effort[jnp.maximum(task_ids, 0)]
             # real tasks remaining in the job's queue strictly after slot m
             # (the !tasks.Empty() side of the yield check, allocate.go:262)
             rc = jnp.cumsum(nb_row[::-1].astype(jnp.int32))[::-1]
             suffix_after = rc - nb_row.astype(jnp.int32)
-
-            # ---- inner placement: pop tasks until yield/break/exhausted ---
-            def pallas_round():
-                """One fused kernel launch for the whole round
-                (ops/pallas_place.py) instead of the M-step scan."""
-                tcl = jnp.maximum(task_ids, 0)
-                tmpl_ids = tasks.template[tcl]
-                vol_node = extras.task_volume_node[tcl]
-                grp = extras.task_or_group[tcl]
-                or_rows = jnp.where(
-                    (grp >= 0)[:, None],
-                    extras.or_feasible[jnp.maximum(grp, 0)], True)
-                node_ok = (~(extras.block_nonrevocable[None, :]
-                             & ~extras.task_revocable[tcl][:, None])
-                           & ~extras.block_all[None, :]
-                           & or_rows
-                           # volume-binding seam: unbindable claims block,
-                           # local-PV claims pin (cache.go:240-272)
-                           & extras.task_volume_ok[tcl][:, None]
-                           & ((vol_node < 0)[:, None]
-                              | (jnp.arange(N)[None, :] == vol_node[:, None]))
-                           & (~extras.node_locked
-                              | (ji == extras.target_job))[None, :])
-                sfeas = (tmpl_static[tmpl_ids] & node_ok).astype(jnp.float32)
-                sscore = tp_static[tmpl_ids]
-                # second static score ref keeps the scan path's f32 addition
-                # association: (dyn+taint) + (na + rev*bonus) + preference
-                sscore2 = (extras.template_na_score[tmpl_ids]
-                           + jnp.where(extras.task_revocable[tcl][:, None],
-                                       extras.tdm_bonus[None, :], 0.0))
-                resreq_t = tasks.resreq[tcl].T
-                gpu_req_row = tasks.gpu_request[tcl][None, :]
-                active_row = nb_row[None, :].astype(jnp.int32)
-                pref_row = extras.task_pref_node[tcl][None, :]
-                suffix_row = suffix_after[None, :]
-                meta_row = jnp.zeros((1, M), jnp.int32)
-                meta_row = meta_row.at[0, 0].set(ready0)
-                meta_row = meta_row.at[0, 1].set(min_avail)
-                meta_row = meta_row.at[0, 2].set(can_batch.astype(jnp.int32))
-                (node_s, mode_s, gpu_s, idle, pipe_extra, pods_extra,
-                 gpu_extra) = placer(
-                    resreq_t, gpu_req_row, active_row, pref_row, suffix_row,
-                    meta_row, sfeas, sscore, sscore2, relmp_t, alloc_t,
-                    cnt_row, maxp_row, gidle0_t, st["idle"],
-                    st["pipe_extra"], st["pods_extra"], st["gpu_extra"])
-                # write back only this round's placements — earlier pops of
-                # a yielded job already own their slots' decisions
-                placed_m = mode_s != MODE_NONE
-                widx = jnp.where((task_ids >= 0) & placed_m, task_ids, T)
-                t_node = st["task_node"].at[widx].set(node_s, mode="drop")
-                t_mode = st["task_mode"].at[widx].set(mode_s, mode="drop")
-                t_gpu = st["task_gpu"].at[widx].set(gpu_s, mode="drop")
-                n_alloc = jnp.sum(mode_s == MODE_ALLOCATED).astype(jnp.int32)
-                n_pipe = jnp.sum(mode_s == MODE_PIPELINED).astype(jnp.int32)
-                # replay the kernel's yield/break events from the mode row:
-                # first stop event (placed & ready & queue non-empty) vs
-                # first break event (attempted & unplaced)
-                alloc_cum = jnp.cumsum((mode_s == MODE_ALLOCATED)
-                                       .astype(jnp.int32))
-                if cfg.enable_gang:
-                    ready_aft = (ready0 + alloc_cum) >= min_avail
-                else:
-                    ready_aft = jnp.ones(M, bool)
-                stop_evt = (nb_row & placed_m & ready_aft
-                            & (suffix_after > 0) & ~can_batch)
-                broke_evt = nb_row & ~placed_m
-                first_stop = jnp.min(jnp.where(stop_evt, slots, M))
-                first_broke = jnp.min(jnp.where(broke_evt, slots, M))
-                stopped = first_stop < first_broke
-                broke = (~stopped) & (first_broke < M)
-                boundary = jnp.where(stopped | broke,
-                                     jnp.minimum(first_stop, first_broke),
-                                     M - 1)
-                n_adv = jnp.sum(open_slot & (slots <= boundary)
-                                ).astype(jnp.int32)
-                placed_sum = jnp.sum(
-                    jnp.where(placed_m[:, None], tasks.resreq[tcl], 0.0),
-                    axis=0)
-                return (idle, pipe_extra, pods_extra, gpu_extra,
-                        t_node, t_mode, t_gpu, n_alloc, n_pipe,
-                        placed_sum, n_adv, stopped, broke)
 
             def task_step(carry, xs):
                 (idle, pipe_extra, pods_extra, gpu_extra,
@@ -823,27 +957,19 @@ def make_allocate_cycle(cfg: AllocateConfig):
                         aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
                         placed_sum, n_adv, stopped, broke), None
 
-            if use_pallas:
-                (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
-                 t_gpu, n_alloc, n_pipe, placed_sum, n_adv, stopped,
-                 broke) = pallas_round()
-                aff_cnt, anti_cnt = st["aff_cnt"], st["anti_cnt"]
-                pe_node, pe_port, pe_cnt = (st["pe_node"], st["pe_port"],
-                                            st["pe_cnt"])
-            else:
-                carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
-                          st["gpu_extra"], st["task_node"], st["task_mode"],
-                          st["task_gpu"], jnp.int32(0), jnp.int32(0),
-                          st["aff_cnt"], st["anti_cnt"],
-                          st["pe_node"], st["pe_port"], st["pe_cnt"],
-                          jnp.zeros(R, jnp.float32), jnp.int32(0),
-                          jnp.bool_(False), jnp.bool_(False))
-                (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
-                 t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt,
-                 pe_node, pe_port, pe_cnt, placed_sum,
-                 n_adv, stopped, broke), _ = jax.lax.scan(
-                    task_step, carry0, (task_ids, slots, suffix_after),
-                    unroll=min(int(M), 16))
+            carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
+                      st["gpu_extra"], st["task_node"], st["task_mode"],
+                      st["task_gpu"], jnp.int32(0), jnp.int32(0),
+                      st["aff_cnt"], st["anti_cnt"],
+                      st["pe_node"], st["pe_port"], st["pe_cnt"],
+                      jnp.zeros(R, jnp.float32), jnp.int32(0),
+                      jnp.bool_(False), jnp.bool_(False))
+            (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
+             t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt,
+             pe_node, pe_port, pe_cnt, placed_sum,
+             n_adv, stopped, broke), _ = jax.lax.scan(
+                task_step, carry0, (task_ids, slots, suffix_after),
+                unroll=min(int(M), 16))
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
             ready = (ready0 + n_alloc) >= min_avail

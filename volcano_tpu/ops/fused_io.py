@@ -91,3 +91,19 @@ def make_fused_cycle(cycle_fn, example_tree):
         return cycle_fn(snap, extras).packed_decisions()
 
     return fn, fuse
+
+
+def fused_cycle_cached(cycle_fn, tree, cache: dict, key_extra=None):
+    """Shape-signature-memoized make_fused_cycle.
+
+    The single implementation of the (key_extra, per-leaf shape/dtype) cache
+    key used by both the Session (framework/session.py) and the sidecar
+    (runtime/sidecar.py) so the two callers cannot drift."""
+    leaves = jax.tree.leaves(tree)
+    key = (key_extra, tuple((np.asarray(l).shape, np.asarray(l).dtype.str)
+                            for l in leaves))
+    hit = cache.get(key)
+    if hit is None:
+        hit = make_fused_cycle(cycle_fn, tree)
+        cache[key] = hit
+    return hit

@@ -1,28 +1,47 @@
-"""Pallas TPU kernel: one fused placement round of the allocate pass.
+"""Pallas TPU kernel: fused placement rounds of the allocate pass.
 
-The hot inner loop of the cycle places the M pending tasks of the selected
-gang one by one (capacity feedback between placements is what makes the pass
+The hot inner loop of the cycle places the pending tasks of selected gangs
+one by one (capacity feedback between placements is what makes the pass
 exact, SURVEY.md section 7 hard part 1). The pure-XLA path runs it as a
 ``lax.scan`` whose every step issues ~40 small HLO ops over [N]-shaped
-arrays; this kernel fuses the WHOLE round into one ``pl.pallas_call`` with
-the capacity state (idle, pipelined-extra, pod counts, per-GPU-card usage)
-resident in VMEM across all M placements — one kernel launch per round
-instead of M x ~40.
+arrays; this kernel fuses WHOLE placement rounds into one ``pl.pallas_call``
+with the capacity state (idle, pipelined-extra, pod counts, per-GPU-card
+usage) resident in VMEM across all placements.
 
-Layout: node-axis tensors are transposed to [R, N] / [G, N] so the node axis
-is the 128-lane dimension (R/G are tiny; [N, R] would waste 32x lanes).
+v2 design (on top of the round-fused v1):
+
+- **In-kernel template gathers.** Per-task static feasibility/score rows are
+  read from the per-TEMPLATE matrices ([P, N] — the predicate-cache analog,
+  predicates/cache.go:42-90) with dynamic sublane slices inside the kernel,
+  instead of materializing [M, N] gather outputs in XLA every round. A round
+  now ships only O(M) scalars per task plus the (static-per-cycle) template
+  maps.
+- **K-job batched rounds** (``K`` static): one launch runs K job sections
+  sequentially with per-section gang commit/discard (JobReady /
+  JobPipelined / Statement.Discard, statement.go:352-395) INSIDE the kernel,
+  so the committed capacity flows section to section without a host/XLA
+  round-trip. Batching K > 1 is bit-exact with the sequential pop order iff
+  the job-ordering keys are static over commits — no drf/hdrf dynamic
+  ordering and no finite proportion ``deserved`` (see
+  AllocateConfig.batch_jobs; the session only enables it when those hold).
+- **Optional GPU path** (``enable_gpu`` static): snapshots with no shared-GPU
+  requests skip the per-card state entirely (decision-neutral: a zero
+  gpu_request never charges a card, gpu.go:41-56).
+
+Layout: node-axis tensors are transposed to [R, N] / [G, N] / [P, N] so the
+node axis is the 128-lane dimension (R/G/P are small; [N, R] would waste 32x
+lanes).
 
 Semantics are bit-identical to the scan path in allocate_scan.task_step
 (asserted by tests/test_pallas_place.py): same feasibility conjunction, same
-score formulas (ops/scoring.py), same lowest-index argmax tie-break
-(ops/select.py best_node), same lowest-fitting-card GPU pick
-(ops/predicates.py pick_gpu_row).
+score formulas (ops/scoring.py) in the same f32 addition order, same
+lowest-index argmax tie-break (ops/select.py best_node), same
+lowest-fitting-card GPU pick (ops/predicates.py pick_gpu_row).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -81,186 +100,294 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
     return score
 
 
-def _round_kernel(cfg, M, N, R, G,
-                  # inputs
-                  resreq_t_ref, gpu_req_ref, active_ref, pref_ref,
-                  suffix_ref, meta_ref, sfeas_ref,
-                  sscore_ref, sscore2_ref, relmp_ref, alloc_t_ref, cnt_ref,
-                  maxp_ref, gidle0_ref, idle_ref, pipe_ref, podsx_ref,
-                  gpux_ref,
-                  # outputs
-                  node_ref, mode_ref, gpu_ref,
-                  idle_o_ref, pipe_o_ref, podsx_o_ref, gpux_o_ref):
+def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
+    """K job sections x M placements, all in VMEM.
+
+    ``refs`` is the flat ref list in the order built by make_round_placer;
+    unpacked here to keep the signature manageable.
+    """
+    gpu = bool(cfg.enable_gpu)
+    it = iter(refs)
+
+    def nxt():
+        return next(it)
+
+    resreq_t_ref = nxt()      # [R, KM]
+    gpu_req_ref = nxt() if gpu else None        # [1, KM]
+    active_ref = nxt()        # [1, KM] i32 (open & not best-effort)
+    pref_ref = nxt()          # [1, KM] i32
+    suffix_ref = nxt()        # [1, KM] i32
+    tmpl_ref = nxt()          # [1, KM] i32 template id (clamped)
+    grp_ref = nxt()           # [1, KM] i32 OR-group id (-1 none)
+    voln_ref = nxt()          # [1, KM] i32 volume pin node (-1 any)
+    volok_ref = nxt()         # [1, KM] i32 volume-bindable flag
+    rev_ref = nxt()           # [1, KM] i32 task revocable flag
+    ready0_ref = nxt()        # [1, K] i32
+    minav_ref = nxt()         # [1, K] i32
+    canb_ref = nxt()          # [1, K] i32 can-batch (re-pop fusion) flag
+    secact_ref = nxt()        # [1, K] i32 section active (ji >= 0)
+    istgt_ref = nxt()         # [1, K] i32 section job == reservation target
+    tstat_ref = nxt()         # [P, N] f32 template static feasibility
+    tscore_ref = nxt()        # [P, N] f32 taint-prefer static score
+    nascore_ref = nxt()       # [P, N] f32 NodeAffinity preferred score
+    blocknr_ref = nxt()       # [1, N] f32 tdm block-nonrevocable
+    blockall_ref = nxt()      # [1, N] f32 tdm block-all
+    bonus_ref = nxt()         # [1, N] f32 tdm revocable bonus
+    locked_ref = nxt()        # [1, N] f32 reservation node locks
+    orfeas_ref = nxt()        # [GR, N] f32 OR-of-terms group feasibility
+    relmp_ref = nxt()         # [R, N] releasing - pipelined
+    alloc_t_ref = nxt()       # [R, N]
+    cnt_ref = nxt()           # [1, N]
+    maxp_ref = nxt()          # [1, N]
+    gidle0_ref = nxt() if gpu else None         # [G, N]
+    idle_ref = nxt()          # [R, N] in
+    pipe_ref = nxt()          # [R, N] in
+    podsx_ref = nxt()         # [1, N] in
+    gpux_ref = nxt() if gpu else None           # [G, N] in
+    node_o = nxt()            # [1, KM] out
+    mode_o = nxt()            # [1, KM] out
+    gpu_o = nxt()             # [1, KM] out
+    idle_o = nxt()            # [R, N] out
+    pipe_o = nxt()            # [R, N] out
+    podsx_o = nxt()           # [1, N] out
+    gpux_o = nxt() if gpu else None             # [G, N] out
+
+    KM = K * M
     relmp = relmp_ref[:]
     alloc_t = alloc_t_ref[:]
     cnt = cnt_ref[:]
     maxp = maxp_ref[:]
-    gidle0 = gidle0_ref[:]
-    resreq_t = resreq_t_ref[:]      # [R, M]
-    gpu_req = gpu_req_ref[:]        # [1, M]
-    active_v = active_ref[:]        # [1, M] int32
-    pref_v = pref_ref[:]            # [1, M] int32
-    suffix_v = suffix_ref[:]        # [1, M] i32 queued tasks after slot m
-    meta_v = meta_ref[:]            # [1, M] i32: [0]=ready0, [1]=min_avail
-    # sfeas/sscore/sscore2 [M, N] stay in their refs: the per-task row comes
-    # out as a dynamic SUBLANE slice below instead of a one-hot [M, N]
-    # reduction (which re-read the whole matrix every task — 3 x M x N x 4B
-    # per round of avoidable VMEM traffic)
+    resreq_t = resreq_t_ref[:]
+    active_v = active_ref[:]
+    pref_v = pref_ref[:]
+    suffix_v = suffix_ref[:]
+    tmpl_v = tmpl_ref[:]
+    grp_v = grp_ref[:]
+    voln_v = voln_ref[:]
+    volok_v = volok_ref[:]
+    rev_v = rev_ref[:]
+    ready0_v = ready0_ref[:]
+    minav_v = minav_ref[:]
+    canb_v = canb_ref[:]
+    secact_v = secact_ref[:]
+    istgt_v = istgt_ref[:]
+    blocknr = blocknr_ref[:] > 0
+    blockall = blockall_ref[:] > 0
+    bonus = bonus_ref[:]
+    locked = locked_ref[:] > 0
+    if gpu:
+        gpu_req = gpu_req_ref[:]
+        gidle0 = gidle0_ref[:]
+
     iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
-    iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
-    iota_m = jax.lax.broadcasted_iota(jnp.int32, (1, M), 1)
-    iota_m_col = jax.lax.broadcasted_iota(jnp.int32, (M, 1), 0)
-    ready0 = jnp.sum(jnp.where(iota_m == 0, meta_v, 0))
-    min_avail = jnp.sum(jnp.where(iota_m == 1, meta_v, 0))
-    can_batch = jnp.sum(jnp.where(iota_m == 2, meta_v, 0)) > 0
+    iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0) if gpu else None
+    iota_km = jax.lax.broadcasted_iota(jnp.int32, (1, KM), 1)
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
 
-    def body(m, carry):
-        # mosaic has no dynamic lane/sublane indexing, so the per-task row
-        # selections are one-hot reductions
+    def seli(row, idx, iota):
+        # mosaic has no dynamic lane indexing: scalar = one-hot reduce
+        return jnp.sum(jnp.where(iota == idx, row, 0))
+
+    def job_body(k, jcarry):
+        # committed (post gang-finalize) state from prior sections
+        (cidle, cpipe, cpods, cgpux, node_v, mode_v, gpuc_v) = jcarry
+        ready0 = seli(ready0_v, k, iota_k)
+        min_avail = seli(minav_v, k, iota_k)
+        can_batch = seli(canb_v, k, iota_k) > 0
+        sec_act = seli(secact_v, k, iota_k) > 0
+        is_tgt = seli(istgt_v, k, iota_k) > 0
+
+        def task_body(m, tcarry):
+            (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
+             n_allocs, n_pipes, stopped, broke) = tcarry
+            s = k * M + m
+            sel_s = (iota_km == s).astype(jnp.float32)          # [1, KM]
+            sel_i = sel_s.astype(jnp.int32)
+            rr_col = jnp.sum(resreq_t * sel_s, axis=1, keepdims=True)  # [R,1]
+            act = jnp.sum(active_v * sel_i) > 0
+            pref = jnp.sum(pref_v * sel_i)
+            suffix = jnp.sum(suffix_v * sel_i)
+            tmpl = jnp.sum(tmpl_v * sel_i)
+            grp = jnp.sum(grp_v * sel_i)
+            voln = jnp.sum(voln_v * sel_i)
+            volok = jnp.sum(volok_v * sel_i) > 0
+            rev = jnp.sum(rev_v * sel_i) > 0
+
+            # static feasibility row: template mask + per-cycle node gates
+            # (the node_ok conjunction of allocate_scan.task_step)
+            trow = (pl.dslice(tmpl, 1), slice(None))
+            sfeas = tstat_ref[trow] > 0                          # [1, N]
+            sfeas &= ~(blocknr & ~rev) & ~blockall
+            orrow = orfeas_ref[(pl.dslice(jnp.maximum(grp, 0), 1),
+                                slice(None))] > 0
+            sfeas &= orrow | (grp < 0)
+            sfeas &= volok & ((voln < 0) | (iota_n == voln))
+            sfeas &= ~locked | is_tgt
+
+            future = jnp.maximum(idle + relmp - pipe, 0.0)
+            pods_ok = (cnt + podsx) < maxp
+            shared = sfeas & pods_ok
+            if gpu:
+                gr = jnp.sum(gpu_req * sel_s, axis=1, keepdims=True)  # [1,1]
+                gidle = gidle0 - gpux
+                gpu_ok = (gr <= 0) | jnp.any(gidle >= gr - _EPS_FIT,
+                                             axis=0, keepdims=True)
+                shared &= gpu_ok
+            fit_now = jnp.all(rr_col <= idle + _EPS_FIT, axis=0,
+                              keepdims=True)
+            fit_fut = jnp.all(rr_col <= future + _EPS_FIT, axis=0,
+                              keepdims=True)
+            feas_now = shared & fit_now
+            feas_fut = shared & fit_fut
+
+            # f32 addition order matches allocate_scan exactly:
+            # dyn terms, then taint-static, then (nodeaffinity + rev*bonus),
+            # then task-topology preference
+            score = _dyn_score(cfg, idle, alloc_t, rr_col)
+            score = score + tscore_ref[trow]
+            score = score + (nascore_ref[trow]
+                             + jnp.where(rev, bonus, 0.0))
+            score = score + jnp.where((pref >= 0) & (iota_n == pref),
+                                      100.0, 0.0)
+
+            def pick(feas):
+                masked = jnp.where(feas, score, NEG)
+                best = jnp.max(masked)
+                idx = jnp.min(jnp.where(masked == best, iota_n, N))
+                found = jnp.max(feas.astype(jnp.int32)) > 0
+                return idx, found
+
+            n_now, found_now = pick(feas_now)
+            n_fut, found_fut = pick(feas_fut)
+            # yield/break state gates the attempt (allocate.go:205-266)
+            active = act & sec_act & ~stopped & ~broke
+            can_now = found_now & active
+            can_fut = found_fut & active & bool(cfg.enable_pipelining)
+            do_alloc = can_now
+            do_pipe = (~can_now) & can_fut
+            placed = do_alloc | do_pipe
+            node = jnp.where(do_alloc, n_now, n_fut)
+
+            onehot = (iota_n == node).astype(jnp.float32)        # [1, N]
+            idle = idle - jnp.where(do_alloc, 1.0, 0.0) * rr_col * onehot
+            pipe = pipe + jnp.where(do_pipe, 1.0, 0.0) * rr_col * onehot
+            podsx = podsx + jnp.where(placed, 1.0, 0.0) * onehot
+
+            if gpu:
+                # lowest fitting card on the chosen node (pick_gpu_row)
+                gcol = jnp.sum(gidle * onehot, axis=1, keepdims=True)  # [G,1]
+                gfits = gcol >= gr - _EPS_FIT
+                card = jnp.min(jnp.where(gfits, iota_g, G))
+                ok_pick = (jnp.max(gfits.astype(jnp.int32)) > 0) \
+                    & (gr[0, 0] > 0)
+                card = jnp.where(ok_pick, card, -1)
+                charge = placed & (card >= 0)
+                gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
+                               * (iota_g == jnp.maximum(card, 0)) * onehot)
+            else:
+                card = jnp.int32(-1)
+                charge = jnp.bool_(False)
+
+            mode = jnp.where(do_alloc, MODE_ALLOCATED,
+                             jnp.where(do_pipe, MODE_PIPELINED, MODE_NONE))
+            is_s = iota_km == s
+            node_v = jnp.where(is_s, jnp.where(placed, node, -1), node_v)
+            mode_v = jnp.where(is_s, mode, mode_v)
+            gpuc_v = jnp.where(is_s, jnp.where(charge, card, -1), gpuc_v)
+            n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
+            n_pipes = n_pipes + jnp.where(do_pipe, 1, 0)
+            if cfg.enable_gang:
+                ready_aft = (ready0 + n_allocs) >= min_avail
+            else:
+                ready_aft = True
+            stopped = stopped | (placed & ready_aft & (suffix > 0)
+                                 & ~can_batch)
+            broke = broke | (active & ~placed)
+            return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
+                    n_allocs, n_pipes, stopped, broke)
+
         (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
-         n_allocs, stopped, broke) = carry
-        sel_m = (iota_m == m).astype(jnp.float32)            # [1,M]
-        rr_col = jnp.sum(resreq_t * sel_m, axis=1, keepdims=True)   # [R,1]
-        gr = jnp.sum(gpu_req * sel_m, axis=1, keepdims=True)        # [1,1]
-        act = jnp.sum(active_v * sel_m.astype(jnp.int32), axis=1,
-                      keepdims=True)                                # [1,1]
-        pref = jnp.sum(pref_v * sel_m.astype(jnp.int32), axis=1,
-                       keepdims=True)                               # [1,1]
-        suffix = jnp.sum(jnp.where(iota_m == m, suffix_v, 0))       # scalar
-        row = (pl.dslice(m, 1), slice(None))
-        sfeas_m = sfeas_ref[row]                                    # [1,N]
-        sscore_m = sscore_ref[row]
-        sscore2_m = sscore2_ref[row]
+         n_allocs, n_pipes, _stopped, _broke) = jax.lax.fori_loop(
+            0, M, task_body,
+            (cidle, cpipe, cpods, cgpux, node_v, mode_v, gpuc_v,
+             jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.bool_(False)))
 
-        future = jnp.maximum(idle + relmp - pipe, 0.0)
-        pods_ok = (cnt + podsx) < maxp
-        gidle = gidle0 - gpux
-        gpu_ok = (gr <= 0) | jnp.any(gidle >= gr - _EPS_FIT, axis=0,
-                                     keepdims=True)
-        shared = (sfeas_m > 0) & pods_ok & gpu_ok
-        fit_now = jnp.all(rr_col <= idle + _EPS_FIT, axis=0, keepdims=True)
-        fit_fut = jnp.all(rr_col <= future + _EPS_FIT, axis=0, keepdims=True)
-        feas_now = shared & fit_now
-        feas_fut = shared & fit_fut
-
-        # addition order matches allocate_scan exactly (float associativity):
-        # dyn terms (binpack..balanced), then taint-static, then the
-        # combined nodeaffinity+tdm static term, then preference
-        score = _dyn_score(cfg, idle, alloc_t, rr_col)
-        score = score + sscore_m
-        score = score + sscore2_m
-        score = score + jnp.where((pref >= 0) & (iota_n == pref),
-                                  100.0, 0.0)
-
-        def pick(feas):
-            # scalar reductions go through int32 (mosaic cannot squeeze
-            # bool arrays to scalars)
-            masked = jnp.where(feas, score, NEG)
-            best = jnp.max(masked)
-            idx = jnp.min(jnp.where(masked == best, iota_n, N))
-            found = jnp.max(feas.astype(jnp.int32)) > 0
-            return idx, found
-
-        n_now, found_now = pick(feas_now)
-        n_fut, found_fut = pick(feas_fut)
-        # yield/break state gates the attempt (allocate.go:205-266): after a
-        # ready-job yield or an unplaceable task, remaining slots are no-ops
-        active = (act[0, 0] > 0) & ~stopped & ~broke
-        can_now = found_now & active
-        can_fut = found_fut & active & bool(cfg.enable_pipelining)
-        do_alloc = can_now
-        do_pipe = (~can_now) & can_fut
-        placed = do_alloc | do_pipe
-        node = jnp.where(do_alloc, n_now, n_fut)
-
-        onehot = (iota_n == node).astype(jnp.float32)               # [1,N]
-        idle = idle - jnp.where(do_alloc, 1.0, 0.0) * rr_col * onehot
-        pipe = pipe + jnp.where(do_pipe, 1.0, 0.0) * rr_col * onehot
-        podsx = podsx + jnp.where(placed, 1.0, 0.0) * onehot
-
-        # lowest fitting card on the chosen node (pick_gpu_row)
-        gcol = jnp.sum(gidle * onehot, axis=1, keepdims=True)       # [G,1]
-        gfits = gcol >= gr - _EPS_FIT
-        card = jnp.min(jnp.where(gfits, iota_g, G))
-        gpu_ok_pick = (jnp.max(gfits.astype(jnp.int32)) > 0) & (gr[0, 0] > 0)
-        card = jnp.where(gpu_ok_pick, card, -1)
-        charge = placed & (card >= 0)
-        gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
-                       * (iota_g == jnp.maximum(card, 0)) * onehot)
-
-        mode = jnp.where(do_alloc, MODE_ALLOCATED,
-                         jnp.where(do_pipe, MODE_PIPELINED, MODE_NONE))
-        is_m = iota_m == m
-        node_v = jnp.where(is_m, jnp.where(placed, node, -1), node_v)
-        mode_v = jnp.where(is_m, mode, mode_v)
-        gpuc_v = jnp.where(is_m, jnp.where(charge, card, -1), gpuc_v)
-        n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
+        # ---- gang finalize in-kernel (JobReady/JobPipelined/Discard) ------
         if cfg.enable_gang:
-            ready_aft = (ready0 + n_allocs) >= min_avail
+            ready = (ready0 + n_allocs) >= min_avail
         else:
-            ready_aft = True
-        stopped = stopped | (placed & ready_aft & (suffix > 0) & ~can_batch)
-        broke = broke | (active & ~placed)
-        return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
-                n_allocs, stopped, broke)
+            ready = jnp.bool_(True)
+        pipelined = (ready0 + n_allocs + n_pipes) >= min_avail
+        keep = ready | pipelined
+        sec = (iota_km >= k * M) & (iota_km < (k + 1) * M)
+        node_v = jnp.where(keep | ~sec, node_v, -1)
+        mode_v = jnp.where(keep | ~sec, mode_v, MODE_NONE)
+        gpuc_v = jnp.where(keep | ~sec, gpuc_v, -1)
+        idle = jnp.where(keep, idle, cidle)
+        pipe = jnp.where(keep, pipe, cpipe)
+        podsx = jnp.where(keep, podsx, cpods)
+        if gpu:
+            gpux = jnp.where(keep, gpux, cgpux)
+        return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v)
 
-    neg1 = jnp.full((1, M), -1, jnp.int32)
-    (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
-     _n_allocs, _stopped, _broke) = jax.lax.fori_loop(
-        0, M, body,
-        (idle_ref[:], pipe_ref[:], podsx_ref[:], gpux_ref[:],
-         neg1, jnp.zeros((1, M), jnp.int32), neg1,
-         jnp.int32(0), jnp.bool_(False), jnp.bool_(False)))
-    node_ref[:] = node_v
-    mode_ref[:] = mode_v
-    gpu_ref[:] = gpuc_v
-    idle_o_ref[:] = idle
-    pipe_o_ref[:] = pipe
-    podsx_o_ref[:] = podsx
-    gpux_o_ref[:] = gpux
+    neg1 = jnp.full((1, KM), -1, jnp.int32)
+    gpux0 = gpux_ref[:] if gpu else jnp.zeros((1, 1), jnp.float32)
+    (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v) = jax.lax.fori_loop(
+        0, K, job_body,
+        (idle_ref[:], pipe_ref[:], podsx_ref[:], gpux0,
+         neg1, jnp.zeros((1, KM), jnp.int32), neg1))
+    node_o[:] = node_v
+    mode_o[:] = mode_v
+    gpu_o[:] = gpuc_v
+    idle_o[:] = idle
+    pipe_o[:] = pipe
+    podsx_o[:] = podsx
+    if gpu:
+        gpux_o[:] = gpux
 
 
-def make_round_placer(cfg, M: int, N: int, R: int, G: int,
-                      interpret: bool = False):
-    """Build the fused round placer.
+def make_round_placer(cfg, K: int, M: int, N: int, R: int, G: int,
+                      GR: int, interpret: bool = False):
+    """Build the fused batched-round placer.
 
-    Returns place(resreq_t [R,M], gpu_req [1,M], active [1,M], pref [1,M],
-    suffix [1,M] (queued tasks after each slot), meta [1,M] ([0]=ready
-    count, [1]=minAvailable, [2]=can-batch flag), sfeas [M,N],
-    sscore [M,N] (taint-static), sscore2 [M,N] (nodeaffinity+tdm static),
-    relmp [R,N], alloc_t [R,N], cnt [1,N], maxp [1,N], gidle0 [G,N],
-    idle [R,N], pipe [R,N], podsx [1,N], gpux [G,N])
-    -> (node [M], mode [M], gpu [M], idle', pipe', podsx', gpux').
+    Returns place(args...) with the input order documented in
+    _batch_kernel; outputs (node [KM], mode [KM], gpu [KM], idle', pipe',
+    podsx'[, gpux']). GPU refs are absent when cfg.enable_gpu is False.
     """
-    kernel = functools.partial(_round_kernel, cfg, M, N, R, G)
+    kernel = functools.partial(_batch_kernel, cfg, K, M, N, R, G, GR)
     f32 = jnp.float32
+    KM = K * M
+    gpu = bool(cfg.enable_gpu)
 
-    def place(resreq_t, gpu_req, active, pref, suffix, meta, sfeas, sscore,
-              sscore2, relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx,
-              gpux):
+    out_shape = [
+        jax.ShapeDtypeStruct((1, KM), jnp.int32),   # node
+        jax.ShapeDtypeStruct((1, KM), jnp.int32),   # mode
+        jax.ShapeDtypeStruct((1, KM), jnp.int32),   # gpu
+        jax.ShapeDtypeStruct((R, N), f32),          # idle'
+        jax.ShapeDtypeStruct((R, N), f32),          # pipe'
+        jax.ShapeDtypeStruct((1, N), f32),          # podsx'
+    ]
+    if gpu:
+        out_shape.append(jax.ShapeDtypeStruct((G, N), f32))  # gpux'
+
+    def place(*args):
         outs = pl.pallas_call(
-            kernel,
-            out_shape=(
-                jax.ShapeDtypeStruct((1, M), jnp.int32),   # node
-                jax.ShapeDtypeStruct((1, M), jnp.int32),   # mode
-                jax.ShapeDtypeStruct((1, M), jnp.int32),   # gpu
-                jax.ShapeDtypeStruct((R, N), f32),         # idle'
-                jax.ShapeDtypeStruct((R, N), f32),         # pipe'
-                jax.ShapeDtypeStruct((1, N), f32),         # podsx'
-                jax.ShapeDtypeStruct((G, N), f32),         # gpux'
-            ),
+            lambda *refs: kernel(refs),
+            out_shape=tuple(out_shape),
             interpret=interpret,
-        )(resreq_t, gpu_req, active, pref, suffix, meta, sfeas, sscore,
-          sscore2, relmp, alloc_t, cnt, maxp, gidle0, idle, pipe, podsx,
-          gpux)
-        node, mode, gpu, idle2, pipe2, podsx2, gpux2 = outs
-        return (node[0], mode[0], gpu[0], idle2, pipe2, podsx2, gpux2)
+        )(*args)
+        node, mode, gpuc = outs[0][0], outs[1][0], outs[2][0]
+        return (node, mode, gpuc) + tuple(outs[3:])
 
     return place
 
 
-def vmem_estimate_bytes(M: int, N: int, R: int, G: int) -> int:
+def vmem_estimate_bytes(K: int, M: int, N: int, R: int, G: int,
+                        P: int, GR: int) -> int:
     """Rough VMEM footprint of the kernel's live values."""
-    per_n = (4 * R * 6 + 4 * G * 3 + 4 * 4) * N     # [R,N]/[G,N]/[1,N] f32
-    per_mn = (4 + 4 + 4) * M * N                    # sfeas + sscore + sscore2
-    return per_n + per_mn
+    per_n = 4 * N * (R * 6          # relmp/alloc/idle/pipe + committed pair
+                     + G * 3        # gidle0 + gpux pair
+                     + 3 * P        # template feasibility/score maps
+                     + GR + 8)      # OR groups + block/bonus/lock/cnt rows
+    per_km = 4 * K * M * (R + 10)   # per-task rows
+    return per_n + per_km

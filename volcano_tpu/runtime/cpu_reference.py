@@ -404,9 +404,11 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
         # so the single-task yields batch into one pass
         keys_static = not (cfg.drf_job_order or cfg.drf_ns_order
                            or cfg.enable_hdrf)
+        # ANY finite deserved (a 0 counts: zero-quota queues flip overused
+        # on the first commit) breaks the static-keys argument
         des_row = queue_deserved[jqueue[ji]]
         can_batch = keys_static and not bool(
-            np.any(np.isfinite(des_row) & (des_row > 0)))
+            np.any(np.isfinite(des_row)))
         if aff_st is not None:
             saved_aff = (aff_st["aff_cnt"].copy(), aff_st["anti_cnt"].copy())
         placed: List[int] = []
