@@ -172,7 +172,8 @@ def _run(force_cpu: bool):
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
-    from volcano_tpu.ops.allocate_scan import make_allocate_cycle
+    from volcano_tpu.ops.allocate_scan import (AllocateExtras,
+                                               make_allocate_cycle)
     from volcano_tpu.runtime.cpu_reference import allocate_cpu
 
     snap, extras, cfg = _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs)
@@ -275,6 +276,93 @@ tiers:
                 times.append(time.time() - t0)
             sidecar_ms = min(times) * 1000
 
+    # ---- DRF multi-queue fair share (BASELINE.json config 3) -------------
+    # 8 weighted queues, 50k tasks over 1k nodes (capacity-scarce so the
+    # dominant-resource ordering decides who places), drf JobOrderFn with
+    # live share recomputation per pop (drf.go:454-472 + 511-536).
+    drf_ms = drf_placed = None
+    if not (force_cpu or os.environ.get("BENCH_SKIP_DRF")):
+        from __graft_entry__ import _synthetic_cluster as _synth
+        from volcano_tpu.api import QueueInfo
+        from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
+        dci = _synth(n_nodes=int(os.environ.get("BENCH_DRF_NODES", 1024)),
+                     n_jobs=int(os.environ.get("BENCH_DRF_JOBS", 3125)),
+                     tasks_per_job=16)
+        for q in range(8):
+            dci.add_queue(QueueInfo(f"q{q}", weight=1 + q % 4))
+        for j, job in enumerate(dci.jobs.values()):
+            job.queue = f"q{j % 8}"
+        from volcano_tpu import native as _nat
+        dsnap, _dm = _nat.pack_best_effort(dci)
+        dextras = AllocateExtras.neutral(dsnap)
+        dcfg = _AC(binpack_weight=1.0, least_allocated_weight=0.0,
+                   balanced_weight=0.0, taint_prefer_weight=0.0,
+                   drf_job_order=True, enable_gpu=False)
+        dfn = jax.jit(make_allocate_cycle(dcfg))
+        dresult, drf_ms, _ = _time_device(dfn, dsnap, dextras, min(reps, 2))
+        drf_placed = int(np.asarray(dresult.task_mode > 0).sum())
+
+    # ---- gang + preempt at scale (BASELINE.json config 4) ----------------
+    # 10k nodes ~75% full of Running preemptable low-priority tasks plus
+    # starving high-priority gangs; the preempt kernel picks victims via
+    # the tiered dispatch and pipelines the preemptors.
+    preempt_ms = preempt_victims = preempt_pipelined = None
+    if not (force_cpu or os.environ.get("BENCH_SKIP_PREEMPT")):
+        from __graft_entry__ import _synthetic_cluster as _synth
+        from volcano_tpu.api import (JobInfo, PodGroupPhase, Resource,
+                                     TaskInfo, TaskStatus)
+        from volcano_tpu.ops.preempt import PreemptConfig, make_preempt_cycle
+        from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
+        pci = _synth(n_nodes=int(os.environ.get("BENCH_PRE_NODES", 10000)),
+                     n_jobs=int(os.environ.get("BENCH_PRE_JOBS", 6000)),
+                     tasks_per_job=16)
+        pnodes = list(pci.nodes)
+        k = 0
+        for job in pci.jobs.values():
+            job.preemptable = True
+            job.pod_group_phase = PodGroupPhase.RUNNING
+            for t in job.tasks.values():
+                nn = pnodes[k % len(pnodes)]
+                k += 1
+                t.status = TaskStatus.RUNNING
+                t.node_name = nn
+                pci.nodes[nn].add_task(t)
+        n_gangs = int(os.environ.get("BENCH_PRE_GANGS", 64))
+        for j in range(n_gangs):
+            job = JobInfo(f"default/hp-{j:05d}", queue="default",
+                          min_available=8, priority=100,
+                          creation_timestamp=float(j),
+                          pod_group_phase=PodGroupPhase.INQUEUE)
+            for t in range(16):
+                job.add_task(TaskInfo(
+                    uid=f"default/hp-{j:05d}-{t}", name=f"hp-{j:05d}-{t}",
+                    resreq=Resource.from_resource_list(
+                        {"cpu": "1500m", "memory": "1Gi"})))
+            pci.add_job(job)
+        from volcano_tpu import native as _nat2
+        psnap, _pm = _nat2.pack_best_effort(pci)
+        pextras = AllocateExtras.neutral(psnap)
+        pcfg = PreemptConfig(scoring=_AC(
+            binpack_weight=1.0, least_allocated_weight=0.0,
+            balanced_weight=0.0, taint_prefer_weight=0.0, enable_gpu=False))
+        pT = psnap.tasks.status.shape[0]
+        pveto = np.zeros(pT, bool)
+        pskip = np.zeros(pT, bool)
+        from volcano_tpu.ops.allocate_scan import MODE_PIPELINED as _MP
+        pfn = jax.jit(make_preempt_cycle(pcfg))
+        pres = pfn(psnap, pextras, pveto, pskip)       # compile + warm
+        np.asarray(pres.evicted)
+        ptimes = []
+        for _ in range(min(reps, 2)):
+            t0 = time.time()
+            pres = pfn(psnap, pextras, pveto, pskip)
+            pev = np.asarray(pres.evicted)
+            ptm = np.asarray(pres.task_mode)
+            ptimes.append(time.time() - t0)
+        preempt_ms = min(ptimes) * 1000
+        preempt_victims = int(pev.sum())
+        preempt_pipelined = int((ptm == _MP).sum())
+
     # ---- topology-aware binpack with affinity (BASELINE.json config 5) ---
     # 10k nodes with zone/rack labels, required + preferred inter-pod
     # (anti-)affinity terms; runs the XLA scan path (the fused placer
@@ -357,6 +445,12 @@ tiers:
                           if full_session_ms is not None else None),
         "sidecar_cycle_ms": (round(sidecar_ms, 1)
                              if sidecar_ms is not None else None),
+        "drf_cycle_ms": (round(drf_ms, 1) if drf_ms is not None else None),
+        "drf_placed": drf_placed,
+        "preempt_cycle_ms": (round(preempt_ms, 1)
+                             if preempt_ms is not None else None),
+        "preempt_victims": preempt_victims,
+        "preempt_pipelined": preempt_pipelined,
         "affinity_cycle_ms": (round(affinity_ms, 1)
                               if affinity_ms is not None else None),
         "affinity_placed": affinity_placed,

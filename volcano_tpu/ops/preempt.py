@@ -139,6 +139,23 @@ def make_preempt_cycle(cfg: PreemptConfig):
         total_cap = snap.cluster_capacity
         vjob = jnp.maximum(tasks.job, 0)
         vqueue = jobs.queue[vjob]
+        # static per-victim projections hoisted out of the round loop:
+        # [T]-index gathers serialize on TPU (~ms each at 100k tasks), so
+        # anything constant per cycle gathers once here and anything that
+        # moves with evictions rides the carry as a [T, R] view
+        vprio = jobs.priority[vjob]
+        vns = jobs.namespace[vjob]
+        S_ns = snap.namespace_weight.shape[0]
+        Q_q = queues.allocated.shape[0]
+        # one-hot matmul views replace [T]-index gathers from small tables
+        # (MXU-friendly; a [T] gather serializes)
+        vns_onehot = (vns[:, None]
+                      == jnp.arange(S_ns)[None, :]).astype(jnp.float32)
+        vq_onehot = (vqueue[:, None]
+                     == jnp.arange(Q_q)[None, :]).astype(jnp.float32)
+        vdes = queue_deserved[vqueue]
+        vreclaimable = queues.reclaimable[vqueue]
+        vrevocable = extras.revocable_node[jnp.maximum(tasks.node, 0)]
 
         # victims must be Running with a real request (preempt.go:116-123,
         # reclaim.go:129-136)
@@ -199,6 +216,11 @@ def make_preempt_cycle(cfg: PreemptConfig):
             # live drf/proportion state (event handlers, drf.go:511-561,
             # proportion.go:281-325)
             job_alloc_dyn=jobs.allocated,
+            # [T, R] per-victim view of its job's live allocation: the
+            # job_alloc_dyn[vjob] gather hoisted to one trace-time gather
+            # and maintained incrementally (a per-step [T] gather
+            # serializes on TPU)
+            valloc=jobs.allocated[vjob],
             queue_alloc_dyn=queues.allocated,
             ns_alloc_dyn=ns_alloc0,
             saved=None,  # replaced below
@@ -206,7 +228,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
         )
         saved_keys = ("extra_idle", "pipe_extra", "evicted",
                       "task_node", "task_mode", "job_alloc_dyn",
-                      "queue_alloc_dyn", "ns_alloc_dyn")
+                      "queue_alloc_dyn", "ns_alloc_dyn", "valloc")
         init["saved"] = {k: init[k] for k in saved_keys}
 
         def eligible(st):
@@ -216,14 +238,18 @@ def make_preempt_cycle(cfg: PreemptConfig):
             return jnp.any(eligible(st)) & (st["rounds"] < J)
 
         def victim_rule(name, t, ji, evicted, job_alloc_dyn, queue_alloc_dyn,
-                        ns_alloc_dyn):
-            """bool[T] candidate mask of one plugin's victim fn."""
+                        ns_alloc_dyn, valloc):
+            """bool[T] candidate mask of one plugin's victim fn.
+
+            ``valloc`` is the carried [T, R] per-victim view of its job's
+            live allocation (the job_alloc_dyn[vjob] gather, maintained
+            incrementally because a [T] gather serializes on TPU)."""
             pprio = jobs.priority[ji]
             if name == "priority" and intra:
                 # same-job branch: task priorities (priority.go:99-107)
                 return tasks.priority < tasks.priority[t]
             if name in ("priority", "gang"):
-                return jobs.priority[vjob] < pprio
+                return vprio < pprio
             if name == "conformance":
                 return ~victim_veto
             if name == "tdm":
@@ -231,14 +257,13 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 # (tdm.go:193-197); victims are preemptable Running tasks
                 # on non-revocable nodes (tdm.go:199-218)
                 abstain = tasks.preemptable[t]
-                mask = (tasks.preemptable
-                        & ~extras.revocable_node[jnp.maximum(tasks.node, 0)])
+                mask = tasks.preemptable & ~vrevocable
                 return mask & ~abstain
             if name == "drf":
+                hi = jax.lax.Precision.HIGHEST
                 ls = dominant_share(
                     job_alloc_dyn[ji] + tasks.resreq[t], total_cap)
-                rs = dominant_share(
-                    job_alloc_dyn[vjob] - tasks.resreq, total_cap)
+                rs = dominant_share(valloc - tasks.resreq, total_cap)
                 job_rule = (ls < rs) | (jnp.abs(ls - rs) <= _DELTA)
                 if not cfg.scoring.drf_ns_order:
                     return job_rule
@@ -250,11 +275,13 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 lns = dominant_share(
                     ns_alloc_dyn[p_ns] + tasks.resreq[t],
                     total_cap) / nsw[p_ns]
-                v_ns = jobs.namespace[vjob]
+                # HIGHEST precision: the one-hot matmul is a row select,
+                # and default TPU matmul precision (bf16 inputs) would
+                # round the allocations the shareDelta compares
                 rns = dominant_share(
-                    ns_alloc_dyn[v_ns] - tasks.resreq,
-                    total_cap) / nsw[v_ns]
-                same_ns = v_ns == p_ns
+                    jnp.matmul(vns_onehot, ns_alloc_dyn, precision=hi)
+                    - tasks.resreq, total_cap) / nsw[vns]
+                same_ns = vns == p_ns
                 return jnp.where(
                     same_ns, job_rule,
                     (lns < rns)
@@ -262,8 +289,11 @@ def make_preempt_cycle(cfg: PreemptConfig):
             if name == "proportion":
                 # queue what-if (proportion.go:217-236): enough allocation
                 # to subtract, and deserved still covered afterwards
-                q_alloc = queue_alloc_dyn[vqueue]
-                des = queue_deserved[vqueue]
+                # HIGHEST precision: row select must stay exact (the
+                # 1e-6-tolerance coverage check below)
+                q_alloc = jnp.matmul(vq_onehot, queue_alloc_dyn,
+                                     precision=jax.lax.Precision.HIGHEST)
+                des = vdes
                 after = q_alloc - tasks.resreq
                 has = ~jnp.all(q_alloc < tasks.resreq, axis=-1)
                 covered = jnp.all(
@@ -297,48 +327,40 @@ def make_preempt_cycle(cfg: PreemptConfig):
             ok = jax.vmap(what_if)(idx) & pre[idx]
             return jnp.zeros(T, bool).at[idx].set(ok)
 
-        def victim_mask_for(t, ji, evicted, job_alloc_dyn, queue_alloc_dyn,
-                            ns_alloc_dyn):
-            """Frozen victim set for one preemptor task: tiered
-            intersection with per-node first-non-empty-tier-wins."""
-            base = running & ~evicted
+        def victim_tier_masks(t, ji, evicted, job_alloc_dyn, queue_alloc_dyn,
+                              ns_alloc_dyn, valloc):
+            """Per-tier candidate masks [K_tiers x bool[T]] for one
+            preemptor task (tiered dispatch, session_plugins.go:131-215).
+            The per-NODE first-non-empty-tier selection happens lazily in
+            the candidate-node walk — the old global scatter to [K, N]
+            cost ~ms per task step on TPU."""
+            vbase = running & ~evicted
             if reclaim:
-                base &= (vqueue != jobs.queue[ji]) & queues.reclaimable[vqueue]
+                vbase &= (vqueue != jobs.queue[ji]) & vreclaimable
             elif intra:
                 # phase 2: victims within the preemptor's own job
                 # (preempt.go:168-175 filter)
-                base &= tasks.job == ji
+                vbase &= tasks.job == ji
             else:
-                base &= (vqueue == jobs.queue[ji]) & (tasks.job != ji)
+                vbase &= (vqueue == jobs.queue[ji]) & (tasks.job != ji)
             if not any(len(tier) for tier in cfg.tiers):
                 # no plugin registered a victim fn: the reference dispatch
                 # returns nil -> no victims at all (session_plugins.go:131)
-                return jnp.zeros_like(base)
+                return jnp.zeros((1,) + vbase.shape, bool)
             tier_masks = []
             for tier in cfg.tiers:
                 if not tier:
                     continue
-                m = base
+                m = vbase
                 for name in tier:
                     if name == "drf_hdrf":
                         continue     # expensive rule intersects last
                     m &= victim_rule(name, t, ji, evicted, job_alloc_dyn,
-                                     queue_alloc_dyn, ns_alloc_dyn)
+                                     queue_alloc_dyn, ns_alloc_dyn, valloc)
                 if "drf_hdrf" in tier:
                     m = hdrf_rule(t, ji, job_alloc_dyn, m)
                 tier_masks.append(m)
-            stacked = jnp.stack(tier_masks)                    # [K, T]
-            node_idx = jnp.where(stacked, tasks.node[None, :], N)
-            node_any = jnp.zeros((len(tier_masks), N + 1), bool)
-            node_any = node_any.at[
-                jnp.arange(len(tier_masks))[:, None], node_idx].set(
-                    True)[:, :N]                               # [K, N]
-            first_tier = jnp.argmax(node_any, axis=0)          # [N]
-            has_tier = jnp.any(node_any, axis=0)
-            pick = first_tier[jnp.maximum(tasks.node, 0)]      # [T]
-            chosen = jnp.take_along_axis(
-                stacked, pick[None, :], axis=0)[0]
-            return chosen & has_tier[jnp.maximum(tasks.node, 0)]
+            return jnp.stack(tier_masks)                       # [K, T]
 
         def body(st):
             elig = eligible(st)
@@ -365,7 +387,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
 
             def task_step(carry, t_idx):
                 (extra_idle, pipe_extra, evicted, t_node, t_mode,
-                 job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
+                 job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn, valloc,
                  n_pipe, broke) = carry
                 active = (t_idx >= 0) & ~tasks.best_effort[jnp.maximum(t_idx, 0)]
                 active &= ~skip[jnp.maximum(t_idx, 0)]
@@ -395,34 +417,115 @@ def make_preempt_cycle(cfg: PreemptConfig):
 
                 # the victim set is FROZEN for this preemptor's eviction
                 # loop (preempt.go:218-233 builds it once per node)
-                vok = victim_mask_for(t, ji, evicted, job_alloc_dyn,
-                                      queue_alloc_dyn, ns_alloc_dyn)
-                evictable = jax.ops.segment_sum(
-                    jnp.where(vok[:, None], tasks.resreq, 0.0),
-                    jnp.where(vok, tasks.node, N), num_segments=N + 1)[:N]
-
+                stacked = victim_tier_masks(t, ji, evicted, job_alloc_dyn,
+                                            queue_alloc_dyn, ns_alloc_dyn,
+                                            valloc)
                 avail = future0 + extra_idle - pipe_extra
-                enough = jnp.all(resreq[None, :] <= avail + evictable + 1e-5,
-                                 axis=-1)
-                feas = base & enough & active
                 score = _score_fn(cfg.scoring, snap, resreq, nodes.idle,
                                   tasks.tol_hash[t], tasks.tol_effect[t],
                                   tasks.tol_mode[t])
-                node = jnp.argmax(jnp.where(feas, score, NEG)).astype(jnp.int32)
-                found = jnp.any(feas)
+
+                def node_victims(n):
+                    """Victim mask + freeable sum on candidate node n: the
+                    first tier with any candidate on n wins, candidates
+                    intersect within it (session_plugins.go:131-215)."""
+                    on_n = tasks.node == n
+                    t_has = jnp.any(stacked & on_n[None, :], axis=1)
+                    ktier = jnp.argmax(t_has)
+                    chosen = jnp.zeros_like(on_n)
+                    for kk in range(stacked.shape[0]):
+                        chosen = jnp.where(ktier == kk, stacked[kk], chosen)
+                    vok_n = chosen & on_n & jnp.any(t_has)
+                    ev_n = jnp.sum(
+                        jnp.where(vok_n[:, None], tasks.resreq, 0.0), axis=0)
+                    return vok_n, ev_n
+
+                # Score-ordered candidate walk with early exit: the first
+                # node (argmax, lowest-index ties) whose frozen victim set
+                # plus available capacity covers the request — exactly the
+                # `base & enough` argmax the old global segment-sum
+                # computed, without its per-step [T]->[N] scatters. Walks
+                # one node in the common case. Candidates are pruned by an
+                # upper bound (avail + total victim resources anywhere),
+                # and a 64-iteration cap hands the rare residue to the
+                # exact global segment-sum path under lax.cond, so a
+                # saturated no-victim cluster cannot degrade to an O(N)
+                # sequential walk.
+                iota_n = jnp.arange(N, dtype=jnp.int32)
+                vic_ub = jnp.sum(
+                    jnp.where(jnp.any(stacked, axis=0)[:, None],
+                              tasks.resreq, 0.0), axis=0)         # [R]
+                possible = base & jnp.all(
+                    resreq[None, :] <= avail + vic_ub[None, :] + 1e-5,
+                    axis=-1)
+
+                def cand_cond(c):
+                    tried, found, _node, k = c
+                    return ((~found) & jnp.any(possible & ~tried) & active
+                            & (k < 64))
+
+                def cand_body(c):
+                    tried, _found, node0, k = c
+                    cand = jnp.argmax(jnp.where(
+                        possible & ~tried, score, NEG)).astype(jnp.int32)
+                    _vok_c, ev_c = node_victims(cand)
+                    fits_c = jnp.all(resreq <= avail[cand] + ev_c + 1e-5)
+                    return (tried | (iota_n == cand), fits_c,
+                            jnp.where(fits_c, cand, node0), k + 1)
+
+                tried, found, node, _k = jax.lax.while_loop(
+                    cand_cond, cand_body,
+                    (jnp.zeros(N, bool), jnp.bool_(False), jnp.int32(0),
+                     jnp.int32(0)))
+
+                def _exact_pick(args):
+                    """Global per-node tier dispatch + victim aggregation
+                    (the segment-sum path) — the walk's cap was hit, so
+                    finish with one exact global argmax over the
+                    untried candidates."""
+                    tried, found0, node0 = args
+                    node_idx = jnp.where(stacked, tasks.node[None, :], N)
+                    n_tiers = stacked.shape[0]
+                    node_any = jnp.zeros((n_tiers, N + 1), bool)
+                    node_any = node_any.at[
+                        jnp.arange(n_tiers)[:, None], node_idx].set(
+                            True)[:, :N]
+                    first_tier = jnp.argmax(node_any, axis=0)
+                    has_tier = jnp.any(node_any, axis=0)
+                    pick = first_tier[jnp.maximum(tasks.node, 0)]
+                    chosen = jnp.take_along_axis(
+                        stacked, pick[None, :], axis=0)[0]
+                    vok_g = chosen & has_tier[jnp.maximum(tasks.node, 0)]
+                    evictable = jax.ops.segment_sum(
+                        jnp.where(vok_g[:, None], tasks.resreq, 0.0),
+                        jnp.where(vok_g, tasks.node, N),
+                        num_segments=N + 1)[:N]
+                    enough = jnp.all(
+                        resreq[None, :] <= avail + evictable + 1e-5, axis=-1)
+                    feas = possible & ~tried & enough
+                    nd = jnp.argmax(
+                        jnp.where(feas, score, NEG)).astype(jnp.int32)
+                    fnd = jnp.any(feas)
+                    return (fnd, jnp.where(fnd, nd, node0))
+
+                found, node = jax.lax.cond(
+                    active & ~found & jnp.any(possible & ~tried),
+                    _exact_pick, lambda a: (a[1], a[2]),
+                    (tried, found, node))
+                vok, _ = node_victims(node)
 
                 # evict victims on `node`, lowest task priority first (the
                 # inverted TaskOrderFn queue, preempt.go:228-233), until
                 # the preemptor fits future idle
                 def evict_cond(ec):
-                    extra_idle, _e, _ja, _qa, _na, k = ec
+                    extra_idle, _e, _ja, _qa, _na, _va, k = ec
                     fits = jnp.all(
                         resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
                     return found & ~fits & (k < cfg.max_victims_per_task)
 
                 def evict_body(ec):
                     (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
-                     ns_alloc_dyn, k) = ec
+                     ns_alloc_dyn, valloc, k) = ec
                     vok_now = vok & ~evicted & (tasks.node == node)
                     vkeys = [
                         tasks.priority.astype(jnp.float32),
@@ -439,15 +542,16 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     ns_alloc_dyn = ns_alloc_dyn.at[
                         jobs.namespace[jnp.maximum(tasks.job[vt], 0)]].add(
                             -dres)
+                    valloc = valloc - (vjob == tasks.job[vt])[:, None] * dres
                     return (extra_idle, evicted, job_alloc_dyn,
-                            queue_alloc_dyn, ns_alloc_dyn,
+                            queue_alloc_dyn, ns_alloc_dyn, valloc,
                             jnp.where(doit, k + 1, cfg.max_victims_per_task))
 
                 (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
-                 ns_alloc_dyn, _) = jax.lax.while_loop(
+                 ns_alloc_dyn, valloc, _) = jax.lax.while_loop(
                     evict_cond, evict_body,
                     (extra_idle, evicted, job_alloc_dyn, queue_alloc_dyn,
-                     ns_alloc_dyn, jnp.int32(0)))
+                     ns_alloc_dyn, valloc, jnp.int32(0)))
 
                 fits = found & jnp.all(
                     resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
@@ -458,6 +562,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 job_alloc_dyn = job_alloc_dyn.at[ji].add(pres)
                 queue_alloc_dyn = queue_alloc_dyn.at[jobs.queue[ji]].add(pres)
                 ns_alloc_dyn = ns_alloc_dyn.at[jobs.namespace[ji]].add(pres)
+                valloc = valloc + (vjob == ji)[:, None] * pres
                 t_node = t_node.at[t].set(jnp.where(fits, node, t_node[t]))
                 t_mode = t_mode.at[t].set(
                     jnp.where(fits, MODE_PIPELINED, t_mode[t]))
@@ -465,15 +570,17 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 broke |= active & ~fits
                 return (extra_idle, pipe_extra, evicted, t_node, t_mode,
                         job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
-                        n_pipe, broke), None
+                        valloc, n_pipe, broke), None
 
             carry0 = (st["extra_idle"], st["pipe_extra"], st["evicted"],
                       st["task_node"], st["task_mode"],
                       st["job_alloc_dyn"], st["queue_alloc_dyn"],
-                      st["ns_alloc_dyn"], jnp.int32(0), jnp.bool_(False))
+                      st["ns_alloc_dyn"], st["valloc"],
+                      jnp.int32(0), jnp.bool_(False))
             (extra_idle, pipe_extra, evicted, t_node, t_mode,
-             job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
-             n_pipe, _broke), _ = jax.lax.scan(task_step, carry0, task_ids)
+             job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn, valloc,
+             n_pipe, _broke), _ = jax.lax.scan(task_step, carry0, task_ids,
+                                               unroll=min(int(M), 16))
 
             pipelined = (jobs.ready_num[ji] + waiting0[ji] + n_pipe
                          >= jobs.min_available[ji])
@@ -485,7 +592,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                        evicted=evicted, task_node=t_node, task_mode=t_mode,
                        job_alloc_dyn=job_alloc_dyn,
                        queue_alloc_dyn=queue_alloc_dyn,
-                       ns_alloc_dyn=ns_alloc_dyn)
+                       ns_alloc_dyn=ns_alloc_dyn, valloc=valloc)
             saved = st["saved"]
             job_tasks = tasks.job == ji
             merged = {}
