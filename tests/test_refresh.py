@@ -1,0 +1,154 @@
+"""Session.refresh_snapshot: incremental snapshot patching vs full repack.
+
+The steady-state cycle path (the event-handler analog of the reference's
+incrementally maintained cache, event_handlers.go:43-740): after binds,
+evictions, and status churn on an unchanged entity set, the patched arrays
+must equal a from-scratch pack of the mutated cluster bit for bit.
+"""
+
+import numpy as np
+import jax
+
+from volcano_tpu.api import TaskStatus
+from volcano_tpu.arrays.pack import pack
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.framework.session import Session
+
+from fixtures import build_job, build_task, simple_cluster
+
+CONF = parse_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+""")
+
+
+def build_cluster(n_nodes=6, n_jobs=8, tasks_per_job=4):
+    ci = simple_cluster(n_nodes=n_nodes, node_cpu="8", node_mem="16Gi")
+    for j in range(n_jobs):
+        job = build_job(f"default/j{j}", min_available=2,
+                        priority=j % 3, creation_timestamp=float(j))
+        for t in range(tasks_per_job):
+            job.add_task(build_task(f"j{j}-t{t}", cpu="1", memory="1Gi",
+                                    priority=t % 2))
+        ci.add_job(job)
+    return ci
+
+
+def assert_snap_equal(got, want):
+    gl = jax.tree.leaves(got)
+    wl = jax.tree.leaves(want)
+    assert len(gl) == len(wl)
+    for g, w in zip(gl, wl):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+class TestRefreshSnapshot:
+    def test_apply_churn_matches_full_pack(self):
+        ci = build_cluster()
+        ssn = Session(ci, CONF)
+        ssn.run_allocate()      # binds mutate the cluster + record dirties
+        assert ssn.binds
+        ok = ssn.refresh_snapshot()
+        assert ok
+        want, _ = pack(ci)
+        assert_snap_equal(ssn.snap, want)
+
+    def test_status_churn_and_eviction(self):
+        ci = build_cluster()
+        ssn = Session(ci, CONF)
+        ssn.run_allocate()
+        # promote some bound tasks to Running, complete one job, evict one
+        uids = list(ci.jobs)
+        run_job = ci.jobs[uids[0]]
+        for task in run_job.tasks.values():
+            if task.status == TaskStatus.BINDING:
+                run_job.update_task_status(task, TaskStatus.RUNNING)
+        ssn.mark_dirty(job_uid=run_job.uid)
+        done_job = ci.jobs[uids[1]]
+        for task in done_job.tasks.values():
+            node = ci.nodes.get(task.node_name)
+            if node is not None and task.uid in node.tasks:
+                node.remove_task(task)
+                ssn.mark_dirty(node_name=node.name)
+            done_job.update_task_status(task, TaskStatus.SUCCEEDED)
+            task.node_name = ""
+        ssn.mark_dirty(job_uid=done_job.uid)
+        ssn.evict_task(next(iter(ci.jobs[uids[2]].tasks)))
+        ok = ssn.refresh_snapshot()
+        assert ok
+        want, _ = pack(ci)
+        assert_snap_equal(ssn.snap, want)
+
+    def test_reset_to_pending_round_trips(self):
+        """The steady-cycle churn shape: a bound gang resets to pending
+        (completed-and-replaced arrival) and the next cycle re-places it."""
+        ci = build_cluster()
+        ssn = Session(ci, CONF)
+        ssn.run_allocate()
+        uid = list(ci.jobs)[3]
+        job = ci.jobs[uid]
+        for task in list(job.tasks.values()):
+            node = ci.nodes.get(task.node_name)
+            if node is not None and task.uid in node.tasks:
+                node.remove_task(task)
+                ssn.mark_dirty(node_name=node.name)
+            job.update_task_status(task, TaskStatus.PENDING)
+            task.node_name = ""
+        job.allocated = type(job.allocated)({})
+        ssn.mark_dirty(job_uid=uid)
+        assert ssn.refresh_snapshot()
+        want, _ = pack(ci)
+        assert_snap_equal(ssn.snap, want)
+        # and the next cycle places the churned gang again
+        before = len(ssn.binds)
+        ssn.run_allocate()
+        placed_again = [b for b in ssn.binds[before:] if b.job_uid == uid]
+        assert len(placed_again) == len(job.tasks)
+
+    def test_queue_close_and_capacity_change(self):
+        """Queue open-state flips re-derive member jobs' schedulable; a
+        node allocatable change re-derives cluster_capacity (both feed
+        the kernel's ordering/eligibility directly)."""
+        from volcano_tpu.api import QueueState, Resource
+        ci = build_cluster()
+        ssn = Session(ci, CONF)
+        ssn.run_allocate()
+        ssn.refresh_snapshot()      # absorb the bind churn
+        ci.queues["default"].state = QueueState.CLOSED
+        node = ci.nodes["n0"]
+        node.allocatable = Resource.from_resource_list(
+            {"cpu": "16", "memory": "32Gi"})
+        node.capability = Resource.from_resource_list(
+            {"cpu": "16", "memory": "32Gi"})
+        ssn.mark_dirty(node_name="n0")
+        assert ssn.refresh_snapshot()
+        want, _ = pack(ci)
+        assert_snap_equal(ssn.snap, want)
+        assert not np.asarray(ssn.snap.jobs.schedulable).any()
+
+    def test_namespace_weight_change(self):
+        ci = build_cluster()
+        ssn = Session(ci, CONF)
+        ssn.run_allocate()
+        ssn.refresh_snapshot()
+        ci.namespaces["default"].weight = 7
+        assert ssn.refresh_snapshot()
+        want, _ = pack(ci)
+        assert_snap_equal(ssn.snap, want)
+
+    def test_entity_set_change_falls_back(self):
+        ci = build_cluster()
+        ssn = Session(ci, CONF)
+        ssn.run_allocate()
+        newjob = build_job("default/late", min_available=1)
+        newjob.add_task(build_task("late-t0", cpu="1", memory="1Gi"))
+        ci.add_job(newjob)
+        ssn.mark_dirty(job_uid="default/late")
+        ok = ssn.refresh_snapshot()
+        assert not ok                       # full repack path
+        want, maps = pack(ci)
+        assert_snap_equal(ssn.snap, want)
+        assert "default/late" in ssn.maps.job_index

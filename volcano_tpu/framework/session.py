@@ -113,6 +113,12 @@ class Session:
         self.phase_updates: Dict[str, object] = {}  # job uid -> new PG phase
         self.last_allocate: Optional[AllocateResult] = None
         self.stats: Dict[str, float] = {}
+        # dirty sets feeding refresh_snapshot (the event-handler analog of
+        # the reference's incrementally maintained cache,
+        # event_handlers.go): apply/evict record their touches; external
+        # mutators call mark_dirty
+        self._dirty_jobs: set = set()
+        self._dirty_nodes: set = set()
 
         self.repack()
         for p in self.plugins:
@@ -151,6 +157,16 @@ class Session:
         Q = np.asarray(self.snap.queues.weight).shape[0]
         J = np.asarray(self.snap.jobs.valid).shape[0]
         self.hierarchy = build_hierarchy(self.cluster, self.maps, Q, J)
+        # queue-known membership mask for refresh_snapshot's aggregate
+        # recompute (pack keeps unknown-queue jobs out of the sums)
+        qk = np.zeros(J, bool)
+        for ji, uid in enumerate(self.maps.job_uids):
+            job = self.cluster.jobs.get(uid)
+            qk[ji] = (job is not None
+                      and job.queue in self.maps.queue_index)
+        self._queue_known = qk
+        self._dirty_jobs = set()
+        self._dirty_nodes = set()
         self._scale_allocatables()
 
     def _scale_allocatables(self) -> None:
@@ -208,6 +224,225 @@ class Session:
             if p.name == name:
                 return p
         return None
+
+    # -------------------------------------------------- incremental refresh
+    def mark_dirty(self, job_uid: Optional[str] = None,
+                   node_name: Optional[str] = None) -> None:
+        """Record an out-of-session mutation for refresh_snapshot."""
+        if job_uid is not None:
+            self._dirty_jobs.add(job_uid)
+        if node_name is not None:
+            self._dirty_nodes.add(node_name)
+
+    def refresh_snapshot(self) -> bool:
+        """Patch the packed snapshot in place for the recorded dirty
+        entities instead of re-packing the whole cluster — the steady-state
+        cycle path (the reference maintains its cache incrementally through
+        informer event handlers, event_handlers.go:43-740, and only
+        deep-copies at Snapshot; here the patch IS the snapshot update).
+
+        Exact only for status/placement/accounting churn on an unchanged
+        entity set: same nodes, same jobs, same per-job task uids, and no
+        task spec changes (selector/toleration/affinity rows are immutable
+        per the job-update webhook, webhooks/jobs.py). Anything else —
+        including any inter-pod affinity terms, whose live counts depend on
+        placements — falls back to a full repack. Returns True when the
+        incremental patch was applied.
+        """
+        import numpy as np
+        dirty_jobs = self._dirty_jobs
+        dirty_nodes = self._dirty_nodes
+        self._dirty_jobs = set()
+        self._dirty_nodes = set()
+        maps = self.maps
+        scaled = any(c.name.lower() == "scaleallocatable"
+                     for c in self.conf.configurations)
+        if (self.affinity.has_terms
+                or scaled                       # node rows carry scaled
+                #                                 allocatable (session.go:448)
+                or len(self.cluster.jobs) != len(maps.job_uids)
+                or len(self.cluster.nodes) != len(maps.node_names)
+                or len(self.cluster.queues) != len(maps.queue_names)
+                or any(q not in maps.queue_index
+                       for q in self.cluster.queues)
+                or sorted(self.cluster.namespaces or {"default": None})
+                != maps.namespace_names
+                or any(u not in maps.job_index for u in dirty_jobs)
+                or any(n not in maps.node_index for n in dirty_nodes)):
+            self.repack()
+            return False
+        snap = self.snap
+        dims = maps.resource_names
+        tjob = np.asarray(snap.tasks.job)
+        tasks_a = snap.tasks
+        jobs_a = snap.jobs
+        nodes_arr = snap.nodes
+        M = jobs_a.task_table.shape[1]
+        from ..api import (PodGroupPhase, QueueState, TaskStatus,
+                           gpu_request_of, is_allocated_status)
+        from ..arrays.pack import queue_capability_row, queue_parent_depth
+
+        def vec(res):
+            q = res.quantities
+            return [q.get(d, 0.0) for d in dims]
+
+        # ---- queue + namespace static rows (Q/S are small: re-encode) ----
+        # covers queue open/closed flips, weight edits, hierarchy
+        # annotation changes, and namespace weight changes without
+        # per-entity dirty tracking
+        queues_a = snap.queues
+        q_changed = False
+        open_flipped = []
+        parents, depths = queue_parent_depth(self.cluster, maps.queue_names)
+        for qi, name in enumerate(maps.queue_names):
+            q = self.cluster.queues[name]
+            hw = q.hierarchy_weight_values()
+            row = (np.float32(max(q.weight, 0)),
+                   queue_capability_row(q, dims),
+                   bool(q.reclaimable),
+                   q.state == QueueState.OPEN,
+                   np.int32(parents[qi]), np.int32(depths[qi]),
+                   np.float32(hw[-1] if hw else 1.0))
+            old = (queues_a.weight[qi], queues_a.capability[qi],
+                   bool(queues_a.reclaimable[qi]), bool(queues_a.open[qi]),
+                   queues_a.parent[qi], queues_a.depth[qi],
+                   queues_a.hier_weight[qi])
+            if (old[0] != row[0] or not np.array_equal(old[1], row[1])
+                    or old[2] != row[2] or old[3] != row[3]
+                    or old[4] != row[4] or old[5] != row[5]
+                    or old[6] != row[6]):
+                q_changed = True
+                if old[3] != row[3]:
+                    open_flipped.append(qi)
+                queues_a.weight[qi] = row[0]
+                queues_a.capability[qi] = row[1]
+                queues_a.reclaimable[qi] = row[2]
+                queues_a.open[qi] = row[3]
+                queues_a.parent[qi] = row[4]
+                queues_a.depth[qi] = row[5]
+                queues_a.hier_weight[qi] = row[6]
+        for si, name in enumerate(maps.namespace_names):
+            ns = self.cluster.namespaces.get(name)
+            snap.namespace_weight[si] = max(ns.weight if ns else 1, 1)
+        if q_changed:
+            # the hdrf tree rides the queue annotations
+            from ..arrays.hierarchy import build_hierarchy
+            Q = np.asarray(queues_a.weight).shape[0]
+            J = np.asarray(jobs_a.valid).shape[0]
+            self.hierarchy = build_hierarchy(self.cluster, maps, Q, J)
+        ns_index = {n: i for i, n in enumerate(maps.namespace_names)}
+        if open_flipped:
+            # member jobs' schedulable depends on queue_open (pack j_sched):
+            # re-encode them like dirty jobs
+            jq = np.asarray(jobs_a.queue)
+            jvalid = np.asarray(jobs_a.valid)
+            for qi in open_flipped:
+                for ji in np.flatnonzero((jq == qi) & jvalid):
+                    dirty_jobs.add(maps.job_uids[int(ji)])
+
+        # ---- dirty task/job rows -----------------------------------------
+        for uid in dirty_jobs:
+            ji = maps.job_index[uid]
+            job = self.cluster.jobs.get(uid)
+            if job is None:
+                self.repack()
+                return False
+            tis = np.flatnonzero(tjob == ji)
+            if ([maps.task_uids[ti] for ti in tis]
+                    != list(job.tasks.keys())):
+                self.repack()       # task set changed: full rebuild
+                return False
+            pending: list = []
+            req_sum = np.zeros(len(dims), np.float32)
+            for ti, task in zip(tis.tolist(), job.tasks.values()):
+                tasks_a.resreq[ti] = vec(task.resreq)
+                tasks_a.status[ti] = int(task.status)
+                tasks_a.priority[ti] = task.priority
+                tasks_a.node[ti] = maps.node_index.get(task.node_name, -1)
+                tasks_a.best_effort[ti] = task.best_effort
+                tasks_a.gpu_request[ti] = gpu_request_of(task.resreq)
+                tasks_a.preemptable[ti] = task.preemptable
+                if task.status == TaskStatus.PENDING:
+                    pending.append(ti)
+                if (task.status == TaskStatus.PENDING
+                        or is_allocated_status(TaskStatus(task.status))):
+                    req_sum += np.asarray(tasks_a.resreq[ti])
+            pending.sort(key=lambda ti: (-int(tasks_a.priority[ti]), ti))
+            if len(pending) > M:
+                self.repack()       # pending row outgrew the M bucket
+                return False
+            ready_num = job.ready_task_num()
+            jobs_a.min_available[ji] = job.min_available
+            jobs_a.queue[ji] = maps.queue_index.get(job.queue, 0)
+            self._queue_known[ji] = job.queue in maps.queue_index
+            jobs_a.namespace[ji] = ns_index.get(job.namespace, 0)
+            jobs_a.priority[ji] = job.priority
+            jobs_a.ready_num[ji] = ready_num
+            jobs_a.allocated[ji] = vec(job.allocated)
+            jobs_a.total_request[ji] = req_sum
+            jobs_a.min_resources[ji] = vec(job.min_resources)
+            jobs_a.task_table[ji] = -1
+            jobs_a.task_table[ji, :len(pending)] = pending
+            jobs_a.n_pending[ji] = len(pending)
+            gang_valid, _ = job.is_valid()
+            qi = maps.queue_index.get(job.queue)
+            queue_open = qi is not None and bool(snap.queues.open[qi])
+            pending_phase = job.pod_group_phase == PodGroupPhase.PENDING
+            jobs_a.pending_phase[ji] = pending_phase
+            jobs_a.inqueue[ji] = not pending_phase
+            jobs_a.schedulable[ji] = (gang_valid and queue_open
+                                      and not pending_phase)
+            jobs_a.preemptable[ji] = job.preemptable
+
+        # ---- dirty node rows ---------------------------------------------
+        for name in dirty_nodes:
+            ni = maps.node_index[name]
+            node = self.cluster.nodes.get(name)
+            if node is None:
+                self.repack()
+                return False
+            nodes_arr.idle[ni] = vec(node.idle)
+            nodes_arr.used[ni] = vec(node.used)
+            nodes_arr.releasing[ni] = vec(node.releasing)
+            nodes_arr.pipelined[ni] = vec(node.pipelined)
+            nodes_arr.allocatable[ni] = vec(node.allocatable)
+            nodes_arr.capability[ni] = vec(node.capability)
+            nodes_arr.pod_count[ni] = node.pod_count()
+            nodes_arr.max_pods[ni] = node.max_pods
+            nodes_arr.schedulable[ni] = (node.ready
+                                         and not node.unschedulable)
+            if node.gpu_devices:
+                nodes_arr.gpu_memory[ni] = 0.0
+                nodes_arr.gpu_used[ni] = 0.0
+                G = nodes_arr.gpu_memory.shape[1]
+                for dev in node.gpu_devices[:G]:
+                    nodes_arr.gpu_memory[ni, dev.id] = dev.memory
+                    nodes_arr.gpu_used[ni, dev.id] = dev.used_memory()
+
+        # ---- cluster capacity (pack.py cluster_capacity formula) ---------
+        if dirty_nodes:
+            nn = len(maps.node_names)
+            snap.cluster_capacity[:] = (
+                nodes_arr.allocatable[:nn].sum(axis=0) if nn
+                else np.zeros(len(dims), np.float32))
+
+        # ---- queue aggregates (proportion.OnSessionOpen sums) ------------
+        if dirty_jobs or q_changed:
+            jq = np.asarray(jobs_a.queue)
+            # pack excludes valid jobs whose queue is unknown from the
+            # aggregates (their j_queue defaults to 0); mirror via the
+            # queue-known mask recorded at repack
+            member = np.asarray(jobs_a.valid) & self._queue_known
+            alloc = np.where(member[:, None], jobs_a.allocated, 0.0)
+            req = np.where(member[:, None], jobs_a.total_request, 0.0)
+            inq = np.where((member & np.asarray(jobs_a.inqueue))[:, None],
+                           jobs_a.min_resources, 0.0)
+            for arr, src in ((snap.queues.allocated, alloc),
+                             (snap.queues.request, req),
+                             (snap.queues.inqueue_minres, inq)):
+                arr[:] = 0.0
+                np.add.at(arr, jq, src)
+        return True
 
     # ------------------------------------------------- kernel composition
     def allocate_config(self) -> AllocateConfig:
@@ -628,8 +863,10 @@ class Session:
             node.remove_task(task)
             job.update_task_status(task, TaskStatus.RELEASING)
             node.add_task(task)
+            self._dirty_nodes.add(node.name)
         else:
             job.update_task_status(task, TaskStatus.RELEASING)
+        self._dirty_jobs.add(job.uid)
         self.evictions.append(EvictIntent(task_uid, job.uid, reason))
 
     # -------------------------------------------------------- apply/readout
@@ -671,7 +908,10 @@ class Session:
                 job.update_task_status(task, TaskStatus.PENDING)
                 task.gpu_index = -1
                 self.bind_errors.append((task_uid, node_name, str(e)))
+                self._dirty_jobs.add(job.uid)
                 return
+            self._dirty_nodes.add(node_name)
+        self._dirty_jobs.add(job.uid)
         self.binds.append(BindIntent(task_uid, job.uid, node_name, gpu_index))
 
     def _bulk_bind(self, bind_idx, task_node, task_gpu) -> None:
@@ -783,6 +1023,7 @@ class Session:
                               if node_sum[ni, k] > 0})
             node.used.add(delta)
             node.idle.sub_floored(delta)
+            self._dirty_nodes.add(node.name)
         job_uids = self.maps.job_uids
         for ji in touched_jobs:
             job = self.cluster.jobs.get(job_uids[int(ji)])
@@ -791,6 +1032,7 @@ class Session:
             job.allocated.add(Resource({d: float(job_sum[ji, k])
                                         for k, d in enumerate(dims)
                                         if job_sum[ji, k] > 0}))
+            self._dirty_jobs.add(job.uid)
 
     def apply_allocate(self, result: AllocateResult, host=None) -> None:
         if host is not None:
