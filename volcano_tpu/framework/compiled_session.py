@@ -43,10 +43,13 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
                    taint_prefer_weight=0.0)
     any_scorer = False
     has_gang = False
+    has_proportion = False
     drf_opt = None
     for opt in _plugin_options(sc):
         if opt.name == "gang":
             has_gang = True
+        if opt.name == "proportion":
+            has_proportion = True
         if opt.name == "drf":
             drf_opt = opt
         plugin = build_plugin(opt)
@@ -57,11 +60,21 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
                 weights[k] = weights.get(k, 0.0) + v
     if not any_scorer:
         weights.update(least_allocated_weight=1.0, balanced_weight=1.0)
+    enable_hdrf = drf_opt is not None and drf_opt.enabled_hierarchy
+    drf_job_order = drf_opt is not None and drf_opt.enabled_job_order
+    drf_ns_order = drf_opt is not None and drf_opt.enabled_namespace_order
+    # K-job batched rounds are provably exact from the conf alone: no
+    # proportion plugin means deserved stays neutral (infinite) for the
+    # whole cycle, and without drf dynamic ordering every job-order key is
+    # static over commits (see AllocateConfig.batch_jobs)
+    batchable = not (has_proportion or enable_hdrf or drf_job_order
+                     or drf_ns_order)
     return AllocateConfig(
         enable_gang=has_gang,
-        enable_hdrf=drf_opt is not None and drf_opt.enabled_hierarchy,
-        drf_job_order=drf_opt is not None and drf_opt.enabled_job_order,
-        drf_ns_order=drf_opt is not None and drf_opt.enabled_namespace_order,
+        enable_hdrf=enable_hdrf,
+        drf_job_order=drf_job_order,
+        drf_ns_order=drf_ns_order,
+        batch_jobs=8 if batchable else 1,
         **weights)
 
 
