@@ -162,7 +162,7 @@ def _run(force_cpu: bool):
                       # batched rounds are exact here: no drf/hdrf ordering
                       # and neutral (infinite) proportion deserved; the
                       # snapshot carries no GPU requests
-                      batch_jobs=8, enable_gpu=False)
+                      batch_jobs=8, enable_gpu=False)  # = DEFAULT_BATCH_JOBS
 
     import jax
     if force_cpu:

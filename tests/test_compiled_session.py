@@ -58,6 +58,39 @@ class TestCompiledSession:
                 compiled_binds[uid] = maps.node_names[task_node[ti]]
         assert compiled_binds == session_binds
 
+    def test_conf_proven_batching_matches_sequential(self):
+        """A batchable conf (no proportion, no drf dynamics) derives
+        batch_jobs=8; its decisions must equal the sequential K=1 cycle
+        on a contended snapshot."""
+        import dataclasses
+        batchable_conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+"""
+        cfg = allocate_config_from_conf(parse_conf(batchable_conf))
+        assert cfg.batch_jobs == 8
+        ci = contended_cluster()
+        snap, maps = pack(ci)
+        from volcano_tpu.ops.allocate_scan import (AllocateExtras,
+                                                   make_allocate_cycle)
+        extras = AllocateExtras.neutral(snap)
+        batched = jax.jit(make_allocate_cycle(dataclasses.replace(
+            cfg, use_pallas="interpret")))(snap, extras)
+        seq = jax.jit(make_allocate_cycle(dataclasses.replace(
+            cfg, use_pallas=False, batch_jobs=1)))(snap, extras)
+        np.testing.assert_array_equal(np.asarray(batched.task_node),
+                                      np.asarray(seq.task_node))
+        np.testing.assert_array_equal(np.asarray(batched.task_mode),
+                                      np.asarray(seq.task_mode))
+        np.testing.assert_array_equal(np.asarray(batched.job_ready),
+                                      np.asarray(seq.job_ready))
+        # a conf with proportion must stay sequential
+        assert allocate_config_from_conf(parse_conf(DEFAULT_CONF)
+                                         ).batch_jobs == 1
+
     def test_hdrf_conf_compiles(self):
         conf = open("conf/volcano-scheduler-dap.conf").read()
         ci = contended_cluster()

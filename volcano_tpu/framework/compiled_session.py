@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from ..arrays.schema import SnapshotArrays
-from ..ops.allocate_scan import (AllocateConfig, AllocateExtras,
+from ..ops.allocate_scan import (DEFAULT_BATCH_JOBS,
+                                 AllocateConfig, AllocateExtras,
                                  make_allocate_cycle)
 from ..ops.fairshare import proportion_deserved
 from .conf import SchedulerConfiguration, parse_conf
@@ -74,7 +75,7 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
         enable_hdrf=enable_hdrf,
         drf_job_order=drf_job_order,
         drf_ns_order=drf_ns_order,
-        batch_jobs=8 if batchable else 1,
+        batch_jobs=DEFAULT_BATCH_JOBS if batchable else 1,
         **weights)
 
 

@@ -718,7 +718,8 @@ class Session:
                 and not (cfg.drf_job_order or cfg.drf_ns_order
                          or cfg.enable_hdrf)
                 and not np.any(np.isfinite(deserved))):
-            cfg = dataclasses.replace(cfg, batch_jobs=8)
+            from ..ops.allocate_scan import DEFAULT_BATCH_JOBS
+            cfg = dataclasses.replace(cfg, batch_jobs=DEFAULT_BATCH_JOBS)
         # GPU-free snapshots skip the per-card kernel state
         # (decision-neutral: zero requests never charge a card)
         if not np.any(np.asarray(self.snap.tasks.gpu_request) > 0):

@@ -57,6 +57,11 @@ MODE_NONE = 0
 MODE_ALLOCATED = 1   # bind now (fits idle)
 MODE_PIPELINED = 2   # placed on releasing capacity, no bind yet
 
+#: jobs per fused round when the static-keys batching precondition holds
+#: (see AllocateConfig.batch_jobs) — the single source for the session's
+#: runtime upgrade, the compiled-session conf derivation, and bench
+DEFAULT_BATCH_JOBS = 8
+
 
 @dataclass(frozen=True)
 class AllocateConfig:
