@@ -157,12 +157,13 @@ def _run(force_cpu: bool):
         n_jobs = int(os.environ.get("BENCH_JOBS", 6250))
     tasks_per_job = int(os.environ.get("BENCH_TASKS_PER_JOB", 16))
     reps = int(os.environ.get("BENCH_REPS", 3))
+    from volcano_tpu.ops.allocate_scan import DEFAULT_BATCH_JOBS
     cfg_kwargs = dict(binpack_weight=1.0, least_allocated_weight=0.0,
                       balanced_weight=0.0, taint_prefer_weight=0.0,
                       # batched rounds are exact here: no drf/hdrf ordering
                       # and neutral (infinite) proportion deserved; the
                       # snapshot carries no GPU requests
-                      batch_jobs=8, enable_gpu=False)  # = DEFAULT_BATCH_JOBS
+                      batch_jobs=DEFAULT_BATCH_JOBS, enable_gpu=False)
 
     import jax
     if force_cpu:
@@ -231,6 +232,7 @@ def _run(force_cpu: bool):
     # host-side bind readout through the real Session object path.
     full_session_ms = None
     steady_ms = steady_binds = None
+    loop_incremental = None
     if not os.environ.get("BENCH_SKIP_SESSION"):
         from __graft_entry__ import _synthetic_cluster
         from volcano_tpu.framework import parse_conf
@@ -257,36 +259,46 @@ tiers:
         full_session_ms = (time.time() - t0) * 1000
         session_binds = len(ssn.binds)
 
-        # ---- steady-state cycle: incremental refresh + re-place churn ----
-        # The recurring cycle a real scheduler pays every schedule period:
-        # most of the cluster is unchanged, ~5% of gangs completed and were
-        # replaced by new arrivals. refresh_snapshot patches only the dirty
-        # entities (the event-handler analog); the kernel re-places only
-        # the churned tasks.
+        # ---- steady-state SCHEDULER LOOP cycle (the production path) ----
+        # The recurring cycle a long-running scheduler pays every schedule
+        # period, measured through Scheduler.run_once itself: most of the
+        # cluster is unchanged, ~5% of gangs completed and were replaced by
+        # new arrivals. run_once holds ONE session over the cluster's live
+        # view and re-opens it via refresh_snapshot from the cluster's
+        # dirty marks (the event-handler analog); the kernel re-places only
+        # the churned tasks; the timed region includes intent dispatch back
+        # into the cluster — everything a real cycle pays.
         from volcano_tpu.api import TaskStatus as _TS
-        # absorb the cold cycle's dirt (every node just received binds)
-        # OUTSIDE the timed region: the steady state being measured is a
-        # long-running scheduler whose snapshot is already current
-        ssn.refresh_snapshot()
-        churn_uids = list(ssn.cluster.jobs)[::20]          # ~5%
-        for uid in churn_uids:
-            job = ssn.cluster.jobs[uid]
-            for task in list(job.tasks.values()):
-                node = ssn.cluster.nodes.get(task.node_name)
-                if node is not None and task.uid in node.tasks:
-                    node.remove_task(task)
-                    ssn.mark_dirty(node_name=node.name)
-                job.update_task_status(task, _TS.PENDING)
-                task.node_name = ""
-            job.allocated = type(job.allocated)({})
-            ssn.mark_dirty(job_uid=uid)
+        from volcano_tpu.runtime.fake_cluster import FakeCluster
+        from volcano_tpu.runtime.scheduler import Scheduler
+        ci = _synthetic_cluster(n_nodes=n_nodes, n_jobs=n_jobs,
+                                tasks_per_job=tasks_per_job)
+        cluster = FakeCluster(ci)
+        sched = Scheduler(cluster, conf=sess_conf)
+        sched.run_once()        # cold cycle: full pack + full placement
+
+        def loop_churn():
+            for uid in list(cluster.ci.jobs)[::20]:        # ~5%
+                job = cluster.ci.jobs[uid]
+                for task in list(job.tasks.values()):
+                    node = cluster.ci.nodes.get(task.node_name)
+                    if node is not None and task.uid in node.tasks:
+                        node.remove_task(task)
+                        cluster.mark_dirty(node_name=node.name)
+                    job.update_task_status(task, _TS.PENDING)
+                    task.node_name = ""
+                job.allocated = type(job.allocated)({})
+                cluster.mark_dirty(job_uid=uid)
+
+        loop_churn()
+        sched.run_once()        # warm: absorbs any residual compile
+        loop_churn()
         t0 = time.time()
-        ssn.refresh_snapshot()
-        before = len(ssn.binds)
-        ssn.run_allocate()
-        ssn.close()
+        loop_ssn = sched.run_once()
         steady_ms = (time.time() - t0) * 1000
-        steady_binds = len(ssn.binds) - before
+        steady_binds = len(loop_ssn.binds)
+        loop_incremental = sched.incremental_cycles >= 2 \
+            and sched.full_packs == 1
 
     # ---- sidecar serving cycle (SURVEY section 5.8 production path) ------
     # The API-layer process ships a VCS3 wire snapshot; the sidecar packs it
@@ -353,78 +365,122 @@ tiers:
     # ---- gang + preempt at scale (BASELINE.json config 4) ----------------
     # 10k nodes ~75% full of Running preemptable low-priority tasks plus
     # starving high-priority gangs; the preempt kernel picks victims via
-    # the tiered dispatch and pipelines the preemptors.
+    # the tiered dispatch and pipelines the preemptors. Verified against
+    # the sequential CPU oracle (runtime/cpu_reference.preempt_cpu):
+    # live at a subscale config EVERY run, at full config-4 scale once
+    # with the fingerprint guard (BENCH_LIVE_PREEMPT_CPU=1 re-records).
     preempt_ms = preempt_victims = preempt_pipelined = None
     preempt_invariants_ok = None
+    preempt_equal_sub = preempt_equal_full = None
+    preempt_sha = None
+    preempt_adv_ms = preempt_adv_victims = preempt_adv_pipelined = None
+    preempt_adv_equal = None
     if not (force_cpu or os.environ.get("BENCH_SKIP_PREEMPT")):
         from __graft_entry__ import _synthetic_cluster as _synth
         from volcano_tpu.api import (JobInfo, PodGroupPhase, Resource,
                                      TaskInfo, TaskStatus)
         from volcano_tpu.ops.preempt import PreemptConfig, make_preempt_cycle
         from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
-        pci = _synth(n_nodes=int(os.environ.get("BENCH_PRE_NODES", 10000)),
-                     n_jobs=int(os.environ.get("BENCH_PRE_JOBS", 6000)),
-                     tasks_per_job=16)
-        pnodes = list(pci.nodes)
-        k = 0
-        for job in pci.jobs.values():
-            job.preemptable = True
-            job.pod_group_phase = PodGroupPhase.RUNNING
-            for t in job.tasks.values():
-                nn = pnodes[k % len(pnodes)]
-                k += 1
-                t.status = TaskStatus.RUNNING
-                t.node_name = nn
-                pci.nodes[nn].add_task(t)
-        n_gangs = int(os.environ.get("BENCH_PRE_GANGS", 64))
-        for j in range(n_gangs):
-            job = JobInfo(f"default/hp-{j:05d}", queue="default",
-                          min_available=8, priority=100,
-                          creation_timestamp=float(j),
-                          pod_group_phase=PodGroupPhase.INQUEUE)
-            for t in range(16):
-                job.add_task(TaskInfo(
-                    uid=f"default/hp-{j:05d}-{t}", name=f"hp-{j:05d}-{t}",
-                    resreq=Resource.from_resource_list(
-                        {"cpu": "1500m", "memory": "1Gi"})))
-            pci.add_job(job)
+        from volcano_tpu.runtime.cpu_reference import preempt_cpu
+        from volcano_tpu.ops.allocate_scan import MODE_PIPELINED as _MP
         from volcano_tpu import native as _nat2
-        psnap, _pm = _nat2.pack_best_effort(pci)
-        pextras = AllocateExtras.neutral(psnap)
+
+        def _preempt_scenario(n_nodes, n_jobs, n_gangs, gang_tasks=16,
+                              min_avail=8):
+            pci = _synth(n_nodes=n_nodes, n_jobs=n_jobs, tasks_per_job=16)
+            pnodes = list(pci.nodes)
+            k = 0
+            for job in pci.jobs.values():
+                job.preemptable = True
+                job.pod_group_phase = PodGroupPhase.RUNNING
+                for t in job.tasks.values():
+                    nn = pnodes[k % len(pnodes)]
+                    k += 1
+                    t.status = TaskStatus.RUNNING
+                    t.node_name = nn
+                    pci.nodes[nn].add_task(t)
+            for j in range(n_gangs):
+                job = JobInfo(f"default/hp-{j:05d}", queue="default",
+                              min_available=min_avail, priority=100,
+                              creation_timestamp=float(j),
+                              pod_group_phase=PodGroupPhase.INQUEUE)
+                for t in range(gang_tasks):
+                    job.add_task(TaskInfo(
+                        uid=f"default/hp-{j:05d}-{t}",
+                        name=f"hp-{j:05d}-{t}",
+                        resreq=Resource.from_resource_list(
+                            {"cpu": "1500m", "memory": "1Gi"})))
+                pci.add_job(job)
+            return pci
+
         pcfg = PreemptConfig(scoring=_AC(
             binpack_weight=1.0, least_allocated_weight=0.0,
             balanced_weight=0.0, taint_prefer_weight=0.0, enable_gpu=False))
-        pT = psnap.tasks.status.shape[0]
-        pveto = np.zeros(pT, bool)
-        pskip = np.zeros(pT, bool)
-        from volcano_tpu.ops.allocate_scan import MODE_PIPELINED as _MP
         pfn = jax.jit(make_preempt_cycle(pcfg))
-        pres = pfn(psnap, pextras, pveto, pskip)       # compile + warm
-        np.asarray(pres.evicted)
-        ptimes = []
-        for _ in range(min(reps, 2)):
-            t0 = time.time()
-            pres = pfn(psnap, pextras, pveto, pskip)
-            pev = np.asarray(pres.evicted)
-            ptm = np.asarray(pres.task_mode)
-            ptimes.append(time.time() - t0)
-        preempt_ms = min(ptimes) * 1000
+
+        def _run_preempt(pci, reps_n):
+            psnap, _pm = _nat2.pack_best_effort(pci)
+            pextras = AllocateExtras.neutral(psnap)
+            pT = psnap.tasks.status.shape[0]
+            pveto = np.zeros(pT, bool)
+            pskip = np.zeros(pT, bool)
+            pres = pfn(psnap, pextras, pveto, pskip)   # compile + warm
+            np.asarray(pres.evicted)
+            times = []
+            for _ in range(reps_n):
+                t0 = time.time()
+                pres = pfn(psnap, pextras, pveto, pskip)
+                pev = np.asarray(pres.evicted)
+                ptm = np.asarray(pres.task_mode)
+                times.append(time.time() - t0)
+            return psnap, pextras, pveto, pskip, pres, pev, ptm, \
+                min(times) * 1000
+
+        # subscale oracle equality, every run
+        sci = _preempt_scenario(1000, 600, 8)
+        ssnap, sextras, sveto, sskip, sres, _sev, _stm, _sms = \
+            _run_preempt(sci, 1)
+        scpu = preempt_cpu(ssnap, sextras, sveto, sskip, pcfg)
+        preempt_equal_sub = bool(
+            np.array_equal(np.asarray(sres.evicted), scpu["evicted"])
+            and np.array_equal(np.asarray(sres.task_node),
+                               scpu["task_node"])
+            and np.array_equal(np.asarray(sres.task_mode),
+                               scpu["task_mode"]))
+
+        # config 4 at full scale
+        pci = _preempt_scenario(
+            int(os.environ.get("BENCH_PRE_NODES", 10000)),
+            int(os.environ.get("BENCH_PRE_JOBS", 6000)),
+            int(os.environ.get("BENCH_PRE_GANGS", 64)))
+        psnap, pextras, pveto, pskip, pres, pev, ptm, preempt_ms = \
+            _run_preempt(pci, min(reps, 2))
         preempt_victims = int(pev.sum())
         preempt_pipelined = int((ptm == _MP).sum())
-        # invariants (no CPU oracle exists for preempt — assert the
-        # semantics the tiered dispatch guarantees): victims only from
+        import hashlib as _hl
+        preempt_sha = _hl.sha256(
+            np.asarray(pres.task_node).tobytes()
+            + np.asarray(pres.task_mode).tobytes()
+            + pev.tobytes()).hexdigest()[:16]
+        rec_psha = (recorded or {}).get("preempt_sha256")
+        if os.environ.get("BENCH_LIVE_PREEMPT_CPU"):
+            pcpu = preempt_cpu(psnap, pextras, pveto, pskip, pcfg)
+            preempt_equal_full = bool(
+                np.array_equal(pev, pcpu["evicted"])
+                and np.array_equal(np.asarray(pres.task_node),
+                                   pcpu["task_node"])
+                and np.array_equal(np.asarray(pres.task_mode),
+                                   pcpu["task_mode"]))
+        elif rec_psha is not None:
+            preempt_equal_full = True if rec_psha == preempt_sha else None
+
+        # invariants (cross-checking the oracle): victims only from
         # lower-priority jobs; every pipelined-flag gang reached
         # minAvailable with its pipelined tasks
         ptjob = np.asarray(psnap.tasks.job)
         pprio = np.asarray(psnap.jobs.priority)
         pjp = np.asarray(pres.job_pipelined)
         pminav = np.asarray(psnap.jobs.min_available)
-        # padding tasks carry job == -1: any such victim/pipeline is
-        # itself an invariant violation, never clamped away. The gang
-        # check uses n_pipe alone because every hp gang here starts with
-        # ready_num == 0 and no pipelined waiters (the kernel's actual
-        # guarantee is ready_num + waiting + n_pipe >= minAvailable,
-        # preempt.py); revisit if the scenario gains pre-placed tasks.
         pipe_jobs = ptjob[ptm == _MP]
         pipe_per_job = np.bincount(np.maximum(pipe_jobs, 0),
                                    minlength=pprio.shape[0])
@@ -432,6 +488,34 @@ tiers:
             (ptjob[pev] >= 0).all() and (pipe_jobs >= 0).all()
             and (pprio[ptjob[pev]] < 100).all()
             and (pipe_per_job[pjp] >= pminav[pjp]).all())
+
+        # adversarial scale (VERDICT r4 #2): >=300 starving gangs, ~28k
+        # pending preemptor tasks over the same 10k-node cluster
+        if not os.environ.get("BENCH_SKIP_PREEMPT_ADV"):
+            aci = _preempt_scenario(10000, 6000, 312, gang_tasks=90,
+                                    min_avail=90)
+            (_a1, _a2, _a3, _a4, ares, aev, atm,
+             preempt_adv_ms) = _run_preempt(aci, 1)
+            preempt_adv_victims = int(aev.sum())
+            preempt_adv_pipelined = int((atm == _MP).sum())
+            # full-scale equality record (PREEMPT_ADV_RECORD.json, written
+            # by scripts/preempt_adv_oracle.py: CPU oracle 1001.8s vs TPU
+            # 7.8s, decisions bit-identical) — fingerprint-guarded
+            arec_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "PREEMPT_ADV_RECORD.json")
+            if os.path.exists(arec_path):
+                with open(arec_path) as f:
+                    arec = json.load(f)
+                asha = _hl.sha256(
+                    np.asarray(ares.task_node).tobytes() + atm.tobytes()
+                    + aev.tobytes()).hexdigest()[:16]
+                preempt_adv_equal = (
+                    True if (arec.get("decisions_equal")
+                             and arec.get("preempt_adv_sha256") == asha)
+                    else None)
+            else:
+                preempt_adv_equal = None
 
     # ---- topology-aware binpack with affinity (BASELINE.json config 5) ---
     # 10k nodes with zone/rack labels, required + preferred inter-pod
@@ -512,9 +596,10 @@ tiers:
                           if full_session_ms is not None else None),
         "sidecar_cycle_ms": (round(sidecar_ms, 1)
                              if sidecar_ms is not None else None),
-        "steady_session_ms": (round(steady_ms, 1)
-                              if steady_ms is not None else None),
-        "steady_binds": steady_binds,
+        "steady_loop_ms": (round(steady_ms, 1)
+                           if steady_ms is not None else None),
+        "steady_loop_binds": steady_binds,
+        "steady_loop_incremental": loop_incremental,
         "drf_cycle_ms": (round(drf_ms, 1) if drf_ms is not None else None),
         "drf_placed": drf_placed,
         "drf_decisions_equal_cpu_subscale": drf_equal_sub,
@@ -523,6 +608,14 @@ tiers:
         "preempt_victims": preempt_victims,
         "preempt_pipelined": preempt_pipelined,
         "preempt_invariants_ok": preempt_invariants_ok,
+        "preempt_decisions_equal_cpu_subscale": preempt_equal_sub,
+        "preempt_decisions_equal_cpu_full_scale": preempt_equal_full,
+        "preempt_sha256": preempt_sha,
+        "preempt_adversarial_ms": (round(preempt_adv_ms, 1)
+                                   if preempt_adv_ms is not None else None),
+        "preempt_adversarial_victims": preempt_adv_victims,
+        "preempt_adversarial_pipelined": preempt_adv_pipelined,
+        "preempt_adversarial_equal_cpu_full_scale": preempt_adv_equal,
         "affinity_cycle_ms": (round(affinity_ms, 1)
                               if affinity_ms is not None else None),
         "affinity_placed": affinity_placed,

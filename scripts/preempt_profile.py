@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Dev profile: preempt kernel at BASELINE config-4 scale and adversarial
+(~300 starving gangs) scale on the live chip. Not part of bench.py's
+record; used to steer the round-5 preempt optimization (VERDICT r4 #2)."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _synthetic_cluster as _synth  # noqa: E402
+from volcano_tpu.api import (JobInfo, PodGroupPhase, Resource,  # noqa: E402
+                             TaskInfo, TaskStatus)
+from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC  # noqa: E402
+from volcano_tpu.ops.preempt import PreemptConfig, make_preempt_cycle  # noqa
+
+
+def scenario(n_nodes=10000, n_jobs=6000, n_gangs=64, gang_tasks=16,
+             min_avail=8):
+    pci = _synth(n_nodes=n_nodes, n_jobs=n_jobs, tasks_per_job=16)
+    pnodes = list(pci.nodes)
+    k = 0
+    for job in pci.jobs.values():
+        job.preemptable = True
+        job.pod_group_phase = PodGroupPhase.RUNNING
+        for t in job.tasks.values():
+            nn = pnodes[k % len(pnodes)]
+            k += 1
+            t.status = TaskStatus.RUNNING
+            t.node_name = nn
+            pci.nodes[nn].add_task(t)
+    for j in range(n_gangs):
+        job = JobInfo(f"default/hp-{j:05d}", queue="default",
+                      min_available=min_avail, priority=100,
+                      creation_timestamp=float(j),
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        for t in range(gang_tasks):
+            job.add_task(TaskInfo(
+                uid=f"default/hp-{j:05d}-{t}", name=f"hp-{j:05d}-{t}",
+                resreq=Resource.from_resource_list(
+                    {"cpu": "1500m", "memory": "1Gi"})))
+        pci.add_job(job)
+    return pci
+
+
+def run(tag, pci, reps=2):
+    import jax
+    from volcano_tpu import native as _nat
+    from volcano_tpu.ops.allocate_scan import (MODE_PIPELINED,
+                                               AllocateExtras)
+    t0 = time.time()
+    psnap, _pm = _nat.pack_best_effort(pci)
+    pextras = AllocateExtras.neutral(psnap)
+    pack_s = time.time() - t0
+    pcfg = PreemptConfig(scoring=_AC(
+        binpack_weight=1.0, least_allocated_weight=0.0,
+        balanced_weight=0.0, taint_prefer_weight=0.0, enable_gpu=False))
+    pT = psnap.tasks.status.shape[0]
+    pveto = np.zeros(pT, bool)
+    pskip = np.zeros(pT, bool)
+    pfn = jax.jit(make_preempt_cycle(pcfg))
+    t0 = time.time()
+    pres = pfn(psnap, pextras, pveto, pskip)
+    np.asarray(pres.evicted)
+    compile_s = time.time() - t0
+    times = []
+    for _ in range(reps):
+        t0 = time.time()
+        pres = pfn(psnap, pextras, pveto, pskip)
+        ev = np.asarray(pres.evicted)
+        tm = np.asarray(pres.task_mode)
+        times.append(time.time() - t0)
+    print(f"{tag}: pack={pack_s:.1f}s compile={compile_s:.1f}s "
+          f"cycle={min(times)*1000:.0f}ms victims={int(ev.sum())} "
+          f"pipelined={int((tm == MODE_PIPELINED).sum())}", flush=True)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("config4", "both"):
+        run("config4 (64 gangs x16, minav 8)", scenario())
+    if which in ("adv", "both"):
+        # adversarial: 312 starving gangs, 90 pending tasks each (~28k
+        # pending), minAvailable 90 — most gangs cannot be served
+        run("adversarial (312 gangs x90, minav 90)",
+            scenario(n_gangs=312, gang_tasks=90, min_avail=90))

@@ -1,0 +1,179 @@
+"""SchedulerCache's incremental mirror vs its full projection.
+
+The mirror (live_view + watch handlers) is the event_handlers.go analog:
+after ANY sequence of store events it must describe the same world as a
+from-scratch snapshot() projection — compared here at the packed-array
+level, which is what the kernels actually consume. Also drives the full
+scheduler loop over the cache and checks decisions match a fresh-snapshot
+scheduler cycle for cycle.
+"""
+
+import jax
+import numpy as np
+
+from volcano_tpu.api.core import (POD_GROUP_ANNOTATION, Pod, PodGroup,
+                                  PodPhase)
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.queue_info import QueueInfo
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.arrays.pack import pack
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.framework.session import BindIntent, EvictIntent
+from volcano_tpu.runtime.apiserver import APIServer
+from volcano_tpu.runtime.cache import SchedulerCache
+
+CONF = parse_conf("""
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: binpack
+""")
+
+
+def make_node(name, cpu="8", mem="16Gi"):
+    return NodeInfo(name, allocatable=Resource.from_resource_list(
+        {"cpu": cpu, "memory": mem}))
+
+
+def make_pod(name, group, cpu="1", mem="1Gi", phase=PodPhase.PENDING,
+             node=""):
+    p = Pod(name=name, annotations={POD_GROUP_ANNOTATION: group},
+            resources={"cpu": cpu, "memory": mem}, creation_timestamp=1.0)
+    p.phase = phase
+    p.node_name = node
+    return p
+
+
+def assert_mirror_matches(cache):
+    got, _ = pack(cache.live_view())
+    want, _ = pack(cache.snapshot())
+    for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+def seed(api):
+    for i in range(4):
+        api.create("nodes", make_node(f"n{i}"))
+    api.create("queues", QueueInfo("q1", weight=2))
+    for g in range(3):
+        api.create("podgroups", PodGroup(
+            name=f"g{g}", min_member=2, queue="q1" if g % 2 else "",
+            creation_timestamp=float(g)))
+        for t in range(3):
+            api.create("pods", make_pod(f"g{g}-t{t}", f"g{g}"))
+
+
+class TestCacheMirror:
+    def test_event_sequences_match_projection(self):
+        api = APIServer()
+        cache = SchedulerCache(api)
+        assert_mirror_matches(cache)        # empty world
+        seed(api)
+        assert_mirror_matches(cache)        # rebuild path
+
+        # bind writes (the scheduler's own write-back)
+        cache.bind(BindIntent("default/g0-t0", "default/g0", "n0"))
+        assert_mirror_matches(cache)
+        # kubelet: pod starts running
+        pod = api.get("pods", "default/g0-t0")
+        pod.phase = PodPhase.RUNNING
+        api.update("pods", pod)
+        assert_mirror_matches(cache)
+        # pod completes
+        pod.phase = PodPhase.SUCCEEDED
+        api.update("pods", pod)
+        assert_mirror_matches(cache)
+        # eviction deletes the pod
+        cache.bind(BindIntent("default/g1-t0", "default/g1", "n1"))
+        cache.evict(EvictIntent("default/g1-t0", "default/g1"))
+        assert_mirror_matches(cache)
+        # controller re-creates it pending
+        api.create("pods", make_pod("g1-t0", "g1"))
+        assert_mirror_matches(cache)
+        # podgroup phase flip + spec change
+        cache.update_podgroup_phases({"default/g2": PodGroupPhase.RUNNING})
+        assert_mirror_matches(cache)
+        pg = api.get("podgroups", "default/g2")
+        pg.min_member = 1
+        api.update("podgroups", pg)
+        assert_mirror_matches(cache)
+        # queue weight edit + new queue
+        q = api.get("queues", "q1")
+        q.weight = 5
+        api.update("queues", q)
+        assert_mirror_matches(cache)
+        api.create("queues", QueueInfo("q2", weight=3))
+        assert_mirror_matches(cache)
+        # node appears / disappears
+        api.create("nodes", make_node("n9"))
+        assert_mirror_matches(cache)
+        api.delete("nodes", "n9")
+        assert_mirror_matches(cache)
+        # pod deleted outright
+        api.delete("pods", "default/g2-t2")
+        assert_mirror_matches(cache)
+
+    def test_node_overcommit_gates_out_and_back(self):
+        """Forced ingestion past allocatable flags the node OutOfSync: it
+        must leave the mirror's node set exactly like the projection drops
+        it, and return once the pressure clears."""
+        api = APIServer()
+        cache = SchedulerCache(api)
+        api.create("nodes", make_node("n0", cpu="2", mem="4Gi"))
+        api.create("nodes", make_node("n1"))
+        api.create("podgroups", PodGroup(name="g", min_member=1))
+        api.create("pods", make_pod("big", "g", cpu="4", mem="2Gi",
+                                    phase=PodPhase.RUNNING, node="n0"))
+        cache.live_view()
+        assert "n0" not in cache.live_view().nodes      # gated out
+        assert_mirror_matches(cache)
+        pod = api.get("pods", "default/big")
+        pod.phase = PodPhase.SUCCEEDED                  # frees the node
+        api.update("pods", pod)
+        assert "n0" in cache.live_view().nodes
+        assert_mirror_matches(cache)
+
+    def test_scheduler_loop_over_cache_matches_fresh(self):
+        """Full loop: persistent-session scheduler over the cache equals a
+        fresh-snapshot scheduler, cycle for cycle, under store churn."""
+        from volcano_tpu.runtime.scheduler import Scheduler
+
+        def build():
+            api = APIServer()
+            cache = SchedulerCache(api)
+            seed(api)
+            return api, cache
+
+        api_a, cache_a = build()
+        api_b, cache_b = build()
+        sa = Scheduler(cache_a, conf=CONF, incremental=True)
+        sb = Scheduler(cache_b, conf=CONF, incremental=False)
+        assert sa.incremental and not sb.incremental
+        for c in range(4):
+            ssn_a = sa.run_once(now=100.0 + c)
+            ssn_b = sb.run_once(now=100.0 + c)
+            da = sorted((b.task_uid, b.node_name) for b in ssn_a.binds)
+            db = sorted((b.task_uid, b.node_name) for b in ssn_b.binds)
+            assert da == db, f"cycle {c}"
+            assert sorted(ssn_a.pipelined) == sorted(ssn_b.pipelined)
+            for api in (api_a, api_b):
+                # kubelet: bound pods run; one runner completes each cycle
+                done = False
+                for pod in sorted(api.stores["pods"].values(),
+                                  key=lambda p: p.key):
+                    if pod.node_name and pod.phase == PodPhase.PENDING:
+                        pod.phase = PodPhase.RUNNING
+                        api.update("pods", pod)
+                    elif pod.phase == PodPhase.RUNNING and not done:
+                        pod.phase = PodPhase.SUCCEEDED
+                        api.update("pods", pod)
+                        done = True
+            assert_mirror_matches(cache_a)
+        assert cache_a.binds == cache_b.binds

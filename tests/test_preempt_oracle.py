@@ -1,0 +1,169 @@
+"""Preempt/reclaim kernel vs sequential CPU oracle (VERDICT r4 #3).
+
+The reference pins victim-choice behavior with dense action tests
+(pkg/scheduler/actions/preempt/preempt_test.go:1-322 and the reclaim/drf/
+proportion suites); here the pin is decision equality between
+ops.preempt.make_preempt_cycle and runtime.cpu_reference.preempt_cpu on
+randomized snapshots: victim sets, pipelined placements, and per-gang
+outcomes must match exactly.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from volcano_tpu.api import (ClusterInfo, JobInfo, NodeInfo, PodGroupPhase,
+                             QueueInfo, Resource, TaskInfo, TaskStatus)
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops.allocate_scan import AllocateConfig, AllocateExtras
+from volcano_tpu.ops.preempt import PreemptConfig, make_preempt_cycle
+from volcano_tpu.runtime.cpu_reference import preempt_cpu
+
+R = Resource.from_resource_list
+
+SCORING = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                         balanced_weight=0.0, taint_prefer_weight=0.0,
+                         enable_gpu=False)
+
+
+def random_cluster(rng, n_nodes=12, n_low=10, n_high=4, reclaim=False):
+    """Nodes mostly full of Running low-priority preemptable gangs plus
+    starving high-priority gangs (config-4 shape, downscaled)."""
+    ci = ClusterInfo()
+    if reclaim:
+        ci.add_queue(QueueInfo("qa", weight=1, reclaimable=True))
+        ci.add_queue(QueueInfo("qb", weight=1, reclaimable=True))
+    else:
+        ci.add_queue(QueueInfo("default", weight=1))
+    names = [f"n{i:02d}" for i in range(n_nodes)]
+    for n in names:
+        ci.add_node(NodeInfo(n, R({"cpu": "8", "memory": "16Gi"}),
+                             R({"cpu": "8", "memory": "16Gi"})))
+    k = 0
+    for j in range(n_low):
+        q = ("qa" if reclaim else "default")
+        job = JobInfo(f"default/lo{j}", queue=q, min_available=1,
+                      priority=int(rng.randint(0, 3)),
+                      creation_timestamp=float(j),
+                      pod_group_phase=PodGroupPhase.RUNNING,
+                      preemptable=True)
+        for t in range(int(rng.randint(2, 6))):
+            cpu = ["1", "2", "3"][rng.randint(3)]
+            task = TaskInfo(f"default/lo{j}-{t}", f"lo{j}-{t}",
+                            resreq=R({"cpu": cpu, "memory": "1Gi"}),
+                            status=TaskStatus.RUNNING,
+                            priority=int(rng.randint(0, 3)),
+                            preemptable=True)
+            node = names[k % n_nodes]
+            k += 1
+            task.node_name = node
+            job.add_task(task)
+            try:
+                ci.nodes[node].add_task(task)
+            except ValueError:
+                job.delete_task(task)
+        job.allocated = R({})
+        for t in job.tasks.values():
+            job.allocated.add(t.resreq)
+        ci.add_job(job)
+    for j in range(n_high):
+        q = ("qb" if reclaim else "default")
+        ma = int(rng.randint(1, 4))
+        job = JobInfo(f"default/hi{j}", queue=q, min_available=ma,
+                      priority=50 + int(rng.randint(0, 3)),
+                      creation_timestamp=100.0 + j,
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        for t in range(ma + int(rng.randint(0, 3))):
+            cpu = ["2", "4"][rng.randint(2)]
+            job.add_task(TaskInfo(
+                f"default/hi{j}-{t}", f"hi{j}-{t}",
+                resreq=R({"cpu": cpu, "memory": "2Gi"}),
+                priority=50))
+        ci.add_job(job)
+    return ci
+
+
+def run_both(ci, pcfg):
+    snap, _maps = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    T = np.asarray(snap.tasks.status).shape[0]
+    veto = np.zeros(T, bool)
+    skipm = np.zeros(T, bool)
+    fn = jax.jit(make_preempt_cycle(pcfg))
+    dev = fn(snap, extras, veto, skipm)
+    cpu = preempt_cpu(snap, extras, veto, skipm, pcfg)
+    return dev, cpu
+
+
+def assert_equal(dev, cpu, msg=""):
+    np.testing.assert_array_equal(np.asarray(dev.evicted),
+                                  cpu["evicted"], err_msg=f"victims {msg}")
+    np.testing.assert_array_equal(np.asarray(dev.task_node),
+                                  cpu["task_node"], err_msg=f"nodes {msg}")
+    np.testing.assert_array_equal(np.asarray(dev.task_mode),
+                                  cpu["task_mode"], err_msg=f"modes {msg}")
+    np.testing.assert_array_equal(np.asarray(dev.job_pipelined),
+                                  cpu["job_pipelined"], err_msg=msg)
+
+
+class TestPreemptOracle:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+    def test_preempt_decisions_equal(self, seed):
+        rng = np.random.RandomState(seed)
+        ci = random_cluster(rng)
+        pcfg = PreemptConfig(scoring=SCORING)
+        dev, cpu = run_both(ci, pcfg)
+        assert_equal(dev, cpu, f"seed={seed}")
+        # the scenario actually preempts something in most seeds
+        if seed == 0:
+            assert np.asarray(dev.evicted).any()
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_preempt_with_drf_rule(self, seed):
+        rng = np.random.RandomState(100 + seed)
+        ci = random_cluster(rng)
+        pcfg = PreemptConfig(
+            scoring=dataclasses.replace(SCORING, drf_job_order=True),
+            tiers=(("priority", "gang"), ("drf",)))
+        dev, cpu = run_both(ci, pcfg)
+        assert_equal(dev, cpu, f"drf seed={seed}")
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_reclaim_decisions_equal(self, seed):
+        rng = np.random.RandomState(200 + seed)
+        ci = random_cluster(rng, reclaim=True)
+        pcfg = PreemptConfig(mode="reclaim",
+                             scoring=SCORING,
+                             tiers=(("gang", "proportion"),))
+        snap, _maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        # finite deserved so reclaim's what-if rule actually gates
+        from volcano_tpu.ops.fairshare import proportion_deserved
+        extras.queue_deserved = np.asarray(proportion_deserved(
+            snap.queues, snap.cluster_capacity), np.float32)
+        T = np.asarray(snap.tasks.status).shape[0]
+        veto = np.zeros(T, bool)
+        skipm = np.zeros(T, bool)
+        fn = jax.jit(make_preempt_cycle(pcfg))
+        dev = fn(snap, extras, veto, skipm)
+        cpu = preempt_cpu(snap, extras, veto, skipm, pcfg)
+        assert_equal(dev, cpu, f"reclaim seed={seed}")
+
+    def test_conformance_veto_respected(self):
+        rng = np.random.RandomState(7)
+        ci = random_cluster(rng)
+        snap, _maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        T = np.asarray(snap.tasks.status).shape[0]
+        veto = np.zeros(T, bool)
+        veto[: T // 2] = True      # arbitrary protected half
+        skipm = np.zeros(T, bool)
+        pcfg = PreemptConfig(scoring=SCORING,
+                             tiers=(("priority", "gang", "conformance"),))
+        fn = jax.jit(make_preempt_cycle(pcfg))
+        dev = fn(snap, extras, veto, skipm)
+        cpu = preempt_cpu(snap, extras, veto, skipm, pcfg)
+        assert_equal(dev, cpu, "veto")
+        assert not np.asarray(dev.evicted)[veto].any()

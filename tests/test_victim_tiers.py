@@ -224,3 +224,81 @@ tiers:
         ssn = _run_preempt(ci, conf)
         ssn.run_preempt("preempt_intra")
         assert ssn.evictions == []
+
+
+class TestTdmBudgetInKernel:
+    """The tdm disruption budget caps placement-path evictions per job
+    (the Preemptable fn's maxVictims batching, tdm.go:219-229 + 304-340),
+    enforced in-kernel via extras.job_victim_budget."""
+
+    def _budget_cluster(self, budget_min_available):
+        from volcano_tpu.api import PodGroupPhase
+        # n0 carries no revocable-zone label: the tdm victim rule admits
+        # preemptable Running tasks on NON-revocable nodes (tdm.go:199-218)
+        ci = simple_cluster(n_nodes=1, node_cpu="8", node_mem="8Gi")
+        victim = build_job("default/victim", min_available=1, priority=1,
+                           preemptable=True,
+                           budget_min_available=budget_min_available)
+        for i in range(6):
+            t = build_task(f"v-{i}", cpu="1", memory=0, preemptable=True)
+            t.status = TaskStatus.RUNNING
+            victim.add_task(t)
+            ci.nodes["n0"].add_task(t)
+        ci.add_job(victim)
+        p = build_job("default/p", min_available=1, priority=50,
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        p.add_task(build_task("p-0", cpu="6", memory=0))
+        ci.add_job(p)
+        return ci
+
+    CONF = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: tdm
+    arguments:
+      tdm.revocable-zone.rz1: 00:00-23:59
+"""
+
+    def test_budget_caps_evictions(self):
+        """budget minAvailable=3 allows at most 3 evictions (6 running -
+        3); the preemptor needs 4 -> it cannot fit and nothing commits
+        (gang discard rolls the evictions back)."""
+        ci = self._budget_cluster("3")
+        ssn = _run_preempt(ci, self.CONF)
+        assert len(ssn.evictions) == 0
+        assert not ssn.pipelined
+
+    def test_budget_allows_when_sufficient(self):
+        """budget minAvailable=1 allows 5 evictions; the preemptor needs 4
+        (2 idle + 4 freed = 6 cpu) -> it pipelines with exactly 4."""
+        ci = self._budget_cluster("1")
+        ssn = _run_preempt(ci, self.CONF)
+        assert len(ssn.evictions) == 4
+        assert "default/p-0" in ssn.pipelined
+
+    def test_oracle_matches_budgeted_kernel(self):
+        import jax
+        from volcano_tpu.arrays import pack as _pack
+        from volcano_tpu.ops.preempt import make_preempt_cycle
+        from volcano_tpu.runtime.cpu_reference import preempt_cpu
+        ci = self._budget_cluster("3")
+        ssn = Session(ci, parse_conf(self.CONF))
+        pcfg_kwargs = {}
+        from volcano_tpu.ops.preempt import PreemptConfig
+        pcfg = PreemptConfig(
+            scoring=ssn.allocate_config(),
+            tiers=ssn.victim_tiers("preempt"))
+        extras = ssn.allocate_extras()
+        T = np.asarray(ssn.snap.tasks.status).shape[0]
+        veto = np.zeros(T, bool)
+        skipm = np.zeros(T, bool)
+        dev = jax.jit(make_preempt_cycle(pcfg))(ssn.snap, extras, veto,
+                                                skipm)
+        cpu = preempt_cpu(ssn.snap, extras, veto, skipm, pcfg)
+        np.testing.assert_array_equal(np.asarray(dev.evicted),
+                                      cpu["evicted"])
+        np.testing.assert_array_equal(np.asarray(dev.task_mode),
+                                      cpu["task_mode"])

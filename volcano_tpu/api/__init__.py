@@ -1,8 +1,9 @@
 """In-memory scheduling data model (reference: pkg/scheduler/api)."""
 
 from .cluster_info import ClusterInfo
-from .job_info import (FitError, FitErrors, JobInfo, PodAffinityTerm,
-                       Taint, TaskInfo, Toleration)
+from .job_info import (FitError, FitErrors, JobInfo, NodeSelectorTerm,
+                       PodAffinityTerm, Taint, TaskInfo, Toleration,
+                       as_node_term)
 from .node_info import (GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE, GPUDevice,
                         NodeInfo, gpu_request_of)
 from .numa import (CPU_MANAGER_POLICY, TOPOLOGY_MANAGER_POLICY, CPUInfo,

@@ -26,6 +26,10 @@ class PodTemplate:
     annotations: Dict[str, str] = field(default_factory=dict)
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
+    #: node-affinity terms (NodeSelectorTerm or match-labels dicts):
+    #: requiredDuringScheduling OR-of-terms and (term, weight) preferred
+    affinity_required: List = field(default_factory=list)
+    affinity_preferred: List = field(default_factory=list)
     priority: int = 0
     restart_policy: str = "OnFailure"
     volumes: List[str] = field(default_factory=list)    # volume claim names

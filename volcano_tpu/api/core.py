@@ -43,6 +43,10 @@ class Pod:
     resources: Dict[str, object] = field(default_factory=dict)  # ResourceList
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
+    #: node-affinity (NodeSelectorTerm or match-labels dicts) — projected
+    #: onto TaskInfo by the scheduler cache
+    affinity_required: List = field(default_factory=list)
+    affinity_preferred: List = field(default_factory=list)
     priority: int = 0
     restart_policy: str = "OnFailure"
     env: Dict[str, str] = field(default_factory=dict)

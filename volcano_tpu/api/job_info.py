@@ -99,6 +99,85 @@ class PodAffinityTerm:
 
 
 @dataclass
+class NodeSelectorTerm:
+    """One required/preferred nodeSelectorTerm: AND of matchLabels equality
+    pairs and matchExpressions with the full k8s operator set
+    In / NotIn / Exists / DoesNotExist / Gt / Lt.
+
+    Reference semantics: the wrapped k8s NodeAffinity plugin that the
+    predicates filter and nodeorder scorer delegate to
+    (pkg/scheduler/plugins/predicates/predicates.go:186-190,
+    pkg/scheduler/plugins/nodeorder/nodeorder.go:255-266), i.e.
+    component-helpers nodeaffinity.NodeSelectorRequirementsAsSelector:
+    In requires the label present with a value in the set; NotIn and
+    DoesNotExist also match when the label is absent; Gt/Lt parse the
+    label value as an integer and require exactly one integer operand
+    (parse failures match nothing). A term with no labels and no
+    expressions matches no nodes (k8s: "a null or empty node selector
+    term matches no objects")."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    #: (key, op, values) tuples; values is a tuple/list of strings
+    match_expressions: List[tuple] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        if not self.match_labels and not self.match_expressions:
+            return False
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for key, op, values in self.match_expressions:
+            present = key in labels
+            if op == "In":
+                if not present or labels[key] not in values:
+                    return False
+            elif op == "NotIn":
+                if present and labels[key] in values:
+                    return False
+            elif op == "Exists":
+                if not present:
+                    return False
+            elif op == "DoesNotExist":
+                if present:
+                    return False
+            elif op in ("Gt", "Lt"):
+                if not present or len(values) != 1:
+                    return False
+                try:
+                    lv = int(str(labels[key]).strip())
+                    rv = int(str(values[0]).strip())
+                except ValueError:
+                    return False
+                if not (lv > rv if op == "Gt" else lv < rv):
+                    return False
+            else:
+                raise ValueError(f"unknown node-selector op {op!r}")
+        return True
+
+    def is_pure_labels(self) -> bool:
+        return not self.match_expressions
+
+    def signature(self) -> tuple:
+        return (tuple(sorted(self.match_labels.items())),
+                tuple((k, op, tuple(v)) for k, op, v
+                      in self.match_expressions))
+
+    def clone(self) -> "NodeSelectorTerm":
+        return NodeSelectorTerm(
+            match_labels=dict(self.match_labels),
+            match_expressions=[(k, op, tuple(v)) for k, op, v
+                               in self.match_expressions])
+
+
+def as_node_term(term) -> NodeSelectorTerm:
+    """Normalize a node-affinity term: plain dicts (the original
+    match-labels-only shape) become expression-less terms."""
+    if isinstance(term, NodeSelectorTerm):
+        return term
+    return NodeSelectorTerm(match_labels=dict(term))
+
+
+@dataclass
 class TaskInfo:
     """A schedulable unit (pod) of a gang job.
 
@@ -132,12 +211,14 @@ class TaskInfo:
     node_selector: Dict[str, str] = field(default_factory=dict)
     tolerations: List[Toleration] = field(default_factory=list)
     labels: Dict[str, str] = field(default_factory=dict)
-    affinity_required: List[Dict[str, str]] = field(default_factory=list)
+    #: requiredDuringSchedulingIgnoredDuringExecution nodeSelectorTerms —
+    #: OR-of-terms; each entry is a NodeSelectorTerm or a plain match-labels
+    #: dict (normalized via as_node_term)
+    affinity_required: List = field(default_factory=list)
     #: preferredDuringSchedulingIgnoredDuringExecution node-affinity terms
-    #: as (match-labels, weight) pairs — the k8s NodeAffinity scorer input
-    #: (nodeorder.go:255-266)
-    affinity_preferred: List[Tuple[Dict[str, str], float]] = field(
-        default_factory=list)
+    #: as (term-or-match-labels, weight) pairs — the k8s NodeAffinity
+    #: scorer input (nodeorder.go:255-266)
+    affinity_preferred: List[Tuple] = field(default_factory=list)
     # inter-pod (anti-)affinity terms (k8s InterPodAffinity semantics,
     # predicates.go:261-273 + nodeorder.go:273-306):
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
@@ -172,8 +253,11 @@ class TaskInfo:
             host_ports=list(self.host_ports), pvcs=list(self.pvcs),
             node_selector=dict(self.node_selector),
             tolerations=list(self.tolerations), labels=dict(self.labels),
-            affinity_required=[dict(m) for m in self.affinity_required],
-            affinity_preferred=[(dict(m), w)
+            affinity_required=[as_node_term(m).clone()
+                               if isinstance(m, NodeSelectorTerm) else dict(m)
+                               for m in self.affinity_required],
+            affinity_preferred=[(m.clone() if isinstance(m, NodeSelectorTerm)
+                                 else dict(m), w)
                                 for m, w in self.affinity_preferred],
             pod_affinity=[t.clone() for t in self.pod_affinity],
             pod_anti_affinity=[t.clone() for t in self.pod_anti_affinity],

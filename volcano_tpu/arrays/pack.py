@@ -5,15 +5,14 @@ struct-of-array schema. The reference's equivalent moment is
 SchedulerCache.Snapshot deep-copying maps (cache.go:712-811); here the copy IS
 the pack, and the result is what gets shipped to the device.
 
-Known encoding divergences from the reference (documented per SURVEY section 7
-hard part 3):
-- Node-affinity required terms use match-labels semantics (hash equality;
-  expression operators are not encoded). Single-term tasks fold into the
-  packed all-of selector row; multi-term OR-of-terms ride a host-computed
-  per-template feasibility mask (extras.template_feasible,
-  Session._node_affinity_extras) — exact on the session path, permissive
-  on the extras-less sidecar path.
-  (InterPodAffinity has its own exact encoding, arrays/affinity.py.)
+Node-affinity encoding (SURVEY section 7 hard part 3): a lone pure-labels
+required term folds into the packed all-of selector row (hash equality);
+multi-term OR-of-terms and any matchExpressions term (full k8s operator set
+In/NotIn/Exists/DoesNotExist/Gt/Lt, api/job_info.py NodeSelectorTerm) ride
+host-computed per-task OR-group node masks (extras.task_or_group /
+or_feasible, Session._node_affinity_extras) — exact on the session path and
+shipped to the sidecar in the VCS4 extras frame.
+(InterPodAffinity has its own exact encoding, arrays/affinity.py.)
 """
 
 from __future__ import annotations
@@ -23,7 +22,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import (CPU, MEMORY, ClusterInfo, JobInfo, PodGroupPhase,
-                   QueueState, TaskStatus, gpu_request_of, is_allocated_status)
+                   QueueState, TaskStatus, as_node_term, gpu_request_of,
+                   is_allocated_status)
 from ..api.job_info import Toleration
 from . import labels as L
 from .schema import (IndexMaps, JobArrays, NodeArrays, QueueArrays,
@@ -244,12 +244,16 @@ def pack(ci: ClusterInfo,
         t_preempt[ti] = task.preemptable
         t_valid[ti] = True
         required = dict(task.node_selector)
-        if len(task.affinity_required) == 1:
-            required.update(task.affinity_required[0])
+        terms = [as_node_term(m) for m in task.affinity_required]
+        if len(terms) == 1 and terms[0].is_pure_labels():
+            required.update(terms[0].match_labels)
         # multi-term required node affinity is OR-of-terms (k8s
-        # NodeSelectorTerms): the packed row keeps only the nodeSelector
-        # conjunction; the OR mask rides extras.template_feasible
-        # (host-computed, Session._node_affinity_extras)
+        # NodeSelectorTerms), and matchExpressions operators
+        # (In/NotIn/Exists/DoesNotExist/Gt/Lt) cannot ride the hash-equality
+        # row: the packed row keeps only the nodeSelector conjunction (plus
+        # a lone pure-labels term); everything else travels as per-task
+        # OR-group masks (extras.or_feasible, Session._node_affinity_extras,
+        # carried over the VCS4 wire extras section)
         sel_rows.append(sorted(L.stable_hash(f"{k}={v}")
                                for k, v in required.items()))
         h, e, m = _toleration_rows(task.tolerations)
@@ -268,7 +272,7 @@ def pack(ci: ClusterInfo,
     rep_tasks: List[int] = []
     for ti in range(nt):
         task = task_entries[ti][1]
-        na_sig = tuple(sorted((tuple(sorted(m.items())), w)
+        na_sig = tuple(sorted((as_node_term(m).signature(), w)
                               for m, w in task.affinity_preferred))
         sig = (tuple(sel_rows[ti]), tuple(tolh_rows[ti]),
                tuple(tole_rows[ti]), tuple(tolm_rows[ti]), na_sig)

@@ -44,12 +44,34 @@ def _template(raw: Optional[Dict]) -> PodTemplate:
                               value=t.get("value", ""),
                               effect=t.get("effect", ""))
                    for t in spec.get("tolerations", []) or []]
+
+    # k8s affinity.nodeAffinity: required OR-of-terms + weighted preferred,
+    # with full matchExpressions operator semantics (api.NodeSelectorTerm)
+    def _term(raw_term):
+        from ..api import NodeSelectorTerm
+        return NodeSelectorTerm(
+            match_labels=dict(raw_term.get("matchLabels") or {}),
+            match_expressions=[
+                (e.get("key", ""), e.get("operator", "In"),
+                 tuple(e.get("values") or ()))
+                for e in raw_term.get("matchExpressions") or []])
+
+    na = (spec.get("affinity") or {}).get("nodeAffinity") or {}
+    req = (na.get("requiredDuringSchedulingIgnoredDuringExecution")
+           or {}).get("nodeSelectorTerms") or []
+    pref = na.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+    affinity_required = [_term(t) for t in req]
+    affinity_preferred = [
+        (_term(p.get("preference") or {}), float(p.get("weight", 1)))
+        for p in pref]
     return PodTemplate(
         resources=resources,
         labels=dict(meta.get("labels") or {}),
         annotations=dict(meta.get("annotations") or {}),
         node_selector=dict(spec.get("nodeSelector") or {}),
         tolerations=tolerations,
+        affinity_required=affinity_required,
+        affinity_preferred=affinity_preferred,
         priority=int(spec.get("priority", 0)),
         restart_policy=spec.get("restartPolicy", "OnFailure"))
 

@@ -30,6 +30,9 @@ def _run(argv_for_vcctl: List[str], system=None) -> int:
 def _base_parser(prog: str, desc: str) -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog=prog, description=desc)
     p.add_argument("--state", help="pickled VolcanoSystem state file")
+    from ..version import version_string
+    p.add_argument("--version", action="version",
+                   version=version_string())
     return p
 
 

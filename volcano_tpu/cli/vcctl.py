@@ -188,11 +188,18 @@ def build_parser() -> argparse.ArgumentParser:
     qd = queue.add_parser("delete")
     qd.add_argument("-N", "--name", required=True)
     qd.set_defaults(fn=cmd_queue_delete)
+
+    # version stamp (cmd/cli/vcctl version, pkg/version analog);
+    # dispatched by main()'s stateless early return
+    sub.add_parser("version")
     return p
 
 
 def main(argv: Optional[List[str]] = None, system=None) -> str:
     args = build_parser().parse_args(argv)
+    if args.group == "version":     # stateless: no system needed
+        from ..version import version_string
+        return version_string()
     persist = False
     if system is None:
         if not args.state:

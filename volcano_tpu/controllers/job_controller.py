@@ -254,6 +254,8 @@ class JobController(Controller):
             resources=dict(tmpl.resources),
             node_selector=dict(tmpl.node_selector),
             tolerations=list(tmpl.tolerations),
+            affinity_required=list(tmpl.affinity_required),
+            affinity_preferred=list(tmpl.affinity_preferred),
             priority=tmpl.priority, restart_policy=tmpl.restart_policy,
             env=dict(tmpl.env), volumes=list(tmpl.volumes))
         # fork's counter-label: monotonically numbered pod label

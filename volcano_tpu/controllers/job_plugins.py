@@ -30,6 +30,18 @@ class SecretObject:
     data: Dict[str, str] = field(default_factory=dict)
 
 
+class NetworkPolicyObject:
+    """Job-scoped ingress isolation (svc.go:316-353): only pods of the
+    same job may talk to the job's pods."""
+
+    def __init__(self, name, namespace, pod_selector, ingress_from):
+        self.name = name
+        self.namespace = namespace
+        self.pod_selector = dict(pod_selector)
+        self.ingress_from = dict(ingress_from)
+        self.policy_types = ["Ingress"]
+
+
 @dataclass
 class ServiceObject:
     name: str
@@ -93,6 +105,18 @@ class SvcPlugin(JobPlugin):
             apiserver.create("services", svc)
         if apiserver.get("configmaps", f"{job.namespace}/{job.name}-svc") is None:
             apiserver.create("configmaps", cm)
+        # job-scoped NetworkPolicy unless disabled by the plugin argument
+        # (svc.go:48-69 disable-network-policy flag + :144-146 creation)
+        args = job.plugins.get(self.name, []) or []
+        if "--disable-network-policy=true" not in args \
+                and "--disable-network-policy" not in args:
+            key = f"{job.namespace}/{job.name}"
+            if apiserver.get("networkpolicies", key) is None:
+                sel = {"volcano.sh/job-name": job.name,
+                       "volcano.sh/job-namespace": job.namespace}
+                apiserver.create("networkpolicies", NetworkPolicyObject(
+                    name=job.name, namespace=job.namespace,
+                    pod_selector=sel, ingress_from=sel))
         job.status.controlled_resources["plugin-svc"] = job.name
 
     def on_pod_create(self, job, pod, index, apiserver):
@@ -107,6 +131,7 @@ class SvcPlugin(JobPlugin):
     def on_job_delete(self, job, apiserver):
         apiserver.delete("services", f"{job.namespace}/{job.name}")
         apiserver.delete("configmaps", f"{job.namespace}/{job.name}-svc")
+        apiserver.delete("networkpolicies", f"{job.namespace}/{job.name}")
 
 
 class SSHPlugin(JobPlugin):

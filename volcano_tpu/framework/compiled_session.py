@@ -81,14 +81,20 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
 
 def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):
     """conf (SchedulerConfiguration | YAML text | None) -> jittable
-    cycle(snap, hierarchy=None) -> AllocateResult with in-graph plugin
-    extras.
+    cycle(snap, hierarchy=None, base_extras=None) -> AllocateResult with
+    in-graph plugin extras.
 
     ``hierarchy`` (arrays/hierarchy.HierarchyArrays) supplies the hdrf tree
     topology when the conf enables drf hierarchy — either baked here or
-    passed per call (the sidecar rebuilds it from the VCS3 wire's queue
+    passed per call (the sidecar rebuilds it from the VCS4 wire's queue
     annotations via native/pywire.decode_hierarchy). An hdrf conf with no
-    tree warns and degrades to a root-only tree (neutral queue keys)."""
+    tree warns and degrades to a root-only tree (neutral queue keys).
+
+    ``base_extras`` (AllocateExtras) replaces the neutral starting point —
+    the sidecar passes the host extras decoded from the VCX1 wire frame
+    (node-affinity masks, ports, volumes) so the served cycle starts from
+    the same inputs an in-process Session would; the conf-derived pieces
+    (hierarchy, proportion deserved) are still applied here on top."""
     if conf is None or isinstance(conf, str):
         sc = parse_conf(conf)
     else:
@@ -99,9 +105,12 @@ def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):
     proportion_on = "proportion" in options
     baked_hierarchy = hierarchy
 
-    def cycle(snap: SnapshotArrays, hierarchy=None):
+    def cycle(snap: SnapshotArrays, hierarchy=None, base_extras=None):
         snap = jax.tree.map(jnp.asarray, snap)
-        extras = jax.tree.map(jnp.asarray, AllocateExtras.neutral(snap))
+        extras = jax.tree.map(
+            jnp.asarray,
+            base_extras if base_extras is not None
+            else AllocateExtras.neutral(snap))
         tree = hierarchy if hierarchy is not None else baked_hierarchy
         if tree is not None:
             extras.hierarchy = jax.tree.map(jnp.asarray, tree)

@@ -91,13 +91,18 @@ class Session:
                  conf: Optional[SchedulerConfiguration] = None,
                  now: Optional[float] = None,
                  plugin_overrides: Optional[Dict[str, object]] = None):
-        from ..plugins.factory import build_plugin
-
         self.cluster = cluster
         self.conf = conf or parse_conf()
         self.now = now if now is not None else time.time()
+        self._build_plugins(plugin_overrides or {})
+
+        self._reset_cycle_state()
+        self.repack()
+        self._open_plugins()
+
+    def _build_plugins(self, overrides: Dict[str, object]) -> None:
+        from ..plugins.factory import build_plugin
         self.plugins = []
-        overrides = plugin_overrides or {}
         for tier in self.conf.tiers:
             for opt in tier.plugins:
                 if opt.name in overrides:
@@ -105,6 +110,15 @@ class Session:
                 else:
                     self.plugins.append(build_plugin(opt))
 
+    def _open_plugins(self) -> None:
+        from ..metrics import METRICS
+        for p in self.plugins:
+            t0 = time.time()
+            p.on_session_open(self)
+            METRICS.observe_plugin(p.name, "OnSessionOpen",
+                                   time.time() - t0)
+
+    def _reset_cycle_state(self) -> None:
         self.binds: List[BindIntent] = []
         self.evictions: List[EvictIntent] = []
         self.bind_errors: List[tuple] = []      # (task uid, node, error)
@@ -112,17 +126,38 @@ class Session:
         self.conditions: Dict[str, str] = {}    # job uid -> condition type
         self.phase_updates: Dict[str, object] = {}  # job uid -> new PG phase
         self.last_allocate: Optional[AllocateResult] = None
+        self._last_queue_deserved = None
         self.stats: Dict[str, float] = {}
         # dirty sets feeding refresh_snapshot (the event-handler analog of
         # the reference's incrementally maintained cache,
         # event_handlers.go): apply/evict record their touches; external
-        # mutators call mark_dirty
-        self._dirty_jobs: set = set()
-        self._dirty_nodes: set = set()
+        # mutators call mark_dirty. Preserved across _reset_cycle_state so
+        # a reopened session refreshes what the previous cycle touched.
+        if not hasattr(self, "_dirty_jobs"):
+            self._dirty_jobs: set = set()
+            self._dirty_nodes: set = set()
 
-        self.repack()
-        for p in self.plugins:
-            p.on_session_open(self)
+    def reopen(self, now: Optional[float] = None,
+               conf: Optional[SchedulerConfiguration] = None,
+               plugin_overrides: Optional[Dict[str, object]] = None) -> bool:
+        """Start the next scheduling cycle on this session without a full
+        re-pack: drop the previous cycle's intents, incrementally refresh
+        the packed snapshot from the recorded dirty entities, and re-open
+        the plugins. This is the production steady-state path — the
+        reference never re-builds its cache between cycles either; informer
+        event handlers patch it in place and runOnce snapshots the result
+        (event_handlers.go:43-740 feeding scheduler.go:91).
+
+        Returns True when the incremental patch sufficed (False = one of
+        refresh_snapshot's documented fallbacks forced a full repack)."""
+        if conf is not None:
+            self.conf = conf
+        self.now = now if now is not None else time.time()
+        self._reset_cycle_state()
+        refreshed = self.refresh_snapshot()
+        self._build_plugins(plugin_overrides or {})
+        self._open_plugins()
+        return refreshed
 
     # ------------------------------------------------------------- packing
     def repack(self) -> None:
@@ -151,6 +186,12 @@ class Session:
         # O(T) pass; skipping it when nothing reads back by uid saved
         # ~150 ms at 100k tasks)
         self._task_lookup_cache = None
+        # packed-order (job, task) object pairs for _bulk_bind: built (and
+        # alignment-verified) once per pack, then kept valid by the dirty
+        # machinery — refresh_snapshot repacks on any task-set change and
+        # patches entries for replaced objects, so per-cycle re-validation
+        # collapses to a length check
+        self._packed_objs_cache = None
         # hdrf tree topology (the drf plugin's hierarchicalRoot,
         # drf.go:128-147) — static per snapshot, consumed in-kernel
         from ..arrays.hierarchy import build_hierarchy
@@ -352,6 +393,15 @@ class Session:
                     != list(job.tasks.keys())):
                 self.repack()       # task set changed: full rebuild
                 return False
+            # a watch-driven store may have replaced the TaskInfo objects
+            # behind unchanged uids: re-point the positional/uid caches so
+            # later binds mutate the live objects, not stale ones
+            if self._packed_objs_cache is not None:
+                for ti, task in zip(tis.tolist(), job.tasks.values()):
+                    self._packed_objs_cache[ti] = (job, task)
+            if self._task_lookup_cache is not None:
+                for task in job.tasks.values():
+                    self._task_lookup_cache[task.uid] = (job, task)
             pending: list = []
             req_sum = np.zeros(len(dims), np.float32)
             for ti, task in zip(tis.tolist(), job.tasks.values()):
@@ -411,11 +461,19 @@ class Session:
             nodes_arr.max_pods[ni] = node.max_pods
             nodes_arr.schedulable[ni] = (node.ready
                                          and not node.unschedulable)
+            # always zero first (a node whose device set emptied must not
+            # keep stale rows — pack zeros them); a device set that outgrew
+            # the packed G bucket or a dev.id past it needs the wider bucket
+            # only a repack can size
+            nodes_arr.gpu_memory[ni] = 0.0
+            nodes_arr.gpu_used[ni] = 0.0
+            G = nodes_arr.gpu_memory.shape[1]
             if node.gpu_devices:
-                nodes_arr.gpu_memory[ni] = 0.0
-                nodes_arr.gpu_used[ni] = 0.0
-                G = nodes_arr.gpu_memory.shape[1]
-                for dev in node.gpu_devices[:G]:
+                if (len(node.gpu_devices) > G
+                        or any(dev.id >= G for dev in node.gpu_devices)):
+                    self.repack()
+                    return False
+                for dev in node.gpu_devices:
                     nodes_arr.gpu_memory[ni, dev.id] = dev.memory
                     nodes_arr.gpu_used[ni, dev.id] = dev.used_memory()
 
@@ -500,131 +558,33 @@ class Session:
     def _port_volume_extras(self, extras: AllocateExtras) -> None:
         """Host-side NodePorts + volume-binding inputs (the predicates
         plugin's nodePortFilter, predicates.go:191, and the
-        defaultVolumeBinder seam, cache.go:240-272)."""
-        from ..arrays.schema import bucket
-        N = np.asarray(self.snap.nodes.pod_count).shape[0]
-        T = np.asarray(self.snap.tasks.status).shape[0]
-        task_ports: Dict[int, list] = {}
-        node_ports: Dict[int, set] = {}
-        vol_ok = np.ones(T, bool)
-        vol_node = np.full(T, -1, np.int32)
-        n_pending_ports = 0
-        for job in self.cluster.jobs.values():
-            for uid, task in job.tasks.items():
-                ti = self.maps.task_index.get(uid)
-                if ti is None:
-                    continue
-                if task.host_ports:
-                    if task.node_name in self.maps.node_index:
-                        node_ports.setdefault(
-                            self.maps.node_index[task.node_name],
-                            set()).update(task.host_ports)
-                    else:
-                        task_ports[ti] = list(task.host_ports)
-                        n_pending_ports += len(task.host_ports)
-                for claim in task.pvcs:
-                    pvc = self.cluster.pvcs.get(claim)
-                    if pvc is None or not pvc.bindable:
-                        vol_ok[ti] = False
-                    elif pvc.node_name:
-                        ni = self.maps.node_index.get(pvc.node_name, -1)
-                        if ni < 0:
-                            vol_ok[ti] = False
-                        elif vol_node[ti] >= 0 and vol_node[ti] != ni:
-                            vol_ok[ti] = False   # claims pin to two nodes
-                        else:
-                            vol_node[ti] = ni
-        HP = bucket(max((len(p) for p in task_ports.values()), default=1), 1)
-        PS = bucket(max((len(p) for p in node_ports.values()), default=1), 1)
-        tp = np.zeros((T, HP), np.int32)
-        for ti, ports in task_ports.items():
-            tp[ti, :len(ports)] = sorted(ports)[:HP]
-        npo = np.zeros((N, PS), np.int32)
-        for ni, ports in node_ports.items():
-            npo[ni, :len(ports)] = sorted(ports)[:PS]
-        PE = bucket(max(n_pending_ports, 1), 8)
-        extras.task_ports = tp
-        extras.node_ports = npo
-        extras.pe_node0 = np.full(PE, -1, np.int32)
-        extras.pe_port0 = np.zeros(PE, np.int32)
-        extras.task_volume_ok = vol_ok
-        extras.task_volume_node = vol_node
+        defaultVolumeBinder seam, cache.go:240-272). The walk itself lives
+        in framework/host_extras.py, shared with the VCS4 wire client."""
+        from .host_extras import apply_port_volume_sections, \
+            port_volume_sections
+        sec = port_volume_sections(self.cluster, self.maps.node_index,
+                                   self.maps.task_index)
+        apply_port_volume_sections(extras, sec, self.snap)
 
     def _node_affinity_extras(self, extras: AllocateExtras) -> None:
         """f32[P, N] NodeAffinity preferred-terms score per predicate
         template: sum of matched term weights x nodeaffinity.weight
         (nodeorder.go:255-266 wrapping the k8s NodeAffinity scorer,
         un-normalized like the reference's TODO notes)."""
+        from .host_extras import (apply_affinity_sections,
+                                  node_affinity_sections)
         no = self.plugin("nodeorder")
         w = no.arg_float("nodeaffinity.weight", 1.0) if no is not None else 0.0
-        do_score = bool(w) and no is not None
         do_required = self.plugin("predicates") is not None
-        if not (do_score or do_required):
+        if not (bool(w) or do_required):
             return
-        rep = np.asarray(self.snap.template_rep)
-        N = len(self.maps.node_names)
-        node_labels = [self.cluster.nodes[n].labels
-                       for n in self.maps.node_names]
-        score = np.asarray(extras.template_na_score).copy()
-        uids = self.maps.task_uids
-
-        def term_mask(match):
-            return np.fromiter(
-                (all(labels.get(k) == v for k, v in match.items())
-                 for labels in node_labels), bool, count=N)
-
-        any_terms = False
-        if do_score:
-            for p, ti in enumerate(rep.tolist()):
-                if ti < 0 or ti >= len(uids):
-                    continue
-                _job, task = self._task_lookup.get(uids[ti], (None, None))
-                if task is None:
-                    continue
-                for match, weight in task.affinity_preferred:
-                    any_terms = True
-                    score[p, :N] += np.float32(w * weight) * term_mask(match)
-        if any_terms:
-            extras.template_na_score = score.astype(np.float32)
-        if do_required:
-            # OR of NodeSelectorTerms (the k8s required semantics the
-            # packed all-of row cannot express) — PER TASK, grouped by
-            # distinct OR set: template identity merges across different
-            # OR sets on the native pack path, so a per-template mask
-            # would misapply (arrays/pack.py note)
-            T = np.asarray(self.snap.tasks.status).shape[0]
-            T_full = np.asarray(extras.task_or_group).shape[0]
-            group_of = {}
-            masks = []
-            task_group = np.full(T_full, -1, np.int32)
-            for job in self.cluster.jobs.values():
-                for uid, task in job.tasks.items():
-                    if len(task.affinity_required) <= 1:
-                        continue
-                    ti = self.maps.task_index.get(uid)
-                    if ti is None:
-                        continue
-                    key = tuple(sorted(tuple(sorted(m.items()))
-                                       for m in task.affinity_required))
-                    g = group_of.get(key)
-                    if g is None:
-                        g = len(masks)
-                        group_of[key] = g
-                        ok = np.zeros(N, bool)
-                        for match in task.affinity_required:
-                            ok |= term_mask(match)
-                        masks.append(ok)
-                    task_group[ti] = g
-            if masks:
-                from ..arrays.schema import bucket as _bucket
-                Nfull = np.asarray(extras.or_feasible).shape[1]
-                GR = _bucket(len(masks), 1)
-                feas = np.ones((GR, Nfull), bool)
-                for g, ok in enumerate(masks):
-                    feas[g, :N] = ok
-                    feas[g, N:] = False   # padded nodes never match a term
-                extras.task_or_group = task_group
-                extras.or_feasible = feas
+        # the walk + grouping (full matchExpressions semantics,
+        # api.NodeSelectorTerm) is shared with the VCS4 wire client so the
+        # served sidecar sees bit-identical masks
+        sec = node_affinity_sections(self.cluster, self.maps.node_names,
+                                     self.maps.task_index, w, do_required)
+        apply_affinity_sections(extras, sec, self.snap,
+                                len(self.maps.node_names))
 
     def allocate_extras(self) -> AllocateExtras:
         extras = AllocateExtras.neutral(self.snap)
@@ -639,6 +599,8 @@ class Session:
             deserved = p.queue_deserved(self)
             if deserved is not None:
                 extras.queue_deserved = np.asarray(deserved, np.float32)
+                # reused by the metric families at close (no re-dispatch)
+                self._last_queue_deserved = extras.queue_deserved
             share = p.job_order_share(self)
             if share is not None and p.option.enabled_job_order:
                 extras.job_share = np.asarray(share, np.float32)
@@ -657,6 +619,9 @@ class Session:
                 extras.tdm_bonus = np.asarray(p.tdm_bonus_mask(self))
             if hasattr(p, "revocable_node_mask"):
                 extras.revocable_node = np.asarray(p.revocable_node_mask(self))
+            if hasattr(p, "job_victim_budget"):
+                extras.job_victim_budget = np.asarray(
+                    p.job_victim_budget(self), np.int32)
             if hasattr(p, "task_pref_node"):
                 extras.task_pref_node = np.asarray(
                     p.task_pref_node(self), np.int32)
@@ -946,24 +911,31 @@ class Session:
         node_l = task_node[bind_idx].tolist()
         gpu_l = task_gpu[bind_idx].tolist()
         # packed-order (job, task) object list: one append pass in the
-        # packer's task order beats building + probing the uid dict
-        packed_objs: list = []
-        extend = packed_objs.extend
-        for juid in self.maps.job_uids:
-            jb = self.cluster.jobs.get(juid)
-            if jb is not None:
-                extend((jb, t) for t in jb.tasks.values())
-        # a cluster mutated between repack and apply (task replaced,
-        # jobs reshaped) silently shifts positional order, so verify the
-        # full uid alignment (~ms at 100k) — count alone cannot catch a
-        # count-preserving swap
-        if (len(packed_objs) != len(uids)
-                or not all(p[1].uid == u
-                           for p, u in zip(packed_objs, uids))):
-            # packing order no longer matches the live cluster: fall
-            # back to the uid index
+        # packer's task order beats building + probing the uid dict. Built
+        # (and uid-alignment-verified — count alone cannot catch a
+        # count-preserving swap) once per pack, then reused: refresh
+        # repacks on any task-set change and patches replaced objects, so
+        # the O(T) verification does not recur every cycle
+        packed_objs = self._packed_objs_cache
+        if packed_objs is None:
+            packed_objs = []
+            extend = packed_objs.extend
+            for juid in self.maps.job_uids:
+                jb = self.cluster.jobs.get(juid)
+                if jb is not None:
+                    extend((jb, t) for t in jb.tasks.values())
+            if (len(packed_objs) != len(uids)
+                    or not all(p[1].uid == u
+                               for p, u in zip(packed_objs, uids))):
+                # packing order no longer matches the live cluster: fall
+                # back to the uid index
+                packed_objs = None
+            else:
+                self._packed_objs_cache = packed_objs
+        elif len(packed_objs) != len(uids):
+            packed_objs = self._packed_objs_cache = None
+        if packed_objs is None:
             lookup_get = self._task_lookup.get
-            packed_objs = None
         node_objs = self.cluster.nodes
         binds_append = self.binds.append
         binding = TaskStatus.BINDING
@@ -1077,5 +1049,88 @@ class Session:
 
     # --------------------------------------------------------------- close
     def close(self) -> None:
+        from ..metrics import METRICS
         for p in self.plugins:
+            t0 = time.time()
             p.on_session_close(self)
+            METRICS.observe_plugin(p.name, "OnSessionClose",
+                                   time.time() - t0)
+        self._flush_metric_families()
+
+    def _flush_metric_families(self) -> None:
+        """Queue + namespace gauge families at session close (the
+        proportion plugin's metrics updates, queue.go:28-284, and
+        namespace.go:28-63 — here from the packed aggregates, so every
+        conf exposes them)."""
+        from ..metrics import METRICS
+        snap, maps = self.snap, self.maps
+        dims = maps.resource_names
+        ci_cpu = dims.index("cpu") if "cpu" in dims else -1
+        ci_mem = dims.index("memory") if "memory" in dims else -1
+
+        def dim(row, i):
+            # Resource stores cpu in millicores and memory in bytes
+            # already — the gauge units (queue.go:28-60) need no scaling
+            return float(row[i]) if i >= 0 else 0.0
+
+        q_alloc = np.asarray(snap.queues.allocated)
+        q_req = np.asarray(snap.queues.request)
+        q_weight = np.asarray(snap.queues.weight)
+        # the deserved shares this cycle's allocate already computed (no
+        # second water-filling dispatch at close)
+        deserved = self._last_queue_deserved
+        from ..api import PodGroupPhase
+        pg_counts: Dict[str, list] = {}
+        for job in self.cluster.jobs.values():
+            c = pg_counts.setdefault(job.queue, [0, 0, 0, 0])
+            ph = job.pod_group_phase
+            if ph == PodGroupPhase.INQUEUE:
+                c[0] += 1
+            elif ph == PodGroupPhase.PENDING:
+                c[1] += 1
+            elif ph == PodGroupPhase.RUNNING:
+                c[2] += 1
+            else:
+                c[3] += 1
+        for qi, name in enumerate(maps.queue_names):
+            des_row = (deserved[qi] if deserved is not None
+                       else np.full(len(dims), np.inf))
+            finite = np.isfinite(des_row) & (des_row > 0)
+            share = float(np.max(np.where(
+                finite, q_alloc[qi] / np.maximum(des_row, 1e-9), 0.0)))
+            overused = bool(np.any(q_alloc[qi] > des_row + 1e-6))
+            pg = pg_counts.get(name, [0, 0, 0, 0])
+            METRICS.update_queue_family(
+                name,
+                allocated_milli_cpu=dim(q_alloc[qi], ci_cpu),
+                allocated_memory_bytes=dim(q_alloc[qi], ci_mem),
+                request_milli_cpu=dim(q_req[qi], ci_cpu),
+                request_memory_bytes=dim(q_req[qi], ci_mem),
+                deserved_milli_cpu=(dim(des_row, ci_cpu)
+                                    if deserved is not None
+                                    and np.isfinite(des_row).all() else 0.0),
+                deserved_memory_bytes=(dim(des_row, ci_mem)
+                                       if deserved is not None
+                                       and np.isfinite(des_row).all()
+                                       else 0.0),
+                share=share, weight=float(q_weight[qi]),
+                overused=overused,
+                pg_inqueue=pg[0], pg_pending=pg[1],
+                pg_running=pg[2], pg_unknown=pg[3])
+        # namespace share/weight (namespace.go:28-63): weighted dominant
+        # share of member jobs' allocations — plain numpy (no device
+        # dispatch on the session-close path)
+        jns = np.asarray(snap.jobs.namespace)
+        jvalid = np.asarray(snap.jobs.valid)
+        nsw = np.asarray(snap.namespace_weight)
+        j_alloc = np.where(jvalid[:, None],
+                           np.asarray(snap.jobs.allocated), 0.0)
+        S = nsw.shape[0]
+        ns_alloc = np.zeros((S, j_alloc.shape[1]))
+        np.add.at(ns_alloc, np.clip(jns, 0, S - 1), j_alloc)
+        total = np.asarray(snap.cluster_capacity)
+        frac = np.where(total > 0, ns_alloc / np.maximum(total, 1e-6), 0.0)
+        share_raw = frac.max(axis=-1)
+        for si, name in enumerate(maps.namespace_names):
+            METRICS.update_namespace_family(
+                name, float(share_raw[si]), float(nsw[si]))

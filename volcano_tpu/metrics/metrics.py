@@ -1,19 +1,37 @@
 """Metric registry with Prometheus text exposition.
 
-Reference metric names (pkg/scheduler/metrics/metrics.go:38-202):
-e2e_scheduling_latency_milliseconds, action_scheduling_latency_microseconds,
-plugin_scheduling_latency_microseconds, task_scheduling_latency_milliseconds,
-schedule_attempts_total, preemption_victims, unschedule_task_count; queue
-gauges in queue.go:28-284.
+Reference metric families (pkg/scheduler/metrics/):
+- metrics.go:38-202 — e2e_scheduling_latency_milliseconds,
+  action/plugin_scheduling_latency_microseconds,
+  task_scheduling_latency_milliseconds, schedule_attempts_total,
+  preemption_victims, unschedule_task_count;
+- queue.go:28-284 — per-queue allocated/request/deserved (milli_cpu +
+  memory_bytes), share, weight, overused, pod-group phase counts;
+- namespace.go:28-63 — namespace share/weight/weighted_share.
+
+Histograms expose full cumulative bucket series (le labels + +Inf) so
+reference-style latency quantile dashboards work against /metrics.
 """
 
 from __future__ import annotations
 
 import threading
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Mapping, Tuple, Union
 
 _BUCKETS_MS = [5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000]
+
+LabelsT = Union[str, Mapping[str, str], None]
+
+
+def _label_str(labels: LabelsT, default_key: str = "queue") -> str:
+    """Canonical `k="v",...` body (sorted) for a label set; a bare string
+    keeps the historical queue-label shorthand."""
+    if labels is None:
+        return ""
+    if isinstance(labels, str):
+        return f'{default_key}="{labels}"'
+    return ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
 
 
 class Histogram:
@@ -35,26 +53,34 @@ class Histogram:
     def mean(self) -> float:
         return self.total / self.n if self.n else 0.0
 
+    def cumulative(self) -> List[int]:
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
 
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
         self.counters: Dict[str, float] = defaultdict(float)
         self.gauges: Dict[Tuple[str, str], float] = {}
-        self.histograms: Dict[str, Histogram] = {}
+        self.histograms: Dict[Tuple[str, str], Histogram] = {}
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self.counters[name] += value
 
-    def set_gauge(self, name: str, label: str, value: float) -> None:
+    def set_gauge(self, name: str, labels: LabelsT, value: float) -> None:
         with self._lock:
-            self.gauges[(name, label)] = value
+            self.gauges[(name, _label_str(labels))] = value
 
-    def _hist(self, name: str) -> Histogram:
-        if name not in self.histograms:
-            self.histograms[name] = Histogram(_BUCKETS_MS)
-        return self.histograms[name]
+    def _hist(self, name: str, labels: LabelsT = None) -> Histogram:
+        key = (name, _label_str(labels))
+        if key not in self.histograms:
+            self.histograms[key] = Histogram(_BUCKETS_MS)
+        return self.histograms[key]
 
     def observe_cycle(self, seconds: float) -> None:
         """volcano_e2e_scheduling_latency_milliseconds (metrics.go:38-45)."""
@@ -65,34 +91,87 @@ class Metrics:
     def observe_action(self, action: str, seconds: float) -> None:
         """volcano_action_scheduling_latency_microseconds (metrics.go:74-81)."""
         with self._lock:
-            self._hist(f"action_scheduling_latency_microseconds"
-                       f'{{action="{action}"}}').observe(seconds * 1e6)
+            self._hist("action_scheduling_latency_microseconds",
+                       {"action": action}).observe(seconds * 1e6)
 
     def observe_plugin(self, plugin: str, event: str, seconds: float) -> None:
+        """volcano_plugin_scheduling_latency_microseconds (metrics.go:65-72,
+        recorded around OnSessionOpen/Close, framework.go:47-60)."""
         with self._lock:
-            self._hist(f'plugin_scheduling_latency_microseconds'
-                       f'{{plugin="{plugin}",event="{event}"}}').observe(
-                seconds * 1e6)
+            self._hist("plugin_scheduling_latency_microseconds",
+                       {"plugin": plugin, "event": event}).observe(
+                           seconds * 1e6)
+
+    def observe_task_latency(self, seconds: float) -> None:
+        """volcano_task_scheduling_latency_milliseconds (metrics.go:83-90)."""
+        with self._lock:
+            self._hist("task_scheduling_latency_milliseconds").observe(
+                seconds * 1000)
+
+    # ------------------------------------------------- gauge families
+    def update_queue_family(self, queue: str, *, allocated_milli_cpu: float,
+                            allocated_memory_bytes: float,
+                            request_milli_cpu: float,
+                            request_memory_bytes: float,
+                            deserved_milli_cpu: float,
+                            deserved_memory_bytes: float,
+                            share: float, weight: float,
+                            overused: bool,
+                            pg_inqueue: int, pg_pending: int,
+                            pg_running: int, pg_unknown: int) -> None:
+        """The queue.go:28-284 gauge families for one queue."""
+        g = self.set_gauge
+        g("queue_allocated_milli_cpu", queue, allocated_milli_cpu)
+        g("queue_allocated_memory_bytes", queue, allocated_memory_bytes)
+        g("queue_request_milli_cpu", queue, request_milli_cpu)
+        g("queue_request_memory_bytes", queue, request_memory_bytes)
+        g("queue_deserved_milli_cpu", queue, deserved_milli_cpu)
+        g("queue_deserved_memory_bytes", queue, deserved_memory_bytes)
+        g("queue_share", queue, share)
+        g("queue_weight", queue, weight)
+        g("queue_overused", queue, 1.0 if overused else 0.0)
+        g("queue_pod_group_inqueue_count", queue, pg_inqueue)
+        g("queue_pod_group_pending_count", queue, pg_pending)
+        g("queue_pod_group_running_count", queue, pg_running)
+        g("queue_pod_group_unknown_count", queue, pg_unknown)
+
+    def update_namespace_family(self, namespace: str, share: float,
+                                weight: float) -> None:
+        """namespace.go:28-63: share, weight, weighted share."""
+        labels = {"namespace_name": namespace}
+        self.set_gauge("namespace_share", labels, share)
+        self.set_gauge("namespace_weight", labels, weight)
+        self.set_gauge("namespace_weighted_share", labels,
+                       share / weight if weight else share)
 
     def update_queue_metrics(self, queue: str, allocated_cpu: float,
                              deserved_cpu: float, share: float) -> None:
-        """queue_allocated/deserved/share gauges (metrics/queue.go:28-284)."""
+        """Back-compat shim over the full family (queue.go:28-284)."""
         self.set_gauge("queue_allocated_milli_cpu", queue, allocated_cpu)
         self.set_gauge("queue_deserved_milli_cpu", queue, deserved_cpu)
         self.set_gauge("queue_share", queue, share)
 
     def exposition(self) -> str:
-        """Prometheus text format (the /metrics endpoint payload)."""
+        """Prometheus text format (the /metrics endpoint payload), with
+        full cumulative histogram bucket series."""
         lines = []
         with self._lock:
             for name, v in sorted(self.counters.items()):
                 lines.append(f"volcano_{name} {v}")
-            for (name, label), v in sorted(self.gauges.items()):
-                lines.append(f'volcano_{name}{{queue="{label}"}} {v}')
-            for name, h in sorted(self.histograms.items()):
-                base = name if "{" in name else name
-                lines.append(f"volcano_{base}_count {h.n}")
-                lines.append(f"volcano_{base}_sum {h.total}")
+            for (name, labels), v in sorted(self.gauges.items()):
+                suffix = f"{{{labels}}}" if labels else ""
+                lines.append(f"volcano_{name}{suffix} {v}")
+            for (name, labels), h in sorted(self.histograms.items()):
+                prefix = f"{labels}," if labels else ""
+                cum = h.cumulative()
+                for b, c in zip(h.buckets, cum):
+                    lines.append(
+                        f'volcano_{name}_bucket{{{prefix}le="{b}"}} {c}')
+                lines.append(
+                    f'volcano_{name}_bucket{{{prefix}le="+Inf"}} {cum[-1]}')
+                suffix = f"{{{labels}}}" if labels else ""
+                lines.append(f"volcano_{name}_count{suffix} {h.n}")
+                lines.append(f"volcano_{name}_sum{suffix} {h.total}")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

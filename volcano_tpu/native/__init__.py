@@ -3,7 +3,7 @@
 Builds ``packer.cc`` into a shared library on first use (g++, no external
 deps) and exposes:
 
-- :func:`pack_wire` — VCS3 buffer -> (SnapshotArrays, dims) via the C++
+- :func:`pack_wire` — VCS4 buffer -> (SnapshotArrays, dims) via the C++
   packer; the fast path for snapshots arriving over the API boundary.
 - :func:`pack_native` — ClusterInfo -> (SnapshotArrays, IndexMaps), i.e.
   serialize + pack_wire; drop-in for :func:`volcano_tpu.arrays.pack`.
@@ -162,7 +162,7 @@ def _np(ptr, shape, dtype):
 
 
 def pack_wire(buf: bytes) -> SnapshotArrays:
-    """Parse a VCS3 buffer into SnapshotArrays using the C++ packer."""
+    """Parse a VCS4 buffer into SnapshotArrays using the C++ packer."""
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native packer unavailable: {_build_error}")

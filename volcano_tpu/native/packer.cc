@@ -1,4 +1,4 @@
-// Native snapshot packer: VCS3 wire buffer -> dense scheduling arrays.
+// Native snapshot packer: VCS4 wire buffer -> dense scheduling arrays.
 //
 // This is the framework's native runtime component: the host-side hot path
 // that turns a serialized cluster snapshot (the payload that crosses the
@@ -9,8 +9,8 @@
 // reference's equivalent moment is SchedulerCache.Snapshot deep-copying the
 // cluster mirror (pkg/scheduler/cache/cache.go:712-811).
 //
-// Wire format VCS3 (little-endian; see volcano_tpu/native/wire.py):
-//   u32 magic 'VCS3' (0x33534356), u32 R, nq, ns, nn, nj, nt
+// Wire format VCS4 (little-endian; see volcano_tpu/native/wire.py):
+//   u32 magic 'VCS4' (0x34534356), u32 R, nq, ns, nn, nj, nt
 //   R   x string            resource dimension names (informational)
 //   nq  x queue record      (sorted by name; per-record, Q is small)
 //   ns  x namespace record  (sorted by name)
@@ -35,7 +35,7 @@
 
 namespace {
 
-constexpr uint32_t kMagic = 0x33534356u;  // "VCS3"
+constexpr uint32_t kMagic = 0x34534356u;  // "VCS4"
 
 // TaskStatus codes (volcano_tpu/api/types.py:14-36; reference
 // pkg/scheduler/api/types.go:29-96).
@@ -234,7 +234,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   std::memset(a, 0, sizeof(*a));
   Reader r{buf, buf + len};
   if (r.U32() != kMagic) {
-    a->error = "bad magic (not a VCS3 buffer)";
+    a->error = "bad magic (not a VCS4 buffer)";
     return 1;
   }
   const uint32_t R = r.U32();
@@ -353,7 +353,7 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   a->n_schedulable = bmalloc(N);
   a->n_valid = bmalloc(N);
   VC_CHECK_ALLOC();
-  // Columnar node section (VCS3): bulk memcpy reads; variable-width sets
+  // Columnar node section (VCS4): bulk memcpy reads; variable-width sets
   // arrive as a count column + one flat array.
   auto SkipStringColumn = [&](uint32_t n) {
     uint32_t blob = r.U32();
@@ -549,6 +549,11 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
   }
   std::vector<int32_t> oflat(3ull * ototal);
   r.I32Vec(oflat.data(), 3 * ototal);
+  // preferred-affinity template split key (VCS4): one i32 signature hash
+  // per task, folded into the template key below so tasks with different
+  // preferred terms never share a score row (arrays/pack.py na_sig analog)
+  std::vector<int32_t> nakey(nt, 0);
+  r.I32Vec(nakey.data(), nt);
   if (!r.ok) {
     a->error = "truncated buffer";
     return 1;
@@ -618,6 +623,8 @@ int vc_pack(const uint8_t* buf, uint64_t len, VCArrays* a) {
       key.push_back(std::numeric_limits<int32_t>::min());
       for (uint32_t o = 0; o < ocnt[i]; ++o)
         key.push_back(oflat[3ull * (ooff[i] + o) + 2]);
+      key.push_back(std::numeric_limits<int32_t>::min());
+      key.push_back(nakey[i]);
       auto it = template_of.find(key);
       int32_t tid;
       if (it == template_of.end()) {

@@ -1,4 +1,4 @@
-"""Pure-Python VCS3 parser: wire buffer -> SnapshotArrays.
+"""Pure-Python VCS4 parser: wire buffer -> SnapshotArrays.
 
 The fallback half of the native packing runtime (packer.cc is the fast
 path): keeps the scheduling sidecar usable on hosts without g++, and acts
@@ -22,7 +22,7 @@ import numpy as np
 from ..arrays.schema import (JobArrays, NodeArrays, QueueArrays,
                              SnapshotArrays, TaskArrays)
 
-MAGIC = 0x33534356  # "VCS3"
+MAGIC = 0x34534356  # "VCS4"
 
 # TaskStatus codes (volcano_tpu/api/types.py; pkg/scheduler/api/types.go:29-96)
 _STATUS_PENDING = 0
@@ -96,19 +96,19 @@ class _Reader:
 
 
 def pack_wire_py(buf: bytes) -> SnapshotArrays:
-    """Parse a VCS3 buffer into SnapshotArrays (pure Python/numpy)."""
+    """Parse a VCS4 buffer into SnapshotArrays (pure Python/numpy)."""
     try:
         return _parse(buf)
     except (struct.error, IndexError, ValueError) as e:
         # columnar reads fail as numpy ValueErrors (short frombuffer,
         # counts/flat mismatches); normalize them all
-        raise ValueError(f"truncated or corrupt VCS3 buffer: {e}") from None
+        raise ValueError(f"truncated or corrupt VCS4 buffer: {e}") from None
 
 
 def _parse(buf: bytes) -> SnapshotArrays:
     r = _Reader(buf)
     if r.u32() != MAGIC:
-        raise ValueError("bad magic (not a VCS3 buffer)")
+        raise ValueError("bad magic (not a VCS4 buffer)")
     R = r.u32()
     nq, ns, nn, nj, nt = (r.u32() for _ in range(5))
     if R == 0 or R > 1024:
@@ -279,6 +279,8 @@ def _parse(buf: bytes) -> SnapshotArrays:
     scounts, sflat = ragged(nt, i32)
     ocounts, oflat = ragged(nt, i32, per=3)
     otrip = oflat.reshape(-1, 3) if len(oflat) else np.zeros((0, 3), i32)
+    # VCS4: per-task preferred-affinity template split key
+    t_nakey = r.i32vec(nt).astype(i32)
 
     K = max(int(scounts.max()) if nt else 0, 1)
     O = max(int(ocounts.max()) if nt else 0, 1)
@@ -302,7 +304,8 @@ def _parse(buf: bytes) -> SnapshotArrays:
     sig = np.concatenate(
         [t_selector[:nt], scounts[:, None].astype(i32),
          t_tol_hash[:nt], t_tol_effect[:nt], t_tol_mode[:nt],
-         ocounts[:, None].astype(i32)], axis=1)
+         ocounts[:, None].astype(i32),
+         t_nakey[:nt, None] if t_nakey.ndim == 1 else t_nakey], axis=1)
     _u, first_idx, inv = np.unique(sig, axis=0, return_index=True,
                                    return_inverse=True)
     rank = np.empty(len(first_idx), i32)
@@ -384,14 +387,14 @@ def _parse(buf: bytes) -> SnapshotArrays:
 
 
 def decode_hierarchy(buf: bytes, job_queue, job_valid):
-    """VCS3 buffer -> HierarchyArrays, parsing only the (early) header and
+    """VCS4 buffer -> HierarchyArrays, parsing only the (early) header and
     queue records. ``job_queue``/``job_valid`` come from the already-decoded
     SnapshotArrays (the job section sits late in the buffer; its queue
     indices are all the tree needs for job leaves)."""
     from ..arrays.hierarchy import build_from_specs
     r = _Reader(buf)
     if r.u32() != MAGIC:
-        raise ValueError("bad magic (not a VCS3 buffer)")
+        raise ValueError("bad magic (not a VCS4 buffer)")
     R = r.u32()
     nq = r.u32()
     for _ in range(4):
@@ -414,3 +417,89 @@ def decode_hierarchy(buf: bytes, job_queue, job_valid):
     jq = np.asarray(job_queue, np.int32)
     jv = np.asarray(job_valid, bool)
     return build_from_specs(specs, Q, jq, jv & (jq >= 0))
+
+
+def decode_extras(buf: bytes, nt: int, nn: int):
+    """VCX1 extras frame -> (affinity_sections, port_volume_sections),
+    the dict shapes framework/host_extras.py appliers consume. Either half
+    is None when its sections are absent. Unknown section tags are skipped
+    (forward compatibility)."""
+    from ..native.wire import (EXTRAS_MAGIC, TAG_NA_GROUPS, TAG_OR_GROUPS,
+                               TAG_PORTS, TAG_VOLUMES)
+    if not buf:
+        return None, None
+    r = _Reader(buf)
+    if r.u32() != EXTRAS_MAGIC:
+        raise ValueError("bad magic (not a VCX1 extras frame)")
+    n_sections = r.u32()
+    aff = None
+    pv = None
+
+    def _aff():
+        nonlocal aff
+        if aff is None:
+            aff = dict(task_or_group=np.full(nt, -1, np.int32),
+                       or_masks=np.zeros((0, nn), bool),
+                       task_na_group=np.full(nt, -1, np.int32),
+                       na_rows=np.zeros((0, nn), np.float32))
+        return aff
+
+    def _pv():
+        nonlocal pv
+        if pv is None:
+            pv = dict(task_ports={}, node_ports={}, n_pending_ports=0,
+                      vol_ok=np.ones(nt, bool),
+                      vol_node=np.full(nt, -1, np.int32))
+        return pv
+
+    def _ragged_dict(rd, count):
+        total = rd.u32()
+        counts = np.frombuffer(rd.buf, "<u4", count, rd.off)
+        rd.off += 4 * count
+        flat = np.frombuffer(rd.buf, "<i4", total, rd.off)
+        rd.off += 4 * total
+        out = {}
+        off = 0
+        for i in range(count):
+            c = int(counts[i])
+            if c:
+                out[i] = flat[off:off + c].tolist()
+            off += c
+        return out
+
+    for _ in range(n_sections):
+        tag = r.u32()
+        ln = r.u32()
+        end = r.off + ln
+        if tag == TAG_OR_GROUPS:
+            g = r.u32()
+            a = _aff()
+            a["task_or_group"] = np.frombuffer(
+                r.buf, "<i4", nt, r.off).astype(np.int32)
+            r.off += 4 * nt
+            a["or_masks"] = np.frombuffer(
+                r.buf, "u1", g * nn, r.off).reshape(g, nn).astype(bool)
+            r.off += g * nn
+        elif tag == TAG_NA_GROUPS:
+            g = r.u32()
+            a = _aff()
+            a["task_na_group"] = np.frombuffer(
+                r.buf, "<i4", nt, r.off).astype(np.int32)
+            r.off += 4 * nt
+            a["na_rows"] = np.frombuffer(
+                r.buf, "<f4", g * nn, r.off).reshape(g, nn).astype(np.float32)
+            r.off += 4 * g * nn
+        elif tag == TAG_PORTS:
+            p = _pv()
+            p["n_pending_ports"] = r.u32()
+            p["task_ports"] = _ragged_dict(r, nt)
+            p["node_ports"] = _ragged_dict(r, nn)
+        elif tag == TAG_VOLUMES:
+            p = _pv()
+            p["vol_ok"] = np.frombuffer(r.buf, "u1", nt, r.off).astype(bool)
+            r.off += nt
+            p["vol_node"] = np.frombuffer(
+                r.buf, "<i4", nt, r.off).astype(np.int32)
+            r.off += 4 * nt
+        r.off = end
+    return aff, pv

@@ -1,4 +1,4 @@
-"""VCS3 binary snapshot wire format — serializer side.
+"""VCS4 binary snapshot wire format — serializer side.
 
 The snapshot payload that crosses the API-layer boundary (SURVEY.md
 section 5.8: cluster state serialized to the scheduling sidecar, decisions
@@ -8,7 +8,7 @@ arrays; the layout keeps every derived encoding decision (resource-dimension
 order, label/taint/toleration hash encodings, queue-hierarchy parent
 pointers) on the producer side so consumers are dumb and fast.
 
-VCS3 is COLUMNAR for the hot sections: the node/job/task data ship as
+VCS4 is COLUMNAR for the hot sections: the node/job/task data ship as
 whole numpy columns (strings as a length-array + one joined blob,
 fixed-width fields as one array each, variable-width hash sets as a
 count-array + one flat array), so serialization is a single python pass
@@ -31,14 +31,21 @@ from typing import List, Tuple
 import numpy as np
 
 from ..api import (GPU_MEMORY_RESOURCE, ClusterInfo, PodGroupPhase,
-                   QueueState)
+                   QueueState, as_node_term)
 from ..arrays import labels as L
 from ..arrays.pack import (_READY_STATUSES, _VALID_ONLY_STATUSES,
                            _toleration_rows, queue_capability_row,
                            queue_parent_depth, resource_dims)
 from ..arrays.schema import IndexMaps
 
-MAGIC = 0x33534356  # "VCS3"
+MAGIC = 0x34534356  # "VCS4"
+EXTRAS_MAGIC = 0x31584356  # "VCX1"
+
+#: extras-frame section tags (serialize_extras / decode side)
+TAG_OR_GROUPS = 1
+TAG_NA_GROUPS = 2
+TAG_PORTS = 3
+TAG_VOLUMES = 4
 
 #: status partitions for the single-pass job counts (job_info.go:560-600),
 #: shared with arrays/pack (the single source) as frozensets for the loop
@@ -91,7 +98,7 @@ def _ragged_column(out: List[bytes], rows: List[list], per: int = 1,
 
 
 def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
-    """ClusterInfo -> (VCS3 buffer, host-side decode maps)."""
+    """ClusterInfo -> (VCS4 buffer, host-side decode maps)."""
     dims = resource_dims(ci)
     R = len(dims)
     maps = IndexMaps(resource_names=dims)
@@ -246,6 +253,8 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     gpu_col: List[float] = []
     sel_rows: List[List[int]] = []
     tol_rows: List[List[int]] = []
+    nakey_col: List[int] = []     # preferred-affinity template split key
+    _nakey_cache: dict = {}
     node_index_get = maps.node_index.get
     task_index = maps.task_index
     gpu_dim = GPU_MEMORY_RESOURCE
@@ -254,7 +263,7 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     fields_of = operator.attrgetter(
         "uid", "resreq.quantities", "status", "priority", "node_name",
         "best_effort", "preemptable", "node_selector", "affinity_required",
-        "tolerations")
+        "tolerations", "affinity_preferred")
     uid_append = t_uids.append
     resreq_append = resreq_rows.append
     status_append = status_col.append
@@ -269,7 +278,8 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     for uid in job_uids:
         for task in ci.jobs[uid].tasks.values():
             (tuid, q, status, prio, node_name, best_effort, preemptable,
-             node_selector, affinity_required, tolerations) = fields_of(task)
+             node_selector, affinity_required, tolerations,
+             affinity_preferred) = fields_of(task)
             uid_append(tuid)
             task_index[tuid] = ti
             resreq_append([q.get(d, 0.0) for d in dims_t])
@@ -282,9 +292,12 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
             if node_selector or affinity_required:
                 required = dict(node_selector)
                 if len(affinity_required) == 1:
-                    required.update(affinity_required[0])
-                # multi-term OR affinity: see arrays/pack.py (the packed
-                # row carries the nodeSelector conjunction only)
+                    lone = as_node_term(affinity_required[0])
+                    if lone.is_pure_labels():
+                        required.update(lone.match_labels)
+                # multi-term OR affinity and expression terms: see
+                # arrays/pack.py (the packed row carries the nodeSelector
+                # conjunction only; the rest rides the VCS4 extras frame)
                 sel_append(sorted(
                     stable_hash(f"{k}={v}") for k, v in required.items()))
             else:
@@ -297,6 +310,20 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
                 tol_append(trow)
             else:
                 tol_append(empty)
+            if affinity_preferred:
+                # preferred terms split predicate templates (their score
+                # rows gather by template id): ship a stable signature
+                # hash for the packer's template key — the hashed analog
+                # of arrays/pack.py's na_sig component
+                sig = tuple(sorted((as_node_term(m).signature(), w)
+                                   for m, w in affinity_preferred))
+                k = _nakey_cache.get(sig)
+                if k is None:
+                    k = stable_hash(repr(sig))
+                    _nakey_cache[sig] = k
+                nakey_col.append(k)
+            else:
+                nakey_col.append(0)
             ti += 1
     t_job = np.repeat(np.arange(nj, dtype="<i4"), job_task_counts)
     t_resreq = np.array(resreq_rows, dtype="<f4").reshape(nt, R)
@@ -311,5 +338,58 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         out.append(arr.tobytes())
     _ragged_column(out, sel_rows)
     _ragged_column(out, tol_rows, per=3)
+    out.append(np.fromiter(nakey_col, dtype="<i4", count=nt).tobytes())
 
     return b"".join(out), maps
+
+
+def serialize_extras(ci: ClusterInfo, maps: IndexMaps, conf=None) -> bytes:
+    """Host-computed session extras -> VCX1 frame (the wire half of
+    framework/host_extras.py). Ships the node-affinity OR-group masks,
+    preferred-score group rows, and port/volume sections so the sidecar's
+    served cycle makes bit-identical decisions to an in-process Session
+    running the same conf — one full-fidelity production path, like the
+    reference's (cache.go:712-811). Returns b"" when the conf needs none
+    of it (the sidecar then runs with neutral extras, exactly as the
+    session would)."""
+    from ..framework.host_extras import (conf_na_weight,
+                                         node_affinity_sections,
+                                         port_volume_sections)
+    w, pred = conf_na_weight(conf)
+    if not (w or pred):
+        return b""
+    nt = len(maps.task_uids)
+    nn = len(maps.node_names)
+    sections: List[bytes] = []
+
+    def add(tag: int, payload: bytes) -> None:
+        sections.append(_u32(tag) + _u32(len(payload)) + payload)
+
+    aff = node_affinity_sections(ci, maps.node_names, maps.task_index,
+                                 w, pred)
+    if aff["or_masks"].shape[0]:
+        add(TAG_OR_GROUPS,
+            _u32(aff["or_masks"].shape[0])
+            + aff["task_or_group"].astype("<i4").tobytes()
+            + aff["or_masks"].astype("u1").tobytes())
+    if aff["na_rows"].shape[0]:
+        add(TAG_NA_GROUPS,
+            _u32(aff["na_rows"].shape[0])
+            + aff["task_na_group"].astype("<i4").tobytes()
+            + aff["na_rows"].astype("<f4").tobytes())
+    if pred:
+        pv = port_volume_sections(ci, maps.node_index, maps.task_index)
+        if pv["task_ports"] or pv["node_ports"]:
+            buf: List[bytes] = [_u32(pv["n_pending_ports"])]
+            tp_rows = [pv["task_ports"].get(ti, []) for ti in range(nt)]
+            np_rows = [pv["node_ports"].get(ni, []) for ni in range(nn)]
+            _ragged_column(buf, tp_rows)
+            _ragged_column(buf, np_rows)
+            add(TAG_PORTS, b"".join(buf))
+        if (not pv["vol_ok"].all()) or (pv["vol_node"] >= 0).any():
+            add(TAG_VOLUMES,
+                pv["vol_ok"].astype("u1").tobytes()
+                + pv["vol_node"].astype("<i4").tobytes())
+    if not sections:
+        return b""
+    return b"".join([_u32(EXTRAS_MAGIC), _u32(len(sections))] + sections)

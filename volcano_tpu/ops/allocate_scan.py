@@ -175,6 +175,10 @@ class AllocateExtras:
     #: at their group's node mask; -1 = no multi-term affinity
     task_or_group: jax.Array      # i32[T]
     or_feasible: jax.Array        # bool[GR, N]
+    #: per-job eviction budget for the preempt path (tdm maxVictims /
+    #: getMaxPodEvictNum, tdm.go:304-340): the kernel stops evicting a
+    #: job's tasks once the budget is spent. INT32_MAX = unbudgeted.
+    job_victim_budget: jax.Array  # i32[J]
 
     @classmethod
     def neutral(cls, snap: SnapshotArrays) -> "AllocateExtras":
@@ -211,6 +215,7 @@ class AllocateExtras:
                 (snap.template_rep.shape[0], N), np.float32),
             task_or_group=np.full(T, -1, np.int32),
             or_feasible=np.ones((1, N), bool),
+            job_victim_budget=np.full(J, 2 ** 31 - 1, np.int32),
         )
 
 

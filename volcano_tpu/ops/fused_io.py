@@ -80,15 +80,16 @@ def make_unfuse(treedef, spec) -> Callable:
 
 
 def make_fused_cycle(cycle_fn, example_tree):
-    """Wrap a cycle over (snap, extras) into fn(fbuf, ibuf, bbuf) with the
-    tree rebuilt on device. Returns (jitted_fn, fuse_inputs)."""
+    """Wrap a cycle over an argument tuple (e.g. (snap, extras) or the
+    sidecar's (snap, hierarchy, base_extras)) into fn(fbuf, ibuf, bbuf)
+    with the tree rebuilt on device. Returns (jitted_fn, fuse_inputs)."""
     treedef, spec = fuse_spec(example_tree)
     unfuse = make_unfuse(treedef, spec)
 
     @jax.jit
     def fn(fbuf, ibuf, bbuf):
-        snap, extras = unfuse(fbuf, ibuf, bbuf)
-        return cycle_fn(snap, extras).packed_decisions()
+        args = unfuse(fbuf, ibuf, bbuf)
+        return cycle_fn(*args).packed_decisions()
 
     return fn, fuse
 

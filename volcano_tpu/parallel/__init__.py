@@ -1,6 +1,8 @@
 """Device-mesh parallelism for the scheduling cycle."""
 
-from .sharding import (make_sharded_allocate, node_sharding_specs,
+from .sharding import (make_sharded_allocate, make_sharded_preempt,
+                       node_sharding_specs,
                        scheduler_mesh)
 
-__all__ = ["make_sharded_allocate", "node_sharding_specs", "scheduler_mesh"]
+__all__ = ["make_sharded_allocate", "make_sharded_preempt",
+           "node_sharding_specs", "scheduler_mesh"]

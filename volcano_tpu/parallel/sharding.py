@@ -83,3 +83,16 @@ def make_sharded_allocate(cfg: AllocateConfig, mesh: Mesh,
     fn = make_allocate_cycle(cfg)
     return jax.jit(fn, in_shardings=(snap_shardings, extras_rep),
                    out_shardings=rep)
+
+
+def make_sharded_preempt(pcfg, mesh: Mesh, snap: SnapshotArrays):
+    """jit the preempt/reclaim cycle with the node axis sharded over
+    ``mesh`` (same layout as make_sharded_allocate: node tensors split,
+    task/job/queue state and extras replicated; the per-round segment-sums
+    and the candidate walk's argmaxes resolve through GSPMD collectives).
+    """
+    from ..ops.preempt import make_preempt_cycle
+    snap_shardings, rep = node_sharding_specs(mesh, snap)
+    fn = make_preempt_cycle(pcfg)
+    return jax.jit(fn, in_shardings=(snap_shardings, None, None, None),
+                   out_shardings=rep)

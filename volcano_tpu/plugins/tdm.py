@@ -160,6 +160,20 @@ class TDMPlugin(Plugin):
             return max(running - min_avail, 0)
         return DEFAULT_POD_EVICT_NUM
 
+    def job_victim_budget(self, ssn) -> np.ndarray:
+        """i32[J]: per-job eviction budget for the preempt path — the
+        maxVictims cap the reference applies INSIDE its tdm Preemptable fn
+        (tdm.go:219-229 -> maxVictims -> getMaxPodEvictNum,
+        tdm.go:304-340), consumed in-kernel so placement-path evictions
+        respect the disruption budget too."""
+        J = np.asarray(ssn.snap.jobs.valid).shape[0]
+        budget = np.full(J, 2 ** 31 - 1, np.int32)
+        for uid, ji in ssn.maps.job_index.items():
+            job = ssn.cluster.jobs.get(uid)
+            if job is not None:
+                budget[ji] = self._max_evict(job)
+        return budget
+
     def victim_tasks(self, ssn) -> np.ndarray:
         """bool[T]: preemptable tasks on closed-window revocable nodes —
         the periodic sweep (tdm.go:232-260), per-job maxVictims batching

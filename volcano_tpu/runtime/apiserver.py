@@ -20,7 +20,7 @@ from ..api.queue_info import QueueInfo
 
 KINDS = ("jobs", "pods", "podgroups", "queues", "nodes", "commands",
          "pvcs", "secrets", "services", "configmaps", "leases",
-         "numatopologies")
+         "numatopologies", "networkpolicies")
 
 
 class APIServer:

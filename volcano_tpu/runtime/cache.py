@@ -1,11 +1,19 @@
 """Scheduler cache: project API-server objects into a ClusterInfo snapshot.
 
 Reference: pkg/scheduler/cache/cache.go:71-917 + event_handlers.go:43-740 —
-the informer-fed mirror whose Snapshot() the session consumes. Here the
-projection is rebuilt from the store each cycle (the store IS the local
-cache; a deep-copy clone per cycle matches the reference's snapshot
-semantics), and bind/evict write back to pods exactly like the
-defaultBinder/defaultEvictor REST calls (cache.go:123-175).
+the informer-fed mirror whose Snapshot() the session consumes. Two paths:
+
+- ``snapshot()`` rebuilds the projection from the stores (the deep-copy
+  Snapshot semantics, cache.go:712-811) — the oracle.
+- ``live_view()`` + ``drain_dirty()`` serve the scheduler's persistent
+  session from a mirror ClusterInfo that watch event handlers patch in
+  place, exactly like AddPod/UpdatePod/DeletePod and friends maintain the
+  reference's cache between cycles (event_handlers.go:43-740). Entity-set
+  or node-gating changes mark the mirror structural, forcing a rebuild —
+  the safe analog of the reference re-listing on informer resync.
+
+bind/evict write back to pods exactly like the defaultBinder/defaultEvictor
+REST calls (cache.go:123-175).
 """
 
 from __future__ import annotations
@@ -33,6 +41,34 @@ _POD_PHASE_TO_STATUS = {
 }
 
 
+def _pod_status(pod: Pod) -> TaskStatus:
+    """Pod phase -> TaskStatus projection (getTaskStatus,
+    event_handlers.go analog used by both snapshot paths)."""
+    status = _POD_PHASE_TO_STATUS.get(pod.phase, TaskStatus.UNKNOWN)
+    if pod.deletion_timestamp and status == TaskStatus.RUNNING:
+        status = TaskStatus.RELEASING
+    if status == TaskStatus.PENDING and pod.node_name:
+        status = TaskStatus.BOUND
+    return status
+
+
+def _project_task(pod: Pod) -> TaskInfo:
+    task = TaskInfo(
+        uid=pod.key, name=pod.name, namespace=pod.namespace,
+        task_role=pod.task_role, resreq=pod.resreq(),
+        status=_pod_status(pod), priority=pod.priority,
+        gpu_index=pod.gpu_index,
+        node_selector=dict(pod.node_selector),
+        tolerations=list(pod.tolerations))
+    task.affinity_required = list(pod.affinity_required)
+    task.affinity_preferred = list(pod.affinity_preferred)
+    task.node_name = pod.node_name
+    return task
+
+
+_ACCOUNTED = (TaskStatus.SUCCEEDED, TaskStatus.FAILED, TaskStatus.UNKNOWN)
+
+
 class SchedulerCache:
     """The scheduler's view of the store, plus the bind/evict seam."""
 
@@ -41,6 +77,18 @@ class SchedulerCache:
         self.binds: List[Tuple[str, str]] = []
         self.evictions: List[str] = []
         self._ensure_default_queue()
+        # ---- incremental mirror state (event_handlers.go analog) ----
+        self._mirror: Optional[ClusterInfo] = None
+        self._shadow_nodes: Dict[str, NodeInfo] = {}  # incl. gated-out
+        self._has_dedicated = False
+        self._needs_rebuild = True
+        self.dirty_jobs: set = set()
+        self.dirty_nodes: set = set()
+        self.structural: bool = True
+        api.watch("pods", self._on_pod)
+        api.watch("podgroups", self._on_podgroup)
+        api.watch("nodes", self._on_node)
+        api.watch("queues", self._on_queue)
 
     def _ensure_default_queue(self) -> None:
         """The cache creates the default queue at startup (cache.go:448-455)."""
@@ -52,10 +100,15 @@ class SchedulerCache:
                 self.api.admission_enabled = True
 
     # ------------------------------------------------------------- snapshot
-    def snapshot(self) -> ClusterInfo:
+    def _project(self) -> Tuple[ClusterInfo, Dict[str, NodeInfo], bool]:
+        """Full projection of the stores: (ci-with-gated-nodes,
+        all-nodes-shadow, has_dedicated)."""
         ci = ClusterInfo()
+        shadow: Dict[str, NodeInfo] = {}
         for node in self.api.stores["nodes"].values():
-            ci.add_node(node.clone())
+            cl = node.clone()
+            shadow[cl.name] = cl
+            ci.add_node(cl)
         for queue in self.api.stores["queues"].values():
             ci.add_queue(queue.clone())
 
@@ -78,23 +131,10 @@ class SchedulerCache:
             job = ci.jobs.get(f"{pod.namespace}/{pg_name}")
             if job is None:
                 continue
-            status = _POD_PHASE_TO_STATUS.get(pod.phase, TaskStatus.UNKNOWN)
-            if pod.deletion_timestamp and status == TaskStatus.RUNNING:
-                status = TaskStatus.RELEASING
-            if status == TaskStatus.PENDING and pod.node_name:
-                status = TaskStatus.BOUND
-            task = TaskInfo(
-                uid=pod.key, name=pod.name, namespace=pod.namespace,
-                task_role=pod.task_role, resreq=pod.resreq(),
-                status=status, priority=pod.priority,
-                gpu_index=pod.gpu_index,
-                node_selector=dict(pod.node_selector),
-                tolerations=list(pod.tolerations))
-            task.node_name = pod.node_name
+            task = _project_task(pod)
             job.add_task(task)
-            if pod.node_name and pod.node_name in ci.nodes and status not in (
-                    TaskStatus.SUCCEEDED, TaskStatus.FAILED,
-                    TaskStatus.UNKNOWN):
+            if pod.node_name and pod.node_name in ci.nodes and \
+                    task.status not in _ACCOUNTED:
                 # forced ingestion: running pods are accounted even if the
                 # node shrank; sync_state below then flags it OutOfSync
                 ci.nodes[pod.node_name].add_task(task, force=True)
@@ -109,14 +149,185 @@ class SchedulerCache:
         for name in list(ci.nodes):
             node = ci.nodes[name]
             node.sync_state()
-            if not node.ready:
+            if not self._gated_in(node, has_dedicated):
                 del ci.nodes[name]
-            elif node.binding_tasks:
-                del ci.nodes[name]
-            elif has_dedicated and \
-                    node.labels.get(DEDICATED_NODE_LABEL) != "true":
-                del ci.nodes[name]
+        return ci, shadow, has_dedicated
+
+    @staticmethod
+    def _gated_in(node: NodeInfo, has_dedicated: bool) -> bool:
+        if not node.ready:
+            return False
+        if node.binding_tasks:
+            return False
+        if has_dedicated and node.labels.get(DEDICATED_NODE_LABEL) != "true":
+            return False
+        return True
+
+    def snapshot(self) -> ClusterInfo:
+        ci, _, _ = self._project()
         return ci
+
+    # ------------------------------------------ incremental mirror (live)
+    def live_view(self) -> ClusterInfo:
+        """The mirror ClusterInfo for a persistent session. Maintained by
+        the watch handlers below; rebuilt from the stores whenever an event
+        the handlers don't patch in place arrives (structural)."""
+        if self._mirror is None or self._needs_rebuild:
+            self._mirror, self._shadow_nodes, self._has_dedicated = \
+                self._project()
+            # the volume-binder seam reads pvcs live (the reference queries
+            # the API at bind time, cache.go:265-272); share the store dict
+            self._mirror.pvcs = self.api.stores["pvcs"]
+            self._needs_rebuild = False
+        return self._mirror
+
+    def drain_dirty(self) -> Tuple[set, set, bool]:
+        dj, dn, st = self.dirty_jobs, self.dirty_nodes, self.structural
+        self.dirty_jobs, self.dirty_nodes = set(), set()
+        self.structural = False
+        return dj, dn, st
+
+    def mark_dirty(self, job_uid: Optional[str] = None,
+                   node_name: Optional[str] = None,
+                   structural: bool = False) -> None:
+        if job_uid is not None:
+            self.dirty_jobs.add(job_uid)
+        if node_name is not None:
+            self.dirty_nodes.add(node_name)
+        if structural:
+            self.structural = True
+            self._needs_rebuild = True
+
+    def _regate(self, name: str) -> None:
+        """Re-evaluate one node's snapshot membership after accounting
+        changed (the OutOfSync half of setNodeState, node_info.go:143-149).
+        A flip is structural: the mirror rebuilds from the stores, keeping
+        packing order identical to a fresh projection."""
+        mirror = self._mirror
+        node = self._shadow_nodes.get(name)
+        if mirror is None or node is None:
+            return
+        node.sync_state()
+        now_in = self._gated_in(node, self._has_dedicated)
+        was_in = name in mirror.nodes
+        if now_in != was_in:
+            # the node SET changed: rebuild the projection in store order
+            # (structural also forces the scheduler onto a fresh Session)
+            self.mark_dirty(structural=True)
+
+    def _on_pod(self, event: str, pod: Pod, old) -> None:
+        if self._mirror is None or self._needs_rebuild:
+            return                      # next live_view rebuilds anyway
+        if pod.scheduler_name != DEFAULT_SCHEDULER_NAME or not pod.pod_group:
+            return
+        mirror = self._mirror
+        job = mirror.jobs.get(f"{pod.namespace}/{pod.pod_group}")
+        if job is None:
+            # pod before its podgroup: the rebuild will pick it up once the
+            # group exists (the reference holds it in schedulingQueue)
+            self.mark_dirty(structural=True)
+            return
+        task = job.tasks.get(pod.key)
+        if event == "deleted":
+            if task is not None:
+                node = mirror.nodes.get(task.node_name) \
+                    or self._shadow_nodes.get(task.node_name)
+                if node is not None and task.uid in node.tasks:
+                    node.remove_task(task)
+                    self.mark_dirty(node_name=node.name)
+                    self._regate(node.name)
+                job.delete_task(task)
+                # task-set change: refresh_snapshot repacks from the mirror
+                self.mark_dirty(job_uid=job.uid)
+            return
+        if task is None:                    # added (or update for unseen)
+            task = _project_task(pod)
+            job.add_task(task)
+            if pod.node_name and task.status not in _ACCOUNTED:
+                node = self._shadow_nodes.get(pod.node_name)
+                if node is not None:
+                    node.add_task(task, force=True)
+                    self.mark_dirty(node_name=node.name)
+                    self._regate(node.name)
+            self.mark_dirty(job_uid=job.uid)
+            return
+        # updated: reconcile the mirror task to the pod (updateTask,
+        # event_handlers.go:170-232) — remove old accounting, patch fields,
+        # re-add. add/remove are commutative sums, so the result equals a
+        # fresh projection.
+        old_node = self._shadow_nodes.get(task.node_name)
+        if old_node is not None and task.uid in old_node.tasks:
+            old_node.remove_task(task)
+            self.mark_dirty(node_name=old_node.name)
+        new_req = pod.resreq()
+        if new_req.quantities != task.resreq.quantities:
+            # job sums ride the stored resreq (add_task/update_task_status,
+            # job_info.go:300-420): swap it with the accounting kept exact
+            from ..api.types import is_allocated_status
+            job.total_request.sub_floored(task.resreq)
+            if is_allocated_status(task.status):
+                job.allocated.sub_floored(task.resreq)
+            task.resreq = new_req
+            job.total_request.add(new_req)
+            if is_allocated_status(task.status):
+                job.allocated.add(new_req)
+        task.priority = pod.priority
+        task.gpu_index = pod.gpu_index
+        task.node_selector = dict(pod.node_selector)
+        task.tolerations = list(pod.tolerations)
+        task.affinity_required = list(pod.affinity_required)
+        task.affinity_preferred = list(pod.affinity_preferred)
+        job.update_task_status(task, _pod_status(pod))
+        task.node_name = pod.node_name
+        if pod.node_name and task.status not in _ACCOUNTED:
+            node = self._shadow_nodes.get(pod.node_name)
+            if node is not None:
+                node.add_task(task, force=True)
+                self.mark_dirty(node_name=node.name)
+        self.mark_dirty(job_uid=job.uid)
+        if old_node is not None:
+            self._regate(old_node.name)
+        if pod.node_name and (old_node is None
+                              or pod.node_name != old_node.name):
+            self._regate(pod.node_name)
+
+    def _on_podgroup(self, event: str, pg: PodGroup, old) -> None:
+        if self._mirror is None or self._needs_rebuild:
+            return
+        mirror = self._mirror
+        if event == "added":
+            # new job: entity-set change -> session repack; membership of
+            # already-stored pods needs the full projection order
+            self.mark_dirty(structural=True)
+            return
+        job = mirror.jobs.get(pg.key)
+        if job is None:
+            self.mark_dirty(structural=True)
+            return
+        if event == "deleted":
+            self.mark_dirty(structural=True)
+            return
+        job.queue = pg.queue or DEFAULT_QUEUE
+        job.min_available = pg.min_member
+        job.min_resources = pg.min_resources_res()
+        job.pod_group_phase = pg.phase
+        self.mark_dirty(job_uid=job.uid)
+
+    def _on_node(self, event: str, node: NodeInfo, old) -> None:
+        # node spec changes are rare and interact with gating + dedicated
+        # mode: rebuild (the reference's informer hands whole NodeInfo
+        # updates to SetNode similarly, event_handlers.go:430-470)
+        self.mark_dirty(structural=True)
+
+    def _on_queue(self, event: str, queue: QueueInfo, old) -> None:
+        if self._mirror is None or self._needs_rebuild:
+            return
+        if event == "updated" and queue.name in self._mirror.queues:
+            # refresh_snapshot re-encodes every queue row each cycle; the
+            # mirror object just needs the new spec
+            self._mirror.queues[queue.name] = queue.clone()
+            return
+        self.mark_dirty(structural=True)
 
     # ----------------------------------------------------------- bind/evict
     def bind(self, intent: BindIntent) -> bool:
@@ -152,6 +363,36 @@ class SchedulerCache:
         self.api.delete("pods", pod.key)
         self.evictions.append(intent.task_uid)
         return True
+
+    def hold_binding(self, intent: BindIntent) -> None:
+        """Failed bind dispatch: the mirror task keeps its Binding state
+        (the session's UpdateTaskStatus persisting until syncTask,
+        cache.go:549-560) — with the persistent session that state is
+        already in the mirror, so nothing to do; a rebuilt mirror re-reads
+        the store where the pod is still unplaced, which is the
+        re-decide-after-resync behavior."""
+
+    def resync_task(self, task_uid: str) -> None:
+        """Give-up resync (syncTask discovering the pod never bound,
+        cache.go:690-709): reset the mirror task to Pending off-node so the
+        next cycle re-decides it."""
+        if self._mirror is None:
+            return
+        for job in self._mirror.jobs.values():
+            task = job.tasks.get(task_uid)
+            if task is None:
+                continue
+            if task.status == TaskStatus.BINDING:
+                node = self._shadow_nodes.get(task.node_name)
+                if node is not None and task.uid in node.tasks:
+                    node.remove_task(task)
+                    self.mark_dirty(node_name=node.name)
+                    self._regate(node.name)
+                task.node_name = ""
+                task.gpu_index = -1
+                job.update_task_status(task, TaskStatus.PENDING)
+                self.mark_dirty(job_uid=job.uid)
+            return
 
     # ------------------------------------------------- status write-back
     def update_podgroup_phases(self, phase_updates: Dict[str, object]) -> None:

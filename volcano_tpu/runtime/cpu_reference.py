@@ -550,3 +550,344 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 job_ready=job_ready,
                 job_pipelined=job_pipelined, idle=idle,
                 queue_allocated=queue_allocated)
+
+
+def preempt_cpu(snap: SnapshotArrays, extras: AllocateExtras,
+                victim_veto, skip_tasks=None, pcfg=None
+                ) -> Dict[str, np.ndarray]:
+    """Sequential CPU reference of the preempt/reclaim pass.
+
+    Independent loop-structured mirror of the reference's preempt action
+    (pkg/scheduler/actions/preempt/preempt.go:42-291: pop starving
+    preemptors in job order, PredicateNodes, build the frozen per-node
+    victim set through the tiered Preemptable dispatch
+    (session_plugins.go:131-215), evict lowest-task-priority-first until
+    the preemptor fits FutureIdle, pipeline, commit/discard per gang) and
+    of reclaim.go:40-191 (mode="reclaim"). Decision oracle for
+    ops.preempt.make_preempt_cycle: victim set, pipelined placements, and
+    gang outcomes must be bit-identical. Shares recompute per eviction
+    exactly like the kernel's carried f32 state (AllocateFunc/
+    DeallocateFunc, drf.go:511-561, proportion.go:281-325).
+    """
+    from ..ops.fairshare import hdrf_level_keys
+    from ..ops.preempt import PreemptConfig
+    from ..api.types import TaskStatus
+
+    if pcfg is None:
+        pcfg = PreemptConfig()
+    reclaim = pcfg.mode == "reclaim"
+    intra = pcfg.mode == "preempt_intra"
+    use_budget = "tdm" in [r for tier in pcfg.tiers for r in tier]
+    cfg = pcfg.scoring
+
+    nodes, tasks, jobs, queues = snap.nodes, snap.tasks, snap.jobs, snap.queues
+    N, R = np.asarray(nodes.idle).shape
+    T = np.asarray(tasks.resreq).shape[0]
+    J, M = np.asarray(jobs.task_table).shape
+    S = np.asarray(snap.namespace_weight).shape[0]
+
+    veto = np.asarray(victim_veto, bool)
+    skip = (np.zeros(T, bool) if skip_tasks is None
+            else np.asarray(skip_tasks, bool))
+    resreq32 = np.asarray(tasks.resreq, np.float32)
+    t_status = np.asarray(tasks.status)
+    t_node0 = np.asarray(tasks.node)
+    t_prio = np.asarray(tasks.priority)
+    t_best_effort = np.asarray(tasks.best_effort)
+    t_valid = np.asarray(tasks.valid)
+    t_preempt = np.asarray(tasks.preemptable)
+    t_template = np.asarray(tasks.template)
+    t_gpu_req = np.asarray(tasks.gpu_request, np.float64)
+    t_selector = np.asarray(tasks.selector)
+    t_tol_hash = np.asarray(tasks.tol_hash)
+    t_tol_effect = np.asarray(tasks.tol_effect)
+    t_tol_mode = np.asarray(tasks.tol_mode)
+    tjob = np.asarray(tasks.job)
+    vjob = np.maximum(tjob, 0)
+    jqueue = np.asarray(jobs.queue)
+    jns = np.asarray(jobs.namespace)
+    jprio = np.asarray(jobs.priority)
+    jrank = np.asarray(jobs.creation_rank)
+    jvalid = np.asarray(jobs.valid)
+    jmin = np.asarray(jobs.min_available)
+    jready0 = np.asarray(jobs.ready_num)
+    jnpend = np.asarray(jobs.n_pending)
+    jsched = np.asarray(jobs.schedulable)
+    jpreempt = np.asarray(jobs.preemptable)
+    jreq32 = np.asarray(jobs.total_request, np.float32)
+    table = np.asarray(jobs.task_table)
+    vqueue = jqueue[vjob]
+    vprio = jprio[vjob]
+    vns = jns[vjob]
+    total_cap = np.asarray(snap.cluster_capacity, np.float32)
+    queue_deserved = np.asarray(extras.queue_deserved)
+    vdes = queue_deserved[vqueue]
+    q_reclaimable = np.asarray(queues.reclaimable)
+    vreclaimable = q_reclaimable[vqueue]
+    vrevocable = np.asarray(extras.revocable_node)[np.maximum(t_node0, 0)]
+    ns_weight = np.asarray(snap.namespace_weight, np.float32)
+    task_or_group = np.asarray(extras.task_or_group)
+    or_feasible = np.asarray(extras.or_feasible)
+    nodes_np = _as_np(nodes)
+
+    def share32(alloc):
+        """f32 dominant share (ops.fairshare.dominant_share formula)."""
+        a = np.asarray(alloc, np.float32)
+        frac = np.where(total_cap > 0,
+                        a / np.maximum(total_cap, np.float32(1e-6)),
+                        np.float32(0.0)).astype(np.float32)
+        return frac.max(axis=-1)
+
+    running = ((t_status == int(TaskStatus.RUNNING)) & t_valid
+               & (t_node0 >= 0) & ~t_best_effort)
+    waiting0 = np.zeros(J, np.int64)
+    np.add.at(waiting0, vjob[(t_status == int(TaskStatus.PIPELINED))], 1)
+
+    q_alloc0 = np.asarray(queues.allocated, np.float32)
+    qshare = np.max(
+        np.where(np.isfinite(queue_deserved) & (queue_deserved > 0),
+                 q_alloc0 / np.maximum(queue_deserved, 1e-9), 0.0), axis=-1)
+    overused = np.any(q_alloc0 > queue_deserved + 1e-6, axis=-1)
+
+    if reclaim:
+        starving = jvalid & jsched & (jnpend > 0) & ~overused[jqueue]
+    else:
+        starving = (jvalid & jsched
+                    & (jready0 + waiting0 < jmin) & (jnpend > 0))
+        if pcfg.tdm_starving:
+            starving = starving & ~jpreempt
+
+    future0 = np.asarray(snap.nodes.future_idle(), np.float32)
+
+    # live f32 state, kernel-order accumulation
+    extra_idle = np.zeros((N, R), np.float32)
+    pipe_extra = np.zeros((N, R), np.float32)
+    evicted = np.zeros(T, bool)
+    task_node = np.full(T, -1, np.int64)
+    task_mode = np.zeros(T, np.int64)
+    job_done = np.zeros(J, bool)
+    job_pipelined = np.zeros(J, bool)
+    job_alloc_dyn = np.asarray(jobs.allocated, np.float32).copy()
+    queue_alloc_dyn = q_alloc0.copy()
+    ns_alloc_dyn = np.zeros((S, R), np.float32)
+    for ji in range(J):
+        if jvalid[ji] and 0 <= jns[ji] < S:
+            ns_alloc_dyn[jns[ji]] += job_alloc_dyn[ji].astype(np.float32)
+    # tdm disruption budget (maxVictims, tdm.go:219-229 + 304-340)
+    budget_left = np.asarray(extras.job_victim_budget, np.int64).copy()
+
+    extras_ns_share = np.asarray(extras.ns_share)
+    extras_q_extra = np.asarray(extras.queue_share_extra)
+    extras_job_share = np.asarray(extras.job_share)
+
+    def victim_rule(name, t, ji):
+        if name == "priority" and intra:
+            return t_prio < t_prio[t]
+        if name in ("priority", "gang"):
+            return vprio < jprio[ji]
+        if name == "conformance":
+            return ~veto
+        if name == "tdm":
+            if t_preempt[t]:
+                return np.zeros(T, bool)
+            return t_preempt & ~vrevocable
+        if name == "drf":
+            ls = share32(job_alloc_dyn[ji] + resreq32[t])
+            rs = share32(job_alloc_dyn[vjob] - resreq32)
+            job_rule = (ls < rs) | (np.abs(ls - rs) <= _DELTA_PREEMPT)
+            if not cfg.drf_ns_order:
+                return job_rule
+            nsw = np.maximum(ns_weight, np.float32(1.0))
+            p_ns = jns[ji]
+            lns = share32(ns_alloc_dyn[p_ns] + resreq32[t]) / nsw[p_ns]
+            rns = share32(ns_alloc_dyn[vns] - resreq32) / nsw[vns]
+            same_ns = vns == p_ns
+            return np.where(same_ns, job_rule,
+                            (lns < rns) | (((lns - rns) <= _DELTA_PREEMPT)
+                                           & job_rule))
+        if name == "proportion":
+            q_alloc = queue_alloc_dyn[vqueue]
+            after = q_alloc - resreq32
+            has = ~np.all(q_alloc < resreq32, axis=-1)
+            covered = np.all(
+                np.where(np.isfinite(vdes), vdes <= after + 1e-6, True),
+                axis=-1)
+            return has & covered
+        raise ValueError(f"unknown victim rule {name!r}")
+
+    def hdrf_rule(t, ji, pre):
+        K = min(64, T)
+        base_alloc = job_alloc_dyn.copy()
+        base_alloc[ji] += resreq32[t]
+        lq = jqueue[ji]
+        order = np.argsort(np.where(pre, t_prio.astype(np.float32), np.inf),
+                           kind="stable")
+        idx = order[:K]
+        ok = np.zeros(T, bool)
+        for v in idx:
+            if not pre[v]:
+                continue
+            alloc_v = base_alloc.copy()
+            alloc_v[tjob[v]] -= resreq32[v]
+            keys = np.asarray(hdrf_level_keys(
+                extras.hierarchy, alloc_v, jreq32, jvalid, total_cap))
+            kl, kr = keys[lq], keys[jqueue[v]]
+            neq = kl != kr
+            if neq.any():
+                first = int(np.argmax(neq))
+                ok[v] = kl[first] < kr[first]
+        return ok
+
+    def victim_tier_masks(t, ji):
+        vbase = running & ~evicted
+        if reclaim:
+            vbase = vbase & (vqueue != jqueue[ji]) & vreclaimable
+        elif intra:
+            vbase = vbase & (tjob == ji)
+        else:
+            vbase = vbase & (vqueue == jqueue[ji]) & (tjob != ji)
+        if not any(len(tier) for tier in pcfg.tiers):
+            return [np.zeros(T, bool)]
+        out = []
+        for tier in pcfg.tiers:
+            if not tier:
+                continue
+            m = vbase.copy()
+            for name in tier:
+                if name == "drf_hdrf":
+                    continue
+                m = m & victim_rule(name, t, ji)
+            if "drf_hdrf" in tier:
+                m = hdrf_rule(t, ji, m)
+            out.append(m)
+        return out
+
+    rounds = 0
+    while rounds < J:
+        elig = starving & ~job_done
+        if not elig.any():
+            break
+        key_rows = [extras_ns_share[jns], jns.astype(np.float32),
+                    (qshare[jqueue] + extras_q_extra[jqueue])]
+        if pcfg.enable_hdrf:
+            hcols = np.asarray(hdrf_level_keys(
+                extras.hierarchy, job_alloc_dyn, jreq32, jvalid, total_cap))
+            key_rows += [hcols[jqueue, c] for c in range(hcols.shape[1])]
+        key_rows += [jqueue.astype(np.float32), -jprio.astype(np.float32),
+                     extras_job_share, jrank.astype(np.float32)]
+        keys = np.stack(key_rows)
+        ji = -1
+        best = None
+        for j in range(J):
+            if not elig[j]:
+                continue
+            k = tuple(keys[:, j])
+            if best is None or k < best:
+                best, ji = k, j
+        rounds += 1
+
+        saved = (extra_idle.copy(), pipe_extra.copy(), evicted.copy(),
+                 task_node.copy(), task_mode.copy(), job_alloc_dyn.copy(),
+                 queue_alloc_dyn.copy(), ns_alloc_dyn.copy(),
+                 budget_left.copy())
+        n_pipe = 0
+        broke = False
+        for t_idx in table[ji]:
+            if t_idx < 0 or t_best_effort[t_idx] or skip[t_idx]:
+                continue
+            if intra and broke:
+                continue
+            if not reclaim and not intra:
+                if jready0[ji] + waiting0[ji] + n_pipe >= jmin[ji]:
+                    break          # no longer starving (preempt.go:99-101)
+            t = int(t_idx)
+            resreq = resreq32[t]
+            avail = future0 + extra_idle - pipe_extra
+            base = _feasible_one(
+                nodes_np, np.zeros(R), t_selector[t], t_tol_hash[t],
+                t_tol_effect[t], t_tol_mode[t],
+                future0 + extra_idle, 0, gpu_req=float(t_gpu_req[t]))
+            g = task_or_group[t]
+            if g >= 0:
+                base = base & or_feasible[g][:N]
+            tiers = victim_tier_masks(t, ji)
+            # per-node first-non-empty-tier victim set + evictable sums
+            node_of = t_node0
+            chosen = np.zeros(T, bool)
+            evictable = np.zeros((N, R), np.float32)
+            tier_has = np.zeros((len(tiers), N), bool)
+            for k_t, mask in enumerate(tiers):
+                on = mask & (node_of >= 0)
+                np.logical_or.at(tier_has[k_t], node_of[on], True)
+            first_tier = np.argmax(tier_has, axis=0)
+            has_any = tier_has.any(axis=0)
+            for k_t, mask in enumerate(tiers):
+                sel = mask & (node_of >= 0)
+                sel = sel & has_any[np.maximum(node_of, 0)] \
+                    & (first_tier[np.maximum(node_of, 0)] == k_t)
+                chosen |= sel
+            on = chosen & (node_of >= 0)
+            np.add.at(evictable, node_of[on], resreq32[on])
+            enough = np.all(resreq[None, :] <= avail + evictable + 1e-5,
+                            axis=-1)
+            feas = base & enough
+            if not feas.any():
+                continue
+            score = _score_one(cfg, nodes_np, np.asarray(resreq, np.float64),
+                               np.asarray(snap.nodes.idle, np.float64),
+                               t_tol_hash[t], t_tol_effect[t], t_tol_mode[t])
+            node = int(np.argmax(np.where(feas, score, -np.inf)))
+            # evict lowest task priority first until the preemptor fits
+            k_ev = 0
+            while k_ev < pcfg.max_victims_per_task:
+                if np.all(resreq <= (extra_idle - pipe_extra
+                                     + future0)[node] + 1e-5):
+                    break
+                cand = chosen & ~evicted & (node_of == node)
+                if use_budget:
+                    cand = cand & (budget_left[tjob] > 0)
+                if not cand.any():
+                    break
+                order = np.lexsort((np.arange(T),
+                                    np.where(cand, t_prio, 2 ** 31 - 1)))
+                vt = int(order[0])
+                if not cand[vt]:
+                    break
+                dres = resreq32[vt]
+                extra_idle[node] += dres
+                evicted[vt] = True
+                budget_left[tjob[vt]] -= 1
+                job_alloc_dyn[tjob[vt]] -= dres
+                queue_alloc_dyn[vqueue[vt]] -= dres
+                ns_alloc_dyn[jns[max(tjob[vt], 0)]] -= dres
+                k_ev += 1
+            fits = np.all(resreq <= (extra_idle - pipe_extra
+                                     + future0)[node] + 1e-5)
+            if fits:
+                pipe_extra[node] += resreq
+                job_alloc_dyn[ji] += resreq
+                queue_alloc_dyn[jqueue[ji]] += resreq
+                ns_alloc_dyn[jns[ji]] += resreq
+                task_node[t] = node
+                task_mode[t] = MODE_PIPELINED
+                n_pipe += 1
+            else:
+                broke = True
+
+        pipelined = bool(jready0[ji] + waiting0[ji] + n_pipe >= jmin[ji])
+        keep = True if intra else pipelined
+        if not keep:
+            job_tasks = tjob == ji
+            (extra_idle, pipe_extra, evicted, s_node, s_mode,
+             job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,
+             budget_left) = saved
+            # placements of THIS job's tasks revert; global arrays restore
+            task_node, task_mode = s_node, s_mode
+        job_done[ji] = True
+        job_pipelined[ji] = pipelined
+
+    return dict(task_node=task_node, task_mode=task_mode, evicted=evicted,
+                job_pipelined=job_pipelined, job_attempted=job_done)
+
+
+_DELTA_PREEMPT = np.float32(1e-6)

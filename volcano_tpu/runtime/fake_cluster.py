@@ -25,11 +25,43 @@ class FakeCluster:
         self.bind_failures: Dict[str, str] = {}     # task uid -> error to inject
         self.volume_bind_failures: set = set()      # claim names failing
         #                                             BindVolumes at dispatch
+        # dirty marks for the scheduler's persistent session (the informer
+        # event-handler analog, event_handlers.go:43-740): every mutator
+        # records what it touched; direct ClusterInfo edits must call
+        # mark_dirty (entity ADD/REMOVE is caught structurally by
+        # refresh_snapshot's count checks either way)
+        self.dirty_jobs: set = set()
+        self.dirty_nodes: set = set()
+        self.structural: bool = False
 
     # ------------------------------------------------------------- snapshot
     def snapshot(self) -> ClusterInfo:
         """Deep copy, like SchedulerCache.Snapshot (cache.go:712-811)."""
         return self.ci.clone()
+
+    def live_view(self) -> ClusterInfo:
+        """The authoritative ClusterInfo itself, for a persistent session
+        maintained across cycles by dirty marks + refresh_snapshot (the
+        reference's cache is likewise one live structure patched by event
+        handlers; Snapshot's deep copy exists only for cycle isolation the
+        synchronous loop doesn't need)."""
+        return self.ci
+
+    def mark_dirty(self, job_uid: Optional[str] = None,
+                   node_name: Optional[str] = None,
+                   structural: bool = False) -> None:
+        if job_uid is not None:
+            self.dirty_jobs.add(job_uid)
+        if node_name is not None:
+            self.dirty_nodes.add(node_name)
+        if structural:
+            self.structural = True
+
+    def drain_dirty(self) -> Tuple[set, set, bool]:
+        dj, dn, st = self.dirty_jobs, self.dirty_nodes, self.structural
+        self.dirty_jobs, self.dirty_nodes = set(), set()
+        self.structural = False
+        return dj, dn, st
 
     # ----------------------------------------------------------- bind/evict
     def bind(self, intent: BindIntent) -> bool:
@@ -88,6 +120,10 @@ class FakeCluster:
                 task.node_name = ""
             return False
         self.binds.append((intent.task_uid, intent.node_name))
+        self.dirty_jobs.add(job.uid)
+        self.dirty_nodes.add(node.name)
+        if removed_from is not None and removed_from is not node:
+            self.dirty_nodes.add(removed_from.name)
         return True
 
     def evict(self, intent: EvictIntent) -> bool:
@@ -105,6 +141,9 @@ class FakeCluster:
         task.node_name = ""
         job.update_task_status(task, TaskStatus.PENDING)
         self.evictions.append(intent.task_uid)
+        self.dirty_jobs.add(job.uid)
+        if node is not None:
+            self.dirty_nodes.add(node.name)
         return True
 
     def hold_binding(self, intent: BindIntent) -> None:
@@ -123,9 +162,11 @@ class FakeCluster:
         task.gpu_index = intent.gpu_index
         try:
             node.add_task(task)
+            self.dirty_nodes.add(node.name)
         except ValueError:
             job.update_task_status(task, TaskStatus.PENDING)
             task.gpu_index = -1
+        self.dirty_jobs.add(job.uid)
 
     def resync_task(self, task_uid: str) -> None:
         """Give-up resync: reset a Binding task to Pending off-node — the
@@ -139,16 +180,19 @@ class FakeCluster:
                 node = self.ci.nodes.get(task.node_name)
                 if node is not None and task.uid in node.tasks:
                     node.remove_task(task)
+                    self.dirty_nodes.add(node.name)
                 task.node_name = ""
                 task.gpu_index = -1
                 job.update_task_status(task, TaskStatus.PENDING)
+                self.dirty_jobs.add(job.uid)
             return
 
     def update_podgroup_phases(self, phase_updates) -> None:
         for uid, phase in phase_updates.items():
             job = self.ci.jobs.get(uid)
-            if job is not None:
+            if job is not None and job.pod_group_phase != phase:
                 job.pod_group_phase = phase
+                self.dirty_jobs.add(uid)
 
     # --------------------------------------------------- lifecycle helpers
     def run_task(self, task_uid: str) -> None:
@@ -161,6 +205,8 @@ class FakeCluster:
                     node.remove_task(task)
                     job.update_task_status(task, TaskStatus.RUNNING)
                     node.add_task(task)
+                    self.dirty_nodes.add(node.name)
                 else:
                     job.update_task_status(task, TaskStatus.RUNNING)
+                self.dirty_jobs.add(job.uid)
                 return

@@ -10,7 +10,13 @@ reference needs no such component because its scheduler computes in-process
 host, so the cycle boundary is a wire protocol.
 
 Framing (little-endian):
-    request:  u32 len | VCS3 snapshot buffer (native/wire.py serialize)
+    request:  u32 magic 'VCR1' | u32 main_len | u32 extras_len |
+              VCS4 snapshot buffer
+              (native/wire.py serialize) | optional VCX1 extras frame
+              (native/wire.py serialize_extras — host-computed session
+              extras: node-affinity OR-group masks, preferred score rows,
+              ports, volumes — so the served cycle is bit-identical to an
+              in-process Session on the same conf)
     response: u32 status (0 ok) | u32 len | payload
         ok payload: u32 magic 'VCD1' | u32 T | u32 J |
                     i32[T] task_node | i32[T] task_mode | i32[T] task_gpu |
@@ -33,6 +39,9 @@ import numpy as np
 from ..ops.allocate_scan import MODE_ALLOCATED, AllocateConfig, AllocateExtras
 
 DECISION_MAGIC = 0x31444356  # "VCD1"
+REQUEST_MAGIC = 0x31524356   # "VCR1" — leads every request frame so a
+#                              version-skewed peer fails fast instead of
+#                              blocking on a misread length prefix
 _u32 = struct.Struct("<I")
 
 
@@ -81,8 +90,9 @@ class SchedulerSidecar:
         #: cycle before compute even starts
         self._fused: Dict[tuple, tuple] = {}
 
-    def schedule_buffer(self, buf: bytes) -> bytes:
-        """VCS3 snapshot buffer -> VCD1 decision payload."""
+    def schedule_buffer(self, buf: bytes, extras_buf: bytes = b"") -> bytes:
+        """VCS4 snapshot buffer (+ optional VCX1 extras frame) -> VCD1
+        decision payload."""
         from ..native import available, pack_wire
         if available():
             snap = pack_wire(buf)
@@ -91,18 +101,30 @@ class SchedulerSidecar:
             snap = pack_wire_py(buf)
         T = int(np.asarray(snap.tasks.status).shape[0])
         J = int(np.asarray(snap.jobs.min_available).shape[0])
+        base = AllocateExtras.neutral(snap)
+        if extras_buf:
+            from ..framework.host_extras import (apply_affinity_sections,
+                                                 apply_port_volume_sections)
+            from ..native.pywire import decode_extras
+            nt = int(np.asarray(snap.tasks.valid).sum())
+            nn = int(np.asarray(snap.nodes.valid).sum())
+            aff, pv = decode_extras(extras_buf, nt, nn)
+            if aff is not None:
+                apply_affinity_sections(base, aff, snap, nn)
+            if pv is not None:
+                apply_port_volume_sections(base, pv, snap)
         if self._conf_mode:
             # hdrf tree from the wire's queue annotations (tiny, early in
             # the buffer) — jobs attach via the decoded queue indices
             from ..native.pywire import decode_hierarchy
             second = decode_hierarchy(buf, np.asarray(snap.jobs.queue),
                                       np.asarray(snap.jobs.valid))
+            tree_in = (snap, second, base)
         else:
-            second = AllocateExtras.neutral(snap)
+            tree_in = (snap, base)
         from ..ops.fused_io import fused_cycle_cached
-        fn, fuse = fused_cycle_cached(self._cycle, (snap, second),
-                                      self._fused)
-        packed = np.asarray(fn(*fuse((snap, second))), dtype=np.int32)
+        fn, fuse = fused_cycle_cached(self._cycle, tree_in, self._fused)
+        packed = np.asarray(fn(*fuse(tree_in)), dtype=np.int32)
         task_node = packed[:T]
         task_mode = packed[T:2 * T]
         task_gpu = packed[2 * T:3 * T]
@@ -121,12 +143,21 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         while True:
             try:
-                (n,) = _u32.unpack(_recv_exact(self.request, 4))
+                (magic,) = _u32.unpack(_recv_exact(self.request, 4))
             except ConnectionError:
                 return
+            if magic != REQUEST_MAGIC:
+                # old/foreign framing: reply with an error and drop the
+                # connection rather than misreading lengths and hanging
+                _send_frame(self.request, 1,
+                            b"bad request magic (expected VCR1 framing)")
+                return
             try:
+                (n,) = _u32.unpack(_recv_exact(self.request, 4))
+                (nx,) = _u32.unpack(_recv_exact(self.request, 4))
                 buf = _recv_exact(self.request, n)
-                payload = self.server.sidecar.schedule_buffer(buf)
+                extras = _recv_exact(self.request, nx) if nx else b""
+                payload = self.server.sidecar.schedule_buffer(buf, extras)
                 _send_frame(self.request, 0, payload)
             except ConnectionError:
                 return
@@ -158,16 +189,27 @@ class SidecarClient:
     """The API-layer half: ships ClusterInfo snapshots, maps decisions back
     to task/job uids (the Binder seam's input)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0):
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 conf=None):
+        """``conf`` (YAML text or SchedulerConfiguration) should match the
+        server's --scheduler-conf: the client computes the host extras the
+        conf needs (affinity masks, ports, volumes) and ships them in the
+        VCX1 frame — the API-layer process owns the objects, so it owns
+        the object-walking half of the cycle."""
+        from ..framework.conf import parse_conf
+        self.conf = (parse_conf(conf) if isinstance(conf, str) else conf)
         self.sock = socket.create_connection((host, port), timeout=timeout)
 
     def close(self) -> None:
         self.sock.close()
 
     def schedule(self, ci) -> Dict[str, object]:
-        from ..native.wire import serialize
+        from ..native.wire import serialize, serialize_extras
         buf, maps = serialize(ci)
-        self.sock.sendall(_u32.pack(len(buf)) + buf)
+        extras = (serialize_extras(ci, maps, self.conf)
+                  if self.conf is not None else b"")
+        self.sock.sendall(_u32.pack(REQUEST_MAGIC) + _u32.pack(len(buf))
+                          + _u32.pack(len(extras)) + buf + extras)
         (status,) = _u32.unpack(_recv_exact(self.sock, 4))
         (n,) = _u32.unpack(_recv_exact(self.sock, 4))
         payload = _recv_exact(self.sock, n)

@@ -1,0 +1,37 @@
+"""Version stamping (pkg/version/version.go analog).
+
+The reference stamps GitSHA/Built/Version at link time via ldflags and
+prints them from every binary's --version flag; here the stamp is a module
+constant plus a best-effort git probe, surfaced by ``vcctl version`` and
+the v* shims' --version.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+__version__ = "5.0.0"
+API_VERSION = "v1alpha1"
+
+
+def git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def version_string() -> str:
+    """Multi-line stamp like PrintVersionAndExit (version.go)."""
+    import sys
+    return (f"Version: {__version__}\n"
+            f"GitSHA: {git_sha()}\n"
+            f"API Version: {API_VERSION}\n"
+            f"Python Version: {sys.version.split()[0]}")
