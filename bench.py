@@ -306,16 +306,18 @@ tiers:
     # buffer-in -> decisions-out, the recurring cost of the served cycle
     # (client-side serialization happens in the API-layer process).
     sidecar_ms = None
+    sidecar_steady_ms = None
     if not os.environ.get("BENCH_SKIP_SIDECAR"):
         from volcano_tpu.native import available as _native_ok
+        from volcano_tpu.native.wire import IncrementalWire
         from volcano_tpu.native.wire import serialize as _wire_ser
         from volcano_tpu.runtime.sidecar import SchedulerSidecar
         from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
         if _native_ok():
             from __graft_entry__ import _synthetic_cluster as _synth
-            wire_buf, _wm = _wire_ser(_synth(
-                n_nodes=n_nodes, n_jobs=n_jobs,
-                tasks_per_job=tasks_per_job))
+            sci0 = _synth(n_nodes=n_nodes, n_jobs=n_jobs,
+                          tasks_per_job=tasks_per_job)
+            wire_buf, _wm = _wire_ser(sci0)
             car = SchedulerSidecar(cfg=_AC(**cfg_kwargs))
             car.schedule_buffer(wire_buf)        # warm the jit cache
             times = []
@@ -325,11 +327,70 @@ tiers:
                 times.append(time.time() - t0)
             sidecar_ms = min(times) * 1000
 
+            # steady-state SERVED cycle: the API layer applies the cold
+            # cycle's binds + a 5% gang churn, then each period patches
+            # only the dirty entities into the retained wire buffer
+            # (IncrementalWire, the refresh_snapshot analog at the wire
+            # boundary) and serves the round end-to-end: patch ->
+            # buffer -> pack -> compute -> decisions
+            from volcano_tpu.api import TaskStatus as _TS2
+            inc = IncrementalWire()
+            buf0, wmaps = inc.serialize(sci0)
+            out0 = car.schedule_buffer(buf0)
+            # apply decisions: bind every allocated task (API-layer role)
+            import struct as _st
+            Tn, Jn = _st.unpack("<II", out0[4:12])
+            tnode = np.frombuffer(out0, "<i4", Tn, 12)
+            tmode = np.frombuffer(out0, "<i4", Tn, 12 + 4 * Tn)
+            names2 = wmaps.node_names
+            dirty_j, dirty_n = set(), set()
+            for job in sci0.jobs.values():
+                for uid, task in job.tasks.items():
+                    ti = wmaps.task_index[uid]
+                    if tmode[ti] == 1 and task.status == _TS2.PENDING:
+                        node = sci0.nodes[names2[tnode[ti]]]
+                        job.update_task_status(task, _TS2.BOUND)
+                        task.node_name = node.name
+                        try:
+                            node.add_task(task)
+                        except ValueError:
+                            job.update_task_status(task, _TS2.PENDING)
+                            task.node_name = ""
+                            continue
+                        dirty_j.add(job.uid)
+                        dirty_n.add(node.name)
+            buf1, _ = inc.serialize(sci0, dirty_jobs=dirty_j,
+                                    dirty_nodes=dirty_n)
+            car.schedule_buffer(buf1)   # warm the steady-shape cache
+
+            def wire_churn():
+                dj, dn = set(), set()
+                for uid in list(sci0.jobs)[::20]:        # ~5% of gangs
+                    job = sci0.jobs[uid]
+                    for task in list(job.tasks.values()):
+                        node = sci0.nodes.get(task.node_name)
+                        if node is not None and task.uid in node.tasks:
+                            node.remove_task(task)
+                            dn.add(node.name)
+                        job.update_task_status(task, _TS2.PENDING)
+                        task.node_name = ""
+                    job.allocated = type(job.allocated)({})
+                    dj.add(uid)
+                return dj, dn
+
+            dj, dn = wire_churn()
+            t0 = time.time()
+            bufN, _ = inc.serialize(sci0, dirty_jobs=dj, dirty_nodes=dn)
+            car.schedule_buffer(bufN)
+            sidecar_steady_ms = (time.time() - t0) * 1000
+            assert inc.incremental_serializes >= 2
+
     # ---- DRF multi-queue fair share (BASELINE.json config 3) -------------
     # 8 weighted queues, 50k tasks over 1k nodes (capacity-scarce so the
     # dominant-resource ordering decides who places), drf JobOrderFn with
     # live share recomputation per pop (drf.go:454-472 + 511-536).
     drf_ms = drf_placed = drf_equal_sub = None
+    drf_equal_full = drf_sha = None
     if not (force_cpu or os.environ.get("BENCH_SKIP_DRF")):
         from __graft_entry__ import _synthetic_cluster as _synth
         from volcano_tpu.api import QueueInfo
@@ -350,6 +411,20 @@ tiers:
         dfn = jax.jit(make_allocate_cycle(dcfg))
         dresult, drf_ms, _ = _time_device(dfn, dsnap, dextras, min(reps, 2))
         drf_placed = int(np.asarray(dresult.task_mode > 0).sum())
+        # full-scale equality record (scripts/drf_record.py runs the live
+        # CPU oracle once at this scale), fingerprint-guarded thereafter
+        import hashlib as _hl2
+        drf_sha = _hl2.sha256(
+            np.asarray(dresult.task_node).tobytes()
+            + np.asarray(dresult.task_mode).tobytes()).hexdigest()[:16]
+        rec_dsha = (recorded or {}).get("drf_sha256")
+        drf_equal_full = (True if (rec_dsha is not None
+                                   and rec_dsha == drf_sha
+                                   and (recorded or {}).get(
+                                       "drf_equal_full_scale_verified"))
+                          else None)
+        if rec_dsha is not None:
+            drf_record_stale = rec_dsha != drf_sha
         # sub-scale decision equality for the dynamic-drf ordering path
         sci = _synth(n_nodes=192, n_jobs=192, tasks_per_job=8)
         for q in range(8):
@@ -373,6 +448,9 @@ tiers:
     preempt_invariants_ok = None
     preempt_equal_sub = preempt_equal_full = None
     preempt_sha = None
+    preempt_record_stale = None
+    preempt_adv_record_stale = None
+    drf_record_stale = None
     preempt_adv_ms = preempt_adv_victims = preempt_adv_pipelined = None
     preempt_adv_equal = None
     if not (force_cpu or os.environ.get("BENCH_SKIP_PREEMPT")):
@@ -385,33 +463,15 @@ tiers:
         from volcano_tpu.ops.allocate_scan import MODE_PIPELINED as _MP
         from volcano_tpu import native as _nat2
 
+        # single scenario builder shared with the recorded-oracle scripts
+        # (scripts/preempt_profile.py) so fingerprints stay comparable
+        from scripts.preempt_profile import scenario as _pp_scenario
+
         def _preempt_scenario(n_nodes, n_jobs, n_gangs, gang_tasks=16,
                               min_avail=8):
-            pci = _synth(n_nodes=n_nodes, n_jobs=n_jobs, tasks_per_job=16)
-            pnodes = list(pci.nodes)
-            k = 0
-            for job in pci.jobs.values():
-                job.preemptable = True
-                job.pod_group_phase = PodGroupPhase.RUNNING
-                for t in job.tasks.values():
-                    nn = pnodes[k % len(pnodes)]
-                    k += 1
-                    t.status = TaskStatus.RUNNING
-                    t.node_name = nn
-                    pci.nodes[nn].add_task(t)
-            for j in range(n_gangs):
-                job = JobInfo(f"default/hp-{j:05d}", queue="default",
-                              min_available=min_avail, priority=100,
-                              creation_timestamp=float(j),
-                              pod_group_phase=PodGroupPhase.INQUEUE)
-                for t in range(gang_tasks):
-                    job.add_task(TaskInfo(
-                        uid=f"default/hp-{j:05d}-{t}",
-                        name=f"hp-{j:05d}-{t}",
-                        resreq=Resource.from_resource_list(
-                            {"cpu": "1500m", "memory": "1Gi"})))
-                pci.add_job(job)
-            return pci
+            return _pp_scenario(n_nodes=n_nodes, n_jobs=n_jobs,
+                                n_gangs=n_gangs, gang_tasks=gang_tasks,
+                                min_avail=min_avail)
 
         pcfg = PreemptConfig(scoring=_AC(
             binpack_weight=1.0, least_allocated_weight=0.0,
@@ -472,7 +532,10 @@ tiers:
                 and np.array_equal(np.asarray(pres.task_mode),
                                    pcpu["task_mode"]))
         elif rec_psha is not None:
+            # mismatch = the verified record no longer describes these
+            # decisions: surface the staleness, do not silently skip
             preempt_equal_full = True if rec_psha == preempt_sha else None
+            preempt_record_stale = rec_psha != preempt_sha
 
         # invariants (cross-checking the oracle): victims only from
         # lower-priority jobs; every pipelined-flag gang reached
@@ -514,6 +577,8 @@ tiers:
                     True if (arec.get("decisions_equal")
                              and arec.get("preempt_adv_sha256") == asha)
                     else None)
+                preempt_adv_record_stale = (
+                    arec.get("preempt_adv_sha256") != asha)
             else:
                 preempt_adv_equal = None
 
@@ -596,6 +661,8 @@ tiers:
                           if full_session_ms is not None else None),
         "sidecar_cycle_ms": (round(sidecar_ms, 1)
                              if sidecar_ms is not None else None),
+        "sidecar_steady_ms": (round(sidecar_steady_ms, 1)
+                              if sidecar_steady_ms is not None else None),
         "steady_loop_ms": (round(steady_ms, 1)
                            if steady_ms is not None else None),
         "steady_loop_binds": steady_binds,
@@ -603,6 +670,8 @@ tiers:
         "drf_cycle_ms": (round(drf_ms, 1) if drf_ms is not None else None),
         "drf_placed": drf_placed,
         "drf_decisions_equal_cpu_subscale": drf_equal_sub,
+        "drf_decisions_equal_cpu_full_scale": drf_equal_full,
+        "drf_sha256": drf_sha,
         "preempt_cycle_ms": (round(preempt_ms, 1)
                              if preempt_ms is not None else None),
         "preempt_victims": preempt_victims,
@@ -611,6 +680,9 @@ tiers:
         "preempt_decisions_equal_cpu_subscale": preempt_equal_sub,
         "preempt_decisions_equal_cpu_full_scale": preempt_equal_full,
         "preempt_sha256": preempt_sha,
+        "preempt_record_stale": preempt_record_stale,
+        "preempt_adv_record_stale": preempt_adv_record_stale,
+        "drf_record_stale": drf_record_stale,
         "preempt_adversarial_ms": (round(preempt_adv_ms, 1)
                                    if preempt_adv_ms is not None else None),
         "preempt_adversarial_victims": preempt_adv_victims,

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""One-off: full-scale DRF (config 3) decision-equality record.
+
+Runs the live CPU oracle at the full 8-queue/50k-task scale against the
+kernel's dynamic dominant-resource ordering and stamps
+drf_sha256/drf_cpu_ms into BENCH_BASELINE.json (VERDICT r4 #8); bench.py
+then guards the record by fingerprint every run."""
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    from __graft_entry__ import _synthetic_cluster as _synth
+    from volcano_tpu import native
+    from volcano_tpu.api import QueueInfo
+    from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                               AllocateExtras,
+                                               make_allocate_cycle)
+    from volcano_tpu.runtime.cpu_reference import allocate_cpu
+    dci = _synth(n_nodes=1024, n_jobs=3125, tasks_per_job=16)
+    for q in range(8):
+        dci.add_queue(QueueInfo(f"q{q}", weight=1 + q % 4))
+    for j, job in enumerate(dci.jobs.values()):
+        job.queue = f"q{j % 8}"
+    dsnap, _dm = native.pack_best_effort(dci)
+    dextras = AllocateExtras.neutral(dsnap)
+    dcfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                          balanced_weight=0.0, taint_prefer_weight=0.0,
+                          drf_job_order=True, enable_gpu=False)
+    dfn = jax.jit(make_allocate_cycle(dcfg))
+    res = dfn(dsnap, dextras)
+    tn = np.asarray(res.task_node)
+    t0 = time.time()
+    res = dfn(dsnap, dextras)
+    tn = np.asarray(res.task_node)
+    tm = np.asarray(res.task_mode)
+    tpu_ms = (time.time() - t0) * 1000
+    print(f"kernel: {tpu_ms:.0f}ms placed={int((tm > 0).sum())}",
+          flush=True)
+    t0 = time.time()
+    cpu = allocate_cpu(dsnap, dextras, dcfg)
+    cpu_ms = (time.time() - t0) * 1000
+    equal = bool(np.array_equal(tn, cpu["task_node"])
+                 and np.array_equal(tm, cpu["task_mode"]))
+    sha = hashlib.sha256(tn.tobytes() + tm.tobytes()).hexdigest()[:16]
+    print(f"cpu oracle: {cpu_ms:.0f}ms equal={equal} sha={sha}", flush=True)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_BASELINE.json")
+    rec = json.load(open(path))
+    rec["drf_sha256"] = sha
+    rec["drf_cpu_ms"] = round(cpu_ms, 1)
+    rec["drf_equal_full_scale_verified"] = (
+        time.strftime("%Y-%m-%d") if equal else None)
+    json.dump(rec, open(path, "w"), indent=1)
+    print("record updated")
+
+
+if __name__ == "__main__":
+    main()

@@ -177,3 +177,26 @@ class TestCacheMirror:
                         done = True
             assert_mirror_matches(cache_a)
         assert cache_a.binds == cache_b.binds
+
+    def test_pod_regroup_and_scheduler_flip(self):
+        """A pod whose group annotation moves to another podgroup, or whose
+        schedulerName stops being ours, must re-project — the old job may
+        not keep a stale twin (the _task_owner guard)."""
+        from volcano_tpu.api.core import POD_GROUP_ANNOTATION
+        api = APIServer()
+        cache = SchedulerCache(api)
+        seed(api)
+        cache.live_view()
+        pod = api.get("pods", "default/g0-t0")
+        pod.annotations[POD_GROUP_ANNOTATION] = "g1"
+        api.update("pods", pod)
+        assert_mirror_matches(cache)
+        mirror = cache.live_view()
+        assert "default/g0-t0" in mirror.jobs["default/g1"].tasks
+        assert "default/g0-t0" not in mirror.jobs["default/g0"].tasks
+        pod2 = api.get("pods", "default/g1-t1")
+        pod2.scheduler_name = "other-scheduler"
+        api.update("pods", pod2)
+        assert_mirror_matches(cache)
+        assert "default/g1-t1" not in cache.live_view().jobs[
+            "default/g1"].tasks

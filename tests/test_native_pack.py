@@ -134,3 +134,78 @@ def test_pywire_rejects_garbage():
     with pytest.raises(ValueError):
         buf, _ = serialize(make_cluster())
         pack_wire_py(buf[: len(buf) // 2])
+
+
+class TestIncrementalWire:
+    """IncrementalWire must produce byte-identical buffers to a fresh
+    serialize() across steady-state churn, falling back to the full path
+    on entity-set or task-set changes."""
+
+    def _cluster(self):
+        from fixtures import build_job, build_task, simple_cluster
+        ci = simple_cluster(n_nodes=6, node_cpu="8", node_mem="16Gi")
+        for j in range(8):
+            job = build_job(f"default/j{j}", min_available=2,
+                            creation_timestamp=float(j))
+            for t in range(4):
+                job.add_task(build_task(f"j{j}-t{t}", cpu="1",
+                                        memory="1Gi"))
+            ci.add_job(job)
+        return ci
+
+    def test_patched_buffer_equals_fresh(self):
+        from volcano_tpu.api import TaskStatus
+        from volcano_tpu.native.wire import IncrementalWire, serialize
+        ci = self._cluster()
+        inc = IncrementalWire()
+        buf0, maps0 = inc.serialize(ci)
+        assert buf0 == serialize(ci)[0]
+        # churn: bind a gang, start it running, complete another
+        uids = list(ci.jobs)
+        dirty_jobs, dirty_nodes = set(), set()
+        names = sorted(ci.nodes)
+        for k, task in enumerate(ci.jobs[uids[0]].tasks.values()):
+            node = ci.nodes[names[k % len(names)]]
+            task.status = TaskStatus.BOUND
+            ci.jobs[uids[0]].update_task_status(task, TaskStatus.RUNNING)
+            node.add_task(task)
+            dirty_nodes.add(node.name)
+        dirty_jobs.add(uids[0])
+        for task in ci.jobs[uids[1]].tasks.values():
+            ci.jobs[uids[1]].update_task_status(task, TaskStatus.SUCCEEDED)
+        dirty_jobs.add(uids[1])
+        buf1, _ = inc.serialize(ci, dirty_jobs=dirty_jobs,
+                                dirty_nodes=dirty_nodes)
+        assert inc.incremental_serializes == 1
+        assert buf1 == serialize(ci)[0]
+        # queue spec edit needs no dirty mark (records rebuild wholesale)
+        ci.queues["default"].weight = 7
+        buf2, _ = inc.serialize(ci)
+        assert inc.incremental_serializes == 2
+        assert buf2 == serialize(ci)[0]
+
+    def test_structural_changes_fall_back(self):
+        from fixtures import build_job, build_task
+        from volcano_tpu.native.wire import IncrementalWire, serialize
+        ci = self._cluster()
+        inc = IncrementalWire()
+        inc.serialize(ci)
+        job = build_job("default/new", min_available=1,
+                        creation_timestamp=99.0)
+        job.add_task(build_task("new-t0", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+        buf, _ = inc.serialize(ci, dirty_jobs={"default/new"})
+        assert inc.full_serializes == 2 and inc.incremental_serializes == 0
+        assert buf == serialize(ci)[0]
+
+    def test_task_set_change_falls_back(self):
+        from fixtures import build_task
+        from volcano_tpu.native.wire import IncrementalWire, serialize
+        ci = self._cluster()
+        inc = IncrementalWire()
+        inc.serialize(ci)
+        uid = list(ci.jobs)[2]
+        ci.jobs[uid].add_task(build_task("j2-extra", cpu="1", memory="1Gi"))
+        buf, _ = inc.serialize(ci, dirty_jobs={uid})
+        assert inc.full_serializes == 2
+        assert buf == serialize(ci)[0]

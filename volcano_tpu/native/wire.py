@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import operator
 import struct
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -97,8 +97,41 @@ def _ragged_column(out: List[bytes], rows: List[list], per: int = 1,
     out.append(flat.tobytes())
 
 
-def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
-    """ClusterInfo -> (VCS4 buffer, host-side decode maps)."""
+def _queue_ns_chunks(ci: ClusterInfo, queue_names: List[str],
+                     ns_names: List[str], dims: List[str]) -> List[bytes]:
+    """The queue + namespace record chunks (shared by serialize and the
+    incremental patcher — Q/S are small, so these rebuild every cycle)."""
+    out: List[bytes] = []
+    parents, depths = queue_parent_depth(ci, queue_names)
+    for i, name in enumerate(queue_names):
+        q = ci.queues[name]
+        _s(out, name)
+        out.append(_f32(max(q.weight, 0)))
+        _fvec(out, queue_capability_row(q, dims))
+        out.append(bytes([1 if q.reclaimable else 0,
+                          1 if q.state == QueueState.OPEN else 0]))
+        out.append(_i32(parents[i]))
+        out.append(_i32(depths[i]))
+        hw = q.hierarchy_weight_values()
+        out.append(_f32(hw[-1] if hw else 1.0))
+        # full hdrf annotations: the receiver rebuilds the exact hierarchy
+        # tree (arrays/hierarchy.build_from_specs) from these
+        _s(out, q.hierarchy)
+        _s(out, q.hierarchy_weights)
+    for name in ns_names:
+        _s(out, name)
+        w = ci.namespaces[name].weight if name in ci.namespaces else 1
+        out.append(_f32(max(w, 1)))
+    return out
+
+
+def serialize(ci: ClusterInfo,
+              _capture: Optional[dict] = None) -> Tuple[bytes, IndexMaps]:
+    """ClusterInfo -> (VCS4 buffer, host-side decode maps).
+
+    ``_capture`` (IncrementalWire's hook) receives the chunk list, the
+    dynamic column arrays, and the layout bookkeeping needed to patch
+    later cycles in place."""
     dims = resource_dims(ci)
     R = len(dims)
     maps = IndexMaps(resource_names=dims)
@@ -128,27 +161,9 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         _s(out, d)
 
     # ---- queues (per-record; Q is small) ---------------------------------
-    parents, depths = queue_parent_depth(ci, queue_names)
-    for i, name in enumerate(queue_names):
-        q = ci.queues[name]
-        _s(out, name)
-        out.append(_f32(max(q.weight, 0)))
-        _fvec(out, queue_capability_row(q, dims))
-        out.append(bytes([1 if q.reclaimable else 0,
-                          1 if q.state == QueueState.OPEN else 0]))
-        out.append(_i32(parents[i]))
-        out.append(_i32(depths[i]))
-        hw = q.hierarchy_weight_values()
-        out.append(_f32(hw[-1] if hw else 1.0))
-        # full hdrf annotations: the receiver rebuilds the exact hierarchy
-        # tree (arrays/hierarchy.build_from_specs) from these
-        _s(out, q.hierarchy)
-        _s(out, q.hierarchy_weights)
-
-    for name in ns_names:
-        _s(out, name)
-        w = ci.namespaces[name].weight if name in ci.namespaces else 1
-        out.append(_f32(max(w, 1)))
+    _q_start = len(out)
+    out.extend(_queue_ns_chunks(ci, queue_names, ns_names, dims))
+    _q_end = len(out)     # queue+namespace records: [_q_start, _q_end)
 
     # ---- nodes (columnar) ------------------------------------------------
     res_mats = [np.empty((nn, R), dtype="<f4") for _ in range(6)]
@@ -182,6 +197,7 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
                          L.stable_hash(t.key), L.effect_code(t.effect)))
         taint_rows.append(trow)
     _string_column(out, node_names)
+    _node_dyn_start = len(out)
     for m in res_mats:
         out.append(m.tobytes())
     out.append(pod_count.tobytes())
@@ -233,6 +249,7 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
         j_flags[i, 1] = gang_valid
         j_flags[i, 2] = job.preemptable
     _string_column(out, job_uids)
+    _job_dyn_start = len(out)
     for arr in (j_min, j_queue, j_ns, j_prio, j_ts, j_ready, j_alloc,
                 j_minres, j_flags):
         out.append(arr.tobytes())
@@ -334,12 +351,40 @@ def serialize(ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
     t_gpu = np.fromiter(gpu_col, dtype="<f4", count=nt)
     maps.task_uids = t_uids
     _string_column(out, t_uids)
+    _task_dyn_start = len(out)
     for arr in (t_job, t_resreq, t_status, t_prio, t_node, t_flags, t_gpu):
         out.append(arr.tobytes())
     _ragged_column(out, sel_rows)
     _ragged_column(out, tol_rows, per=3)
     out.append(np.fromiter(nakey_col, dtype="<i4", count=nt).tobytes())
 
+    if _capture is not None:
+        # per-job contiguous task ranges + uid tuples (validity checks)
+        ranges = {}
+        off = 0
+        for i, uid in enumerate(job_uids):
+            cnt = int(job_task_counts[i])
+            ranges[uid] = (off, tuple(t_uids[off:off + cnt]))
+            off += cnt
+        _capture.update(
+            out=out, maps=maps, dims=dims_t,
+            counts=(len(queue_names), len(ns_names), nn, nj, nt),
+            q_range=(_q_start, _q_end),
+            node_dyn_start=_node_dyn_start,
+            job_dyn_start=_job_dyn_start,
+            task_dyn_start=_task_dyn_start,
+            res_mats=res_mats, pod_count=pod_count, max_pods=max_pods,
+            sched=sched,
+            job_cols=dict(j_min=j_min, j_queue=j_queue, j_ns=j_ns,
+                          j_prio=j_prio, j_ts=j_ts, j_ready=j_ready,
+                          j_alloc=j_alloc, j_minres=j_minres,
+                          j_flags=j_flags),
+            task_cols=dict(t_resreq=t_resreq, t_status=t_status,
+                           t_prio=t_prio, t_node=t_node, t_flags=t_flags,
+                           t_gpu=t_gpu),
+            task_ranges=ranges,
+            gpu_nodes={n for n in node_names if ci.nodes[n].gpu_devices},
+        )
     return b"".join(out), maps
 
 
@@ -393,3 +438,150 @@ def serialize_extras(ci: ClusterInfo, maps: IndexMaps, conf=None) -> bytes:
     if not sections:
         return b""
     return b"".join([_u32(EXTRAS_MAGIC), _u32(len(sections))] + sections)
+
+
+class IncrementalWire:
+    """Steady-state wire serializer — refresh_snapshot's analog at the
+    wire boundary (VERDICT r4 #1, the served half).
+
+    First call performs a full :func:`serialize`, capturing the chunk list
+    and the dynamic column arrays; later calls patch only the dirty
+    entities' rows and re-join, so a 5% churn cycle pays tens of
+    milliseconds instead of the full object walk. Exact under the same
+    contract as Session.refresh_snapshot: unchanged entity sets, unchanged
+    per-job task uid lists, and immutable task/node specs (selectors,
+    tolerations, affinity, labels, taints, GPU devices — the job-update
+    webhook's immutability rules); anything else falls back to a full
+    serialize. Produces byte-identical buffers to :func:`serialize`
+    (tests/test_native_pack.py::TestIncrementalWire).
+    """
+
+    _JOB_COL_ORDER = ("j_min", "j_queue", "j_ns", "j_prio", "j_ts",
+                      "j_ready", "j_alloc", "j_minres", "j_flags")
+    _TASK_COL_ORDER = ("t_resreq", "t_status", "t_prio", "t_node",
+                       "t_flags", "t_gpu")
+
+    def __init__(self):
+        self._c: Optional[dict] = None
+        self.full_serializes = 0
+        self.incremental_serializes = 0
+
+    def _full(self, ci: ClusterInfo) -> Tuple[bytes, IndexMaps]:
+        cap: dict = {}
+        buf, maps = serialize(ci, _capture=cap)
+        self._c = cap
+        self.full_serializes += 1
+        return buf, maps
+
+    def serialize(self, ci: ClusterInfo, dirty_jobs=(), dirty_nodes=(),
+                  structural: bool = False) -> Tuple[bytes, IndexMaps]:
+        c = self._c
+        if structural or c is None:
+            return self._full(ci)
+        maps = c["maps"]
+        nq, ns_c, nn, nj, _nt = c["counts"]
+        ns_names = sorted(ci.namespaces) or ["default"]
+        if (len(ci.queues) != nq or len(ci.nodes) != nn
+                or len(ci.jobs) != nj or len(ns_names) != ns_c
+                or ns_names != maps.namespace_names
+                or any(q not in maps.queue_index for q in ci.queues)
+                or any(u not in maps.job_index for u in dirty_jobs)
+                or any(n not in maps.node_index for n in dirty_nodes)):
+            return self._full(ci)
+        out = c["out"]
+        dims_t = c["dims"]
+
+        # queue + namespace records: rebuilt wholesale (small); any length
+        # drift (renames, annotation edits) forces the full path
+        qchunks = _queue_ns_chunks(ci, maps.queue_names, ns_names,
+                                   list(dims_t))
+        qs, qe = c["q_range"]
+        if len(qchunks) != qe - qs or any(
+                len(b) != len(out[qs + i]) for i, b in enumerate(qchunks)):
+            return self._full(ci)
+        for i, b in enumerate(qchunks):
+            out[qs + i] = b
+
+        # ---- dirty node rows --------------------------------------------
+        for name in dirty_nodes:
+            node = ci.nodes.get(name)
+            if node is None:
+                return self._full(ci)
+            if node.gpu_devices or name in c["gpu_nodes"]:
+                return self._full(ci)   # gpu usage lives in a ragged column
+            i = maps.node_index[name]
+            for m, res in zip(c["res_mats"],
+                              (node.idle, node.used, node.releasing,
+                               node.pipelined, node.allocatable,
+                               node.capability)):
+                q = res.quantities
+                m[i] = [q.get(d, 0.0) for d in dims_t]
+            c["pod_count"][i] = node.pod_count()
+            c["max_pods"][i] = node.max_pods
+            c["sched"][i] = 1 if (node.ready
+                                  and not node.unschedulable) else 0
+        if dirty_nodes:
+            nds = c["node_dyn_start"]
+            for k, m in enumerate(c["res_mats"]):
+                out[nds + k] = m.tobytes()
+            out[nds + 6] = c["pod_count"].tobytes()
+            out[nds + 7] = c["max_pods"].tobytes()
+            out[nds + 8] = c["sched"].tobytes()
+
+        # ---- dirty job + task rows --------------------------------------
+        jc = c["job_cols"]
+        tc = c["task_cols"]
+        gpu_dim = GPU_MEMORY_RESOURCE
+        pending_phase = PodGroupPhase.PENDING
+        node_index_get = maps.node_index.get
+        for uid in dirty_jobs:
+            job = ci.jobs.get(uid)
+            if job is None:
+                return self._full(ci)
+            start, uids = c["task_ranges"][uid]
+            if tuple(job.tasks.keys()) != uids:
+                return self._full(ci)   # task-set change: full rebuild
+            i = maps.job_index[uid]
+            jc["j_min"][i] = job.min_available
+            jc["j_queue"][i] = maps.queue_index.get(job.queue, -1)
+            jc["j_ns"][i] = ns_names.index(job.namespace) \
+                if job.namespace in ns_names else 0
+            jc["j_prio"][i] = job.priority
+            jc["j_ts"][i] = job.creation_timestamp
+            ready = valid = 0
+            for st, tasks_of in job.task_status_index.items():
+                n = len(tasks_of)
+                if st in _READY_SET:
+                    ready += n
+                    valid += n
+                elif st in _VALID_ONLY_SET:
+                    valid += n
+            jc["j_ready"][i] = ready
+            q = job.allocated.quantities
+            jc["j_alloc"][i] = [q.get(d, 0.0) for d in dims_t]
+            q = job.min_resources.quantities
+            jc["j_minres"][i] = [q.get(d, 0.0) for d in dims_t]
+            gang_valid = (valid >= job.min_available
+                          and job.check_task_min_available())
+            jc["j_flags"][i, 0] = job.pod_group_phase == pending_phase
+            jc["j_flags"][i, 1] = gang_valid
+            jc["j_flags"][i, 2] = job.preemptable
+            for off, task in enumerate(job.tasks.values()):
+                ti = start + off
+                q = task.resreq.quantities
+                tc["t_resreq"][ti] = [q.get(d, 0.0) for d in dims_t]
+                tc["t_status"][ti] = task.status
+                tc["t_prio"][ti] = task.priority
+                tc["t_node"][ti] = node_index_get(task.node_name, -1)
+                tc["t_flags"][ti, 0] = task.best_effort
+                tc["t_flags"][ti, 1] = task.preemptable
+                tc["t_gpu"][ti] = q.get(gpu_dim, 0.0)
+        if dirty_jobs:
+            jds = c["job_dyn_start"]
+            for k, name in enumerate(self._JOB_COL_ORDER):
+                out[jds + k] = jc[name].tobytes()
+            tds = c["task_dyn_start"]       # +0 is the static t_job column
+            for k, name in enumerate(self._TASK_COL_ORDER):
+                out[tds + 1 + k] = tc[name].tobytes()
+        self.incremental_serializes += 1
+        return b"".join(out), maps

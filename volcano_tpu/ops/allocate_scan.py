@@ -489,6 +489,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
             job_alloc_count=jnp.zeros(J, jnp.int32),
             job_alloc_dyn=jobs.allocated,
             rounds=jnp.int32(0),
+            # True while rounds keep placing: the capacity-give-up check
+            # only runs after a stalled round (zero per-round cost on the
+            # saturating hot path)
+            progressed=jnp.bool_(True),
             # live inter-pod affinity counts (neutral [1,1] when disabled)
             aff_cnt=extras.affinity.cnt0,
             anti_cnt=extras.affinity.anti_cnt0,
@@ -562,8 +566,49 @@ def make_allocate_cycle(cfg: AllocateConfig):
         def cond(st):
             return jnp.any(eligible(st)) & (st["rounds"] < max_rounds)
 
+        # cheapest pending request per job, per dim (static): the give-up
+        # bound below compares it against per-dim capacity maxima
+        _tbl = jnp.maximum(jobs.task_table, 0)
+        _slot_req = tasks.resreq[_tbl]                        # [J, M, R]
+        _slot_ok = (jobs.task_table >= 0)[:, :, None]
+        jobs_min_req = jnp.min(
+            jnp.where(_slot_ok, _slot_req, jnp.inf), axis=1)  # [J, R]
+        node_live = (nodes.valid & nodes.schedulable)
+
+        def hopeless_jobs(st, elig):
+            """bool[J]: eligible jobs whose CHEAPEST pending request exceeds
+            the per-dim maximum of every node's idle AND future idle — no
+            task of theirs can place or pipeline now, and capacity is
+            non-increasing across rounds (a gang discard restores at most a
+            later state), so their eventual pop is guaranteed to fail.
+            Marking them done+popped in one round is decision-identical to
+            paying a round each; the tail of a saturated cycle collapses
+            from O(jobs) rounds to one."""
+            if use_pallas:
+                idle_t = st["idle"]                           # [R, N]
+                fut_t = jnp.maximum(
+                    idle_t + relmp_t - st["pipe_extra"], 0.0)
+                live = node_live[None, :]
+                bound = jnp.max(
+                    jnp.where(live, jnp.maximum(idle_t, fut_t), -jnp.inf),
+                    axis=1)                                   # [R]
+            else:
+                idle_a = st["idle"]                           # [N, R]
+                fut_a = jnp.maximum(
+                    idle_a + nodes.releasing - nodes.pipelined
+                    - st["pipe_extra"], 0.0)
+                live = node_live[:, None]
+                bound = jnp.max(
+                    jnp.where(live, jnp.maximum(idle_a, fut_a), -jnp.inf),
+                    axis=0)                                   # [R]
+            return elig & jnp.any(jobs_min_req > bound + 1e-5, axis=-1)
+
         def body(st):
             elig = eligible(st)
+            give_up = jax.lax.cond(
+                st["progressed"],
+                lambda: jnp.zeros(J, bool),
+                lambda: hopeless_jobs(st, elig))
 
             # ---- job selection: lexicographic pop of ns->queue->job PQs ----
             # Queue share: max over dims of allocated/deserved (proportion
@@ -792,9 +837,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     saved_pe_port=st["saved_pe_port"],
                     saved_pe_cnt=st["saved_pe_cnt"],
                     task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
-                    job_done=st["job_done"].at[jdrop].set(
+                    job_done=(st["job_done"] | give_up).at[jdrop].set(
                         ~stopped_vec, mode="drop"),
-                    job_popped=st["job_popped"].at[jdrop].set(
+                    job_popped=(st["job_popped"] | give_up).at[jdrop].set(
                         jnp.ones(K, bool), mode="drop"),
                     job_ready=st["job_ready"].at[jdrop].set(
                         ready_vec, mode="drop"),
@@ -809,6 +854,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     queue_allocated=st["queue_allocated"].at[qdrop].add(
                         committed, mode="drop"),
                     rounds=st["rounds"] + 1,
+                    progressed=(jnp.any(n_alloc_vec > 0)
+                                | jnp.any(pipelined_vec)
+                                | jnp.any(ready_vec)),
                 )
 
             # ---- scan path: single pop ----------------------------------
@@ -1044,12 +1092,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 saved_pe_cnt=saved_pe_cnt,
                 task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
                 # a yielded (ready, queue non-empty) job is re-pushed; any
-                # other outcome finishes it for the cycle
-                job_done=st["job_done"].at[ji].set(~stopped),
+                # other outcome finishes it for the cycle; capacity-
+                # hopeless jobs batch-finish alongside (give_up)
+                job_done=(st["job_done"] | give_up).at[ji].set(~stopped),
                 # attempted = popped at least once this cycle, even if a
                 # later overused-queue gate or round cap cuts the job off
                 # while job_done is still False (yield re-push pending)
-                job_popped=st["job_popped"].at[ji].set(True),
+                job_popped=(st["job_popped"] | give_up).at[ji].set(True),
                 job_ready=st["job_ready"].at[ji].set(ready),
                 job_pipelined=st["job_pipelined"].at[ji].set(
                     pipelined & ~ready),
@@ -1059,6 +1108,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 job_alloc_dyn=st["job_alloc_dyn"].at[ji].add(committed),
                 queue_allocated=queue_allocated,
                 rounds=st["rounds"] + 1,
+                progressed=(n_alloc > 0) | pipelined | ready,
             )
 
         final = jax.lax.while_loop(cond, body, init)

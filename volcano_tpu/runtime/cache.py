@@ -79,6 +79,7 @@ class SchedulerCache:
         self._ensure_default_queue()
         # ---- incremental mirror state (event_handlers.go analog) ----
         self._mirror: Optional[ClusterInfo] = None
+        self._task_owner: Dict[str, str] = {}
         self._shadow_nodes: Dict[str, NodeInfo] = {}  # incl. gated-out
         self._has_dedicated = False
         self._needs_rebuild = True
@@ -178,6 +179,11 @@ class SchedulerCache:
             # the volume-binder seam reads pvcs live (the reference queries
             # the API at bind time, cache.go:265-272); share the store dict
             self._mirror.pvcs = self.api.stores["pvcs"]
+            # task uid -> owning job key: detects pods whose group (or
+            # scheduler) annotation changed, which must re-project
+            self._task_owner = {
+                uid: job.uid for job in self._mirror.jobs.values()
+                for uid in job.tasks}
             self._needs_rebuild = False
         return self._mirror
 
@@ -218,10 +224,21 @@ class SchedulerCache:
     def _on_pod(self, event: str, pod: Pod, old) -> None:
         if self._mirror is None or self._needs_rebuild:
             return                      # next live_view rebuilds anyway
+        owner = self._task_owner.get(pod.key)
         if pod.scheduler_name != DEFAULT_SCHEDULER_NAME or not pod.pod_group:
+            if owner is not None:
+                # a pod the mirror tracks stopped being ours (scheduler or
+                # group annotation cleared): re-project
+                self.mark_dirty(structural=True)
             return
         mirror = self._mirror
-        job = mirror.jobs.get(f"{pod.namespace}/{pod.pod_group}")
+        key = f"{pod.namespace}/{pod.pod_group}"
+        if owner is not None and owner != key:
+            # the pod moved between groups: the old job still holds it —
+            # only a rebuild removes the stale twin exactly
+            self.mark_dirty(structural=True)
+            return
+        job = mirror.jobs.get(key)
         if job is None:
             # pod before its podgroup: the rebuild will pick it up once the
             # group exists (the reference holds it in schedulingQueue)
@@ -229,6 +246,7 @@ class SchedulerCache:
             return
         task = job.tasks.get(pod.key)
         if event == "deleted":
+            self._task_owner.pop(pod.key, None)
             if task is not None:
                 node = mirror.nodes.get(task.node_name) \
                     or self._shadow_nodes.get(task.node_name)
@@ -243,6 +261,7 @@ class SchedulerCache:
         if task is None:                    # added (or update for unseen)
             task = _project_task(pod)
             job.add_task(task)
+            self._task_owner[pod.key] = job.uid
             if pod.node_name and task.status not in _ACCOUNTED:
                 node = self._shadow_nodes.get(pod.node_name)
                 if node is not None:
