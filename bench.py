@@ -21,16 +21,23 @@ equality at a 1k-node/10k-task sub-scale, reported in the stderr extras.
 Fail-soft contract (VERDICT round 1, item 1): this script exits 0 with one
 valid JSON line in EVERY outcome.  The TPU backend is probed in a
 subprocess with a hard timeout first (a dead axon tunnel can make backend
-init hang, not just raise); if the chip is unreachable the whole
-measurement re-runs on the CPU backend at a reduced scale and the record
-carries "tpu_unavailable": true.  A mid-run TPU failure re-execs into the
-CPU path in a clean process.
+init hang, not just raise) and RETRIED with capped backoff (VERDICT r5
+item 1 — a transient tunnel blip must not blind a whole round's record);
+if the chip stays unreachable the whole measurement re-runs on the CPU
+backend at a reduced scale and the record carries "tpu_unavailable": true.
+A mid-run TPU failure re-execs into the CPU path in a clean process.
+
+Degrade, never skip (VERDICT r5 item 1): under the CPU fallback the
+drf / preempt / affinity configs still run, at sub-scale on the CPU
+backend, labeled with an explicit ``*_backend: "cpu_subscale"`` column —
+no BENCH record ships all-null config columns because the chip was away.
 
 Env knobs: BENCH_NODES, BENCH_JOBS, BENCH_TASKS_PER_JOB, BENCH_REPS,
 BENCH_LIVE_CPU=1 (measure the CPU baseline at full scale instead of using
 BENCH_BASELINE.json), BENCH_SKIP_CHECK=1 (skip the sub-scale equality
 check), BENCH_FORCE_CPU=1 (skip the TPU probe, run the degraded CPU path),
-BENCH_PROBE_TIMEOUT (seconds, default 150).
+BENCH_PROBE_TIMEOUT (seconds, default 150), BENCH_PROBE_RETRIES (default
+3, backoff 5s doubling capped at 60s).
 """
 
 from __future__ import annotations
@@ -391,46 +398,68 @@ tiers:
     # live share recomputation per pop (drf.go:454-472 + 511-536).
     drf_ms = drf_placed = drf_equal_sub = None
     drf_equal_full = drf_sha = None
-    if not (force_cpu or os.environ.get("BENCH_SKIP_DRF")):
+    drf_backend = None
+    # initialized BEFORE the drf section: the preempt block's init used to
+    # re-None this after the drf section had already set it
+    drf_record_stale = None
+    if not os.environ.get("BENCH_SKIP_DRF"):
         from __graft_entry__ import _synthetic_cluster as _synth
         from volcano_tpu.api import QueueInfo
-        from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
-        dci = _synth(n_nodes=int(os.environ.get("BENCH_DRF_NODES", 1024)),
-                     n_jobs=int(os.environ.get("BENCH_DRF_JOBS", 3125)),
-                     tasks_per_job=16)
-        for q in range(8):
-            dci.add_queue(QueueInfo(f"q{q}", weight=1 + q % 4))
-        for j, job in enumerate(dci.jobs.values()):
-            job.queue = f"q{j % 8}"
+        from volcano_tpu.ops.allocate_scan import (AllocateConfig as _AC,
+                                                   derive_batching)
+
+        def _drf_cluster(n_nodes, n_jobs, tasks_per_job):
+            c = _synth(n_nodes=n_nodes, n_jobs=n_jobs,
+                       tasks_per_job=tasks_per_job)
+            for q in range(8):
+                c.add_queue(QueueInfo(f"q{q}", weight=1 + q % 4))
+            for j, job in enumerate(c.jobs.values()):
+                job.queue = f"q{j % 8}"
+            return c
+
+        if force_cpu:
+            # degrade, never skip: sub-scale on the CPU backend, labeled
+            drf_backend = "cpu_subscale"
+            dci = _drf_cluster(
+                int(os.environ.get("BENCH_DRF_NODES", 256)),
+                int(os.environ.get("BENCH_DRF_JOBS", 384)), 8)
+        else:
+            drf_backend = "tpu"
+            dci = _drf_cluster(
+                int(os.environ.get("BENCH_DRF_NODES", 1024)),
+                int(os.environ.get("BENCH_DRF_JOBS", 3125)), 16)
         from volcano_tpu import native as _nat
         dsnap, _dm = _nat.pack_best_effort(dci)
         dextras = AllocateExtras.neutral(dsnap)
-        dcfg = _AC(binpack_weight=1.0, least_allocated_weight=0.0,
-                   balanced_weight=0.0, taint_prefer_weight=0.0,
-                   drf_job_order=True, enable_gpu=False)
+        # derive_batching routes the dynamic-key (drf) ordering through
+        # the fused in-kernel-selection path on TPU (batch_rounds); on the
+        # CPU backend the auto probe falls back to the XLA scan
+        dcfg = derive_batching(
+            _AC(binpack_weight=1.0, least_allocated_weight=0.0,
+                balanced_weight=0.0, taint_prefer_weight=0.0,
+                drf_job_order=True, enable_gpu=False),
+            has_proportion=False)
         dfn = jax.jit(make_allocate_cycle(dcfg))
         dresult, drf_ms, _ = _time_device(dfn, dsnap, dextras, min(reps, 2))
         drf_placed = int(np.asarray(dresult.task_mode > 0).sum())
-        # full-scale equality record (scripts/drf_record.py runs the live
-        # CPU oracle once at this scale), fingerprint-guarded thereafter
-        import hashlib as _hl2
-        drf_sha = _hl2.sha256(
-            np.asarray(dresult.task_node).tobytes()
-            + np.asarray(dresult.task_mode).tobytes()).hexdigest()[:16]
-        rec_dsha = (recorded or {}).get("drf_sha256")
-        drf_equal_full = (True if (rec_dsha is not None
-                                   and rec_dsha == drf_sha
-                                   and (recorded or {}).get(
-                                       "drf_equal_full_scale_verified"))
-                          else None)
-        if rec_dsha is not None:
-            drf_record_stale = rec_dsha != drf_sha
+        if not force_cpu:
+            # full-scale equality record (scripts/drf_record.py runs the
+            # live CPU oracle once at this scale), fingerprint-guarded
+            # thereafter; meaningless at the degraded sub-scale
+            import hashlib as _hl2
+            drf_sha = _hl2.sha256(
+                np.asarray(dresult.task_node).tobytes()
+                + np.asarray(dresult.task_mode).tobytes()).hexdigest()[:16]
+            rec_dsha = (recorded or {}).get("drf_sha256")
+            drf_equal_full = (True if (rec_dsha is not None
+                                       and rec_dsha == drf_sha
+                                       and (recorded or {}).get(
+                                           "drf_equal_full_scale_verified"))
+                              else None)
+            if rec_dsha is not None:
+                drf_record_stale = rec_dsha != drf_sha
         # sub-scale decision equality for the dynamic-drf ordering path
-        sci = _synth(n_nodes=192, n_jobs=192, tasks_per_job=8)
-        for q in range(8):
-            sci.add_queue(QueueInfo(f"q{q}", weight=1 + q % 4))
-        for j, job in enumerate(sci.jobs.values()):
-            job.queue = f"q{j % 8}"
+        sci = _drf_cluster(192, 192, 8)
         ssnap2, _sm2 = _nat.pack_best_effort(sci)
         sextras2 = AllocateExtras.neutral(ssnap2)
         sres2 = dfn(ssnap2, sextras2)     # same jit object, new shape bucket
@@ -450,10 +479,10 @@ tiers:
     preempt_sha = None
     preempt_record_stale = None
     preempt_adv_record_stale = None
-    drf_record_stale = None
     preempt_adv_ms = preempt_adv_victims = preempt_adv_pipelined = None
     preempt_adv_equal = None
-    if not (force_cpu or os.environ.get("BENCH_SKIP_PREEMPT")):
+    preempt_backend = None
+    if not os.environ.get("BENCH_SKIP_PREEMPT"):
         from __graft_entry__ import _synthetic_cluster as _synth
         from volcano_tpu.api import (JobInfo, PodGroupPhase, Resource,
                                      TaskInfo, TaskStatus)
@@ -498,7 +527,7 @@ tiers:
 
         # subscale oracle equality, every run
         sci = _preempt_scenario(1000, 600, 8)
-        ssnap, sextras, sveto, sskip, sres, _sev, _stm, _sms = \
+        ssnap, sextras, sveto, sskip, sres, _sev, _stm, sub_pre_ms = \
             _run_preempt(sci, 1)
         scpu = preempt_cpu(ssnap, sextras, sveto, sskip, pcfg)
         preempt_equal_sub = bool(
@@ -508,34 +537,45 @@ tiers:
             and np.array_equal(np.asarray(sres.task_mode),
                                scpu["task_mode"]))
 
-        # config 4 at full scale
-        pci = _preempt_scenario(
-            int(os.environ.get("BENCH_PRE_NODES", 10000)),
-            int(os.environ.get("BENCH_PRE_JOBS", 6000)),
-            int(os.environ.get("BENCH_PRE_GANGS", 64)))
-        psnap, pextras, pveto, pskip, pres, pev, ptm, preempt_ms = \
-            _run_preempt(pci, min(reps, 2))
+        import hashlib as _hl
+        if force_cpu:
+            # degrade, never skip: the oracle-checked sub-scale scenario
+            # IS the measured config on the CPU backend, labeled
+            preempt_backend = "cpu_subscale"
+            psnap, pres, pev, ptm = ssnap, sres, np.asarray(sres.evicted), \
+                np.asarray(sres.task_mode)
+            preempt_ms = sub_pre_ms
+        else:
+            # config 4 at full scale
+            preempt_backend = "tpu"
+            pci = _preempt_scenario(
+                int(os.environ.get("BENCH_PRE_NODES", 10000)),
+                int(os.environ.get("BENCH_PRE_JOBS", 6000)),
+                int(os.environ.get("BENCH_PRE_GANGS", 64)))
+            psnap, pextras, pveto, pskip, pres, pev, ptm, preempt_ms = \
+                _run_preempt(pci, min(reps, 2))
         preempt_victims = int(pev.sum())
         preempt_pipelined = int((ptm == _MP).sum())
-        import hashlib as _hl
-        preempt_sha = _hl.sha256(
-            np.asarray(pres.task_node).tobytes()
-            + np.asarray(pres.task_mode).tobytes()
-            + pev.tobytes()).hexdigest()[:16]
-        rec_psha = (recorded or {}).get("preempt_sha256")
-        if os.environ.get("BENCH_LIVE_PREEMPT_CPU"):
-            pcpu = preempt_cpu(psnap, pextras, pveto, pskip, pcfg)
-            preempt_equal_full = bool(
-                np.array_equal(pev, pcpu["evicted"])
-                and np.array_equal(np.asarray(pres.task_node),
-                                   pcpu["task_node"])
-                and np.array_equal(np.asarray(pres.task_mode),
-                                   pcpu["task_mode"]))
-        elif rec_psha is not None:
-            # mismatch = the verified record no longer describes these
-            # decisions: surface the staleness, do not silently skip
-            preempt_equal_full = True if rec_psha == preempt_sha else None
-            preempt_record_stale = rec_psha != preempt_sha
+        if not force_cpu:
+            preempt_sha = _hl.sha256(
+                np.asarray(pres.task_node).tobytes()
+                + np.asarray(pres.task_mode).tobytes()
+                + pev.tobytes()).hexdigest()[:16]
+            rec_psha = (recorded or {}).get("preempt_sha256")
+            if os.environ.get("BENCH_LIVE_PREEMPT_CPU"):
+                pcpu = preempt_cpu(psnap, pextras, pveto, pskip, pcfg)
+                preempt_equal_full = bool(
+                    np.array_equal(pev, pcpu["evicted"])
+                    and np.array_equal(np.asarray(pres.task_node),
+                                       pcpu["task_node"])
+                    and np.array_equal(np.asarray(pres.task_mode),
+                                       pcpu["task_mode"]))
+            elif rec_psha is not None:
+                # mismatch = the verified record no longer describes these
+                # decisions: surface the staleness, do not silently skip
+                preempt_equal_full = True if rec_psha == preempt_sha \
+                    else None
+                preempt_record_stale = rec_psha != preempt_sha
 
         # invariants (cross-checking the oracle): victims only from
         # lower-priority jobs; every pipelined-flag gang reached
@@ -554,9 +594,14 @@ tiers:
 
         # adversarial scale (VERDICT r4 #2): >=300 starving gangs, ~28k
         # pending preemptor tasks over the same 10k-node cluster
+        # (cpu_subscale: same gang density at 1/10 the cluster)
         if not os.environ.get("BENCH_SKIP_PREEMPT_ADV"):
-            aci = _preempt_scenario(10000, 6000, 312, gang_tasks=90,
-                                    min_avail=90)
+            if force_cpu:
+                aci = _preempt_scenario(1000, 600, 31, gang_tasks=90,
+                                        min_avail=90)
+            else:
+                aci = _preempt_scenario(10000, 6000, 312, gang_tasks=90,
+                                        min_avail=90)
             (_a1, _a2, _a3, _a4, ares, aev, atm,
              preempt_adv_ms) = _run_preempt(aci, 1)
             preempt_adv_victims = int(aev.sum())
@@ -567,7 +612,9 @@ tiers:
             arec_path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
                 "PREEMPT_ADV_RECORD.json")
-            if os.path.exists(arec_path):
+            if force_cpu:
+                pass    # sub-scale decisions can't match the full record
+            elif os.path.exists(arec_path):
                 with open(arec_path) as f:
                     arec = json.load(f)
                 asha = _hl.sha256(
@@ -584,48 +631,56 @@ tiers:
 
     # ---- topology-aware binpack with affinity (BASELINE.json config 5) ---
     # 10k nodes with zone/rack labels, required + preferred inter-pod
-    # (anti-)affinity terms; runs the XLA scan path (the fused placer
-    # carries no affinity state).
+    # (anti-)affinity terms. The fused round placer now carries the live
+    # affinity counts in VMEM (ops/pallas_place v3), so the auto path uses
+    # it on TPU; oracle equality is checked live at 1k-node sub-scale
+    # every run, and the full-scale record is fingerprint-guarded like the
+    # north-star config's (affinity_sha256 in BENCH_BASELINE.json).
     affinity_ms = affinity_placed = None
-    if not (force_cpu or os.environ.get("BENCH_SKIP_AFFINITY")):
+    affinity_equal_sub = affinity_equal_full = affinity_sha = None
+    affinity_record_stale = None
+    affinity_backend = None
+    if not os.environ.get("BENCH_SKIP_AFFINITY"):
         import dataclasses as _dc
-        from __graft_entry__ import _synthetic_cluster
-        from volcano_tpu.api import PodAffinityTerm
-        from volcano_tpu.arrays import pack as _pack
-        from volcano_tpu.arrays.affinity import build_affinity
-        from volcano_tpu.ops.allocate_scan import AllocateExtras as _AE
-        rng = np.random.RandomState(0)
-        aci = _synthetic_cluster(
-            n_nodes=int(os.environ.get("BENCH_AFF_NODES", 10000)),
-            n_jobs=int(os.environ.get("BENCH_AFF_JOBS", 2500)),
-            tasks_per_job=8)
-        apps = [f"app{i}" for i in range(8)]
-        for i, node in enumerate(aci.nodes.values()):
-            node.labels["zone"] = f"z{i % 16}"
-            node.labels["rack"] = f"r{i % 512}"
-        for j, job in enumerate(aci.jobs.values()):
-            app = apps[j % len(apps)]
-            for t in job.tasks.values():
-                t.labels["app"] = app
-                r = rng.rand()
-                if r < 0.10:
-                    t.pod_anti_affinity = [PodAffinityTerm(
-                        topology_key="rack", match_labels={"app": app})]
-                elif r < 0.20:
-                    t.pod_affinity_preferred = [PodAffinityTerm(
-                        topology_key="zone", match_labels={"app": app},
-                        weight=10)]
-        asnap, amaps = _pack(aci)
-        aN = asnap.nodes.idle.shape[0]
-        aT = asnap.tasks.resreq.shape[0]
-        aextras = _dc.replace(
-            _AE.neutral(asnap),
-            affinity=build_affinity(aci, amaps, aN, aT))
-        acfg = _dc.replace(cfg, enable_pod_affinity=True, use_pallas=False)
+        # same scenario + extras builders as the recorded-oracle script so
+        # fingerprints stay comparable (scripts/affinity_record.py)
+        from scripts.affinity_record import build as _aff_pack
+        from scripts.affinity_record import scenario as _aff_cluster
+
+        if force_cpu:
+            affinity_backend = "cpu_subscale"
+            aci = _aff_cluster(int(os.environ.get("BENCH_AFF_NODES", 512)),
+                               int(os.environ.get("BENCH_AFF_JOBS", 192)))
+        else:
+            affinity_backend = "tpu"
+            aci = _aff_cluster(
+                int(os.environ.get("BENCH_AFF_NODES", 10000)),
+                int(os.environ.get("BENCH_AFF_JOBS", 2500)))
+        asnap, aextras = _aff_pack(aci)
+        acfg = _dc.replace(cfg, enable_pod_affinity=True)
         afn = jax.jit(make_allocate_cycle(acfg))
         aresult, affinity_ms, _ = _time_device(afn, asnap, aextras,
                                                min(reps, 2))
         affinity_placed = int(np.asarray(aresult.task_mode > 0).sum())
+        if not force_cpu:
+            import hashlib as _hl3
+            affinity_sha = _hl3.sha256(
+                np.asarray(aresult.task_node).tobytes()
+                + np.asarray(aresult.task_mode).tobytes()).hexdigest()[:16]
+            rec_asha = (recorded or {}).get("affinity_sha256")
+            affinity_equal_full = (
+                True if (rec_asha is not None and rec_asha == affinity_sha
+                         and (recorded or {}).get(
+                             "affinity_equal_full_scale_verified"))
+                else None)
+            if rec_asha is not None:
+                affinity_record_stale = rec_asha != affinity_sha
+        # live 1k-node oracle equality, every run (VERDICT r5 item 3)
+        saci = _aff_cluster(1024, 320, seed=1)
+        sasnap, saextras = _aff_pack(saci)
+        sares = afn(sasnap, saextras)
+        sacpu = allocate_cpu(sasnap, saextras, acfg)
+        affinity_equal_sub = _decisions_equal(sares, sacpu)
 
     # ---- live sub-scale decision-equality + speedup check ----------------
     equal_sub = sub_speedup = stpu_ms = scpu_ms = None
@@ -668,12 +723,14 @@ tiers:
         "steady_loop_binds": steady_binds,
         "steady_loop_incremental": loop_incremental,
         "drf_cycle_ms": (round(drf_ms, 1) if drf_ms is not None else None),
+        "drf_backend": drf_backend,
         "drf_placed": drf_placed,
         "drf_decisions_equal_cpu_subscale": drf_equal_sub,
         "drf_decisions_equal_cpu_full_scale": drf_equal_full,
         "drf_sha256": drf_sha,
         "preempt_cycle_ms": (round(preempt_ms, 1)
                              if preempt_ms is not None else None),
+        "preempt_backend": preempt_backend,
         "preempt_victims": preempt_victims,
         "preempt_pipelined": preempt_pipelined,
         "preempt_invariants_ok": preempt_invariants_ok,
@@ -690,7 +747,12 @@ tiers:
         "preempt_adversarial_equal_cpu_full_scale": preempt_adv_equal,
         "affinity_cycle_ms": (round(affinity_ms, 1)
                               if affinity_ms is not None else None),
+        "affinity_backend": affinity_backend,
         "affinity_placed": affinity_placed,
+        "affinity_decisions_equal_cpu_1024n": affinity_equal_sub,
+        "affinity_decisions_equal_cpu_full_scale": affinity_equal_full,
+        "affinity_sha256": affinity_sha,
+        "affinity_record_stale": affinity_record_stale,
         "decisions_equal_cpu_full_scale": equal_full,
         "decisions_sha256": decisions_sha,
         "decisions_equal_cpu_1024n_10240t": equal_sub,
@@ -707,8 +769,23 @@ def main():
     force_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
     if not force_cpu:
         timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
-        if not _tpu_alive(timeout_s):
-            _reexec_cpu("backend probe failed/timed out after %gs" % timeout_s)
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", 3))
+        alive, backoff = False, 5.0
+        for attempt in range(max(1, retries)):
+            if _tpu_alive(timeout_s):
+                alive = True
+                break
+            if attempt + 1 < retries:
+                # capped backoff: a transient tunnel blip must not blind
+                # the whole record (VERDICT r5 item 1)
+                print("bench: TPU probe attempt %d/%d failed; retrying "
+                      "in %gs" % (attempt + 1, retries, backoff),
+                      file=sys.stderr)
+                time.sleep(backoff)
+                backoff = min(backoff * 3, 60.0)
+        if not alive:
+            _reexec_cpu("backend probe failed/timed out after %d attempts "
+                        "x %gs" % (retries, timeout_s))
     try:
         _run(force_cpu)
     except Exception as e:  # noqa: BLE001 — fail-soft contract

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""One-off: full-scale affinity (config 5) decision-equality record.
+
+Runs the live CPU oracle at the full 10k-node zone/rack scale against the
+compiled cycle with inter-pod affinity enabled and stamps
+affinity_sha256/affinity_cpu_ms into BENCH_BASELINE.json (VERDICT r5
+item 3); bench.py then guards the record by fingerprint every run.
+bench.py imports :func:`scenario` so the bench's measured cluster and the
+recorded oracle cluster are the same object, keeping fingerprints
+comparable."""
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def scenario(n_nodes=10000, n_jobs=2500, seed=0):
+    """BASELINE.json config-5 shape: zone/rack topology, mixed required
+    anti-affinity + preferred affinity terms over 8 apps."""
+    from __graft_entry__ import _synthetic_cluster
+    from volcano_tpu.api import PodAffinityTerm
+    rng = np.random.RandomState(seed)
+    ci = _synthetic_cluster(n_nodes=n_nodes, n_jobs=n_jobs, tasks_per_job=8)
+    apps = [f"app{i}" for i in range(8)]
+    for i, node in enumerate(ci.nodes.values()):
+        node.labels["zone"] = f"z{i % 16}"
+        node.labels["rack"] = f"r{i % max(1, n_nodes // 20)}"
+    for j, job in enumerate(ci.jobs.values()):
+        app = apps[j % len(apps)]
+        for t in job.tasks.values():
+            t.labels["app"] = app
+            r = rng.rand()
+            if r < 0.10:
+                t.pod_anti_affinity = [PodAffinityTerm(
+                    topology_key="rack", match_labels={"app": app})]
+            elif r < 0.20:
+                t.pod_affinity_preferred = [PodAffinityTerm(
+                    topology_key="zone", match_labels={"app": app},
+                    weight=10)]
+    return ci
+
+
+def build(ci):
+    import dataclasses
+    from volcano_tpu.arrays import pack
+    from volcano_tpu.arrays.affinity import build_affinity
+    from volcano_tpu.ops.allocate_scan import AllocateExtras
+    snap, maps = pack(ci)
+    N = snap.nodes.idle.shape[0]
+    T = snap.tasks.resreq.shape[0]
+    extras = dataclasses.replace(
+        AllocateExtras.neutral(snap),
+        affinity=build_affinity(ci, maps, N, T))
+    return snap, extras
+
+
+def main():
+    import jax
+    from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                               make_allocate_cycle)
+    from volcano_tpu.runtime.cpu_reference import allocate_cpu
+    n_nodes = int(os.environ.get("AFF_RECORD_NODES", 10000))
+    n_jobs = int(os.environ.get("AFF_RECORD_JOBS", 2500))
+    ci = scenario(n_nodes=n_nodes, n_jobs=n_jobs)
+    snap, extras = build(ci)
+    acfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                          balanced_weight=0.0, taint_prefer_weight=0.0,
+                          enable_pod_affinity=True, enable_gpu=False,
+                          batch_jobs=8)
+    afn = jax.jit(make_allocate_cycle(acfg))
+    res = afn(snap, extras)
+    tn = np.asarray(res.task_node)
+    t0 = time.time()
+    res = afn(snap, extras)
+    tn = np.asarray(res.task_node)
+    tm = np.asarray(res.task_mode)
+    dev_ms = (time.time() - t0) * 1000
+    print(f"kernel: {dev_ms:.0f}ms placed={int((tm > 0).sum())}", flush=True)
+    t0 = time.time()
+    cpu = allocate_cpu(snap, extras, acfg)
+    cpu_ms = (time.time() - t0) * 1000
+    equal = bool(np.array_equal(tn, cpu["task_node"])
+                 and np.array_equal(tm, cpu["task_mode"]))
+    sha = hashlib.sha256(tn.tobytes() + tm.tobytes()).hexdigest()[:16]
+    print(f"cpu oracle: {cpu_ms:.0f}ms equal={equal} sha={sha}", flush=True)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_BASELINE.json")
+    rec = json.load(open(path))
+    rec["affinity_sha256"] = sha
+    rec["affinity_cpu_ms"] = round(cpu_ms, 1)
+    rec["affinity_config"] = {"nodes": n_nodes, "jobs": n_jobs,
+                              "tasks_per_job": 8}
+    rec["affinity_equal_full_scale_verified"] = (
+        time.strftime("%Y-%m-%d") if equal else None)
+    json.dump(rec, open(path, "w"), indent=1)
+    print("record updated")
+
+
+if __name__ == "__main__":
+    main()

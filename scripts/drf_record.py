@@ -23,6 +23,7 @@ def main():
     from volcano_tpu.api import QueueInfo
     from volcano_tpu.ops.allocate_scan import (AllocateConfig,
                                                AllocateExtras,
+                                               derive_batching,
                                                make_allocate_cycle)
     from volcano_tpu.runtime.cpu_reference import allocate_cpu
     dci = _synth(n_nodes=1024, n_jobs=3125, tasks_per_job=16)
@@ -32,9 +33,13 @@ def main():
         job.queue = f"q{j % 8}"
     dsnap, _dm = native.pack_best_effort(dci)
     dextras = AllocateExtras.neutral(dsnap)
-    dcfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
-                          balanced_weight=0.0, taint_prefer_weight=0.0,
-                          drf_job_order=True, enable_gpu=False)
+    # same conf derivation as bench.py's drf section: the dynamic-key
+    # fused path on TPU, the XLA scan on CPU — decisions identical
+    dcfg = derive_batching(
+        AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                       balanced_weight=0.0, taint_prefer_weight=0.0,
+                       drf_job_order=True, enable_gpu=False),
+        has_proportion=False)
     dfn = jax.jit(make_allocate_cycle(dcfg))
     res = dfn(dsnap, dextras)
     tn = np.asarray(res.task_node)

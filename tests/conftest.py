@@ -22,3 +22,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # tier-1 (scripts/tier1.sh) runs `-m 'not slow'`; the slow tail
+    # (sharded 8-device identity, full hdrf outcome sweeps, sidecar e2e)
+    # runs in the full suite only
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from tier-1")

@@ -369,15 +369,24 @@ class TestSessionIntegration:
         assert len(set(binds.values())) == 3, \
             f"anti-affinity must spread the gang: {binds}"
 
-    def test_pallas_conflict_raises(self):
-        with pytest.raises(ValueError):
-            cfg = dataclasses.replace(CFG, use_pallas=True)
-            ci = make_zone_cluster()
-            job = JobInfo("default/j", min_available=1, queue="default",
-                          pod_group_phase=PodGroupPhase.INQUEUE)
-            job.add_task(task("t0"))
-            ci.add_job(job)
-            run_cycle(ci, cfg)
+    def test_pallas_affinity_supported_ports_not(self):
+        """The v3 fused placer carries the live affinity counts in VMEM,
+        so use_pallas + enable_pod_affinity is now a SUPPORTED pair
+        (interpret run must succeed); host ports remain excluded."""
+        ci = make_zone_cluster()
+        job = JobInfo("default/j", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        t = task("t0", labels={"app": "x"})
+        t.pod_affinity_preferred = [PodAffinityTerm(
+            topology_key="zone", match_labels={"app": "x"}, weight=3)]
+        job.add_task(t)
+        ci.add_job(job)
+        cfg = dataclasses.replace(CFG, use_pallas="interpret")
+        _, node_of, _, _ = run_cycle(ci, cfg)
+        assert node_of["t0"] is not None
+        with pytest.raises(ValueError, match="host-port"):
+            run_cycle(ci, dataclasses.replace(
+                CFG, use_pallas=True, enable_host_ports=True))
 
     def test_affinity_arrays_neutral_has_no_terms(self):
         assert not AffinityArrays.neutral(8, 8).has_terms
@@ -432,3 +441,118 @@ class TestEquivalenceAtScale:
         np.testing.assert_array_equal(np.asarray(res.task_mode),
                                       cpu["task_mode"])
         assert int((np.asarray(res.task_mode) > 0).sum()) > 10
+
+
+def _random_affinity_cluster(seed, n_nodes, n_jobs, zones=8, racks=32,
+                             tasks_lo=1, tasks_hi=4, running_pods=12,
+                             cpu="4"):
+    """Randomized mixed required/preferred workload over a zone/rack
+    topology with running pods seeding the static counts (the
+    BASELINE.json config-5 shape, scalable to any node count)."""
+    rng = np.random.default_rng(seed)
+    ci = make_zone_cluster(n_nodes=n_nodes,
+                           zones=tuple(f"z{i}" for i in range(zones)),
+                           cpu=cpu)
+    for i, n in enumerate(ci.nodes.values()):
+        n.labels["rack"] = f"r{i % racks}"
+    apps = [f"app{i}" for i in range(5)]
+    for j in range(n_jobs):
+        job = JobInfo(f"default/j{j}", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE,
+                      creation_timestamp=float(j))
+        for i in range(int(rng.integers(tasks_lo, tasks_hi))):
+            app = apps[int(rng.integers(len(apps)))]
+            t = task(f"j{j}-t{i}", labels={"app": app})
+            r = rng.random()
+            if r < 0.25:
+                t.pod_anti_affinity = [PodAffinityTerm(
+                    topology_key="rack", match_labels={"app": app})]
+            elif r < 0.5:
+                t.pod_affinity = [PodAffinityTerm(
+                    topology_key="zone", match_labels={"app": app})]
+            elif r < 0.75:
+                t.pod_affinity_preferred = [PodAffinityTerm(
+                    topology_key="zone", match_labels={"app": apps[0]},
+                    weight=int(rng.integers(1, 20)))]
+            job.add_task(t)
+        ci.add_job(job)
+    nodes = list(ci.nodes)
+    seedjob = JobInfo("default/seed", min_available=1, queue="default",
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+    for i in range(running_pods):
+        t = task(f"s-{i}", labels={"app": apps[int(rng.integers(3))]},
+                 status=TaskStatus.RUNNING)
+        seedjob.add_task(t)
+        ci.nodes[nodes[int(rng.integers(len(nodes)))]].add_task(t)
+    ci.add_job(seedjob)
+    return ci
+
+
+class TestPallasAffinityParity:
+    """ops/pallas_place v3: the live inter-pod affinity counts are kernel
+    state with per-section commit/discard. Both kernels must match the
+    scan path and the CPU oracle bitwise."""
+
+    @pytest.mark.parametrize("seed", [0, 2])
+    def test_static_k_kernel_parity(self, seed):
+        ci = _random_affinity_cluster(seed, n_nodes=16, n_jobs=6, zones=4,
+                                      racks=5, running_pods=6)
+        snap, maps = pack(ci)
+        N = snap.nodes.idle.shape[0]
+        T = snap.tasks.resreq.shape[0]
+        extras = dataclasses.replace(
+            AllocateExtras.neutral(snap),
+            affinity=build_affinity(ci, maps, N, T))
+        scan = jax.jit(make_allocate_cycle(
+            dataclasses.replace(CFG, use_pallas=False)))(snap, extras)
+        pls = jax.jit(make_allocate_cycle(dataclasses.replace(
+            CFG, use_pallas="interpret", batch_jobs=4)))(snap, extras)
+        for f in ("task_node", "task_mode", "job_ready", "job_pipelined"):
+            np.testing.assert_array_equal(np.asarray(getattr(scan, f)),
+                                          np.asarray(getattr(pls, f)), f)
+        cpu = allocate_cpu(snap, extras, CFG)
+        np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                      cpu["task_node"])
+
+    def test_dyn_kernel_affinity_with_drf(self):
+        """Affinity state + in-kernel fairness-key recompute together
+        (the dynamic-key kernel with enable_pod_affinity)."""
+        ci = _random_affinity_cluster(1, n_nodes=16, n_jobs=6, zones=4,
+                                      racks=5, running_pods=6)
+        snap, maps = pack(ci)
+        N = snap.nodes.idle.shape[0]
+        T = snap.tasks.resreq.shape[0]
+        extras = dataclasses.replace(
+            AllocateExtras.neutral(snap),
+            affinity=build_affinity(ci, maps, N, T))
+        cfg = dataclasses.replace(CFG, drf_job_order=True)
+        scan = jax.jit(make_allocate_cycle(
+            dataclasses.replace(cfg, use_pallas=False)))(snap, extras)
+        dyn = jax.jit(make_allocate_cycle(dataclasses.replace(
+            cfg, use_pallas="interpret", batch_jobs=4,
+            batch_rounds=12)))(snap, extras)
+        for f in ("task_node", "task_mode", "job_ready", "job_pipelined"):
+            np.testing.assert_array_equal(np.asarray(getattr(scan, f)),
+                                          np.asarray(getattr(dyn, f)), f)
+        cpu = allocate_cpu(snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                      cpu["task_node"])
+
+
+class TestEquivalenceAt1kNodes:
+    """Oracle equality at >=1k randomized nodes/tasks (VERDICT r5 item 3
+    raised the bar from <=256); the full-scale 10k record is fingerprint-
+    guarded in bench.py (affinity_sha256 in BENCH_BASELINE.json)."""
+
+    @pytest.mark.parametrize("seed", [17, 23])
+    def test_device_matches_cpu_reference_1k_nodes(self, seed):
+        ci = _random_affinity_cluster(seed, n_nodes=1024, n_jobs=96,
+                                      zones=16, racks=128, tasks_hi=3,
+                                      running_pods=48)
+        res, _, maps, (snap, extras) = run_cycle(ci)
+        cpu = allocate_cpu(snap, extras, CFG)
+        np.testing.assert_array_equal(np.asarray(res.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(res.task_mode),
+                                      cpu["task_mode"])
+        assert int((np.asarray(res.task_mode) > 0).sum()) > 40

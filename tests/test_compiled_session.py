@@ -87,9 +87,12 @@ tiers:
                                       np.asarray(seq.task_mode))
         np.testing.assert_array_equal(np.asarray(batched.job_ready),
                                       np.asarray(seq.job_ready))
-        # a conf with proportion must stay sequential
-        assert allocate_config_from_conf(parse_conf(DEFAULT_CONF)
-                                         ).batch_jobs == 1
+        # a conf with proportion carries dynamic ordering keys: it must
+        # NOT take the static-keys K-section path — derive_batching routes
+        # it to the in-kernel-selection path (batch_rounds) instead
+        dyn_cfg = allocate_config_from_conf(parse_conf(DEFAULT_CONF))
+        assert dyn_cfg.batch_rounds > 0
+        assert cfg.batch_rounds == 0
 
     def test_hdrf_conf_compiles(self):
         conf = open("conf/volcano-scheduler-dap.conf").read()

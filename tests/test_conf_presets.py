@@ -19,6 +19,12 @@ from fixtures import build_job, build_task, simple_cluster
 
 PRESETS = sorted(glob.glob(os.path.join(
     os.path.dirname(__file__), "..", "conf", "*.conf")))
+# the ci preset compiles the full 5-action pipeline (~20s on one core):
+# slow-marked so tier-1 keeps the other presets' e2e coverage in budget
+_PRESET_PARAMS = [
+    pytest.param(p, marks=pytest.mark.slow)
+    if os.path.basename(p) == "volcano-scheduler-ci.conf" else p
+    for p in PRESETS]
 
 
 def preset_cluster():
@@ -34,7 +40,7 @@ def preset_cluster():
 
 
 class TestPresets:
-    @pytest.mark.parametrize("path", PRESETS,
+    @pytest.mark.parametrize("path", _PRESET_PARAMS,
                              ids=[os.path.basename(p) for p in PRESETS])
     def test_preset_schedules(self, path):
         with open(path) as f:

@@ -10,6 +10,7 @@ allocate path consumes the tree).
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from volcano_tpu.arrays.hierarchy import HierarchyArrays, build_hierarchy
 from volcano_tpu.ops.fairshare import hdrf_level_keys, hdrf_tree_state
@@ -147,6 +148,7 @@ def _rand_tree(rng, max_depth=3, max_queues=6, max_jobs=8, R=2):
 
 
 class TestTreeState:
+    @pytest.mark.slow
     def test_fuzz_matches_go_recursion(self):
         rng = np.random.default_rng(7)
         for trial in range(60):
@@ -162,6 +164,7 @@ class TestTreeState:
             assert np.allclose(share, gshare, atol=1e-4), trial
             assert (sat == gsat).all(), trial
 
+    @pytest.mark.slow
     def test_fuzz_queue_order_matches_compare_queues(self):
         rng = np.random.default_rng(11)
         for trial in range(60):
@@ -400,6 +403,7 @@ class TestHDRFOutcomes:
         np.testing.assert_array_equal(np.asarray(result.task_mode),
                                       ref["task_mode"])
 
+    @pytest.mark.slow
     def test_blocking_nodes(self):
         ci = _hdrf_cluster(
             "30", str(30 * 2 ** 30),

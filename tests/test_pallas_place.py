@@ -245,3 +245,182 @@ class TestPallasPipelining:
         assert all(int(modes[maps.task_index[f"default/w{i}"]]) ==
                    MODE_PIPELINED for i in range(2))
         assert gpus == [0, 1]   # in-cycle card accounting on pipelined tasks
+
+
+def run_dyn_paths(ci, cfg, extras_fn=None, batch=(4, 12), check_cpu=True):
+    """Scan path vs the dynamic-key batched kernel (batch_rounds > 0:
+    in-kernel job selection + fairness-key recompute), plus the CPU oracle.
+    Returns (snap, maps, scan, dyn)."""
+    from volcano_tpu.runtime.cpu_reference import allocate_cpu
+    snap, maps = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    if extras_fn:
+        extras = extras_fn(snap, maps, extras)
+    scan = jax.jit(make_allocate_cycle(
+        dataclasses.replace(cfg, use_pallas=False)))(snap, extras)
+    dyn = jax.jit(make_allocate_cycle(dataclasses.replace(
+        cfg, use_pallas="interpret", batch_jobs=batch[0],
+        batch_rounds=batch[1])))(snap, extras)
+    assert_equal(scan, dyn)
+    np.testing.assert_array_equal(np.asarray(scan.job_pipelined),
+                                  np.asarray(dyn.job_pipelined))
+    if check_cpu:
+        cpu = allocate_cpu(snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                      cpu["task_node"])
+        np.testing.assert_array_equal(np.asarray(scan.task_mode),
+                                      cpu["task_mode"])
+    return snap, maps, scan, dyn
+
+
+def dyn_cluster(seed, n_nodes=5, n_jobs=8, node_cpu="3", gpus=False,
+                ns=False):
+    """Capacity-scarce multi-queue cluster: the dominant-share ordering
+    decides who places, so a key-recompute bug changes decisions."""
+    rng = np.random.RandomState(seed)
+    ci = simple_cluster(n_nodes=0)
+    for i in range(n_nodes):
+        scalars = {}
+        if gpus and i % 2 == 0:
+            scalars = {GPU_MEMORY_RESOURCE: 16, GPU_NUMBER_RESOURCE: 2}
+        ci.add_node(build_node(f"n{i}", cpu=node_cpu, memory="8Gi",
+                               scalars=scalars))
+    ci.add_queue(QueueInfo("batch", weight=2))
+    for j in range(n_jobs):
+        queue = "default" if j % 2 == 0 else "batch"
+        nspace = ("default" if (not ns or j % 3 == 0) else "team-a")
+        n_tasks = 1 + int(rng.randint(4))
+        job = build_job(f"{nspace}/j{j}", queue=queue,
+                        min_available=max(1, n_tasks - 1),
+                        priority=int(rng.randint(2)))
+        for t in range(n_tasks):
+            scalars = {}
+            if gpus and rng.rand() < 0.4:
+                scalars = {GPU_MEMORY_RESOURCE: int(rng.randint(1, 10))}
+            job.add_task(build_task(
+                f"j{j}-t{t}", cpu=f"{int(rng.randint(1, 4)) * 500}m",
+                memory="1Gi", scalars=scalars))
+        ci.add_job(job)
+    return ci
+
+
+class TestDynamicKeyRounds:
+    """The dynamic-key batched kernel (in-kernel job selection +
+    fairness-key recompute, ops/pallas_place._dyn_kernel) must replay the
+    sequential pop order bit-identically for every dynamic-ordering
+    config: drf job/namespace shares, finite proportion deserved, hdrf
+    frozen-column guard, and combinations with GPU + affinity state."""
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_drf_job_order(self, seed):
+        run_dyn_paths(dyn_cluster(seed),
+                      AllocateConfig(binpack_weight=1.0, drf_job_order=True,
+                                     enable_gpu=False))
+
+    def test_drf_ns_and_job_order(self):
+        run_dyn_paths(dyn_cluster(2, ns=True),
+                      AllocateConfig(binpack_weight=1.0, drf_job_order=True,
+                                     drf_ns_order=True, enable_gpu=False))
+
+    def test_proportion_finite_deserved(self):
+        def des_fn(snap, maps, extras):
+            d = np.asarray(extras.queue_deserved).copy()
+            d[maps.queue_index["default"]] = 2.5
+            d[maps.queue_index["batch"]] = 4.0
+            extras.queue_deserved = d
+            return extras
+        run_dyn_paths(dyn_cluster(1),
+                      AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+                      extras_fn=des_fn)
+
+    def test_zero_deserved_overused_flip(self):
+        """A zero-quota queue flips overused on the FIRST commit; the
+        in-kernel eligibility recompute must stop popping its jobs exactly
+        like the sequential order does."""
+        def zero_fn(snap, maps, extras):
+            d = np.asarray(extras.queue_deserved).copy()
+            d[maps.queue_index["default"]] = 0.0
+            extras.queue_deserved = d
+            return extras
+        run_dyn_paths(dyn_cluster(7),
+                      AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+                      extras_fn=zero_fn)
+
+    def test_gpu_with_drf(self):
+        run_dyn_paths(dyn_cluster(0, gpus=True),
+                      AllocateConfig(binpack_weight=1.0, drf_job_order=True))
+
+    def test_hdrf_frozen_columns_guard(self):
+        """hdrf level keys are frozen per launch and guarded (a pop after
+        any commit proceeds only while the eligible set spans one queue):
+        the hdrf_test.go rescaling scenario must still come out
+        bit-identical through the dynamic-key kernel."""
+        from test_hdrf import _hdrf_cluster
+        from volcano_tpu.arrays.hierarchy import build_hierarchy
+        from volcano_tpu.runtime.cpu_reference import allocate_cpu
+        ci = _hdrf_cluster(
+            "10", str(10 * 2 ** 30),
+            [("root-sci", "root/sci", "100/50"),
+             ("root-eng-dev", "root/eng/dev", "100/50/50"),
+             ("root-eng-prod", "root/eng/prod", "100/50/50")],
+            [("pg1", "root-sci", 10, "1", 2 ** 30),
+             ("pg21", "root-eng-dev", 10, "1", 0),
+             ("pg22", "root-eng-prod", 10, "0", 2 ** 30)])
+        snap, maps = pack(ci)
+        Q = np.asarray(snap.queues.weight).shape[0]
+        J = np.asarray(snap.jobs.valid).shape[0]
+        extras = AllocateExtras.neutral(snap)
+        extras.hierarchy = build_hierarchy(ci, maps, Q, J)
+        cfg = AllocateConfig(enable_gang=False, enable_hdrf=True,
+                             drf_job_order=True)
+        scan = jax.jit(make_allocate_cycle(
+            dataclasses.replace(cfg, use_pallas=False)))(snap, extras)
+        dyn = jax.jit(make_allocate_cycle(dataclasses.replace(
+            cfg, use_pallas="interpret", batch_jobs=4,
+            batch_rounds=12)))(snap, extras)
+        assert_equal(scan, dyn)
+        cpu = allocate_cpu(snap, extras, cfg)
+        np.testing.assert_array_equal(np.asarray(scan.task_node),
+                                      cpu["task_node"])
+
+    def test_derive_batching_is_single_authority(self):
+        """The one-place precondition: static-key confs get batch_jobs
+        only; any dynamic-ordering evidence routes to batch_rounds; manual
+        settings are respected; and the kernel builder refuses the
+        illegal static-K + dynamic-keys combination outright."""
+        from volcano_tpu.ops.allocate_scan import (DEFAULT_BATCH_JOBS,
+                                                   DEFAULT_BATCH_ROUNDS,
+                                                   derive_batching)
+        neutral = np.full((2, 3), np.inf, np.float32)
+        finite = neutral.copy()
+        finite[1, 0] = 4.0
+        static = derive_batching(AllocateConfig(), neutral)
+        assert static.batch_jobs == DEFAULT_BATCH_JOBS
+        assert static.batch_rounds == 0
+        for dyn_cfg in (AllocateConfig(drf_job_order=True),
+                        AllocateConfig(drf_ns_order=True),
+                        AllocateConfig(enable_hdrf=True)):
+            got = derive_batching(dyn_cfg, neutral)
+            assert got.batch_rounds == DEFAULT_BATCH_ROUNDS
+            assert got.batch_jobs == DEFAULT_BATCH_JOBS
+        prop = derive_batching(AllocateConfig(), finite)
+        assert prop.batch_rounds == DEFAULT_BATCH_ROUNDS
+        zero = neutral.copy()
+        zero[0, 1] = 0.0    # a zero quota counts as finite deserved
+        assert derive_batching(AllocateConfig(), zero).batch_rounds > 0
+        manual = derive_batching(
+            AllocateConfig(drf_job_order=True, batch_jobs=2), neutral)
+        assert manual.batch_jobs == 2 and manual.batch_rounds == 0
+        with pytest.raises(ValueError, match="static-keys path"):
+            make_allocate_cycle(AllocateConfig(
+                drf_job_order=True, batch_jobs=4, use_pallas="interpret"))(
+                *_tiny_snapshot())
+
+
+def _tiny_snapshot():
+    ci = simple_cluster(n_nodes=1)
+    job = build_job("default/j", min_available=1)
+    job.add_task(build_task("t", cpu="1"))
+    ci.add_job(job)
+    snap, _ = pack(ci)
+    return snap, AllocateExtras.neutral(snap)

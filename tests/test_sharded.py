@@ -51,6 +51,7 @@ def mesh():
     return scheduler_mesh(8)
 
 
+@pytest.mark.slow
 class TestShardedDecisionIdentity:
     @pytest.mark.parametrize("seed", [0, 1, 2])
     def test_sharded_equals_unsharded(self, mesh, seed):
@@ -112,6 +113,7 @@ class TestShardedDecisionIdentity:
                    for s in arr.addressable_shards)
 
 
+@pytest.mark.slow
 class TestShardedPreemptIdentity:
     """VERDICT r4 #6: sharded preempt/reclaim decision identity."""
 
@@ -147,6 +149,7 @@ class TestShardedPreemptIdentity:
             assert np.asarray(sharded.evicted).any()
 
 
+@pytest.mark.slow
 class TestShardedHDRFAndAffinity:
     def test_sharded_hdrf_ordering_identity(self, mesh):
         """hdrf dynamic queue keys (level-wise tree solve each round) over

@@ -88,6 +88,7 @@ class TestSidecar:
             server.shutdown()
 
 
+@pytest.mark.slow
 class TestSidecarHDRF:
     def test_wire_carries_hierarchy_tree(self):
         """A conf-mode sidecar serving an hdrf policy rebuilds the exact

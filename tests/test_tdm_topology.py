@@ -8,6 +8,7 @@ import datetime
 import time
 
 import numpy as np
+import pytest
 
 from volcano_tpu.api import TaskStatus
 from volcano_tpu.framework import parse_conf
@@ -221,6 +222,7 @@ tiers:
       tdm.revocable-zone.z1: "{win}"{extra_args}
 """
 
+    @pytest.mark.slow
     def test_sweep_caps_victims_at_default_budget(self):
         """Without a budget annotation at most defaultPodEvictNum=1 task
         per job is swept per run (tdm.go:330-340)."""

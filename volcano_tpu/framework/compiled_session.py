@@ -24,9 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from ..arrays.schema import SnapshotArrays
-from ..ops.allocate_scan import (DEFAULT_BATCH_JOBS,
-                                 AllocateConfig, AllocateExtras,
-                                 make_allocate_cycle)
+from ..ops.allocate_scan import (AllocateConfig, AllocateExtras,
+                                 derive_batching, make_allocate_cycle)
 from ..ops.fairshare import proportion_deserved
 from .conf import SchedulerConfiguration, parse_conf
 
@@ -64,19 +63,18 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
     enable_hdrf = drf_opt is not None and drf_opt.enabled_hierarchy
     drf_job_order = drf_opt is not None and drf_opt.enabled_job_order
     drf_ns_order = drf_opt is not None and drf_opt.enabled_namespace_order
-    # K-job batched rounds are provably exact from the conf alone: no
-    # proportion plugin means deserved stays neutral (infinite) for the
-    # whole cycle, and without drf dynamic ordering every job-order key is
-    # static over commits (see AllocateConfig.batch_jobs)
-    batchable = not (has_proportion or enable_hdrf or drf_job_order
-                     or drf_ns_order)
-    return AllocateConfig(
+    # Batching is derivable from the conf alone: no proportion plugin
+    # means deserved stays neutral (infinite) for the whole cycle.
+    # derive_batching (ops/allocate_scan.py) owns the rule — static-key
+    # confs batch K pre-selected sections, dynamic-key confs (drf/hdrf
+    # ordering or proportion) get the in-kernel-selection batch_rounds
+    # path.
+    return derive_batching(AllocateConfig(
         enable_gang=has_gang,
         enable_hdrf=enable_hdrf,
         drf_job_order=drf_job_order,
         drf_ns_order=drf_ns_order,
-        batch_jobs=DEFAULT_BATCH_JOBS if batchable else 1,
-        **weights)
+        **weights), has_proportion=has_proportion)
 
 
 def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):

@@ -671,20 +671,14 @@ class Session:
         t0 = time.time()
         cfg = self.allocate_config()
         extras = self.allocate_extras()
-        # Batched pallas rounds (AllocateConfig.batch_jobs) are exact only
-        # when the job-ordering keys are static over commits: no dynamic
-        # drf/hdrf ordering AND no finite proportion deserved anywhere.
-        # Both are verifiable right here, so the session — the only
-        # auto-setter — proves the precondition it documents.
-        # ANY finite deserved (a 0 counts: zero-quota queues flip overused
-        # on the first commit) breaks the static-keys argument.
-        deserved = np.asarray(extras.queue_deserved)
-        if (cfg.batch_jobs == 1
-                and not (cfg.drf_job_order or cfg.drf_ns_order
-                         or cfg.enable_hdrf)
-                and not np.any(np.isfinite(deserved))):
-            from ..ops.allocate_scan import DEFAULT_BATCH_JOBS
-            cfg = dataclasses.replace(cfg, batch_jobs=DEFAULT_BATCH_JOBS)
+        # Batched pallas rounds: ops/allocate_scan.derive_batching is the
+        # single authority for the exactness preconditions — static-key
+        # configs get K pre-selected sections (batch_jobs), dynamic-key
+        # configs (drf/hdrf ordering or any finite proportion deserved,
+        # including 0: zero-quota queues flip overused on the first
+        # commit) get the in-kernel-selection path (batch_rounds).
+        from ..ops.allocate_scan import derive_batching
+        cfg = derive_batching(cfg, extras.queue_deserved)
         # GPU-free snapshots skip the per-card kernel state
         # (decision-neutral: zero requests never charge a card)
         if not np.any(np.asarray(self.snap.tasks.gpu_request) > 0):

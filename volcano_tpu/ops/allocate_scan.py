@@ -57,10 +57,50 @@ MODE_NONE = 0
 MODE_ALLOCATED = 1   # bind now (fits idle)
 MODE_PIPELINED = 2   # placed on releasing capacity, no bind yet
 
-#: jobs per fused round when the static-keys batching precondition holds
-#: (see AllocateConfig.batch_jobs) — the single source for the session's
-#: runtime upgrade, the compiled-session conf derivation, and bench
+#: jobs per fused round (static-keys path: K pre-selected sections; dynamic
+#: path: C candidate jobs per launch). Consumed ONLY through
+#: :func:`derive_batching` — the single authority for the batching rules
+#: that the session's runtime upgrade, the compiled-session conf
+#: derivation, and bench all share.
 DEFAULT_BATCH_JOBS = 8
+
+#: in-kernel pops per launch on the dynamic-key path (see
+#: AllocateConfig.batch_rounds); > batch_jobs because yielded ready jobs
+#: re-pop from VMEM-resident candidate data without a fresh launch
+DEFAULT_BATCH_ROUNDS = 32
+
+
+def derive_batching(cfg: "AllocateConfig", queue_deserved=None,
+                    has_proportion: bool = None) -> "AllocateConfig":
+    """THE single authority for the auto-batching preconditions.
+
+    Static-keys batching (``batch_jobs`` > 1, one launch of K pre-selected
+    job sections) is bit-exact with the sequential pop order ONLY when the
+    ordering keys cannot move under any commit: no drf/hdrf dynamic
+    ordering AND no finite proportion ``deserved`` anywhere (a 0 counts:
+    zero-quota queues flip overused on the first commit). When the keys
+    ARE dynamic, the dynamic-key path (``batch_rounds`` > 0) batches
+    instead: job selection moves into the kernel, which recomputes the
+    drf/proportion keys after every commit (ops/pallas_place._dyn_kernel)
+    and stops the launch whenever exactness would be at risk.
+
+    Callers supply the deserved evidence they have: the session passes the
+    live ``queue_deserved`` array; the conf-only derivation passes
+    ``has_proportion`` (no proportion plugin == deserved stays neutral for
+    the whole cycle). Explicit manual settings are respected untouched.
+    """
+    if cfg.batch_jobs != 1 or cfg.batch_rounds:
+        return cfg          # manually set — caller owns the precondition
+    if has_proportion is None:
+        import numpy as np
+        has_proportion = bool(np.any(np.isfinite(
+            np.asarray(queue_deserved))))
+    dynamic = (cfg.drf_job_order or cfg.drf_ns_order or cfg.enable_hdrf
+               or has_proportion)
+    if dynamic:
+        return dataclasses.replace(cfg, batch_jobs=DEFAULT_BATCH_JOBS,
+                                   batch_rounds=DEFAULT_BATCH_ROUNDS)
+    return dataclasses.replace(cfg, batch_jobs=DEFAULT_BATCH_JOBS)
 
 
 @dataclass(frozen=True)
@@ -104,14 +144,21 @@ class AllocateConfig:
     #: backend, lane-aligned N, fits VMEM), True/False = force,
     #: "interpret" = pallas interpreter (for CPU tests).
     use_pallas: Optional[object] = None
-    #: Jobs per fused round (pallas path only). K > 1 runs K consecutive
-    #: job pops in ONE kernel launch with in-kernel gang commit/discard —
-    #: bit-exact with the sequential pop order ONLY when the ordering keys
-    #: are static over commits: no drf/hdrf dynamic ordering AND no finite
-    #: proportion `deserved` anywhere (else a commit moves qshare and the
-    #: next sequential pop could differ). The session auto-sets this; set
-    #: manually only when those conditions are guaranteed.
+    #: Jobs per fused round (pallas path only). On the static-keys path,
+    #: K > 1 runs K consecutive job pops in ONE kernel launch with
+    #: in-kernel gang commit/discard; on the dynamic-key path
+    #: (batch_rounds > 0) it is the CANDIDATE count whose task data each
+    #: launch pre-gathers. Auto-set via :func:`derive_batching` — the one
+    #: place the exactness preconditions live; set manually only when you
+    #: own them.
     batch_jobs: int = 1
+    #: In-kernel pops per launch on the DYNAMIC-key path (drf/hdrf ordering
+    #: or finite proportion deserved): job selection moves into the kernel,
+    #: which recomputes the dynamic fairness keys after every gang commit
+    #: and early-stops whenever the next sequential pop is not provably
+    #: available in VMEM (candidate miss, hdrf multi-queue guard). 0 = use
+    #: the static-keys path. Auto-set via :func:`derive_batching`.
+    batch_rounds: int = 0
     #: Shared-GPU predicate + card accounting (gpu.go:41-56). Static so
     #: GPU-free snapshots skip the per-card kernel state entirely
     #: (decision-neutral when no task requests GPU memory); the session
@@ -419,6 +466,21 @@ def make_allocate_cycle(cfg: AllocateConfig):
         n_templates = snap.template_rep.shape[0]
         GR = extras.or_feasible.shape[0]
         K = max(1, int(cfg.batch_jobs))
+        KP = max(0, int(cfg.batch_rounds))
+        # one-place config assert backing derive_batching: static-key
+        # batching cannot carry dynamic ordering keys (their recompute
+        # lives only in the dynamic-key kernel)
+        if K > 1 and not KP and (cfg.drf_job_order or cfg.drf_ns_order
+                                 or cfg.enable_hdrf):
+            raise ValueError(
+                "batch_jobs > 1 on the static-keys path requires static "
+                "ordering keys (no drf/hdrf dynamic ordering); use "
+                "batch_rounds (derive_batching sets it) for dynamic keys")
+        aff_shapes = (extras.affinity.sk_domain.shape[0],
+                      extras.affinity.eta_domain.shape[0],
+                      extras.affinity.task_match.shape[0])
+        Q = extras.queue_deserved.shape[0]
+        S_ns = extras.ns_share.shape[0]
         if cfg.use_pallas == "interpret":
             use_pallas, interp = True, True
         elif cfg.use_pallas is None:
@@ -431,25 +493,24 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 backend = jax.default_backend()
             except Exception:
                 backend = "unavailable"
-            # The fused placer has no affinity-count state; affinity cycles
-            # run the scan path (the reference's InterPodAffinity disables
-            # its predicate cache the same way, predicates.go:244-255).
+            vmem = vmem_estimate_bytes(
+                K, M, N, R, G, n_templates, GR,
+                *(aff_shapes if cfg.enable_pod_affinity else (0, 0, 0)),
+                J=J if KP else 0, Q=Q if KP else 0)
             use_pallas = (backend in ("tpu", "axon") and N % 128 == 0
-                          and not cfg.enable_pod_affinity
                           and not cfg.enable_host_ports
-                          and vmem_estimate_bytes(K, M, N, R, G,
-                                                  n_templates, GR)
-                          < 12 * 2 ** 20)
+                          and vmem < 12 * 2 ** 20)
             interp = False
         else:
             use_pallas, interp = bool(cfg.use_pallas), False
-        if use_pallas and (cfg.enable_pod_affinity or cfg.enable_host_ports):
+        if use_pallas and cfg.enable_host_ports:
             raise ValueError(
-                "use_pallas excludes enable_pod_affinity/enable_host_ports: "
-                "the fused round placer carries no affinity-count or "
-                "host-port state")
+                "use_pallas excludes enable_host_ports: the fused round "
+                "placer carries no host-port state")
         if not use_pallas:
             K = 1
+            KP = 0
+        dyn = use_pallas and KP > 0
 
         if use_pallas:
             # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
@@ -462,6 +523,15 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 pods_extra=jnp.zeros((1, N), jnp.float32),
                 gpu_extra=jnp.zeros((G, N), jnp.float32),
             )
+            if cfg.enable_pod_affinity:
+                # live inter-pod affinity counts, VMEM-split layout:
+                # [SK, N] node-space counts + [SK, 1] cluster totals
+                # (the first-pod-escape column of arrays/affinity.cnt0)
+                init_cap.update(
+                    aff_cnt=extras.affinity.cnt0[:, :N],
+                    aff_tot=extras.affinity.cnt0[:, N:],
+                    anti_cnt=extras.affinity.anti_cnt0,
+                )
         else:
             init_cap = dict(
                 idle=nodes.idle,
@@ -472,6 +542,19 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 saved_pipe=jnp.zeros((N, R), jnp.float32),
                 saved_pods=jnp.zeros(N, jnp.int32),
                 saved_gpu=jnp.zeros((N, G), jnp.float32),
+                # live inter-pod affinity counts (neutral [1,..] when off)
+                aff_cnt=extras.affinity.cnt0,
+                anti_cnt=extras.affinity.anti_cnt0,
+                saved_aff=extras.affinity.cnt0,
+                saved_anti=extras.affinity.anti_cnt0,
+                # in-cycle hostPort placements (neutral [1] when disabled;
+                # the pallas paths exclude enable_host_ports entirely)
+                pe_node=extras.pe_node0,
+                pe_port=extras.pe_port0,
+                pe_cnt=jnp.int32(0),
+                saved_pe_node=extras.pe_node0,
+                saved_pe_port=extras.pe_port0,
+                saved_pe_cnt=jnp.int32(0),
             )
         init = dict(
             task_node=jnp.full(T, -1, jnp.int32),
@@ -493,18 +576,6 @@ def make_allocate_cycle(cfg: AllocateConfig):
             # only runs after a stalled round (zero per-round cost on the
             # saturating hot path)
             progressed=jnp.bool_(True),
-            # live inter-pod affinity counts (neutral [1,1] when disabled)
-            aff_cnt=extras.affinity.cnt0,
-            anti_cnt=extras.affinity.anti_cnt0,
-            saved_aff=extras.affinity.cnt0,
-            saved_anti=extras.affinity.anti_cnt0,
-            # in-cycle hostPort placements (neutral [1] when disabled)
-            pe_node=extras.pe_node0,
-            pe_port=extras.pe_port0,
-            pe_cnt=jnp.int32(0),
-            saved_pe_node=extras.pe_node0,
-            saved_pe_port=extras.pe_port0,
-            saved_pe_cnt=jnp.int32(0),
             **init_cap,
         )
 
@@ -526,9 +597,20 @@ def make_allocate_cycle(cfg: AllocateConfig):
                              extras.or_feasible[jnp.maximum(grp, 0)], True)
 
         if use_pallas:
-            from .pallas_place import make_round_placer
-            placer = make_round_placer(cfg, K, M, N, R, G, GR,
-                                       interpret=interp)
+            from .pallas_place import (make_dyn_round_placer,
+                                       make_round_placer)
+            SK, ETA, SEL = aff_shapes
+            aff_dims = (SK, ETA) if cfg.enable_pod_affinity else None
+            NH = (2 * extras.hierarchy.queue_path.shape[1]
+                  if cfg.enable_hdrf else 0)
+            if dyn:
+                placer = make_dyn_round_placer(
+                    cfg, K, KP, M, N, R, G, GR, J, Q, S_ns, NH,
+                    aff_dims=aff_dims, interpret=interp)
+            else:
+                placer = make_round_placer(cfg, K, M, N, R, G, GR,
+                                           aff_dims=aff_dims,
+                                           interpret=interp)
             relmp_t = (nodes.releasing - nodes.pipelined).T
             alloc_t = nodes.allocatable.T
             cnt_row = nodes.pod_count.astype(jnp.float32)[None, :]
@@ -552,6 +634,104 @@ def make_allocate_cycle(cfg: AllocateConfig):
             bonus_row = extras.tdm_bonus.astype(jnp.float32)[None, :]
             locked_row = extras.node_locked.astype(jnp.float32)[None, :]
             orfeas_f = extras.or_feasible.astype(jnp.float32)
+
+            def node_env_args():
+                out = [tstat_f, tp_static, na_f, blocknr_row, blockall_row,
+                       bonus_row, locked_row, orfeas_f, relmp_t, alloc_t,
+                       cnt_row, maxp_row]
+                if cfg.enable_gpu:
+                    out.append(gidle0_t)
+                return out
+
+            if cfg.enable_pod_affinity:
+                # static affinity maps in kernel layout (arrays/affinity.py
+                # node-space encoding; counts split into [SK, N] + totals)
+                afa = extras.affinity
+                aff_static_args = [
+                    (nodes.valid & nodes.schedulable).astype(
+                        jnp.float32)[None, :],
+                    afa.sk_domain,
+                    afa.sk_sel[:, None],
+                    afa.eta_sk[None, :],
+                    afa.eta_domain,
+                    afa.static_pref,
+                ]
+
+                def aff_slot_args(flat_ids):
+                    """Per-launch slot gathers of the task-side term
+                    tables (the only [.., CM] affinity traffic a round
+                    ships; everything node-shaped stays resident)."""
+                    f32 = jnp.float32
+                    return [
+                        afa.task_aff_sk[flat_ids].T,
+                        afa.task_anti_term[flat_ids].T,
+                        afa.task_pref_sk[flat_ids].T,
+                        afa.task_pref_w[flat_ids].T,
+                        afa.task_match[jnp.maximum(afa.sk_sel, 0)][
+                            :, flat_ids].astype(f32),
+                        ((afa.eta_sel >= 0)[:, None]
+                         & afa.task_match[jnp.maximum(afa.eta_sel, 0)][
+                             :, flat_ids]).astype(f32),
+                        afa.task_match[:, flat_ids].astype(f32),
+                    ]
+
+                def aff_state_args(st):
+                    return [st["aff_cnt"], st["aff_tot"], st["anti_cnt"]]
+            else:
+                def aff_slot_args(flat_ids):
+                    return []
+
+                def aff_state_args(st):
+                    return []
+                aff_static_args = []
+
+        if dyn:
+            # ---- static per-job inputs of the dynamic-key kernel ---------
+            # static key columns in the exact order _dyn_kernel's reader
+            # walks them (the dynamic ones — qshare, ready_now, and the
+            # drf job/ns shares when enabled — are recomputed in VMEM)
+            skey_rows = []
+            if not cfg.drf_ns_order:
+                skey_rows.append(extras.ns_share[jobs.namespace])
+            skey_rows.append(jobs.namespace.astype(jnp.float32))
+            skey_rows.append(jobs.queue.astype(jnp.float32))
+            skey_rows.append(-jobs.priority.astype(jnp.float32))
+            if cfg.tdm_job_order:
+                skey_rows.append(jobs.preemptable.astype(jnp.float32))
+            if cfg.sla_job_order:
+                skey_rows.append(extras.job_deadline)
+            if not cfg.drf_job_order:
+                skey_rows.append(extras.job_share)
+            skey_rows.append(jobs.creation_rank.astype(jnp.float32))
+            skeys_mat = jnp.stack(skey_rows).astype(jnp.float32)
+            qid_row = jobs.queue.astype(jnp.int32)[None, :]
+            qoh_mat = (jnp.arange(Q, dtype=jnp.int32)[:, None]
+                       == jobs.queue[None, :]).astype(jnp.float32)
+            ns_args = []
+            if cfg.drf_ns_order:
+                nsm = (jnp.arange(S_ns, dtype=jnp.int32)[:, None]
+                       == jobs.namespace[None, :])
+                ns_args = [nsm.astype(jnp.float32),
+                           (nsm & jobs.valid[None, :]).astype(jnp.float32),
+                           snap.namespace_weight.astype(jnp.float32)[None, :]]
+            minav_row = jobs.min_available.astype(jnp.int32)[None, :]
+            rdy0_row = jobs.ready_num.astype(jnp.int32)[None, :]
+            npend_row = jobs.n_pending.astype(jnp.int32)[None, :]
+            eligs_row = (jobs.valid & jobs.schedulable).astype(
+                jnp.int32)[None, :]
+            validf_row = jobs.valid.astype(jnp.float32)[None, :]
+            # re-pop fusion flag per job (see the scan path's can_batch):
+            # static keys AND no finite deserved on the job's queue
+            keys_static_cfg = not (cfg.drf_job_order or cfg.drf_ns_order
+                                   or cfg.enable_hdrf)
+            canb_row = (jnp.bool_(keys_static_cfg)
+                        & ~jnp.any(jnp.isfinite(
+                            extras.queue_deserved[jobs.queue]), axis=1)
+                        ).astype(jnp.int32)[None, :]
+            qex_col = extras.queue_share_extra.astype(jnp.float32)[:, None]
+            total_col = total_cap.astype(jnp.float32)[:, None]
+            tgt_in = jnp.asarray(extras.target_job,
+                                 jnp.int32).reshape(1, 1)
 
         def eligible(st):
             # Overused queues are skipped (proportion.Overused,
@@ -677,6 +857,137 @@ def make_allocate_cycle(cfg: AllocateConfig):
                                or cfg.enable_hdrf)
             slots = jnp.arange(M, dtype=jnp.int32)
 
+            if dyn:
+                # ---- dynamic-key path: up to KP in-kernel pops over K
+                # candidate jobs, fairness keys recomputed in VMEM after
+                # every commit (pallas_place._dyn_kernel). Candidates are
+                # this launch's K lexicographically-smallest eligible
+                # jobs; the kernel stops early the moment the true next
+                # pop is not one of them, so decisions replay the
+                # sequential order exactly.
+                jis = []
+                elig_k = elig
+                jidx = jnp.arange(J, dtype=jnp.int32)
+                for _ in range(K):
+                    ji_k, found_k = lex_argmin(keys, elig_k)
+                    ji_k = jnp.where(found_k, ji_k, -1)
+                    jis.append(ji_k)
+                    elig_k = elig_k & (jidx != ji_k)
+                ji_vec = jnp.stack(jis).astype(jnp.int32)        # [C]
+                jsafe = jnp.maximum(ji_vec, 0)
+                cslot = jnp.full(J, -1, jnp.int32).at[
+                    jnp.where(ji_vec >= 0, jsafe, J)].set(
+                    jnp.arange(K, dtype=jnp.int32), mode="drop")[None, :]
+                task_ids = jobs.task_table[jsafe]                # [C, M]
+                tcl = jnp.maximum(task_ids, 0)
+                flat_ids = tcl.reshape(K * M)
+                tid_ok = task_ids >= 0
+                nbe = ~tasks.best_effort[tcl]
+                # cursor-independent suffix: real non-best-effort slots
+                # strictly after m (for attempted slots m >= cursor this
+                # equals the scan path's open-slot suffix)
+                nbreal = tid_ok & nbe
+                rc = jnp.cumsum(nbreal[:, ::-1].astype(jnp.int32),
+                                axis=1)[:, ::-1]
+                suffix_after = rc - nbreal.astype(jnp.int32)
+                args = [tasks.resreq[flat_ids].T]
+                if cfg.enable_gpu:
+                    args.append(tasks.gpu_request[flat_ids][None, :])
+                args += [
+                    extras.task_pref_node[flat_ids][None, :],
+                    suffix_after.reshape(1, K * M),
+                    jnp.maximum(tasks.template[flat_ids], 0)[None, :],
+                    extras.task_or_group[flat_ids][None, :],
+                    extras.task_volume_node[flat_ids][None, :],
+                    extras.task_volume_ok[flat_ids][None, :]
+                    .astype(jnp.int32),
+                    extras.task_revocable[flat_ids][None, :]
+                    .astype(jnp.int32),
+                    tid_ok.reshape(1, K * M).astype(jnp.int32),
+                    nbe.reshape(1, K * M).astype(jnp.int32),
+                    ji_vec[None, :],
+                    cslot,
+                    skeys_mat,
+                ]
+                if cfg.enable_hdrf:
+                    # frozen per-launch hdrf columns (guarded in-kernel)
+                    args.append(jnp.stack(
+                        [hcols[:, c][job_q]
+                         for c in range(int(hcols.shape[1]))]
+                    ).astype(jnp.float32))
+                args += [qid_row, qoh_mat] + ns_args + [
+                    minav_row, rdy0_row, npend_row, eligs_row,
+                    validf_row, canb_row, queue_deserved, qex_col,
+                    total_col,
+                    jnp.minimum(jnp.int32(KP), max_rounds - st["rounds"])
+                    .astype(jnp.int32).reshape(1, 1),
+                    tgt_in,
+                ]
+                args += node_env_args()
+                args += aff_static_args + aff_slot_args(flat_ids)
+                args += [st["idle"], st["pipe_extra"], st["pods_extra"]]
+                if cfg.enable_gpu:
+                    args.append(st["gpu_extra"])
+                args += aff_state_args(st)
+                done_in = st["job_done"] | give_up
+                popped_in = st["job_popped"] | give_up
+                args += [
+                    done_in.astype(jnp.int32)[None, :],
+                    popped_in.astype(jnp.int32)[None, :],
+                    st["job_ready"].astype(jnp.int32)[None, :],
+                    st["job_pipelined"].astype(jnp.int32)[None, :],
+                    st["job_cursor"][None, :],
+                    st["job_alloc_count"][None, :],
+                    st["job_alloc_dyn"].T,
+                    st["queue_allocated"],
+                ]
+                outs = placer(*args)
+                node_s, mode_s, gpu_s = outs[0][0], outs[1][0], outs[2][0]
+                idle, pipe_extra, pods_extra = outs[3], outs[4], outs[5]
+                o = 6
+                if cfg.enable_gpu:
+                    gpu_extra = outs[o]
+                    o += 1
+                else:
+                    gpu_extra = st["gpu_extra"]
+                aff_upd = {}
+                if cfg.enable_pod_affinity:
+                    aff_upd = dict(aff_cnt=outs[o], aff_tot=outs[o + 1],
+                                   anti_cnt=outs[o + 2])
+                    o += 3
+                (done_o, popped_o, ready_o, pipe_o, cursor_o, acount_o,
+                 jalloc_o, qalloc_o, pops_o, prog_o) = outs[o:o + 10]
+                node_km = node_s.reshape(K, M)
+                mode_km = mode_s.reshape(K, M)
+                gpu_km = gpu_s.reshape(K, M)
+                placed_m = mode_km != MODE_NONE
+                widx = jnp.where(tid_ok & placed_m, task_ids, T)
+                wflat = widx.reshape(K * M)
+                t_node = st["task_node"].at[wflat].set(
+                    node_km.reshape(K * M), mode="drop")
+                t_mode = st["task_mode"].at[wflat].set(
+                    mode_km.reshape(K * M), mode="drop")
+                t_gpu = st["task_gpu"].at[wflat].set(
+                    gpu_km.reshape(K * M), mode="drop")
+                return dict(
+                    idle=idle, pipe_extra=pipe_extra,
+                    pods_extra=pods_extra, gpu_extra=gpu_extra,
+                    task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
+                    **aff_upd,
+                    job_done=done_o[0] > 0,
+                    job_popped=popped_o[0] > 0,
+                    job_ready=ready_o[0] > 0,
+                    job_pipelined=pipe_o[0] > 0,
+                    job_cursor=cursor_o[0],
+                    job_alloc_count=acount_o[0],
+                    job_alloc_dyn=jalloc_o.T,
+                    queue_allocated=qalloc_o,
+                    # pop-0 forcing guarantees >= 1 pop per launch; the
+                    # maximum is a belt-and-braces termination bound
+                    rounds=st["rounds"] + jnp.maximum(pops_o[0, 0], 1),
+                    progressed=prog_o[0, 0] > 0,
+                )
+
             if use_pallas:
                 # ---- K batched pops, one fused kernel launch -------------
                 jis = []
@@ -729,7 +1040,6 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 if cfg.enable_gpu:
                     args.append(tasks.gpu_request[flat_ids][None, :])
                 args += [
-                    nb.reshape(1, K * M).astype(jnp.int32),
                     extras.task_pref_node[flat_ids][None, :],
                     suffix_after.reshape(1, K * M),
                     # clamped: padded slots carry template -1, and the
@@ -741,27 +1051,31 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     .astype(jnp.int32),
                     extras.task_revocable[flat_ids][None, :]
                     .astype(jnp.int32),
+                    nb.reshape(1, K * M).astype(jnp.int32),
                     ready0_vec[None, :], minav_vec[None, :],
                     canb_vec[None, :].astype(jnp.int32),
                     secact[None, :].astype(jnp.int32),
                     istgt[None, :].astype(jnp.int32),
-                    tstat_f, tp_static, na_f,
-                    blocknr_row, blockall_row, bonus_row, locked_row,
-                    orfeas_f, relmp_t, alloc_t, cnt_row, maxp_row,
                 ]
-                if cfg.enable_gpu:
-                    args.append(gidle0_t)
+                args += node_env_args()
+                args += aff_static_args + aff_slot_args(flat_ids)
                 args += [st["idle"], st["pipe_extra"], st["pods_extra"]]
                 if cfg.enable_gpu:
                     args.append(st["gpu_extra"])
+                args += aff_state_args(st)
                 outs = placer(*args)
+                node_s, mode_s, gpu_s = outs[0], outs[1], outs[2]
+                idle, pipe_extra, pods_extra = outs[3], outs[4], outs[5]
+                o = 6
                 if cfg.enable_gpu:
-                    (node_s, mode_s, gpu_s, idle, pipe_extra, pods_extra,
-                     gpu_extra) = outs
+                    gpu_extra = outs[o]
+                    o += 1
                 else:
-                    (node_s, mode_s, gpu_s, idle, pipe_extra,
-                     pods_extra) = outs
                     gpu_extra = st["gpu_extra"]
+                aff_upd = {}
+                if cfg.enable_pod_affinity:
+                    aff_upd = dict(aff_cnt=outs[o], aff_tot=outs[o + 1],
+                                   anti_cnt=outs[o + 2])
 
                 node_km = node_s.reshape(K, M)
                 mode_km = mode_s.reshape(K, M)
@@ -829,14 +1143,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 return dict(
                     idle=idle, pipe_extra=pipe_extra,
                     pods_extra=pods_extra, gpu_extra=gpu_extra,
-                    aff_cnt=st["aff_cnt"], anti_cnt=st["anti_cnt"],
-                    saved_aff=st["saved_aff"], saved_anti=st["saved_anti"],
-                    pe_node=st["pe_node"], pe_port=st["pe_port"],
-                    pe_cnt=st["pe_cnt"],
-                    saved_pe_node=st["saved_pe_node"],
-                    saved_pe_port=st["saved_pe_port"],
-                    saved_pe_cnt=st["saved_pe_cnt"],
                     task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
+                    **aff_upd,
                     job_done=(st["job_done"] | give_up).at[jdrop].set(
                         ~stopped_vec, mode="drop"),
                     job_popped=(st["job_popped"] | give_up).at[jdrop].set(

@@ -1,12 +1,13 @@
-"""Pallas TPU kernel: fused placement rounds of the allocate pass.
+"""Pallas TPU kernels: fused placement rounds of the allocate pass.
 
 The hot inner loop of the cycle places the pending tasks of selected gangs
 one by one (capacity feedback between placements is what makes the pass
 exact, SURVEY.md section 7 hard part 1). The pure-XLA path runs it as a
 ``lax.scan`` whose every step issues ~40 small HLO ops over [N]-shaped
-arrays; this kernel fuses WHOLE placement rounds into one ``pl.pallas_call``
-with the capacity state (idle, pipelined-extra, pod counts, per-GPU-card
-usage) resident in VMEM across all placements.
+arrays; these kernels fuse WHOLE placement rounds into one
+``pl.pallas_call`` with the capacity state (idle, pipelined-extra, pod
+counts, per-GPU-card usage — and, new in v3, the live inter-pod affinity
+counts) resident in VMEM across all placements.
 
 v2 design (on top of the round-fused v1):
 
@@ -23,14 +24,45 @@ v2 design (on top of the round-fused v1):
   round-trip. Batching K > 1 is bit-exact with the sequential pop order iff
   the job-ordering keys are static over commits — no drf/hdrf dynamic
   ordering and no finite proportion ``deserved`` (see
-  AllocateConfig.batch_jobs; the session only enables it when those hold).
+  allocate_scan.derive_batching, the single authority for the rule).
 - **Optional GPU path** (``enable_gpu`` static): snapshots with no shared-GPU
   requests skip the per-card state entirely (decision-neutral: a zero
   gpu_request never charges a card, gpu.go:41-56).
 
+v3 design (this round):
+
+- **Affinity state in VMEM** (``enable_pod_affinity`` static): the live
+  inter-pod affinity counts (``arrays/affinity.py`` node-space encoding,
+  split as cnt[SK, N] + cluster-total[SK, 1] and anti_cnt[ETA, N]) are
+  kernel loop state with per-section commit/discard, and the dynamic
+  affinity predicate + preferred-term scorer run in-kernel — config-5
+  cycles stop re-materializing [M, N] gathers in XLA every round. All
+  affinity accumulations are integer-valued counts/weights, so f32 sums
+  are exact in any order and the kernel matches the scan path bitwise.
+- **Dynamic-key batched pops** (``_dyn_kernel`` / make_dyn_round_placer):
+  for configs whose job-ordering keys move with commits (drf/hdrf dynamic
+  ordering, finite proportion deserved), job SELECTION moves into the
+  kernel: each launch runs up to KP sequential pops, recomputing the
+  dynamic fairness keys (drf job dominant share, drf namespace share,
+  proportion qshare/overused — the ops/fairshare.py share math ported to
+  VMEM layouts) from the live in-kernel allocation state after every gang
+  commit, exactly as the scan path recomputes them per pop. Task data for
+  the C candidate jobs is pre-gathered by XLA; a pop whose
+  lexicographic argmin is NOT one of the candidates stops the launch
+  early and hands back to XLA (which re-selects candidates from the
+  committed state), so decisions are bit-identical to the sequential pop
+  order by construction. hdrf level keys are the one component NOT
+  recomputed in-kernel (the tree update is a multi-level segment
+  reduction, measured off-budget in VMEM): they are frozen per launch and
+  guarded — a pop after any commit proceeds only while the eligible set
+  spans a single queue (then the frozen per-queue columns are constant
+  across all contenders and cannot affect the argmin); otherwise the
+  launch stops. See docs/architecture.md "Batched dynamic-key rounds".
+
 Layout: node-axis tensors are transposed to [R, N] / [G, N] / [P, N] so the
 node axis is the 128-lane dimension (R/G/P are small; [N, R] would waste 32x
-lanes).
+lanes). Per-job key state is [R, J] / [1, J] (J lanes); per-queue state is
+[Q, R] (queue on sublanes so per-queue reductions land in [Q, 1] columns).
 
 Semantics are bit-identical to the scan path in allocate_scan.task_step
 (asserted by tests/test_pallas_place.py): same feasibility conjunction, same
@@ -52,6 +84,12 @@ from .allocate_scan import MODE_ALLOCATED, MODE_NONE, MODE_PIPELINED
 _EPS_FIT = 1e-5     # predicates._EPS
 _EPS_DIV = 1e-9     # scoring._EPS
 NEG = -1e30         # select.NEG
+_BIG = 3.4e38   # allocate_scan._affinity_terms normalize (python float:
+#                 a jnp scalar here would be a captured constant in pallas)
+
+
+class _NS:
+    """Plain namespace for kernel-side loaded refs/values."""
 
 
 def _dyn_score(cfg, idle, alloc_t, rr_col):
@@ -100,6 +138,317 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
     return score
 
 
+def _seli(row, idx, iota):
+    """mosaic has no dynamic lane indexing: scalar = one-hot reduce."""
+    return jnp.sum(jnp.where(iota == idx, row, 0))
+
+
+def _self(row, idx, iota):
+    return jnp.sum(jnp.where(iota == idx, row, 0.0))
+
+
+# --------------------------------------------------------------------------
+# shared ref readers — the builder functions emit args in EXACTLY this order
+# --------------------------------------------------------------------------
+
+def _read_slot_env(cfg, nxt, env):
+    """Per-slot ([1, CM] / [R, CM]) rows shared by both kernels."""
+    env.resreq_t = nxt()[:]                       # [R, CM]
+    env.gpu_req = nxt()[:] if env.gpu else None   # [1, CM]
+    env.pref_v = nxt()[:]                         # [1, CM] i32
+    env.suffix_v = nxt()[:]                       # [1, CM] i32
+    env.tmpl_v = nxt()[:]                         # [1, CM] i32 (clamped)
+    env.grp_v = nxt()[:]                          # [1, CM] i32 (-1 none)
+    env.voln_v = nxt()[:]                         # [1, CM] i32 (-1 any)
+    env.volok_v = nxt()[:]                        # [1, CM] i32
+    env.rev_v = nxt()[:]                          # [1, CM] i32
+
+
+def _read_node_env(cfg, nxt, env):
+    """Static node-space maps shared by both kernels."""
+    env.tstat_ref = nxt()      # [P, N] f32 template static feasibility
+    env.tscore_ref = nxt()     # [P, N] f32 taint-prefer static score
+    env.nascore_ref = nxt()    # [P, N] f32 NodeAffinity preferred score
+    env.blocknr = nxt()[:] > 0   # [1, N] tdm block-nonrevocable
+    env.blockall = nxt()[:] > 0  # [1, N] tdm block-all
+    env.bonus = nxt()[:]         # [1, N] f32 tdm revocable bonus
+    env.locked = nxt()[:] > 0    # [1, N] reservation node locks
+    env.orfeas_ref = nxt()     # [GR, N] f32 OR-of-terms group feasibility
+    env.relmp = nxt()[:]       # [R, N] releasing - pipelined
+    env.alloc_t = nxt()[:]     # [R, N]
+    env.cnt = nxt()[:]         # [1, N]
+    env.maxp = nxt()[:]        # [1, N]
+    env.gidle0 = nxt()[:] if env.gpu else None    # [G, N]
+
+
+def _read_aff_env(nxt, env):
+    """Inter-pod affinity refs (only when cfg.enable_pod_affinity)."""
+    a = _NS()
+    a.live = nxt()[:] > 0      # [1, N] valid & schedulable nodes
+    a.skdom_ref = nxt()        # [SK, N] i32 node's domain per (sel,key)
+    a.sk_sel_col = nxt()[:]    # [SK, 1] i32
+    a.eta_sk_row = nxt()[:]    # [1, ETA] i32
+    a.eta_dom_ref = nxt()      # [ETA, N] i32
+    a.static_pref = nxt()[:]   # [SEL, N] f32 symmetric preferred map
+    a.aff_sk_ref = nxt()       # [A, CM] i32 required-affinity pair slots
+    a.anti_ref = nxt()         # [B, CM] i32 own required-anti term slots
+    a.prefsk_ref = nxt()       # [PP, CM] i32 preferred pair slots
+    a.prefw_ref = nxt()        # [PP, CM] f32 preferred weights
+    a.skm_ref = nxt()          # [SK, CM] f32 task_match[sk_sel] per slot
+    a.etm_ref = nxt()          # [ETA, CM] f32 (eta_sel>=0)&match per slot
+    a.selm_ref = nxt()         # [SEL, CM] f32 task_match per slot
+    a.SK = a.skdom_ref.shape[0]
+    a.ETA = a.eta_dom_ref.shape[0]
+    a.SEL = a.static_pref.shape[0]
+    a.A = a.aff_sk_ref.shape[0]
+    a.B = a.anti_ref.shape[0]
+    a.PP = a.prefsk_ref.shape[0]
+    a.iota_eta = jax.lax.broadcasted_iota(jnp.int32, (1, a.ETA), 1)
+    a.iota_eta_sub = jax.lax.broadcasted_iota(jnp.int32, (a.ETA, 1), 0)
+    a.iota_sk_sub = jax.lax.broadcasted_iota(jnp.int32, (a.SK, 1), 0)
+    env.aff = a
+
+
+def _aff_eval(cfg, env, sel_s, aff_state):
+    """InterPodAffinity feasibility mask + normalized score for slot
+    ``sel_s`` against the LIVE in-kernel counts — the VMEM port of
+    allocate_scan._affinity_terms (same conjunctions; the weighted count
+    sums are integer-valued so f32 accumulation order cannot change them).
+    """
+    a = env.aff
+    aff_cnt, aff_tot, anti_cnt = aff_state
+    N = env.N
+
+    def row_at(mat, idx, iota_sub):
+        # dynamic sublane pick from a loop-carried VALUE (refs take
+        # pl.dslice, values don't): one-hot select-reduce, exact because
+        # exactly one row contributes
+        return jnp.sum(jnp.where(iota_sub == idx, mat, 0.0), axis=0,
+                       keepdims=True)
+
+    # required affinity: domain must already hold a matching pod; k8s
+    # first-pod escape via the cluster-total column
+    ok_acc = jnp.ones((1, N), bool)
+    for i in range(a.A):
+        ska = jnp.sum(a.aff_sk_ref[(pl.dslice(i, 1), slice(None))]
+                      * sel_s.astype(jnp.int32))
+        act_a = ska >= 0
+        skc = jnp.maximum(ska, 0)
+        have = row_at(aff_cnt, skc, a.iota_sk_sub)            # [1, N]
+        tot = jnp.sum(aff_tot * (a.iota_sk_sub == skc))
+        dom = a.skdom_ref[(pl.dslice(skc, 1), slice(None))]   # [1, N]
+        match_a = jnp.sum(a.skm_ref[(pl.dslice(skc, 1), slice(None))]
+                          * sel_s) > 0
+        ok = (have > 0) & (dom >= 0)
+        ok = ok | ((tot == 0) & match_a & (dom >= 0))
+        ok_acc &= ok | ~act_a
+    aff_ok = ok_acc
+
+    # required anti-affinity: own terms vs pods already counted
+    viol_own = jnp.zeros((1, N), bool)
+    for i in range(a.B):
+        etab = jnp.sum(a.anti_ref[(pl.dslice(i, 1), slice(None))]
+                       * sel_s.astype(jnp.int32))
+        bact = etab >= 0
+        ec = jnp.maximum(etab, 0)
+        eskb = jnp.maximum(jnp.sum(jnp.where(a.iota_eta == ec,
+                                             a.eta_sk_row, 0)), 0)
+        cnt_b = row_at(aff_cnt, eskb, a.iota_sk_sub)          # [1, N]
+        dom_b = a.eta_dom_ref[(pl.dslice(ec, 1), slice(None))]
+        viol_own |= bact & (cnt_b > 0) & (dom_b >= 0)
+
+    # required anti-affinity: placed pods' terms vs this task (symmetric)
+    m_eta = jnp.sum(jnp.where(sel_s > 0, a.etm_ref[:], 0.0),
+                    axis=1, keepdims=True)                    # [ETA, 1]
+    viol_sym = jnp.any((m_eta > 0) & (anti_cnt > 0)
+                       & (a.eta_dom_ref[:] >= 0), axis=0, keepdims=True)
+
+    feas = aff_ok & ~viol_own & ~viol_sym
+
+    # preferred terms of the incoming task (dynamic counts); stacked then
+    # summed like the scan path's jnp.sum over the PP axis — exact either
+    # way (integer-valued addends)
+    rows = []
+    for i in range(a.PP):
+        pskp = jnp.sum(a.prefsk_ref[(pl.dslice(i, 1), slice(None))]
+                       * sel_s.astype(jnp.int32))
+        pw = jnp.sum(a.prefw_ref[(pl.dslice(i, 1), slice(None))] * sel_s)
+        pact = pskp >= 0
+        pskc = jnp.maximum(pskp, 0)
+        cnt_p = row_at(aff_cnt, pskc, a.iota_sk_sub)
+        dom_p = a.skdom_ref[(pl.dslice(pskc, 1), slice(None))]
+        rows.append(jnp.where(pact & (dom_p >= 0), pw * cnt_p, 0.0))
+    raw = rows[0]
+    for r in rows[1:]:
+        raw = raw + r
+    # symmetric preferred from snapshot pods (node-space static map)
+    mcol = jnp.sum(jnp.where(sel_s > 0, a.selm_ref[:], 0.0),
+                   axis=1, keepdims=True)                     # [SEL, 1]
+    raw = raw + jnp.sum(mcol * a.static_pref, axis=0, keepdims=True)
+
+    # min-max normalize over schedulable nodes -> 0..100 (k8s NormalizeScore)
+    mx = jnp.max(jnp.where(a.live, raw, -_BIG))
+    mn = jnp.min(jnp.where(a.live, raw, _BIG))
+    span = mx - mn
+    norm = jnp.where(span > 0,
+                     (raw - mn) * (100.0 / jnp.maximum(span, 1e-9)), 0.0)
+    return feas, norm
+
+
+def _aff_commit(env, sel_s, node_onehot, placed, aff_state):
+    """Account a placement in the live counts — the VMEM port of
+    allocate_scan._affinity_place_update (domain-membership mask adds)."""
+    a = env.aff
+    aff_cnt, aff_tot, anti_cnt = aff_state
+    skdom = a.skdom_ref[:]                                    # [SK, N]
+    # node_onehot selects exactly one lane; masked lanes contribute 0 and a
+    # missing key is -1, so select via sum of (value + 1) - 1 to keep -1
+    dom_at = jnp.sum(jnp.where(node_onehot > 0, skdom + 1, 0),
+                     axis=1, keepdims=True) - 1               # [SK, 1]
+    member = (skdom == dom_at) & (skdom >= 0) & (dom_at >= 0)
+    matchc = jnp.sum(jnp.where(sel_s > 0, a.skm_ref[:], 0.0),
+                     axis=1, keepdims=True) > 0               # [SK, 1]
+    addsk = jnp.where(placed & (a.sk_sel_col >= 0) & matchc, 1.0, 0.0)
+    aff_cnt = aff_cnt + member.astype(jnp.float32) * addsk
+    aff_tot = aff_tot + (dom_at >= 0).astype(jnp.float32) * addsk
+    # the task's own required anti terms mark their presence in the domain
+    for i in range(a.B):
+        etab = jnp.sum(a.anti_ref[(pl.dslice(i, 1), slice(None))]
+                       * sel_s.astype(jnp.int32))
+        ec = jnp.maximum(etab, 0)
+        edom = a.eta_dom_ref[(pl.dslice(ec, 1), slice(None))]  # [1, N]
+        edom_at = jnp.sum(jnp.where(node_onehot > 0, edom + 1, 0)) - 1
+        emember = (edom == edom_at) & (edom >= 0) & (edom_at >= 0)
+        g = jnp.where((etab >= 0) & placed, 1.0, 0.0)
+        anti_cnt = anti_cnt + (g * emember.astype(jnp.float32)
+                               * (a.iota_eta_sub == ec))
+    return aff_cnt, aff_tot, anti_cnt
+
+
+def _make_attempt(cfg, env):
+    """Shared single-placement step: feasibility -> score -> pick ->
+    capacity/output updates for slot scalar ``s`` — the in-kernel mirror of
+    allocate_scan.task_step's per-task body. ``active``/``is_tgt`` gates are
+    caller-supplied; returns the updated state plus the event flags the
+    caller needs for yield/break/gang bookkeeping."""
+    gpu = env.gpu
+    N = env.N
+    iota_n = env.iota_n
+    iota_km = env.iota_km
+    iota_g = env.iota_g
+
+    def attempt(s, active, is_tgt, cap, aff_state, outs):
+        idle, pipe, podsx, gpux = cap
+        node_v, mode_v, gpuc_v = outs
+        sel_s = (iota_km == s).astype(jnp.float32)            # [1, CM]
+        sel_i = sel_s.astype(jnp.int32)
+        rr_col = jnp.sum(env.resreq_t * sel_s, axis=1, keepdims=True)
+        pref = jnp.sum(env.pref_v * sel_i)
+        tmpl = jnp.sum(env.tmpl_v * sel_i)
+        grp = jnp.sum(env.grp_v * sel_i)
+        voln = jnp.sum(env.voln_v * sel_i)
+        volok = jnp.sum(env.volok_v * sel_i) > 0
+        rev = jnp.sum(env.rev_v * sel_i) > 0
+
+        # static feasibility row: template mask + per-cycle node gates
+        # (the node_ok conjunction of allocate_scan.task_step)
+        trow = (pl.dslice(tmpl, 1), slice(None))
+        sfeas = env.tstat_ref[trow] > 0                       # [1, N]
+        sfeas &= ~(env.blocknr & ~rev) & ~env.blockall
+        orrow = env.orfeas_ref[(pl.dslice(jnp.maximum(grp, 0), 1),
+                                slice(None))] > 0
+        sfeas &= orrow | (grp < 0)
+        sfeas &= volok & ((voln < 0) | (iota_n == voln))
+        sfeas &= ~env.locked | is_tgt
+
+        future = jnp.maximum(idle + env.relmp - pipe, 0.0)
+        pods_ok = (env.cnt + podsx) < env.maxp
+        shared = sfeas & pods_ok
+        if gpu:
+            gr = jnp.sum(env.gpu_req * sel_s, axis=1, keepdims=True)
+            gidle = env.gidle0 - gpux
+            gpu_ok = (gr <= 0) | jnp.any(gidle >= gr - _EPS_FIT,
+                                         axis=0, keepdims=True)
+            shared &= gpu_ok
+        fit_now = jnp.all(rr_col <= idle + _EPS_FIT, axis=0,
+                          keepdims=True)
+        fit_fut = jnp.all(rr_col <= future + _EPS_FIT, axis=0,
+                          keepdims=True)
+        feas_now = shared & fit_now
+        feas_fut = shared & fit_fut
+
+        # f32 addition order matches allocate_scan exactly:
+        # dyn terms, then taint-static, then (nodeaffinity + rev*bonus),
+        # then task-topology preference, then the affinity scorer
+        score = _dyn_score(cfg, idle, env.alloc_t, rr_col)
+        score = score + env.tscore_ref[trow]
+        score = score + (env.nascore_ref[trow]
+                         + jnp.where(rev, env.bonus, 0.0))
+        score = score + jnp.where((pref >= 0) & (iota_n == pref),
+                                  100.0, 0.0)
+        if cfg.enable_pod_affinity:
+            aff_feas, aff_score = _aff_eval(cfg, env, sel_s, aff_state)
+            feas_now &= aff_feas
+            feas_fut &= aff_feas
+            score = score + cfg.pod_affinity_weight * aff_score
+
+        def pick(feas):
+            masked = jnp.where(feas, score, NEG)
+            best = jnp.max(masked)
+            idx = jnp.min(jnp.where(masked == best, iota_n, N))
+            found = jnp.max(feas.astype(jnp.int32)) > 0
+            return idx, found
+
+        n_now, found_now = pick(feas_now)
+        n_fut, found_fut = pick(feas_fut)
+        can_now = found_now & active
+        can_fut = found_fut & active & bool(cfg.enable_pipelining)
+        do_alloc = can_now
+        do_pipe = (~can_now) & can_fut
+        placed = do_alloc | do_pipe
+        node = jnp.where(do_alloc, n_now, n_fut)
+
+        onehot = (iota_n == node).astype(jnp.float32)         # [1, N]
+        idle = idle - jnp.where(do_alloc, 1.0, 0.0) * rr_col * onehot
+        pipe = pipe + jnp.where(do_pipe, 1.0, 0.0) * rr_col * onehot
+        podsx = podsx + jnp.where(placed, 1.0, 0.0) * onehot
+
+        if gpu:
+            # lowest fitting card on the chosen node (pick_gpu_row)
+            gcol = jnp.sum(gidle * onehot, axis=1, keepdims=True)  # [G, 1]
+            gfits = gcol >= gr - _EPS_FIT
+            card = jnp.min(jnp.where(gfits, iota_g, env.G))
+            ok_pick = (jnp.max(gfits.astype(jnp.int32)) > 0) \
+                & (gr[0, 0] > 0)
+            card = jnp.where(ok_pick, card, -1)
+            charge = placed & (card >= 0)
+            gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
+                           * (iota_g == jnp.maximum(card, 0)) * onehot)
+        else:
+            card = jnp.int32(-1)
+            charge = jnp.bool_(False)
+
+        mode = jnp.where(do_alloc, MODE_ALLOCATED,
+                         jnp.where(do_pipe, MODE_PIPELINED, MODE_NONE))
+        is_s = iota_km == s
+        node_v = jnp.where(is_s, jnp.where(placed, node, -1), node_v)
+        mode_v = jnp.where(is_s, mode, mode_v)
+        gpuc_v = jnp.where(is_s, jnp.where(charge, card, -1), gpuc_v)
+
+        if cfg.enable_pod_affinity:
+            aff_state = _aff_commit(env, sel_s, onehot, placed, aff_state)
+
+        return ((idle, pipe, podsx, gpux), aff_state,
+                (node_v, mode_v, gpuc_v),
+                placed, do_alloc, do_pipe, rr_col)
+
+    return attempt
+
+
+# --------------------------------------------------------------------------
+# static-key kernel: K pre-selected job sections per launch
+# --------------------------------------------------------------------------
+
 def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
     """K job sections x M placements, all in VMEM.
 
@@ -107,43 +456,40 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
     unpacked here to keep the signature manageable.
     """
     gpu = bool(cfg.enable_gpu)
+    aff = bool(cfg.enable_pod_affinity)
     it = iter(refs)
 
     def nxt():
         return next(it)
 
-    resreq_t_ref = nxt()      # [R, KM]
-    gpu_req_ref = nxt() if gpu else None        # [1, KM]
+    env = _NS()
+    env.gpu = gpu
+    env.N, env.M, env.R, env.G = N, M, R, G
+    KM = K * M
+    env.iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    env.iota_g = (jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+                  if gpu else None)
+    env.iota_km = jax.lax.broadcasted_iota(jnp.int32, (1, KM), 1)
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
+
+    _read_slot_env(cfg, nxt, env)
     active_ref = nxt()        # [1, KM] i32 (open & not best-effort)
-    pref_ref = nxt()          # [1, KM] i32
-    suffix_ref = nxt()        # [1, KM] i32
-    tmpl_ref = nxt()          # [1, KM] i32 template id (clamped)
-    grp_ref = nxt()           # [1, KM] i32 OR-group id (-1 none)
-    voln_ref = nxt()          # [1, KM] i32 volume pin node (-1 any)
-    volok_ref = nxt()         # [1, KM] i32 volume-bindable flag
-    rev_ref = nxt()           # [1, KM] i32 task revocable flag
     ready0_ref = nxt()        # [1, K] i32
     minav_ref = nxt()         # [1, K] i32
     canb_ref = nxt()          # [1, K] i32 can-batch (re-pop fusion) flag
     secact_ref = nxt()        # [1, K] i32 section active (ji >= 0)
     istgt_ref = nxt()         # [1, K] i32 section job == reservation target
-    tstat_ref = nxt()         # [P, N] f32 template static feasibility
-    tscore_ref = nxt()        # [P, N] f32 taint-prefer static score
-    nascore_ref = nxt()       # [P, N] f32 NodeAffinity preferred score
-    blocknr_ref = nxt()       # [1, N] f32 tdm block-nonrevocable
-    blockall_ref = nxt()      # [1, N] f32 tdm block-all
-    bonus_ref = nxt()         # [1, N] f32 tdm revocable bonus
-    locked_ref = nxt()        # [1, N] f32 reservation node locks
-    orfeas_ref = nxt()        # [GR, N] f32 OR-of-terms group feasibility
-    relmp_ref = nxt()         # [R, N] releasing - pipelined
-    alloc_t_ref = nxt()       # [R, N]
-    cnt_ref = nxt()           # [1, N]
-    maxp_ref = nxt()          # [1, N]
-    gidle0_ref = nxt() if gpu else None         # [G, N]
+    _read_node_env(cfg, nxt, env)
+    if aff:
+        _read_aff_env(nxt, env)
     idle_ref = nxt()          # [R, N] in
     pipe_ref = nxt()          # [R, N] in
     podsx_ref = nxt()         # [1, N] in
     gpux_ref = nxt() if gpu else None           # [G, N] in
+    if aff:
+        affc_ref = nxt()      # [SK, N] in
+        afft_ref = nxt()      # [SK, 1] in
+        antic_ref = nxt()     # [ETA, N] in
     node_o = nxt()            # [1, KM] out
     mode_o = nxt()            # [1, KM] out
     gpu_o = nxt()             # [1, KM] out
@@ -151,149 +497,40 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
     pipe_o = nxt()            # [R, N] out
     podsx_o = nxt()           # [1, N] out
     gpux_o = nxt() if gpu else None             # [G, N] out
+    if aff:
+        affc_o = nxt()
+        afft_o = nxt()
+        antic_o = nxt()
 
-    KM = K * M
-    relmp = relmp_ref[:]
-    alloc_t = alloc_t_ref[:]
-    cnt = cnt_ref[:]
-    maxp = maxp_ref[:]
-    resreq_t = resreq_t_ref[:]
     active_v = active_ref[:]
-    pref_v = pref_ref[:]
-    suffix_v = suffix_ref[:]
-    tmpl_v = tmpl_ref[:]
-    grp_v = grp_ref[:]
-    voln_v = voln_ref[:]
-    volok_v = volok_ref[:]
-    rev_v = rev_ref[:]
+    suffix_v = env.suffix_v
     ready0_v = ready0_ref[:]
     minav_v = minav_ref[:]
     canb_v = canb_ref[:]
     secact_v = secact_ref[:]
     istgt_v = istgt_ref[:]
-    blocknr = blocknr_ref[:] > 0
-    blockall = blockall_ref[:] > 0
-    bonus = bonus_ref[:]
-    locked = locked_ref[:] > 0
-    if gpu:
-        gpu_req = gpu_req_ref[:]
-        gidle0 = gidle0_ref[:]
 
-    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
-    iota_g = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0) if gpu else None
-    iota_km = jax.lax.broadcasted_iota(jnp.int32, (1, KM), 1)
-    iota_k = jax.lax.broadcasted_iota(jnp.int32, (1, K), 1)
-
-    def seli(row, idx, iota):
-        # mosaic has no dynamic lane indexing: scalar = one-hot reduce
-        return jnp.sum(jnp.where(iota == idx, row, 0))
+    attempt = _make_attempt(cfg, env)
 
     def job_body(k, jcarry):
         # committed (post gang-finalize) state from prior sections
-        (cidle, cpipe, cpods, cgpux, node_v, mode_v, gpuc_v) = jcarry
-        ready0 = seli(ready0_v, k, iota_k)
-        min_avail = seli(minav_v, k, iota_k)
-        can_batch = seli(canb_v, k, iota_k) > 0
-        sec_act = seli(secact_v, k, iota_k) > 0
-        is_tgt = seli(istgt_v, k, iota_k) > 0
+        (ccap, caff, outs) = jcarry
+        ready0 = _seli(ready0_v, k, iota_k)
+        min_avail = _seli(minav_v, k, iota_k)
+        can_batch = _seli(canb_v, k, iota_k) > 0
+        sec_act = _seli(secact_v, k, iota_k) > 0
+        is_tgt = _seli(istgt_v, k, iota_k) > 0
 
         def task_body(m, tcarry):
-            (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
-             n_allocs, n_pipes, stopped, broke) = tcarry
+            (cap, aff_st, outs, n_allocs, n_pipes, stopped, broke) = tcarry
             s = k * M + m
-            sel_s = (iota_km == s).astype(jnp.float32)          # [1, KM]
-            sel_i = sel_s.astype(jnp.int32)
-            rr_col = jnp.sum(resreq_t * sel_s, axis=1, keepdims=True)  # [R,1]
+            sel_i = (env.iota_km == s).astype(jnp.int32)
             act = jnp.sum(active_v * sel_i) > 0
-            pref = jnp.sum(pref_v * sel_i)
             suffix = jnp.sum(suffix_v * sel_i)
-            tmpl = jnp.sum(tmpl_v * sel_i)
-            grp = jnp.sum(grp_v * sel_i)
-            voln = jnp.sum(voln_v * sel_i)
-            volok = jnp.sum(volok_v * sel_i) > 0
-            rev = jnp.sum(rev_v * sel_i) > 0
-
-            # static feasibility row: template mask + per-cycle node gates
-            # (the node_ok conjunction of allocate_scan.task_step)
-            trow = (pl.dslice(tmpl, 1), slice(None))
-            sfeas = tstat_ref[trow] > 0                          # [1, N]
-            sfeas &= ~(blocknr & ~rev) & ~blockall
-            orrow = orfeas_ref[(pl.dslice(jnp.maximum(grp, 0), 1),
-                                slice(None))] > 0
-            sfeas &= orrow | (grp < 0)
-            sfeas &= volok & ((voln < 0) | (iota_n == voln))
-            sfeas &= ~locked | is_tgt
-
-            future = jnp.maximum(idle + relmp - pipe, 0.0)
-            pods_ok = (cnt + podsx) < maxp
-            shared = sfeas & pods_ok
-            if gpu:
-                gr = jnp.sum(gpu_req * sel_s, axis=1, keepdims=True)  # [1,1]
-                gidle = gidle0 - gpux
-                gpu_ok = (gr <= 0) | jnp.any(gidle >= gr - _EPS_FIT,
-                                             axis=0, keepdims=True)
-                shared &= gpu_ok
-            fit_now = jnp.all(rr_col <= idle + _EPS_FIT, axis=0,
-                              keepdims=True)
-            fit_fut = jnp.all(rr_col <= future + _EPS_FIT, axis=0,
-                              keepdims=True)
-            feas_now = shared & fit_now
-            feas_fut = shared & fit_fut
-
-            # f32 addition order matches allocate_scan exactly:
-            # dyn terms, then taint-static, then (nodeaffinity + rev*bonus),
-            # then task-topology preference
-            score = _dyn_score(cfg, idle, alloc_t, rr_col)
-            score = score + tscore_ref[trow]
-            score = score + (nascore_ref[trow]
-                             + jnp.where(rev, bonus, 0.0))
-            score = score + jnp.where((pref >= 0) & (iota_n == pref),
-                                      100.0, 0.0)
-
-            def pick(feas):
-                masked = jnp.where(feas, score, NEG)
-                best = jnp.max(masked)
-                idx = jnp.min(jnp.where(masked == best, iota_n, N))
-                found = jnp.max(feas.astype(jnp.int32)) > 0
-                return idx, found
-
-            n_now, found_now = pick(feas_now)
-            n_fut, found_fut = pick(feas_fut)
             # yield/break state gates the attempt (allocate.go:205-266)
             active = act & sec_act & ~stopped & ~broke
-            can_now = found_now & active
-            can_fut = found_fut & active & bool(cfg.enable_pipelining)
-            do_alloc = can_now
-            do_pipe = (~can_now) & can_fut
-            placed = do_alloc | do_pipe
-            node = jnp.where(do_alloc, n_now, n_fut)
-
-            onehot = (iota_n == node).astype(jnp.float32)        # [1, N]
-            idle = idle - jnp.where(do_alloc, 1.0, 0.0) * rr_col * onehot
-            pipe = pipe + jnp.where(do_pipe, 1.0, 0.0) * rr_col * onehot
-            podsx = podsx + jnp.where(placed, 1.0, 0.0) * onehot
-
-            if gpu:
-                # lowest fitting card on the chosen node (pick_gpu_row)
-                gcol = jnp.sum(gidle * onehot, axis=1, keepdims=True)  # [G,1]
-                gfits = gcol >= gr - _EPS_FIT
-                card = jnp.min(jnp.where(gfits, iota_g, G))
-                ok_pick = (jnp.max(gfits.astype(jnp.int32)) > 0) \
-                    & (gr[0, 0] > 0)
-                card = jnp.where(ok_pick, card, -1)
-                charge = placed & (card >= 0)
-                gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
-                               * (iota_g == jnp.maximum(card, 0)) * onehot)
-            else:
-                card = jnp.int32(-1)
-                charge = jnp.bool_(False)
-
-            mode = jnp.where(do_alloc, MODE_ALLOCATED,
-                             jnp.where(do_pipe, MODE_PIPELINED, MODE_NONE))
-            is_s = iota_km == s
-            node_v = jnp.where(is_s, jnp.where(placed, node, -1), node_v)
-            mode_v = jnp.where(is_s, mode, mode_v)
-            gpuc_v = jnp.where(is_s, jnp.where(charge, card, -1), gpuc_v)
+            (cap, aff_st, outs, placed, do_alloc, do_pipe,
+             _rr) = attempt(s, active, is_tgt, cap, aff_st, outs)
             n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
             n_pipes = n_pipes + jnp.where(do_pipe, 1, 0)
             if cfg.enable_gang:
@@ -303,14 +540,13 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
             stopped = stopped | (placed & ready_aft & (suffix > 0)
                                  & ~can_batch)
             broke = broke | (active & ~placed)
-            return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
-                    n_allocs, n_pipes, stopped, broke)
+            return (cap, aff_st, outs, n_allocs, n_pipes, stopped, broke)
 
-        (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v,
-         n_allocs, n_pipes, _stopped, _broke) = jax.lax.fori_loop(
+        (cap, aff_st, outs, n_allocs, n_pipes, _stopped,
+         _broke) = jax.lax.fori_loop(
             0, M, task_body,
-            (cidle, cpipe, cpods, cgpux, node_v, mode_v, gpuc_v,
-             jnp.int32(0), jnp.int32(0), jnp.bool_(False), jnp.bool_(False)))
+            (ccap, caff, outs, jnp.int32(0), jnp.int32(0),
+             jnp.bool_(False), jnp.bool_(False)))
 
         # ---- gang finalize in-kernel (JobReady/JobPipelined/Discard) ------
         if cfg.enable_gang:
@@ -319,45 +555,57 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
             ready = jnp.bool_(True)
         pipelined = (ready0 + n_allocs + n_pipes) >= min_avail
         keep = ready | pipelined
-        sec = (iota_km >= k * M) & (iota_km < (k + 1) * M)
+        sec = (env.iota_km >= k * M) & (env.iota_km < (k + 1) * M)
+        node_v, mode_v, gpuc_v = outs
         node_v = jnp.where(keep | ~sec, node_v, -1)
         mode_v = jnp.where(keep | ~sec, mode_v, MODE_NONE)
         gpuc_v = jnp.where(keep | ~sec, gpuc_v, -1)
+        idle, pipe, podsx, gpux = cap
+        cidle, cpipe, cpods, cgpux = ccap
         idle = jnp.where(keep, idle, cidle)
         pipe = jnp.where(keep, pipe, cpipe)
         podsx = jnp.where(keep, podsx, cpods)
         if gpu:
             gpux = jnp.where(keep, gpux, cgpux)
-        return (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v)
+        if aff:
+            ac, at, an = aff_st
+            cac, cat, can = caff
+            aff_st = (jnp.where(keep, ac, cac), jnp.where(keep, at, cat),
+                      jnp.where(keep, an, can))
+        return ((idle, pipe, podsx, gpux), aff_st,
+                (node_v, mode_v, gpuc_v))
 
     neg1 = jnp.full((1, KM), -1, jnp.int32)
     gpux0 = gpux_ref[:] if gpu else jnp.zeros((1, 1), jnp.float32)
-    (idle, pipe, podsx, gpux, node_v, mode_v, gpuc_v) = jax.lax.fori_loop(
+    aff0 = ((affc_ref[:], afft_ref[:], antic_ref[:]) if aff
+            else (jnp.zeros((1, 1), jnp.float32),) * 3)
+    (cap, aff_st, outs) = jax.lax.fori_loop(
         0, K, job_body,
-        (idle_ref[:], pipe_ref[:], podsx_ref[:], gpux0,
-         neg1, jnp.zeros((1, KM), jnp.int32), neg1))
-    node_o[:] = node_v
-    mode_o[:] = mode_v
-    gpu_o[:] = gpuc_v
-    idle_o[:] = idle
-    pipe_o[:] = pipe
-    podsx_o[:] = podsx
+        ((idle_ref[:], pipe_ref[:], podsx_ref[:], gpux0), aff0,
+         (neg1, jnp.zeros((1, KM), jnp.int32), neg1)))
+    node_o[:], mode_o[:], gpu_o[:] = outs
+    idle_o[:], pipe_o[:], podsx_o[:] = cap[0], cap[1], cap[2]
     if gpu:
-        gpux_o[:] = gpux
+        gpux_o[:] = cap[3]
+    if aff:
+        affc_o[:], afft_o[:], antic_o[:] = aff_st
 
 
 def make_round_placer(cfg, K: int, M: int, N: int, R: int, G: int,
-                      GR: int, interpret: bool = False):
-    """Build the fused batched-round placer.
+                      GR: int, aff_dims=None, interpret: bool = False):
+    """Build the fused batched-round placer (static ordering keys).
 
     Returns place(args...) with the input order documented in
     _batch_kernel; outputs (node [KM], mode [KM], gpu [KM], idle', pipe',
-    podsx'[, gpux']). GPU refs are absent when cfg.enable_gpu is False.
+    podsx'[, gpux'][, aff_cnt', aff_tot', anti_cnt']). GPU refs are absent
+    when cfg.enable_gpu is False; affinity refs/state only exist when
+    cfg.enable_pod_affinity (``aff_dims`` = (SK, ETA) then sizes them).
     """
     kernel = functools.partial(_batch_kernel, cfg, K, M, N, R, G, GR)
     f32 = jnp.float32
     KM = K * M
     gpu = bool(cfg.enable_gpu)
+    aff = bool(cfg.enable_pod_affinity)
 
     out_shape = [
         jax.ShapeDtypeStruct((1, KM), jnp.int32),   # node
@@ -369,6 +617,11 @@ def make_round_placer(cfg, K: int, M: int, N: int, R: int, G: int,
     ]
     if gpu:
         out_shape.append(jax.ShapeDtypeStruct((G, N), f32))  # gpux'
+    if aff:
+        SK, ETA = aff_dims
+        out_shape += [jax.ShapeDtypeStruct((SK, N), f32),    # aff_cnt'
+                      jax.ShapeDtypeStruct((SK, 1), f32),    # aff_tot'
+                      jax.ShapeDtypeStruct((ETA, N), f32)]   # anti_cnt'
 
     def place(*args):
         outs = pl.pallas_call(
@@ -382,12 +635,432 @@ def make_round_placer(cfg, K: int, M: int, N: int, R: int, G: int,
     return place
 
 
+# --------------------------------------------------------------------------
+# dynamic-key kernel: in-kernel job selection + fairness-key recompute
+# --------------------------------------------------------------------------
+
+def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
+    """Up to KP sequential pops per launch over C candidate jobs, with the
+    dynamic ordering keys recomputed IN-KERNEL after every gang commit —
+    the exact mirror of the scan path's per-pop key recompute
+    (allocate_scan body: qshare / namespace_shares / drf_job_shares /
+    ready_now), so K-batched rounds stay bit-identical to the sequential
+    pop order even when commits move the keys. See the module docstring
+    for the candidate-set early stop and the hdrf frozen-cols guard."""
+    gpu = bool(cfg.enable_gpu)
+    aff = bool(cfg.enable_pod_affinity)
+    it = iter(refs)
+
+    def nxt():
+        return next(it)
+
+    env = _NS()
+    env.gpu = gpu
+    env.N, env.M, env.R, env.G = N, M, R, G
+    CM = C * M
+    env.iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    env.iota_g = (jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)
+                  if gpu else None)
+    env.iota_km = jax.lax.broadcasted_iota(jnp.int32, (1, CM), 1)
+    iota_j = jax.lax.broadcasted_iota(jnp.int32, (1, J), 1)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (1, C), 1)
+    iota_q_sub = jax.lax.broadcasted_iota(jnp.int32, (Q, 1), 0)
+    iota_rr_s = jax.lax.broadcasted_iota(jnp.int32, (R, R), 0)
+    iota_rr_l = jax.lax.broadcasted_iota(jnp.int32, (R, R), 1)
+
+    _read_slot_env(cfg, nxt, env)
+    tidok_ref = nxt()         # [1, CM] i32 task slot holds a real task
+    nbe_ref = nxt()           # [1, CM] i32 task is NOT best-effort
+    cand_ref = nxt()          # [1, C] i32 candidate job ids (-1 pad)
+    cslot_ref = nxt()         # [1, J] i32 job -> candidate slot (-1)
+    skeys_ref = nxt()         # [NKS, J] f32 static key columns
+    hcols_ref = nxt() if NH else None   # [NH, J] f32 frozen hdrf columns
+    qid_ref = nxt()           # [1, J] i32 job -> queue
+    qoh_ref = nxt()           # [Q, J] f32 queue one-hot
+    if cfg.drf_ns_order:
+        nsm_ref = nxt()       # [S, J] f32 ns membership (key mapping)
+        nsc_ref = nxt()       # [S, J] f32 ns contribution mask (valid jobs)
+        nsw_ref = nxt()       # [1, S] f32 namespace weights
+    minav_ref = nxt()         # [1, J] i32
+    rdy0_ref = nxt()          # [1, J] i32 snapshot ready_num
+    npend_ref = nxt()         # [1, J] i32
+    eligs_ref = nxt()         # [1, J] i32 valid & schedulable
+    validf_ref = nxt()        # [1, J] f32 jobs.valid (drf share masking)
+    canb_ref = nxt()          # [1, J] i32 re-pop fusion flag per job
+    des_ref = nxt()           # [Q, R] f32 proportion deserved
+    qex_ref = nxt()           # [Q, 1] f32 queue_share_extra
+    total_ref = nxt()         # [R, 1] f32 cluster capacity
+    kmax_ref = nxt()          # [1, 1] i32 pop budget this launch
+    tgt_ref = nxt()           # [1, 1] i32 reservation target job
+    _read_node_env(cfg, nxt, env)
+    if aff:
+        _read_aff_env(nxt, env)
+    idle_ref = nxt()
+    pipe_ref = nxt()
+    podsx_ref = nxt()
+    gpux_ref = nxt() if gpu else None
+    if aff:
+        affc_ref = nxt()
+        afft_ref = nxt()
+        antic_ref = nxt()
+    done_ref = nxt()          # [1, J] i32 in
+    popped_ref = nxt()        # [1, J] i32 in
+    jready_ref = nxt()        # [1, J] i32 in
+    jpipe_ref = nxt()         # [1, J] i32 in
+    cursor_ref = nxt()        # [1, J] i32 in
+    acount_ref = nxt()        # [1, J] i32 in
+    jalloc_ref = nxt()        # [R, J] f32 in (live drf allocations)
+    qalloc_ref = nxt()        # [Q, R] f32 in (live queue allocations)
+    node_o = nxt()
+    mode_o = nxt()
+    gpu_o = nxt()
+    idle_o = nxt()
+    pipe_o = nxt()
+    podsx_o = nxt()
+    gpux_o = nxt() if gpu else None
+    if aff:
+        affc_o = nxt()
+        afft_o = nxt()
+        antic_o = nxt()
+    done_o = nxt()
+    popped_o = nxt()
+    jready_o = nxt()
+    jpipe_o = nxt()
+    cursor_o = nxt()
+    acount_o = nxt()
+    jalloc_o = nxt()
+    qalloc_o = nxt()
+    pops_o = nxt()            # [1, 1] i32
+    prog_o = nxt()            # [1, 1] i32
+
+    tidok_v = tidok_ref[:]
+    nbe_v = nbe_ref[:]
+    suffix_v = env.suffix_v
+    cand_v = cand_ref[:]
+    cslot_v = cslot_ref[:]
+    skeys = skeys_ref[:]
+    hcols = hcols_ref[:] if NH else None
+    qid_v = qid_ref[:]
+    qid_f = qid_v.astype(jnp.float32)
+    qoh = qoh_ref[:]
+    minav_v = minav_ref[:]
+    rdy0_v = rdy0_ref[:]
+    npend_v = npend_ref[:]
+    eligs_v = eligs_ref[:] > 0
+    valid_f = validf_ref[:]
+    canb_v = canb_ref[:] > 0
+    des = des_ref[:]
+    qex = qex_ref[:]
+    total = total_ref[:]
+    kmax = jnp.sum(kmax_ref[:])
+    tgt = jnp.sum(tgt_ref[:])
+    cand0 = _seli(cand_v, 0, iota_c)
+
+    attempt = _make_attempt(cfg, env)
+    inf = jnp.float32(jnp.inf)
+
+    # static key column cursor: the builder packs the static columns in the
+    # same flag-dependent order this reader walks (mirror of the scan
+    # path's `keys` list construction)
+    def skey(i):
+        return skeys[i:i + 1, :]
+
+    def pop_body(p, carry):
+        (stop, pops, kept_any, prog, cap, aff_st, outs,
+         done, popped, jready, jpipe, cursor, acount,
+         jalloc, qalloc) = carry
+
+        # ---- eligibility (mirror of allocate_scan.eligible) --------------
+        over_col = jnp.max(
+            jnp.where(qalloc > des + 1e-6, 1.0, 0.0), axis=1,
+            keepdims=True)                                    # [Q, 1]
+        over_j = jnp.sum(qoh * over_col, axis=0, keepdims=True) > 0
+        elig = (eligs_v & (done == 0) & (cursor < npend_v) & ~over_j)
+        any_elig = jnp.any(elig)
+
+        # ---- hdrf guard: frozen per-queue columns are exact only while
+        # every contender shares one queue once any commit has moved the
+        # tree (see module docstring) -------------------------------------
+        if NH:
+            qmn = jnp.min(jnp.where(elig, qid_f, inf))
+            qmx = jnp.max(jnp.where(elig, qid_f, -inf))
+            guard_stop = kept_any & (qmn != qmx)
+        else:
+            guard_stop = jnp.bool_(False)
+
+        # ---- dynamic keys (the fairshare.py share math, VMEM layout) -----
+        qshare_col = jnp.max(
+            jnp.where(jnp.isfinite(des) & (des > 0),
+                      qalloc / jnp.maximum(des, 1e-9), 0.0),
+            axis=1, keepdims=True) + qex                      # [Q, 1]
+        qshare_j = jnp.sum(qoh * qshare_col, axis=0, keepdims=True)
+        si = iter(range(skeys.shape[0]))
+        keys = []
+        if cfg.drf_ns_order:
+            # namespace_shares: dominant share of the ns member sum / weight
+            ns_key = jnp.zeros((1, J), jnp.float32)
+            for s_ in range(S):
+                member = nsm_ref[(pl.dslice(s_, 1), slice(None))]  # [1, J]
+                contrib = nsc_ref[(pl.dslice(s_, 1), slice(None))]
+                alloc_s = jnp.sum(jnp.where(contrib > 0, jalloc, 0.0),
+                                  axis=1, keepdims=True)      # [R, 1]
+                frac = jnp.where(total > 0,
+                                 alloc_s / jnp.maximum(total, 1e-9), 0.0)
+                share_s = jnp.max(frac)
+                w_s = jnp.sum(jnp.where(
+                    jax.lax.broadcasted_iota(jnp.int32, (1, S), 1) == s_,
+                    nsw_ref[:], 0.0))
+                share_s = share_s / jnp.maximum(w_s, 1.0)
+                ns_key = jnp.where(member > 0, share_s, ns_key)
+            keys.append(ns_key)
+        else:
+            keys.append(skey(next(si)))
+        keys.append(skey(next(si)))                           # job_ns
+        keys.append(qshare_j)
+        if NH:
+            for c_ in range(NH):
+                keys.append(hcols[c_:c_ + 1, :])
+        keys.append(skey(next(si)))                           # job_q
+        keys.append(skey(next(si)))                           # -priority
+        if cfg.tdm_job_order:
+            keys.append(skey(next(si)))
+        if cfg.sla_job_order:
+            keys.append(skey(next(si)))
+        ready_now = ((rdy0_v + acount >= minav_v)
+                     & (minav_v > 0)).astype(jnp.float32)
+        keys.append(ready_now)
+        if cfg.drf_job_order:
+            # drf_job_shares: dominant share over live allocations
+            frac = jnp.where(total > 0,
+                             jalloc / jnp.maximum(total, 1e-9), 0.0)
+            jshare = jnp.max(frac, axis=0, keepdims=True)
+            keys.append(jnp.where(valid_f > 0, jshare, inf))
+        else:
+            keys.append(skey(next(si)))
+        keys.append(skey(next(si)))                           # creation_rank
+
+        # ---- lexicographic argmin (ops/select.lex_argmin mirror) ---------
+        m = elig
+        for k_ in keys:
+            kmin = jnp.min(jnp.where(m, k_, inf))
+            m = m & (k_ <= kmin)
+        jsel = jnp.min(jnp.where(m, iota_j, J))
+        # pop 0 is the launch's XLA-selected argmin (same state, same
+        # keys): forcing it guarantees >= 1 pop per launch (termination)
+        jstar = jnp.where(p == 0, cand0, jsel)
+        cslot = _seli(cslot_v, jstar, iota_j)
+        ok = ((~stop) & (p < kmax) & any_elig & (~guard_stop)
+              & (cslot >= 0) & (jstar >= 0) & (jstar < J))
+        stop = stop | ~ok
+
+        onehot_j = iota_j == jstar                            # [1, J]
+        cur0 = jnp.sum(jnp.where(onehot_j, cursor, 0))
+        ready0_dyn = jnp.sum(jnp.where(onehot_j, rdy0_v + acount, 0))
+        min_avail = jnp.sum(jnp.where(onehot_j, minav_v, 0))
+        can_batch = jnp.sum(jnp.where(onehot_j, canb_v.astype(jnp.int32),
+                                      0)) > 0
+        is_tgt = jstar == tgt
+        q_j = jnp.sum(jnp.where(onehot_j, qid_v, 0))
+        off = cslot * M
+
+        # ---- the M-placement section (mirror of the scan task loop) ------
+        def task_body(m_, tcarry):
+            (cap, aff_st, outs, n_allocs, n_pipes, n_adv,
+             stopped, broke) = tcarry
+            s = off + m_
+            sel_i = (env.iota_km == s).astype(jnp.int32)
+            tid_ok = jnp.sum(tidok_v * sel_i) > 0
+            nbe = jnp.sum(nbe_v * sel_i) > 0
+            suffix = jnp.sum(suffix_v * sel_i)
+            can_run = (tid_ok & (m_ >= cur0) & ~stopped & ~broke & ok)
+            active = can_run & nbe
+            (cap, aff_st, outs, placed, do_alloc, do_pipe,
+             _rr) = attempt(s, active, is_tgt, cap, aff_st, outs)
+            n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
+            n_pipes = n_pipes + jnp.where(do_pipe, 1, 0)
+            n_adv = n_adv + jnp.where(can_run, 1, 0)
+            if cfg.enable_gang:
+                ready_aft = (ready0_dyn + n_allocs) >= min_avail
+            else:
+                ready_aft = True
+            stopped = stopped | (placed & ready_aft & (suffix > 0)
+                                 & ~can_batch)
+            broke = broke | (active & ~placed)
+            return (cap, aff_st, outs, n_allocs, n_pipes, n_adv,
+                    stopped, broke)
+
+        (ncap, naff, nouts, n_allocs, n_pipes, n_adv, stopped,
+         _broke) = jax.lax.fori_loop(
+            0, M, task_body,
+            (cap, aff_st, outs, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+             jnp.bool_(False), jnp.bool_(False)))
+
+        # ---- gang finalize + key-state commit ----------------------------
+        if cfg.enable_gang:
+            ready = (ready0_dyn + n_allocs) >= min_avail
+        else:
+            ready = jnp.bool_(True)
+        pipelined = ((ready0_dyn + n_allocs + n_pipes) >= min_avail) \
+            & ~ready
+        keep = (ready | pipelined) & ok
+        sec = (env.iota_km >= off + cur0) & (env.iota_km < off + M)
+        node_v, mode_v, gpuc_v = nouts
+        onode, omode, ogpu = outs
+        # only THIS pop's section slots may change: the task loop walks all
+        # M slots and writes neutral values at the already-consumed ones
+        # (m < cur0), which would clobber earlier pops' committed
+        # placements in the carry — restore everything outside the section
+        node_v = jnp.where(sec & ok, node_v, onode)
+        mode_v = jnp.where(sec & ok, mode_v, omode)
+        gpuc_v = jnp.where(sec & ok, gpuc_v, ogpu)
+        # discard clears only THIS pop's slot writes (>= the pop-start
+        # cursor; earlier pops of the job were committed — a kept gang
+        # never discards later, see the module docstring)
+        disc = sec & ok & ~keep
+        node_v = jnp.where(disc, -1, node_v)
+        mode_v = jnp.where(disc, MODE_NONE, mode_v)
+        gpuc_v = jnp.where(disc, -1, gpuc_v)
+        # kept-but-unready gang: capacity held, no binds — demote this
+        # pop's Allocated placements to Pipelined (session.go:317-330)
+        demote = (keep & ~ready) & sec & (mode_v == MODE_ALLOCATED)
+        mode_v = jnp.where(demote, MODE_PIPELINED, mode_v)
+
+        def merge(new, old):
+            return jax.tree.map(
+                lambda a, b: jnp.where(keep, a, b), new, old)
+
+        cap = merge(ncap, cap)
+        aff_st = merge(naff, aff_st)
+
+        # committed resources of this pop, accumulated in slot order like
+        # the scan path's placed_sum (f32 adds in the same sequence)
+        placed_m = (mode_v != MODE_NONE) & sec
+        sel_rows = jnp.where(placed_m, 1.0, 0.0)
+        placed_col = jnp.sum(env.resreq_t * sel_rows, axis=1,
+                             keepdims=True)                   # [R, 1]
+        commit_col = jnp.where(keep, placed_col, 0.0)
+        # [R, 1] -> [1, R] exact transpose via one-hot diagonal
+        commit_row = jnp.sum(
+            jnp.where(iota_rr_s == iota_rr_l, commit_col, 0.0),
+            axis=0, keepdims=True)                            # [1, R]
+
+        upd = onehot_j & ok
+        done = jnp.where(upd, jnp.where(stopped, 0, 1), done)
+        popped = jnp.where(upd, 1, popped)
+        jready = jnp.where(upd, jnp.where(ready & keep, 1, 0), jready)
+        jpipe = jnp.where(upd, jnp.where(pipelined & keep, 1, 0), jpipe)
+        cursor = jnp.where(upd, cursor + n_adv, cursor)
+        acount = jnp.where(upd & keep, acount + n_allocs, acount)
+        jalloc = jalloc + jnp.where(upd, commit_col, 0.0)
+        qalloc = qalloc + jnp.where(iota_q_sub == q_j, 1.0, 0.0) \
+            * commit_row * jnp.where(ok, 1.0, 0.0)
+        kept_any = kept_any | (keep & ((n_allocs + n_pipes) > 0))
+        prog = prog | (ok & ((n_allocs > 0) | pipelined | ready))
+        pops = pops + jnp.where(ok, 1, 0)
+        return (stop, pops, kept_any, prog, cap, aff_st,
+                (node_v, mode_v, gpuc_v),
+                done, popped, jready, jpipe, cursor, acount,
+                jalloc, qalloc)
+
+    neg1 = jnp.full((1, CM), -1, jnp.int32)
+    gpux0 = gpux_ref[:] if gpu else jnp.zeros((1, 1), jnp.float32)
+    aff0 = ((affc_ref[:], afft_ref[:], antic_ref[:]) if aff
+            else (jnp.zeros((1, 1), jnp.float32),) * 3)
+    init = (jnp.bool_(False), jnp.int32(0), jnp.bool_(False),
+            jnp.bool_(False),
+            (idle_ref[:], pipe_ref[:], podsx_ref[:], gpux0), aff0,
+            (neg1, jnp.zeros((1, CM), jnp.int32), neg1),
+            done_ref[:], popped_ref[:], jready_ref[:], jpipe_ref[:],
+            cursor_ref[:], acount_ref[:], jalloc_ref[:], qalloc_ref[:])
+    (stop, pops, kept_any, prog, cap, aff_st, outs,
+     done, popped, jready, jpipe, cursor, acount,
+     jalloc, qalloc) = jax.lax.fori_loop(0, KP, pop_body, init)
+    node_o[:], mode_o[:], gpu_o[:] = outs
+    idle_o[:], pipe_o[:], podsx_o[:] = cap[0], cap[1], cap[2]
+    if gpu:
+        gpux_o[:] = cap[3]
+    if aff:
+        affc_o[:], afft_o[:], antic_o[:] = aff_st
+    done_o[:] = done
+    popped_o[:] = popped
+    jready_o[:] = jready
+    jpipe_o[:] = jpipe
+    cursor_o[:] = cursor
+    acount_o[:] = acount
+    jalloc_o[:] = jalloc
+    qalloc_o[:] = qalloc
+    pops_o[:] = jnp.full((1, 1), 1, jnp.int32) * pops
+    prog_o[:] = jnp.full((1, 1), 1, jnp.int32) * prog.astype(jnp.int32)
+
+
+def make_dyn_round_placer(cfg, C: int, KP: int, M: int, N: int, R: int,
+                          G: int, GR: int, J: int, Q: int, S: int,
+                          NH: int = 0, aff_dims=None,
+                          interpret: bool = False):
+    """Build the dynamic-key batched placer: KP in-kernel pops per launch
+    over C candidate jobs. Input order as read by _dyn_kernel; outputs
+    (node [CM], mode [CM], gpu [CM], idle', pipe', podsx'[, gpux']
+    [, aff'...], done', popped', ready', pipelined', cursor', acount',
+    job_alloc', queue_alloc', pops, progressed)."""
+    kernel = functools.partial(_dyn_kernel, cfg, C, KP, M, N, R, G, GR,
+                               J, Q, S, NH)
+    f32, i32 = jnp.float32, jnp.int32
+    CM = C * M
+    gpu = bool(cfg.enable_gpu)
+    aff = bool(cfg.enable_pod_affinity)
+
+    out_shape = [
+        jax.ShapeDtypeStruct((1, CM), i32),     # node
+        jax.ShapeDtypeStruct((1, CM), i32),     # mode
+        jax.ShapeDtypeStruct((1, CM), i32),     # gpu
+        jax.ShapeDtypeStruct((R, N), f32),      # idle'
+        jax.ShapeDtypeStruct((R, N), f32),      # pipe'
+        jax.ShapeDtypeStruct((1, N), f32),      # podsx'
+    ]
+    if gpu:
+        out_shape.append(jax.ShapeDtypeStruct((G, N), f32))
+    if aff:
+        SK, ETA = aff_dims
+        out_shape += [jax.ShapeDtypeStruct((SK, N), f32),
+                      jax.ShapeDtypeStruct((SK, 1), f32),
+                      jax.ShapeDtypeStruct((ETA, N), f32)]
+    out_shape += [
+        jax.ShapeDtypeStruct((1, J), i32),      # done'
+        jax.ShapeDtypeStruct((1, J), i32),      # popped'
+        jax.ShapeDtypeStruct((1, J), i32),      # ready'
+        jax.ShapeDtypeStruct((1, J), i32),      # pipelined'
+        jax.ShapeDtypeStruct((1, J), i32),      # cursor'
+        jax.ShapeDtypeStruct((1, J), i32),      # acount'
+        jax.ShapeDtypeStruct((R, J), f32),      # job_alloc'
+        jax.ShapeDtypeStruct((Q, R), f32),      # queue_alloc'
+        jax.ShapeDtypeStruct((1, 1), i32),      # pops
+        jax.ShapeDtypeStruct((1, 1), i32),      # progressed
+    ]
+
+    def place(*args):
+        return pl.pallas_call(
+            lambda *refs: kernel(refs),
+            out_shape=tuple(out_shape),
+            interpret=interpret,
+        )(*args)
+
+    return place
+
+
 def vmem_estimate_bytes(K: int, M: int, N: int, R: int, G: int,
-                        P: int, GR: int) -> int:
-    """Rough VMEM footprint of the kernel's live values."""
+                        P: int, GR: int, SK: int = 0, ETA: int = 0,
+                        SEL: int = 0, J: int = 0, Q: int = 0) -> int:
+    """Rough VMEM footprint of the kernel's live values (both kernels; the
+    dynamic-key path adds the per-job key state, the affinity path the
+    node-space count maps — keep in sync with _read_*_env)."""
     per_n = 4 * N * (R * 6          # relmp/alloc/idle/pipe + committed pair
                      + G * 3        # gidle0 + gpux pair
                      + 3 * P        # template feasibility/score maps
                      + GR + 8)      # OR groups + block/bonus/lock/cnt rows
     per_km = 4 * K * M * (R + 10)   # per-task rows
-    return per_n + per_km
+    per_aff = 4 * N * (SK * 3       # sk_domain + live/committed counts
+                       + ETA * 3    # eta_domain + anti counts pair
+                       + SEL)       # static preferred map
+    per_aff += 4 * K * M * (SK + ETA + SEL + 8)
+    per_j = 4 * J * (R * 2 + 24) + 4 * Q * R * 3
+    return per_n + per_km + per_aff + per_j
