@@ -95,17 +95,26 @@ def _reexec_cpu(reason: str) -> "NoReturn":
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
+def _bench_cfg(cfg_kwargs):
+    """The bench's kernel config, routed through derive_batching — the
+    single authority for the batching preconditions (graphcheck
+    obligations). No drf/hdrf ordering and no proportion plugin here, so
+    the derivation lands on the static-keys K-batch path."""
+    from volcano_tpu.ops.allocate_scan import AllocateConfig, derive_batching
+    return derive_batching(AllocateConfig(**cfg_kwargs),
+                           has_proportion=False)
+
+
 def _build(n_nodes, n_jobs, tasks_per_job, cfg_kwargs):
     from __graft_entry__ import _synthetic_cluster
     from volcano_tpu.arrays import pack
-    from volcano_tpu.ops.allocate_scan import AllocateConfig, AllocateExtras
+    from volcano_tpu.ops.allocate_scan import AllocateExtras
 
     ci = _synthetic_cluster(n_nodes=n_nodes, n_jobs=n_jobs,
                             tasks_per_job=tasks_per_job)
     snap, _maps = pack(ci)
     extras = AllocateExtras.neutral(snap)
-    cfg = AllocateConfig(**cfg_kwargs)
-    return snap, extras, cfg
+    return snap, extras, _bench_cfg(cfg_kwargs)
 
 
 def _decisions_equal(result, cpu) -> bool:
@@ -164,13 +173,12 @@ def _run(force_cpu: bool):
         n_jobs = int(os.environ.get("BENCH_JOBS", 6250))
     tasks_per_job = int(os.environ.get("BENCH_TASKS_PER_JOB", 16))
     reps = int(os.environ.get("BENCH_REPS", 3))
-    from volcano_tpu.ops.allocate_scan import DEFAULT_BATCH_JOBS
+    # batching comes from derive_batching (_bench_cfg): exact K-batching
+    # here because there is no drf/hdrf ordering and neutral (infinite)
+    # proportion deserved; the snapshot carries no GPU requests
     cfg_kwargs = dict(binpack_weight=1.0, least_allocated_weight=0.0,
                       balanced_weight=0.0, taint_prefer_weight=0.0,
-                      # batched rounds are exact here: no drf/hdrf ordering
-                      # and neutral (infinite) proportion deserved; the
-                      # snapshot carries no GPU requests
-                      batch_jobs=DEFAULT_BATCH_JOBS, enable_gpu=False)
+                      enable_gpu=False)
 
     import jax
     if force_cpu:
@@ -319,13 +327,12 @@ tiers:
         from volcano_tpu.native.wire import IncrementalWire
         from volcano_tpu.native.wire import serialize as _wire_ser
         from volcano_tpu.runtime.sidecar import SchedulerSidecar
-        from volcano_tpu.ops.allocate_scan import AllocateConfig as _AC
         if _native_ok():
             from __graft_entry__ import _synthetic_cluster as _synth
             sci0 = _synth(n_nodes=n_nodes, n_jobs=n_jobs,
                           tasks_per_job=tasks_per_job)
             wire_buf, _wm = _wire_ser(sci0)
-            car = SchedulerSidecar(cfg=_AC(**cfg_kwargs))
+            car = SchedulerSidecar(cfg=_bench_cfg(cfg_kwargs))
             car.schedule_buffer(wire_buf)        # warm the jit cache
             times = []
             for _ in range(min(reps, 3)):
@@ -694,11 +701,41 @@ tiers:
         equal_sub = _decisions_equal(sresult, scpu)
         sub_speedup = round(scpu_ms / stpu_ms, 1)
 
+    # ---- graphcheck static-analysis status (volcano_tpu/analysis) --------
+    # The perf trajectory carries the static-analysis state alongside the
+    # decision fingerprints: a record with graphcheck_clean=false (or
+    # null = the pass itself failed) flags that these numbers were
+    # measured on a cycle violating a framework invariant. Subprocess on
+    # the CPU backend so a TPU-poisoned parent process can't block it;
+    # fail-soft like everything else in this script.
+    graphcheck_clean = graphcheck_sha = None
+    if not os.environ.get("BENCH_SKIP_GRAPHCHECK"):
+        import tempfile
+        rpt = os.path.join(tempfile.gettempdir(), "graphcheck_bench.json")
+        try:
+            genv = dict(os.environ, JAX_PLATFORMS="cpu")
+            proc = subprocess.run(
+                [sys.executable, "-m", "volcano_tpu.analysis",
+                 "--json", rpt],
+                capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=float(os.environ.get("BENCH_GRAPHCHECK_TIMEOUT",
+                                             300)), env=genv)
+            if proc.returncode in (0, 1):
+                with open(rpt) as f:
+                    grpt = json.load(f)
+                graphcheck_clean = bool(grpt["clean"])
+                graphcheck_sha = grpt["report_sha256"]
+        except Exception:  # noqa: BLE001 — the record ships regardless
+            pass
+
     out = {
         "metric": f"schedule_cycle_ms_{n_nodes}nodes_{n_tasks}tasks",
         "value": round(dev_ms, 3),
         "unit": "ms",
         "vs_baseline": round(cpu_ms / dev_ms, 2),
+        "graphcheck_clean": graphcheck_clean,
+        "graphcheck_sha256": graphcheck_sha,
     }
     if force_cpu:
         out["tpu_unavailable"] = True

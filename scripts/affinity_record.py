@@ -62,16 +62,20 @@ def build(ci):
 def main():
     import jax
     from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                               derive_batching,
                                                make_allocate_cycle)
     from volcano_tpu.runtime.cpu_reference import allocate_cpu
     n_nodes = int(os.environ.get("AFF_RECORD_NODES", 10000))
     n_jobs = int(os.environ.get("AFF_RECORD_JOBS", 2500))
     ci = scenario(n_nodes=n_nodes, n_jobs=n_jobs)
     snap, extras = build(ci)
-    acfg = AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
-                          balanced_weight=0.0, taint_prefer_weight=0.0,
-                          enable_pod_affinity=True, enable_gpu=False,
-                          batch_jobs=8)
+    # static ordering keys + neutral deserved: derive_batching lands on
+    # the K-batch path (K=8), same as bench's config-5 measurement
+    acfg = derive_batching(
+        AllocateConfig(binpack_weight=1.0, least_allocated_weight=0.0,
+                       balanced_weight=0.0, taint_prefer_weight=0.0,
+                       enable_pod_affinity=True, enable_gpu=False),
+        has_proportion=False)
     afn = jax.jit(make_allocate_cycle(acfg))
     res = afn(snap, extras)
     tn = np.asarray(res.task_node)

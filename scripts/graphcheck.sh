@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Graphcheck — trace-time static analysis of the compiled scheduling
+# cycle (volcano_tpu/analysis). Runs entirely on the CPU backend, so a
+# dead TPU tunnel can never block the gate.
+#
+# Stable contract for bench/driver harnesses:
+#   exit 0  clean            exit 1  findings          exit 2  internal error
+#   the JSON report lands at $GRAPHCHECK_REPORT (default
+#   /tmp/graphcheck_report.json) and its path is echoed on the last line.
+#
+# Extra CLI flags pass through (e.g. --fast, --families dtype,vmem).
+set -o pipefail
+cd "$(dirname "$0")/.."
+REPORT="${GRAPHCHECK_REPORT:-/tmp/graphcheck_report.json}"
+JAX_PLATFORMS=cpu python -m volcano_tpu.analysis --json "$REPORT" "$@"
+rc=$?
+echo "GRAPHCHECK_REPORT=$REPORT"
+exit $rc

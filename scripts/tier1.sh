@@ -1,12 +1,14 @@
 #!/usr/bin/env bash
-# Tier-1 verify — the EXACT command from ROADMAP.md, wrapped so the
-# builder, CI, and the driver all run the identical thing.
+# Tier-1 verify — the EXACT pytest command from ROADMAP.md, wrapped so the
+# builder, CI, and the driver all run the identical thing, followed by the
+# graphcheck static-analysis gate (scripts/graphcheck.sh --fast; skip with
+# TIER1_SKIP_GRAPHCHECK=1).
 #
 # Fast deterministic subset: excludes tests marked `slow` (registered in
 # tests/conftest.py; run `pytest -m slow` for the long tail — sharded
-# 8-device identity, full hdrf outcome sweeps, sidecar serving e2e).
-# DOTS_PASSED counts progress dots so a timeout mid-run still reports how
-# far the suite got.
+# 8-device identity, full hdrf outcome sweeps, sidecar serving e2e, the
+# full-entry graphcheck CLI run). DOTS_PASSED counts progress dots so a
+# timeout mid-run still reports how far the suite got.
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
@@ -18,4 +20,14 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu \
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
-exit $rc
+grc=0
+if [ "${TIER1_SKIP_GRAPHCHECK:-0}" != "1" ]; then
+    # the fast pruned entry set; tests/test_graphcheck.py already ran the
+    # same pass in-suite — this standalone run hands harnesses the JSON
+    # report + stable exit code without parsing pytest output
+    bash scripts/graphcheck.sh --fast || grc=$?
+fi
+if [ $rc -ne 0 ]; then
+    exit $rc
+fi
+exit $grc

@@ -30,3 +30,10 @@ def pytest_configure(config):
     # runs in the full suite only
     config.addinivalue_line(
         "markers", "slow: long-running test excluded from tier-1")
+    # dtype/API drift must not accumulate silently (graphcheck satellite):
+    # a JAX/NumPy deprecation in the cycle is tomorrow's behavior change,
+    # so the suite fails the moment one appears
+    config.addinivalue_line("filterwarnings", "error::DeprecationWarning")
+    config.addinivalue_line(
+        "filterwarnings", "error::PendingDeprecationWarning")
+    config.addinivalue_line("filterwarnings", "error::FutureWarning")

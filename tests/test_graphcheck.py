@@ -1,0 +1,252 @@
+"""Graphcheck: each check family fires on a planted violation AND stays
+green on the repo's real entry points (ISSUE 2 acceptance).
+
+The planted tests build tiny synthetic jaxprs/fixtures per family; the
+real-entry test runs the whole pass in fast mode (pruned entry set — the
+full set runs in the CLI / the slow-marked test below).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from volcano_tpu.analysis import apply_allowlist, report_sha, run_graphcheck
+from volcano_tpu.analysis.entrypoints import EntryTrace
+from volcano_tpu.analysis.jaxpr_audit import (check_dtype, check_gather,
+                                              check_purity)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _trace(fn, *args, x64=False, dims=None, cfg=None):
+    if x64:
+        with jax.experimental.enable_x64():
+            closed = jax.make_jaxpr(fn)(*args)
+    else:
+        closed = jax.make_jaxpr(fn)(*args)
+    return EntryTrace("planted", closed,
+                      dims or {"N": 7, "task_dims": {5}}, cfg)
+
+
+class TestPlantedViolations:
+    def test_purity_fires_on_callback_in_hot_path(self):
+        def hot(x):
+            jax.debug.callback(lambda v: None, x[0])
+            return x * 2.0
+
+        findings = check_purity(_trace(hot, np.ones(4, np.float32)))
+        assert findings and findings[0].family == "purity"
+        assert "debug_callback" in findings[0].what
+
+    def test_purity_clean_on_pure_fn(self):
+        findings = check_purity(_trace(lambda x: x * 2.0,
+                                       np.ones(4, np.float32)))
+        assert findings == []
+
+    def test_dtype_fires_on_float64_leak(self):
+        def leaky(x):
+            # the classic leak: a weak float literal paired with a bool
+            return jnp.where(x > 0, 1.0, 0.0)
+
+        findings = check_dtype(_trace(leaky, np.ones(4, np.float32),
+                                      x64=True))
+        assert any("float64" in f.what for f in findings)
+
+    def test_dtype_clean_on_pinned_fn(self):
+        def pinned(x):
+            return jnp.where(x > 0, jnp.float32(1.0), jnp.float32(0.0))
+
+        assert check_dtype(_trace(pinned, np.ones(4, np.float32),
+                                  x64=True)) == []
+
+    def test_gather_fires_on_mn_materialization(self):
+        T, N = 5, 7
+
+        def regress(req, cap):
+            # the PR 1 regression class: a [T, N] fit product
+            return jnp.sum(req[:, None] <= cap[None, :], axis=1)
+
+        findings = check_gather(_trace(
+            regress, np.ones(T, np.float32), np.ones(N, np.float32),
+            dims={"N": N, "task_dims": {T}}))
+        assert findings and str((T, N)) in findings[0].what
+
+    def test_gather_clean_on_node_resident_form(self):
+        T, N = 5, 7
+
+        def ok(req, cap):
+            return jnp.sum(cap) + jnp.sum(req)
+
+        assert check_gather(_trace(
+            ok, np.ones(T, np.float32), np.ones(N, np.float32),
+            dims={"N": N, "task_dims": {T}})) == []
+
+    def test_recompile_fires_on_size_dependent_shapes(self):
+        from volcano_tpu.analysis.recompile import check_recompile
+
+        # one nominal problem size whose two packs produce different
+        # shapes — the value-dependent-padding hazard: 2 traces where the
+        # shape-bucket contract promises 1
+        probes = [("planted", lambda: (lambda x: x * 2.0),
+                   {"a": [(np.ones(4, np.float32),),
+                          (np.ones(5, np.float32),)]})]
+        findings = check_recompile(probes=probes)
+        assert findings and "traced 2x" in findings[0].what
+
+    def test_recompile_clean_on_stable_shapes(self):
+        from volcano_tpu.analysis.recompile import check_recompile
+        probes = [("stable", lambda: (lambda x: x * 2.0),
+                   {"a": (np.ones(4, np.float32),),
+                    "b": (np.ones(5, np.float32),)})]
+        assert check_recompile(probes=probes) == []
+
+    def test_vmem_fires_on_over_budget_blockspec(self, graph_traces):
+        from volcano_tpu.analysis.vmem import check_vmem
+        findings = check_vmem(graph_traces, budget_bytes=1024)
+        assert any("per-core budget" in f.what for f in findings)
+
+    def test_obligation_fires_on_hand_set_batch_rounds(self, tmp_path):
+        from volcano_tpu.analysis.obligations import scan_file
+        mod = tmp_path / "rogue.py"
+        mod.write_text(textwrap.dedent("""\
+            from volcano_tpu.ops.allocate_scan import AllocateConfig
+            CFG = AllocateConfig(drf_job_order=True, batch_rounds=32)
+        """))
+        findings = scan_file(str(mod), "rogue.py")
+        assert findings and "batch_rounds" in findings[0].key
+
+    def test_obligation_accepts_derive_batching_route(self, tmp_path):
+        from volcano_tpu.analysis.obligations import scan_file
+        mod = tmp_path / "lawful.py"
+        mod.write_text(textwrap.dedent("""\
+            from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                                       derive_batching)
+            CFG = derive_batching(AllocateConfig(drf_job_order=True),
+                                  has_proportion=False)
+        """))
+        assert scan_file(str(mod), "lawful.py") == []
+
+    def test_obligation_fires_on_splatted_dict(self, tmp_path):
+        from volcano_tpu.analysis.obligations import scan_file
+        mod = tmp_path / "splat.py"
+        mod.write_text(textwrap.dedent("""\
+            from volcano_tpu.ops.allocate_scan import AllocateConfig
+            KW = {"binpack_weight": 1.0, "batch_jobs": 8}
+            CFG = AllocateConfig(**KW)
+        """))
+        findings = scan_file(str(mod), "splat.py")
+        assert findings and "dict" in findings[0].key
+
+
+class TestDeriveBatchingErrorPaths:
+    """Satellite: the documented error paths of the batching authority."""
+
+    def test_illegal_static_k_dynamic_keys_raises(self):
+        from volcano_tpu.analysis.entrypoints import _ALT_SIZE, _snap_extras
+        from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                                   make_allocate_cycle)
+        snap, extras = _snap_extras(_ALT_SIZE)
+        for bad in (AllocateConfig(batch_jobs=8, drf_job_order=True),
+                    AllocateConfig(batch_jobs=8, drf_ns_order=True),
+                    AllocateConfig(batch_jobs=8, enable_hdrf=True)):
+            with pytest.raises(ValueError,
+                               match="static-keys path requires static "
+                                     "ordering keys"):
+                jax.eval_shape(make_allocate_cycle(bad), snap, extras)
+
+    def test_batching_rule_verifies_clean(self):
+        from volcano_tpu.analysis.obligations import verify_batching_rule
+        assert verify_batching_rule() == []
+
+    def test_deserved_evidence_paths(self):
+        from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                                   derive_batching)
+        neutral = np.full((3, 2), np.inf, np.float32)
+        got = derive_batching(AllocateConfig(), queue_deserved=neutral)
+        assert got.batch_jobs > 1 and got.batch_rounds == 0
+        finite = neutral.copy()
+        finite[0, 0] = 0.0      # zero-quota queue counts as dynamic
+        got = derive_batching(AllocateConfig(), queue_deserved=finite)
+        assert got.batch_rounds > 0
+
+    def test_manual_settings_pass_through(self):
+        from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                                   derive_batching)
+        manual = AllocateConfig(batch_jobs=4)
+        assert derive_batching(manual, has_proportion=True) == manual
+
+
+@pytest.fixture(scope="module")
+def graph_traces():
+    from volcano_tpu.analysis.entrypoints import build_traces
+    return build_traces(fast=True)
+
+
+@pytest.fixture(scope="module")
+def fast_report(graph_traces):
+    # run_graphcheck re-traces internally; the fixture order just keeps
+    # the heavyweight jax state warm within the module
+    return run_graphcheck(fast=True)
+
+
+class TestRealEntryPoints:
+    def test_repo_is_clean(self, fast_report):
+        blocking = [f for f in fast_report["findings"]
+                    if not f["allowlisted"]]
+        assert fast_report["clean"], (
+            "graphcheck found violations on the real entry points:\n"
+            + "\n".join(f"  {f['family']}: {f['what']}" for f in blocking))
+
+    def test_all_families_ran(self, fast_report):
+        assert all(fast_report["families"].values())
+        assert fast_report["meta"]["traced_entry_points"]
+
+    def test_pallas_kernels_in_trace_set(self, graph_traces):
+        from volcano_tpu.analysis.vmem import _pallas_bytes
+        names = [t.name for t in graph_traces
+                 if t.cfg is not None and t.cfg.use_pallas
+                 and _pallas_bytes(t.closed)]
+        assert "allocate/pallas_static" in names
+        assert "allocate/pallas_dyn" in names
+
+    def test_report_sha_ignores_timing(self, fast_report):
+        clone = dict(fast_report)
+        clone["elapsed_s"] = 9999.0
+        assert report_sha(clone) == fast_report["report_sha256"]
+
+
+class TestAllowlistPlumbing:
+    def test_allowlist_marks_matching_findings(self, monkeypatch):
+        from volcano_tpu.analysis import Finding
+        from volcano_tpu.analysis import allowlist as al
+        monkeypatch.setattr(
+            al, "ALLOWLIST",
+            (al.Allow("dtype", "known-site", "intentional for the test"),))
+        fs = apply_allowlist([
+            Finding("dtype", "dtype:known-site:f64", "x", "leak"),
+            Finding("dtype", "dtype:other:f64", "y", "leak")])
+        assert fs[0].allowlisted and fs[0].reason
+        assert not fs[1].allowlisted
+
+
+@pytest.mark.slow
+def test_full_graphcheck_cli_exits_zero(tmp_path):
+    """Acceptance: `python -m volcano_tpu.analysis` exits 0 on the repo
+    with all six families enabled (full entry set, CLI surface)."""
+    rpt = tmp_path / "graphcheck.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.analysis", "--json", str(rpt)],
+        capture_output=True, text=True, cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(rpt.read_text())
+    assert report["clean"] and all(report["families"].values())

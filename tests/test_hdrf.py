@@ -377,6 +377,9 @@ class TestHDRFOutcomes:
              ("pg21", "root-eng-dev", 10, "1", 0),
              ("pg22", "root-eng-prod", 10, "0", 2 ** 30)])
 
+    # tier-1 keeps the semantic outcome assertion; the scan/pallas and
+    # CPU-oracle parity replays of the same cluster run in the full
+    # suite (`pytest -m slow`) — tier-1 budget calibration
     def test_rescaling(self):
         snap, maps, extras, cfg, result = _run_hdrf(self._rescaling_cluster())
         got = _job_placed(snap, maps, result)
@@ -385,6 +388,7 @@ class TestHDRFOutcomes:
         assert got["pg21"][cpu] == 5000 and got["pg21"][mem] == 0, got
         assert got["pg22"][cpu] == 0 and got["pg22"][mem] == 5 * 2 ** 30, got
 
+    @pytest.mark.slow
     def test_rescaling_pallas_parity(self):
         ci = self._rescaling_cluster()
         _, _, _, _, scan = _run_hdrf(ci)
@@ -394,6 +398,7 @@ class TestHDRFOutcomes:
         np.testing.assert_array_equal(np.asarray(scan.task_mode),
                                       np.asarray(pls.task_mode))
 
+    @pytest.mark.slow
     def test_rescaling_cpu_oracle_parity(self):
         from volcano_tpu.runtime.cpu_reference import allocate_cpu
         snap, maps, extras, cfg, result = _run_hdrf(self._rescaling_cluster())

@@ -1,0 +1,165 @@
+"""Graphcheck: trace-time static analysis of the compiled scheduling cycle.
+
+The paper's north star is the whole cycle (predicate x score x argmax,
+fairness pops, preempt) as ONE compiled TPU program — which means whole
+failure classes live in the traced graph, not in any single Python line:
+a host callback smuggled into the hot path, a float64/weak-type promotion
+that doubles VMEM traffic (or breaks mosaic, which has no 64-bit types),
+an O(M*N) jobs-x-nodes re-materialization (the regression class the PR 1
+affinity rounds fixed), a Python-value-dependent shape that recompiles
+per cycle, or a Pallas kernel whose VMEM footprint outgrows the core.
+Every one of those is visible at TRACE time on a plain CPU — graphcheck
+walks the closed jaxprs of the real entry points (framework session +
+compiled_session conf presets, the ops/ cycle functions, both Pallas
+kernel builders) and turns each class into a CI failure instead of a
+driver-TPU surprise.
+
+Check families (all six run by default):
+
+- ``purity``       — no pure_callback/io_callback/debug_callback
+                     primitives anywhere in a compiled cycle.
+- ``dtype``        — no 64-bit (float64/int64) intermediates when the
+                     cycle is traced under enable_x64 with 32-bit inputs:
+                     any 64-bit value is a weak-type/default-dtype
+                     promotion leak that production silently truncates
+                     only because x64 is globally off.
+- ``gather``       — no intermediate carrying BOTH a task-axis dim and
+                     the node-axis dim (the [M, N] gather
+                     re-materialization class; shapes are made
+                     distinguishable by construction, see entrypoints).
+- ``recompile``    — each jitted entry point compiles exactly once per
+                     problem-size bucket: re-invoking with fresh
+                     same-shaped inputs must not retrace.
+- ``vmem``         — the static VMEM footprint of every Pallas kernel
+                     input/output (whole-array BlockSpecs) stays under
+                     the per-core budget, the ``vmem_estimate_bytes``
+                     gate never understates the traced truth, and the
+                     north-star-scale projection clears the budget.
+- ``obligations``  — ``derive_batching`` stays the single authority for
+                     the static-segment batching rule: the rule itself is
+                     re-derived and re-verified, the illegal static-K +
+                     dynamic-keys combination still raises, and an AST
+                     scan proves no construction site in the package
+                     hand-sets ``batch_jobs``/``batch_rounds``.
+
+Run ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh)
+for the CLI; tier-1 runs the same pass via tests/test_graphcheck.py.
+Intentional findings are registered in :mod:`.allowlist` with a one-line
+justification.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import time
+from typing import List, Optional, Sequence
+
+FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violation of a framework invariant.
+
+    ``key`` is the stable identity string the allowlist matches on
+    (family:location:detail); ``what`` is the human-readable sentence.
+    """
+
+    family: str
+    key: str
+    where: str
+    what: str
+    allowlisted: bool = False
+    reason: Optional[str] = None
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def apply_allowlist(findings: Sequence[Finding]) -> List[Finding]:
+    from .allowlist import ALLOWLIST
+    for f in findings:
+        for entry in ALLOWLIST:
+            if entry.family == f.family and entry.match in f.key:
+                f.allowlisted = True
+                f.reason = entry.reason
+                break
+    return list(findings)
+
+
+def run_graphcheck(families: Optional[Sequence[str]] = None,
+                   fast: bool = False,
+                   vmem_budget_bytes: Optional[int] = None,
+                   repo_root: Optional[str] = None) -> dict:
+    """Run the requested check families and assemble the report dict.
+
+    ``fast`` prunes the traced-entry set to a representative subset (one
+    entry per graph shape) so the tier-1 test stays cheap; the CLI runs
+    the full set. The report is machine-readable (see schema below) and
+    carries a content sha so bench records can fingerprint the
+    static-analysis state alongside the decision fingerprints.
+    """
+    families = list(families) if families else list(FAMILIES)
+    unknown = [f for f in families if f not in FAMILIES]
+    if unknown:
+        raise ValueError(f"unknown graphcheck families: {unknown}; "
+                         f"known: {list(FAMILIES)}")
+    t0 = time.time()
+    findings: List[Finding] = []
+    fam_meta = {}
+
+    need_traces = bool({"purity", "dtype", "gather", "vmem"} & set(families))
+    traces = []
+    if need_traces:
+        from .entrypoints import build_traces
+        traces = build_traces(fast=fast)
+        fam_meta["traced_entry_points"] = [t.name for t in traces]
+
+    if "purity" in families or "dtype" in families or "gather" in families:
+        from .jaxpr_audit import check_dtype, check_gather, check_purity
+        for tr in traces:
+            if "purity" in families:
+                findings += check_purity(tr)
+            if "dtype" in families:
+                findings += check_dtype(tr)
+            if "gather" in families:
+                findings += check_gather(tr)
+
+    if "vmem" in families:
+        from .vmem import check_vmem
+        findings += check_vmem(traces,
+                               budget_bytes=vmem_budget_bytes)
+
+    if "recompile" in families:
+        from .recompile import check_recompile
+        findings += check_recompile(fast=fast)
+
+    if "obligations" in families:
+        from .obligations import check_obligations
+        findings += check_obligations(repo_root=repo_root)
+
+    findings = apply_allowlist(findings)
+    blocking = [f for f in findings if not f.allowlisted]
+    report = {
+        "graphcheck_version": 1,
+        "clean": not blocking,
+        "families": {f: f in families for f in FAMILIES},
+        "finding_count": len(findings),
+        "blocking_count": len(blocking),
+        "findings": [f.to_dict() for f in findings],
+        "meta": fam_meta,
+        "elapsed_s": round(time.time() - t0, 2),
+    }
+    report["report_sha256"] = report_sha(report)
+    return report
+
+
+def report_sha(report: dict) -> str:
+    """Content fingerprint over everything decision-relevant in the report
+    (NOT elapsed time), for the bench record's graphcheck column."""
+    core = {k: report[k] for k in
+            ("graphcheck_version", "clean", "families", "findings")}
+    return hashlib.sha256(
+        json.dumps(core, sort_keys=True).encode()).hexdigest()[:16]

@@ -1,0 +1,92 @@
+"""CLI: ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh).
+
+Runs the six graphcheck families over the repo's real entry points on the
+CPU backend, writes a machine-readable JSON report, prints human-readable
+findings, and exits with a stable code:
+
+    0  clean (no non-allowlisted findings)
+    1  findings
+    2  internal error (the analysis itself failed)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.analysis",
+        description="graphcheck: trace-time static analysis of the "
+                    "compiled scheduling cycle")
+    parser.add_argument(
+        "--json", default=os.environ.get("GRAPHCHECK_REPORT",
+                                         "/tmp/graphcheck_report.json"),
+        help="path for the machine-readable report "
+             "(default: $GRAPHCHECK_REPORT or /tmp/graphcheck_report.json)")
+    parser.add_argument(
+        "--families", default=None,
+        help="comma-separated subset of check families "
+             "(default: all six)")
+    parser.add_argument(
+        "--fast", action="store_true",
+        help="prune the traced-entry set to a representative subset "
+             "(the tier-1 test mode)")
+    parser.add_argument(
+        "--vmem-budget-bytes", type=int, default=None,
+        help="override the per-core VMEM budget (default 12 MiB, the "
+             "runtime auto-gate's bound)")
+    parser.add_argument(
+        "--list-families", action="store_true",
+        help="print the known families and exit")
+    args = parser.parse_args(argv)
+
+    from . import FAMILIES, run_graphcheck
+    if args.list_families:
+        print("\n".join(FAMILIES))
+        return 0
+
+    # graphcheck is a CPU CI pass: never touch (or hang on) a TPU tunnel
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass    # a backend already initialized (in-process caller owns it)
+
+    families = ([f.strip() for f in args.families.split(",") if f.strip()]
+                if args.families else None)
+    try:
+        report = run_graphcheck(families=families, fast=args.fast,
+                                vmem_budget_bytes=args.vmem_budget_bytes)
+    except Exception as e:  # noqa: BLE001 — stable exit code for harnesses
+        print(f"graphcheck: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        return 2
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+
+    for fdict in report["findings"]:
+        tag = "allowlisted" if fdict["allowlisted"] else "FINDING"
+        line = f"[{tag}] {fdict['family']}: {fdict['what']}"
+        if fdict["allowlisted"]:
+            line += f" (allowed: {fdict['reason']})"
+        print(line)
+    print(f"graphcheck: {'CLEAN' if report['clean'] else 'DIRTY'} — "
+          f"{report['blocking_count']} blocking / "
+          f"{report['finding_count']} total findings, "
+          f"{len(report['meta'].get('traced_entry_points', []))} entry "
+          f"points traced, {report['elapsed_s']}s "
+          f"(report sha {report['report_sha256']}"
+          + (f", written to {args.json})" if args.json else ")"))
+    return 0 if report["clean"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

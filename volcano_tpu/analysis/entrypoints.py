@@ -1,0 +1,306 @@
+"""Traced entry-point registry for graphcheck.
+
+Builds small REAL snapshots (through the same ``arrays.pack`` path every
+production cycle uses) and traces the real entry points with abstract
+values: the XLA scan cycle, both Pallas kernel builders (static-keys
+K-batch and dynamic-keys in-kernel-selection, traced in interpret mode so
+the ``pallas_call`` primitive and its kernel jaxpr appear on CPU), the
+conf-preset compiled cycles (framework/compiled_session), the in-process
+Session's derived config (framework/session), and the enqueue / backfill /
+preempt passes.
+
+Shape discipline for the gather audit: the synthetic sizes are chosen so
+the PADDED axes are distinguishable — the node axis buckets to a size no
+task-ish axis (T, J*M, K*M) shares, so "an intermediate carrying both a
+task dim and the node dim" is decidable by exact dim match. See
+``_AUDIT_SIZE`` below; changing it requires re-checking the bucket table
+in arrays/schema.bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+#: (n_nodes, n_jobs, tasks_per_job) for the audited traces. Buckets to
+#: N=128, T=32, J=16, M=4 — so N collides with NO task-ish axis
+#: (T=32, J*M=64, K*M=32 for K=8) and the gather audit can key on exact
+#: dims. Changing this requires re-checking arrays/schema.bucket.
+_AUDIT_SIZE = (100, 10, 3)
+#: second size for the recompile lint (distinct buckets: N=64, T=32)
+_ALT_SIZE = (48, 10, 3)
+
+
+def _mini_cluster(n_nodes: int, n_jobs: int, tasks_per_job: int,
+                  seed: int = 0, affinity: bool = False):
+    """Small ClusterInfo in the same shape family as the bench/driver
+    synthetic cluster (two queues, Inqueue gangs, mixed cpu requests) —
+    local so the analysis package has no repo-root import."""
+    import numpy as np
+    from ..api import (ClusterInfo, JobInfo, NodeInfo, PodGroupPhase,
+                       QueueInfo, Resource, TaskInfo)
+    rng = np.random.RandomState(seed)
+    ci = ClusterInfo()
+    for i in range(n_nodes):
+        node = NodeInfo(
+            f"n{i:05d}",
+            allocatable=Resource.from_resource_list(
+                {"cpu": "16", "memory": "64Gi", "pods": "110"}))
+        if affinity:
+            node.labels["zone"] = f"z{i % 4}"
+        ci.add_node(node)
+    ci.add_queue(QueueInfo("default", weight=1))
+    ci.add_queue(QueueInfo("batch", weight=2))
+    for j in range(n_jobs):
+        job = JobInfo(f"default/job-{j:05d}",
+                      queue="default" if j % 2 == 0 else "batch",
+                      min_available=max(1, tasks_per_job // 2),
+                      priority=int(rng.randint(3)),
+                      creation_timestamp=float(j),
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        for t in range(tasks_per_job):
+            task = TaskInfo(
+                uid=f"default/job-{j:05d}-{t}", name=f"job-{j:05d}-{t}",
+                resreq=Resource.from_resource_list(
+                    {"cpu": f"{rng.randint(1, 4) * 500}m", "memory": "1Gi"}))
+            if affinity:
+                from ..api import PodAffinityTerm
+                task.labels["app"] = f"app{j % 4}"
+                if j % 3 == 0:
+                    task.pod_anti_affinity = [PodAffinityTerm(
+                        topology_key="zone",
+                        match_labels={"app": f"app{j % 4}"})]
+                elif j % 3 == 1:
+                    task.pod_affinity_preferred = [PodAffinityTerm(
+                        topology_key="zone",
+                        match_labels={"app": f"app{j % 4}"}, weight=10)]
+            job.add_task(task)
+        ci.add_job(job)
+    return ci
+
+
+def _snap_extras(size=_AUDIT_SIZE, affinity: bool = False):
+    import dataclasses as dc
+    from ..arrays import pack
+    from ..ops.allocate_scan import AllocateExtras
+    ci = _mini_cluster(*size, affinity=affinity)
+    snap, maps = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    if affinity:
+        from ..arrays.affinity import build_affinity
+        N = snap.nodes.idle.shape[0]
+        T = snap.tasks.resreq.shape[0]
+        extras = dc.replace(extras,
+                            affinity=build_affinity(ci, maps, N, T))
+    return snap, extras
+
+
+def _dims(snap, cfg=None, extras=None) -> Dict[str, object]:
+    """Semantic axis sizes of a packed snapshot, for the gather audit and
+    the VMEM estimator cross-check."""
+    N, R = snap.nodes.idle.shape
+    J, M = snap.jobs.task_table.shape
+    T = snap.tasks.resreq.shape[0]
+    d = dict(N=N, R=R, J=J, M=M, T=T,
+             G=snap.nodes.gpu_memory.shape[1],
+             P=snap.template_rep.shape[0],
+             Q=snap.queues.allocated.shape[0],
+             S=snap.namespace_weight.shape[0],
+             GR=extras.or_feasible.shape[0] if extras is not None else 1,
+             SK=(extras.affinity.sk_domain.shape[0]
+                 if extras is not None else 0),
+             ETA=(extras.affinity.eta_domain.shape[0]
+                  if extras is not None else 0),
+             SEL=(extras.affinity.task_match.shape[0]
+                  if extras is not None else 0))
+    task_dims = {T, J * M}
+    if cfg is not None and cfg.batch_jobs > 1:
+        task_dims.add(cfg.batch_jobs * M)
+    d["task_dims"] = task_dims
+    return d
+
+
+@dataclasses.dataclass
+class EntryTrace:
+    """One traced entry point: its closed jaxpr (traced under enable_x64
+    with 32-bit inputs) plus the dim map the audits key on."""
+
+    name: str
+    closed: object                  # jax.core.ClosedJaxpr
+    dims: Dict[str, object]
+    cfg: Optional[object] = None    # AllocateConfig when applicable
+
+
+def _allocate_cfgs(fast: bool):
+    import dataclasses as dc
+    from ..ops.allocate_scan import AllocateConfig, derive_batching
+    base = AllocateConfig(binpack_weight=1.0, enable_gpu=False)
+    cfgs = [
+        ("allocate/scan", dc.replace(
+            derive_batching(base, has_proportion=False), use_pallas=False)),
+        ("allocate/pallas_static", dc.replace(
+            derive_batching(base, has_proportion=False),
+            use_pallas="interpret")),
+        ("allocate/pallas_dyn", dc.replace(
+            derive_batching(dc.replace(base, drf_job_order=True),
+                            has_proportion=False),
+            use_pallas="interpret")),
+    ]
+    if not fast:
+        cfgs.append(("allocate/pallas_affinity", dc.replace(
+            derive_batching(dc.replace(base, enable_pod_affinity=True),
+                            has_proportion=False),
+            use_pallas="interpret")))
+    return cfgs
+
+
+def _conf_presets(fast: bool):
+    """(name, conf text) for every parseable tiered policy in conf/."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from ..framework.conf import parse_conf
+    out = []
+    conf_dir = os.path.join(root, "conf")
+    names = sorted(os.listdir(conf_dir)) if os.path.isdir(conf_dir) else []
+    for fname in names:
+        if not fname.endswith(".conf"):
+            continue
+        with open(os.path.join(conf_dir, fname)) as f:
+            text = f.read()
+        try:
+            sc = parse_conf(text)
+        except Exception:
+            continue
+        if not sc.tiers:
+            continue    # hierarchy-weights files are not scheduler policies
+        out.append((f"conf/{fname[:-len('.conf')]}", text))
+        if fast:
+            break
+    return out
+
+
+def build_traces(fast: bool = False) -> List[EntryTrace]:
+    """Trace every entry point under enable_x64 (inputs stay 32-bit, so
+    any 64-bit intermediate is a promotion leak) and return the closed
+    jaxprs for the purity/dtype/gather/vmem walks."""
+    import jax
+    from ..ops.allocate_scan import make_allocate_cycle
+    traces: List[EntryTrace] = []
+
+    snap, extras = _snap_extras()
+    with jax.experimental.enable_x64():
+        for name, cfg in _allocate_cfgs(fast):
+            if "affinity" in name:
+                asnap, aextras = _snap_extras(affinity=True)
+                closed = jax.make_jaxpr(make_allocate_cycle(cfg))(
+                    asnap, aextras)
+                traces.append(EntryTrace(
+                    name, closed, _dims(asnap, cfg, aextras), cfg))
+            else:
+                closed = jax.make_jaxpr(make_allocate_cycle(cfg))(
+                    snap, extras)
+                traces.append(EntryTrace(
+                    name, closed, _dims(snap, cfg, extras), cfg))
+
+        # compiled_session conf presets (in-graph plugin extras included)
+        from ..framework.compiled_session import make_conf_cycle
+        for name, text in _conf_presets(fast):
+            cycle = make_conf_cycle(text)
+            closed = jax.make_jaxpr(lambda s: cycle(s))(snap)
+            traces.append(EntryTrace(name, closed, _dims(snap)))
+
+        # the in-process Session's derived config (framework/session.py)
+        if not fast:
+            from ..framework.session import Session
+            ssn = Session(_mini_cluster(*_AUDIT_SIZE))
+            scfg = ssn.allocate_config()
+            sextras = ssn.allocate_extras()
+            closed = jax.make_jaxpr(make_allocate_cycle(scfg))(
+                ssn.snap, sextras)
+            traces.append(EntryTrace("framework/session", closed,
+                                     _dims(ssn.snap, scfg, sextras), scfg))
+            ssn.close()
+
+        # enqueue / backfill / preempt cycle functions
+        import numpy as np
+        from ..ops.enqueue import EnqueueConfig, make_enqueue_pass
+        J = snap.jobs.min_available.shape[0]
+        closed = jax.make_jaxpr(make_enqueue_pass(EnqueueConfig()))(
+            snap, np.zeros(J, bool))
+        traces.append(EntryTrace("ops/enqueue", closed, _dims(snap)))
+
+        from ..ops.backfill import make_backfill_pass
+        closed = jax.make_jaxpr(make_backfill_pass())(snap)
+        traces.append(EntryTrace("ops/backfill", closed, _dims(snap)))
+
+        from ..ops.allocate_scan import AllocateConfig
+        from ..ops.preempt import PreemptConfig, make_preempt_cycle
+        T = snap.tasks.resreq.shape[0]
+        pcfg = PreemptConfig(scoring=AllocateConfig(binpack_weight=1.0,
+                                                    enable_gpu=False))
+        closed = jax.make_jaxpr(make_preempt_cycle(pcfg))(
+            snap, extras, np.zeros(T, bool), np.zeros(T, bool))
+        traces.append(EntryTrace("ops/preempt", closed, _dims(snap)))
+
+    return traces
+
+
+def recompile_probes(fast: bool = False) -> List[tuple]:
+    """(name, build_fn, args_for_size) triples for the recompile lint.
+
+    ``build_fn()`` returns the raw (unjitted) callable; the lint wraps it
+    with a trace counter + jax.jit and calls it twice per size. Sizes
+    bucket to different shapes, so the expected trace count equals the
+    number of sizes — any extra trace is a Python-value-dependent shape
+    or control-flow hazard.
+    """
+    import numpy as np
+    from ..ops.allocate_scan import make_allocate_cycle
+
+    sizes = (_AUDIT_SIZE, _ALT_SIZE)
+    packed = {s: _snap_extras(s) for s in sizes}
+
+    probes: List[tuple] = []
+    for name, cfg in _allocate_cfgs(fast=True):
+        if fast and name != "allocate/scan":
+            continue
+        probes.append((name, lambda cfg=cfg: make_allocate_cycle(cfg),
+                       {s: packed[s] for s in sizes}))
+
+    from ..ops.enqueue import EnqueueConfig, make_enqueue_pass
+
+    def enq_args(s):
+        snap, _ = packed[s]
+        return (snap, np.zeros(snap.jobs.min_available.shape[0], bool))
+
+    probes.append(("ops/enqueue",
+                   lambda: make_enqueue_pass(EnqueueConfig()),
+                   {s: enq_args(s) for s in sizes}))
+
+    if not fast:
+        from ..ops.backfill import make_backfill_pass
+        probes.append(("ops/backfill", make_backfill_pass,
+                       {s: (packed[s][0],) for s in sizes}))
+
+        from ..ops.allocate_scan import AllocateConfig
+        from ..ops.preempt import PreemptConfig, make_preempt_cycle
+        pcfg = PreemptConfig(scoring=AllocateConfig(binpack_weight=1.0,
+                                                    enable_gpu=False))
+
+        def pre_args(s):
+            snap, extras = packed[s]
+            T = snap.tasks.resreq.shape[0]
+            return (snap, extras, np.zeros(T, bool), np.zeros(T, bool))
+
+        probes.append(("ops/preempt",
+                       lambda: make_preempt_cycle(pcfg),
+                       {s: pre_args(s) for s in sizes}))
+
+        from ..framework.compiled_session import make_conf_cycle
+        presets = _conf_presets(fast=True)
+        if presets:
+            name, text = presets[0]
+            probes.append((name, lambda text=text: make_conf_cycle(text),
+                           {s: (packed[s][0],) for s in sizes}))
+    return probes

@@ -1,0 +1,149 @@
+"""Jaxpr walkers: hot-path purity, dtype discipline, gather-shape audit.
+
+All three checks share one recursive walk over a closed jaxpr (descending
+into while/scan/cond bodies, pjit sub-jaxprs, and the Pallas kernel jaxpr
+carried in the ``pallas_call`` params), so one trace per entry point
+serves every family.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from . import Finding
+
+#: host-callback primitives that must never appear in a compiled cycle:
+#: each one pins the program to a host round-trip per invocation, which
+#: destroys the one-launch-per-cycle budget and breaks sharded execution
+CALLBACK_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "host_callback_call",
+     "outside_call"})
+
+#: 64-bit dtypes that cannot exist on the production path: mosaic has no
+#: 64-bit types, and under the production x64-off config these silently
+#: truncate — so their appearance under an x64 trace is always a
+#: weak-type/default-dtype promotion leak
+WIDE_DTYPES = frozenset({"float64", "int64", "uint64", "complex128"})
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Yield every eqn in ``jaxpr`` and, recursively, in every sub-jaxpr
+    found in eqn params (while/scan/cond bodies, pjit, pallas_call)."""
+    from jax.core import ClosedJaxpr, Jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            if isinstance(v, ClosedJaxpr):
+                yield from iter_eqns(v.jaxpr)
+            elif isinstance(v, Jaxpr):
+                yield from iter_eqns(v)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, ClosedJaxpr):
+                        yield from iter_eqns(x.jaxpr)
+                    elif isinstance(x, Jaxpr):
+                        yield from iter_eqns(x)
+
+
+def _loc(eqn) -> str:
+    """Best-effort user-code location of an eqn ("file.py:line (fn)").
+
+    Caveat: jnp composites are trace-cached, so a sub-jaxpr first traced
+    by another entry point can carry that entry's frame — locations are a
+    debugging aid, not an identity (the finding key includes them, but a
+    clean repo has zero findings so staleness cannot hide anything).
+    """
+    try:
+        from jax._src import source_info_util
+        s = source_info_util.summarize(eqn.source_info)
+        # keep paths repo-relative so finding keys are machine-stable
+        for marker in ("/volcano_tpu/", "/tests/", "/scripts/"):
+            i = s.find(marker)
+            if i >= 0:
+                return s[i + 1:]
+        return s
+    except Exception:
+        return "unknown"
+
+
+def check_purity(trace) -> List[Finding]:
+    """No host-callback primitive anywhere in the compiled cycle."""
+    out = []
+    seen = set()
+    for eqn in iter_eqns(trace.closed.jaxpr):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES:
+            loc = _loc(eqn)
+            key = f"purity:{trace.name}:{name}:{loc}"
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                family="purity", key=key, where=f"{trace.name} @ {loc}",
+                what=(f"host callback primitive '{name}' inside the "
+                      f"compiled cycle '{trace.name}' — the hot path must "
+                      "stay device-pure (one launch per cycle)")))
+    return out
+
+
+def check_dtype(trace) -> List[Finding]:
+    """No 64-bit intermediates when traced under enable_x64 with 32-bit
+    inputs (see entrypoints.build_traces)."""
+    out = []
+    seen = set()
+    for eqn in iter_eqns(trace.closed.jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt is None or str(dt) not in WIDE_DTYPES:
+                continue
+            loc = _loc(eqn)
+            key = f"dtype:{trace.name}:{loc}:{eqn.primitive.name}:{dt}"
+            dedup = (loc, str(dt))
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            out.append(Finding(
+                family="dtype", key=key, where=f"{trace.name} @ {loc}",
+                what=(f"{dt} intermediate ({eqn.primitive.name}) in "
+                      f"'{trace.name}': a weak-type/default-dtype "
+                      "promotion that only the global x64-off config "
+                      "truncates — pin the dtype at the source")))
+    return out
+
+
+def check_gather(trace) -> List[Finding]:
+    """No intermediate carrying BOTH a task-axis dim and the node-axis
+    dim — the O(M*N) jobs-x-nodes re-materialization class the PR 1
+    affinity rounds eliminated (per-round [M, N] gather outputs serialized
+    on TPU and dominated the cycle)."""
+    N = trace.dims["N"]
+    task_dims = set(trace.dims["task_dims"]) - {N}
+    out = []
+    seen = set()
+    for eqn in iter_eqns(trace.closed.jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", None)
+            if not shape or len(shape) < 2:
+                continue
+            dims = list(shape)
+            # a task dim and the node dim on distinct axes (task_dims
+            # excludes N above, so two different axes must match)
+            if N in dims and any(d in task_dims for d in dims):
+                loc = _loc(eqn)
+                key = (f"gather:{trace.name}:{loc}:"
+                       f"{eqn.primitive.name}:{tuple(shape)}")
+                dedup = (loc, tuple(shape))
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                out.append(Finding(
+                    family="gather", key=key,
+                    where=f"{trace.name} @ {loc}",
+                    what=(f"O(M*N) intermediate of shape {tuple(shape)} "
+                          f"({eqn.primitive.name}) in '{trace.name}': a "
+                          "task-axis x node-axis materialization — ship "
+                          "O(M) scalars + node-resident maps instead "
+                          "(the PR 1 affinity regression class)")))
+    return out

@@ -1,0 +1,221 @@
+"""Proof-obligation checker for the static-segment batching rule.
+
+``derive_batching`` (ops/allocate_scan.py) is the single authority for
+when K-job batching is bit-exact with the sequential pop order: static
+ordering keys batch as K pre-selected sections, dynamic keys (drf/hdrf
+ordering or finite proportion deserved) must take the in-kernel-selection
+``batch_rounds`` path. This module enforces the obligation from both
+sides:
+
+- ``verify_batching_rule`` RE-DERIVES the rule over every flag
+  combination and checks derive_batching's output against it, checks the
+  deserved-array evidence path (any finite entry, including 0, counts as
+  dynamic), checks manual settings pass through untouched, and probes
+  that the illegal static-K + dynamic-keys combination still raises with
+  the documented message.
+- ``scan_sources`` walks the package AST and flags every construction
+  site that hand-sets ``batch_jobs``/``batch_rounds`` without routing
+  through derive_batching — including ``dataclasses.replace`` and dict
+  literals later splatted into AllocateConfig(**kwargs). tests/ are
+  exempt (kernel tests own the preconditions they set).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+from . import Finding
+
+BATCH_KEYS = frozenset({"batch_jobs", "batch_rounds"})
+
+#: the one file allowed to set batch fields directly: derive_batching's
+#: home (the authority itself) and the kernel's one-place config assert
+_HOME = os.path.join("volcano_tpu", "ops", "allocate_scan.py")
+
+#: documented error of the illegal combination (allocate_scan one-place
+#: config assert) — verified verbatim so the message stays documented
+_ILLEGAL_MSG = "static-keys path requires static ordering keys"
+
+
+def _call_name(func) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _parent_map(tree) -> dict:
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _routed_through_derive(node, parents) -> bool:
+    """True when ``node`` sits (at any depth) inside the arguments of a
+    derive_batching(...) call — the compliant construction pattern."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call) and \
+                _call_name(cur.func) == "derive_batching":
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def scan_file(path: str, rel: str) -> List[Finding]:
+    """AST-scan one file for hand-set batch_jobs/batch_rounds sites."""
+    out: List[Finding] = []
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        out.append(Finding(
+            family="obligations", key=f"obligations:{rel}:syntax",
+            where=rel, what=f"unparseable source: {e}"))
+        return out
+    parents = _parent_map(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            kw = {k.arg for k in node.keywords if k.arg}
+            hit = kw & BATCH_KEYS
+            if hit and not _routed_through_derive(node, parents):
+                fname = _call_name(node.func) or "<call>"
+                out.append(Finding(
+                    family="obligations",
+                    key=(f"obligations:{rel}:{node.lineno}:"
+                         f"{fname}:{'/'.join(sorted(hit))}"),
+                    where=f"{rel}:{node.lineno}",
+                    what=(f"{fname}(...) hand-sets {sorted(hit)} without "
+                          "routing through derive_batching — the "
+                          "static-segment exactness precondition lives "
+                          "in ONE place (ops/allocate_scan."
+                          "derive_batching); wrap the config there or "
+                          "drop the manual setting")))
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if isinstance(k, ast.Constant) and k.value in BATCH_KEYS:
+                    out.append(Finding(
+                        family="obligations",
+                        key=f"obligations:{rel}:{node.lineno}:dict:{k.value}",
+                        where=f"{rel}:{node.lineno}",
+                        what=(f"dict literal carries '{k.value}' (splatted "
+                              "into a config constructor) — route the "
+                              "constructed AllocateConfig through "
+                              "derive_batching instead")))
+    return out
+
+
+def scan_sources(repo_root: Optional[str] = None) -> List[Finding]:
+    root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out: List[Finding] = []
+    for base, dirs, files in os.walk(root):
+        rel_base = os.path.relpath(base, root)
+        parts = rel_base.split(os.sep)
+        if any(p.startswith(".") for p in parts if p != "."):
+            continue
+        if parts[0] in ("tests", "examples", "deploy", "related"):
+            continue
+        # the checker itself constructs manual configs as rule probes
+        if rel_base.startswith(os.path.join("volcano_tpu", "analysis")):
+            continue
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            rel = os.path.normpath(os.path.join(rel_base, fname))
+            if rel == _HOME:
+                continue    # the authority itself
+            out.extend(scan_file(os.path.join(base, fname), rel))
+    return out
+
+
+def verify_batching_rule() -> List[Finding]:
+    from itertools import product
+
+    import numpy as np
+
+    from ..ops.allocate_scan import (DEFAULT_BATCH_JOBS, AllocateConfig,
+                                     derive_batching, make_allocate_cycle)
+    out: List[Finding] = []
+
+    def finding(key, what):
+        out.append(Finding(family="obligations",
+                           key=f"obligations:rule:{key}",
+                           where="ops/allocate_scan.derive_batching",
+                           what=what))
+
+    # the rule, re-derived: batching is exact iff no ordering key can
+    # move under a commit — drf/hdrf dynamic ordering moves job/ns keys,
+    # ANY finite proportion deserved (zero included) can flip a queue
+    # overused; dynamic keys must take the in-kernel-selection path
+    for dj, dn, hd, hp in product((False, True), repeat=4):
+        cfg = AllocateConfig(drf_job_order=dj, drf_ns_order=dn,
+                             enable_hdrf=hd)
+        got = derive_batching(cfg, has_proportion=hp)
+        dynamic = dj or dn or hd or hp
+        if got.batch_jobs != DEFAULT_BATCH_JOBS or \
+                bool(got.batch_rounds) != dynamic:
+            finding(f"combo:dj={dj}:dn={dn}:hd={hd}:hp={hp}",
+                    f"derive_batching({cfg}) -> batch_jobs="
+                    f"{got.batch_jobs}, batch_rounds={got.batch_rounds}; "
+                    f"the static-segment rule requires batch_jobs="
+                    f"{DEFAULT_BATCH_JOBS} and batch_rounds "
+                    f"{'> 0' if dynamic else '== 0'} here")
+
+    # deserved-array evidence: all-inf is static, one finite entry
+    # (including 0) is dynamic
+    neutral = np.full((2, 3), np.inf, np.float32)
+    if derive_batching(AllocateConfig(),
+                       queue_deserved=neutral).batch_rounds:
+        finding("deserved:neutral",
+                "all-infinite queue_deserved must derive the static-keys "
+                "path (neutral deserved cannot move qshare)")
+    finite = neutral.copy()
+    finite[1, 0] = 0.0
+    if not derive_batching(AllocateConfig(),
+                           queue_deserved=finite).batch_rounds:
+        finding("deserved:finite-zero",
+                "a finite deserved entry (zero counts: the queue flips "
+                "overused on the first commit) must derive the "
+                "dynamic-key path")
+
+    # manual settings pass through untouched (caller owns the precondition)
+    for manual in (AllocateConfig(batch_jobs=4),
+                   AllocateConfig(batch_rounds=16)):
+        if derive_batching(manual, has_proportion=True) != manual:
+            finding("manual-passthrough",
+                    f"derive_batching must not rewrite explicit manual "
+                    f"batching ({manual.batch_jobs}/{manual.batch_rounds})")
+
+    # the illegal combination still raises with the documented message
+    import jax
+
+    from .entrypoints import _ALT_SIZE, _snap_extras
+    snap, extras = _snap_extras(_ALT_SIZE)
+    bad = AllocateConfig(batch_jobs=DEFAULT_BATCH_JOBS, drf_job_order=True)
+    try:
+        jax.eval_shape(make_allocate_cycle(bad), snap, extras)
+        finding("illegal-combo:no-raise",
+                "batch_jobs > 1 with dynamic ordering keys and no "
+                "batch_rounds must raise in make_allocate_cycle — the "
+                "one-place config assert is gone")
+    except ValueError as e:
+        if _ILLEGAL_MSG not in str(e):
+            finding("illegal-combo:message",
+                    f"the illegal-combination error dropped its "
+                    f"documented message ({_ILLEGAL_MSG!r}): got {e}")
+    except Exception as e:  # noqa: BLE001
+        finding("illegal-combo:wrong-error",
+                f"expected ValueError for the illegal combination, got "
+                f"{type(e).__name__}: {e}")
+    return out
+
+
+def check_obligations(repo_root: Optional[str] = None) -> List[Finding]:
+    return scan_sources(repo_root) + verify_batching_rule()

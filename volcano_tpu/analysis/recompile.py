@@ -1,0 +1,68 @@
+"""Recompilation-hazard lint.
+
+Every jitted entry point must compile exactly once per problem-size
+bucket: the arrays/schema.bucket grid exists so a production scheduler
+pays one compile per shape family, and a Python-value-dependent shape or
+branch (a host int folded into a shape, an `if` on a concrete value that
+differs per call, a non-weak scalar captured per invocation) silently
+turns that into a compile per CYCLE — the exact hazard class that makes a
+1 s schedule period impossible.
+
+The lint wraps each entry's raw callable with a trace counter, jits it,
+and runs it twice per size with FRESH same-shaped inputs. Expected trace
+count == number of distinct sizes; anything more is a finding naming the
+entry point.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import Finding
+
+
+def check_recompile(fast: bool = False,
+                    probes: Optional[list] = None) -> List[Finding]:
+    import jax
+
+    from .entrypoints import recompile_probes
+    out: List[Finding] = []
+    for name, build_fn, args_by_size in (
+            probes if probes is not None else recompile_probes(fast=fast)):
+        raw = build_fn()
+        count = 0
+
+        def counted(*args, _raw=raw):
+            nonlocal count
+            count += 1
+            return _raw(*args)
+
+        jfn = jax.jit(counted)
+        try:
+            for calls in args_by_size.values():
+                # a size entry is one arg tuple (called twice — the second
+                # same-shaped call must not retrace) or a list of arg
+                # tuples (every call must land in the size's one bucket)
+                if isinstance(calls, tuple):
+                    calls = [calls, calls]
+                for args in calls:
+                    jax.block_until_ready(jfn(*args))
+        except Exception as e:  # noqa: BLE001 — report, don't crash the CLI
+            out.append(Finding(
+                family="recompile", key=f"recompile:{name}:error",
+                where=name,
+                what=(f"entry point '{name}' failed to execute during the "
+                      f"recompile lint: {type(e).__name__}: {e}")))
+            continue
+        expected = len(args_by_size)
+        if count != expected:
+            out.append(Finding(
+                family="recompile",
+                key=f"recompile:{name}:traces={count}:expected={expected}",
+                where=name,
+                what=(f"entry point '{name}' traced {count}x for "
+                      f"{expected} problem-size bucket(s) — a "
+                      "Python-value-dependent shape or control flow is "
+                      "defeating the jit cache (one compile per shape "
+                      "bucket is the budget)")))
+    return out

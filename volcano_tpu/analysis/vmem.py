@@ -1,0 +1,126 @@
+"""Pallas VMEM budget estimator + fidelity check.
+
+Both placement kernels run with whole-array BlockSpecs (no grid), so the
+kernel's static VMEM footprint is exactly the byte sum of the
+``pallas_call`` equation's input and output avals. The runtime auto-gate
+(allocate_scan: ``use_pallas is None``) admits the kernel only when
+``vmem_estimate_bytes`` stays under budget — which means a lowering
+surprise on the driver's TPU can only come from the ESTIMATOR drifting
+below the truth. This check closes that gap on CPU:
+
+1. per traced kernel, the jaxpr-derived footprint must stay under the
+   per-core budget;
+2. ``vmem_estimate_bytes`` (fed the same dims the auto-gate feeds it)
+   must not understate the jaxpr-derived truth;
+3. the north-star-scale projection (10240 nodes, M=16 task slots, the
+   bench's bucketed J/Q) must clear the budget, so the full-scale cycle
+   keeps lowering long before a TPU sees it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import Finding
+
+#: per-core VMEM budget the auto-gate enforces (allocate_scan keeps 4 MiB
+#: of the ~16 MiB core for mosaic's own scratch/padding headroom)
+DEFAULT_BUDGET_BYTES = 12 * 2 ** 20
+
+#: estimator must cover at least this fraction of the traced footprint
+FIDELITY = 1.0
+
+#: north-star problem size (BASELINE.json config 1, bucketed)
+_NS_NODES = 10240
+_NS_M = 16
+_NS_JOBS = 6250
+
+
+def _pallas_bytes(closed) -> List[int]:
+    """Byte totals (inputs + outputs) of every pallas_call in the trace."""
+    import numpy as np
+
+    from .jaxpr_audit import iter_eqns
+    totals = []
+    for eqn in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        tot = 0
+        for v in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            tot += int(np.prod(aval.shape, dtype=np.int64)
+                       ) * aval.dtype.itemsize
+        totals.append(tot)
+    return totals
+
+
+def _estimate(dims, cfg, N=None, M=None, J=None, Q=None) -> int:
+    """vmem_estimate_bytes with the SAME dim wiring the auto-gate uses."""
+    from ..ops.pallas_place import vmem_estimate_bytes
+    K = max(1, int(cfg.batch_jobs))
+    KP = max(0, int(cfg.batch_rounds))
+    aff = ((dims["SK"], dims["ETA"], dims["SEL"])
+           if cfg.enable_pod_affinity else (0, 0, 0))
+    return vmem_estimate_bytes(
+        K, M if M is not None else dims["M"],
+        N if N is not None else dims["N"],
+        dims["R"], dims["G"], dims["P"], dims["GR"], *aff,
+        J=(J if J is not None else dims["J"]) if KP else 0,
+        Q=(Q if Q is not None else dims["Q"]) if KP else 0)
+
+
+def check_vmem(traces, budget_bytes: Optional[int] = None) -> List[Finding]:
+    from ..arrays.schema import bucket
+    budget = budget_bytes or DEFAULT_BUDGET_BYTES
+    out: List[Finding] = []
+    checked = 0
+    for tr in traces:
+        cfg = tr.cfg
+        if cfg is None or not getattr(cfg, "use_pallas", None):
+            continue
+        totals = _pallas_bytes(tr.closed)
+        if not totals:
+            continue
+        checked += 1
+        traced = max(totals)
+        if traced > budget:
+            out.append(Finding(
+                family="vmem",
+                key=f"vmem:{tr.name}:traced={traced}:budget={budget}",
+                where=tr.name,
+                what=(f"pallas kernel in '{tr.name}' holds {traced} bytes "
+                      f"of VMEM-resident inputs/outputs, over the "
+                      f"{budget}-byte per-core budget")))
+        est = _estimate(tr.dims, cfg)
+        if est < FIDELITY * traced:
+            out.append(Finding(
+                family="vmem",
+                key=f"vmem:{tr.name}:estimator={est}:traced={traced}",
+                where=tr.name,
+                what=(f"vmem_estimate_bytes returns {est} for the dims of "
+                      f"'{tr.name}' but the traced kernel holds {traced} "
+                      "bytes — the runtime auto-gate is understating the "
+                      "footprint (keep the estimator in sync with "
+                      "_read_*_env)")))
+        # north-star projection through the SAME estimator the gate uses
+        est_ns = _estimate(tr.dims, cfg, N=_NS_NODES, M=_NS_M,
+                           J=bucket(_NS_JOBS), Q=tr.dims["Q"])
+        if est_ns > budget:
+            out.append(Finding(
+                family="vmem",
+                key=f"vmem:{tr.name}:northstar={est_ns}:budget={budget}",
+                where=tr.name,
+                what=(f"north-star-scale ({_NS_NODES} nodes, M={_NS_M}) "
+                      f"VMEM estimate for '{tr.name}' is {est_ns} bytes, "
+                      f"over the {budget}-byte budget — the full-scale "
+                      "cycle would fall off the fused-kernel path")))
+    if checked == 0:
+        out.append(Finding(
+            family="vmem", key="vmem:no-pallas-entry-traced",
+            where="analysis/entrypoints",
+            what=("no pallas_call found in any traced entry point — the "
+                  "vmem family has nothing to certify (entrypoints "
+                  "registry out of sync with ops/pallas_place)")))
+    return out

@@ -1082,9 +1082,9 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 gpu_km = gpu_s.reshape(K, M)
                 placed_m = mode_km != MODE_NONE
                 n_alloc_vec = jnp.sum(mode_km == MODE_ALLOCATED,
-                                      axis=1).astype(jnp.int32)
+                                      axis=1, dtype=jnp.int32)
                 n_pipe_vec = jnp.sum(mode_km == MODE_PIPELINED,
-                                     axis=1).astype(jnp.int32)
+                                     axis=1, dtype=jnp.int32)
                 # gang flags from the kernel's (discard-cleared) modes:
                 # a discarded section counts zero, reproducing the XLA
                 # finalize's false flags; kept sections carry real counts
@@ -1132,7 +1132,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
                                      M - 1)
                 n_adv = jnp.sum(
                     open_slot & (slots[None, :] <= boundary[:, None]),
-                    axis=1).astype(jnp.int32)
+                    axis=1, dtype=jnp.int32)
                 committed = jnp.sum(
                     jnp.where(placed_m[:, :, None], tasks.resreq[tcl],
                               0.0), axis=1)                       # [K, R]
@@ -1216,7 +1216,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                            # volume-binding seam (cache.go:240-272)
                            & extras.task_volume_ok[t]
                            & ((extras.task_volume_node[t] < 0)
-                              | (jnp.arange(N) == extras.task_volume_node[t]))
+                              | (jnp.arange(N, dtype=jnp.int32)
+                                 == extras.task_volume_node[t]))
                            & (~extras.node_locked | (ji == extras.target_job))
                            & tmpl_static[tasks.template[t]])
                 if cfg.enable_host_ports:
@@ -1271,12 +1272,14 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 placed = do_alloc | do_pipe
                 node = jnp.where(do_alloc, n_now, n_fut)
 
-                delta = jnp.where(do_alloc, 1.0, 0.0) * resreq
+                delta = jnp.where(do_alloc, jnp.float32(1.0),
+                                  jnp.float32(0.0)) * resreq
                 idle = idle.at[node].add(-delta)
-                pipe_delta = jnp.where(do_pipe, 1.0, 0.0) * resreq
+                pipe_delta = jnp.where(do_pipe, jnp.float32(1.0),
+                                       jnp.float32(0.0)) * resreq
                 pipe_extra = pipe_extra.at[node].add(pipe_delta)
                 pods_extra = pods_extra.at[node].add(
-                    jnp.where(placed, 1, 0))
+                    jnp.where(placed, jnp.int32(1), jnp.int32(0)))
                 # shared-GPU card assignment: lowest fitting card on the chosen
                 # node (predicateGPU, gpu.go:41-56), charged for the cycle
                 card = P.pick_gpu_row(gpu_req, nodes.gpu_memory[node],
@@ -1290,10 +1293,11 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 t_mode = t_mode.at[t].set(
                     jnp.where(do_alloc, MODE_ALLOCATED,
                               jnp.where(do_pipe, MODE_PIPELINED, t_mode[t])))
-                n_alloc += jnp.where(do_alloc, 1, 0)
-                n_pipe += jnp.where(do_pipe, 1, 0)
-                placed_sum = placed_sum + jnp.where(placed, 1.0, 0.0) * resreq
-                n_adv += jnp.where(can_run, 1, 0)
+                n_alloc += jnp.where(do_alloc, jnp.int32(1), jnp.int32(0))
+                n_pipe += jnp.where(do_pipe, jnp.int32(1), jnp.int32(0))
+                placed_sum = placed_sum + jnp.where(
+                    placed, jnp.float32(1.0), jnp.float32(0.0)) * resreq
+                n_adv += jnp.where(can_run, jnp.int32(1), jnp.int32(0))
                 # yield: a ready job with tasks still queued re-enters the
                 # job queue after each placement (allocate.go:262-265);
                 # break: a task no node can take fails the whole job
@@ -1317,7 +1321,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     pe_node = pe_node.at[widx].set(node, mode="drop")
                     pe_port = pe_port.at[widx].set(tp, mode="drop")
                     pe_cnt = pe_cnt + jnp.where(
-                        placed, jnp.sum(act_p.astype(jnp.int32)), 0)
+                        placed, jnp.sum(act_p, dtype=jnp.int32),
+                        jnp.int32(0))
                 return (idle, pipe_extra, pods_extra, gpu_extra,
                         t_node, t_mode, t_gpu, n_alloc, n_pipe,
                         aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
@@ -1385,7 +1390,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             # on Allocate/Pipeline, proportion.go:281-325, drf.go:511-536);
             # only this pop's placements count, and only when kept
             qi = jobs.queue[ji]
-            committed = jnp.where(keep, 1.0, 0.0) * placed_sum
+            committed = jnp.where(keep, jnp.float32(1.0),
+                                  jnp.float32(0.0)) * placed_sum
             queue_allocated = st["queue_allocated"].at[qi].add(committed)
 
             return dict(

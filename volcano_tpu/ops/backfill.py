@@ -53,16 +53,18 @@ def make_backfill_pass():
             feas = (tmpl_static[tasks.template[t]] & or_ok
                     & P.capacity_feasible(nodes, tasks.resreq[t], nodes.idle,
                                           pods_extra))
-            node = jnp.argmax(feas).astype(jnp.int32)  # lowest feasible index
+            node = jax.lax.argmax(feas, 0, jnp.int32)  # lowest feasible index
             ok = candidate[t] & jnp.any(feas)
-            pods_extra = pods_extra.at[node].add(jnp.where(ok, 1, 0))
+            pods_extra = pods_extra.at[node].add(
+                jnp.where(ok, jnp.int32(1), jnp.int32(0)))
             t_node = t_node.at[t].set(jnp.where(ok, node, -1))
             placed = placed.at[t].set(ok)
             return (pods_extra, t_node, placed), None
 
         init = (jnp.zeros(N, jnp.int32), jnp.full(T, -1, jnp.int32),
                 jnp.zeros(T, bool))
-        (_, t_node, placed), _ = jax.lax.scan(step, init, jnp.arange(T))
+        (_, t_node, placed), _ = jax.lax.scan(
+            step, init, jnp.arange(T, dtype=jnp.int32))
         return t_node, placed
 
     return backfill

@@ -80,7 +80,8 @@ def make_enqueue_pass(cfg: EnqueueConfig):
             permit = permit | sla_waiting[ji]
             admit = ok & permit
 
-            upd = jnp.where(admit, 1.0, 0.0) * minres
+            upd = jnp.where(admit, jnp.float32(1.0), jnp.float32(0.0)) \
+                * minres
             q_inqueue = q_inqueue.at[qi].add(upd)
             cluster_inqueue = cluster_inqueue + upd
             admitted = admitted.at[ji].set(admit)

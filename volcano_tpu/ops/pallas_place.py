@@ -104,7 +104,7 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
         frac = jnp.where(applicable,
                          (used + rr_col) / jnp.maximum(alloc_t, _EPS_DIV), 0.0)
         over = frac > 1.0 + 1e-6
-        w = 1.0 * applicable
+        w = applicable.astype(jnp.float32)
         wsum = jnp.sum(w, axis=0, keepdims=True)
         raw = jnp.sum(frac * w, axis=0, keepdims=True) \
             / jnp.maximum(wsum, _EPS_DIV)
@@ -114,7 +114,8 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
         cap = jnp.maximum(alloc_t, _EPS_DIV)
         free_frac = (alloc_t - used - rr_col) / cap
         counted = alloc_t > 0
-        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True), 1)
+        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True,
+                                dtype=jnp.int32), 1)
         score += cfg.least_allocated_weight * (
             jnp.sum(jnp.clip(free_frac, 0.0, 1.0) * counted, axis=0,
                     keepdims=True) / n * 100.0)
@@ -122,7 +123,8 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
         cap = jnp.maximum(alloc_t, _EPS_DIV)
         used_frac = (used + rr_col) / cap
         counted = alloc_t > 0
-        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True), 1)
+        n = jnp.maximum(jnp.sum(counted, axis=0, keepdims=True,
+                                dtype=jnp.int32), 1)
         score += cfg.most_allocated_weight * (
             jnp.sum(jnp.clip(used_frac, 0.0, 1.0) * counted, axis=0,
                     keepdims=True) / n * 100.0)
@@ -140,7 +142,7 @@ def _dyn_score(cfg, idle, alloc_t, rr_col):
 
 def _seli(row, idx, iota):
     """mosaic has no dynamic lane indexing: scalar = one-hot reduce."""
-    return jnp.sum(jnp.where(iota == idx, row, 0))
+    return jnp.sum(jnp.where(iota == idx, row, 0), dtype=jnp.int32)
 
 
 def _self(row, idx, iota):
@@ -231,7 +233,7 @@ def _aff_eval(cfg, env, sel_s, aff_state):
     ok_acc = jnp.ones((1, N), bool)
     for i in range(a.A):
         ska = jnp.sum(a.aff_sk_ref[(pl.dslice(i, 1), slice(None))]
-                      * sel_s.astype(jnp.int32))
+                      * sel_s.astype(jnp.int32), dtype=jnp.int32)
         act_a = ska >= 0
         skc = jnp.maximum(ska, 0)
         have = row_at(aff_cnt, skc, a.iota_sk_sub)            # [1, N]
@@ -248,11 +250,12 @@ def _aff_eval(cfg, env, sel_s, aff_state):
     viol_own = jnp.zeros((1, N), bool)
     for i in range(a.B):
         etab = jnp.sum(a.anti_ref[(pl.dslice(i, 1), slice(None))]
-                       * sel_s.astype(jnp.int32))
+                       * sel_s.astype(jnp.int32), dtype=jnp.int32)
         bact = etab >= 0
         ec = jnp.maximum(etab, 0)
         eskb = jnp.maximum(jnp.sum(jnp.where(a.iota_eta == ec,
-                                             a.eta_sk_row, 0)), 0)
+                                             a.eta_sk_row, 0),
+                                   dtype=jnp.int32), 0)
         cnt_b = row_at(aff_cnt, eskb, a.iota_sk_sub)          # [1, N]
         dom_b = a.eta_dom_ref[(pl.dslice(ec, 1), slice(None))]
         viol_own |= bact & (cnt_b > 0) & (dom_b >= 0)
@@ -271,7 +274,7 @@ def _aff_eval(cfg, env, sel_s, aff_state):
     rows = []
     for i in range(a.PP):
         pskp = jnp.sum(a.prefsk_ref[(pl.dslice(i, 1), slice(None))]
-                       * sel_s.astype(jnp.int32))
+                       * sel_s.astype(jnp.int32), dtype=jnp.int32)
         pw = jnp.sum(a.prefw_ref[(pl.dslice(i, 1), slice(None))] * sel_s)
         pact = pskp >= 0
         pskc = jnp.maximum(pskp, 0)
@@ -304,22 +307,25 @@ def _aff_commit(env, sel_s, node_onehot, placed, aff_state):
     # node_onehot selects exactly one lane; masked lanes contribute 0 and a
     # missing key is -1, so select via sum of (value + 1) - 1 to keep -1
     dom_at = jnp.sum(jnp.where(node_onehot > 0, skdom + 1, 0),
-                     axis=1, keepdims=True) - 1               # [SK, 1]
+                     axis=1, keepdims=True, dtype=jnp.int32) - 1  # [SK, 1]
     member = (skdom == dom_at) & (skdom >= 0) & (dom_at >= 0)
     matchc = jnp.sum(jnp.where(sel_s > 0, a.skm_ref[:], 0.0),
                      axis=1, keepdims=True) > 0               # [SK, 1]
-    addsk = jnp.where(placed & (a.sk_sel_col >= 0) & matchc, 1.0, 0.0)
+    addsk = jnp.where(placed & (a.sk_sel_col >= 0) & matchc,
+                      jnp.float32(1.0), jnp.float32(0.0))
     aff_cnt = aff_cnt + member.astype(jnp.float32) * addsk
     aff_tot = aff_tot + (dom_at >= 0).astype(jnp.float32) * addsk
     # the task's own required anti terms mark their presence in the domain
     for i in range(a.B):
         etab = jnp.sum(a.anti_ref[(pl.dslice(i, 1), slice(None))]
-                       * sel_s.astype(jnp.int32))
+                       * sel_s.astype(jnp.int32), dtype=jnp.int32)
         ec = jnp.maximum(etab, 0)
         edom = a.eta_dom_ref[(pl.dslice(ec, 1), slice(None))]  # [1, N]
-        edom_at = jnp.sum(jnp.where(node_onehot > 0, edom + 1, 0)) - 1
+        edom_at = jnp.sum(jnp.where(node_onehot > 0, edom + 1, 0),
+                          dtype=jnp.int32) - 1
         emember = (edom == edom_at) & (edom >= 0) & (edom_at >= 0)
-        g = jnp.where((etab >= 0) & placed, 1.0, 0.0)
+        g = jnp.where((etab >= 0) & placed, jnp.float32(1.0),
+                      jnp.float32(0.0))
         anti_cnt = anti_cnt + (g * emember.astype(jnp.float32)
                                * (a.iota_eta_sub == ec))
     return aff_cnt, aff_tot, anti_cnt
@@ -343,12 +349,12 @@ def _make_attempt(cfg, env):
         sel_s = (iota_km == s).astype(jnp.float32)            # [1, CM]
         sel_i = sel_s.astype(jnp.int32)
         rr_col = jnp.sum(env.resreq_t * sel_s, axis=1, keepdims=True)
-        pref = jnp.sum(env.pref_v * sel_i)
-        tmpl = jnp.sum(env.tmpl_v * sel_i)
-        grp = jnp.sum(env.grp_v * sel_i)
-        voln = jnp.sum(env.voln_v * sel_i)
-        volok = jnp.sum(env.volok_v * sel_i) > 0
-        rev = jnp.sum(env.rev_v * sel_i) > 0
+        pref = jnp.sum(env.pref_v * sel_i, dtype=jnp.int32)
+        tmpl = jnp.sum(env.tmpl_v * sel_i, dtype=jnp.int32)
+        grp = jnp.sum(env.grp_v * sel_i, dtype=jnp.int32)
+        voln = jnp.sum(env.voln_v * sel_i, dtype=jnp.int32)
+        volok = jnp.sum(env.volok_v * sel_i, dtype=jnp.int32) > 0
+        rev = jnp.sum(env.rev_v * sel_i, dtype=jnp.int32) > 0
 
         # static feasibility row: template mask + per-cycle node gates
         # (the node_ok conjunction of allocate_scan.task_step)
@@ -385,7 +391,7 @@ def _make_attempt(cfg, env):
         score = score + (env.nascore_ref[trow]
                          + jnp.where(rev, env.bonus, 0.0))
         score = score + jnp.where((pref >= 0) & (iota_n == pref),
-                                  100.0, 0.0)
+                                  jnp.float32(100.0), jnp.float32(0.0))
         if cfg.enable_pod_affinity:
             aff_feas, aff_score = _aff_eval(cfg, env, sel_s, aff_state)
             feas_now &= aff_feas
@@ -409,9 +415,10 @@ def _make_attempt(cfg, env):
         node = jnp.where(do_alloc, n_now, n_fut)
 
         onehot = (iota_n == node).astype(jnp.float32)         # [1, N]
-        idle = idle - jnp.where(do_alloc, 1.0, 0.0) * rr_col * onehot
-        pipe = pipe + jnp.where(do_pipe, 1.0, 0.0) * rr_col * onehot
-        podsx = podsx + jnp.where(placed, 1.0, 0.0) * onehot
+        one, zero = jnp.float32(1.0), jnp.float32(0.0)
+        idle = idle - jnp.where(do_alloc, one, zero) * rr_col * onehot
+        pipe = pipe + jnp.where(do_pipe, one, zero) * rr_col * onehot
+        podsx = podsx + jnp.where(placed, one, zero) * onehot
 
         if gpu:
             # lowest fitting card on the chosen node (pick_gpu_row)
@@ -422,14 +429,15 @@ def _make_attempt(cfg, env):
                 & (gr[0, 0] > 0)
             card = jnp.where(ok_pick, card, -1)
             charge = placed & (card >= 0)
-            gpux = gpux + (jnp.where(charge, 1.0, 0.0) * gr
+            gpux = gpux + (jnp.where(charge, one, zero) * gr
                            * (iota_g == jnp.maximum(card, 0)) * onehot)
         else:
             card = jnp.int32(-1)
             charge = jnp.bool_(False)
 
-        mode = jnp.where(do_alloc, MODE_ALLOCATED,
-                         jnp.where(do_pipe, MODE_PIPELINED, MODE_NONE))
+        mode = jnp.where(do_alloc, jnp.int32(MODE_ALLOCATED),
+                         jnp.where(do_pipe, jnp.int32(MODE_PIPELINED),
+                                   jnp.int32(MODE_NONE)))
         is_s = iota_km == s
         node_v = jnp.where(is_s, jnp.where(placed, node, -1), node_v)
         mode_v = jnp.where(is_s, mode, mode_v)
@@ -525,14 +533,16 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
             (cap, aff_st, outs, n_allocs, n_pipes, stopped, broke) = tcarry
             s = k * M + m
             sel_i = (env.iota_km == s).astype(jnp.int32)
-            act = jnp.sum(active_v * sel_i) > 0
-            suffix = jnp.sum(suffix_v * sel_i)
+            act = jnp.sum(active_v * sel_i, dtype=jnp.int32) > 0
+            suffix = jnp.sum(suffix_v * sel_i, dtype=jnp.int32)
             # yield/break state gates the attempt (allocate.go:205-266)
             active = act & sec_act & ~stopped & ~broke
             (cap, aff_st, outs, placed, do_alloc, do_pipe,
              _rr) = attempt(s, active, is_tgt, cap, aff_st, outs)
-            n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
-            n_pipes = n_pipes + jnp.where(do_pipe, 1, 0)
+            n_allocs = n_allocs + jnp.where(do_alloc, jnp.int32(1),
+                                            jnp.int32(0))
+            n_pipes = n_pipes + jnp.where(do_pipe, jnp.int32(1),
+                                          jnp.int32(0))
             if cfg.enable_gang:
                 ready_aft = (ready0 + n_allocs) >= min_avail
             else:
@@ -544,7 +554,7 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
 
         (cap, aff_st, outs, n_allocs, n_pipes, _stopped,
          _broke) = jax.lax.fori_loop(
-            0, M, task_body,
+            jnp.int32(0), jnp.int32(M), task_body,
             (ccap, caff, outs, jnp.int32(0), jnp.int32(0),
              jnp.bool_(False), jnp.bool_(False)))
 
@@ -580,7 +590,7 @@ def _batch_kernel(cfg, K, M, N, R, G, GR, refs):
     aff0 = ((affc_ref[:], afft_ref[:], antic_ref[:]) if aff
             else (jnp.zeros((1, 1), jnp.float32),) * 3)
     (cap, aff_st, outs) = jax.lax.fori_loop(
-        0, K, job_body,
+        jnp.int32(0), jnp.int32(K), job_body,
         ((idle_ref[:], pipe_ref[:], podsx_ref[:], gpux0), aff0,
          (neg1, jnp.zeros((1, KM), jnp.int32), neg1)))
     node_o[:], mode_o[:], gpu_o[:] = outs
@@ -752,8 +762,8 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
     des = des_ref[:]
     qex = qex_ref[:]
     total = total_ref[:]
-    kmax = jnp.sum(kmax_ref[:])
-    tgt = jnp.sum(tgt_ref[:])
+    kmax = jnp.sum(kmax_ref[:], dtype=jnp.int32)
+    tgt = jnp.sum(tgt_ref[:], dtype=jnp.int32)
     cand0 = _seli(cand_v, 0, iota_c)
 
     attempt = _make_attempt(cfg, env)
@@ -772,7 +782,8 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
 
         # ---- eligibility (mirror of allocate_scan.eligible) --------------
         over_col = jnp.max(
-            jnp.where(qalloc > des + 1e-6, 1.0, 0.0), axis=1,
+            jnp.where(qalloc > des + 1e-6, jnp.float32(1.0),
+                      jnp.float32(0.0)), axis=1,
             keepdims=True)                                    # [Q, 1]
         over_j = jnp.sum(qoh * over_col, axis=0, keepdims=True) > 0
         elig = (eligs_v & (done == 0) & (cursor < npend_v) & ~over_j)
@@ -854,13 +865,15 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
         stop = stop | ~ok
 
         onehot_j = iota_j == jstar                            # [1, J]
-        cur0 = jnp.sum(jnp.where(onehot_j, cursor, 0))
-        ready0_dyn = jnp.sum(jnp.where(onehot_j, rdy0_v + acount, 0))
-        min_avail = jnp.sum(jnp.where(onehot_j, minav_v, 0))
+        cur0 = jnp.sum(jnp.where(onehot_j, cursor, 0), dtype=jnp.int32)
+        ready0_dyn = jnp.sum(jnp.where(onehot_j, rdy0_v + acount, 0),
+                             dtype=jnp.int32)
+        min_avail = jnp.sum(jnp.where(onehot_j, minav_v, 0),
+                            dtype=jnp.int32)
         can_batch = jnp.sum(jnp.where(onehot_j, canb_v.astype(jnp.int32),
-                                      0)) > 0
+                                      0), dtype=jnp.int32) > 0
         is_tgt = jstar == tgt
-        q_j = jnp.sum(jnp.where(onehot_j, qid_v, 0))
+        q_j = jnp.sum(jnp.where(onehot_j, qid_v, 0), dtype=jnp.int32)
         off = cslot * M
 
         # ---- the M-placement section (mirror of the scan task loop) ------
@@ -869,16 +882,18 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
              stopped, broke) = tcarry
             s = off + m_
             sel_i = (env.iota_km == s).astype(jnp.int32)
-            tid_ok = jnp.sum(tidok_v * sel_i) > 0
-            nbe = jnp.sum(nbe_v * sel_i) > 0
-            suffix = jnp.sum(suffix_v * sel_i)
+            tid_ok = jnp.sum(tidok_v * sel_i, dtype=jnp.int32) > 0
+            nbe = jnp.sum(nbe_v * sel_i, dtype=jnp.int32) > 0
+            suffix = jnp.sum(suffix_v * sel_i, dtype=jnp.int32)
             can_run = (tid_ok & (m_ >= cur0) & ~stopped & ~broke & ok)
             active = can_run & nbe
             (cap, aff_st, outs, placed, do_alloc, do_pipe,
              _rr) = attempt(s, active, is_tgt, cap, aff_st, outs)
-            n_allocs = n_allocs + jnp.where(do_alloc, 1, 0)
-            n_pipes = n_pipes + jnp.where(do_pipe, 1, 0)
-            n_adv = n_adv + jnp.where(can_run, 1, 0)
+            n_allocs = n_allocs + jnp.where(do_alloc, jnp.int32(1),
+                                            jnp.int32(0))
+            n_pipes = n_pipes + jnp.where(do_pipe, jnp.int32(1),
+                                          jnp.int32(0))
+            n_adv = n_adv + jnp.where(can_run, jnp.int32(1), jnp.int32(0))
             if cfg.enable_gang:
                 ready_aft = (ready0_dyn + n_allocs) >= min_avail
             else:
@@ -891,7 +906,7 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
 
         (ncap, naff, nouts, n_allocs, n_pipes, n_adv, stopped,
          _broke) = jax.lax.fori_loop(
-            0, M, task_body,
+            jnp.int32(0), jnp.int32(M), task_body,
             (cap, aff_st, outs, jnp.int32(0), jnp.int32(0), jnp.int32(0),
              jnp.bool_(False), jnp.bool_(False)))
 
@@ -935,28 +950,30 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
         # committed resources of this pop, accumulated in slot order like
         # the scan path's placed_sum (f32 adds in the same sequence)
         placed_m = (mode_v != MODE_NONE) & sec
-        sel_rows = jnp.where(placed_m, 1.0, 0.0)
+        sel_rows = jnp.where(placed_m, jnp.float32(1.0), jnp.float32(0.0))
         placed_col = jnp.sum(env.resreq_t * sel_rows, axis=1,
                              keepdims=True)                   # [R, 1]
-        commit_col = jnp.where(keep, placed_col, 0.0)
+        commit_col = jnp.where(keep, placed_col, jnp.float32(0.0))
         # [R, 1] -> [1, R] exact transpose via one-hot diagonal
         commit_row = jnp.sum(
-            jnp.where(iota_rr_s == iota_rr_l, commit_col, 0.0),
+            jnp.where(iota_rr_s == iota_rr_l, commit_col, jnp.float32(0.0)),
             axis=0, keepdims=True)                            # [1, R]
 
         upd = onehot_j & ok
-        done = jnp.where(upd, jnp.where(stopped, 0, 1), done)
-        popped = jnp.where(upd, 1, popped)
-        jready = jnp.where(upd, jnp.where(ready & keep, 1, 0), jready)
-        jpipe = jnp.where(upd, jnp.where(pipelined & keep, 1, 0), jpipe)
+        i1, i0 = jnp.int32(1), jnp.int32(0)
+        done = jnp.where(upd, jnp.where(stopped, i0, i1), done)
+        popped = jnp.where(upd, i1, popped)
+        jready = jnp.where(upd, jnp.where(ready & keep, i1, i0), jready)
+        jpipe = jnp.where(upd, jnp.where(pipelined & keep, i1, i0), jpipe)
         cursor = jnp.where(upd, cursor + n_adv, cursor)
         acount = jnp.where(upd & keep, acount + n_allocs, acount)
-        jalloc = jalloc + jnp.where(upd, commit_col, 0.0)
-        qalloc = qalloc + jnp.where(iota_q_sub == q_j, 1.0, 0.0) \
-            * commit_row * jnp.where(ok, 1.0, 0.0)
+        jalloc = jalloc + jnp.where(upd, commit_col, jnp.float32(0.0))
+        qalloc = qalloc + jnp.where(iota_q_sub == q_j, jnp.float32(1.0),
+                                    jnp.float32(0.0)) \
+            * commit_row * jnp.where(ok, jnp.float32(1.0), jnp.float32(0.0))
         kept_any = kept_any | (keep & ((n_allocs + n_pipes) > 0))
         prog = prog | (ok & ((n_allocs > 0) | pipelined | ready))
-        pops = pops + jnp.where(ok, 1, 0)
+        pops = pops + jnp.where(ok, i1, i0)
         return (stop, pops, kept_any, prog, cap, aff_st,
                 (node_v, mode_v, gpuc_v),
                 done, popped, jready, jpipe, cursor, acount,
@@ -974,7 +991,8 @@ def _dyn_kernel(cfg, C, KP, M, N, R, G, GR, J, Q, S, NH, refs):
             cursor_ref[:], acount_ref[:], jalloc_ref[:], qalloc_ref[:])
     (stop, pops, kept_any, prog, cap, aff_st, outs,
      done, popped, jready, jpipe, cursor, acount,
-     jalloc, qalloc) = jax.lax.fori_loop(0, KP, pop_body, init)
+     jalloc, qalloc) = jax.lax.fori_loop(jnp.int32(0), jnp.int32(KP),
+                                         pop_body, init)
     node_o[:], mode_o[:], gpu_o[:] = outs
     idle_o[:], pipe_o[:], podsx_o[:] = cap[0], cap[1], cap[2]
     if gpu:

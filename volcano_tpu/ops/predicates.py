@@ -112,7 +112,7 @@ def pick_gpu(gpu_request: jax.Array, nodes: NodeArrays,
     if gpu_extra is not None:
         idle = idle - gpu_extra
     fits = idle >= gpu_request - _EPS
-    first = jnp.argmax(fits, axis=-1).astype(jnp.int32)
+    first = jax.lax.argmax(fits, fits.ndim - 1, jnp.int32)
     ok = jnp.any(fits, axis=-1) & (gpu_request > 0)
     return jnp.where(ok, first, -1)
 
@@ -171,7 +171,7 @@ def pick_gpu_row(gpu_request: jax.Array, mem_row: jax.Array,
     allocate inner scan where only the chosen node's pick is needed)."""
     idle = mem_row - used_row - extra_row
     fits = idle >= gpu_request - _EPS
-    first = jnp.argmax(fits).astype(jnp.int32)
+    first = jax.lax.argmax(fits, 0, jnp.int32)
     ok = jnp.any(fits) & (gpu_request > 0)
     return jnp.where(ok, first, -1)
 

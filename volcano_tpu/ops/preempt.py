@@ -162,9 +162,11 @@ def make_preempt_cycle(cfg: PreemptConfig):
         # one-hot matmul views replace [T]-index gathers from small tables
         # (MXU-friendly; a [T] gather serializes)
         vns_onehot = (vns[:, None]
-                      == jnp.arange(S_ns)[None, :]).astype(jnp.float32)
+                      == jnp.arange(S_ns, dtype=jnp.int32)[None, :]
+                      ).astype(jnp.float32)
         vq_onehot = (vqueue[:, None]
-                     == jnp.arange(Q_q)[None, :]).astype(jnp.float32)
+                     == jnp.arange(Q_q, dtype=jnp.int32)[None, :]
+                     ).astype(jnp.float32)
         vdes = queue_deserved[vqueue]
         vreclaimable = queues.reclaimable[vqueue]
         # [T] per-victim remaining-eviction budget of its job (one hoisted
@@ -511,7 +513,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     intersect within it (session_plugins.go:131-215)."""
                     on_n = tasks.node == n
                     t_has = jnp.any(stacked & on_n[None, :], axis=1)
-                    ktier = jnp.argmax(t_has)
+                    ktier = jax.lax.argmax(t_has, 0, jnp.int32)
                     chosen = jnp.zeros_like(on_n)
                     for kk in range(stacked.shape[0]):
                         chosen = jnp.where(ktier == kk, stacked[kk], chosen)
@@ -543,8 +545,9 @@ def make_preempt_cycle(cfg: PreemptConfig):
 
                 def cand_body(c):
                     tried, _found, node0, k = c
-                    cand = jnp.argmax(jnp.where(
-                        possible & ~tried, score, NEG)).astype(jnp.int32)
+                    cand = jax.lax.argmax(jnp.where(
+                        possible & ~tried, score, jnp.float32(NEG)),
+                        0, jnp.int32)
                     _vok_c, ev_c = node_victims(cand)
                     fits_c = jnp.all(resreq <= avail[cand] + ev_c + 1e-5)
                     return (tried | (iota_n == cand), fits_c,
@@ -565,9 +568,10 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     n_tiers = stacked.shape[0]
                     node_any = jnp.zeros((n_tiers, N + 1), bool)
                     node_any = node_any.at[
-                        jnp.arange(n_tiers)[:, None], node_idx].set(
+                        jnp.arange(n_tiers, dtype=jnp.int32)[:, None],
+                        node_idx].set(
                             True)[:, :N]
-                    first_tier = jnp.argmax(node_any, axis=0)
+                    first_tier = jax.lax.argmax(node_any, 0, jnp.int32)
                     has_tier = jnp.any(node_any, axis=0)
                     pick = first_tier[jnp.maximum(tasks.node, 0)]
                     chosen = jnp.take_along_axis(
@@ -580,8 +584,9 @@ def make_preempt_cycle(cfg: PreemptConfig):
                     enough = jnp.all(
                         resreq[None, :] <= avail + evictable + 1e-5, axis=-1)
                     feas = possible & ~tried & enough
-                    nd = jnp.argmax(
-                        jnp.where(feas, score, NEG)).astype(jnp.int32)
+                    nd = jax.lax.argmax(
+                        jnp.where(feas, score, jnp.float32(NEG)),
+                        0, jnp.int32)
                     fnd = jnp.any(feas)
                     return (fnd, jnp.where(fnd, nd, node0))
 
@@ -622,7 +627,9 @@ def make_preempt_cycle(cfg: PreemptConfig):
                             [tasks.priority.astype(jnp.float32)], vok_now)
                         doit = (go & vfound & ~fits_now
                                 & (k < cfg.max_victims_per_task))
-                        dres = jnp.where(doit, 1.0, 0.0) * tasks.resreq[vt]
+                        dres = jnp.where(doit, jnp.float32(1.0),
+                                         jnp.float32(0.0)) \
+                            * tasks.resreq[vt]
                         extra_idle = extra_idle.at[node].add(dres)
                         ub_node = ub_node.at[node].add(-dres)
                         evicted = evicted.at[vt].set(evicted[vt] | doit)
@@ -640,7 +647,8 @@ def make_preempt_cycle(cfg: PreemptConfig):
                         if use_budget:
                             vbudget = vbudget - (
                                 (vjob == tasks.job[vt]) & doit)
-                        k = k + jnp.where(doit, 1, 0)
+                        k = k + jnp.where(doit, jnp.int32(1),
+                                          jnp.int32(0))
                         progressed |= doit
                     # no victim found and still unfit: bail out exactly
                     # like the one-per-iteration loop did
@@ -661,9 +669,11 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 fits = found & jnp.all(
                     resreq <= (extra_idle - pipe_extra + future0)[node] + 1e-5)
                 pipe_extra = pipe_extra.at[node].add(
-                    jnp.where(fits, 1.0, 0.0) * resreq)
+                    jnp.where(fits, jnp.float32(1.0),
+                              jnp.float32(0.0)) * resreq)
                 # AllocateFunc analog for the pipelined preemptor
-                pres = jnp.where(fits, 1.0, 0.0) * resreq
+                pres = jnp.where(fits, jnp.float32(1.0),
+                                 jnp.float32(0.0)) * resreq
                 job_alloc_dyn = job_alloc_dyn.at[ji].add(pres)
                 queue_alloc_dyn = queue_alloc_dyn.at[jobs.queue[ji]].add(pres)
                 ns_alloc_dyn = ns_alloc_dyn.at[jobs.namespace[ji]].add(pres)
@@ -671,7 +681,7 @@ def make_preempt_cycle(cfg: PreemptConfig):
                 t_node = t_node.at[t].set(jnp.where(fits, node, t_node[t]))
                 t_mode = t_mode.at[t].set(
                     jnp.where(fits, MODE_PIPELINED, t_mode[t]))
-                n_pipe += jnp.where(fits, 1, 0)
+                n_pipe += jnp.where(fits, jnp.int32(1), jnp.int32(0))
                 broke |= active & ~fits
                 return (extra_idle, pipe_extra, evicted, t_node, t_mode,
                         job_alloc_dyn, queue_alloc_dyn, ns_alloc_dyn,

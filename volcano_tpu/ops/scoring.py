@@ -49,7 +49,8 @@ def least_allocated_score(used: jax.Array, allocatable: jax.Array,
     cap = jnp.maximum(allocatable, _EPS)
     free_frac = (allocatable - used - resreq[None, :]) / cap
     counted = allocatable > 0
-    n = jnp.maximum(jnp.sum(counted, axis=-1), 1)
+    # dtype pins: integer/bool sums follow the x64 default int otherwise
+    n = jnp.maximum(jnp.sum(counted, axis=-1, dtype=jnp.int32), 1)
     return jnp.sum(jnp.clip(free_frac, 0.0, 1.0) * counted, axis=-1) / n * 100.0
 
 
@@ -59,7 +60,7 @@ def most_allocated_score(used: jax.Array, allocatable: jax.Array,
     cap = jnp.maximum(allocatable, _EPS)
     used_frac = (used + resreq[None, :]) / cap
     counted = allocatable > 0
-    n = jnp.maximum(jnp.sum(counted, axis=-1), 1)
+    n = jnp.maximum(jnp.sum(counted, axis=-1, dtype=jnp.int32), 1)
     return jnp.sum(jnp.clip(used_frac, 0.0, 1.0) * counted, axis=-1) / n * 100.0
 
 
@@ -83,7 +84,7 @@ def taint_prefer_score(tol_hash: jax.Array, tol_effect: jax.Array,
     from .predicates import toleration_covers
     covered = toleration_covers(tol_hash, tol_effect, tol_mode, nodes)
     prefer = nodes.taint_effect == EFFECT_PREFER_NO_SCHEDULE
-    intolerable = jnp.sum(prefer & ~covered, axis=-1)
+    intolerable = jnp.sum(prefer & ~covered, axis=-1, dtype=jnp.int32)
     max_count = jnp.maximum(jnp.max(intolerable), 1)
     return (1.0 - intolerable / max_count) * 100.0
 
@@ -92,5 +93,6 @@ def node_preference_score(preferred_node: jax.Array, n_nodes: int) -> jax.Array:
     """One-hot bonus for a specific node — used by task-topology's bucket
     preference (pkg/scheduler/plugins/task-topology/topology.go:344) and the
     reservation plugin's locked nodes."""
-    idx = jnp.arange(n_nodes)
-    return jnp.where((preferred_node >= 0) & (idx == preferred_node), 100.0, 0.0)
+    idx = jnp.arange(n_nodes, dtype=jnp.int32)
+    return jnp.where((preferred_node >= 0) & (idx == preferred_node),
+                     jnp.float32(100.0), jnp.float32(0.0))

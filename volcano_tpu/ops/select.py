@@ -20,10 +20,12 @@ NEG = -1e30
 
 def best_node(score: jax.Array, feasible: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """(index i32, found bool): argmax of score over feasible nodes,
-    first-index tie-break (jnp.argmax returns the first maximum)."""
-    masked = jnp.where(feasible, score, NEG)
-    idx = jnp.argmax(masked)
-    return idx.astype(jnp.int32), jnp.any(feasible)
+    first-index tie-break (lax.argmax returns the first maximum; the
+    index dtype is pinned so the graph stays 32-bit under any x64 config
+    — graphcheck dtype discipline)."""
+    masked = jnp.where(feasible, score, jnp.float32(NEG))
+    idx = jax.lax.argmax(masked, 0, jnp.int32)
+    return idx, jnp.any(feasible)
 
 
 def lex_argmin(keys: Sequence[jax.Array], mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -37,22 +39,31 @@ def lex_argmin(keys: Sequence[jax.Array], mask: jax.Array) -> Tuple[jax.Array, j
     m = mask
     for k in keys:
         k = k.astype(jnp.float32)
-        kmin = jnp.min(jnp.where(m, k, jnp.inf))
+        kmin = jnp.min(jnp.where(m, k, jnp.float32(jnp.inf)))
         m = m & (k <= kmin + 0.0)
     # first surviving index
-    idx = jnp.argmax(m)
-    return idx.astype(jnp.int32), jnp.any(mask)
+    idx = jax.lax.argmax(m, 0, jnp.int32)
+    return idx, jnp.any(mask)
 
 
 def sort_order(keys: Sequence[jax.Array], mask: jax.Array) -> jax.Array:
     """i32[n]: indices sorted lexicographically by ``keys`` (most significant
     first), masked-out entries last. Stable, so equal keys keep index order."""
     n = keys[0].shape[0]
-    order = jnp.arange(n)
+    order = jnp.arange(n, dtype=jnp.int32)
+
+    def _argsort_i32(k):
+        # stable ascending argsort with a pinned i32 index payload
+        # (jnp.argsort's index dtype follows the x64 config; lax.sort
+        # with an iota payload is the same sort, 32-bit by construction)
+        iota = jnp.arange(k.shape[0], dtype=jnp.int32)
+        _, idx = jax.lax.sort((k, iota), num_keys=1, is_stable=True)
+        return idx
+
     # lexsort: apply stable sorts from least-significant key to most
     for k in reversed(list(keys)):
-        k = jnp.where(mask, k.astype(jnp.float32), jnp.inf)
-        order = order[jnp.argsort(k[order], stable=True)]
+        k = jnp.where(mask, k.astype(jnp.float32), jnp.float32(jnp.inf))
+        order = order[_argsort_i32(k[order])]
     # push masked entries to the end while keeping relative order
-    masked_last = jnp.argsort(~mask[order], stable=True)
-    return order[masked_last].astype(jnp.int32)
+    masked_last = _argsort_i32(~mask[order])
+    return order[masked_last]
