@@ -701,6 +701,44 @@ tiers:
         equal_sub = _decisions_equal(sresult, scpu)
         sub_speedup = round(scpu_ms / stpu_ms, 1)
 
+    # ---- in-graph cycle telemetry block (volcano_tpu/telemetry) ----------
+    # Every BENCH record carries the telemetry=True cycle's counter block
+    # at the oracle-checked sub-scale: rejection totals, rounds/pops, the
+    # unplaced-reason histogram, and the live jit retrace counters. Fail
+    # soft: a telemetry failure (or BENCH_SKIP_TELEMETRY=1) records null,
+    # never kills the bench.
+    telemetry_block = None
+    if not os.environ.get("BENCH_SKIP_TELEMETRY"):
+        try:
+            import dataclasses as _dct
+            from volcano_tpu.telemetry import unpack_cycle_telemetry
+            from volcano_tpu.telemetry import tracecount as _tc
+            tsnap, textras, tcfg = _build(512, 320, 8, cfg_kwargs)
+            tfn = jax.jit(make_allocate_cycle(
+                _dct.replace(tcfg, telemetry=True)))
+            tres = tfn(tsnap, textras)
+            tR = int(np.asarray(tsnap.nodes.idle).shape[1])
+            tel = unpack_cycle_telemetry(
+                np.asarray(tres.telemetry.packed()), tR)
+            telemetry_block = {
+                "rejections_total": sum(tel["pred_reject"].values()),
+                "pred_reject": tel["pred_reject"],
+                "unplaced": tel["unplaced"],
+                "rounds": tel["rounds"],
+                "pops": tel["pops"],
+                "placed_now": tel["placed_now"],
+                "placed_future": tel["placed_future"],
+                "argmax_ties": tel["argmax_ties"],
+                "dyn_launches": tel["dyn_launches"],
+                "dyn_early_stops": tel["dyn_early_stops"],
+                "jit_retraces": {e: c["traces"]
+                                 for e, c in _tc.counts().items()},
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: telemetry block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            telemetry_block = None
+
     # ---- graphcheck static-analysis status (volcano_tpu/analysis) --------
     # The perf trajectory carries the static-analysis state alongside the
     # decision fingerprints: a record with graphcheck_clean=false (or
@@ -736,6 +774,7 @@ tiers:
         "vs_baseline": round(cpu_ms / dev_ms, 2),
         "graphcheck_clean": graphcheck_clean,
         "graphcheck_sha256": graphcheck_sha,
+        "telemetry": telemetry_block,
     }
     if force_cpu:
         out["tpu_unavailable"] = True

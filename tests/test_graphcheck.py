@@ -147,6 +147,26 @@ class TestPlantedViolations:
         assert findings and "dict" in findings[0].key
 
 
+class TestTelemetryFamily:
+    """Family 7 (ISSUE 3): fires on a planted 64-bit telemetry leak and
+    the off-build DCE contract; stays green on the real code (covered by
+    the fast_report fixture below, which runs all seven families)."""
+
+    def test_fires_on_planted_f64_leak(self, monkeypatch):
+        from volcano_tpu.analysis.telemetry import check_telemetry
+        from volcano_tpu.telemetry import cycle as tel_cycle
+        # the classic accumulator leak: a counter leaf born float64 — under
+        # the x64 trace every accumulation step goes wide
+        monkeypatch.setattr(tel_cycle, "_F32", jnp.float64)
+        findings = check_telemetry(fast=True)
+        assert any(f.family == "telemetry" and "float64" in f.what
+                   for f in findings), [f.what for f in findings]
+
+    def test_family_registered(self):
+        from volcano_tpu.analysis import FAMILIES
+        assert "telemetry" in FAMILIES
+
+
 class TestDeriveBatchingErrorPaths:
     """Satellite: the documented error paths of the batching authority."""
 

@@ -132,3 +132,77 @@ tiers:
                        (("queue", "default"),))] == 6000.0
         assert parsed[("volcano_queue_weight",
                        (("queue", "default"),))] == 1.0
+
+
+class TestMetricsSatellites:
+    """ISSUE 3 satellites: per-metric bucket sets, labeled counters,
+    HELP/TYPE exposition metadata."""
+
+    def setup_method(self):
+        METRICS.reset()
+
+    def test_microsecond_histograms_have_microsecond_buckets(self):
+        # a 50 ms action used to land in +Inf (the shared 5..10000 series
+        # read microseconds against millisecond bounds)
+        METRICS.observe_action("allocate", 0.050)       # 50000 us
+        METRICS.observe_plugin("gang", "OnSessionOpen", 0.2)  # 200000 us
+        parsed = parse_exposition(METRICS.exposition())
+        a = {dict(k[1])["le"]: v for k, v in parsed.items()
+             if k[0] == "volcano_action_scheduling_latency_"
+             "microseconds_bucket"}
+        assert a["50000"] == 1 and a["25000"] == 0
+        p = {dict(k[1])["le"]: v for k, v in parsed.items()
+             if k[0] == "volcano_plugin_scheduling_latency_"
+             "microseconds_bucket"}
+        assert p["250000"] == 1 and p["100000"] == 0
+        # millisecond histograms keep the millisecond series
+        METRICS.observe_cycle(0.050)                    # 50 ms
+        parsed = parse_exposition(METRICS.exposition())
+        e = {dict(k[1])["le"]: v for k, v in parsed.items()
+             if k[0] == "volcano_e2e_scheduling_latency_"
+             "milliseconds_bucket"}
+        assert e["50"] == 1
+
+    def test_counter_labels(self):
+        METRICS.inc("schedule_attempts_total", labels={"result": "scheduled"})
+        METRICS.inc("schedule_attempts_total", 2,
+                    labels={"result": "unschedulable"})
+        METRICS.inc("unschedule_task_count", 3, labels={"reason": "job_failed"})
+        METRICS.inc("plain_counter")            # bare name still works
+        parsed = parse_exposition(METRICS.exposition())
+        assert parsed[("volcano_schedule_attempts_total",
+                       (("result", "scheduled"),))] == 1.0
+        assert parsed[("volcano_schedule_attempts_total",
+                       (("result", "unschedulable"),))] == 2.0
+        assert parsed[("volcano_unschedule_task_count",
+                       (("reason", "job_failed"),))] == 3.0
+        assert parsed[("volcano_plain_counter", ())] == 1.0
+        assert METRICS.counter_value("schedule_attempts_total",
+                                     {"result": "scheduled"}) == 1.0
+
+    def test_help_and_type_lines(self):
+        METRICS.inc("schedule_attempts_total", labels={"result": "scheduled"})
+        METRICS.set_gauge("queue_share", "default", 0.5)
+        METRICS.observe_cycle(0.01)
+        text = METRICS.exposition()
+        lines = text.splitlines()
+        typed = {}
+        for line in lines:
+            if line.startswith("# TYPE "):
+                _h, _t, name, mtype = line.split(" ")
+                typed[name] = mtype
+        assert typed["volcano_schedule_attempts_total"] == "counter"
+        assert typed["volcano_queue_share"] == "gauge"
+        assert typed["volcano_e2e_scheduling_latency_milliseconds"] \
+            == "histogram"
+        # every TYPE has a HELP partner, emitted before the first sample
+        for name, mtype in typed.items():
+            assert any(ln.startswith(f"# HELP {name} ") for ln in lines)
+            first_meta = min(i for i, ln in enumerate(lines)
+                             if ln.startswith(f"# HELP {name} "))
+            sample_idx = [i for i, ln in enumerate(lines)
+                          if ln.startswith(name) and not ln.startswith("#")]
+            assert sample_idx and first_meta < min(sample_idx)
+        # sample line format unchanged (parser above already enforces it)
+        parsed = parse_exposition(text)
+        assert parsed[("volcano_queue_share", (("queue", "default"),))] == 0.5

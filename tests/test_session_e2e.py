@@ -393,7 +393,7 @@ class TestResyncRetry:
         sched = make_scheduler(ci)
         sched.resync.max_attempts = 3
         sched.cluster.bind_failures["default/t-0"] = "node gone"   # forever
-        dropped0 = METRICS.counters["resync_dropped"]
+        dropped0 = METRICS.counter_value("resync_dropped")
         sched.run_once(now=100.0)
         task = sched.cluster.ci.jobs["default/j"].tasks["default/t-0"]
         assert task.status == TaskStatus.BINDING
@@ -402,7 +402,7 @@ class TestResyncRetry:
         # retries exhausted -> the drop resyncs the task to Pending (the
         # syncTask give-up, cache.go:690-709) and the SAME cycle's fresh
         # session re-decides it, restarting the retry ladder at attempt 1
-        assert METRICS.counters["resync_dropped"] == dropped0 + 1
+        assert METRICS.counter_value("resync_dropped") == dropped0 + 1
         assert len(sched.resync) == 1
         assert sched.resync.entries[0]["attempts"] == 1
         # once the backend recovers, the retry path completes the bind

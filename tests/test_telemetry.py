@@ -1,0 +1,384 @@
+"""In-graph cycle telemetry (ISSUE 3 acceptance).
+
+- Equality: decisions (and their sha256 fingerprints) are bit-identical
+  with telemetry on vs off, across the scan path, both pallas interpret
+  paths, and conf presets.
+- Counter correctness: the kernel's CycleTelemetry block equals the CPU
+  reference oracle's mirror exactly on the scan path (rejection counts
+  per family, attempts, placements, discards, ties, rounds/pops,
+  committed f32 sums, unplaced-reason histogram).
+- Flight recorder: bounded ring semantics, scheduler + dashboard wiring.
+- Trace counters and the metrics bridge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops.allocate_scan import (AllocateConfig, AllocateExtras,
+                                           derive_batching,
+                                           make_allocate_cycle)
+from volcano_tpu.runtime.cpu_reference import allocate_cpu
+from volcano_tpu.telemetry import (FlightRecorder, cycle_telemetry_size,
+                                   unpack_cycle_telemetry)
+from volcano_tpu.telemetry.cycle import (PRED_FAMILIES, UNPLACED_REASONS,
+                                         CycleTelemetry)
+
+from fixtures import build_job, build_node, build_task, make_cluster, \
+    simple_cluster
+
+
+def _scarce_cluster():
+    """3 small nodes, 8 gangs of 4x(2cpu) with min_available=3: forces
+    breaks, gang discards, give-up rounds, and unplaced tasks."""
+    ci = simple_cluster(n_nodes=3, node_cpu="4", node_mem="8Gi")
+    for j in range(8):
+        job = build_job(f"default/g{j}", min_available=3,
+                        creation_timestamp=float(j))
+        for t in range(4):
+            job.add_task(build_task(f"g{j}-t{t}", cpu="2", memory="2Gi"))
+        ci.add_job(job)
+    return ci
+
+
+def _tie_cluster():
+    """Identical empty nodes => exactly tied scores in f32 and f64, so
+    the argmax tie counter is comparable against the oracle."""
+    ci = simple_cluster(n_nodes=4, node_cpu="8", node_mem="16Gi")
+    job = build_job("default/j", min_available=1, creation_timestamp=0.0)
+    for t in range(3):
+        job.add_task(build_task(f"j-t{t}", cpu="1", memory="1Gi"))
+    ci.add_job(job)
+    return ci
+
+
+def _snap_extras(ci):
+    snap, _maps = pack(ci)
+    return snap, AllocateExtras.neutral(snap)
+
+
+def _cfg(**kw):
+    return dataclasses.replace(
+        derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False,
+                                       **kw),
+                        has_proportion=False), use_pallas=False)
+
+
+def _sha(res):
+    return hashlib.sha256(
+        np.asarray(res.task_node).tobytes()
+        + np.asarray(res.task_mode).tobytes()).hexdigest()
+
+
+def _kernel_tel(res, snap):
+    R = np.asarray(snap.nodes.idle).shape[1]
+    return unpack_cycle_telemetry(np.asarray(res.telemetry.packed()), R)
+
+
+class TestDecisionEquality:
+    """Telemetry must be decision-neutral: shas bit-identical on/off."""
+
+    @pytest.mark.parametrize("build", [make_cluster, _scarce_cluster])
+    def test_scan_path(self, build):
+        snap, extras = _snap_extras(build())
+        cfg = _cfg()
+        off = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        on = jax.jit(make_allocate_cycle(
+            dataclasses.replace(cfg, telemetry=True)))(snap, extras)
+        assert _sha(off) == _sha(on)
+        assert np.array_equal(np.asarray(off.task_gpu),
+                              np.asarray(on.task_gpu))
+        assert np.array_equal(np.asarray(off.job_ready),
+                              np.asarray(on.job_ready))
+        assert off.telemetry is None and on.telemetry is not None
+
+    @pytest.mark.parametrize("dyn", [False, True])
+    def test_pallas_interpret_paths(self, dyn):
+        snap, extras = _snap_extras(make_cluster())
+        base = derive_batching(
+            AllocateConfig(binpack_weight=1.0, enable_gpu=False,
+                           drf_job_order=dyn), has_proportion=False)
+        cfg = dataclasses.replace(base, use_pallas="interpret")
+        off = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        on = jax.jit(make_allocate_cycle(
+            dataclasses.replace(cfg, telemetry=True)))(snap, extras)
+        assert _sha(off) == _sha(on)
+        tel = _kernel_tel(on, snap)
+        total_placed = int(np.asarray(on.task_mode > 0).sum())
+        assert tel["placed_now"] + tel["placed_future"] == total_placed
+        if dyn:
+            assert tel["dyn_launches"] >= 1
+            assert tel["dyn_pops"] >= tel["dyn_launches"]
+
+    def test_default_conf_cycle(self):
+        from volcano_tpu.framework.compiled_session import make_conf_cycle
+        from volcano_tpu.framework.conf import DEFAULT_SCHEDULER_CONF
+        snap, _ = _snap_extras(make_cluster())
+        off = make_conf_cycle(DEFAULT_SCHEDULER_CONF)
+        on = make_conf_cycle("telemetry: true\n" + DEFAULT_SCHEDULER_CONF)
+        r_off = jax.jit(lambda s: off(s))(snap)
+        r_on = jax.jit(lambda s: on(s))(snap)
+        assert _sha(r_off) == _sha(r_on)
+        assert r_on.telemetry is not None
+
+
+@pytest.mark.slow
+def test_all_conf_presets_equal():
+    """Full preset sweep (slow tail): every shipped conf places
+    identically with telemetry compiled in."""
+    from volcano_tpu.analysis.entrypoints import _conf_presets
+    from volcano_tpu.framework.compiled_session import make_conf_cycle
+    snap, _ = _snap_extras(make_cluster())
+    for name, text in _conf_presets(fast=False):
+        r_off = jax.jit(lambda s, c=make_conf_cycle(text): c(s))(snap)
+        r_on = jax.jit(lambda s, c=make_conf_cycle(
+            "telemetry: true\n" + text): c(s))(snap)
+        assert _sha(r_off) == _sha(r_on), name
+
+
+class TestCounterCorrectness:
+    """Kernel counters == CPU oracle mirror, exactly (scan path)."""
+
+    @pytest.mark.parametrize("build,kw", [
+        (make_cluster, {}),
+        (_scarce_cluster, {}),
+        (make_cluster, dict(drf_job_order=True)),
+    ])
+    def test_oracle_equality(self, build, kw):
+        snap, extras = _snap_extras(build())
+        cfg = dataclasses.replace(_cfg(**kw), telemetry=True)
+        res = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        cpu = allocate_cpu(snap, extras, cfg, collect_telemetry=True)
+        assert np.array_equal(np.asarray(res.task_node), cpu["task_node"])
+        assert np.array_equal(np.asarray(res.task_mode), cpu["task_mode"])
+        ktel, otel = _kernel_tel(res, snap), cpu["telemetry"]
+        assert ktel == otel
+
+    def test_scarce_fixture_exercises_counters(self):
+        """The fixture must actually hit the interesting counters, or the
+        equality above proves nothing."""
+        snap, extras = _snap_extras(_scarce_cluster())
+        cfg = dataclasses.replace(_cfg(), telemetry=True)
+        res = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        tel = _kernel_tel(res, snap)
+        assert sum(tel["pred_reject"].values()) > 0
+        assert tel["gang_discarded"] > 0
+        assert tel["unplaced"]["job_failed"] > 0
+        assert tel["attempts"] > tel["placed_now"]
+
+    def test_argmax_ties_counted(self):
+        snap, extras = _snap_extras(_tie_cluster())
+        cfg = dataclasses.replace(_cfg(), telemetry=True)
+        res = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        cpu = allocate_cpu(snap, extras, cfg, collect_telemetry=True)
+        tel = _kernel_tel(res, snap)
+        # first placement: 4 identical empty nodes tie 4-ways -> 3 extras
+        assert tel["argmax_ties"] >= 3
+        assert tel["argmax_ties"] == cpu["telemetry"]["argmax_ties"]
+
+    def test_unplaced_reason_names_stable(self):
+        # the metrics bridge and dashboards key on these label sets
+        assert PRED_FAMILIES[0] == "template" and len(PRED_FAMILIES) == 11
+        assert UNPLACED_REASONS == ("job_not_popped", "job_failed",
+                                    "job_kept_leftover")
+
+
+class TestPackedRoundtrip:
+    def test_zeros_roundtrip(self):
+        tel = CycleTelemetry.zeros(3)
+        d = unpack_cycle_telemetry(np.asarray(tel.packed()), 3)
+        assert sum(d["pred_reject"].values()) == 0
+        assert d["committed"] == [0.0, 0.0, 0.0]
+        assert d["rounds"] == 0
+
+    def test_f32_bitcast_roundtrip(self):
+        tel = dataclasses.replace(
+            CycleTelemetry.zeros(2),
+            committed=np.asarray([1.5, 3.25e9], np.float32))
+        d = unpack_cycle_telemetry(np.asarray(tel.packed()), 2)
+        assert d["committed"] == [1.5, float(np.float32(3.25e9))]
+        assert len(np.asarray(tel.packed())) == cycle_telemetry_size(2)
+
+
+class TestBackfillPreemptBlocks:
+    def test_backfill_counts(self):
+        ci = simple_cluster(n_nodes=2)
+        job = build_job("default/be", min_available=1)
+        job.add_task(build_task("be-0", cpu=0, memory=0))
+        ci.add_job(job)
+        snap, _ = _snap_extras(ci)
+        from volcano_tpu.ops.backfill import make_backfill_pass
+        tn_off, pl_off = jax.jit(make_backfill_pass())(snap)
+        tn_on, pl_on, tel = jax.jit(make_backfill_pass(telemetry=True))(snap)
+        assert np.array_equal(np.asarray(tn_off), np.asarray(tn_on))
+        assert np.array_equal(np.asarray(pl_off), np.asarray(pl_on))
+        host = tel.to_host()
+        assert host["candidates"] >= 1
+        assert host["placed"] == int(np.asarray(pl_on).sum())
+
+    def test_preempt_counts(self):
+        import sys
+        sys.path.insert(0, "/root/repo")
+        from scripts.preempt_profile import scenario
+        from volcano_tpu.ops.preempt import PreemptConfig, make_preempt_cycle
+        snap, _maps = pack(scenario(n_nodes=32, n_jobs=24, n_gangs=2,
+                                    gang_tasks=4, min_avail=2))
+        extras = AllocateExtras.neutral(snap)
+        T = np.asarray(snap.tasks.status).shape[0]
+        veto = np.zeros(T, bool)
+        skip = np.zeros(T, bool)
+        pcfg = PreemptConfig(scoring=AllocateConfig(binpack_weight=1.0,
+                                                    enable_gpu=False))
+        off = jax.jit(make_preempt_cycle(pcfg))(snap, extras, veto, skip)
+        on = jax.jit(make_preempt_cycle(dataclasses.replace(
+            pcfg, telemetry=True)))(snap, extras, veto, skip)
+        assert np.array_equal(np.asarray(off.evicted), np.asarray(on.evicted))
+        assert np.array_equal(np.asarray(off.task_mode),
+                              np.asarray(on.task_mode))
+        assert off.telemetry is None
+        host = on.telemetry.to_host()
+        assert host["evicted"] == int(np.asarray(on.evicted).sum())
+        assert host["pipelined_tasks"] == int(
+            (np.asarray(on.task_mode) == 2).sum())
+        assert host["rounds"] >= 1
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        fr = FlightRecorder(capacity=5)
+        for i in range(17):
+            fr.record(now=float(i), cycle=i)
+        assert len(fr) == 5
+        assert fr.recorded_total == 17
+        snaps = fr.snapshots()
+        assert [e["cycle"] for e in snaps] == list(range(12, 17))
+        assert snaps[-1]["seq"] == 17
+        body = json.loads(fr.to_json())
+        assert body["capacity"] == 5 and len(body["cycles"]) == 5
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+
+TELEMETRY_CONF = """
+telemetry: true
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+"""
+
+
+def _run_scheduler(conf_text=TELEMETRY_CONF, cycles=3):
+    from volcano_tpu.framework import parse_conf
+    from volcano_tpu.runtime.fake_cluster import FakeCluster
+    from volcano_tpu.runtime.scheduler import Scheduler
+    ci = simple_cluster(n_nodes=4, node_cpu="8", node_mem="16Gi")
+    for j in range(3):
+        job = build_job(f"default/j{j}", min_available=1,
+                        creation_timestamp=float(j))
+        for t in range(2):
+            job.add_task(build_task(f"j{j}-t{t}", cpu="1", memory="1Gi"))
+        ci.add_job(job)
+    # one forever-unplaceable gang so unschedule reasons are non-trivial
+    big = build_job("default/huge", min_available=1, creation_timestamp=9.0)
+    big.add_task(build_task("huge-0", cpu="64", memory="1Gi"))
+    ci.add_job(big)
+    sched = Scheduler(FakeCluster(ci), conf=parse_conf(conf_text))
+    for _ in range(cycles):
+        sched.run_once()
+    return sched
+
+
+class TestSchedulerIntegration:
+    def setup_method(self):
+        from volcano_tpu.metrics import METRICS
+        METRICS.reset()
+
+    def test_session_last_telemetry_and_flight(self):
+        sched = _run_scheduler()
+        assert len(sched.flight) == 3
+        entry = sched.flight.snapshots()[-1]
+        assert entry["cycle"] == 3 and "wall_ts" in entry
+        tel = entry["telemetry"]["allocate"]
+        assert set(tel["pred_reject"]) == set(PRED_FAMILIES)
+        # the 64-cpu task never places: counted with a reason every cycle
+        assert sum(tel["unplaced"].values()) >= 1
+        json.dumps(entry)   # flight entries must stay JSON-serializable
+
+    def test_metrics_bridge(self):
+        from volcano_tpu.metrics import METRICS
+        _run_scheduler()
+        text = METRICS.exposition()
+        assert 'volcano_schedule_attempts_total{result="scheduled"}' in text
+        assert "volcano_unschedule_task_count{reason=" in text
+        assert "volcano_jit_traces{" in text
+        # steady state: the fused cycle traced once, called every cycle
+        from volcano_tpu.telemetry.tracecount import counts
+        c = counts().get("fused_cycle")
+        assert c is not None and c["calls"] >= 3
+        assert c["cache_hits"] == c["calls"] - c["traces"]
+
+    def test_telemetry_off_by_default(self):
+        sched = _run_scheduler(conf_text=TELEMETRY_CONF.replace(
+            "telemetry: true\n", ""), cycles=1)
+        entry = sched.flight.snapshots()[-1]
+        assert entry["telemetry"] is None
+
+    def test_dashboard_serves_flight_ring(self):
+        sched = _run_scheduler(cycles=2)
+
+        class _Sys:          # dashboard only needs the flight recorder path
+            scheduler = sched
+        from volcano_tpu.runtime.dashboard import Dashboard, _flight_of
+        assert _flight_of(_Sys()) is sched.flight
+        dash = Dashboard(_Sys())
+        port = dash.serve(port=0)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/telemetry").read())
+            assert len(body["cycles"]) == 2
+            assert body["cycles"][-1]["telemetry"]["allocate"]["placed_now"] \
+                >= 0
+        finally:
+            dash.shutdown()
+
+
+class TestTraceCount:
+    def test_counted_jit_counts_traces_not_calls(self):
+        from volcano_tpu.telemetry import tracecount as tc
+
+        def f(x):
+            return x * 2.0
+
+        g = tc.counted_jit(f, "test_entry_xyz")
+        a = np.ones(4, np.float32)
+        for _ in range(3):
+            np.asarray(g(a))
+        np.asarray(g(np.ones(5, np.float32)))   # new shape bucket
+        c = tc.counts()["test_entry_xyz"]
+        assert c["calls"] == 4 and c["traces"] == 2 and c["cache_hits"] == 2
+
+
+class TestSidecarFlight:
+    def test_served_cycles_recorded(self):
+        from volcano_tpu.native.wire import serialize
+        from volcano_tpu.runtime.sidecar import SchedulerSidecar
+        ci = make_cluster()
+        buf, _maps = serialize(ci)
+        car = SchedulerSidecar(cfg=AllocateConfig(binpack_weight=1.0))
+        car.schedule_buffer(buf)
+        car.schedule_buffer(buf)
+        assert len(car.flight) == 2
+        e = car.flight.snapshots()[-1]
+        assert e["buffer_bytes"] == len(buf) and e["tasks"] > 0

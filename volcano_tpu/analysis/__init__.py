@@ -14,7 +14,7 @@ compiled_session conf presets, the ops/ cycle functions, both Pallas
 kernel builders) and turns each class into a CI failure instead of a
 driver-TPU surprise.
 
-Check families (all six run by default):
+Check families (all seven run by default):
 
 - ``purity``       — no pure_callback/io_callback/debug_callback
                      primitives anywhere in a compiled cycle.
@@ -41,6 +41,14 @@ Check families (all six run by default):
                      dynamic-keys combination still raises, and an AST
                      scan proves no construction site in the package
                      hand-sets ``batch_jobs``/``batch_rounds``.
+- ``telemetry``    — the in-graph cycle-telemetry contract
+                     (volcano_tpu/telemetry): counter outputs are pure
+                     i32/f32, the telemetry=True build introduces no
+                     callbacks / 64-bit leaks / per-cycle retraces, and
+                     with telemetry=False (default) the counters are
+                     dead-code-eliminated — nothing telemetry-shaped in
+                     the outputs, jaxpr equation-count-identical to a
+                     telemetry-free build.
 
 Run ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh)
 for the CLI; tier-1 runs the same pass via tests/test_graphcheck.py.
@@ -56,7 +64,8 @@ import json
 import time
 from typing import List, Optional, Sequence
 
-FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations")
+FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations",
+            "telemetry")
 
 
 @dataclasses.dataclass
@@ -139,6 +148,10 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     if "obligations" in families:
         from .obligations import check_obligations
         findings += check_obligations(repo_root=repo_root)
+
+    if "telemetry" in families:
+        from .telemetry import check_telemetry
+        findings += check_telemetry(fast=fast)
 
     findings = apply_allowlist(findings)
     blocking = [f for f in findings if not f.allowlisted]

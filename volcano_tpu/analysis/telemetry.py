@@ -1,0 +1,199 @@
+"""Graphcheck family 7: the in-graph telemetry contract.
+
+The telemetry tentpole (volcano_tpu/telemetry) rides INSIDE the compiled
+cycle, so its failure modes are graph failure modes and belong in CI:
+
+- **dtype**: every telemetry leaf must be i32/f32 — traced under
+  enable_x64 with 32-bit inputs so any 64-bit counter (a weak-type
+  promotion in an accumulator) is visible; checked both on the traced
+  jaxpr of the telemetry=True build and on the result's telemetry leaf
+  avals.
+- **purity**: the telemetry=True build must not introduce host callbacks
+  (the whole point of in-graph counters is avoiding them).
+- **retrace**: the telemetry=True entry compiles once per shape bucket —
+  re-invoking with fresh same-shaped inputs must not retrace (counters
+  must not smuggle in value-dependent shapes). Full mode only; the fast
+  tier-1 pass skips the extra compile.
+- **DCE when disabled**: with telemetry=False (the default) the result's
+  ``telemetry`` field is None and the flattened output carries exactly
+  the pre-telemetry leaf count — nothing telemetry-shaped survives in the
+  disabled build. (Equation-count identity vs the telemetry-free builder
+  holds by construction: every counter sits behind ``if cfg.telemetry``;
+  this check guards the output contract that construction relies on.)
+
+Same shape for the preempt and backfill counter blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+from . import Finding
+
+#: AllocateResult's non-telemetry leaf count (task_node, task_mode,
+#: task_gpu, job_ready, job_pipelined, job_attempted, idle,
+#: queue_allocated) — the disabled build must flatten to exactly this.
+_ALLOCATE_LEAVES = 8
+_OK_DTYPES = {"int32", "float32", "bool"}
+
+
+def _leaf_findings(name: str, tel_tree) -> List[Finding]:
+    """Findings for non-i32/f32 leaves in a telemetry pytree."""
+    import jax
+    out = []
+    for i, leaf in enumerate(jax.tree.leaves(tel_tree)):
+        dt = str(getattr(leaf, "dtype", ""))
+        if dt not in ("int32", "float32"):
+            out.append(Finding(
+                family="telemetry",
+                key=f"telemetry:{name}:leaf{i}:{dt}",
+                where=f"{name} telemetry leaf {i}",
+                what=(f"telemetry output leaf of dtype {dt} in '{name}' — "
+                      "counter blocks must be pure i32/f32 (mosaic has no "
+                      "64-bit types; the production x64-off config would "
+                      "silently truncate)")))
+    return out
+
+
+def _jaxpr_findings(name: str, closed) -> List[Finding]:
+    """Purity + 64-bit walk over a telemetry=True trace, reported under
+    the telemetry family (the planted-leak surface of the test suite)."""
+    from .jaxpr_audit import (CALLBACK_PRIMITIVES, WIDE_DTYPES, _loc,
+                              iter_eqns)
+    out = []
+    seen = set()
+    for eqn in iter_eqns(closed.jaxpr):
+        pname = eqn.primitive.name
+        if pname in CALLBACK_PRIMITIVES:
+            key = f"telemetry:{name}:callback:{pname}"
+            if key not in seen:
+                seen.add(key)
+                out.append(Finding(
+                    family="telemetry", key=key, where=f"{name}",
+                    what=(f"host callback primitive '{pname}' in the "
+                          f"telemetry-enabled build of '{name}' — "
+                          "telemetry must stay device-pure")))
+            continue
+        for v in eqn.outvars:
+            dt = str(getattr(getattr(v, "aval", None), "dtype", ""))
+            if dt in WIDE_DTYPES:
+                loc = _loc(eqn)
+                dedup = (loc, dt)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                out.append(Finding(
+                    family="telemetry",
+                    key=f"telemetry:{name}:{loc}:{pname}:{dt}",
+                    where=f"{name} @ {loc}",
+                    what=(f"{dt} intermediate ({pname}) in the "
+                          f"telemetry-enabled build of '{name}': a 64-bit "
+                          "leak the telemetry counters introduced — pin "
+                          "the counter dtype at the source")))
+    return out
+
+
+def check_telemetry(fast: bool = False) -> List[Finding]:
+    import jax
+    import numpy as np
+
+    from ..ops.allocate_scan import (AllocateConfig, derive_batching,
+                                     make_allocate_cycle)
+    from .entrypoints import _snap_extras
+
+    findings: List[Finding] = []
+    snap, extras = _snap_extras()
+    cfg_off = dataclasses.replace(
+        derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+                        has_proportion=False), use_pallas=False)
+    cfg_on = dataclasses.replace(cfg_off, telemetry=True)
+
+    # ---- DCE when disabled ------------------------------------------------
+    out_off = jax.eval_shape(make_allocate_cycle(cfg_off), snap, extras)
+    if out_off.telemetry is not None:
+        findings.append(Finding(
+            family="telemetry",
+            key="telemetry:allocate:off-not-none",
+            where="ops/allocate_scan telemetry=False",
+            what=("telemetry=False build still returns a telemetry block — "
+                  "the disabled path must dead-code-eliminate every "
+                  "counter")))
+    n_off = len(jax.tree.leaves(out_off))
+    if n_off != _ALLOCATE_LEAVES:
+        findings.append(Finding(
+            family="telemetry",
+            key=f"telemetry:allocate:off-leaves:{n_off}",
+            where="ops/allocate_scan telemetry=False",
+            what=(f"telemetry=False AllocateResult flattens to {n_off} "
+                  f"leaves (expected {_ALLOCATE_LEAVES}) — a telemetry-"
+                  "shaped output leaked into the disabled build")))
+
+    # ---- telemetry=True: dtypes + purity under an x64 trace ---------------
+    with jax.experimental.enable_x64():
+        closed_on = jax.make_jaxpr(make_allocate_cycle(cfg_on))(snap, extras)
+    findings += _jaxpr_findings("allocate/scan+telemetry", closed_on)
+    out_on = jax.eval_shape(make_allocate_cycle(cfg_on), snap, extras)
+    findings += _leaf_findings("allocate/scan", out_on.telemetry)
+
+    # ---- preempt + backfill counter blocks --------------------------------
+    from ..ops.backfill import make_backfill_pass
+    from ..ops.preempt import PreemptConfig, make_preempt_cycle
+    T = snap.tasks.resreq.shape[0]
+    zeros_t = np.zeros(T, bool)
+    pcfg_off = PreemptConfig(scoring=AllocateConfig(binpack_weight=1.0,
+                                                    enable_gpu=False))
+    pcfg_on = dataclasses.replace(pcfg_off, telemetry=True)
+    pres_off = jax.eval_shape(make_preempt_cycle(pcfg_off), snap, extras,
+                              zeros_t, zeros_t)
+    if pres_off.telemetry is not None:
+        findings.append(Finding(
+            family="telemetry", key="telemetry:preempt:off-not-none",
+            where="ops/preempt telemetry=False",
+            what="telemetry=False preempt build still returns a counter "
+                 "block"))
+    pres_on = jax.eval_shape(make_preempt_cycle(pcfg_on), snap, extras,
+                             zeros_t, zeros_t)
+    findings += _leaf_findings("ops/preempt", pres_on.telemetry)
+    bf_off = jax.eval_shape(make_backfill_pass(), snap)
+    if len(bf_off) != 2:
+        findings.append(Finding(
+            family="telemetry", key="telemetry:backfill:off-arity",
+            where="ops/backfill telemetry=False",
+            what="telemetry=False backfill no longer returns exactly "
+                 "(task_node, placed)"))
+    bf_on = jax.eval_shape(make_backfill_pass(telemetry=True), snap)
+    findings += _leaf_findings("ops/backfill", bf_on[2])
+
+    # ---- conf plumbing: `telemetry: true` reaches the kernel config -------
+    from ..framework.compiled_session import allocate_config_from_conf
+    from ..framework.conf import DEFAULT_SCHEDULER_CONF, parse_conf
+    sc = parse_conf("telemetry: true\n" + DEFAULT_SCHEDULER_CONF)
+    if not allocate_config_from_conf(sc).telemetry:
+        findings.append(Finding(
+            family="telemetry", key="telemetry:conf:not-plumbed",
+            where="framework/compiled_session",
+            what="a conf with `telemetry: true` derives an AllocateConfig "
+                 "with telemetry off — the conf plumb broke"))
+
+    # ---- no per-cycle retraces with telemetry on (full mode: one compile) -
+    if not fast:
+        trace_n = [0]
+
+        def counted(s, e):
+            trace_n[0] += 1
+            return make_allocate_cycle(cfg_on)(s, e)
+
+        fn = jax.jit(counted)
+        fn(snap, extras)
+        fn(jax.tree.map(lambda x: x, snap), jax.tree.map(lambda x: x,
+                                                         extras))
+        if trace_n[0] != 1:
+            findings.append(Finding(
+                family="telemetry",
+                key=f"telemetry:allocate:retrace:{trace_n[0]}",
+                where="ops/allocate_scan telemetry=True",
+                what=(f"telemetry-enabled cycle traced {trace_n[0]}x for "
+                      "one shape bucket — counters introduced a "
+                      "per-cycle retrace hazard")))
+    return findings

@@ -74,6 +74,10 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
         enable_hdrf=enable_hdrf,
         drf_job_order=drf_job_order,
         drf_ns_order=drf_ns_order,
+        # in-graph telemetry rides the conf (top-level ``telemetry: true``)
+        # so a served sidecar cycle carries the same counter block an
+        # in-process Session would
+        telemetry=bool(getattr(sc, "telemetry", False)),
         **weights), has_proportion=has_proportion)
 
 

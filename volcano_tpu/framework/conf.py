@@ -78,6 +78,12 @@ class SchedulerConfiguration:
                                                         "backfill"])
     tiers: List[Tier] = field(default_factory=list)
     configurations: List[Configuration] = field(default_factory=list)
+    #: in-graph cycle telemetry (ISSUE 3): compiles the CycleTelemetry /
+    #: PreemptTelemetry / BackfillTelemetry counter blocks into the cycle
+    #: programs. Default off — decisions are bit-identical either way, and
+    #: the off-build's jaxprs carry zero telemetry equations. YAML:
+    #: top-level ``telemetry: true``.
+    telemetry: bool = False
 
     def plugin_option(self, name: str) -> Optional[PluginOption]:
         for tier in self.tiers:
@@ -122,6 +128,7 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     conflict exactly like unmarshalSchedulerConf (util.go:60-71)."""
     data = yaml.safe_load(text or DEFAULT_SCHEDULER_CONF) or {}
     sc = SchedulerConfiguration()
+    sc.telemetry = bool(data.get("telemetry", False))
     raw_actions = data.get("actions", "enqueue, allocate, backfill")
     if isinstance(raw_actions, str):
         sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]

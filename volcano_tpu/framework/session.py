@@ -52,9 +52,21 @@ class EvictIntent:
     reason: str = ""
 
 
+def _trace_span(name: str):
+    """Host-side profiler span around a cycle entry point
+    (jax.profiler.TraceAnnotation) — shows up in a collected device/host
+    trace; a no-op context when the profiler is unavailable."""
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        import contextlib
+        return contextlib.nullcontext()
+
+
 @lru_cache(maxsize=64)
 def _allocate_fn(cfg: AllocateConfig):
-    return jax.jit(make_allocate_cycle(cfg))
+    from ..telemetry import counted_jit
+    return counted_jit(make_allocate_cycle(cfg), "allocate_cycle")
 
 
 #: (cfg, input-shape signature) -> (jitted fused fn, fuse) — the 3-buffer
@@ -72,18 +84,22 @@ def _fused_allocate(cfg: AllocateConfig, snap, extras):
 
 @lru_cache(maxsize=64)
 def _enqueue_fn(cfg: EnqueueConfig):
-    return jax.jit(make_enqueue_pass(cfg))
+    from ..telemetry import counted_jit
+    return counted_jit(make_enqueue_pass(cfg), "enqueue_pass")
 
 
-@lru_cache(maxsize=1)
-def _backfill_fn():
-    return jax.jit(make_backfill_pass())
+@lru_cache(maxsize=2)
+def _backfill_fn(telemetry: bool = False):
+    from ..telemetry import counted_jit
+    return counted_jit(make_backfill_pass(telemetry=telemetry),
+                       "backfill_pass")
 
 
 @lru_cache(maxsize=64)
 def _preempt_fn(cfg):
     from ..ops.preempt import make_preempt_cycle
-    return jax.jit(make_preempt_cycle(cfg))
+    from ..telemetry import counted_jit
+    return counted_jit(make_preempt_cycle(cfg), "preempt_cycle")
 
 
 class Session:
@@ -128,6 +144,10 @@ class Session:
         self.last_allocate: Optional[AllocateResult] = None
         self._last_queue_deserved = None
         self.stats: Dict[str, float] = {}
+        #: per-pass in-graph telemetry of this cycle (conf telemetry: true):
+        #: {"allocate": CycleTelemetry dict, "backfill": {...},
+        #:  "preempt": [per-mode dicts]} — empty when telemetry is off
+        self.last_telemetry: Dict[str, object] = {}
         # dirty sets feeding refresh_snapshot (the event-handler analog of
         # the reference's incrementally maintained cache,
         # event_handlers.go): apply/evict record their touches; external
@@ -539,7 +559,9 @@ class Session:
             weights["pod_affinity_weight"] = 1.0
         drf = self.plugin("drf")
         tdm = self.plugin("tdm")
-        return AllocateConfig(enable_gang=self.plugin("gang") is not None,
+        return AllocateConfig(telemetry=bool(getattr(self.conf, "telemetry",
+                                                     False)),
+                              enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,
                               enable_host_ports=enable_ports,
                               enable_hdrf=(drf is not None
@@ -654,6 +676,10 @@ class Session:
     def run_enqueue(self) -> int:
         """Run the enqueue pass; promote admitted jobs Pending -> Inqueue.
         Returns the number admitted."""
+        with _trace_span("volcano/session/enqueue"):
+            return self._run_enqueue()
+
+    def _run_enqueue(self) -> int:
         fn = _enqueue_fn(self.enqueue_config())
         admitted = np.asarray(fn(self.snap, self.sla_waiting_flags()))
         count = 0
@@ -668,6 +694,10 @@ class Session:
         return count
 
     def run_allocate(self):
+        with _trace_span("volcano/session/allocate"):
+            return self._run_allocate()
+
+    def _run_allocate(self):
         t0 = time.time()
         cfg = self.allocate_config()
         extras = self.allocate_extras()
@@ -695,6 +725,16 @@ class Session:
         (task_node, task_mode, task_gpu, job_ready, job_pipelined,
          job_attempted) = unpack_decisions(packed, T, J)
         self.stats["kernel_ms"] = (time.time() - t0) * 1000
+        if cfg.telemetry and packed.shape[0] > 3 * T + 3 * J:
+            # the CycleTelemetry block rode the same packed readback as
+            # the decisions — decode its i32 tail and bridge it into the
+            # METRICS registry (unschedule_task_count{reason=...} etc.)
+            from ..telemetry import (publish_cycle_telemetry,
+                                     unpack_cycle_telemetry)
+            R = np.asarray(self.snap.nodes.idle).shape[1]
+            tel = unpack_cycle_telemetry(packed[3 * T + 3 * J:], R)
+            self.last_telemetry["allocate"] = tel
+            publish_cycle_telemetry(tel)
         import types
         result = types.SimpleNamespace(
             task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
@@ -709,9 +749,19 @@ class Session:
         return result
 
     def run_backfill(self) -> int:
+        with _trace_span("volcano/session/backfill"):
+            return self._run_backfill()
+
+    def _run_backfill(self) -> int:
         extras = self.allocate_extras()
-        t_node, placed = _backfill_fn()(self.snap, extras.task_or_group,
-                                        extras.or_feasible)
+        telem = bool(getattr(self.conf, "telemetry", False))
+        out = _backfill_fn(telem)(self.snap, extras.task_or_group,
+                                  extras.or_feasible)
+        if telem:
+            t_node, placed, tel = out
+            self.last_telemetry["backfill"] = tel.to_host()
+        else:
+            t_node, placed = out
         t_node, placed = np.asarray(t_node), np.asarray(placed)
         count = 0
         uids = self.maps.task_uids
@@ -774,12 +824,17 @@ class Session:
         return tuple(tiers)
 
     def run_preempt(self, mode: str = "preempt"):
+        with _trace_span(f"volcano/session/{mode}"):
+            return self._run_preempt(mode)
+
+    def _run_preempt(self, mode: str = "preempt"):
         from ..ops.preempt import PreemptConfig
         tdm = self.plugin("tdm")
         drf = self.plugin("drf")
         dispatch = "preempt" if mode == "preempt_intra" else mode
         cfg = PreemptConfig(
             mode=mode,
+            telemetry=bool(getattr(self.conf, "telemetry", False)),
             scoring=self.allocate_config(),
             tiers=self.victim_tiers(dispatch),
             tdm_starving=(dispatch == "preempt" and tdm is not None
@@ -797,6 +852,9 @@ class Session:
                     skip[ti] = True
         result = _preempt_fn(cfg)(self.snap, self.allocate_extras(),
                                   self.victim_veto_mask(), skip)
+        if cfg.telemetry and result.telemetry is not None:
+            entry = dict(result.telemetry.to_host(), mode=mode)
+            self.last_telemetry.setdefault("preempt", []).append(entry)
         self.apply_preempt(result, mode)
         return result
 

@@ -19,9 +19,40 @@ import threading
 from collections import defaultdict
 from typing import Dict, List, Mapping, Tuple, Union
 
+#: millisecond-valued histograms (e2e / task scheduling latency)
 _BUCKETS_MS = [5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000]
 
+#: microsecond-valued histograms (action / plugin latency). Every
+#: histogram used to share the millisecond series above, so any action
+#: slower than 10 ms (= 10000 us) fell straight into +Inf — per-metric
+#: bucket sets fix the mismatch (50 us .. 10 s, roughly the reference's
+#: prometheus.ExponentialBuckets(5, 2, ...) span).
+_BUCKETS_US = [50, 100, 250, 500, 1000, 2500, 5000, 10000, 25000, 50000,
+               100000, 250000, 500000, 1000000, 2500000, 5000000, 10000000]
+
 LabelsT = Union[str, Mapping[str, str], None]
+
+#: `# HELP` text per metric base name; names without an entry get a
+#: generated default so strict parsers always see HELP/TYPE pairs.
+_HELP = {
+    "e2e_scheduling_latency_milliseconds":
+        "E2E scheduling latency in ms (scheduling algorithm + binding)",
+    "action_scheduling_latency_microseconds":
+        "Action scheduling latency in microseconds",
+    "plugin_scheduling_latency_microseconds":
+        "Plugin scheduling latency in microseconds",
+    "task_scheduling_latency_milliseconds":
+        "Task scheduling latency in milliseconds",
+    "schedule_attempts_total":
+        "Number of attempts to schedule pods, by result",
+    "unschedule_task_count":
+        "Number of tasks that could not be scheduled, by reason",
+    "cycle_predicate_rejections":
+        "In-graph per-predicate-family node rejection counts",
+    "jit_traces": "Times each jitted cycle entry point was traced",
+    "jit_calls": "Times each jitted cycle entry point was called",
+    "jit_cache_hits": "Jit calls served from the compile cache",
+}
 
 
 def _label_str(labels: LabelsT, default_key: str = "queue") -> str:
@@ -64,22 +95,33 @@ class Histogram:
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
-        self.counters: Dict[str, float] = defaultdict(float)
+        #: (name, label_str) -> value. Bare .inc(name) keys on (name, "")
+        #: so existing callers are unchanged; labeled counters carry the
+        #: reference's label sets (schedule_attempts_total{result=...},
+        #: unschedule_task_count{reason=...}).
+        self.counters: Dict[Tuple[str, str], float] = defaultdict(float)
         self.gauges: Dict[Tuple[str, str], float] = {}
         self.histograms: Dict[Tuple[str, str], Histogram] = {}
 
-    def inc(self, name: str, value: float = 1.0) -> None:
+    def inc(self, name: str, value: float = 1.0,
+            labels: LabelsT = None) -> None:
         with self._lock:
-            self.counters[name] += value
+            self.counters[(name, _label_str(labels))] += value
+
+    def counter_value(self, name: str, labels: LabelsT = None) -> float:
+        """Read a counter (0.0 when never incremented)."""
+        with self._lock:
+            return self.counters.get((name, _label_str(labels)), 0.0)
 
     def set_gauge(self, name: str, labels: LabelsT, value: float) -> None:
         with self._lock:
             self.gauges[(name, _label_str(labels))] = value
 
-    def _hist(self, name: str, labels: LabelsT = None) -> Histogram:
+    def _hist(self, name: str, labels: LabelsT = None,
+              buckets=None) -> Histogram:
         key = (name, _label_str(labels))
         if key not in self.histograms:
-            self.histograms[key] = Histogram(_BUCKETS_MS)
+            self.histograms[key] = Histogram(buckets or _BUCKETS_MS)
         return self.histograms[key]
 
     def observe_cycle(self, seconds: float) -> None:
@@ -89,18 +131,22 @@ class Metrics:
                 seconds * 1000)
 
     def observe_action(self, action: str, seconds: float) -> None:
-        """volcano_action_scheduling_latency_microseconds (metrics.go:74-81)."""
+        """volcano_action_scheduling_latency_microseconds (metrics.go:74-81).
+        Microsecond values get the microsecond bucket series (_BUCKETS_US);
+        the former shared millisecond buckets put anything over 10 ms in
+        +Inf."""
         with self._lock:
             self._hist("action_scheduling_latency_microseconds",
-                       {"action": action}).observe(seconds * 1e6)
+                       {"action": action},
+                       buckets=_BUCKETS_US).observe(seconds * 1e6)
 
     def observe_plugin(self, plugin: str, event: str, seconds: float) -> None:
         """volcano_plugin_scheduling_latency_microseconds (metrics.go:65-72,
         recorded around OnSessionOpen/Close, framework.go:47-60)."""
         with self._lock:
             self._hist("plugin_scheduling_latency_microseconds",
-                       {"plugin": plugin, "event": event}).observe(
-                           seconds * 1e6)
+                       {"plugin": plugin, "event": event},
+                       buckets=_BUCKETS_US).observe(seconds * 1e6)
 
     def observe_task_latency(self, seconds: float) -> None:
         """volcano_task_scheduling_latency_milliseconds (metrics.go:83-90)."""
@@ -151,17 +197,35 @@ class Metrics:
         self.set_gauge("queue_deserved_milli_cpu", queue, deserved_cpu)
         self.set_gauge("queue_share", queue, share)
 
+    @staticmethod
+    def _meta_lines(lines, seen, name: str, mtype: str) -> None:
+        """Emit `# HELP` / `# TYPE` once per metric base name, ahead of its
+        first sample — strict Prometheus parsers require the pair; the
+        sample line format itself is unchanged."""
+        if name in seen:
+            return
+        seen.add(name)
+        help_text = _HELP.get(name, name.replace("_", " "))
+        lines.append(f"# HELP volcano_{name} {help_text}")
+        lines.append(f"# TYPE volcano_{name} {mtype}")
+
     def exposition(self) -> str:
         """Prometheus text format (the /metrics endpoint payload), with
-        full cumulative histogram bucket series."""
+        `# HELP` / `# TYPE` metadata and full cumulative histogram bucket
+        series."""
         lines = []
+        seen = set()
         with self._lock:
-            for name, v in sorted(self.counters.items()):
-                lines.append(f"volcano_{name} {v}")
+            for (name, labels), v in sorted(self.counters.items()):
+                self._meta_lines(lines, seen, name, "counter")
+                suffix = f"{{{labels}}}" if labels else ""
+                lines.append(f"volcano_{name}{suffix} {v}")
             for (name, labels), v in sorted(self.gauges.items()):
+                self._meta_lines(lines, seen, name, "gauge")
                 suffix = f"{{{labels}}}" if labels else ""
                 lines.append(f"volcano_{name}{suffix} {v}")
             for (name, labels), h in sorted(self.histograms.items()):
+                self._meta_lines(lines, seen, name, "histogram")
                 prefix = f"{labels}," if labels else ""
                 cum = h.cumulative()
                 for b, c in zip(h.buckets, cum):

@@ -164,6 +164,15 @@ class AllocateConfig:
     #: (decision-neutral when no task requests GPU memory); the session
     #: disables it when the packed gpu_request column is all zero.
     enable_gpu: bool = True
+    #: In-graph cycle telemetry (telemetry/cycle.CycleTelemetry): pure
+    #: i32/f32 counters carried through the cycle and returned as one
+    #: extra output in the packed readback — per-predicate-family
+    #: rejection counts, placed/pipelined/discarded counts, argmax ties,
+    #: pallas dyn-kernel pop/early-stop counts, unplaced-reason
+    #: histogram. Static so the default-off jaxpr stays equation-count-
+    #: identical to a build without telemetry (graphcheck family 7);
+    #: decisions are bit-identical either way.
+    telemetry: bool = False
 
 
 @jax.tree_util.register_dataclass
@@ -274,20 +283,28 @@ class AllocateResult:
     task_gpu: jax.Array        # i32[T] assigned GPU card or -1 (gpu.go:41-56)
 
     def packed_decisions(self) -> jax.Array:
-        """i32[3T + 3J]: all decision outputs in ONE array so the host pays a
-        single device->host fetch per cycle (the axon tunnel charges ~tens of
-        ms per readback regardless of size). Decode with
-        :func:`unpack_decisions`."""
-        return jnp.concatenate([
+        """i32[3T + 3J (+ telemetry tail)]: all decision outputs in ONE
+        array so the host pays a single device->host fetch per cycle (the
+        axon tunnel charges ~tens of ms per readback regardless of size).
+        Decode with :func:`unpack_decisions`; when cfg.telemetry is on the
+        CycleTelemetry block rides the same fetch as an i32 tail
+        (telemetry/cycle.unpack_cycle_telemetry)."""
+        parts = [
             self.task_node, self.task_mode, self.task_gpu,
             self.job_ready.astype(jnp.int32),
             self.job_pipelined.astype(jnp.int32),
-            self.job_attempted.astype(jnp.int32)])
+            self.job_attempted.astype(jnp.int32)]
+        if self.telemetry is not None:
+            parts.append(self.telemetry.packed())
+        return jnp.concatenate(parts)
     job_ready: jax.Array       # bool[J] gang became ready (binds emitted)
     job_pipelined: jax.Array   # bool[J] gang holds capacity, no binds
     job_attempted: jax.Array   # bool[J] job was popped this cycle
     idle: jax.Array            # f32[N, R] remaining idle after the pass
     queue_allocated: jax.Array  # f32[Q, R] post-pass queue usage
+    #: telemetry/cycle.CycleTelemetry when cfg.telemetry, else None (the
+    #: None field is an empty pytree: zero leaves, zero equations)
+    telemetry: Optional[object] = None
 
 
 def unpack_decisions(packed, T: int, J: int):
@@ -511,6 +528,12 @@ def make_allocate_cycle(cfg: AllocateConfig):
             K = 1
             KP = 0
         dyn = use_pallas and KP > 0
+        # In-graph telemetry is a static config bit: with TEL False not one
+        # counter equation is traced (the jaxpr is equation-count-identical
+        # to a telemetry-free build — graphcheck family 7 guards this).
+        TEL = bool(cfg.telemetry)
+        if TEL:
+            from ..telemetry.cycle import CycleTelemetry
 
         if use_pallas:
             # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
@@ -578,6 +601,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             progressed=jnp.bool_(True),
             **init_cap,
         )
+        if TEL:
+            init["telemetry"] = CycleTelemetry.zeros(R)
 
         # a ready job yields after each placement and re-enters the queue
         # (allocate.go:262-265), so pops are bounded by J + total tasks
@@ -915,12 +940,14 @@ def make_allocate_cycle(cfg: AllocateConfig):
                         [hcols[:, c][job_q]
                          for c in range(int(hcols.shape[1]))]
                     ).astype(jnp.float32))
+                kp_req = jnp.minimum(jnp.int32(KP),
+                                     max_rounds - st["rounds"]) \
+                    .astype(jnp.int32)
                 args += [qid_row, qoh_mat] + ns_args + [
                     minav_row, rdy0_row, npend_row, eligs_row,
                     validf_row, canb_row, queue_deserved, qex_col,
                     total_col,
-                    jnp.minimum(jnp.int32(KP), max_rounds - st["rounds"])
-                    .astype(jnp.int32).reshape(1, 1),
+                    kp_req.reshape(1, 1),
                     tgt_in,
                 ]
                 args += node_env_args()
@@ -969,11 +996,42 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     mode_km.reshape(K * M), mode="drop")
                 t_gpu = st["task_gpu"].at[wflat].set(
                     gpu_km.reshape(K * M), mode="drop")
+                tel_upd = {}
+                if TEL:
+                    # wrapper-visible dyn-kernel stats: the kernel already
+                    # commits/discards internally, so counts here are
+                    # COMMITTED placements only (per-family rejections stay
+                    # kernel-internal on the pallas paths); the "newly"
+                    # guard keeps re-reported slots from double-counting
+                    t0 = st["telemetry"]
+                    from .pallas_place import dyn_launch_stats
+                    pops_inc, early = dyn_launch_stats(pops_o[0, 0], kp_req)
+                    prev = st["task_mode"][tcl]
+                    newly = tid_ok & (mode_km != MODE_NONE) \
+                        & (prev == MODE_NONE)
+                    n_new_a = jnp.sum(newly & (mode_km == MODE_ALLOCATED),
+                                      dtype=jnp.int32)
+                    n_new_p = jnp.sum(newly & (mode_km == MODE_PIPELINED),
+                                      dtype=jnp.int32)
+                    com_new = jnp.sum(
+                        jnp.where(newly[:, :, None], tasks.resreq[tcl],
+                                  jnp.float32(0.0)), axis=(0, 1))
+                    tel_upd["telemetry"] = dataclasses.replace(
+                        t0,
+                        placed_now=t0.placed_now + n_new_a,
+                        placed_future=t0.placed_future + n_new_p,
+                        committed=t0.committed + com_new,
+                        rounds=t0.rounds + jnp.int32(1),
+                        pops=t0.pops + pops_inc,
+                        dyn_launches=t0.dyn_launches + jnp.int32(1),
+                        dyn_pops=t0.dyn_pops + pops_inc,
+                        dyn_early_stops=t0.dyn_early_stops + early)
                 return dict(
                     idle=idle, pipe_extra=pipe_extra,
                     pods_extra=pods_extra, gpu_extra=gpu_extra,
                     task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
                     **aff_upd,
+                    **tel_upd,
                     job_done=done_o[0] > 0,
                     job_popped=popped_o[0] > 0,
                     job_ready=ready_o[0] > 0,
@@ -1140,11 +1198,34 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 jdrop = jnp.where(secact, jsafe, J)
                 Q = st["queue_allocated"].shape[0]
                 qdrop = jnp.where(secact, jobs.queue[jsafe], Q)
+                tel_upd = {}
+                if TEL:
+                    # the kernel's mode rows are discard-cleared, so these
+                    # are COMMITTED counts (kernel-internal discards and
+                    # per-family rejections are not visible to the wrapper
+                    # on the pallas paths — the scan path carries the full
+                    # per-attempt detail)
+                    t0 = st["telemetry"]
+                    kept = secact & keep_vec
+                    tel_upd["telemetry"] = dataclasses.replace(
+                        t0,
+                        placed_now=t0.placed_now + jnp.sum(
+                            jnp.where(kept, n_alloc_vec, jnp.int32(0)),
+                            dtype=jnp.int32),
+                        placed_future=t0.placed_future + jnp.sum(
+                            jnp.where(kept, n_pipe_vec, jnp.int32(0)),
+                            dtype=jnp.int32),
+                        committed=t0.committed + jnp.sum(
+                            jnp.where(secact[:, None], committed,
+                                      jnp.float32(0.0)), axis=0),
+                        rounds=t0.rounds + jnp.int32(1),
+                        pops=t0.pops + jnp.sum(secact, dtype=jnp.int32))
                 return dict(
                     idle=idle, pipe_extra=pipe_extra,
                     pods_extra=pods_extra, gpu_extra=gpu_extra,
                     task_node=t_node, task_mode=t_mode, task_gpu=t_gpu,
                     **aff_upd,
+                    **tel_upd,
                     job_done=(st["job_done"] | give_up).at[jdrop].set(
                         ~stopped_vec, mode="drop"),
                     job_popped=(st["job_popped"] | give_up).at[jdrop].set(
@@ -1190,6 +1271,8 @@ def make_allocate_cycle(cfg: AllocateConfig):
             suffix_after = rc - nb_row.astype(jnp.int32)
 
             def task_step(carry, xs):
+                if TEL:
+                    carry, tel = carry
                 (idle, pipe_extra, pods_extra, gpu_extra,
                  t_node, t_mode, t_gpu, n_alloc, n_pipe,
                  aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
@@ -1272,6 +1355,62 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 placed = do_alloc | do_pipe
                 node = jnp.where(do_alloc, n_now, n_fut)
 
+                if TEL:
+                    # Per-family rejection counts for this attempt, over
+                    # live nodes, each family INDEPENDENT (see
+                    # telemetry/cycle.PRED_FAMILIES). Masks are recomputed
+                    # from the raw inputs (pre-placement capacity view) so
+                    # the telemetry=False trace stays byte-identical; XLA
+                    # CSE folds the duplicates on the telemetry=True build.
+                    from .select import tie_count
+                    acti = jnp.where(active, jnp.int32(1), jnp.int32(0))
+                    live = node_live
+                    tmpl_row = tmpl_static[tasks.template[t]]
+                    blk_row = ((extras.block_nonrevocable
+                                & ~extras.task_revocable[t])
+                               | extras.block_all)
+                    vol_row = (extras.task_volume_ok[t]
+                               & ((extras.task_volume_node[t] < 0)
+                                  | (jnp.arange(N, dtype=jnp.int32)
+                                     == extras.task_volume_node[t])))
+                    lock_row = (extras.node_locked
+                                & ~(ji == extras.target_job))
+                    if cfg.enable_host_ports:
+                        ports_rej = P.rejection_count(
+                            live, ~(stat_conf | dyn_conf))
+                    else:
+                        ports_rej = jnp.int32(0)
+                    if cfg.enable_pod_affinity:
+                        aff_rej = P.rejection_count(live, aff_feas)
+                    else:
+                        aff_rej = jnp.int32(0)
+                    rej = jnp.stack([
+                        P.rejection_count(live, tmpl_row),
+                        P.rejection_count(live, ~blk_row),
+                        P.rejection_count(live, or_ok_row(t)),
+                        P.rejection_count(live, vol_row),
+                        P.rejection_count(live, ~lock_row),
+                        ports_rej,
+                        P.rejection_count(
+                            live, P.pod_count_fit(nodes, pods_extra)),
+                        P.rejection_count(
+                            live, P.gpu_fit(gpu_req, nodes, gpu_extra)),
+                        P.rejection_count(live, fit2[0]),
+                        P.rejection_count(live, fit2[1]),
+                        aff_rej,
+                    ])
+                    ties = jnp.where(
+                        do_alloc, tie_count(score, feas_now),
+                        jnp.where(do_pipe, tie_count(score, feas_fut),
+                                  jnp.int32(0)))
+                    tel = (tel[0] + rej * acti,
+                           tel[1] + acti,
+                           tel[2] + jnp.where(do_alloc, jnp.int32(1),
+                                              jnp.int32(0)),
+                           tel[3] + jnp.where(do_pipe, jnp.int32(1),
+                                              jnp.int32(0)),
+                           tel[4] + ties)
+
                 delta = jnp.where(do_alloc, jnp.float32(1.0),
                                   jnp.float32(0.0)) * resreq
                 idle = idle.at[node].add(-delta)
@@ -1323,10 +1462,13 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     pe_cnt = pe_cnt + jnp.where(
                         placed, jnp.sum(act_p, dtype=jnp.int32),
                         jnp.int32(0))
-                return (idle, pipe_extra, pods_extra, gpu_extra,
-                        t_node, t_mode, t_gpu, n_alloc, n_pipe,
-                        aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
-                        placed_sum, n_adv, stopped, broke), None
+                out = (idle, pipe_extra, pods_extra, gpu_extra,
+                       t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                       aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
+                       placed_sum, n_adv, stopped, broke)
+                if TEL:
+                    out = (out, tel)
+                return out, None
 
             carry0 = (st["idle"], st["pipe_extra"], st["pods_extra"],
                       st["gpu_extra"], st["task_node"], st["task_mode"],
@@ -1335,12 +1477,20 @@ def make_allocate_cycle(cfg: AllocateConfig):
                       st["pe_node"], st["pe_port"], st["pe_cnt"],
                       jnp.zeros(R, jnp.float32), jnp.int32(0),
                       jnp.bool_(False), jnp.bool_(False))
+            if TEL:
+                tel0 = st["telemetry"]
+                carry0 = (carry0, (tel0.pred_reject, tel0.attempts,
+                                   tel0.placed_now, tel0.placed_future,
+                                   tel0.argmax_ties))
+            carry_fin, _ = jax.lax.scan(
+                task_step, carry0, (task_ids, slots, suffix_after),
+                unroll=min(int(M), 16))
+            if TEL:
+                carry_fin, tel_fin = carry_fin
             (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
              t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt,
              pe_node, pe_port, pe_cnt, placed_sum,
-             n_adv, stopped, broke), _ = jax.lax.scan(
-                task_step, carry0, (task_ids, slots, suffix_after),
-                unroll=min(int(M), 16))
+             n_adv, stopped, broke) = carry_fin
 
             # ---- gang finalize: JobReady / JobPipelined / Discard ---------
             ready = (ready0 + n_alloc) >= min_avail
@@ -1394,7 +1544,24 @@ def make_allocate_cycle(cfg: AllocateConfig):
                                   jnp.float32(0.0)) * placed_sum
             queue_allocated = st["queue_allocated"].at[qi].add(committed)
 
+            tel_upd = {}
+            if TEL:
+                t0 = st["telemetry"]
+                tel_upd["telemetry"] = dataclasses.replace(
+                    t0,
+                    pred_reject=tel_fin[0],
+                    attempts=tel_fin[1],
+                    placed_now=tel_fin[2],
+                    placed_future=tel_fin[3],
+                    argmax_ties=tel_fin[4],
+                    gang_discarded=t0.gang_discarded + jnp.where(
+                        keep, jnp.int32(0), n_alloc + n_pipe),
+                    committed=t0.committed + committed,
+                    rounds=t0.rounds + jnp.int32(1),
+                    pops=t0.pops + jnp.int32(1))
+
             return dict(
+                **tel_upd,
                 idle=idle, pipe_extra=pipe_extra, pods_extra=pods_extra,
                 gpu_extra=gpu_extra,
                 saved_idle=saved_idle, saved_pipe=saved_pipe,
@@ -1428,6 +1595,27 @@ def make_allocate_cycle(cfg: AllocateConfig):
         final = jax.lax.while_loop(cond, body, init)
         if use_pallas:
             final["idle"] = final["idle"].T
+        tel_final = None
+        if TEL:
+            # end-of-cycle unplaced-reason histogram (the TPU-native
+            # unschedule_task_count{reason=...}): classify every pending
+            # non-best-effort task that got no placement by its job's fate
+            from ..api.types import TaskStatus
+            tel_final = final["telemetry"]
+            pend = (tasks.valid & ~tasks.best_effort & (tasks.job >= 0)
+                    & (tasks.status == jnp.int32(int(TaskStatus.PENDING))))
+            tjc = jnp.maximum(tasks.job, 0)
+            popped = final["job_popped"][tjc]
+            kept = (final["job_ready"] | final["job_pipelined"])[tjc]
+            unplaced = pend & (final["task_mode"] == MODE_NONE)
+            reason = jnp.where(~popped, jnp.int32(0),
+                               jnp.where(kept, jnp.int32(2), jnp.int32(1)))
+            n_r = tel_final.unplaced.shape[0]
+            hist = jnp.zeros(n_r, jnp.int32).at[
+                jnp.where(unplaced, reason, n_r)].add(
+                jnp.int32(1), mode="drop")
+            tel_final = dataclasses.replace(
+                tel_final, unplaced=tel_final.unplaced + hist)
         return AllocateResult(
             task_node=final["task_node"],
             task_mode=final["task_mode"],
@@ -1437,6 +1625,7 @@ def make_allocate_cycle(cfg: AllocateConfig):
             job_attempted=final["job_popped"],
             idle=final["idle"],
             queue_allocated=final["queue_allocated"],
+            telemetry=tel_final,
         )
 
     return allocate

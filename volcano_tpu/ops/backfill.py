@@ -16,12 +16,16 @@ from ..arrays.schema import SnapshotArrays
 from . import predicates as P
 
 
-def make_backfill_pass():
+def make_backfill_pass(telemetry: bool = False):
     """Returns backfill(snap, task_or_group=None, or_feasible=None) ->
     (task_node i32[T], placed bool[T]). The optional pair is the
     OR-of-terms node-affinity group mask (arrays/pack.py note) — required
     affinity binds best-effort tasks too (backfill.go runs the same
-    PredicateFn)."""
+    PredicateFn).
+
+    ``telemetry`` (static, default off) appends an in-graph
+    BackfillTelemetry counter block (telemetry/cycle.py) as a third
+    output; the off-build traces not one extra equation."""
 
     def backfill(snap: SnapshotArrays, task_or_group=None, or_feasible=None):
         snap = jax.tree.map(jnp.asarray, snap)
@@ -65,6 +69,12 @@ def make_backfill_pass():
                 jnp.zeros(T, bool))
         (_, t_node, placed), _ = jax.lax.scan(
             step, init, jnp.arange(T, dtype=jnp.int32))
+        if telemetry:
+            from ..telemetry.cycle import BackfillTelemetry
+            tel = BackfillTelemetry(
+                candidates=jnp.sum(candidate, dtype=jnp.int32),
+                placed=jnp.sum(placed, dtype=jnp.int32))
+            return t_node, placed, tel
         return t_node, placed
 
     return backfill

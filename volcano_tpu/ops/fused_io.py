@@ -86,10 +86,15 @@ def make_fused_cycle(cycle_fn, example_tree):
     treedef, spec = fuse_spec(example_tree)
     unfuse = make_unfuse(treedef, spec)
 
-    @jax.jit
-    def fn(fbuf, ibuf, bbuf):
+    def _cycle(fbuf, ibuf, bbuf):
         args = unfuse(fbuf, ibuf, bbuf)
         return cycle_fn(*args).packed_decisions()
+
+    # trace-vs-call accounting (telemetry/tracecount): a retrace of the
+    # fused cycle on the steady-state path is a production incident the
+    # volcano_jit_* gauges must surface
+    from ..telemetry import counted_jit
+    fn = counted_jit(_cycle, "fused_cycle")
 
     return fn, fuse
 

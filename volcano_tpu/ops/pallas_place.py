@@ -634,11 +634,14 @@ def make_round_placer(cfg, K: int, M: int, N: int, R: int, G: int,
                       jax.ShapeDtypeStruct((ETA, N), f32)]   # anti_cnt'
 
     def place(*args):
-        outs = pl.pallas_call(
-            lambda *refs: kernel(refs),
-            out_shape=tuple(out_shape),
-            interpret=interpret,
-        )(*args)
+        # launch-boundary trace annotation (name-stack metadata only -
+        # zero equations, decisions and jaxpr counts untouched)
+        with jax.named_scope("volcano/pallas/static_rounds"):
+            outs = pl.pallas_call(
+                lambda *refs: kernel(refs),
+                out_shape=tuple(out_shape),
+                interpret=interpret,
+            )(*args)
         node, mode, gpuc = outs[0][0], outs[1][0], outs[2][0]
         return (node, mode, gpuc) + tuple(outs[3:])
 
@@ -1056,13 +1059,28 @@ def make_dyn_round_placer(cfg, C: int, KP: int, M: int, N: int, R: int,
     ]
 
     def place(*args):
-        return pl.pallas_call(
-            lambda *refs: kernel(refs),
-            out_shape=tuple(out_shape),
-            interpret=interpret,
-        )(*args)
+        # launch-boundary trace annotation (name-stack metadata only -
+        # zero equations, decisions and jaxpr counts untouched)
+        with jax.named_scope("volcano/pallas/dyn_rounds"):
+            return pl.pallas_call(
+                lambda *refs: kernel(refs),
+                out_shape=tuple(out_shape),
+                interpret=interpret,
+            )(*args)
 
     return place
+
+
+def dyn_launch_stats(pops, requested):
+    """(pops_clamped i32, early_stop i32) for one dyn-kernel launch: the
+    telemetry decomposition of the kernel's pops output. Every launch
+    counts at least one pop (pop-0 forcing), and a launch that returned
+    fewer pops than its requested budget early-stopped (candidate miss,
+    hdrf frozen-column guard, or simply no more eligible work)."""
+    import jax.numpy as jnp
+    p = jnp.maximum(pops, jnp.int32(1))
+    early = jnp.where(pops < requested, jnp.int32(1), jnp.int32(0))
+    return p, early
 
 
 def vmem_estimate_bytes(K: int, M: int, N: int, R: int, G: int,

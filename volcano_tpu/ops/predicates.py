@@ -176,6 +176,16 @@ def pick_gpu_row(gpu_request: jax.Array, mem_row: jax.Array,
     return jnp.where(ok, first, -1)
 
 
+def rejection_count(live: jax.Array, ok: jax.Array) -> jax.Array:
+    """i32: live (valid AND schedulable) nodes that FAIL predicate mask
+    ``ok`` — the per-family rejection counter primitive of the in-graph
+    cycle telemetry (telemetry/cycle.PRED_FAMILIES). Families are counted
+    independently: each family's count is over its own mask alone, so one
+    node failing three families contributes to all three (the aggregate
+    analog of the reference's per-plugin predicate error strings)."""
+    return jnp.sum(live & ~ok, dtype=jnp.int32)
+
+
 def feasible(nodes: NodeArrays, resreq: jax.Array, selector: jax.Array,
              tol_hash: jax.Array, tol_effect: jax.Array, tol_mode: jax.Array,
              avail: jax.Array, extra_pods: jax.Array | None = None,

@@ -97,6 +97,10 @@ class PreemptConfig:
     #: live job allocations each round
     enable_hdrf: bool = False
     max_victims_per_task: int = 16
+    #: in-graph counter block (telemetry/cycle.PreemptTelemetry) appended
+    #: to the result. Static, default off: the off-build's jaxpr carries
+    #: zero telemetry equations (graphcheck family 7).
+    telemetry: bool = False
 
 
 @jax.tree_util.register_dataclass
@@ -107,6 +111,8 @@ class PreemptResult:
     evicted: jax.Array        # bool[T] victims to evict
     job_pipelined: jax.Array  # bool[J] preemptor gangs that got capacity
     job_attempted: jax.Array  # bool[J]
+    #: telemetry/cycle.PreemptTelemetry when cfg.telemetry, else None
+    telemetry: object = None
 
 
 def _lex_row_less(kl: jax.Array, kr: jax.Array) -> jax.Array:
@@ -737,12 +743,26 @@ def make_preempt_cycle(cfg: PreemptConfig):
             )
 
         final = jax.lax.while_loop(cond, body, init)
+        tel = None
+        if cfg.telemetry:
+            # counts derived from the final decision arrays — still
+            # in-graph (one fetch with the result), no extra carry state
+            from ..telemetry.cycle import PreemptTelemetry
+            tel = PreemptTelemetry(
+                evicted=jnp.sum(final["evicted"], dtype=jnp.int32),
+                pipelined_tasks=jnp.sum(
+                    final["task_mode"] == MODE_PIPELINED, dtype=jnp.int32),
+                attempted_jobs=jnp.sum(final["job_done"], dtype=jnp.int32),
+                pipelined_jobs=jnp.sum(final["job_pipelined"],
+                                       dtype=jnp.int32),
+                rounds=final["rounds"].astype(jnp.int32))
         return PreemptResult(
             task_node=final["task_node"],
             task_mode=final["task_mode"],
             evicted=final["evicted"],
             job_pipelined=final["job_pipelined"],
             job_attempted=final["job_done"],
+            telemetry=tel,
         )
 
     return preempt

@@ -21,6 +21,7 @@ from ..arrays.schema import NodeArrays
 _EPS = 1e-9
 
 
+@jax.named_scope("volcano/score/binpack")
 def binpack_score(used: jax.Array, allocatable: jax.Array, resreq: jax.Array,
                   resource_weights: jax.Array) -> jax.Array:
     """Best-fit score, higher = fuller node after placement.
@@ -42,6 +43,7 @@ def binpack_score(used: jax.Array, allocatable: jax.Array, resreq: jax.Array,
     return raw * 100.0
 
 
+@jax.named_scope("volcano/score/least-allocated")
 def least_allocated_score(used: jax.Array, allocatable: jax.Array,
                           resreq: jax.Array) -> jax.Array:
     """Spread score, higher = emptier node after placement (k8s
@@ -54,6 +56,7 @@ def least_allocated_score(used: jax.Array, allocatable: jax.Array,
     return jnp.sum(jnp.clip(free_frac, 0.0, 1.0) * counted, axis=-1) / n * 100.0
 
 
+@jax.named_scope("volcano/score/most-allocated")
 def most_allocated_score(used: jax.Array, allocatable: jax.Array,
                          resreq: jax.Array) -> jax.Array:
     """Packing score via k8s NodeResourcesMostAllocated (nodeorder.go)."""
@@ -64,6 +67,7 @@ def most_allocated_score(used: jax.Array, allocatable: jax.Array,
     return jnp.sum(jnp.clip(used_frac, 0.0, 1.0) * counted, axis=-1) / n * 100.0
 
 
+@jax.named_scope("volcano/score/balanced-allocation")
 def balanced_allocation_score(used: jax.Array, allocatable: jax.Array,
                               resreq: jax.Array) -> jax.Array:
     """100 - 100*std(resource fractions): k8s NodeResourcesBalancedAllocation
@@ -77,6 +81,7 @@ def balanced_allocation_score(used: jax.Array, allocatable: jax.Array,
     return (1.0 - jnp.sqrt(var)) * 100.0
 
 
+@jax.named_scope("volcano/score/taint-prefer")
 def taint_prefer_score(tol_hash: jax.Array, tol_effect: jax.Array,
                        tol_mode: jax.Array, nodes: NodeArrays) -> jax.Array:
     """Fewer intolerable PreferNoSchedule taints = higher score (k8s
@@ -89,6 +94,7 @@ def taint_prefer_score(tol_hash: jax.Array, tol_effect: jax.Array,
     return (1.0 - intolerable / max_count) * 100.0
 
 
+@jax.named_scope("volcano/score/node-preference")
 def node_preference_score(preferred_node: jax.Array, n_nodes: int) -> jax.Array:
     """One-hot bonus for a specific node — used by task-topology's bucket
     preference (pkg/scheduler/plugins/task-topology/topology.go:344) and the

@@ -28,6 +28,18 @@ def best_node(score: jax.Array, feasible: jax.Array) -> Tuple[jax.Array, jax.Arr
     return idx, jnp.any(feasible)
 
 
+def tie_count(score: jax.Array, feasible: jax.Array) -> jax.Array:
+    """i32: how many feasible nodes BEYOND the winner share the winning
+    score — the telemetry counter behind the documented lowest-index
+    tie-break divergence (the reference rolls rand.Intn over the tied set,
+    scheduler_helper.go:227; this counts how often that die would have
+    been rolled). 0 when no node is feasible."""
+    masked = jnp.where(feasible, score, jnp.float32(NEG))
+    mx = jnp.max(masked)
+    n = jnp.sum((masked == mx) & feasible, dtype=jnp.int32)
+    return jnp.maximum(n - jnp.int32(1), jnp.int32(0))
+
+
 def lex_argmin(keys: Sequence[jax.Array], mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Lexicographic masked argmin.
 

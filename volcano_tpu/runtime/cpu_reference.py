@@ -257,11 +257,64 @@ def _hdrf_keys(hier, job_alloc, job_req, job_valid, total):
         hier, np.asarray(job_alloc, np.float32), job_req, job_valid, total))
 
 
+def _tmpl_ok(nodes, sel, th, te, tm) -> np.ndarray:
+    """bool[N]: the selector+taints static template row alone (the
+    'template' telemetry family) — predicates.static_feasible minus the
+    valid/schedulable gate, loop-structured like the rest of the oracle."""
+    N = nodes.labels.shape[0]
+    ok = np.ones(N, bool)
+    for s in sel:
+        if s != 0:
+            ok &= np.any(nodes.labels == s, axis=-1)
+    kv, key, eff = nodes.taint_kv, nodes.taint_key, nodes.taint_effect
+    has_hard = np.isin(eff, (EFFECT_NO_SCHEDULE,
+                             EFFECT_NO_EXECUTE)).any(axis=-1)
+    for n in range(N):
+        if not has_hard[n]:
+            continue
+        for e in range(kv.shape[1]):
+            if eff[n, e] not in (EFFECT_NO_SCHEDULE, EFFECT_NO_EXECUTE):
+                continue
+            tolerated = False
+            for o in range(len(th)):
+                if tm[o] == TOL_EXISTS_ALL and th[o] != 0:
+                    match = True
+                elif tm[o] == TOL_EXISTS_KEY:
+                    match = key[n, e] == th[o]
+                else:
+                    match = kv[n, e] == th[o] and th[o] != 0
+                if match and (te[o] == 0 or te[o] == eff[n, e]):
+                    tolerated = True
+                    break
+            if not tolerated:
+                ok[n] = False
+                break
+    return ok
+
+
+def _tie_count(score, feas) -> int:
+    """Sequential mirror of ops.select.tie_count on the chosen view."""
+    if not feas.any():
+        return 0
+    msk = np.where(feas, score, -np.inf)
+    return int(((msk == msk.max()) & feas).sum()) - 1
+
+
 def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
-                 cfg: AllocateConfig = AllocateConfig()) -> Dict[str, np.ndarray]:
+                 cfg: AllocateConfig = AllocateConfig(),
+                 collect_telemetry: bool = False) -> Dict[str, np.ndarray]:
     """Run the allocate pass sequentially on the host. Returns the same
     decision arrays as ops.allocate_scan (task_node, task_mode, job_ready,
-    job_pipelined)."""
+    job_pipelined).
+
+    ``collect_telemetry`` additionally mirrors the kernel's in-graph
+    CycleTelemetry block (telemetry/cycle.py) — per-family rejection
+    counts, attempts, placements, discards, ties, rounds/pops, committed
+    f32 sums, unplaced-reason histogram — under "telemetry" in the result.
+    The mirror also replays the kernel's capacity-give-up short-circuit
+    (hopeless jobs batch-finish after a stalled round WITHOUT being
+    evaluated), which is decision-neutral but counter-relevant; with the
+    flag off the oracle's historical behavior is byte-identical."""
     if extras is None:
         extras = AllocateExtras.neutral(snap)
     job_share = np.asarray(extras.job_share)
@@ -300,6 +353,7 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     job_done = np.zeros(J, bool)
     job_ready = np.zeros(J, bool)
     job_pipelined = np.zeros(J, bool)
+    job_popped = np.zeros(J, bool)
 
     jns = np.array(jobs.namespace)
     jvalid_all = np.array(jobs.valid)
@@ -347,11 +401,39 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 return g
         return -1
 
+    # telemetry mirror state (telemetry/cycle.CycleTelemetry, kernel order)
+    tel = None
+    progressed = True
+    if collect_telemetry:
+        from ..telemetry.cycle import PRED_FAMILIES
+        tel = dict(pred_reject=np.zeros(len(PRED_FAMILIES), np.int64),
+                   attempts=0, placed_now=0, placed_future=0,
+                   gang_discarded=0, argmax_ties=0, rounds=0, pops=0,
+                   committed=np.zeros(len(total_cap), np.float32))
+        # cheapest pending request per job per dim (the kernel's
+        # jobs_min_req): min over ALL real table slots, f32
+        jobs_min_req = np.where(
+            (table >= 0)[:, :, None], resreq32[np.maximum(table, 0)],
+            np.inf).min(axis=1)
+
     while True:
         overused = np.any(queue_allocated > queue_deserved + 1e-6, axis=-1)
         elig = jvalid & ~job_done & (job_cursor < n_pending) & ~overused[jqueue]
         if not elig.any():
             break
+        # capacity-give-up mirror (kernel hopeless_jobs): after a stalled
+        # round, eligible jobs whose cheapest pending request exceeds every
+        # node's idle AND future idle batch-finish without being evaluated
+        # — decision-identical, but their pops/attempts never happen, so
+        # the telemetry mirror must replay it
+        hopeless = np.zeros(J, bool)
+        if collect_telemetry and not progressed:
+            fut_all = np.maximum(idle + releasing - pipelined0 - pipe_extra,
+                                 0.0)
+            bound = np.max(np.where(valid_sched[:, None],
+                                    np.maximum(idle, fut_all), -np.inf),
+                           axis=0)
+            hopeless = elig & (jobs_min_req > bound + 1e-5).any(axis=-1)
         qshare = np.max(
             np.where(np.isfinite(queue_deserved) & (queue_deserved > 0),
                      queue_allocated / np.maximum(queue_deserved, 1e-9), 0.0),
@@ -395,6 +477,11 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             if best_key is None or k < best_key:
                 best_key, best_ji = k, ji
         ji = best_ji
+        # hopeless jobs (minus the popped one, whose fate the evaluation
+        # below decides) finish without evaluation, like the kernel's
+        # give_up OR into job_done/job_popped before the .at[ji].set
+        job_done |= hopeless
+        job_popped |= hopeless
 
         saved = (idle.copy(), pipe_extra.copy(), pods_extra.copy(),
                  gpu_extra.copy())
@@ -461,6 +548,46 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 aff_feas, aff_score = _affinity_one(aff_st, t, valid_sched)
                 feas_now &= aff_feas
                 score = score + cfg.pod_affinity_weight * aff_score
+            if collect_telemetry:
+                # per-family rejection counts over live nodes, families
+                # independent, pre-placement capacity view — the kernel's
+                # task_step TEL block, loop-structured
+                live = valid_sched
+                tmpl = _tmpl_ok(nodes_np, sel, th, te, tm)
+                blk = (block_nonrevocable & ~task_revocable[t]) | block_all
+                orr = (or_feasible[task_or_group[t]][:N]
+                       if task_or_group[t] >= 0 else np.ones(N, bool))
+                volr = vol_ok[t] & ((vol_node[t] < 0)
+                                    | (np.arange(N) == vol_node[t]))
+                lockr = node_locked & ~(ji == target_job)
+                ports_rej = 0
+                if cfg.enable_host_ports:
+                    tp2 = [p for p in task_ports_a[t] if p > 0]
+                    conf2 = np.zeros(N, bool)
+                    for p in tp2:
+                        conf2 |= (node_ports_a == p).any(axis=-1)
+                    for pn, pp in ports_placed:
+                        if pp in tp2:
+                            conf2[pn] = True
+                    ports_rej = int((live & conf2).sum())
+                pcf = (nodes_np.pod_count + pods_extra) < nodes_np.max_pods
+                gidle2 = (nodes_np.gpu_memory - nodes_np.gpu_used
+                          - gpu_extra)
+                gfit = (greq <= 0) | (gidle2 >= greq - _EPS).any(axis=-1)
+                fit_n = np.all(req[None, :] <= idle + _EPS, axis=-1)
+                fut_v = np.maximum(
+                    idle + releasing - pipelined0 - pipe_extra, 0.0)
+                fit_f = np.all(req[None, :] <= fut_v + _EPS, axis=-1)
+                aff_rej = (int((live & ~aff_feas).sum())
+                           if aff_st is not None else 0)
+                tel["pred_reject"] += np.asarray([
+                    int((live & ~tmpl).sum()), int((live & blk).sum()),
+                    int((live & ~orr).sum()), int((live & ~volr).sum()),
+                    int((live & lockr).sum()), ports_rej,
+                    int((live & ~pcf).sum()), int((live & ~gfit).sum()),
+                    int((live & ~fit_n).sum()), int((live & ~fit_f).sum()),
+                    aff_rej])
+                tel["attempts"] += 1
             did_place = False
             if feas_now.any():
                 node = int(np.argmax(np.where(feas_now, score, -np.inf)))
@@ -476,6 +603,9 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 placed_sum32 = placed_sum32 + resreq32[t]
                 n_alloc += 1
                 did_place = True
+                if collect_telemetry:
+                    tel["placed_now"] += 1
+                    tel["argmax_ties"] += _tie_count(score, feas_now)
                 if aff_st is not None:
                     _affinity_place(aff_st, t, node)
                 if cfg.enable_host_ports:
@@ -501,6 +631,9 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                     placed_sum32 = placed_sum32 + resreq32[t]
                     n_pipe += 1
                     did_place = True
+                    if collect_telemetry:
+                        tel["placed_future"] += 1
+                        tel["argmax_ties"] += _tie_count(score, feas_fut)
                     if aff_st is not None:
                         _affinity_place(aff_st, t, node)
                     if cfg.enable_host_ports:
@@ -535,6 +668,8 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 # kept-but-unready gang: capacity held, no binds
                 for t in placed:
                     task_mode[t] = MODE_PIPELINED
+            if collect_telemetry:
+                tel["committed"] = tel["committed"] + placed_sum32
         else:
             idle, pipe_extra, pods_extra, gpu_extra = saved
             if aff_st is not None:
@@ -544,12 +679,49 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 task_node[t] = -1
                 task_mode[t] = MODE_NONE
                 task_gpu[t] = -1
+            if collect_telemetry:
+                tel["gang_discarded"] += len(placed)
         job_done[ji] = not stopped
+        job_popped[ji] = True
+        progressed = (n_alloc > 0) or bool(pipelined) or bool(ready)
+        if collect_telemetry:
+            tel["rounds"] += 1
+            tel["pops"] += 1
 
-    return dict(task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
-                job_ready=job_ready,
-                job_pipelined=job_pipelined, idle=idle,
-                queue_allocated=queue_allocated)
+    out = dict(task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
+               job_ready=job_ready,
+               job_pipelined=job_pipelined, job_attempted=job_popped,
+               idle=idle,
+               queue_allocated=queue_allocated)
+    if collect_telemetry:
+        from ..api.types import TaskStatus
+        from ..telemetry.cycle import PRED_FAMILIES, UNPLACED_REASONS
+        t_status = np.array(tasks.status)
+        t_valid = np.array(tasks.valid)
+        pend = (t_valid & ~best_effort & (tjob >= 0)
+                & (t_status == int(TaskStatus.PENDING)))
+        unplaced = pend & (task_mode == MODE_NONE)
+        popped_t = job_popped[np.maximum(tjob, 0)]
+        kept_t = (job_ready | job_pipelined)[np.maximum(tjob, 0)]
+        reason = np.where(~popped_t, 0, np.where(kept_t, 2, 1))
+        hist = np.zeros(len(UNPLACED_REASONS), np.int64)
+        for r in reason[unplaced]:
+            hist[r] += 1
+        out["telemetry"] = {
+            "pred_reject": {f: int(v) for f, v in
+                            zip(PRED_FAMILIES, tel["pred_reject"])},
+            "unplaced": {r: int(v) for r, v in
+                         zip(UNPLACED_REASONS, hist)},
+            "committed": [float(v) for v in tel["committed"]],
+            "attempts": tel["attempts"],
+            "placed_now": tel["placed_now"],
+            "placed_future": tel["placed_future"],
+            "gang_discarded": tel["gang_discarded"],
+            "argmax_ties": tel["argmax_ties"],
+            "rounds": tel["rounds"], "pops": tel["pops"],
+            "dyn_launches": 0, "dyn_pops": 0, "dyn_early_stops": 0,
+        }
+    return out
 
 
 def preempt_cpu(snap: SnapshotArrays, extras: AllocateExtras,

@@ -6,7 +6,9 @@ tables (jobs, podgroups, queues, pods) behind a static frontend.  Here the
 page is built straight from the in-memory API server, cached with a TTL
 (the reference's poll interval), and served as server-rendered HTML plus a
 JSON API (``/api/page``), a Prometheus exposition passthrough
-(``/metrics``), and ``/healthz``.
+(``/metrics``), the scheduler's flight-recorder ring as JSON
+(``/api/telemetry`` — per-cycle snapshots; /metrics stays cumulative),
+and ``/healthz``.
 """
 
 from __future__ import annotations
@@ -87,7 +89,43 @@ def build_page(system, now: Optional[float] = None) -> Page:
         "headers": ["Name", "CPU idle/alloc", "Mem idle/alloc", "Pods",
                     "Status"],
         "rows": nodes}
+
+    # ---- cycle telemetry (flight-recorder ring, newest first) ------------
+    flight = _flight_of(system)
+    if flight is not None:
+        rows = []
+        for e in reversed(flight.snapshots()[-16:]):
+            tel = e.get("telemetry") or {}
+            alloc = tel.get("allocate") or {}
+            rej = alloc.get("pred_reject") or {}
+            unp = alloc.get("unplaced") or {}
+            rows.append([
+                e.get("cycle", "-"),
+                time.strftime("%H:%M:%S",
+                              time.localtime(e.get("wall_ts", 0))),
+                e.get("cycle_ms", "-"), e.get("binds", "-"),
+                e.get("evictions", "-"), e.get("result", "-"),
+                alloc.get("rounds", "-"), alloc.get("pops", "-"),
+                sum(rej.values()) if rej else "-",
+                sum(unp.values()) if unp else "-",
+                alloc.get("argmax_ties", "-"),
+            ])
+        page.tables["telemetry"] = {
+            "headers": ["Cycle", "Time", "ms", "Binds", "Evictions",
+                        "Result", "Rounds", "Pops", "PredRejects",
+                        "Unplaced", "ArgmaxTies"],
+            "rows": rows}
     return page
+
+
+def _flight_of(system):
+    """The flight recorder behind a system-ish object: a VolcanoSystem
+    (``.scheduler.flight``), a bare Scheduler (``.flight``), or anything
+    exposing a FlightRecorder-shaped ``flight`` attribute."""
+    sched = getattr(system, "scheduler", system)
+    flight = getattr(sched, "flight", None)
+    return flight if flight is not None and hasattr(flight, "snapshots") \
+        else None
 
 
 def render_html(page: Page) -> str:
@@ -156,6 +194,15 @@ class Dashboard:
                     self._send(METRICS.exposition(), "text/plain")
                 elif self.path == "/api/page":
                     self._send(dashboard.page().to_json(), "application/json")
+                elif self.path == "/api/telemetry":
+                    # the flight-recorder ring, always live (no page TTL):
+                    # per-cycle snapshots are the whole point of the ring
+                    flight = _flight_of(dashboard.system)
+                    body = (flight.to_json() if flight is not None
+                            else json.dumps({"capacity": 0,
+                                             "recorded_total": 0,
+                                             "cycles": []}))
+                    self._send(body, "application/json")
                 elif self.path in ("/", "/index.html"):
                     self._send(render_html(dashboard.page()), "text/html")
                 else:

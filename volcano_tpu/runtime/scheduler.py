@@ -89,6 +89,12 @@ class Scheduler:
         #: the steady-state claim is checkable: full_packs stays at 1
         self.full_packs = 0
         self.incremental_cycles = 0
+        #: bounded flight recorder: the last N cycle snapshots (host
+        #: timestamps, latency, bind/evict counts, in-graph telemetry when
+        #: the conf enables it), served by the dashboard's /api/telemetry
+        from ..telemetry import FlightRecorder
+        self.flight = FlightRecorder(
+            capacity=int(os.environ.get("VOLCANO_FLIGHT_CYCLES", 64)))
 
     def _load_conf(self) -> Optional[SchedulerConfiguration]:
         """Conf hot-reload (fsnotify watcher, scheduler.go:146-171 — here a
@@ -188,9 +194,29 @@ class Scheduler:
                 # while the rate-limited retry works (cache.go:549-560)
                 self.cluster.hold_binding(intent)
                 self.resync.add(intent, "bind", wall)
-        METRICS.observe_cycle(time.time() - t0)
+        cycle_s = time.time() - t0
+        METRICS.observe_cycle(cycle_s)
         METRICS.inc("schedule_attempts")
+        # reference vocabulary: schedule_attempts_total{result=...}
+        # (metrics.go:92-100 scheduleAttempts) — "error" when a bind
+        # degraded to a recorded error, else by whether anything placed
+        result = ("error" if ssn.bind_errors
+                  else "scheduled" if (ssn.binds or ssn.pipelined)
+                  else "unschedulable")
+        METRICS.inc("schedule_attempts_total", labels={"result": result})
+        # jit trace-vs-call gauges (telemetry/tracecount): a moving
+        # volcano_jit_traces{entry=...} on the steady-state path is a
+        # retrace incident
+        from ..telemetry import publish_gauges
+        publish_gauges(METRICS)
         self.cycles += 1
+        self.flight.record(
+            now=wall, cycle=self.cycles, cycle_ms=round(cycle_s * 1000, 3),
+            binds=len(ssn.binds), evictions=len(ssn.evictions),
+            pipelined=len(ssn.pipelined), bind_errors=len(ssn.bind_errors),
+            resync_pending=len(self.resync), result=result,
+            stats={k: round(float(v), 3) for k, v in ssn.stats.items()},
+            telemetry=ssn.last_telemetry or None)
         return ssn
 
     def run(self, cycles: int = 1, sleep: bool = False) -> List[Session]:

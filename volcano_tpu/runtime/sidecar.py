@@ -89,10 +89,27 @@ class SchedulerSidecar:
         #: ~tens of ms EACH over the axon tunnel, dominating the served
         #: cycle before compute even starts
         self._fused: Dict[tuple, tuple] = {}
+        #: bounded ring of the last N served cycles (host timestamps,
+        #: buffer sizes, cycle latency, in-graph telemetry when the conf
+        #: enables it) — the sidecar half of the flight recorder
+        import os
+        from ..telemetry import FlightRecorder
+        self.flight = FlightRecorder(
+            capacity=int(os.environ.get("VOLCANO_FLIGHT_CYCLES", 64)))
+        if conf is not None:
+            from ..framework.conf import parse_conf
+            self._conf_telemetry = bool(parse_conf(conf).telemetry)
+        else:
+            self._conf_telemetry = bool(self.cfg.telemetry)
 
     def schedule_buffer(self, buf: bytes, extras_buf: bytes = b"") -> bytes:
         """VCS4 snapshot buffer (+ optional VCX1 extras frame) -> VCD1
-        decision payload."""
+        decision payload. Every served cycle lands one snapshot in the
+        flight-recorder ring (telemetry included when the conf enables
+        it); the wire response stays the fixed-layout decision prefix, so
+        version-skewed clients are unaffected."""
+        import time as _time
+        t_start = _time.time()
         from ..native import available, pack_wire
         if available():
             snap = pack_wire(buf)
@@ -125,6 +142,19 @@ class SchedulerSidecar:
         from ..ops.fused_io import fused_cycle_cached
         fn, fuse = fused_cycle_cached(self._cycle, tree_in, self._fused)
         packed = np.asarray(fn(*fuse(tree_in)), dtype=np.int32)
+        tel = None
+        if self._conf_telemetry and packed.shape[0] > 3 * T + 2 * J:
+            # conf cycles pack job_attempted too (3T+3J prefix); the
+            # telemetry tail follows it
+            base = 3 * T + 3 * J
+            if packed.shape[0] > base:
+                from ..telemetry import unpack_cycle_telemetry
+                R = int(np.asarray(snap.nodes.idle).shape[1])
+                tel = unpack_cycle_telemetry(packed[base:], R)
+        self.flight.record(
+            buffer_bytes=len(buf) + len(extras_buf), tasks=T, jobs=J,
+            cycle_ms=round((_time.time() - t_start) * 1000, 3),
+            telemetry=tel)
         task_node = packed[:T]
         task_mode = packed[T:2 * T]
         task_gpu = packed[2 * T:3 * T]

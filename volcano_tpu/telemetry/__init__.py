@@ -1,0 +1,64 @@
+"""Observability for the compiled scheduling cycle (ISSUE 3 tentpole).
+
+Three layers, all host-callback-free on the hot path:
+
+- :mod:`.cycle` — ``CycleTelemetry`` and friends: pure i32/f32 counter
+  pytrees accumulated INSIDE the compiled cycle (per-predicate-family
+  rejection counts, placed/pipelined/discarded task counts, pallas
+  dyn-kernel pop/early-stop counts, argmax tie counts, unplaced-reason
+  histograms), returned as one extra output and fetched in the same packed
+  readback as the decisions. Gated by ``AllocateConfig.telemetry`` (default
+  False — the off-jaxpr is equation-count-identical to no telemetry at
+  all; graphcheck family 7 guards the contract).
+- :mod:`.flight_recorder` — a bounded ring of the last N per-cycle
+  snapshots with host timestamps, owned by the scheduler loop and the
+  sidecar, served as JSON by the dashboard's ``/api/telemetry``.
+- :mod:`.tracecount` — jit trace-vs-call counters for the compiled entry
+  points, exported as ``volcano_jit_*`` gauges (a live retrace is the
+  production analog of the graphcheck recompile family).
+
+``/metrics`` keeps the cumulative prometheus families (the reference's
+surface); ``/api/telemetry`` serves the per-cycle flight record — see
+docs/architecture.md "Observability".
+"""
+
+from __future__ import annotations
+
+from .cycle import (PRED_FAMILIES, UNPLACED_REASONS, BackfillTelemetry,
+                    CycleTelemetry, PreemptTelemetry, cycle_telemetry_size,
+                    unpack_cycle_telemetry)
+from .flight_recorder import FlightRecorder
+from .tracecount import counted_jit, publish_gauges
+
+__all__ = [
+    "PRED_FAMILIES", "UNPLACED_REASONS", "BackfillTelemetry",
+    "CycleTelemetry", "PreemptTelemetry", "cycle_telemetry_size",
+    "unpack_cycle_telemetry", "FlightRecorder", "counted_jit",
+    "publish_gauges", "publish_cycle_telemetry",
+]
+
+
+def publish_cycle_telemetry(tel: dict, metrics=None) -> None:
+    """Bridge one cycle's unpacked CycleTelemetry dict into the METRICS
+    registry: labeled counters in the reference's metric vocabulary
+    (``unschedule_task_count{reason=...}``,
+    ``cycle_predicate_rejections{family=...}``) plus last-cycle gauges."""
+    if metrics is None:
+        from ..metrics import METRICS as metrics
+    for fam, n in tel.get("pred_reject", {}).items():
+        if n:
+            metrics.inc("cycle_predicate_rejections", n,
+                        labels={"family": fam})
+    for reason, n in tel.get("unplaced", {}).items():
+        if n:
+            metrics.inc("unschedule_task_count", n,
+                        labels={"reason": reason})
+    metrics.inc("cycle_tasks_allocated", tel.get("placed_now", 0))
+    metrics.inc("cycle_tasks_pipelined", tel.get("placed_future", 0))
+    metrics.inc("cycle_gang_discarded_tasks", tel.get("gang_discarded", 0))
+    metrics.inc("cycle_argmax_ties", tel.get("argmax_ties", 0))
+    metrics.set_gauge("cycle_rounds", None, tel.get("rounds", 0))
+    metrics.set_gauge("cycle_pops", None, tel.get("pops", 0))
+    metrics.set_gauge("cycle_dyn_launches", None, tel.get("dyn_launches", 0))
+    metrics.set_gauge("cycle_dyn_early_stops", None,
+                      tel.get("dyn_early_stops", 0))

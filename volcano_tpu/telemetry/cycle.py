@@ -1,0 +1,190 @@
+"""In-graph cycle telemetry counter blocks (the tentpole of ISSUE 3).
+
+The reference scheduler answers "why did this task not place?" with
+host-side prometheus counters incremented mid-loop
+(pkg/scheduler/metrics/metrics.go:38-202 — ``unschedule_task_count`` with
+reason labels, ``schedule_attempts_total``). The compiled TPU cycle cannot
+host-callback (graphcheck purity family), so the same information is
+reproduced as pure device-side accumulators: small i32/f32 arrays carried
+through the cycle's ``while_loop`` and returned as ONE extra output,
+fetched in the same packed readback the decisions already pay
+(``AllocateResult.packed_decisions``). No callbacks, no extra transfers,
+no per-cycle retraces.
+
+Design constraints (enforced by the graphcheck ``telemetry`` family):
+
+- every leaf is i32 or f32 — mosaic has no 64-bit types, and a 64-bit
+  counter under the production x64-off config would silently truncate;
+- the whole block hides behind ``AllocateConfig.telemetry`` (default
+  False): when off, nothing is traced and the cycle's jaxpr is
+  equation-count-identical to a build without telemetry, and the result's
+  ``telemetry`` field is None (dead-code elimination by construction);
+- counters are accumulated in the exact order the sequential pop order
+  visits work, so the CPU reference oracle
+  (runtime/cpu_reference.allocate_cpu with ``collect_telemetry=True``)
+  reproduces them bit-for-bit on the scan path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: dtype pins for every counter leaf, module-level so the graphcheck test
+#: suite can plant a 64-bit leak (monkeypatching ``_F32 = jnp.float64``)
+#: and prove the telemetry family fires on it.
+_I32 = jnp.int32
+_F32 = jnp.float32
+
+#: predicate families of the allocate cycle's per-task node filter, in the
+#: order the rejection counters index them. Counts are "live (valid AND
+#: schedulable) nodes rejected by this family alone", summed over every
+#: attempted (popped, non-best-effort) task — families are counted
+#: INDEPENDENTLY, so one node failing three families counts in all three
+#: (the reference's per-plugin predicate error strings, aggregated).
+PRED_FAMILIES = (
+    "template",       # selector/taints static template row (predicates.py)
+    "tdm",            # revocable-zone window gates (tdm.go:149-167)
+    "node_affinity",  # OR-of-terms required node affinity group mask
+    "volume",         # volume-binding seam (unbindable / pinned claims)
+    "locked",         # reservation node locks (reservation.go:56-63)
+    "ports",          # k8s NodePorts conflicts (predicates.go:191)
+    "pod_count",      # pod-slot exhaustion (predicates.go:213-230)
+    "gpu",            # single-card GPU fit (gpu.go:27-56)
+    "fit_now",        # resource fit vs current idle
+    "fit_future",     # resource fit vs future idle (pipelining view)
+    "pod_affinity",   # inter-pod (anti-)affinity (predicates.go:261-273)
+)
+
+#: end-of-cycle classification of pending non-best-effort tasks that got
+#: no placement — the TPU-native ``unschedule_task_count{reason=...}``
+#: label set.
+UNPLACED_REASONS = (
+    "job_not_popped",     # job never popped: overused queue, gang-invalid,
+    #                       closed queue, or the round cap cut it off
+    "job_failed",         # job popped and broke (no feasible node) or its
+    #                       gang discarded / capacity-give-up fired
+    "job_kept_leftover",  # job committed (ready/pipelined) but this task
+    #                       was still beyond the cursor when the cycle ended
+)
+
+_N_SCALARS = 10
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CycleTelemetry:
+    """Counter block of one allocate pass. All leaves i32/f32."""
+
+    pred_reject: jax.Array     # i32[len(PRED_FAMILIES)]
+    unplaced: jax.Array        # i32[len(UNPLACED_REASONS)]
+    committed: jax.Array       # f32[R] resources committed (gang-kept)
+    attempts: jax.Array        # i32: task evaluations (pops x tasks tried)
+    placed_now: jax.Array      # i32: MODE_ALLOCATED placements. Scan path:
+    #                            counted when MADE (a later gang discard
+    #                            shows up in gang_discarded instead);
+    #                            pallas paths: committed only (the kernel
+    #                            discards internally before the wrapper
+    #                            sees the mode rows)
+    placed_future: jax.Array   # i32: MODE_PIPELINED placements (same
+    #                            made-vs-committed split as placed_now)
+    gang_discarded: jax.Array  # i32: placements undone by gang discard
+    #                            (scan path only; kernel-internal discards
+    #                            are invisible to the wrapper)
+    argmax_ties: jax.Array     # i32: placements whose best-node argmax had
+    #                            score ties (lowest index won — the
+    #                            deterministic stand-in for rand.Intn)
+    rounds: jax.Array          # i32: outer while_loop rounds
+    pops: jax.Array            # i32: job pops (scan: ==rounds; batched
+    #                            paths: sections/in-kernel pops)
+    dyn_launches: jax.Array    # i32: dynamic-key pallas kernel launches
+    dyn_pops: jax.Array        # i32: in-kernel pops across dyn launches
+    dyn_early_stops: jax.Array  # i32: launches that popped fewer than the
+    #                             requested budget (candidate miss / hdrf
+    #                             guard / work exhausted)
+
+    @classmethod
+    def zeros(cls, n_res: int) -> "CycleTelemetry":
+        z = jnp.zeros((), _I32)
+        return cls(
+            pred_reject=jnp.zeros(len(PRED_FAMILIES), _I32),
+            unplaced=jnp.zeros(len(UNPLACED_REASONS), _I32),
+            committed=jnp.zeros(n_res, _F32),
+            attempts=z, placed_now=z, placed_future=z, gang_discarded=z,
+            argmax_ties=z, rounds=z, pops=z,
+            dyn_launches=z, dyn_pops=z, dyn_early_stops=z)
+
+    def packed(self) -> jax.Array:
+        """i32[cycle_telemetry_size(R)]: the block as one i32 vector,
+        appended to the decision readback so the host still pays a single
+        fetch per cycle. f32 leaves ride as bitcasts."""
+        scalars = jnp.stack([
+            self.attempts, self.placed_now, self.placed_future,
+            self.gang_discarded, self.argmax_ties, self.rounds, self.pops,
+            self.dyn_launches, self.dyn_pops, self.dyn_early_stops])
+        return jnp.concatenate([
+            self.pred_reject.astype(jnp.int32),
+            self.unplaced.astype(jnp.int32),
+            jax.lax.bitcast_convert_type(self.committed.astype(jnp.float32),
+                                         jnp.int32),
+            scalars.astype(jnp.int32)])
+
+
+def cycle_telemetry_size(n_res: int) -> int:
+    """Element count of CycleTelemetry.packed for an R-dim snapshot."""
+    return len(PRED_FAMILIES) + len(UNPLACED_REASONS) + n_res + _N_SCALARS
+
+
+def unpack_cycle_telemetry(vec, n_res: int) -> dict:
+    """Host-side inverse of :meth:`CycleTelemetry.packed`: an i32 numpy
+    tail -> plain-python dict (ints / lists), JSON- and metrics-ready."""
+    vec = np.asarray(vec, np.int32)
+    nf, nr = len(PRED_FAMILIES), len(UNPLACED_REASONS)
+    off = 0
+    pred = vec[off:off + nf]; off += nf
+    unpl = vec[off:off + nr]; off += nr
+    committed = vec[off:off + n_res].view(np.float32); off += n_res
+    names = ("attempts", "placed_now", "placed_future", "gang_discarded",
+             "argmax_ties", "rounds", "pops", "dyn_launches", "dyn_pops",
+             "dyn_early_stops")
+    out = {
+        "pred_reject": {f: int(v) for f, v in zip(PRED_FAMILIES, pred)},
+        "unplaced": {r: int(v) for r, v in zip(UNPLACED_REASONS, unpl)},
+        "committed": [float(v) for v in committed],
+    }
+    for k, v in zip(names, vec[off:off + _N_SCALARS]):
+        out[k] = int(v)
+    return out
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BackfillTelemetry:
+    """Counter block of one backfill pass (ops/backfill.py)."""
+
+    candidates: jax.Array  # i32: pending best-effort tasks considered
+    placed: jax.Array      # i32: tasks placed
+
+    def to_host(self) -> dict:
+        return {"candidates": int(np.asarray(self.candidates)),
+                "placed": int(np.asarray(self.placed))}
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PreemptTelemetry:
+    """Counter block of one preempt/reclaim pass (ops/preempt.py)."""
+
+    evicted: jax.Array          # i32: victim tasks evicted
+    pipelined_tasks: jax.Array  # i32: preemptor tasks pipelined
+    attempted_jobs: jax.Array   # i32: preemptor jobs popped
+    pipelined_jobs: jax.Array   # i32: preemptor gangs that got capacity
+    rounds: jax.Array           # i32: outer loop rounds
+
+    def to_host(self) -> dict:
+        return {k: int(np.asarray(getattr(self, k)))
+                for k in ("evicted", "pipelined_tasks", "attempted_jobs",
+                          "pipelined_jobs", "rounds")}

@@ -1,0 +1,84 @@
+"""Compile/retrace counters for the jitted cycle entry points.
+
+A retrace on the hot path is a production incident (the graphcheck
+``recompile`` family lints for it statically); this module counts the
+live truth: how many times each jitted entry point actually TRACED vs how
+many times it was CALLED. The trick is the standard one (shared with
+analysis/recompile.py): a host-side counter increment placed inside the
+traced Python function body runs only when jax traces it — a cache hit
+never re-enters Python.
+
+Counts are process-global and exported as gauges
+(``volcano_jit_traces{entry=...}`` / ``volcano_jit_calls{entry=...}`` /
+``volcano_jit_cache_hits{entry=...}``) by :func:`publish_gauges`, which the
+scheduler loop calls once per cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Dict
+
+_LOCK = threading.Lock()
+_TRACES: Dict[str, int] = defaultdict(int)
+_CALLS: Dict[str, int] = defaultdict(int)
+
+
+def note_trace(entry: str) -> None:
+    with _LOCK:
+        _TRACES[entry] += 1
+
+
+def note_call(entry: str) -> None:
+    with _LOCK:
+        _CALLS[entry] += 1
+
+
+def counts() -> Dict[str, Dict[str, int]]:
+    """{entry: {"traces": n, "calls": n, "cache_hits": n}} snapshot."""
+    with _LOCK:
+        entries = set(_TRACES) | set(_CALLS)
+        return {e: {"traces": _TRACES[e], "calls": _CALLS[e],
+                    "cache_hits": max(_CALLS[e] - _TRACES[e], 0)}
+                for e in sorted(entries)}
+
+
+def reset() -> None:
+    with _LOCK:
+        _TRACES.clear()
+        _CALLS.clear()
+
+
+def counted_jit(fn: Callable, entry: str, **jit_kwargs) -> Callable:
+    """jax.jit(fn) with trace/call accounting under ``entry``.
+
+    The wrapper is call-transparent (same signature, same result); the
+    trace counter lives INSIDE the traced body so only real traces count.
+    """
+    import jax
+
+    def _traced(*args, **kwargs):
+        note_trace(entry)
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(_traced, **jit_kwargs)
+
+    def wrapper(*args, **kwargs):
+        note_call(entry)
+        return jitted(*args, **kwargs)
+
+    wrapper.__wrapped__ = fn
+    wrapper.__name__ = getattr(fn, "__name__", entry)
+    return wrapper
+
+
+def publish_gauges(metrics=None) -> None:
+    """Export the counters as gauges into the METRICS registry."""
+    if metrics is None:
+        from ..metrics import METRICS as metrics
+    for entry, c in counts().items():
+        labels = {"entry": entry}
+        metrics.set_gauge("jit_traces", labels, c["traces"])
+        metrics.set_gauge("jit_calls", labels, c["calls"])
+        metrics.set_gauge("jit_cache_hits", labels, c["cache_hits"])
