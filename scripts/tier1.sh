@@ -2,7 +2,7 @@
 # Tier-1 verify — the EXACT pytest command from ROADMAP.md, wrapped so the
 # builder, CI, and the driver all run the identical thing, followed by the
 # graphcheck static-analysis gate (scripts/graphcheck.sh --fast — all
-# seven families incl. the in-graph telemetry contract; skip with
+# eight families incl. the telemetry and donation contracts; skip with
 # TIER1_SKIP_GRAPHCHECK=1).
 #
 # Fast deterministic subset: excludes tests marked `slow` (registered in

@@ -1,0 +1,266 @@
+"""Delta uploads + pipelined cycle loop vs the full-upload synchronous
+oracle (ISSUE 4 acceptance).
+
+Multi-cycle scheduler runs over identical clusters must produce
+sha256-identical decision sequences across all four loop variants:
+
+- fresh Session per cycle (incremental=False) — the reference oracle,
+- persistent session, full uploads every cycle (delta_uploads: false),
+- persistent session, device-resident delta uploads (the default),
+- persistent session, delta uploads + one-deep pipelined readback.
+
+Churn covers binds landing, evictions (preempt), job completion/rearrival,
+and node add/remove (entity-set change -> the structural full-re-fuse
+fallback).
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from volcano_tpu.api import NodeInfo, Resource, TaskStatus
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.scheduler import Scheduler
+
+from fixtures import build_job, build_task, simple_cluster
+from test_runtime_incremental import PREEMPT_CONF, build_cluster, churn
+
+#: allocate-terminal variant of the incremental-suite conf (same plugins;
+#: backfill only places best-effort tasks, which this cluster has none of)
+#: so the pipelined loop can defer the allocate readback
+_PARITY_BODY = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: binpack
+"""
+PARITY_CONF = parse_conf(_PARITY_BODY)
+NODELTA_CONF = parse_conf("delta_uploads: false\n" + _PARITY_BODY)
+
+ALLOC_CONF = parse_conf("""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+""")
+
+
+def digest(ssn) -> tuple:
+    return (sorted((b.task_uid, b.node_name, b.gpu_index)
+                   for b in ssn.binds),
+            sorted(e.task_uid for e in ssn.evictions),
+            sorted(ssn.pipelined.items()),
+            sorted((u, str(p)) for u, p in ssn.phase_updates.items()))
+
+
+def decisions_sha(digests) -> str:
+    return hashlib.sha256(repr(digests).encode()).hexdigest()[:16]
+
+
+def node_churn(cluster: FakeCluster, cycle: int) -> None:
+    """Entity-set churn: a node arrives, later an empty node drains —
+    both structural (refresh_snapshot repacks, the scheduler opens a
+    fresh Session, the delta path re-fuses in full)."""
+    ci = cluster.ci
+    if cycle == 2:
+        ci.add_node(NodeInfo(
+            f"late{cycle}", allocatable=Resource.from_resource_list(
+                {"cpu": "8", "memory": "16Gi", "pods": "110"})))
+        cluster.mark_dirty(structural=True)
+    if cycle == 4:
+        empty = [n for n, node in ci.nodes.items() if not node.tasks]
+        if empty:
+            del ci.nodes[empty[-1]]
+            cluster.mark_dirty(structural=True)
+
+
+class TestDeltaLoopParity:
+    def run_variants(self, conf_pairs, cycles, with_node_churn=False):
+        """Drive every (label, conf, pipeline) variant over clones of one
+        cluster with identical churn; return {label: sha}."""
+        base = build_cluster(n_nodes=8, n_jobs=10)
+        shas = {}
+        for label, conf, incremental, pipeline in conf_pairs:
+            cluster = FakeCluster(base.clone())
+            sched = Scheduler(cluster, conf=conf, incremental=incremental,
+                              pipeline=pipeline)
+            digests = []
+            for c in range(cycles):
+                out = sched.run_once(now=1000.0 + c)
+                rec = (sched.drain(now=1000.0 + c) or out) if pipeline \
+                    else out
+                digests.append(digest(rec))
+                churn(cluster, c, arrivals=True)
+                if with_node_churn:
+                    node_churn(cluster, c)
+            shas[label] = decisions_sha(digests)
+        assert len(set(shas.values())) == 1, shas
+        return shas
+
+    def test_delta_and_pipeline_match_oracle_sha(self):
+        """Binds + completions + arrivals: all variants sha-identical to
+        the fresh-session oracle across 6 cycles."""
+        self.run_variants([
+            ("oracle_fresh", PARITY_CONF, False, False),
+            ("sync_full_upload", NODELTA_CONF, True, False),
+            ("sync_delta", PARITY_CONF, True, False),
+            ("pipelined_delta", PARITY_CONF, True, True),
+        ], cycles=6)
+
+    def test_node_add_remove_structural_fallback(self):
+        """Node arrival/drain mid-run: the delta path must take the full
+        re-fuse fallback and stay sha-identical."""
+        self.run_variants([
+            ("oracle_fresh", PARITY_CONF, False, False),
+            ("sync_delta", PARITY_CONF, True, False),
+            ("pipelined_delta", PARITY_CONF, True, True),
+        ], cycles=6, with_node_churn=True)
+
+    def test_evictions_preempt_loop(self):
+        """Preempt evictions through the delta+pipelined loop: eviction
+        bookkeeping must round-trip exactly. The preempt conf does not end
+        with allocate, so the pipelined scheduler transparently falls back
+        to the synchronous path — decisions must be unaffected either
+        way."""
+        base = build_cluster(n_nodes=4, n_jobs=6, tasks_per_job=4)
+        shas = {}
+        for label, incremental, pipeline in (
+                ("oracle", False, False), ("delta", True, False),
+                ("pipelined_flag", True, True)):
+            cluster = FakeCluster(base.clone())
+            sched = Scheduler(cluster, conf=PREEMPT_CONF,
+                              incremental=incremental, pipeline=pipeline)
+            digests = []
+            for c in range(3):
+                ssn = sched.run_once(now=2000.0 + c)
+                assert sched._pending is None   # fallback: nothing queued
+                digests.append(digest(ssn))
+                for uid in sorted(u for job in cluster.ci.jobs.values()
+                                  for u, t in job.tasks.items()
+                                  if t.status == TaskStatus.BOUND):
+                    cluster.run_task(uid)
+                if c == 0:
+                    hi = build_job("default/hi", min_available=4,
+                                   priority=100, creation_timestamp=50.0,
+                                   preemptable=False)
+                    for t in range(4):
+                        hi.add_task(build_task(f"hi-t{t}", cpu="6",
+                                               memory="12Gi", priority=100))
+                    cluster.ci.add_job(hi)
+                    cluster.mark_dirty(job_uid=hi.uid)
+            shas[label] = decisions_sha(digests)
+        assert len(set(shas.values())) == 1, shas
+
+
+class TestFreeRunningPipeline:
+    def test_free_run_matches_sync_cycle_for_cycle(self):
+        """Free-running pipeline (no per-cycle drain): with churn that
+        does not read the in-flight cycle's outcome, the completed-cycle
+        records must equal the synchronous scheduler's cycles one for
+        one — the deferred readback shifts timing, never decisions."""
+        ci = simple_cluster(n_nodes=6, node_cpu="8", node_mem="16Gi")
+        for j in range(8):
+            job = build_job(f"default/j{j}", min_available=2,
+                            creation_timestamp=float(j))
+            for t in range(3):
+                job.add_task(build_task(f"j{j}-t{t}", cpu="2",
+                                        memory="2Gi"))
+            ci.add_job(job)
+        ca, cb = FakeCluster(ci.clone()), FakeCluster(ci.clone())
+        sync = Scheduler(ca, conf=ALLOC_CONF)
+        pipe = Scheduler(cb, conf=ALLOC_CONF, pipeline=True)
+
+        def arrive(cluster, c):
+            job = build_job(f"default/new{c}", min_available=1,
+                            creation_timestamp=100.0 + c)
+            job.add_task(build_task(f"new{c}-t0", cpu="1", memory="1Gi"))
+            cluster.ci.add_job(job)
+            cluster.mark_dirty(job_uid=job.uid)
+
+        sync_digests, pipe_digests = [], []
+        for c in range(5):
+            sync_digests.append(digest(sync.run_once(now=1000.0 + c)))
+            out = pipe.run_once(now=1000.0 + c)
+            if c > 0:
+                pipe_digests.append(digest(out))
+            arrive(ca, c)
+            arrive(cb, c)
+        pipe_digests.append(digest(pipe.drain(now=1005.0)))
+        assert sync_digests == pipe_digests
+        assert pipe.cycles == sync.cycles == 5
+        # the steady cycles actually took the delta path
+        kinds = [e.get("cycle_kind") for e in pipe.flight.snapshots()]
+        assert kinds.count("delta") >= 2, kinds
+        # flight records carry the upload accounting bench consumes
+        deltas = [e for e in pipe.flight.snapshots()
+                  if e.get("cycle_kind") == "delta"]
+        assert all(e["upload_bytes"] < e["upload_bytes_full"]
+                   for e in deltas)
+
+    def test_structural_epochs_counted(self):
+        ci = build_cluster(n_nodes=4, n_jobs=4)
+        cluster = FakeCluster(ci)
+        assert cluster.structural_epochs == 0
+        cluster.mark_dirty(structural=True)
+        cluster.mark_dirty(structural=True)   # same pending epoch
+        assert cluster.structural_epochs == 1
+        cluster.drain_dirty()
+        cluster.mark_dirty(structural=True)
+        assert cluster.structural_epochs == 2
+
+
+class TestConfFlags:
+    def test_parse_conf_flags(self):
+        sc = parse_conf("delta_uploads: false\npipeline: true\n"
+                        "compilation_cache_dir: /tmp/vc_cache\n"
+                        + """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: binpack
+""")
+        assert sc.delta_uploads is False
+        assert sc.pipeline is True
+        assert sc.compilation_cache_dir == "/tmp/vc_cache"
+        default = parse_conf()
+        assert default.delta_uploads is True
+        assert default.pipeline is False
+        assert default.compilation_cache_dir is None
+
+    def test_scheduler_picks_up_conf_pipeline(self):
+        conf = parse_conf("pipeline: true\n" + """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: binpack
+""")
+        sched = Scheduler(FakeCluster(build_cluster(4, 2)), conf=conf)
+        assert sched.pipeline
+        sched = Scheduler(FakeCluster(build_cluster(4, 2)), conf=conf,
+                          pipeline=False)
+        assert not sched.pipeline
+
+
+class TestWarmup:
+    def test_scheduler_warmup_compiles_without_cycle(self):
+        from volcano_tpu.telemetry import tracecount
+        cluster = FakeCluster(build_cluster(n_nodes=4, n_jobs=4))
+        sched = Scheduler(cluster, conf=ALLOC_CONF)
+        before = tracecount.counts().get("fused_cycle_delta",
+                                         {"traces": 0})["traces"]
+        sched.warmup(now=999.0)
+        after = tracecount.counts().get("fused_cycle_delta",
+                                        {"traces": 0})["traces"]
+        assert after == before + 1          # AOT-traced, nothing executed
+        assert sched.cycles == 0
+        ssn = sched.run_once(now=1000.0)    # and the real cycle still runs
+        assert ssn.binds

@@ -1,13 +1,19 @@
 """Fused 3-buffer snapshot transfer (ops/fused_io): the rebuilt tree and
-cycle decisions must be identical to the per-leaf path."""
+cycle decisions must be identical to the per-leaf path — on the full-upload
+path AND the device-resident delta path (ISSUE 4)."""
 
 import numpy as np
 import jax
+import pytest
 
 from volcano_tpu.arrays import pack
 from volcano_tpu.ops import AllocateConfig, make_allocate_cycle
 from volcano_tpu.ops.allocate_scan import AllocateExtras
-from volcano_tpu.ops.fused_io import fuse, fuse_spec, make_fused_cycle, make_unfuse
+from volcano_tpu.ops.fused_io import (DeltaKernel, ResidentState,
+                                      delta_bucket, delta_cycle_cached,
+                                      fuse, fuse_spec, fused_cycle_cached,
+                                      group_sizes, make_fused_cycle,
+                                      make_unfuse)
 
 from fixtures import build_job, build_task, simple_cluster
 
@@ -43,3 +49,146 @@ class TestFusedIO:
         fn, fz = make_fused_cycle(cycle, (snap, extras))
         fused = np.asarray(fn(*fz((snap, extras))))
         np.testing.assert_array_equal(plain, fused)
+
+    def test_unsupported_dtype_raises(self):
+        tree = {"bad": np.zeros(3, np.complex64)}
+        with pytest.raises(TypeError, match="unsupported dtype"):
+            fuse_spec(tree)
+        with pytest.raises(TypeError, match="unsupported dtype"):
+            fuse(tree)
+
+    def test_empty_dtype_groups_round_trip(self):
+        # a tree with ONLY float leaves: the i32 and bool group buffers
+        # are empty and the round trip must still be exact
+        tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": np.float32(7.5)}
+        treedef, spec = fuse_spec(tree)
+        sizes = group_sizes(spec)
+        assert sizes[1] == 0 and sizes[2] == 0
+        bufs = fuse(tree)
+        assert bufs[1].size == 0 and bufs[2].size == 0
+        rebuilt = make_unfuse(treedef, spec)(*map(jax.numpy.asarray, bufs))
+        for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(rebuilt)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fuse_matches_spec_layout(self):
+        # fuse() fills slices from the same spec make_unfuse reads — every
+        # leaf must land at its spec offset with the group target dtype
+        tree = snapshot()
+        _td, spec = fuse_spec(tree)
+        bufs = dict(zip("fib", fuse(tree)))
+        for leaf, (g, off, shape, _dt) in zip(jax.tree.leaves(tree), spec):
+            arr = np.asarray(leaf)
+            np.testing.assert_array_equal(
+                bufs[g][off:off + arr.size],
+                arr.ravel().astype(bufs[g].dtype, copy=False))
+
+    def test_fused_cycle_cached_key_isolation(self):
+        snap, extras = snapshot()
+        cycle = make_allocate_cycle(AllocateConfig(binpack_weight=1.0))
+        cache = {}
+        a1 = fused_cycle_cached(cycle, (snap, extras), cache, key_extra="a")
+        b1 = fused_cycle_cached(cycle, (snap, extras), cache, key_extra="b")
+        a2 = fused_cycle_cached(cycle, (snap, extras), cache, key_extra="a")
+        assert a1 is a2                 # same key: cache hit
+        assert a1 is not b1             # differing key_extra: isolated
+        assert len(cache) == 2
+        dcache = {}
+        ka = delta_cycle_cached(cycle, (snap, extras), dcache, key_extra="a")
+        kb = delta_cycle_cached(cycle, (snap, extras), dcache, key_extra="b")
+        assert ka is not kb and len(dcache) == 2
+        assert ka is delta_cycle_cached(cycle, (snap, extras), dcache,
+                                        key_extra="a")
+
+
+class TestDeltaPath:
+    def test_bucket_shape(self):
+        assert delta_bucket(0) == 0
+        assert delta_bucket(1) == 256
+        assert delta_bucket(256) == 256
+        assert delta_bucket(257) == 512
+
+    def test_delta_cycles_byte_identical_to_full(self):
+        """full -> delta -> idle-delta -> huge-delta(full fallback): every
+        cycle's packed decisions equal the full-upload reference on the
+        same mutated snapshot."""
+        snap, extras = snapshot()
+        cycle = make_allocate_cycle(AllocateConfig(binpack_weight=1.0))
+        fn, fz = make_fused_cycle(cycle, (snap, extras))
+        kern = DeltaKernel(cycle, (snap, extras))
+        state = ResidentState()
+
+        def check(expect_kind):
+            ref = np.asarray(fn(*fz((snap, extras))))
+            got = np.asarray(kern.run(state, (snap, extras)))
+            np.testing.assert_array_equal(got, ref)
+            assert state.last_kind == expect_kind
+
+        check("full")                       # cold: resident buffers land
+        prio = np.asarray(snap.tasks.priority)
+        prio[0] += 1
+        check("delta")                      # one changed element
+        assert state.last_upload_bytes < state.full_upload_bytes
+        check("delta")                      # idle cycle: empty delta
+        assert state.last_upload_bytes == 0
+        # status/placement churn across several rows stays a delta
+        idle = np.asarray(snap.nodes.idle)
+        idle[0] = idle[0] * np.float32(0.5)
+        check("delta")
+        # structural change: the caller forces a full re-fuse — still
+        # byte-identical, residency re-established
+        ref = np.asarray(fn(*fz((snap, extras))))
+        got = np.asarray(kern.run(state, (snap, extras), force_full=True))
+        np.testing.assert_array_equal(got, ref)
+        assert state.last_kind == "full"
+        assert state.full_cycles == 2 and state.delta_cycles == 3
+
+    def test_huge_delta_falls_back_to_full_upload(self):
+        # when the diff covers most of the buffers, shipping idx+vals
+        # would move MORE bytes than the buffers themselves: the size
+        # heuristic must take the full path (decisions identical anyway)
+        class _Stub:
+            def __init__(self, tree):
+                self._x = tree["a"]
+
+            def packed_decisions(self):
+                return (self._x * 2).astype(jax.numpy.int32)
+
+        tree = {"a": np.arange(1024, dtype=np.float32)}
+        kern = DeltaKernel(lambda t: _Stub(t), (tree,))
+        state = ResidentState()
+        kern.run(state, (tree,))
+        assert state.last_kind == "full"
+        tree["a"] = tree["a"] + np.float32(1.0)      # every element changed
+        out = np.asarray(kern.run(state, (tree,)))
+        assert state.last_kind == "full"
+        np.testing.assert_array_equal(
+            out, ((tree["a"]) * 2).astype(np.int32))
+
+    def test_consumed_residents_fail_fast_on_reread(self):
+        """The invalidation deadline: a resident handle consumed by cycle
+        k is dead no later than cycle k+1's dispatch (immediately where
+        the backend honored the donation)."""
+        snap, extras = snapshot()
+        cycle = make_allocate_cycle(AllocateConfig(binpack_weight=1.0))
+        kern = DeltaKernel(cycle, (snap, extras))
+        state = ResidentState()
+        kern.run(state, (snap, extras))
+        old = state.device
+        np.asarray(snap.tasks.priority)[1] += 1
+        packed = kern.run(state, (snap, extras))    # consumes `old`
+        np.asarray(packed)              # the OUTPUT stays readable
+        kern.run(state, (snap, extras))             # next dispatch
+        for h in old:                   # ...retires the consumed inputs
+            with pytest.raises(RuntimeError):
+                np.asarray(h)
+
+    def test_donation_matches_backend_contract(self):
+        from volcano_tpu.ops.fused_io import donation_for_backend
+        assert donation_for_backend("cpu") == ()
+        assert donation_for_backend("tpu") == (0, 1, 2)
+        snap, extras = snapshot()
+        kern = DeltaKernel(
+            make_allocate_cycle(AllocateConfig(binpack_weight=1.0)),
+            (snap, extras))
+        assert tuple(kern.donate_argnums) == donation_for_backend()

@@ -167,6 +167,68 @@ class TestTelemetryFamily:
         assert "telemetry" in FAMILIES
 
 
+class TestDonationFamily:
+    """Family 8 (ISSUE 4): the device-resident delta path's contract.
+    Planted violations — a host callback in the delta update entry and a
+    re-read of a donated buffer — must provably fire; the real code stays
+    green (covered by the fast_report fixture, which runs all families)."""
+
+    def test_fires_on_planted_callback_in_delta_entry(self, monkeypatch):
+        from volcano_tpu.analysis.donation import check_donation
+        from volcano_tpu.ops import fused_io as fio
+        real_unfuse = fio.make_unfuse
+
+        def planted(treedef, spec):
+            unfuse = real_unfuse(treedef, spec)
+
+            def wrapped(fbuf, ibuf, bbuf):
+                # the violation class: a host round-trip smuggled into the
+                # scatter+cycle entry
+                jax.debug.callback(lambda v: None, fbuf[0])
+                return unfuse(fbuf, ibuf, bbuf)
+
+            return wrapped
+
+        monkeypatch.setattr(fio, "make_unfuse", planted)
+        findings = check_donation(fast=True)
+        assert any(f.family == "donation" and "callback" in f.key
+                   for f in findings), [f.what for f in findings]
+
+    def test_fires_on_planted_reread_of_donated_buffer(self, monkeypatch):
+        import volcano_tpu.telemetry as tel
+        from volcano_tpu.analysis.donation import check_donation
+        from volcano_tpu.ops import fused_io as fio
+        # the double failure that leaves resident handles readable: the
+        # entry silently compiles WITHOUT donation (a wrapper dropping
+        # jit kwargs would do it) AND the fail-fast invalidation is lost.
+        # Either layer alone keeps the contract (the runtime deletes
+        # donated inputs itself); losing both is the re-read hazard the
+        # family exists to catch.
+        real_cj = tel.counted_jit
+
+        def undonated_jit(fn, entry, **kwargs):
+            kwargs.pop("donate_argnums", None)
+            return real_cj(fn, entry, **kwargs)
+
+        monkeypatch.setattr(tel, "counted_jit", undonated_jit)
+        monkeypatch.setattr(fio.DeltaKernel, "_invalidate",
+                            lambda self, handles: None)
+        findings = check_donation(fast=True)
+        assert any(f.family == "donation" and "re-read" in f.key
+                   for f in findings), [f.what for f in findings]
+
+    def test_clean_on_real_delta_path(self):
+        from volcano_tpu.analysis.donation import check_donation
+        assert check_donation(fast=True) == []
+
+    def test_family_registered(self):
+        from volcano_tpu.analysis import FAMILIES
+        assert "donation" in FAMILIES
+
+    def test_delta_entry_in_trace_set(self, graph_traces):
+        assert "fused_io/delta_update" in [t.name for t in graph_traces]
+
+
 class TestDeriveBatchingErrorPaths:
     """Satellite: the documented error paths of the batching authority."""
 

@@ -66,6 +66,49 @@ class TestSidecar:
         finally:
             server.shutdown()
 
+    def test_pipelined_rounds_match_sync_shifted_by_one(self):
+        """VCRP serving: response k carries round k-1's decisions and the
+        stream (prime, rounds, drain) reproduces the synchronous responses
+        exactly, one round late. Exercises the device-resident delta path
+        under evolving snapshots."""
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            sync_client = SidecarClient(*server.address)
+            pipe_client = SidecarClient(*server.address)
+            # evolving snapshots: each round binds one more task up front
+            cis = []
+            for k in range(3):
+                ci = cluster()
+                names = sorted(ci.nodes)
+                bound = 0
+                for job in ci.jobs.values():
+                    for task in job.tasks.values():
+                        if bound >= k:
+                            break
+                        from volcano_tpu.api import TaskStatus
+                        job.update_task_status(task, TaskStatus.RUNNING)
+                        task.node_name = names[bound % len(names)]
+                        ci.nodes[task.node_name].add_task(task)
+                        bound += 1
+                cis.append(ci)
+            sync_outs = [sync_client.schedule(ci) for ci in cis]
+            assert pipe_client.schedule_pipelined(cis[0]) is None  # prime
+            pipe_outs = [pipe_client.schedule_pipelined(ci)
+                         for ci in cis[1:]]
+            pipe_outs.append(pipe_client.drain_pipelined())
+            for k, (s, p) in enumerate(zip(sync_outs, pipe_outs)):
+                np.testing.assert_array_equal(s["task_node"],
+                                              p["task_node"], f"round {k}")
+                np.testing.assert_array_equal(s["task_mode"],
+                                              p["task_mode"], f"round {k}")
+                assert s["binds"] == p["binds"], f"round {k}"
+            assert pipe_client.drain_pipelined() is None
+            sync_client.close()
+            pipe_client.close()
+        finally:
+            server.shutdown()
+
     def test_error_reply_keeps_connection(self):
         import socket, struct
         server = SidecarServer()

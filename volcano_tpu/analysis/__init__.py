@@ -14,7 +14,7 @@ compiled_session conf presets, the ops/ cycle functions, both Pallas
 kernel builders) and turns each class into a CI failure instead of a
 driver-TPU surprise.
 
-Check families (all seven run by default):
+Check families (all eight run by default):
 
 - ``purity``       — no pure_callback/io_callback/debug_callback
                      primitives anywhere in a compiled cycle.
@@ -49,6 +49,16 @@ Check families (all seven run by default):
                      dead-code-eliminated — nothing telemetry-shaped in
                      the outputs, jaxpr equation-count-identical to a
                      telemetry-free build.
+- ``donation``     — the device-resident delta-upload contract
+                     (ops/fused_io.DeltaKernel): the update+cycle entry's
+                     donation matches the platform contract (resident
+                     buffers donated on accelerators, none on CPU where
+                     donation forces inline execution), every consumed
+                     handle is invalidated within one dispatch (a host
+                     re-read fails fast instead of silently reading
+                     aliased post-scatter memory on TPU), the delta
+                     scatter stays device-pure, and delta-ingested
+                     decisions are byte-identical to a full upload.
 
 Run ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh)
 for the CLI; tier-1 runs the same pass via tests/test_graphcheck.py.
@@ -65,7 +75,7 @@ import time
 from typing import List, Optional, Sequence
 
 FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations",
-            "telemetry")
+            "telemetry", "donation")
 
 
 @dataclasses.dataclass
@@ -152,6 +162,10 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     if "telemetry" in families:
         from .telemetry import check_telemetry
         findings += check_telemetry(fast=fast)
+
+    if "donation" in families:
+        from .donation import check_donation
+        findings += check_donation(fast=fast)
 
     findings = apply_allowlist(findings)
     blocking = [f for f in findings if not f.allowlisted]

@@ -1,6 +1,6 @@
 """CLI: ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh).
 
-Runs the seven graphcheck families over the repo's real entry points on the
+Runs the eight graphcheck families over the repo's real entry points on the
 CPU backend, writes a machine-readable JSON report, prints human-readable
 findings, and exits with a stable code:
 
@@ -30,7 +30,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--families", default=None,
         help="comma-separated subset of check families "
-             "(default: all seven)")
+             "(default: all eight)")
     parser.add_argument(
         "--fast", action="store_true",
         help="prune the traced-entry set to a representative subset "

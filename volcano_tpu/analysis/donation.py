@@ -1,0 +1,144 @@
+"""Graphcheck family 8: delta-upload + buffer-donation safety.
+
+The device-resident snapshot path (ops/fused_io.DeltaKernel) donates the
+three fused group buffers through the update+cycle entry so XLA updates
+them in place. Donation makes a whole failure class possible that no unit
+assertion sees until a driver TPU corrupts a cycle:
+
+- **re-read after donation** — host code (or a second consumer of the
+  same state) reading a buffer handle the entry already consumed. On TPU
+  the memory is aliased into the outputs, so the read returns whatever the
+  scatter wrote — silently. The framework's discipline is fail-fast
+  invalidation with a one-dispatch deadline: an honored donation kills
+  the handle at dispatch, and DeltaKernel deletes whatever the runtime
+  left alive at the NEXT dispatch (when the depth-1 pipeline has drained
+  the consumer, so the delete cannot block). This family runs real
+  full-then-delta cycles and fails if a consumed handle is still
+  readable one dispatch later.
+- **donation off-contract** — the entry's donation must match the
+  platform: the three resident buffers on accelerators (in-place
+  scatter), NONE on the CPU backend, where XLA executes donated
+  computations inline and would serialize the pipelined loop on compute
+  (``ops/fused_io.donation_for_backend`` is the single authority).
+- **host callback in the delta scatter** — the update half must stay as
+  device-pure as the cycle itself; a callback smuggled into the scatter
+  path re-serializes every cycle on a host round-trip. Checked on the
+  traced jaxpr of the REAL update+cycle entry (the purity walk scoped to
+  this family so a planted violation is attributable to the delta path).
+- **delta/full divergence** — the scattered buffers must be bit-identical
+  to freshly fused ones; the family replays one mutation through both
+  paths and compares the packed decisions byte-for-byte.
+
+All checks run on CPU with small REAL snapshots through the same
+``arrays.pack`` path production uses.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding
+
+
+def check_donation(fast: bool = False) -> List[Finding]:
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from ..ops.allocate_scan import (AllocateConfig, derive_batching,
+                                     make_allocate_cycle)
+    from ..ops.fused_io import (DeltaKernel, ResidentState,
+                                donation_for_backend)
+    from .entrypoints import _snap_extras
+    from .jaxpr_audit import CALLBACK_PRIMITIVES, _loc, iter_eqns
+
+    findings: List[Finding] = []
+    snap, extras = _snap_extras()
+    cfg = dataclasses.replace(
+        derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+                        has_proportion=False), use_pallas=False)
+    cycle = make_allocate_cycle(cfg)
+    kernel = DeltaKernel(cycle, (snap, extras))
+
+    # ---- donation must match the platform contract ------------------------
+    # accelerators donate the three resident buffers (in-place scatter);
+    # the CPU backend must NOT donate — XLA executes donated computations
+    # inline there, which serializes the pipelined loop on compute
+    expected = donation_for_backend()
+    if tuple(kernel.donate_argnums) != tuple(expected):
+        findings.append(Finding(
+            family="donation",
+            key=f"donation:delta-entry:argnums:{kernel.donate_argnums}",
+            where="ops/fused_io.DeltaKernel",
+            what=(f"delta update+cycle entry donates {kernel.donate_argnums}"
+                  f" but this backend's contract is {expected} — donation "
+                  "on CPU forces synchronous dispatch; missing donation on "
+                  "an accelerator re-allocates the full fused buffers "
+                  "every cycle")))
+
+    # ---- purity of the delta scatter (traced on the REAL entry) -----------
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(kernel.traceable)(
+            *kernel.example_delta_args())
+    seen = set()
+    for eqn in iter_eqns(closed.jaxpr):
+        pname = eqn.primitive.name
+        if pname in CALLBACK_PRIMITIVES and pname not in seen:
+            seen.add(pname)
+            findings.append(Finding(
+                family="donation",
+                key=f"donation:delta-entry:callback:{pname}",
+                where=f"ops/fused_io delta entry @ {_loc(eqn)}",
+                what=(f"host callback primitive '{pname}' in the delta "
+                      "update+cycle entry — the scatter path must stay "
+                      "device-pure (a callback re-serializes every "
+                      "steady-state cycle on a host round-trip)")))
+
+    # ---- live full -> delta cycles: invalidation + parity -----------------
+    state = ResidentState()
+    np.asarray(kernel.run(state, (snap, extras)))     # cold full upload
+    handles_after_full = state.device
+    # mutate one packed leaf in place (a priority bump: the smallest
+    # realistic steady-state churn) and run the delta path
+    prio = np.asarray(snap.tasks.priority)
+    prio[0] = prio[0] + 1
+    delta_packed = np.asarray(kernel.run(state, (snap, extras)))
+    if state.last_kind != "delta":
+        findings.append(Finding(
+            family="donation",
+            key=f"donation:delta-entry:no-delta:{state.last_kind}",
+            where="ops/fused_io.DeltaKernel.run",
+            what=("a one-element change took the "
+                  f"'{state.last_kind}' path instead of a delta upload — "
+                  "the steady-state O(dirty) contract is broken")))
+    # the invalidation deadline: a consumed handle is dead no later than
+    # the NEXT dispatched cycle (immediately under honored donation)
+    np.asarray(kernel.run(state, (snap, extras)))     # idle delta cycle
+    for i, h in enumerate(handles_after_full):
+        try:
+            np.asarray(h)
+        except RuntimeError:
+            continue        # deleted — the contract
+        findings.append(Finding(
+            family="donation",
+            key=f"donation:delta-entry:re-read:buf{i}",
+            where="ops/fused_io.ResidentState",
+            what=(f"resident buffer {i} is still readable one dispatch "
+                  "after the cycle that consumed it — the invalidation "
+                  "discipline was lost, so a host re-read on TPU would "
+                  "silently return post-scatter (aliased) data instead "
+                  "of failing fast")))
+    # delta-ingested decisions must equal a cold full-upload run
+    kernel2 = DeltaKernel(cycle, (snap, extras))
+    ref_mutated = np.asarray(kernel2.run(ResidentState(), (snap, extras)))
+    if not np.array_equal(delta_packed, ref_mutated):
+        findings.append(Finding(
+            family="donation",
+            key="donation:delta-entry:divergence",
+            where="ops/fused_io.DeltaKernel",
+            what=("delta-ingested cycle decisions differ from the "
+                  "full-upload path on the same snapshot — the scatter is "
+                  "not reproducing the fused buffers bit-exactly")))
+    prio[0] = prio[0] - 1   # restore the shared packed snapshot
+    return findings

@@ -203,6 +203,18 @@ def build_traces(fast: bool = False) -> List[EntryTrace]:
                 traces.append(EntryTrace(
                     name, closed, _dims(snap, cfg, extras), cfg))
 
+        # the delta-update + cycle entry (ops/fused_io.DeltaKernel): the
+        # steady-state production program — in-graph scatter of the packed
+        # deltas onto the device-resident buffers, then the cycle over the
+        # rebuilt tree. Traced with a representative non-empty delta
+        # bucket so the scatter path itself is walked.
+        from ..ops.fused_io import DeltaKernel
+        _scan_cfg = _allocate_cfgs(fast=True)[0][1]
+        dk = DeltaKernel(make_allocate_cycle(_scan_cfg), (snap, extras))
+        closed = jax.make_jaxpr(dk.traceable)(*dk.example_delta_args())
+        traces.append(EntryTrace("fused_io/delta_update", closed,
+                                 _dims(snap, _scan_cfg, extras), _scan_cfg))
+
         # compiled_session conf presets (in-graph plugin extras included)
         from ..framework.compiled_session import make_conf_cycle
         for name, text in _conf_presets(fast):
@@ -303,4 +315,12 @@ def recompile_probes(fast: bool = False) -> List[tuple]:
             name, text = presets[0]
             probes.append((name, lambda text=text: make_conf_cycle(text),
                            {s: (packed[s][0],) for s in sizes}))
+
+        # delta-update entry: the "problem sizes" are delta BUCKETS — the
+        # steady loop must compile once per bucket, never per delta size
+        from ..ops.fused_io import DeltaKernel
+        dcfg = _allocate_cfgs(fast=True)[0][1]
+        dk = DeltaKernel(make_allocate_cycle(dcfg), packed[_AUDIT_SIZE])
+        probes.append(("fused_io/delta_update", lambda dk=dk: dk.traceable,
+                       {b: dk.example_delta_args(b) for b in (256, 512)}))
     return probes

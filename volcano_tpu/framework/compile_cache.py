@@ -1,0 +1,44 @@
+"""Opt-in persistent XLA compilation cache for the cycle programs.
+
+Every scheduler/sidecar restart used to re-pay the full trace+compile of
+the fused cycle (BENCH records ``compile_s`` ~= 4.0 on this machine's CPU
+backend; a driver-TPU mosaic lowering costs more). jax ships a persistent
+compilation cache keyed by the serialized HLO — enabling it turns the
+restart cost into a disk read for every shape/delta bucket the process has
+ever compiled.
+
+Opt-in only (the cache directory is a deployment decision):
+
+- conf: top-level ``compilation_cache_dir: /path`` (framework/conf.py)
+- env:  ``VOLCANO_JAX_CACHE_DIR=/path`` (wins over nothing, loses to an
+  explicit argument)
+
+Pair with the AOT warmup hooks (``Scheduler.warmup`` /
+``SchedulerSidecar.warmup``) to move even the first cycle's compile off
+the serving path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``path`` (or
+    ``$VOLCANO_JAX_CACHE_DIR``). Returns the directory in effect, or None
+    when disabled/unavailable. Safe to call repeatedly and before or after
+    backend init; failures are swallowed (an old jax without the knob must
+    not take the scheduler down)."""
+    path = path or os.environ.get("VOLCANO_JAX_CACHE_DIR")
+    if not path:
+        return None
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ.get("VOLCANO_JAX_CACHE_MIN_S", 1.0)))
+    except Exception:
+        return None
+    return path

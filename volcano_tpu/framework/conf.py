@@ -84,6 +84,25 @@ class SchedulerConfiguration:
     #: the off-build's jaxprs carry zero telemetry equations. YAML:
     #: top-level ``telemetry: true``.
     telemetry: bool = False
+    #: device-resident snapshot buffers with packed delta uploads
+    #: (ops/fused_io.DeltaKernel): steady-state cycles ship O(changed
+    #: elements) instead of re-uploading the full fused buffers. Decisions
+    #: are bit-identical either way (the delta is a value-level diff
+    #: against the mirror of device truth); ``delta_uploads: false``
+    #: restores the full-upload path. YAML: top-level key.
+    delta_uploads: bool = True
+    #: one-deep pipelined scheduler loop (runtime/scheduler.py): dispatch
+    #: the compiled cycle, defer the packed readback, and drain it at the
+    #: top of the next run_once, overlapping device compute with host
+    #: event ingestion. Default off — the synchronous loop is the
+    #: reference semantics; see docs/architecture.md "Steady-state
+    #: pipeline" for the exact apply-ordering contract. YAML: top-level
+    #: ``pipeline: true``.
+    pipeline: bool = False
+    #: opt-in persistent XLA compilation cache directory
+    #: (framework/compile_cache.enable_compilation_cache); also settable
+    #: via $VOLCANO_JAX_CACHE_DIR. None = disabled.
+    compilation_cache_dir: Optional[str] = None
 
     def plugin_option(self, name: str) -> Optional[PluginOption]:
         for tier in self.tiers:
@@ -129,6 +148,10 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     data = yaml.safe_load(text or DEFAULT_SCHEDULER_CONF) or {}
     sc = SchedulerConfiguration()
     sc.telemetry = bool(data.get("telemetry", False))
+    sc.delta_uploads = bool(data.get("delta_uploads", True))
+    sc.pipeline = bool(data.get("pipeline", False))
+    cache_dir = data.get("compilation_cache_dir")
+    sc.compilation_cache_dir = str(cache_dir) if cache_dir else None
     raw_actions = data.get("actions", "enqueue, allocate, backfill")
     if isinstance(raw_actions, str):
         sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]

@@ -75,11 +75,38 @@ def _allocate_fn(cfg: AllocateConfig):
 #: time at scale
 _FUSED_CACHE: Dict[tuple, tuple] = {}
 
+#: same key -> DeltaKernel — the device-resident delta-upload path
+#: (conf delta_uploads, default on). Kernels are stateless programs and
+#: shared across sessions; the device residency itself (ResidentState)
+#: lives on each Session so concurrent sessions never fight over buffers.
+_DELTA_CACHE: Dict[tuple, object] = {}
+
 
 def _fused_allocate(cfg: AllocateConfig, snap, extras):
     from ..ops.fused_io import fused_cycle_cached
     return fused_cycle_cached(make_allocate_cycle(cfg), (snap, extras),
                               _FUSED_CACHE, key_extra=cfg)
+
+
+def _delta_allocate(cfg: AllocateConfig, snap, extras):
+    from ..ops.fused_io import delta_cycle_cached
+    return delta_cycle_cached(make_allocate_cycle(cfg), (snap, extras),
+                              _DELTA_CACHE, key_extra=cfg)
+
+
+@dataclasses.dataclass
+class PendingAllocate:
+    """An in-flight dispatched allocate cycle: the device handle of the
+    packed decisions plus everything complete_allocate needs to decode and
+    apply them. The one-deep pipeline (runtime/scheduler.py) holds exactly
+    one of these across a run_once boundary."""
+
+    packed: object              # device array (readback deferred)
+    cfg: AllocateConfig
+    T: int
+    J: int
+    R: int
+    dispatch_ms: float = 0.0
 
 
 @lru_cache(maxsize=64)
@@ -112,6 +139,11 @@ class Session:
         self.now = now if now is not None else time.time()
         self._build_plugins(plugin_overrides or {})
 
+        # device residency for the delta-upload path: DeltaKernel ->
+        # ResidentState. Survives reopen (that's the point: the fused
+        # buffers stay on-device across cycles); a fresh Session starts
+        # cold and pays one full upload.
+        self._resident: Dict[object, object] = {}
         self._reset_cycle_state()
         self.repack()
         self._open_plugins()
@@ -698,41 +730,104 @@ class Session:
             return self._run_allocate()
 
     def _run_allocate(self):
-        t0 = time.time()
+        return self.complete_allocate(self.dispatch_allocate())
+
+    def _derived_allocate_inputs(self):
+        """(cfg, extras) exactly as the dispatched cycle consumes them.
+
+        Batched pallas rounds: ops/allocate_scan.derive_batching is the
+        single authority for the exactness preconditions — static-key
+        configs get K pre-selected sections (batch_jobs), dynamic-key
+        configs (drf/hdrf ordering or any finite proportion deserved,
+        including 0: zero-quota queues flip overused on the first
+        commit) get the in-kernel-selection path (batch_rounds)."""
         cfg = self.allocate_config()
         extras = self.allocate_extras()
-        # Batched pallas rounds: ops/allocate_scan.derive_batching is the
-        # single authority for the exactness preconditions — static-key
-        # configs get K pre-selected sections (batch_jobs), dynamic-key
-        # configs (drf/hdrf ordering or any finite proportion deserved,
-        # including 0: zero-quota queues flip overused on the first
-        # commit) get the in-kernel-selection path (batch_rounds).
         from ..ops.allocate_scan import derive_batching
         cfg = derive_batching(cfg, extras.queue_deserved)
         # GPU-free snapshots skip the per-card kernel state
         # (decision-neutral: zero requests never charge a card)
         if not np.any(np.asarray(self.snap.tasks.gpu_request) > 0):
             cfg = dataclasses.replace(cfg, enable_gpu=False)
+        return cfg, extras
+
+    def warm_allocate(self) -> None:
+        """AOT-compile the allocate entry for the current shape bucket
+        WITHOUT executing a cycle — the cold-start hook (pair with
+        framework/compile_cache: a restarted scheduler stops paying
+        ``compile_s`` on its first real cycle)."""
+        cfg, extras = self._derived_allocate_inputs()
+        if bool(getattr(self.conf, "delta_uploads", True)):
+            _delta_allocate(cfg, self.snap, extras).warm()
+        else:
+            from ..ops.fused_io import _TARGETS, fuse_spec, group_sizes
+            fn, _fuse = _fused_allocate(cfg, self.snap, extras)
+            _td, spec = fuse_spec((self.snap, extras))
+            import jax
+            avals = tuple(jax.ShapeDtypeStruct((n,), _TARGETS[g])
+                          for g, n in zip(("f", "i", "b"),
+                                          group_sizes(spec)))
+            fn.lower(*avals).compile()
+
+    def dispatch_allocate(self) -> PendingAllocate:
+        """Upload (full or delta) + dispatch the compiled allocate cycle
+        WITHOUT reading the decisions back. Returns the pending handle;
+        :meth:`complete_allocate` drains it. The synchronous path is
+        ``complete_allocate(dispatch_allocate())``; the pipelined scheduler
+        loop holds the pending across one run_once boundary so device
+        compute overlaps host event ingestion."""
+        t0 = time.time()
+        cfg, extras = self._derived_allocate_inputs()
         self.stats["extras_ms"] = (time.time() - t0) * 1000
         t0 = time.time()
-        # fused 3-buffer upload + single packed readback (the per-leaf
-        # transfer cost over the axon tunnel dominated at scale)
-        fn, fuse = _fused_allocate(cfg, self.snap, extras)
-        packed = np.asarray(fn(*fuse((self.snap, extras))))
+        if bool(getattr(self.conf, "delta_uploads", True)):
+            # device-resident buffers + packed delta scatter: steady-state
+            # upload is O(changed elements); full re-fuse only on the
+            # first cycle of a shape bucket or when the diff is huge
+            kernel = _delta_allocate(cfg, self.snap, extras)
+            state = self._resident.get(id(kernel))
+            if state is None:
+                from ..ops.fused_io import ResidentState
+                state = self._resident[id(kernel)] = ResidentState()
+            packed = kernel.run(state, (self.snap, extras))
+            self.stats["upload_bytes"] = float(state.last_upload_bytes)
+            self.stats["upload_bytes_full"] = float(state.full_upload_bytes)
+            self.stats["delta_cycle"] = float(state.last_kind == "delta")
+            from ..metrics import METRICS
+            METRICS.inc("cycle_upload_bytes", state.last_upload_bytes,
+                        labels={"kind": state.last_kind})
+        else:
+            # fused 3-buffer full upload + single packed readback (the
+            # per-leaf transfer cost over the axon tunnel dominated at
+            # scale; conf delta_uploads: false)
+            fn, fuse = _fused_allocate(cfg, self.snap, extras)
+            packed = fn(*fuse((self.snap, extras)))
+        T = int(np.asarray(self.snap.tasks.status).shape[0])
+        J = int(np.asarray(self.snap.jobs.valid).shape[0])
+        R = int(np.asarray(self.snap.nodes.idle).shape[1])
+        dispatch_ms = (time.time() - t0) * 1000
+        self.stats["dispatch_ms"] = dispatch_ms
+        return PendingAllocate(packed=packed, cfg=cfg, T=T, J=J, R=R,
+                               dispatch_ms=dispatch_ms)
+
+    def complete_allocate(self, pending: PendingAllocate):
+        """Drain a dispatched cycle: read the packed decisions back, decode
+        the telemetry tail, and apply binds/pipelines to the session."""
+        t0 = time.time()
+        cfg, T, J = pending.cfg, pending.T, pending.J
+        packed = np.asarray(pending.packed)
         from ..ops.allocate_scan import unpack_decisions
-        T = np.asarray(self.snap.tasks.status).shape[0]
-        J = np.asarray(self.snap.jobs.valid).shape[0]
         (task_node, task_mode, task_gpu, job_ready, job_pipelined,
          job_attempted) = unpack_decisions(packed, T, J)
-        self.stats["kernel_ms"] = (time.time() - t0) * 1000
+        self.stats["kernel_ms"] = (pending.dispatch_ms
+                                   + (time.time() - t0) * 1000)
         if cfg.telemetry and packed.shape[0] > 3 * T + 3 * J:
             # the CycleTelemetry block rode the same packed readback as
             # the decisions — decode its i32 tail and bridge it into the
             # METRICS registry (unschedule_task_count{reason=...} etc.)
             from ..telemetry import (publish_cycle_telemetry,
                                      unpack_cycle_telemetry)
-            R = np.asarray(self.snap.nodes.idle).shape[1]
-            tel = unpack_cycle_telemetry(packed[3 * T + 3 * J:], R)
+            tel = unpack_cycle_telemetry(packed[3 * T + 3 * J:], pending.R)
             self.last_telemetry["allocate"] = tel
             publish_cycle_telemetry(tel)
         import types

@@ -86,6 +86,11 @@ class SchedulerCache:
         self.dirty_jobs: set = set()
         self.dirty_nodes: set = set()
         self.structural: bool = True
+        #: total structural marks ever raised — each one forces the
+        #: scheduler onto a fresh Session (and therefore a full re-fuse of
+        #: the device-resident buffers), so the counter is the ground truth
+        #: for "full upload only on structural change" claims
+        self.structural_epochs: int = 1
         api.watch("pods", self._on_pod)
         api.watch("podgroups", self._on_podgroup)
         api.watch("nodes", self._on_node)
@@ -201,6 +206,8 @@ class SchedulerCache:
         if node_name is not None:
             self.dirty_nodes.add(node_name)
         if structural:
+            if not self.structural:
+                self.structural_epochs += 1
             self.structural = True
             self._needs_rebuild = True
 

@@ -33,6 +33,9 @@ class FakeCluster:
         self.dirty_jobs: set = set()
         self.dirty_nodes: set = set()
         self.structural: bool = False
+        #: total structural marks ever raised (see SchedulerCache) — the
+        #: expected full-re-fuse count of a delta-upload steady loop
+        self.structural_epochs: int = 0
 
     # ------------------------------------------------------------- snapshot
     def snapshot(self) -> ClusterInfo:
@@ -55,6 +58,8 @@ class FakeCluster:
         if node_name is not None:
             self.dirty_nodes.add(node_name)
         if structural:
+            if not self.structural:
+                self.structural_epochs += 1
             self.structural = True
 
     def drain_dirty(self) -> Tuple[set, set, bool]:

@@ -68,12 +68,29 @@ class Scheduler:
                  conf: Optional[SchedulerConfiguration] = None,
                  conf_path: Optional[str] = None,
                  schedule_period: float = 1.0,
-                 incremental: bool = True):
+                 incremental: bool = True,
+                 pipeline: Optional[bool] = None):
         self.cluster = cluster
         self.conf_path = conf_path
         self._conf_mtime = 0.0
         self.conf = conf or self._load_conf() or parse_conf()
         self.schedule_period = schedule_period
+        # one-deep pipelined loop (conf `pipeline: true` or constructor
+        # override): run_once dispatches the compiled cycle and defers the
+        # packed readback; the NEXT run_once drains it first — decisions
+        # are always applied before their input buffers can be
+        # overwritten (depth 1), and before the next cycle's snapshot is
+        # refreshed, so the decision sequence matches the synchronous loop
+        self.pipeline = (bool(getattr(self.conf, "pipeline", False))
+                         if pipeline is None else bool(pipeline))
+        #: (session, PendingAllocate, host_ms_so_far, wall) of the
+        #: dispatched-but-not-drained cycle; bounded depth 1
+        self._pending = None
+        # opt-in persistent XLA compilation cache (conf/env) — restarts
+        # stop paying compile_s for already-seen shape buckets
+        from ..framework.compile_cache import enable_compilation_cache
+        enable_compilation_cache(
+            getattr(self.conf, "compilation_cache_dir", None))
         self._plugin_state: Dict[str, object] = {}
         self.cycles = 0
         self.resync = ResyncQueue()
@@ -89,6 +106,11 @@ class Scheduler:
         #: the steady-state claim is checkable: full_packs stays at 1
         self.full_packs = 0
         self.incremental_cycles = 0
+        #: (dirty job count, dirty node count) the last session open drained
+        #: from the cluster — the raw material the delta upload packs, so
+        #: the flight recorder can correlate dirty-mark volume with
+        #: upload_bytes per cycle
+        self._last_dirty = (0, 0)
         #: bounded flight recorder: the last N cycle snapshots (host
         #: timestamps, latency, bind/evict counts, in-graph telemetry when
         #: the conf enables it), served by the dashboard's /api/telemetry
@@ -138,6 +160,7 @@ class Scheduler:
             return Session(self.cluster.snapshot(), self.conf, now=now,
                            plugin_overrides=overrides)
         dj, dn, structural = self.cluster.drain_dirty()
+        self._last_dirty = (len(dj), len(dn))
         ssn = self._session
         if ssn is None or structural:
             # a fresh full pack absorbs any dirty backlog
@@ -156,13 +179,38 @@ class Scheduler:
             self.full_packs += 1
         return ssn
 
+    def warmup(self, now: Optional[float] = None) -> None:
+        """AOT warmup hook: open the persistent session for the cluster's
+        current shape bucket and compile the allocate entry ahead of the
+        first real cycle. With the persistent compilation cache enabled
+        (conf ``compilation_cache_dir`` / $VOLCANO_JAX_CACHE_DIR) a
+        restarted scheduler pays a disk read instead of ``compile_s``."""
+        self._open_session(now).warm_allocate()
+
     def run_once(self, now: Optional[float] = None) -> Session:
-        """One scheduling cycle (runOnce, scheduler.go:91-120)."""
+        """One scheduling cycle (runOnce, scheduler.go:91-120).
+
+        Synchronous mode (default): dispatch + readback + apply + flush in
+        this call; returns this cycle's Session.
+
+        Pipelined mode (conf ``pipeline: true``): FIRST drain the previous
+        cycle's deferred readback — apply its decisions and flush its
+        intents — THEN refresh the snapshot and dispatch this cycle,
+        returning without reading it back (device compute overlaps the
+        host's inter-cycle event ingestion). Depth is bounded at 1, so a
+        cycle's decisions are always applied before the resident input
+        buffers can be overwritten by the next delta upload, and before
+        the next snapshot refresh — the decision sequence is bit-identical
+        to the synchronous loop (see docs/architecture.md "Steady-state
+        pipeline"). Returns the just-COMPLETED cycle's record (None-like
+        first call returns the in-flight session); call :meth:`drain` to
+        retire the final in-flight cycle."""
         reloaded = self._load_conf()
         if reloaded is not None:
             self.conf = reloaded
         t0 = time.time()
         wall = now if now is not None else t0
+        completed = self._drain_pending(wall)
         # drain due resync retries BEFORE snapshotting so the cycle sees
         # their outcomes (the errTasks worker runs alongside the loop,
         # cache.go:687-709)
@@ -173,10 +221,50 @@ class Scheduler:
             METRICS.inc("resync_dropped", rs["dropped"])
         ssn = self._open_session(now)
         from ..actions import get_action
-        for name in self.conf.actions:
+        actions = list(self.conf.actions)
+        # the pipeline defers the allocate readback across the run_once
+        # boundary, so it requires allocate to be the cycle's LAST action
+        # (anything after it would need the decisions applied); other
+        # action lists fall back to the synchronous path
+        pipelined = self.pipeline and actions and actions[-1] == "allocate"
+        for name in (actions[:-1] if pipelined else actions):
             ta = time.time()
             get_action(name).execute(ssn)
             METRICS.observe_action(name, time.time() - ta)
+        if pipelined:
+            ta = time.time()
+            pending = ssn.dispatch_allocate()
+            METRICS.observe_action("allocate_dispatch", time.time() - ta)
+            self._pending = (ssn, pending, time.time() - t0, wall)
+            return completed if completed is not None else ssn
+        return self._finish_cycle(ssn, time.time() - t0, wall)
+
+    def _drain_pending(self, wall: float):
+        """Drain the one-deep pipeline: read the in-flight cycle's packed
+        decisions back, apply them, and flush its intents. Returns a
+        detached record of the completed cycle (the live Session object is
+        re-opened for the next cycle right after, which resets its intent
+        lists) or None when nothing was in flight."""
+        if self._pending is None:
+            return None
+        import numpy as np
+        ssn, pending, host_s, _wall0 = self._pending
+        self._pending = None
+        t0 = time.time()
+        result = ssn.complete_allocate(pending)
+        # the AllocateAction readouts the synchronous path records
+        ssn.stats["allocated_binds"] = len(ssn.binds)
+        ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
+        ssn.stats["jobs_pipelined"] = int(
+            np.asarray(result.job_pipelined).sum())
+        self._finish_cycle(ssn, host_s + (time.time() - t0), wall)
+        return CompletedCycle(ssn)
+
+    def _finish_cycle(self, ssn: Session, host_s: float,
+                      wall: float) -> Session:
+        """Everything after the last action: close, write back, flush
+        intents, metrics, flight record — shared by the synchronous path
+        and the pipelined drain."""
         ssn.close()
 
         # PodGroup status write-back at session close (the jobUpdater's
@@ -194,8 +282,7 @@ class Scheduler:
                 # while the rate-limited retry works (cache.go:549-560)
                 self.cluster.hold_binding(intent)
                 self.resync.add(intent, "bind", wall)
-        cycle_s = time.time() - t0
-        METRICS.observe_cycle(cycle_s)
+        METRICS.observe_cycle(host_s)
         METRICS.inc("schedule_attempts")
         # reference vocabulary: schedule_attempts_total{result=...}
         # (metrics.go:92-100 scheduleAttempts) — "error" when a bind
@@ -210,14 +297,39 @@ class Scheduler:
         from ..telemetry import publish_gauges
         publish_gauges(METRICS)
         self.cycles += 1
+        stats = ssn.stats
         self.flight.record(
-            now=wall, cycle=self.cycles, cycle_ms=round(cycle_s * 1000, 3),
+            now=wall, cycle=self.cycles, cycle_ms=round(host_s * 1000, 3),
             binds=len(ssn.binds), evictions=len(ssn.evictions),
             pipelined=len(ssn.pipelined), bind_errors=len(ssn.bind_errors),
             resync_pending=len(self.resync), result=result,
-            stats={k: round(float(v), 3) for k, v in ssn.stats.items()},
+            # delta-upload observability: what this cycle actually shipped
+            # vs what a full upload would have, and which path it took
+            cycle_kind=("delta" if stats.get("delta_cycle") else
+                        "full" if "delta_cycle" in stats else None),
+            upload_bytes=stats.get("upload_bytes"),
+            upload_bytes_full=stats.get("upload_bytes_full"),
+            dirty_jobs=self._last_dirty[0], dirty_nodes=self._last_dirty[1],
+            stats={k: round(float(v), 3) for k, v in stats.items()},
             telemetry=ssn.last_telemetry or None)
         return ssn
+
+    def drain(self, now: Optional[float] = None):
+        """Retire the in-flight pipelined cycle, if any: readback, apply,
+        flush. Returns the completed cycle's record or None."""
+        return self._drain_pending(now if now is not None else time.time())
+
+    def wait_pending(self) -> bool:
+        """Block until the in-flight cycle's DEVICE work has finished,
+        without draining it (no readback, no apply — state unchanged).
+        In production the 1 s schedule period provides this wait for
+        free; bench and shutdown paths call it explicitly. Returns True
+        when something was in flight."""
+        if self._pending is None:
+            return False
+        import jax
+        jax.block_until_ready(self._pending[1].packed)
+        return True
 
     def run(self, cycles: int = 1, sleep: bool = False) -> List[Session]:
         out = []
@@ -226,3 +338,21 @@ class Scheduler:
             if sleep:
                 time.sleep(self.schedule_period)
         return out
+
+
+class CompletedCycle:
+    """Detached readout of a pipelined cycle, snapshotted at finish time —
+    the live Session is reopened (intents reset) before the next run_once
+    returns, so pipelined callers get this stable copy instead."""
+
+    __slots__ = ("binds", "evictions", "pipelined", "bind_errors",
+                 "phase_updates", "stats", "last_telemetry")
+
+    def __init__(self, ssn: Session):
+        self.binds = list(ssn.binds)
+        self.evictions = list(ssn.evictions)
+        self.pipelined = dict(ssn.pipelined)
+        self.bind_errors = list(ssn.bind_errors)
+        self.phase_updates = dict(ssn.phase_updates)
+        self.stats = dict(ssn.stats)
+        self.last_telemetry = dict(ssn.last_telemetry)

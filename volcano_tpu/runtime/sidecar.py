@@ -42,6 +42,12 @@ DECISION_MAGIC = 0x31444356  # "VCD1"
 REQUEST_MAGIC = 0x31524356   # "VCR1" — leads every request frame so a
 #                              version-skewed peer fails fast instead of
 #                              blocking on a misread length prefix
+PIPELINE_MAGIC = 0x50524356  # "VCRP" — one-deep pipelined round: the
+#                              response carries the PREVIOUS dispatched
+#                              snapshot's decisions (T=0, J=0 primes the
+#                              pipeline on the first round)
+DRAIN_MAGIC = 0x44524356     # "VCRD" — drain the pending pipelined cycle
+#                              (no snapshot payload)
 _u32 = struct.Struct("<I")
 
 
@@ -69,7 +75,8 @@ class SchedulerSidecar:
     """
 
     def __init__(self, cfg: Optional[AllocateConfig] = None,
-                 conf: Optional[str] = None):
+                 conf: Optional[str] = None,
+                 delta_uploads: Optional[bool] = None):
         import jax
         if cfg is not None and conf is not None:
             raise ValueError(
@@ -89,6 +96,41 @@ class SchedulerSidecar:
         #: ~tens of ms EACH over the axon tunnel, dominating the served
         #: cycle before compute even starts
         self._fused: Dict[tuple, tuple] = {}
+        import os
+        # device-resident delta path (ops/fused_io.DeltaKernel): the fused
+        # buffers stay on the TPU across served cycles; each request ships
+        # only the packed (indices, values) diff vs the mirror. Conf mode
+        # honors the policy's `delta_uploads:` key; env
+        # VOLCANO_SIDECAR_DELTA=0 and the constructor arg override.
+        if delta_uploads is None:
+            delta_uploads = os.environ.get("VOLCANO_SIDECAR_DELTA",
+                                           "1") != "0"
+            if conf is not None:
+                from ..framework.conf import parse_conf as _pc
+                delta_uploads = delta_uploads and _pc(conf).delta_uploads
+        self.delta_uploads = bool(delta_uploads)
+        #: shape signature -> DeltaKernel, plus per-kernel ResidentState —
+        #: the sidecar owns the returned (donated) buffers; nothing may
+        #: re-read a handle after a cycle consumed it (graphcheck donation
+        #: family). Serialized by _serve_lock: resident buffers are
+        #: process state, so concurrent connections must not interleave
+        #: delta cycles.
+        self._delta: Dict[tuple, object] = {}
+        self._states: Dict[int, object] = {}
+        self._serve_lock = threading.Lock()
+        #: the one-deep pipelined serving slot (VCRP rounds): the
+        #: dispatched-but-unread cycle whose decisions the NEXT round's
+        #: response carries. Bounded depth 1 by construction — the slot is
+        #: drained before the next dispatch.
+        self._pending: Optional[dict] = None
+        # opt-in persistent compilation cache ($VOLCANO_JAX_CACHE_DIR or
+        # the conf's compilation_cache_dir): restarts stop paying compile_s
+        from ..framework.compile_cache import enable_compilation_cache
+        cache_dir = None
+        if conf is not None:
+            from ..framework.conf import parse_conf as _pc2
+            cache_dir = _pc2(conf).compilation_cache_dir
+        enable_compilation_cache(cache_dir)
         #: bounded ring of the last N served cycles (host timestamps,
         #: buffer sizes, cycle latency, in-graph telemetry when the conf
         #: enables it) — the sidecar half of the flight recorder
@@ -102,14 +144,8 @@ class SchedulerSidecar:
         else:
             self._conf_telemetry = bool(self.cfg.telemetry)
 
-    def schedule_buffer(self, buf: bytes, extras_buf: bytes = b"") -> bytes:
-        """VCS4 snapshot buffer (+ optional VCX1 extras frame) -> VCD1
-        decision payload. Every served cycle lands one snapshot in the
-        flight-recorder ring (telemetry included when the conf enables
-        it); the wire response stays the fixed-layout decision prefix, so
-        version-skewed clients are unaffected."""
-        import time as _time
-        t_start = _time.time()
+    def _build_tree(self, buf: bytes, extras_buf: bytes):
+        """Wire buffers -> the cycle's argument tree + (snap, T, J)."""
         from ..native import available, pack_wire
         if available():
             snap = pack_wire(buf)
@@ -139,22 +175,32 @@ class SchedulerSidecar:
             tree_in = (snap, second, base)
         else:
             tree_in = (snap, base)
+        return tree_in, snap, T, J
+
+    def _dispatch_cycle(self, tree_in):
+        """Dispatch the compiled cycle over the fused tree WITHOUT reading
+        the decisions back, taking the device-resident delta path when
+        enabled. Returns (packed device handle, "delta"|"full"|None,
+        upload bytes|None). Caller holds _serve_lock."""
+        if self.delta_uploads:
+            from ..ops.fused_io import ResidentState, delta_cycle_cached
+            kernel = delta_cycle_cached(self._cycle, tree_in, self._delta)
+            state = self._states.get(id(kernel))
+            if state is None:
+                state = self._states[id(kernel)] = ResidentState()
+            packed = kernel.run(state, tree_in)
+            return packed, state.last_kind, state.last_upload_bytes
         from ..ops.fused_io import fused_cycle_cached
         fn, fuse = fused_cycle_cached(self._cycle, tree_in, self._fused)
-        packed = np.asarray(fn(*fuse(tree_in)), dtype=np.int32)
-        tel = None
-        if self._conf_telemetry and packed.shape[0] > 3 * T + 2 * J:
-            # conf cycles pack job_attempted too (3T+3J prefix); the
-            # telemetry tail follows it
-            base = 3 * T + 3 * J
-            if packed.shape[0] > base:
-                from ..telemetry import unpack_cycle_telemetry
-                R = int(np.asarray(snap.nodes.idle).shape[1])
-                tel = unpack_cycle_telemetry(packed[base:], R)
-        self.flight.record(
-            buffer_bytes=len(buf) + len(extras_buf), tasks=T, jobs=J,
-            cycle_ms=round((_time.time() - t_start) * 1000, 3),
-            telemetry=tel)
+        return fn(*fuse(tree_in)), None, None
+
+    def _run_cycle(self, tree_in):
+        """_dispatch_cycle + synchronous readback (the VCR1 path)."""
+        packed, kind, upload = self._dispatch_cycle(tree_in)
+        return np.asarray(packed, dtype=np.int32), kind, upload
+
+    @staticmethod
+    def _decisions_payload(packed: np.ndarray, T: int, J: int) -> bytes:
         task_node = packed[:T]
         task_mode = packed[T:2 * T]
         task_gpu = packed[2 * T:3 * T]
@@ -168,6 +214,132 @@ class SchedulerSidecar:
             job_ready.tobytes(), job_pipelined.tobytes(),
         ])
 
+    def warmup(self, buf: bytes, extras_buf: bytes = b"") -> None:
+        """AOT warmup hook: compile the served cycle for this wire
+        snapshot's shape bucket WITHOUT serving a decision round. With the
+        persistent compilation cache enabled a restarted sidecar answers
+        its first request at steady-state latency."""
+        tree_in, _snap, _T, _J = self._build_tree(buf, extras_buf)
+        with self._serve_lock:
+            if self.delta_uploads:
+                from ..ops.fused_io import delta_cycle_cached
+                delta_cycle_cached(self._cycle, tree_in, self._delta).warm()
+            else:
+                from ..ops.fused_io import (_TARGETS, fuse_spec,
+                                            fused_cycle_cached, group_sizes)
+                import jax
+                fn, _fz = fused_cycle_cached(self._cycle, tree_in,
+                                             self._fused)
+                _td, spec = fuse_spec(tree_in)
+                avals = tuple(jax.ShapeDtypeStruct((n,), _TARGETS[g])
+                              for g, n in zip(("f", "i", "b"),
+                                              group_sizes(spec)))
+                fn.lower(*avals).compile()
+
+    def schedule_buffer(self, buf: bytes, extras_buf: bytes = b"") -> bytes:
+        """VCS4 snapshot buffer (+ optional VCX1 extras frame) -> VCD1
+        decision payload. Every served cycle lands one snapshot in the
+        flight-recorder ring (telemetry included when the conf enables
+        it); the wire response stays the fixed-layout decision prefix, so
+        version-skewed clients are unaffected."""
+        payload, finish = self.schedule_buffer_deferred(buf, extras_buf)
+        finish()
+        return payload
+
+    def schedule_buffer_deferred(self, buf: bytes, extras_buf: bytes = b""):
+        """Like :meth:`schedule_buffer`, but returns ``(payload, finish)``
+        so the server handler can SEND the decisions first and run
+        ``finish()`` — the flight-recorder append and telemetry-tail decode
+        — off the response critical path. ``finish`` must be called exactly
+        once per served round."""
+        import time as _time
+        t_start = _time.time()
+        tree_in, snap, T, J = self._build_tree(buf, extras_buf)
+        with self._serve_lock:
+            self._drain_locked()        # a VCRP round must not be orphaned
+            packed, cycle_kind, upload_bytes = self._run_cycle(tree_in)
+        cycle_ms = round((_time.time() - t_start) * 1000, 3)
+        payload = self._decisions_payload(packed, T, J)
+
+        def finish():
+            tel = None
+            if self._conf_telemetry and packed.shape[0] > 3 * T + 2 * J:
+                # conf cycles pack job_attempted too (3T+3J prefix); the
+                # telemetry tail follows it
+                tail = 3 * T + 3 * J
+                if packed.shape[0] > tail:
+                    from ..telemetry import unpack_cycle_telemetry
+                    R = int(np.asarray(snap.nodes.idle).shape[1])
+                    tel = unpack_cycle_telemetry(packed[tail:], R)
+            self.flight.record(
+                buffer_bytes=len(buf) + len(extras_buf), tasks=T, jobs=J,
+                cycle_ms=cycle_ms, cycle_kind=cycle_kind,
+                upload_bytes=upload_bytes, telemetry=tel)
+
+        return payload, finish
+
+    # ------------------------------------------- one-deep pipelined serving
+    def _drain_locked(self) -> Optional[bytes]:
+        """Read back and payload the pending VCRP cycle (caller holds
+        _serve_lock). Returns None when nothing is pending."""
+        pending = self._pending
+        if pending is None:
+            return None
+        self._pending = None
+        import time as _time
+        packed = np.asarray(pending["packed"], dtype=np.int32)
+        payload = self._decisions_payload(packed, pending["T"],
+                                          pending["J"])
+        self.flight.record(
+            buffer_bytes=pending["buffer_bytes"], tasks=pending["T"],
+            jobs=pending["J"], pipelined_round=True,
+            cycle_ms=round((_time.time() - pending["t0"]) * 1000, 3),
+            cycle_kind=pending["kind"], upload_bytes=pending["upload"])
+        return payload
+
+    def schedule_buffer_pipelined(self, buf: bytes,
+                                  extras_buf: bytes = b"") -> bytes:
+        """One-deep pipelined round (VCRP): dispatch THIS snapshot's cycle
+        and return the PREVIOUS dispatched snapshot's decisions — the
+        sidecar half of the cycle pipeline. The first round primes the
+        pipeline and returns an empty VCD1 payload (T=0, J=0); call
+        :meth:`drain_pending` (VCRD) to retire the final in-flight cycle.
+        The caller therefore runs exactly one cycle behind, which is the
+        same contract as the pipelined scheduler loop: a round's decisions
+        are always handed back (and applied by the API layer) before the
+        resident buffers can be overwritten by the round after it."""
+        import time as _time
+        tree_in, _snap, T, J = self._build_tree(buf, extras_buf)
+        with self._serve_lock:
+            prev_payload = self._drain_locked()
+            packed, kind, upload = self._dispatch_cycle(tree_in)
+            self._pending = dict(packed=packed, T=T, J=J, kind=kind,
+                                 upload=upload, t0=_time.time(),
+                                 buffer_bytes=len(buf) + len(extras_buf))
+        if prev_payload is None:
+            # priming round: an explicit empty decision payload
+            prev_payload = self._decisions_payload(
+                np.zeros(0, np.int32), 0, 0)
+        return prev_payload
+
+    def drain_pending(self) -> Optional[bytes]:
+        """Retire the in-flight pipelined cycle (VCRD). Returns its VCD1
+        payload, or None when the pipeline is empty."""
+        with self._serve_lock:
+            return self._drain_locked()
+
+    def wait_idle(self) -> bool:
+        """Block until the in-flight pipelined cycle's device work is done
+        WITHOUT draining it. Production serving gets this wait for free
+        from the API layer's schedule period; bench calls it explicitly so
+        the measured round isolates the serving path from raw compute."""
+        pending = self._pending
+        if pending is None:
+            return False
+        import jax
+        jax.block_until_ready(pending["packed"])
+        return True
+
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
@@ -176,7 +348,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 (magic,) = _u32.unpack(_recv_exact(self.request, 4))
             except ConnectionError:
                 return
-            if magic != REQUEST_MAGIC:
+            if magic == DRAIN_MAGIC:
+                # drain-only round: retire the pending pipelined cycle
+                payload = self.server.sidecar.drain_pending()
+                if payload is None:
+                    _send_frame(self.request, 1, b"pipeline empty")
+                else:
+                    _send_frame(self.request, 0, payload)
+                continue
+            if magic not in (REQUEST_MAGIC, PIPELINE_MAGIC):
                 # old/foreign framing: reply with an error and drop the
                 # connection rather than misreading lengths and hanging
                 _send_frame(self.request, 1,
@@ -187,8 +367,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 (nx,) = _u32.unpack(_recv_exact(self.request, 4))
                 buf = _recv_exact(self.request, n)
                 extras = _recv_exact(self.request, nx) if nx else b""
-                payload = self.server.sidecar.schedule_buffer(buf, extras)
+                if magic == PIPELINE_MAGIC:
+                    payload = self.server.sidecar \
+                        .schedule_buffer_pipelined(buf, extras)
+                    _send_frame(self.request, 0, payload)
+                    continue
+                # send the decisions first; the flight-recorder append and
+                # telemetry decode run after the client is unblocked
+                payload, finish = self.server.sidecar \
+                    .schedule_buffer_deferred(buf, extras)
                 _send_frame(self.request, 0, payload)
+                finish()
             except ConnectionError:
                 return
             except Exception as e:  # report, keep serving
@@ -229,22 +418,25 @@ class SidecarClient:
         from ..framework.conf import parse_conf
         self.conf = (parse_conf(conf) if isinstance(conf, str) else conf)
         self.sock = socket.create_connection((host, port), timeout=timeout)
+        #: uid maps of the snapshot whose decisions the NEXT pipelined
+        #: response will carry (the client-side half of the one-deep
+        #: pipeline: decisions arrive one round late, so they decode with
+        #: the maps of the round that produced them)
+        self._pipeline_maps = None
 
     def close(self) -> None:
         self.sock.close()
 
-    def schedule(self, ci) -> Dict[str, object]:
-        from ..native.wire import serialize, serialize_extras
-        buf, maps = serialize(ci)
-        extras = (serialize_extras(ci, maps, self.conf)
-                  if self.conf is not None else b"")
-        self.sock.sendall(_u32.pack(REQUEST_MAGIC) + _u32.pack(len(buf))
-                          + _u32.pack(len(extras)) + buf + extras)
+    def _recv_payload(self) -> bytes:
         (status,) = _u32.unpack(_recv_exact(self.sock, 4))
         (n,) = _u32.unpack(_recv_exact(self.sock, 4))
         payload = _recv_exact(self.sock, n)
         if status != 0:
             raise RuntimeError(f"sidecar error: {payload.decode()}")
+        return payload
+
+    @staticmethod
+    def _decode(payload: bytes, maps) -> Dict[str, object]:
         (magic,) = _u32.unpack(payload[:4])
         if magic != DECISION_MAGIC:
             raise ValueError("bad decision magic")
@@ -267,6 +459,41 @@ class SidecarClient:
             "task_gpu": task_gpu, "job_ready": job_ready,
             "job_pipelined": job_pipelined, "maps": maps,
         }
+
+    def _send_snapshot(self, ci, magic: int):
+        from ..native.wire import serialize, serialize_extras
+        buf, maps = serialize(ci)
+        extras = (serialize_extras(ci, maps, self.conf)
+                  if self.conf is not None else b"")
+        self.sock.sendall(_u32.pack(magic) + _u32.pack(len(buf))
+                          + _u32.pack(len(extras)) + buf + extras)
+        return maps
+
+    def schedule(self, ci) -> Dict[str, object]:
+        maps = self._send_snapshot(ci, REQUEST_MAGIC)
+        return self._decode(self._recv_payload(), maps)
+
+    def schedule_pipelined(self, ci) -> Optional[Dict[str, object]]:
+        """One-deep pipelined round (VCRP): ship this snapshot, receive
+        the PREVIOUS round's decisions (decoded with the maps of the round
+        that produced them). Returns None on the priming round; finish a
+        stream with :meth:`drain_pipelined`."""
+        maps = self._send_snapshot(ci, PIPELINE_MAGIC)
+        payload = self._recv_payload()
+        prev_maps, self._pipeline_maps = self._pipeline_maps, maps
+        T, J = struct.unpack("<II", payload[4:12])
+        if prev_maps is None or (T == 0 and J == 0):
+            return None
+        return self._decode(payload, prev_maps)
+
+    def drain_pipelined(self) -> Optional[Dict[str, object]]:
+        """Retire the in-flight pipelined round (VCRD)."""
+        if self._pipeline_maps is None:
+            return None
+        self.sock.sendall(_u32.pack(DRAIN_MAGIC))
+        payload = self._recv_payload()
+        maps, self._pipeline_maps = self._pipeline_maps, None
+        return self._decode(payload, maps)
 
 
 def main(argv=None) -> int:

@@ -70,6 +70,10 @@ def counted_jit(fn: Callable, entry: str, **jit_kwargs) -> Callable:
 
     wrapper.__wrapped__ = fn
     wrapper.__name__ = getattr(fn, "__name__", entry)
+    # AOT surface (jitted.lower(...).compile()): the warmup hooks compile
+    # an entry ahead of the first cycle so a restart stops paying the
+    # trace+compile inline (counts as a trace — the body runs)
+    wrapper.lower = jitted.lower
     return wrapper
 
 
