@@ -822,6 +822,37 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             telemetry_block = None
 
+    # ---- fault-tolerance robustness block (volcano_tpu/chaos) ------------
+    # Every BENCH record carries a fail-soft chaos probe: a seeded fault
+    # storm (every recoverable kind) over a small multi-cycle pipelined
+    # scheduler run, verified against the identical no-fault run. The
+    # block records how many cycles recovered, how fast, how far down the
+    # degradation ladder the loop went, and — the actual claim — whether
+    # the post-recovery decision sha still equals the clean run's.
+    # BENCH_SKIP_CHAOS=1 skips; a probe failure records null, never kills
+    # the bench.
+    robustness_block = None
+    if not os.environ.get("BENCH_SKIP_CHAOS"):
+        try:
+            from volcano_tpu.chaos import run_chaos_probe
+            rpt = run_chaos_probe(seed=int(os.environ.get("BENCH_CHAOS_SEED",
+                                                          7)),
+                                  cycles=6)
+            robustness_block = {
+                "decisions_equal_clean": rpt["decisions_equal_clean"],
+                "faults_fired": rpt["faults_fired"],
+                "fault_schedule_sha": rpt["fault_schedule_sha"],
+                "recovered_cycles": rpt["recovered_cycles"],
+                "recovery_ms_p50": rpt["recovery_ms_p50"],
+                "degradation_max": rpt["degradation_max"],
+                "digest_mismatches": rpt["digest_mismatches"],
+                "resync_dead_letter": rpt["resync_dead_letter"],
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: robustness block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            robustness_block = None
+
     # ---- graphcheck static-analysis status (volcano_tpu/analysis) --------
     # The perf trajectory carries the static-analysis state alongside the
     # decision fingerprints: a record with graphcheck_clean=false (or
@@ -858,6 +889,7 @@ tiers:
         "graphcheck_clean": graphcheck_clean,
         "graphcheck_sha256": graphcheck_sha,
         "telemetry": telemetry_block,
+        "robustness": robustness_block,
     }
     if force_cpu:
         out["tpu_unavailable"] = True

@@ -28,7 +28,18 @@ if [ "${TIER1_SKIP_GRAPHCHECK:-0}" != "1" ]; then
     # report + stable exit code without parsing pytest output
     bash scripts/graphcheck.sh --fast || grc=$?
 fi
+crc=0
+if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
+    # fast chaos smoke (volcano_tpu/chaos): a seeded storm of every
+    # recoverable fault kind over a multi-cycle pipelined run, verified
+    # decision-sha-identical to the clean run, with the planted
+    # resident-state corruption provably tripping the integrity digest
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke || crc=$?
+fi
 if [ $rc -ne 0 ]; then
     exit $rc
 fi
-exit $grc
+if [ $grc -ne 0 ]; then
+    exit $grc
+fi
+exit $crc

@@ -1,0 +1,494 @@
+"""Fault injection + recovery (ISSUE 5 acceptance).
+
+- FaultPlan determinism: same seed -> same schedule sha, same fired-fault
+  log, same post-recovery decision sha across two runs (scan AND
+  pallas-interpret cycle paths).
+- Recoverable-fault sha matrix: under every recoverable fault kind a
+  multi-cycle Scheduler run completes and its decision sha equals the
+  no-fault run's — including pipelined mode and the sidecar
+  socket-drop-and-reconnect path.
+- A planted resident-state corruption provably trips the integrity
+  digest, triggers the full re-fuse recovery, and the recovery is visible
+  in last_telemetry, METRICS and the flight-recorder ring.
+- ResyncQueue dead-letters attempts-exhausted intents instead of
+  dropping them silently; the leader elector survives a stolen lease.
+"""
+
+import hashlib
+import struct
+
+import numpy as np
+import pytest
+
+from volcano_tpu.chaos import (FAULT_KINDS, ChaosError, FaultInjector,
+                               FaultPlan, chaos)
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.metrics import METRICS
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.scheduler import ResyncQueue, Scheduler
+
+from fixtures import build_job, build_task, simple_cluster
+from test_delta_pipeline import PARITY_CONF, decisions_sha, digest
+from test_runtime_incremental import build_cluster, churn
+
+
+def run_chaos_sched(plan=None, cycles=6, pipeline=True, conf=PARITY_CONF,
+                    deadline_ms=None, slow_s=0.3, cluster_mutator=None):
+    """Drive a Scheduler over the incremental-suite cluster + churn with
+    an optional fault plan; per-cycle drain so every cycle's record is
+    digested. Returns (sha, scheduler, injector)."""
+    cluster = FakeCluster(build_cluster(n_nodes=8, n_jobs=10))
+    sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
+    if deadline_ms is not None:
+        sched.cycle_deadline_s = deadline_ms / 1000.0
+    injector = FaultInjector(plan, slow_s=slow_s) if plan else None
+    digests = []
+    import contextlib
+    ctx = chaos(injector) if injector else contextlib.nullcontext()
+    with ctx:
+        for c in range(cycles):
+            out = sched.run_once(now=1000.0 + c)
+            rec = (sched.drain(now=1000.0 + c) or out) if pipeline else out
+            digests.append(digest(rec))
+            if cluster_mutator is not None:
+                cluster_mutator(cluster, c)
+            churn(cluster, c, arrivals=True)
+    return decisions_sha(digests), sched, injector
+
+
+class TestFaultPlan:
+    def test_same_seed_same_schedule(self):
+        a = FaultPlan(seed=42, cycles=8)
+        b = FaultPlan(seed=42, cycles=8)
+        assert a.schedule_sha() == b.schedule_sha()
+        assert a.faults == b.faults
+
+    def test_different_seed_different_schedule(self):
+        shas = {FaultPlan(seed=s, cycles=8).schedule_sha()
+                for s in range(5)}
+        assert len(shas) > 1
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kinds"):
+            FaultPlan(seed=0, kinds=("meteor_strike",))
+
+    def test_all_kinds_covered(self):
+        plan = FaultPlan(seed=3, cycles=10)
+        assert {f.kind for f in plan.faults} == set(FAULT_KINDS)
+
+
+class TestRecoverableShaMatrix:
+    """Acceptance: under an injected fault of each recoverable kind, a
+    multi-cycle run completes and its final decision sha256 matches the
+    no-fault run (pipelined mode — the hardest: the fault hits an
+    in-flight cycle)."""
+
+    @pytest.fixture(scope="class")
+    def clean_sha(self):
+        sha, sched, _ = run_chaos_sched(None)
+        assert sched.degradation_level == 0
+        return sha
+
+    @pytest.mark.parametrize("kind", ["backend_loss", "resident_corrupt",
+                                      "mirror_drift", "bind_fail"])
+    def test_kind_is_decision_neutral(self, kind, clean_sha):
+        plan = FaultPlan(seed=11, cycles=6, kinds=(kind,))
+        sha, sched, inj = run_chaos_sched(plan)
+        assert [f[1] for f in inj.fired] == [kind], inj.fired
+        assert sha == clean_sha
+
+    def test_slow_dispatch_trips_watchdog_decision_neutral(self, clean_sha):
+        plan = FaultPlan(seed=11, cycles=6, kinds=("slow_dispatch",))
+        sha, sched, inj = run_chaos_sched(plan, deadline_ms=120,
+                                          slow_s=0.35)
+        assert ("slow_dispatch" in [f[1] for f in inj.fired])
+        assert sha == clean_sha
+        # the watchdog retired the slow cycle and degraded to sync
+        assert any(f["stage"].startswith("deadline")
+                   for e in sched.flight.snapshots()
+                   for f in (e.get("faults") or []))
+
+    def test_sync_loop_recovers_too(self, clean_sha):
+        sync_clean, _, _ = run_chaos_sched(None, pipeline=False)
+        plan = FaultPlan(seed=5, cycles=6, kinds=("backend_loss",
+                                                  "resident_corrupt"))
+        sha, _, inj = run_chaos_sched(plan, pipeline=False)
+        assert len(inj.fired) == 2
+        assert sha == sync_clean == clean_sha
+
+
+class TestChaosDeterminism:
+    def test_two_runs_same_seed_identical(self):
+        """Same FaultPlan seed -> same fault schedule, same fired log,
+        same post-recovery decision sha (scan path)."""
+        plan_kinds = ("backend_loss", "resident_corrupt", "mirror_drift")
+        out = []
+        for _ in range(2):
+            plan = FaultPlan(seed=23, cycles=5, kinds=plan_kinds)
+            sha, _, inj = run_chaos_sched(plan, cycles=5)
+            out.append((plan.schedule_sha(), tuple(inj.fired), sha))
+        assert out[0] == out[1]
+
+    def test_pallas_interpret_path_deterministic(self):
+        """The same determinism contract on the pallas-interpret cycle
+        path, driven at the DeltaKernel level (the seam the faults
+        actually hook)."""
+        from volcano_tpu.arrays import pack
+        from volcano_tpu.ops import AllocateConfig, make_allocate_cycle
+        from volcano_tpu.ops.allocate_scan import AllocateExtras
+        from volcano_tpu.ops.fused_io import DeltaKernel, ResidentState
+
+        ci = simple_cluster(n_nodes=4, node_cpu="8", node_mem="16Gi")
+        for j in range(4):
+            job = build_job(f"default/j{j}", min_available=2)
+            for t in range(2):
+                job.add_task(build_task(f"j{j}-t{t}", cpu="2",
+                                        memory="2Gi"))
+            ci.add_job(job)
+        snap, _maps = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        cfg = AllocateConfig(binpack_weight=1.0, use_pallas="interpret",
+                             enable_gpu=False)
+        kern = DeltaKernel(make_allocate_cycle(cfg), (snap, extras))
+
+        def one_run():
+            state = ResidentState()
+            plan = FaultPlan(seed=9, cycles=4,
+                             kinds=("resident_corrupt",))
+            inj = FaultInjector(plan)
+            decs = []
+            prio = np.asarray(snap.tasks.priority)
+            with chaos(inj):
+                for c in range(4):
+                    inj.begin_cycle(c)
+                    packed = np.asarray(kern.run(state, (snap, extras)))
+                    dec, dev_dig = kern.split_digest(packed)
+                    if not np.array_equal(dev_dig,
+                                          kern.mirror_digest(state)):
+                        dec, _ = kern.split_digest(np.asarray(
+                            kern.recover(state, (snap, extras))))
+                    decs.append(dec.tobytes())
+                    prio[c % prio.size] += 1    # steady churn
+            prio[:4] -= 1                        # restore shared snapshot
+            return (hashlib.sha256(b"".join(decs)).hexdigest(),
+                    tuple(inj.fired))
+
+        a, b = one_run(), one_run()
+        assert a == b
+        assert any(k == "resident_corrupt" for _, k, _p in a[1])
+
+
+class TestIntegrityDigest:
+    def test_planted_mirror_corruption_trips_digest_and_recovers(self):
+        """Acceptance: a planted corruption provably trips the in-graph
+        digest, triggers full re-fuse, keeps decisions identical, and the
+        recovery is visible in last_telemetry + METRICS."""
+        from volcano_tpu.framework.session import Session
+        ci = build_cluster(n_nodes=6, n_jobs=6)
+        ssn = Session(ci.clone(), PARITY_CONF)
+        ref = ssn.run_allocate()
+        ref_binds = sorted((b.task_uid, b.node_name) for b in ssn.binds)
+
+        ssn2 = Session(ci.clone(), PARITY_CONF)
+        before = METRICS.counter_value("resident_digest_mismatch_total")
+        pending = ssn2.dispatch_allocate()
+        assert pending.state is not None and pending.state.mirror is not None
+        # the mirror drifts from device truth after dispatch (a bit-level
+        # flip: value-level nudges can vanish in f32 precision)
+        pending.state.mirror[0].view(np.uint32)[3] ^= np.uint32(0x5A5A5A5A)
+        result = ssn2.complete_allocate(pending)
+        assert METRICS.counter_value(
+            "resident_digest_mismatch_total") == before + 1
+        integ = ssn2.last_telemetry["integrity"]
+        assert integ["reason"] == "digest" and integ["mode"] == "refuse"
+        assert ssn2.stats["recovery_ms"] >= 0
+        assert sorted((b.task_uid, b.node_name)
+                      for b in ssn2.binds) == ref_binds
+        np.testing.assert_array_equal(np.asarray(result.task_node),
+                                      np.asarray(ref.task_node))
+
+    def test_recovery_visible_in_flight_recorder(self):
+        plan = FaultPlan(seed=11, cycles=6, kinds=("mirror_drift",))
+        _sha, sched, inj = run_chaos_sched(plan)
+        assert inj.fired
+        flights = sched.flight.snapshots()
+        rec = [e for e in flights
+               if any(f["stage"].startswith("integrity")
+                      for f in (e.get("faults") or []))]
+        assert rec, [e.get("faults") for e in flights]
+        assert "recovery_ms" in rec[0]["stats"]
+        assert rec[0]["telemetry"]["integrity"]["reason"] == "digest"
+        assert rec[0]["degradation"] >= 1
+
+
+class TestDegradationLadder:
+    def test_dispatch_fault_degrades_to_sync_then_recovers(self):
+        """A recovered dispatch fault suspends pipelining for the cooldown
+        window, then the ladder climbs back to 0."""
+        plan = FaultPlan(seed=11, cycles=3, kinds=("backend_loss",))
+        _sha, sched, inj = run_chaos_sched(plan, cycles=10)
+        assert len(inj.fired) == 1
+        degr = [e.get("degradation", 0) for e in sched.flight.snapshots()]
+        assert max(degr) >= 1                   # the ladder engaged...
+        assert degr[-1] == 0                    # ...and de-escalated
+        assert sched.degradation_level == 0
+
+    def test_oracle_rung_decisions_match(self):
+        """Force the cpu-oracle rung: with BOTH faults scheduled at cycle
+        1 (cycles=2 pins randrange(1, 2) == 1), the pipelined dispatch
+        fails AND the sync retry fails, leaving only the pure-host CPU
+        oracle — whose decisions must still match the clean run."""
+        clean_sha, _, _ = run_chaos_sched(None, cycles=4)
+        plan = FaultPlan(seed=2, cycles=2, kinds=("backend_loss",),
+                         per_kind=2)
+        assert [f.cycle for f in plan.faults] == [1, 1]
+        sha, sched, inj = run_chaos_sched(plan, cycles=4)
+        assert sha == clean_sha
+        assert len(inj.fired) == 2
+        assert METRICS.counter_value(
+            "cycle_recoveries_total",
+            labels={"reason": "dispatch", "mode": "cpu_oracle"}) >= 1
+        assert 2 in [e.get("degradation", 0)
+                     for e in sched.flight.snapshots()]
+
+
+class TestResyncDeadLetter:
+    class _AlwaysFails:
+        def bind(self, intent):
+            return False
+
+        def evict(self, intent):
+            return False
+
+        def resync_task(self, uid):
+            self.resynced = uid
+
+    def test_exhausted_attempts_dead_letter_not_dropped(self):
+        from volcano_tpu.framework.session import BindIntent
+        q = ResyncQueue(base_delay=0.001, max_delay=0.001, max_attempts=3)
+        cluster = self._AlwaysFails()
+        q.add(BindIntent("default/t0", "default/j0", "n0"), "bind", now=0.0)
+        now, dead = 0.0, 0
+        for _ in range(6):
+            now += 1.0
+            stats = q.process(cluster, now)
+            dead += stats["dead_lettered"]
+        assert dead == 1
+        assert len(q) == 0
+        letters = q.dead_letter()
+        assert len(letters) == 1
+        assert letters[0]["intent"].task_uid == "default/t0"
+        assert letters[0]["attempts"] == 3
+        assert letters[0]["gave_up_at"] == now or letters[0]["gave_up_at"] > 0
+        assert cluster.resynced == "default/t0"
+
+    def test_scheduler_surfaces_dead_letter(self):
+        cluster = FakeCluster(build_cluster(n_nodes=4, n_jobs=4))
+        sched = Scheduler(cluster, conf=PARITY_CONF, pipeline=False)
+        sched.resync = ResyncQueue(base_delay=0.001, max_delay=0.001,
+                                   max_attempts=2)
+        # every bind of one task permanently rejected by the API
+        ssn = sched.run_once(now=1000.0)
+        assert ssn.binds
+        uid = ssn.binds[0].task_uid
+        # re-decide with a permanent failure injected
+        cluster2 = FakeCluster(build_cluster(n_nodes=4, n_jobs=4))
+        cluster2.bind_failures[uid] = "permanent"
+        sched2 = Scheduler(cluster2, conf=PARITY_CONF, pipeline=False)
+        sched2.resync = ResyncQueue(base_delay=0.001, max_delay=0.001,
+                                    max_attempts=2)
+        before = METRICS.counter_value("resync_dead_letter_total")
+        for c in range(4):
+            sched2.run_once(now=2000.0 + c)
+        assert len(sched2.resync.dead_letter()) >= 1
+        assert METRICS.counter_value("resync_dead_letter_total") > before
+        assert sched2.flight.snapshots()[-1]["resync_dead_letter"] >= 1
+
+
+from volcano_tpu import native
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason=f"native packer unavailable: "
+                           f"{native.build_error()}")
+class TestSidecarFaults:
+    """Acceptance: the sidecar socket-drop-and-reconnect path keeps the
+    one-deep pipelined stream bit-identical to the sync responses shifted
+    by one — a dropped response is replayed from the server's idempotency
+    cache, a partial frame is resent whole, and neither double-applies a
+    cycle."""
+
+    def _cluster(self, k: int):
+        from volcano_tpu.api import TaskStatus
+        ci = simple_cluster(n_nodes=3)
+        for j in range(3):
+            job = build_job(f"default/j{j}", min_available=2)
+            for t in range(2):
+                job.add_task(build_task(f"j{j}-t{t}", cpu="1",
+                                        memory="1Gi"))
+            ci.add_job(job)
+        names = sorted(ci.nodes)
+        bound = 0
+        for job in ci.jobs.values():
+            for task in job.tasks.values():
+                if bound >= k:
+                    break
+                job.update_task_status(task, TaskStatus.RUNNING)
+                task.node_name = names[bound % len(names)]
+                ci.nodes[task.node_name].add_task(task)
+                bound += 1
+        return ci
+
+    def _fast_backoff(self):
+        from volcano_tpu.runtime.backoff import Backoff
+        return Backoff(base=0.01, cap=0.05, attempts=5, jitter=0.0, seed=0)
+
+    # partial_frame exercises the same reconnect-and-resend machinery from
+    # the send side; socket_drop (the replay-cache path) stays tier-1, the
+    # send-side variant rides the slow tail for the budget
+    @pytest.mark.parametrize(
+        "kind", ["socket_drop",
+                 pytest.param("partial_frame", marks=pytest.mark.slow)])
+    def test_drop_and_reconnect_stream_intact(self, kind):
+        from volcano_tpu.runtime.sidecar import SidecarClient, SidecarServer
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            cis = [self._cluster(k) for k in range(4)]
+            sync_client = SidecarClient(*server.address)
+            sync_outs = [sync_client.schedule(ci) for ci in cis]
+            sync_client.close()
+
+            reconnects0 = METRICS.counter_value("sidecar_reconnects_total")
+            pipe = SidecarClient(*server.address,
+                                 backoff=self._fast_backoff(),
+                                 call_timeout=10.0)
+            plan = FaultPlan(seed=1, cycles=6, kinds=(kind,))
+            with chaos(FaultInjector(plan)) as inj:
+                assert pipe.schedule_pipelined(cis[0]) is None   # prime
+                pipe_outs = [pipe.schedule_pipelined(ci)
+                             for ci in cis[1:]]
+                pipe_outs.append(pipe.drain_pipelined())
+                assert inj.fired and inj.fired[0][1] == kind
+            for k, (s, p) in enumerate(zip(sync_outs, pipe_outs)):
+                np.testing.assert_array_equal(s["task_node"],
+                                              p["task_node"], f"round {k}")
+                assert s["binds"] == p["binds"], f"round {k}"
+            assert METRICS.counter_value(
+                "sidecar_reconnects_total") > reconnects0
+            pipe.close()
+        finally:
+            server.shutdown()
+
+    def test_seq_replay_serves_cache_without_redispatch(self):
+        from volcano_tpu.native.wire import serialize
+        from volcano_tpu.ops.allocate_scan import AllocateConfig
+        from volcano_tpu.runtime.sidecar import SchedulerSidecar
+        sidecar = SchedulerSidecar(AllocateConfig(binpack_weight=1.0))
+        buf, _maps = serialize(self._cluster(0))
+        st1, p1 = sidecar.schedule_buffer_seq(5, 1, buf)      # prime
+        assert st1 == 0
+        replays0 = METRICS.counter_value("sidecar_replayed_rounds_total")
+        st1b, p1b = sidecar.schedule_buffer_seq(5, 1, buf)    # replay
+        assert (st1b, p1b) == (st1, p1)
+        assert METRICS.counter_value(
+            "sidecar_replayed_rounds_total") == replays0 + 1
+        assert sidecar._pending is not None       # pipeline untouched
+        st2, p2 = sidecar.schedule_buffer_seq(5, 2, buf)
+        assert st2 == 0 and len(p2) > len(p1)     # real decisions now
+        # a NEW epoch retires the stale stream's pending cycle first
+        st3, p3 = sidecar.schedule_buffer_seq(6, 1, buf)
+        assert st3 == 0
+        import struct as _struct
+        T, J = _struct.unpack("<II", p3[4:12])
+        assert (T, J) == (0, 0)                   # clean re-prime
+
+    def test_structured_error_frames(self):
+        import socket
+        from volcano_tpu.runtime.sidecar import (ERR_BAD_REQUEST,
+                                                 ERR_EMPTY_PIPELINE,
+                                                 ERROR_MAGIC, SidecarClient,
+                                                 SidecarError, SidecarServer)
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            # bad magic -> structured FATAL code, connection dropped
+            sock = socket.create_connection(server.address, timeout=30)
+            sock.sendall(struct.pack("<II", 0xDEAD, 0) + b"")
+            status, n = struct.unpack("<II", sock.recv(8))
+            payload = sock.recv(n)
+            assert status == 1
+            magic, code = struct.unpack("<II", payload[:8])
+            assert magic == ERROR_MAGIC and code == ERR_BAD_REQUEST
+            sock.close()
+            # empty-pipeline drain -> benign retryable code
+            client = SidecarClient(*server.address)
+            with pytest.raises(SidecarError) as ei:
+                client._roundtrip(struct.pack("<I", 0x44524356))  # VCRD
+            assert ei.value.code == ERR_EMPTY_PIPELINE
+            assert ei.value.retryable
+            client.close()
+        finally:
+            server.shutdown()
+
+    def test_connect_retries_through_backoff(self):
+        from volcano_tpu.runtime.backoff import Backoff
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("refused")
+            return "ok"
+
+        bo = Backoff(base=0.0, cap=0.0, attempts=5, jitter=0.0)
+        assert bo.call(flaky) == "ok"
+        assert len(calls) == 3
+        calls.clear()
+        with pytest.raises(OSError):
+            Backoff(base=0.0, cap=0.0, attempts=2, jitter=0.0).call(flaky)
+        assert len(calls) == 2
+
+    def test_per_call_timeout_distinct_from_connect(self):
+        from volcano_tpu.runtime.sidecar import SidecarClient, SidecarServer
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            client = SidecarClient(*server.address, timeout=60.0,
+                                   call_timeout=1.5)
+            assert client.sock.gettimeout() == 1.5
+            assert client.connect_timeout == 60.0
+            out = client.schedule(self._cluster(0))
+            assert out["binds"]
+            client.close()
+        finally:
+            server.shutdown()
+
+
+class TestLeaderChaos:
+    def test_lease_expiry_steps_down_then_reacquires(self):
+        from volcano_tpu.runtime.leader import LeaderElector
+        from volcano_tpu.runtime.system import VolcanoSystem
+
+        class Clock:
+            now = 100.0
+
+            def __call__(self):
+                return self.now
+
+        api = VolcanoSystem().api
+        clock = Clock()
+        el = LeaderElector(api, identity="s0", clock=clock)
+        assert el.tick() and el.is_leader
+        plan = FaultPlan(seed=1, cycles=2, kinds=("lease_expiry",))
+        inj = FaultInjector(plan)
+        with chaos(inj):
+            inj.begin_cycle(1)
+            clock.now += 1
+            assert not el.tick() and not el.is_leader   # rival stole it
+            assert inj.fired and inj.fired[0][1] == "lease_expiry"
+            # the rival never renews: after its lease expires we win back
+            clock.now += el.lease_duration + 1
+            assert el.tick() and el.is_leader
+        lease = api.get("leases", "volcano-system/vc-scheduler")
+        assert lease.holder == "s0"

@@ -125,12 +125,15 @@ class TestDeltaLoopParity:
             ("pipelined_delta", PARITY_CONF, True, True),
         ], cycles=6, with_node_churn=True)
 
+    @pytest.mark.slow
     def test_evictions_preempt_loop(self):
         """Preempt evictions through the delta+pipelined loop: eviction
         bookkeeping must round-trip exactly. The preempt conf does not end
         with allocate, so the pipelined scheduler transparently falls back
         to the synchronous path — decisions must be unaffected either
-        way."""
+        way. Slow-marked for the tier-1 budget (the 20 s preempt-conf
+        compile dominates); the sha-matrix coverage of the delta+pipelined
+        loop itself stays tier-1 in the two tests above."""
         base = build_cluster(n_nodes=4, n_jobs=6, tasks_per_job=4)
         shas = {}
         for label, incremental, pipeline in (

@@ -108,8 +108,17 @@ def assert_equal(dev, cpu, msg=""):
                                   cpu["job_pipelined"], err_msg=msg)
 
 
+#: tier-1 budget (same pattern as the K∈{2,4} batched-round rows and the
+#: hdrf rescaling replays): the oracle-equality fuzz REPLAYS beyond the
+#: first seed move to the `slow` tail — seed 0 stays in tier-1 (it is the
+#: seed asserted to actually preempt), the full suite runs all of them
+_FUZZ = pytest.mark.slow
+
+
 class TestPreemptOracle:
-    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+    @pytest.mark.parametrize(
+        "seed", [0] + [pytest.param(s, marks=_FUZZ) for s in (1, 2, 3, 4,
+                                                              5)])
     def test_preempt_decisions_equal(self, seed):
         rng = np.random.RandomState(seed)
         ci = random_cluster(rng)
@@ -120,7 +129,8 @@ class TestPreemptOracle:
         if seed == 0:
             assert np.asarray(dev.evicted).any()
 
-    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize(
+        "seed", [0] + [pytest.param(s, marks=_FUZZ) for s in (1, 2)])
     def test_preempt_with_drf_rule(self, seed):
         rng = np.random.RandomState(100 + seed)
         ci = random_cluster(rng)
@@ -130,7 +140,8 @@ class TestPreemptOracle:
         dev, cpu = run_both(ci, pcfg)
         assert_equal(dev, cpu, f"drf seed={seed}")
 
-    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize(
+        "seed", [0] + [pytest.param(s, marks=_FUZZ) for s in (1, 2)])
     def test_reclaim_decisions_equal(self, seed):
         rng = np.random.RandomState(200 + seed)
         ci = random_cluster(rng, reclaim=True)

@@ -1,0 +1,36 @@
+"""Fault injection + recovery verification for the cycle runtime (ISSUE 5).
+
+Three pieces:
+
+- :mod:`.plan` — :class:`FaultPlan`: a seed-deterministic schedule of
+  faults (kind, cycle, parameter). Same seed, same storm, every time.
+- :mod:`.inject` — :class:`FaultInjector` and the :func:`seam` hook the
+  runtime calls at its real failure seams (compiled dispatch, the
+  device-resident delta path, cluster bind/evict dispatch, sidecar
+  framing, the leader lease). Near-zero cost when no injector is
+  installed.
+- :mod:`.probe` — :func:`run_chaos_probe`: a fault storm over a
+  multi-cycle scheduler run compared against the clean run, shared by
+  the tier-1 smoke CLI (``python -m volcano_tpu.chaos --smoke``) and
+  bench.py's ``robustness`` block.
+
+The hardening the faults exercise lives where it belongs: the in-graph
+integrity digest and mirror-rebuild recovery in :mod:`..ops.fused_io`,
+the pipelined->sync->cpu-oracle degradation ladder in
+:mod:`..runtime.scheduler`, and the reconnect/idempotent-replay protocol
+in :mod:`..runtime.sidecar` — see docs/architecture.md "Fault tolerance
+& degradation ladder".
+"""
+
+from __future__ import annotations
+
+from .inject import (ChaosError, FaultInjector, active, chaos, install,
+                     seam, uninstall)
+from .plan import FAULT_KINDS, RECOVERABLE_KINDS, Fault, FaultPlan
+from .probe import run_chaos_probe
+
+__all__ = [
+    "FAULT_KINDS", "RECOVERABLE_KINDS", "Fault", "FaultPlan",
+    "FaultInjector", "ChaosError", "seam", "active", "install",
+    "uninstall", "chaos", "run_chaos_probe",
+]

@@ -1,0 +1,56 @@
+"""``python -m volcano_tpu.chaos --smoke`` — the tier-1 chaos smoke.
+
+Runs a small seeded fault storm (every recoverable fault kind once) over a
+multi-cycle pipelined scheduler run on the current backend and verifies:
+
+- the run COMPLETES (every fault recovered, the loop kept serving),
+- the decision sha equals the no-fault run's (recoverable faults are
+  decision-neutral),
+- the planted resident-state corruption tripped the integrity digest.
+
+Exit 0 on success, 1 on any violated claim, 2 on harness error. The JSON
+report prints either way so CI logs carry the evidence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="chaos smoke: seeded fault storm + recovery check")
+    parser.add_argument("--smoke", action="store_true",
+                        help="run the fast tier-1 smoke plan")
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--cycles", type=int, default=6)
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="per-cycle watchdog deadline (default: off — "
+                             "CI machines vary too much for a fixed one)")
+    args = parser.parse_args(argv)
+    from . import run_chaos_probe
+    try:
+        report = run_chaos_probe(seed=args.seed, cycles=args.cycles,
+                                 deadline_ms=args.deadline_ms)
+    except Exception as e:  # harness failure, not a chaos verdict
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    print(json.dumps(report, indent=2, default=str))
+    ok = (report["decisions_equal_clean"]
+          and report["faults_fired"] > 0
+          and report["digest_mismatches"] >= 1)
+    if not ok:
+        print("chaos smoke FAILED: "
+              + ("decision sha diverged from the clean run; "
+                 if not report["decisions_equal_clean"] else "")
+              + ("no faults fired; " if report["faults_fired"] == 0 else "")
+              + ("integrity digest never tripped"
+                 if report["digest_mismatches"] < 1 else ""),
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,254 @@
+"""Fault injector + the seam hook the runtime calls.
+
+The production modules expose their failure seams by calling
+:func:`seam` at the exact points a real deployment can break:
+
+======================  ====================================================
+seam point              caller
+======================  ====================================================
+``scheduler.cycle``     Scheduler.run_once (cycle start; arms this cycle's
+                        faults)
+``session.dispatch``    Session.dispatch_allocate, right before the
+                        compiled dispatch (backend loss / slow dispatch)
+``delta.run``           ops/fused_io.DeltaKernel.run, before any state is
+                        touched (resident-buffer corruption)
+``session.complete``    Session.complete_allocate, after the readback and
+                        before the integrity compare (mirror drift — a
+                        PRE-dispatch drift is invisible: the value diff
+                        self-heals it)
+``sidecar.complete``    SchedulerSidecar, same point on the served path
+``cluster.bind``        FakeCluster.bind (bind dispatch failure)
+``cluster.evict``       FakeCluster.evict (evict dispatch failure)
+``leader.tick``         runtime/leader.LeaderElector.tick (lease expiry)
+``sidecar.round``       SchedulerSidecar serving entry (arms faults per
+                        served round)
+``sidecar.dispatch``    SchedulerSidecar._dispatch_cycle (backend loss /
+                        slow dispatch on the served path)
+``sidecar.client_send`` SidecarClient, before sending a request frame
+                        (partial-frame injection)
+``sidecar.client_recv`` SidecarClient, before reading the response
+                        (socket drop after the request landed)
+======================  ====================================================
+
+With no injector installed every seam is a module-global ``None`` check —
+zero allocations, no imports, nothing measurable on the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .plan import Fault, FaultPlan
+
+
+class ChaosError(RuntimeError):
+    """An injected fault surfacing as an exception (e.g. backend loss)."""
+
+    def __init__(self, message: str, kind: str = "chaos"):
+        super().__init__(message)
+        self.kind = kind
+
+
+_ACTIVE: Optional["FaultInjector"] = None
+_LOCK = threading.Lock()
+
+
+def active() -> Optional["FaultInjector"]:
+    return _ACTIVE
+
+
+def seam(point: str, **ctx):
+    """The hook the runtime calls at each failure seam. No-op (one global
+    read) unless an injector is installed."""
+    inj = _ACTIVE
+    if inj is None:
+        return None
+    return inj.on(point, **ctx)
+
+
+def install(injector: "FaultInjector") -> "FaultInjector":
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+@contextlib.contextmanager
+def chaos(plan_or_injector):
+    """``with chaos(FaultPlan(seed=7)): run()`` — install for the scope."""
+    inj = (plan_or_injector if isinstance(plan_or_injector, FaultInjector)
+           else FaultInjector(plan_or_injector))
+    install(inj)
+    try:
+        yield inj
+    finally:
+        uninstall()
+
+
+class FaultInjector:
+    """Fires a :class:`FaultPlan`'s faults at the runtime's seams.
+
+    Faults are released into an armed pool when their scheduled cycle
+    begins and fire at the FIRST reachable seam from then on (a
+    resident-state fault scheduled before the mirror exists waits,
+    deterministically, for the next cycle that has one). ``fired`` is the
+    replayable log: (cycle, kind, point) triples in firing order — two
+    runs of the same plan over the same workload must produce identical
+    logs, which tests/test_chaos.py pins.
+    """
+
+    def __init__(self, plan: FaultPlan, slow_s: float = 0.25):
+        self.plan = plan
+        #: how long a ``slow_dispatch`` fault stalls (must exceed the
+        #: scheduler's cycle deadline for the watchdog to trip)
+        self.slow_s = slow_s
+        self.cycle = -1
+        self.fired: List[Tuple[int, str, str]] = []
+        self._pool: List[Fault] = []
+        self._released = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ plumbing
+    def begin_cycle(self, cycle: int) -> None:
+        """Release every fault scheduled at or before ``cycle``."""
+        with self._lock:
+            self.cycle = max(self.cycle, int(cycle))
+            while (self._released < len(self.plan.faults)
+                   and self.plan.faults[self._released].cycle <= self.cycle):
+                self._pool.append(self.plan.faults[self._released])
+                self._released += 1
+
+    def _take(self, kind: str, point: str) -> Optional[Fault]:
+        with self._lock:
+            for f in self._pool:
+                if f.kind == kind:
+                    self._pool.remove(f)
+                    self.fired.append((self.cycle, kind, point))
+                    return f
+        return None
+
+    def pending(self) -> List[Fault]:
+        with self._lock:
+            return list(self._pool)
+
+    def on(self, point: str, **ctx):
+        handler = getattr(self, "_on_" + point.replace(".", "_"), None)
+        return handler(**ctx) if handler else None
+
+    # ------------------------------------------------------- seam handlers
+    def _on_scheduler_cycle(self, cycle: int, **_):
+        self.begin_cycle(cycle)
+
+    def _on_sidecar_round(self, round: int, **_):
+        self.begin_cycle(round)
+
+    def _dispatch_faults(self, point: str):
+        f = self._take("backend_loss", point)
+        if f is not None:
+            raise ChaosError("injected backend loss (accelerator gone)",
+                             kind="backend_loss")
+        f = self._take("slow_dispatch", point)
+        if f is not None:
+            time.sleep(self.slow_s)
+
+    def _on_session_dispatch(self, **_):
+        self._dispatch_faults("session.dispatch")
+
+    def _on_sidecar_dispatch(self, **_):
+        self._dispatch_faults("sidecar.dispatch")
+
+    def _on_delta_run(self, kernel=None, state=None, **_):
+        if state is None or state.mirror is None:
+            return  # nothing resident yet: the fault stays armed
+        f = self._take("resident_corrupt", "delta.run")
+        if f is not None and state.device is not None:
+            import jax
+            corrupted = tuple(np.array(b, copy=True) for b in state.mirror)
+            _flip_host(corrupted, f.param)
+            # the live handles are drained (depth-1 contract: the seam
+            # fires before the next dispatch), so dropping them is safe
+            if kernel is not None:
+                kernel._invalidate(state.device)
+            state.device = tuple(jax.device_put(b) for b in corrupted)
+
+    def _drift_mirror(self, point: str, state) -> None:
+        # fires AFTER dispatch, before the integrity compare: the mirror
+        # diverges from device truth (the self-healing value diff makes a
+        # PRE-dispatch drift invisible — it rewrites any drifted element
+        # with source truth — so the detectable desync is post-dispatch)
+        if state is None or state.mirror is None:
+            return
+        f = self._take("mirror_drift", point)
+        if f is not None:
+            _flip_host(state.mirror, f.param)
+
+    def _on_session_complete(self, state=None, **_):
+        self._drift_mirror("session.complete", state)
+
+    def _on_sidecar_complete(self, state=None, **_):
+        self._drift_mirror("sidecar.complete", state)
+
+    def _on_cluster_bind(self, intent=None, **_):
+        if self._take("bind_fail", "cluster.bind") is not None:
+            return "fail"
+
+    def _on_cluster_evict(self, intent=None, **_):
+        if self._take("evict_fail", "cluster.evict") is not None:
+            return "fail"
+
+    def _on_leader_tick(self, elector=None, lease=None, **_):
+        f = self._take("lease_expiry", "leader.tick")
+        if f is not None and lease is not None and elector is not None:
+            # a rival steals the lease and never renews: the elector must
+            # step down now and re-acquire after the rival's lease expires
+            now = elector.clock()
+            lease.holder = "chaos-rival"
+            lease.acquire_time = now
+            lease.renew_time = now
+            lease.transitions += 1
+
+    def _on_sidecar_client_send(self, client=None, frame: bytes = b"", **_):
+        f = self._take("partial_frame", "sidecar.client_send")
+        if f is not None and client is not None:
+            try:
+                client.sock.sendall(frame[:max(1, len(frame) // 2)])
+            except OSError:
+                pass
+            client.sock.close()
+            raise ConnectionResetError("chaos: partial frame, socket died "
+                                       "mid-send")
+
+    def _on_sidecar_client_recv(self, client=None, **_):
+        f = self._take("socket_drop", "sidecar.client_recv")
+        if f is not None and client is not None:
+            client.sock.close()
+            raise ConnectionResetError("chaos: socket dropped before the "
+                                       "response was read")
+
+
+def _flip_host(bufs, param: int) -> None:
+    """Flip one element of one non-empty host group buffer, chosen by
+    ``param``. The flip is guaranteed to CHANGE the value: bools invert,
+    f32/i32 get a bit-level xor (a NaN-producing flip is fine — the
+    value diff treats NaN as always-changed and the digest is bit-level)."""
+    nonempty = [b for b in bufs if b.size]
+    if not nonempty:
+        return
+    buf = nonempty[param % len(nonempty)]
+    i = param % buf.size
+    if buf.dtype == np.bool_:
+        buf[i] = not buf[i]
+    else:
+        view = buf.view(np.uint32)
+        view[i] = view[i] ^ np.uint32(0x5A5A5A5A)

@@ -1,0 +1,108 @@
+"""Deterministic, seeded fault plans for the cycle runtime.
+
+The reference scheduler earns its HA claims from machinery that only runs
+when things break: rate-limited retry queues, informer resyncs, leader
+re-election. Our TPU-native loop has MORE volatile state (device-resident
+buffers, a one-deep pipeline, a wire protocol) and the failure handling is
+only trustworthy if every recovery path is exercised on purpose. A
+:class:`FaultPlan` is a reproducible storm: given a seed it derives the
+exact same schedule of faults (kind, cycle, parameter) every time, so a
+chaos run is as replayable as a unit test — two runs with the same seed
+must produce the same fault log AND the same post-recovery decision sha
+(tests/test_chaos.py).
+
+Fault kinds (the seams they fire at live in :mod:`.inject`):
+
+- ``socket_drop``      — sidecar client socket dies after the request was
+                         sent (the response is lost mid-flight)
+- ``partial_frame``    — sidecar client dies mid-send (server reads a
+                         truncated frame)
+- ``backend_loss``     — the compiled dispatch raises (accelerator gone)
+- ``resident_corrupt`` — a device-resident group buffer is corrupted
+                         (one element flipped behind the runtime's back)
+- ``mirror_drift``     — the host mirror of device truth drifts (one
+                         element flipped, so the next value-diff is wrong)
+- ``slow_dispatch``    — the dispatch stalls past the cycle deadline
+- ``bind_fail``        — a bind dispatch to the cluster API fails once
+- ``evict_fail``       — an evict dispatch fails once
+- ``lease_expiry``     — the leader lease is stolen by a rival that then
+                         lets it expire
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import random
+from typing import Iterable, List, Optional, Tuple
+
+#: every injectable fault kind, in canonical order
+FAULT_KINDS = (
+    "socket_drop", "partial_frame", "backend_loss", "resident_corrupt",
+    "mirror_drift", "slow_dispatch", "bind_fail", "evict_fail",
+    "lease_expiry",
+)
+
+#: kinds whose recovery must keep the decision sequence bit-identical to
+#: the no-fault run (the sha-matrix acceptance set); socket faults are
+#: recoverable too but only fire on the sidecar serving path
+RECOVERABLE_KINDS = ("backend_loss", "resident_corrupt", "mirror_drift",
+                     "slow_dispatch", "bind_fail", "evict_fail")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scheduled fault: fire ``kind`` at scheduling cycle ``cycle``
+    (or the first later cycle where its seam becomes reachable), with a
+    seed-derived ``param`` the injector uses for kind-specific choices
+    (which element to flip, etc.)."""
+
+    kind: str
+    cycle: int
+    param: int
+
+
+class FaultPlan:
+    """A seed-deterministic fault schedule over ``cycles`` cycles.
+
+    Same (seed, cycles, kinds, per_kind) -> byte-identical schedule:
+    the schedule is derived from a private :class:`random.Random` and
+    fingerprinted by :meth:`schedule_sha`. Faults are scheduled from
+    cycle 1 on — cycle 0 is the cold full-pack/compile cycle, and the
+    resident-state faults need a mirror to corrupt.
+    """
+
+    def __init__(self, seed: int = 0, cycles: int = 8,
+                 kinds: Optional[Iterable[str]] = None, per_kind: int = 1):
+        kinds = tuple(kinds) if kinds is not None else FAULT_KINDS
+        unknown = [k for k in kinds if k not in FAULT_KINDS]
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {unknown}")
+        if cycles < 2:
+            raise ValueError("a fault plan needs at least 2 cycles "
+                             "(cycle 0 is the cold full-pack cycle)")
+        self.seed = int(seed)
+        self.cycles = int(cycles)
+        self.kinds = kinds
+        rng = random.Random(self.seed)
+        faults: List[Fault] = []
+        for kind in kinds:
+            for _ in range(per_kind):
+                faults.append(Fault(kind=kind,
+                                    cycle=rng.randrange(1, cycles),
+                                    param=rng.randrange(1 << 30)))
+        self.faults: Tuple[Fault, ...] = tuple(
+            sorted(faults, key=lambda f: (f.cycle, f.kind, f.param)))
+
+    def for_cycle(self, cycle: int) -> List[Fault]:
+        return [f for f in self.faults if f.cycle == cycle]
+
+    def schedule_sha(self) -> str:
+        """sha256 fingerprint of the exact schedule — two plans with the
+        same seed/config must agree, which is the determinism contract
+        the chaos tests pin."""
+        return hashlib.sha256(repr(self.faults).encode()).hexdigest()[:16]
+
+    def __repr__(self) -> str:  # readable in assertion diffs
+        return (f"FaultPlan(seed={self.seed}, cycles={self.cycles}, "
+                f"faults={list(self.faults)})")

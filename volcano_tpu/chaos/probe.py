@@ -1,0 +1,155 @@
+"""Self-contained chaos probe: a seeded fault storm over a multi-cycle
+scheduler run, compared against the identical no-fault run.
+
+Shared by the tier-1 smoke (``python -m volcano_tpu.chaos --smoke``) and
+bench.py's ``robustness`` block. The probe is the executable form of the
+fail-soft claim: under every recoverable fault kind the loop keeps serving
+and its decision sha stays bit-identical to the clean run, a planted
+resident-state corruption provably trips the integrity digest, and the
+recovery shows up in the flight-recorder ring.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+from typing import Dict, Optional
+
+from .inject import FaultInjector, chaos
+from .plan import RECOVERABLE_KINDS, FaultPlan
+
+#: allocate-terminal policy so the pipelined loop can defer the readback
+#: (the same shape tests/test_delta_pipeline.py pins)
+_PROBE_CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: binpack
+"""
+
+
+def _small_cluster(n_nodes: int = 6, n_jobs: int = 8,
+                   tasks_per_job: int = 3):
+    from ..api import (ClusterInfo, JobInfo, NodeInfo, PodGroupPhase,
+                       QueueInfo, Resource, TaskInfo)
+    ci = ClusterInfo()
+    for i in range(n_nodes):
+        ci.add_node(NodeInfo(
+            f"n{i}", allocatable=Resource.from_resource_list(
+                {"cpu": "8", "memory": "16Gi", "pods": "110"})))
+    ci.add_queue(QueueInfo("default", weight=1))
+    for j in range(n_jobs):
+        job = JobInfo(uid=f"default/j{j}", name=f"j{j}",
+                      namespace="default", queue="default", min_available=2,
+                      priority=j % 3, creation_timestamp=float(j),
+                      pod_group_phase=PodGroupPhase.INQUEUE)
+        for t in range(tasks_per_job):
+            job.add_task(TaskInfo(
+                uid=f"default/j{j}-t{t}", name=f"j{j}-t{t}",
+                namespace="default",
+                resreq=Resource.from_resource_list(
+                    {"cpu": "2", "memory": "2Gi"})))
+        ci.add_job(job)
+    return ci
+
+
+def _churn(cluster, cycle: int) -> None:
+    """Deterministic between-cycle churn: bound tasks start running, one
+    fully-running gang completes and re-arrives."""
+    from ..api import TaskStatus
+    ci = cluster.ci
+    for uid in sorted(t.uid for job in ci.jobs.values()
+                      for t in job.tasks.values()
+                      if t.status == TaskStatus.BOUND):
+        cluster.run_task(uid)
+    for uid in sorted(ci.jobs):
+        job = ci.jobs[uid]
+        tasks = list(job.tasks.values())
+        if tasks and all(t.status == TaskStatus.RUNNING for t in tasks) \
+                and (cycle + len(uid)) % 3 == 0:
+            for t in tasks:
+                node = ci.nodes.get(t.node_name)
+                if node is not None and t.uid in node.tasks:
+                    node.remove_task(t)
+                    cluster.mark_dirty(node_name=node.name)
+                job.update_task_status(t, TaskStatus.PENDING)
+                t.node_name = ""
+            job.allocated = type(job.allocated)({})
+            cluster.mark_dirty(job_uid=uid)
+            break
+
+
+def _cycle_digest(rec) -> tuple:
+    return (sorted((b.task_uid, b.node_name, b.gpu_index)
+                   for b in rec.binds),
+            sorted(e.task_uid for e in rec.evictions),
+            sorted(rec.pipelined.items()),
+            sorted((u, str(p)) for u, p in rec.phase_updates.items()))
+
+
+def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
+                    kinds=RECOVERABLE_KINDS,
+                    deadline_ms: Optional[float] = None,
+                    slow_s: float = 0.25) -> Dict[str, object]:
+    """Run the probe; returns a JSON-ready robustness report."""
+    from ..framework.conf import parse_conf
+    from ..metrics import METRICS
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+    conf = parse_conf(_PROBE_CONF)
+    base = _small_cluster()
+
+    def run(injector):
+        cluster = FakeCluster(base.clone())
+        sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
+        if deadline_ms is not None:
+            sched.cycle_deadline_s = deadline_ms / 1000.0
+        digests = []
+        ctx = chaos(injector) if injector is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            for c in range(cycles):
+                out = sched.run_once(now=1000.0 + c)
+                rec = (sched.drain(now=1000.0 + c) or out) if pipeline \
+                    else out
+                digests.append(_cycle_digest(rec))
+                _churn(cluster, c)
+        sha = hashlib.sha256(repr(digests).encode()).hexdigest()[:16]
+        return sha, sched
+
+    clean_sha, _clean = run(None)
+    plan = FaultPlan(seed=seed, cycles=cycles, kinds=kinds)
+    injector = FaultInjector(plan, slow_s=slow_s)
+    mismatches0 = METRICS.counter_value("resident_digest_mismatch_total")
+    recoveries0 = METRICS.counter_total("cycle_recoveries_total")
+    chaos_sha, sched = run(injector)
+    flight = sched.flight.snapshots()
+    recovery_ms = sorted(e["stats"]["recovery_ms"] for e in flight
+                         if "recovery_ms" in e.get("stats", {}))
+    degradation = [e.get("degradation", 0) or 0 for e in flight]
+    return {
+        "seed": seed,
+        "cycles": cycles,
+        "pipeline": pipeline,
+        "kinds": list(kinds),
+        "fault_schedule_sha": plan.schedule_sha(),
+        "faults_fired": len(injector.fired),
+        "fault_log": [list(f) for f in injector.fired],
+        "decisions_sha": chaos_sha,
+        "clean_sha": clean_sha,
+        "decisions_equal_clean": chaos_sha == clean_sha,
+        "recovered_cycles": len(recovery_ms),
+        "recovery_ms_p50": (recovery_ms[len(recovery_ms) // 2]
+                            if recovery_ms else None),
+        "degradation_max": max(degradation) if degradation else 0,
+        "digest_mismatches": METRICS.counter_value(
+            "resident_digest_mismatch_total") - mismatches0,
+        "recoveries_total": METRICS.counter_total(
+            "cycle_recoveries_total") - recoveries0,
+        "resync_dead_letter": len(sched.resync.dead_letter()),
+    }

@@ -103,6 +103,14 @@ class SchedulerConfiguration:
     #: (framework/compile_cache.enable_compilation_cache); also settable
     #: via $VOLCANO_JAX_CACHE_DIR. None = disabled.
     compilation_cache_dir: Optional[str] = None
+    #: per-cycle watchdog deadline for the dispatch/drain halves of the
+    #: scheduler loop, in milliseconds (ISSUE 5). A cycle that blows it is
+    #: retired synchronously (decisions unaffected) and the loop drops out
+    #: of pipelining for the fault-cooldown window. None = no watchdog —
+    #: the default, because a sane deadline is deployment-specific (it
+    #: must exceed the cold-compile cycle). YAML: top-level
+    #: ``cycle_deadline_ms: 500``.
+    cycle_deadline_ms: Optional[float] = None
 
     def plugin_option(self, name: str) -> Optional[PluginOption]:
         for tier in self.tiers:
@@ -152,6 +160,8 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     sc.pipeline = bool(data.get("pipeline", False))
     cache_dir = data.get("compilation_cache_dir")
     sc.compilation_cache_dir = str(cache_dir) if cache_dir else None
+    ddl = data.get("cycle_deadline_ms")
+    sc.cycle_deadline_ms = float(ddl) if ddl else None
     raw_actions = data.get("actions", "enqueue, allocate, backfill")
     if isinstance(raw_actions, str):
         sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]

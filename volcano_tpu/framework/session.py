@@ -107,6 +107,14 @@ class PendingAllocate:
     J: int
     R: int
     dispatch_ms: float = 0.0
+    #: recovery context (delta path only): the DeltaKernel + ResidentState
+    #: that dispatched this cycle and the exact argument tree it consumed —
+    #: complete_allocate verifies the in-graph integrity digest against the
+    #: mirror and, on mismatch or a failed readback, re-fuses from ``tree``
+    #: (or falls to the CPU oracle when the accelerator is gone)
+    kernel: object = None
+    state: object = None
+    tree: object = None
 
 
 @lru_cache(maxsize=64)
@@ -732,6 +740,35 @@ class Session:
     def _run_allocate(self):
         return self.complete_allocate(self.dispatch_allocate())
 
+    def run_allocate_oracle(self):
+        """Graceful-degradation rung: the whole allocate pass on the
+        pure-host CPU reference (no jax dispatch at all), applied to the
+        session exactly like the compiled result. Decisions are
+        bit-identical to the compiled cycle (the oracle IS the equality
+        reference of the kernel test suites), so a scheduler that lost its
+        accelerator keeps serving the same placements, just slower."""
+        cfg, extras = self._derived_allocate_inputs()
+        from ..runtime.cpu_reference import allocate_cpu
+        # collect_telemetry=True enables the oracle's kernel-mirroring
+        # give-up short-circuit (see _oracle_packed) — required for exact
+        # job_ready/phase-update parity, not for the counters
+        out = allocate_cpu(self.snap, extras, cfg, collect_telemetry=True)
+        import types
+        task_node = np.asarray(out["task_node"], np.int32)
+        task_mode = np.asarray(out["task_mode"], np.int32)
+        task_gpu = np.asarray(out["task_gpu"], np.int32)
+        job_ready = np.asarray(out["job_ready"], bool)
+        job_pipelined = np.asarray(out["job_pipelined"], bool)
+        result = types.SimpleNamespace(
+            task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,
+            job_ready=job_ready, job_pipelined=job_pipelined,
+            job_attempted=np.asarray(out["job_attempted"], bool))
+        self.last_allocate = result
+        self.stats["cpu_oracle"] = 1.0
+        self.apply_allocate(result, host=(task_node, task_mode, task_gpu,
+                                          job_ready, job_pipelined))
+        return result
+
     def _derived_allocate_inputs(self):
         """(cfg, extras) exactly as the dispatched cycle consumes them.
 
@@ -780,6 +817,12 @@ class Session:
         cfg, extras = self._derived_allocate_inputs()
         self.stats["extras_ms"] = (time.time() - t0) * 1000
         t0 = time.time()
+        # fault-injection seam (chaos backend-loss / slow-dispatch faults
+        # fire here, before any resident state is touched, exactly where a
+        # real accelerator loss surfaces)
+        from ..chaos.inject import seam
+        seam("session.dispatch", session=self)
+        kernel = state = None
         if bool(getattr(self.conf, "delta_uploads", True)):
             # device-resident buffers + packed delta scatter: steady-state
             # upload is O(changed elements); full re-fuse only on the
@@ -808,14 +851,89 @@ class Session:
         dispatch_ms = (time.time() - t0) * 1000
         self.stats["dispatch_ms"] = dispatch_ms
         return PendingAllocate(packed=packed, cfg=cfg, T=T, J=J, R=R,
-                               dispatch_ms=dispatch_ms)
+                               dispatch_ms=dispatch_ms, kernel=kernel,
+                               state=state, tree=(self.snap, extras))
+
+    def _oracle_packed(self, pending: PendingAllocate) -> np.ndarray:
+        """Last rung of the degradation ladder: decisions from the
+        pure-host CPU reference (runtime/cpu_reference.allocate_cpu — the
+        decision-equality oracle of the kernel test suites), packed into
+        the same 3T+3J layout so the drain path is shared. Used when the
+        compiled re-dispatch itself fails, i.e. the accelerator is gone."""
+        from ..runtime.cpu_reference import allocate_cpu
+        snap, extras = pending.tree
+        # collect_telemetry=True is NOT about telemetry here: it enables
+        # the oracle's kernel-mirroring capacity-give-up short-circuit,
+        # without which an already-ready gang evaluated after a stalled
+        # round flips job_ready where the kernel skipped it — task
+        # decisions match either way, but phase updates would not
+        out = allocate_cpu(snap, extras, pending.cfg,
+                           collect_telemetry=True)
+        return np.concatenate([
+            np.asarray(out["task_node"], np.int32),
+            np.asarray(out["task_mode"], np.int32),
+            np.asarray(out["task_gpu"], np.int32),
+            np.asarray(out["job_ready"], np.int32),
+            np.asarray(out["job_pipelined"], np.int32),
+            np.asarray(out["job_attempted"], np.int32)])
+
+    def _readback_packed(self, pending: PendingAllocate) -> np.ndarray:
+        """Read a dispatched cycle's packed decisions back, verifying the
+        in-graph integrity digest against the host mirror's. On a failed
+        readback (handle dead, backend error) or a digest mismatch the
+        cycle is recovered in place: full re-fuse from the pending tree +
+        recompute (decision-neutral), falling to the CPU oracle if the
+        compiled dispatch is gone too. Recovery is visible in METRICS
+        (``resident_digest_mismatch_total``, ``cycle_recoveries_total``),
+        ``last_telemetry["integrity"]`` and the flight-recorder ring."""
+        from ..chaos.inject import seam
+        from ..metrics import METRICS
+        kernel, state = pending.kernel, pending.state
+        reason = None
+        packed = None
+        try:
+            packed = np.asarray(pending.packed)
+        except Exception as e:
+            if kernel is None or pending.tree is None:
+                raise
+            reason = f"readback:{type(e).__name__}"
+        if packed is not None and kernel is not None and kernel.digest_words:
+            # chaos mirror-drift faults fire here: after the dispatch,
+            # before the compare — the point where a real desync sits
+            seam("session.complete", state=state)
+            packed, dev_digest = kernel.split_digest(packed)
+            host_digest = kernel.mirror_digest(state)
+            if host_digest is not None and not np.array_equal(dev_digest,
+                                                              host_digest):
+                reason = "digest"
+                METRICS.inc("resident_digest_mismatch_total")
+                packed = None
+        if reason is None:
+            return packed
+        t0 = time.time()
+        try:
+            packed = np.asarray(kernel.recover(state, pending.tree))
+            packed, _dig = kernel.split_digest(packed)
+            mode = "refuse"
+        except Exception:
+            packed = self._oracle_packed(pending)
+            mode = "cpu_oracle"
+        ms = (time.time() - t0) * 1000
+        METRICS.inc("cycle_recoveries_total",
+                    labels={"reason": reason.split(":")[0], "mode": mode})
+        self.stats["recovery_ms"] = ms
+        self.last_telemetry["integrity"] = dict(
+            reason=reason, mode=mode, recovery_ms=round(ms, 3))
+        return packed
 
     def complete_allocate(self, pending: PendingAllocate):
-        """Drain a dispatched cycle: read the packed decisions back, decode
-        the telemetry tail, and apply binds/pipelines to the session."""
+        """Drain a dispatched cycle: read the packed decisions back
+        (verifying the resident-buffer integrity digest and recovering in
+        place if it trips), decode the telemetry tail, and apply
+        binds/pipelines to the session."""
         t0 = time.time()
         cfg, T, J = pending.cfg, pending.T, pending.J
-        packed = np.asarray(pending.packed)
+        packed = self._readback_packed(pending)
         from ..ops.allocate_scan import unpack_decisions
         (task_node, task_mode, task_gpu, job_ready, job_pipelined,
          job_attempted) = unpack_decisions(packed, T, J)

@@ -52,6 +52,26 @@ _HELP = {
     "jit_traces": "Times each jitted cycle entry point was traced",
     "jit_calls": "Times each jitted cycle entry point was called",
     "jit_cache_hits": "Jit calls served from the compile cache",
+    "resident_digest_mismatch_total":
+        "Device-resident buffer integrity digest mismatches vs the host "
+        "mirror (each one triggered a full re-fuse recovery)",
+    "cycle_recoveries_total":
+        "Scheduling cycles recovered in place, by reason and mode "
+        "(refuse / sync / cpu_oracle)",
+    "cycle_faults_total":
+        "Faults absorbed by the cycle runtime, by stage",
+    "cycle_dropped_total":
+        "Cycles retired with no decisions after recovery failed",
+    "resync_dead_letter_total":
+        "Bind/evict intents that exhausted resync retries and moved to "
+        "the dead-letter list (never dropped silently)",
+    "degradation_level":
+        "Current degradation ladder rung: 0 pipelined, 1 sync, "
+        "2 cpu-oracle",
+    "sidecar_reconnects_total":
+        "Sidecar client reconnects after a socket failure",
+    "sidecar_replayed_rounds_total":
+        "VCRQ rounds served from the idempotent replay cache",
 }
 
 
@@ -112,6 +132,12 @@ class Metrics:
         """Read a counter (0.0 when never incremented)."""
         with self._lock:
             return self.counters.get((name, _label_str(labels)), 0.0)
+
+    def counter_total(self, name: str) -> float:
+        """Sum of a counter across ALL label sets (0.0 when absent)."""
+        with self._lock:
+            return sum(v for (n, _ls), v in self.counters.items()
+                       if n == name)
 
     def set_gauge(self, name: str, labels: LabelsT, value: float) -> None:
         with self._lock:

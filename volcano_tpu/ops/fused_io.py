@@ -49,6 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..chaos.inject import seam
+
 _GROUPS = ("f", "i", "b")
 _TARGETS = {"f": np.float32, "i": np.int32, "b": np.bool_}
 
@@ -203,6 +205,57 @@ def _pad_delta(idx: np.ndarray, vals: np.ndarray, bucket: int):
             np.concatenate([vals, np.full(pad, vals[-1], vals.dtype)]))
 
 
+# --------------------------------------------------------------------------
+# Integrity digest: does the device still hold what the mirror says it holds?
+# --------------------------------------------------------------------------
+# A resident buffer lives on the device for thousands of cycles; a single
+# corrupted element (driver fault, aliasing bug, a mirror that drifted from
+# device truth) silently poisons every later delta diff. The digest is a
+# cheap position-weighted u32 checksum computed IN-GRAPH over the three
+# post-scatter group buffers and returned as a 3-word i32 tail riding the
+# same packed readback as the decisions (no extra transfer, no callback).
+# The host computes the identical formula over its mirror; a mismatch means
+# device truth and host truth diverged, and the owner recovers with
+# :meth:`DeltaKernel.recover` (full re-fuse from SOURCE truth + recompute).
+# u32 multiply/add wrap identically mod 2^32 in XLA and numpy, and the sum
+# is order-independent, so the comparison is exact on every backend.
+
+#: digest words appended to the packed readback (one per group buffer)
+DIGEST_WORDS = 3
+_DIGEST_MUL = np.uint32(2654435761)     # Knuth multiplicative hash constant
+_DIGEST_ADD = np.uint32(0x9E3779B9)     # golden-ratio offset: element 0 counts
+
+
+def host_digest(bufs) -> np.ndarray:
+    """u32[3] digest of host group buffers — the mirror half of the check.
+    Bit-level: f32/i32 words are reinterpreted, never converted, so NaNs
+    and negative zeros digest deterministically."""
+    out = np.zeros(DIGEST_WORDS, np.uint32)
+    for k, b in enumerate(bufs):
+        w = (b.astype(np.uint32) if b.dtype == np.bool_
+             else np.ascontiguousarray(b).view(np.uint32))
+        idx = np.arange(w.size, dtype=np.uint32)
+        out[k] = np.sum(w * (idx * _DIGEST_MUL + _DIGEST_ADD),
+                        dtype=np.uint32)
+    return out
+
+
+def _device_digest(fbuf, ibuf, bbuf) -> jax.Array:
+    """i32[3] in-graph digest of the resident buffers (bitcast of the u32
+    words so the packed readback stays a single i32 array). Pure 32-bit
+    arithmetic: traced clean under the graphcheck dtype family."""
+    words = []
+    for buf in (fbuf, ibuf, bbuf):
+        if buf.dtype == jnp.bool_:
+            w = buf.astype(jnp.uint32)
+        else:
+            w = jax.lax.bitcast_convert_type(buf, jnp.uint32)
+        idx = jnp.arange(w.shape[0], dtype=jnp.uint32)
+        words.append(jnp.sum(w * (idx * _DIGEST_MUL + _DIGEST_ADD),
+                             dtype=jnp.uint32))
+    return jax.lax.bitcast_convert_type(jnp.stack(words), jnp.int32)
+
+
 def donation_for_backend(platform: Optional[str] = None) -> tuple:
     """The donate_argnums the delta update+cycle entry uses on this
     backend: the three resident buffers on accelerators, nothing on CPU.
@@ -277,10 +330,15 @@ class DeltaKernel:
     """
 
     def __init__(self, cycle_fn, example_tree,
-                 entry: str = "fused_cycle_delta"):
+                 entry: str = "fused_cycle_delta", integrity: bool = True):
         self.treedef, self.spec = fuse_spec(example_tree)
         self.sizes = group_sizes(self.spec)
         self.entry = entry
+        #: i32 words the packed readback carries past the decisions: the
+        #: in-graph integrity digest of the post-scatter resident buffers
+        #: (see host_digest). Kernel-aware consumers strip it with
+        #: :meth:`split_digest` and compare against :meth:`mirror_digest`.
+        self.digest_words = DIGEST_WORDS if integrity else 0
         #: backend-dependent donation of the resident buffers (see
         #: donation_for_backend) — the graphcheck ``donation`` family
         #: verifies this matches the platform contract
@@ -293,7 +351,11 @@ class DeltaKernel:
             ibuf = ibuf.at[iidx].set(ivals)
             bbuf = bbuf.at[bidx].set(bvals)
             args = unfuse(fbuf, ibuf, bbuf)
-            return fbuf, ibuf, bbuf, cycle_fn(*args).packed_decisions()
+            packed = cycle_fn(*args).packed_decisions()
+            if integrity:
+                packed = jnp.concatenate(
+                    [packed, _device_digest(fbuf, ibuf, bbuf)])
+            return fbuf, ibuf, bbuf, packed
 
         from ..telemetry import counted_jit
         self._fn = counted_jit(_update_cycle, entry,
@@ -325,6 +387,62 @@ class DeltaKernel:
                       for a in self.example_delta_args(bucket))
         self._fn.lower(*avals).compile()
 
+    # ----------------------------------------------- integrity + recovery
+    def split_digest(self, packed: np.ndarray):
+        """Split a host readback into (decisions, u32[3] device digest).
+        The digest is None when this kernel was built without integrity."""
+        if not self.digest_words:
+            return packed, None
+        tail = np.ascontiguousarray(packed[-self.digest_words:])
+        return packed[:-self.digest_words], tail.view(np.uint32)
+
+    def mirror_digest(self, state: "ResidentState"):
+        """The host half of the integrity check: digest of the mirror of
+        device truth for the cycle most recently dispatched from
+        ``state`` (valid until the next dispatch — the depth-1 pipeline
+        guarantees the pending cycle is drained first)."""
+        if state.mirror is None:
+            return None
+        return host_digest(state.mirror)
+
+    def recover(self, state: "ResidentState", tree):
+        """Integrity recovery: full re-fuse from SOURCE truth + recompute.
+
+        Drops whatever the device holds, re-packs ``tree`` (the exact
+        argument tree of the cycle whose digest or readback failed — the
+        caller kept it pending until drain, so it is still the dispatched
+        cycle's truth) and re-runs the cycle as a forced full upload. This
+        heals BOTH divergence directions: a corrupted resident buffer
+        (device wrong, mirror right) and a drifted mirror (device right,
+        mirror wrong) — re-deriving from the tree never trusts either
+        side. The returned packed decisions are what the uncorrupted
+        cycle would have produced, so recovery is decision-neutral. If
+        the dispatch itself raises (the accelerator is gone, not just a
+        buffer), residency is reset and the error propagates to the
+        caller's next rung on the degradation ladder (the CPU oracle)."""
+        # the suspect residents feed nothing anymore — the failed cycle
+        # has been read back, so the deletes are free
+        if state.device is not None:
+            self._invalidate(state.device)
+            state.device = None
+        state.mirror = None     # force_full below; never diff vs a suspect
+        packed = self.run(state, tree, force_full=True)
+        state.last_kind = "recovery"
+        return packed
+
+    def _reset_state(self, state: "ResidentState") -> None:
+        """After a failed dispatch the runtime may or may not have consumed
+        the donated inputs — residency is indeterminate. Drop everything so
+        the next run pays one clean full upload instead of trusting a
+        half-applied scatter."""
+        for handles in (state.retiring,
+                        state.device if state.device is not None else ()):
+            self._invalidate(handles)
+        state.retiring = ()
+        state.device = None
+        state.mirror = None
+        state.scratch = None
+
     # ------------------------------------------------------------- running
     def _invalidate(self, handles) -> None:
         """Kill any retired input handle the runtime left alive, so a host
@@ -347,6 +465,11 @@ class DeltaKernel:
         compute on device. Returns the packed-decisions DEVICE array (the
         caller owns the readback, so a pipelined loop can defer it);
         ``state`` is updated in place with the new residency + counters."""
+        # fault-injection seam: resident-buffer corruption faults fire
+        # here, before this run diffs/dispatches — exactly where a real
+        # device-side desync would sit (mirror drift fires at the owner's
+        # complete/verify seam instead: a pre-dispatch drift self-heals)
+        seam("delta.run", kernel=self, state=state)
         # retire the handles the PREVIOUS cycle consumed: by the depth-1
         # contract that cycle has been drained, so the delete is free — and
         # where donation was honored the runtime killed them at dispatch
@@ -393,7 +516,11 @@ class DeltaKernel:
             state.last_kind = "delta"
             state.last_upload_bytes = upload
         state.full_upload_bytes = full_bytes
-        fnew, inew, bnew, packed = self._fn(*dev, *args)
+        try:
+            fnew, inew, bnew, packed = self._fn(*dev, *args)
+        except Exception:
+            self._reset_state(state)
+            raise
         # the consumed inputs are CONTRACTUALLY dead from here on: honored
         # donation killed them at dispatch; otherwise they retire at the
         # next dispatch (deleting now would block on the in-flight

@@ -1,0 +1,54 @@
+"""Capped exponential backoff with jitter — the call-site retry helper.
+
+The per-ITEM shape (ItemExponentialFailureRateLimiter) already lives in
+:class:`..runtime.scheduler.ResyncQueue`; this is the per-CALL shape the
+sidecar client uses for connection establishment and reconnect-and-resend
+(client-go's wait.Backoff). Jitter decorrelates a thundering herd of
+replicas reconnecting to a restarted sidecar; tests pin ``jitter=0``
+and/or ``seed`` for determinism.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+@dataclass
+class Backoff:
+    base: float = 0.05          # first retry delay, seconds
+    cap: float = 2.0            # per-delay ceiling
+    factor: float = 2.0
+    attempts: int = 6           # total tries (first one immediate)
+    jitter: float = 0.1         # +- fraction of the delay
+    seed: Optional[int] = None  # pin for deterministic tests
+    _rng: random.Random = field(init=False, repr=False, default=None)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def delay(self, attempt: int) -> float:
+        """Delay before retry ``attempt`` (0-based: the delay AFTER the
+        first failure)."""
+        d = min(self.cap, self.base * (self.factor ** attempt))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(d, 0.0)
+
+    def call(self, fn: Callable[[], T],
+             retry_on=(OSError,),
+             sleep: Callable[[float], None] = time.sleep) -> T:
+        """Run ``fn`` up to ``attempts`` times, sleeping the backoff
+        schedule between failures; the final failure propagates."""
+        for attempt in range(self.attempts):
+            try:
+                return fn()
+            except retry_on:
+                if attempt >= self.attempts - 1:
+                    raise
+                sleep(self.delay(attempt))
+        raise RuntimeError("unreachable")  # pragma: no cover

@@ -14,6 +14,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api import (ClusterInfo, JobInfo, NodeInfo, QueueInfo, TaskInfo,
                    TaskStatus)
+from ..chaos.inject import seam
 from ..framework.session import BindIntent, EvictIntent
 
 
@@ -74,6 +75,10 @@ class FakeCluster:
         cache.go:123-143). Injectable failures exercise the resync path: a
         string value fails every attempt, an int value fails that many
         attempts then succeeds."""
+        # fault-injection seam: a chaos bind_fail fault is a one-shot API
+        # rejection, landing the intent in the scheduler's resync path
+        if seam("cluster.bind", intent=intent) == "fail":
+            return False
         fail = self.bind_failures.get(intent.task_uid)
         if fail is not None:
             if isinstance(fail, int):
@@ -134,6 +139,8 @@ class FakeCluster:
     def evict(self, intent: EvictIntent) -> bool:
         """Apply an eviction: task goes back to Pending off-node
         (defaultEvictor.Evict, cache.go:145-175)."""
+        if seam("cluster.evict", intent=intent) == "fail":
+            return False
         job = self.ci.jobs.get(intent.job_uid)
         if job is None:
             return False

@@ -73,8 +73,13 @@ class LeaderElector:
 
     def tick(self) -> bool:
         """Try to acquire or renew the lease; returns is_leader."""
+        from ..chaos.inject import seam
         now = self.clock()
         lease = self._lease()
+        # fault-injection seam: a chaos lease_expiry fault hands the lease
+        # to a rival that never renews — this replica must step down now
+        # and win it back once the rival's lease expires
+        seam("leader.tick", elector=self, lease=lease)
         if lease is None:
             lease = Lease(name=self.lock_name, namespace=self.namespace,
                           holder=self.identity, acquire_time=now,

@@ -34,6 +34,13 @@ class ResyncQueue:
         self.max_delay = max_delay
         self.max_attempts = max_attempts
         self.entries: List[dict] = []
+        #: attempts-exhausted intents, kept (bounded by workload, not
+        #: uptime: an intent dead-letters at most once) instead of being
+        #: dropped silently — surfaced through METRICS
+        #: ``resync_dead_letter_total`` and the flight recorder so an
+        #: operator can see WHAT the scheduler gave up on, the way the
+        #: reference's Forget + event log does
+        self.dead: List[dict] = []
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -43,11 +50,19 @@ class ResyncQueue:
         self.entries.append(dict(intent=intent, kind=kind, attempts=attempts,
                                  next_try=now + delay))
 
+    def dead_letter(self) -> List[dict]:
+        """Copies of the attempts-exhausted entries (intent, kind,
+        attempts, gave_up_at). Never mutated by later processing."""
+        return [dict(e) for e in self.dead]
+
     def process(self, cluster, now: float) -> Dict[str, int]:
-        """Retry every due entry against the cluster. Returns counters."""
+        """Retry every due entry against the cluster. Returns counters.
+        An entry that exhausts ``max_attempts`` is never dropped silently:
+        it moves to the dead-letter list (and a bind additionally resyncs
+        the task back to Pending, the syncTask give-up)."""
         due = [e for e in self.entries if e["next_try"] <= now]
         self.entries = [e for e in self.entries if e["next_try"] > now]
-        stats = dict(retried=0, succeeded=0, dropped=0)
+        stats = dict(retried=0, succeeded=0, dropped=0, dead_lettered=0)
         for e in due:
             stats["retried"] += 1
             ok = (cluster.bind(e["intent"]) if e["kind"] == "bind"
@@ -56,6 +71,8 @@ class ResyncQueue:
                 stats["succeeded"] += 1
             elif e["attempts"] >= self.max_attempts:
                 stats["dropped"] += 1
+                stats["dead_lettered"] += 1
+                self.dead.append(dict(e, gave_up_at=now))
                 if e["kind"] == "bind":
                     cluster.resync_task(e["intent"].task_uid)
             else:
@@ -117,6 +134,22 @@ class Scheduler:
         from ..telemetry import FlightRecorder
         self.flight = FlightRecorder(
             capacity=int(os.environ.get("VOLCANO_FLIGHT_CYCLES", 64)))
+        # ---- fault tolerance (ISSUE 5) --------------------------------
+        #: per-cycle watchdog deadline for the dispatch/drain halves, in
+        #: seconds (conf ``cycle_deadline_ms``; None = off). A blown
+        #: deadline retires the cycle synchronously and drops out of
+        #: pipelining for the cooldown window.
+        ddl = getattr(self.conf, "cycle_deadline_ms", None)
+        self.cycle_deadline_s = (float(ddl) / 1000.0) if ddl else None
+        #: degradation ladder: 0 = pipelined (when configured), 1 = sync
+        #: (a fault was recovered; pipelining suspended), 2 = cpu-oracle
+        #: (the compiled dispatch is gone). De-escalates to 0 after
+        #: ``fault_cooldown`` clean cycles.
+        self.degradation_level = 0
+        self.fault_cooldown = int(os.environ.get("VOLCANO_FAULT_COOLDOWN",
+                                                 4))
+        self._degrade_until = 0
+        self._cycle_faults: List[dict] = []
 
     def _load_conf(self) -> Optional[SchedulerConfiguration]:
         """Conf hot-reload (fsnotify watcher, scheduler.go:146-171 — here a
@@ -210,6 +243,14 @@ class Scheduler:
             self.conf = reloaded
         t0 = time.time()
         wall = now if now is not None else t0
+        # fault-injection seam: arms this cycle's scheduled faults
+        from ..chaos.inject import seam
+        seam("scheduler.cycle", cycle=self.cycles, scheduler=self)
+        # degradation de-escalation probe: after the cooldown window of
+        # clean cycles, climb back to the configured mode
+        if self.degradation_level and self.cycles >= self._degrade_until:
+            self.degradation_level = 0
+            METRICS.set_gauge("degradation_level", None, 0)
         completed = self._drain_pending(wall)
         # drain due resync retries BEFORE snapshotting so the cycle sees
         # their outcomes (the errTasks worker runs alongside the loop,
@@ -219,25 +260,98 @@ class Scheduler:
             METRICS.inc("resync_retried", rs["retried"])
             METRICS.inc("resync_succeeded", rs["succeeded"])
             METRICS.inc("resync_dropped", rs["dropped"])
+            if rs["dead_lettered"]:
+                METRICS.inc("resync_dead_letter_total", rs["dead_lettered"])
         ssn = self._open_session(now)
         from ..actions import get_action
         actions = list(self.conf.actions)
         # the pipeline defers the allocate readback across the run_once
         # boundary, so it requires allocate to be the cycle's LAST action
         # (anything after it would need the decisions applied); other
-        # action lists fall back to the synchronous path
-        pipelined = self.pipeline and actions and actions[-1] == "allocate"
+        # action lists fall back to the synchronous path. A degraded
+        # scheduler (recent fault) also runs synchronously until the
+        # cooldown expires.
+        pipelined = (self.pipeline and self.degradation_level == 0
+                     and actions and actions[-1] == "allocate")
         for name in (actions[:-1] if pipelined else actions):
             ta = time.time()
-            get_action(name).execute(ssn)
+            try:
+                get_action(name).execute(ssn)
+            except Exception as e:
+                if name != "allocate":
+                    raise
+                # the compiled allocate failed mid-action: walk the ladder
+                self._note_fault("allocate", e)
+                self._allocate_degraded(ssn)
             METRICS.observe_action(name, time.time() - ta)
         if pipelined:
             ta = time.time()
-            pending = ssn.dispatch_allocate()
-            METRICS.observe_action("allocate_dispatch", time.time() - ta)
+            try:
+                pending = ssn.dispatch_allocate()
+            except Exception as e:
+                # dispatch failed before anything was in flight: recover
+                # synchronously (retry -> oracle) and retire the cycle now
+                self._note_fault("dispatch", e)
+                self._allocate_degraded(ssn)
+                return self._finish_cycle(ssn, time.time() - t0, wall)
+            took = time.time() - ta
+            METRICS.observe_action("allocate_dispatch", took)
+            if self.cycle_deadline_s is not None \
+                    and took > self.cycle_deadline_s:
+                # watchdog: the dispatch blew the cycle deadline — retire
+                # the pending cycle synchronously NOW (its decisions are
+                # unaffected; only the overlap is lost) and drop out of
+                # pipelining for the cooldown window
+                self._note_fault("deadline", TimeoutError(
+                    f"dispatch took {took * 1000:.0f} ms "
+                    f"(deadline {self.cycle_deadline_s * 1000:.0f} ms)"))
+                self._degrade(1)
+                self._pending = (ssn, pending, time.time() - t0, wall)
+                completed_now = self._drain_pending(wall)
+                return completed if completed is not None else completed_now
             self._pending = (ssn, pending, time.time() - t0, wall)
             return completed if completed is not None else ssn
         return self._finish_cycle(ssn, time.time() - t0, wall)
+
+    # -------------------------------------------- fault handling / ladder
+    def _note_fault(self, stage: str, exc: BaseException) -> None:
+        """Record a recovered fault: METRICS counter, the per-cycle fault
+        list the flight recorder snapshots, and a log-ready string."""
+        METRICS.inc("cycle_faults_total", labels={"stage": stage})
+        self._cycle_faults.append(
+            dict(stage=stage, error=f"{type(exc).__name__}: {exc}"))
+
+    def _degrade(self, level: int) -> None:
+        """Escalate the degradation ladder and (re)start the cooldown."""
+        self.degradation_level = max(self.degradation_level, level)
+        self._degrade_until = self.cycles + self.fault_cooldown
+        METRICS.set_gauge("degradation_level", None, self.degradation_level)
+
+    def _allocate_degraded(self, ssn: Session) -> None:
+        """The compiled allocate dispatch raised: walk the degradation
+        ladder — one synchronous retry (a transient fault; the delta path
+        reset itself to a clean full upload), then the pure-host CPU
+        oracle if the accelerator is really gone. Decisions stay
+        bit-identical on every rung (the oracle is the kernel suites'
+        equality reference), so a recovered fault is decision-neutral."""
+        import numpy as np
+        t0 = time.time()
+        try:
+            result = ssn.run_allocate()
+            mode = "sync"
+            self._degrade(1)
+        except Exception as e:
+            self._note_fault("sync_retry", e)
+            result = ssn.run_allocate_oracle()
+            mode = "cpu_oracle"
+            self._degrade(2)
+        ssn.stats["allocated_binds"] = len(ssn.binds)
+        ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
+        ssn.stats["jobs_pipelined"] = int(
+            np.asarray(result.job_pipelined).sum())
+        ssn.stats.setdefault("recovery_ms", (time.time() - t0) * 1000)
+        METRICS.inc("cycle_recoveries_total",
+                    labels={"reason": "dispatch", "mode": mode})
 
     def _drain_pending(self, wall: float):
         """Drain the one-deep pipeline: read the in-flight cycle's packed
@@ -251,13 +365,36 @@ class Scheduler:
         ssn, pending, host_s, _wall0 = self._pending
         self._pending = None
         t0 = time.time()
-        result = ssn.complete_allocate(pending)
+        try:
+            result = ssn.complete_allocate(pending)
+        except Exception as e:
+            # complete_allocate already walked re-fuse -> cpu-oracle; if it
+            # STILL raised the cycle is unrecoverable. Keep serving: retire
+            # it with no decisions applied instead of crashing the loop.
+            self._note_fault("drain", e)
+            self._degrade(2)
+            METRICS.inc("cycle_dropped_total")
+            ssn.stats["cycle_dropped"] = 1.0
+            self._finish_cycle(ssn, host_s + (time.time() - t0), wall)
+            return CompletedCycle(ssn)
+        took = time.time() - t0
+        integ = ssn.last_telemetry.get("integrity")
+        if integ is not None:
+            # the drain recovered in place (digest trip / dead readback):
+            # drop to the matching ladder rung for the cooldown window
+            self._note_fault("integrity:" + str(integ.get("reason")),
+                             RuntimeError(str(integ.get("mode"))))
+            self._degrade(2 if integ.get("mode") == "cpu_oracle" else 1)
+        if self.cycle_deadline_s is not None and took > self.cycle_deadline_s:
+            self._note_fault("deadline_drain", TimeoutError(
+                f"drain took {took * 1000:.0f} ms"))
+            self._degrade(1)
         # the AllocateAction readouts the synchronous path records
         ssn.stats["allocated_binds"] = len(ssn.binds)
         ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
         ssn.stats["jobs_pipelined"] = int(
             np.asarray(result.job_pipelined).sum())
-        self._finish_cycle(ssn, host_s + (time.time() - t0), wall)
+        self._finish_cycle(ssn, host_s + took, wall)
         return CompletedCycle(ssn)
 
     def _finish_cycle(self, ssn: Session, host_s: float,
@@ -298,11 +435,17 @@ class Scheduler:
         publish_gauges(METRICS)
         self.cycles += 1
         stats = ssn.stats
+        faults, self._cycle_faults = self._cycle_faults, []
         self.flight.record(
             now=wall, cycle=self.cycles, cycle_ms=round(host_s * 1000, 3),
             binds=len(ssn.binds), evictions=len(ssn.evictions),
             pipelined=len(ssn.pipelined), bind_errors=len(ssn.bind_errors),
             resync_pending=len(self.resync), result=result,
+            # fault-tolerance observability: recovered faults this cycle,
+            # the current ladder rung, and the resync dead-letter depth
+            faults=faults or None,
+            degradation=self.degradation_level,
+            resync_dead_letter=len(self.resync.dead),
             # delta-upload observability: what this cycle actually shipped
             # vs what a full upload would have, and which path it took
             cycle_kind=("delta" if stats.get("delta_cycle") else

@@ -21,7 +21,15 @@ Framing (little-endian):
         ok payload: u32 magic 'VCD1' | u32 T | u32 J |
                     i32[T] task_node | i32[T] task_mode | i32[T] task_gpu |
                     u8[J] job_ready | u8[J] job_pipelined
-        error payload: UTF-8 message
+        error payload: u32 magic 'VCE1' | u32 code | UTF-8 message
+                    (codes distinguish retryable from fatal; pre-VCE1
+                    servers sent the bare message and clients still
+                    accept that)
+
+Pipelined rounds ('VCRQ') prepend an idempotency header (u32 epoch |
+u32 seq) so a round replayed after a reconnect is served from the
+server's response cache instead of double-dispatching; see
+docs/architecture.md "Fault tolerance & degradation ladder".
 
 One request per connection round; connections persist for many cycles.
 """
@@ -48,7 +56,58 @@ PIPELINE_MAGIC = 0x50524356  # "VCRP" — one-deep pipelined round: the
 #                              pipeline on the first round)
 DRAIN_MAGIC = 0x44524356     # "VCRD" — drain the pending pipelined cycle
 #                              (no snapshot payload)
+SEQ_PIPELINE_MAGIC = 0x51524356  # "VCRQ" — pipelined round with an
+#                              idempotency header (u32 epoch | u32 seq)
+#                              ahead of the VCRP payload: the server caches
+#                              the last response per client epoch, so a
+#                              round REPLAYED after a reconnect (the client
+#                              never saw the response) is served from cache
+#                              instead of double-dispatching — the
+#                              one-deep stream survives socket loss intact
+ERROR_MAGIC = 0x31454356     # "VCE1" — structured error payload on
+#                              status=1 frames: u32 magic | u32 code |
+#                              utf-8 message. Lets clients distinguish
+#                              retryable from fatal (the bare stringified
+#                              exception of the old protocol could not).
+# error codes (SidecarError.code)
+ERR_BAD_REQUEST = 2      # fatal: framing/protocol/snapshot decode error
+ERR_INTERNAL = 3         # retryable: the handler failed, state rolled back
+ERR_BACKEND = 4          # retryable after degrade: the accelerator is gone
+ERR_EMPTY_PIPELINE = 5   # benign: VCRD with nothing in flight
 _u32 = struct.Struct("<I")
+
+
+class SidecarError(RuntimeError):
+    """A status!=0 reply, decoded. ``retryable`` is the client's contract:
+    resending the same round is safe (VCRQ rounds are idempotent via the
+    server's replay cache; VCR1 rounds are value-idempotent)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"sidecar error[{code}]: {message}")
+        self.code = code
+        self.message = message
+
+    @property
+    def retryable(self) -> bool:
+        return self.code != ERR_BAD_REQUEST
+
+
+def _error_payload(code: int, message: str) -> bytes:
+    return (_u32.pack(ERROR_MAGIC) + _u32.pack(code)
+            + message.encode("utf-8", "replace"))
+
+
+def _classify_error(e: BaseException) -> int:
+    """Map a handler exception to a wire error code."""
+    from ..chaos.inject import ChaosError
+    if isinstance(e, ChaosError) and e.kind == "backend_loss":
+        return ERR_BACKEND
+    name = type(e).__name__
+    if name in ("XlaRuntimeError",) or "backend" in str(e).lower():
+        return ERR_BACKEND
+    if isinstance(e, (struct.error, ValueError, KeyError, IndexError)):
+        return ERR_BAD_REQUEST
+    return ERR_INTERNAL
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -123,6 +182,16 @@ class SchedulerSidecar:
         #: response carries. Bounded depth 1 by construction — the slot is
         #: drained before the next dispatch.
         self._pending: Optional[dict] = None
+        #: idempotent-replay cache for VCRQ rounds: (epoch, seq,
+        #: (status, payload)) of the last served round. A client that
+        #: reconnected without its response resends the same seq and gets
+        #: this back without a second dispatch; a NEW epoch retires the
+        #: previous stream's pending cycle first (the drain-on-reconnect
+        #: rule for the one-deep stream).
+        self._round_cache: Optional[tuple] = None
+        self._seq_lock = threading.Lock()
+        #: served-round counter, arming per-round chaos faults
+        self._rounds_served = 0
         # opt-in persistent compilation cache ($VOLCANO_JAX_CACHE_DIR or
         # the conf's compilation_cache_dir): restarts stop paying compile_s
         from ..framework.compile_cache import enable_compilation_cache
@@ -181,7 +250,11 @@ class SchedulerSidecar:
         """Dispatch the compiled cycle over the fused tree WITHOUT reading
         the decisions back, taking the device-resident delta path when
         enabled. Returns (packed device handle, "delta"|"full"|None,
-        upload bytes|None). Caller holds _serve_lock."""
+        upload bytes|None, kernel|None, state|None) — kernel/state are the
+        integrity-recovery context for the drain side. Caller holds
+        _serve_lock."""
+        from ..chaos.inject import seam
+        seam("sidecar.dispatch", sidecar=self)
         if self.delta_uploads:
             from ..ops.fused_io import ResidentState, delta_cycle_cached
             kernel = delta_cycle_cached(self._cycle, tree_in, self._delta)
@@ -189,15 +262,41 @@ class SchedulerSidecar:
             if state is None:
                 state = self._states[id(kernel)] = ResidentState()
             packed = kernel.run(state, tree_in)
-            return packed, state.last_kind, state.last_upload_bytes
+            return (packed, state.last_kind, state.last_upload_bytes,
+                    kernel, state)
         from ..ops.fused_io import fused_cycle_cached
         fn, fuse = fused_cycle_cached(self._cycle, tree_in, self._fused)
-        return fn(*fuse(tree_in)), None, None
+        return fn(*fuse(tree_in)), None, None, None, None
+
+    def _verify_integrity(self, packed: np.ndarray, kernel, state, tree_in,
+                          kind, upload):
+        """Strip + check the in-graph integrity digest against the host
+        mirror; on mismatch recover in place (full re-fuse from the round's
+        tree + recompute — decision-neutral). Caller holds _serve_lock.
+        Returns (decisions, kind, upload)."""
+        if kernel is None or not kernel.digest_words:
+            return packed, kind, upload
+        from ..chaos.inject import seam
+        from ..metrics import METRICS
+        seam("sidecar.complete", state=state)
+        dec, dev_digest = kernel.split_digest(packed)
+        host_digest = kernel.mirror_digest(state)
+        if host_digest is None or np.array_equal(dev_digest, host_digest):
+            return dec, kind, upload
+        METRICS.inc("resident_digest_mismatch_total")
+        packed = np.asarray(kernel.recover(state, tree_in), dtype=np.int32)
+        dec, _dig = kernel.split_digest(packed)
+        METRICS.inc("cycle_recoveries_total",
+                    labels={"reason": "digest", "mode": "refuse"})
+        return dec, "recovery", state.last_upload_bytes
 
     def _run_cycle(self, tree_in):
-        """_dispatch_cycle + synchronous readback (the VCR1 path)."""
-        packed, kind, upload = self._dispatch_cycle(tree_in)
-        return np.asarray(packed, dtype=np.int32), kind, upload
+        """_dispatch_cycle + synchronous readback + integrity verify (the
+        VCR1 path)."""
+        packed, kind, upload, kernel, state = self._dispatch_cycle(tree_in)
+        packed = np.asarray(packed, dtype=np.int32)
+        return self._verify_integrity(packed, kernel, state, tree_in,
+                                      kind, upload)
 
     @staticmethod
     def _decisions_payload(packed: np.ndarray, T: int, J: int) -> bytes:
@@ -253,7 +352,10 @@ class SchedulerSidecar:
         — off the response critical path. ``finish`` must be called exactly
         once per served round."""
         import time as _time
+        from ..chaos.inject import seam
         t_start = _time.time()
+        self._rounds_served += 1
+        seam("sidecar.round", round=self._rounds_served)
         tree_in, snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
             self._drain_locked()        # a VCRP round must not be orphaned
@@ -288,13 +390,17 @@ class SchedulerSidecar:
         self._pending = None
         import time as _time
         packed = np.asarray(pending["packed"], dtype=np.int32)
+        packed, kind, upload = self._verify_integrity(
+            packed, pending["kernel"], pending["state"], pending["tree"],
+            pending["kind"], pending["upload"])
         payload = self._decisions_payload(packed, pending["T"],
                                           pending["J"])
         self.flight.record(
             buffer_bytes=pending["buffer_bytes"], tasks=pending["T"],
             jobs=pending["J"], pipelined_round=True,
             cycle_ms=round((_time.time() - pending["t0"]) * 1000, 3),
-            cycle_kind=pending["kind"], upload_bytes=pending["upload"])
+            cycle_kind=kind, upload_bytes=upload,
+            recovered=(kind == "recovery") or None)
         return payload
 
     def schedule_buffer_pipelined(self, buf: bytes,
@@ -309,18 +415,57 @@ class SchedulerSidecar:
         are always handed back (and applied by the API layer) before the
         resident buffers can be overwritten by the round after it."""
         import time as _time
+        from ..chaos.inject import seam
+        self._rounds_served += 1
+        seam("sidecar.round", round=self._rounds_served)
         tree_in, _snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
             prev_payload = self._drain_locked()
-            packed, kind, upload = self._dispatch_cycle(tree_in)
+            packed, kind, upload, kernel, state = \
+                self._dispatch_cycle(tree_in)
             self._pending = dict(packed=packed, T=T, J=J, kind=kind,
                                  upload=upload, t0=_time.time(),
-                                 buffer_bytes=len(buf) + len(extras_buf))
+                                 buffer_bytes=len(buf) + len(extras_buf),
+                                 kernel=kernel, state=state, tree=tree_in)
         if prev_payload is None:
             # priming round: an explicit empty decision payload
             prev_payload = self._decisions_payload(
                 np.zeros(0, np.int32), 0, 0)
         return prev_payload
+
+    def schedule_buffer_seq(self, epoch: int, seq: int, buf: bytes,
+                            extras_buf: bytes = b"") -> Tuple[int, bytes]:
+        """One idempotent pipelined round (VCRQ): like
+        :meth:`schedule_buffer_pipelined`, but keyed by the client's
+        (epoch, seq). Returns ``(status, payload)``.
+
+        - A REPLAYED round (same epoch+seq as the cached one) is served
+          from the cache without touching the pipeline — the reconnect
+          contract: a client that never read its response resends the
+          same seq and the stream continues exactly where it was.
+        - A NEW epoch means a new client stream: the previous stream's
+          pending cycle is retired (drained and discarded) first, so the
+          fresh stream primes cleanly instead of inheriting a stale
+          cycle (the drain-on-reconnect rule).
+        - A failed round caches its error frame too, so the replay of a
+          failed round reports the same failure instead of
+          double-dispatching."""
+        with self._seq_lock:
+            cached = self._round_cache
+            if cached is not None and cached[0] == epoch \
+                    and cached[1] == seq:
+                from ..metrics import METRICS
+                METRICS.inc("sidecar_replayed_rounds_total")
+                return cached[2]
+            if cached is not None and cached[0] != epoch:
+                self.drain_pending()    # retire the stale stream's cycle
+            try:
+                payload = self.schedule_buffer_pipelined(buf, extras_buf)
+                resp = (0, payload)
+            except Exception as e:  # cache the failure for the replay
+                resp = (1, _error_payload(_classify_error(e), str(e)))
+            self._round_cache = (epoch, seq, resp)
+            return resp
 
     def drain_pending(self) -> Optional[bytes]:
         """Retire the in-flight pipelined cycle (VCRD). Returns its VCD1
@@ -346,27 +491,45 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 (magic,) = _u32.unpack(_recv_exact(self.request, 4))
-            except ConnectionError:
+            except (ConnectionError, OSError):
                 return
             if magic == DRAIN_MAGIC:
                 # drain-only round: retire the pending pipelined cycle
-                payload = self.server.sidecar.drain_pending()
+                try:
+                    payload = self.server.sidecar.drain_pending()
+                except Exception as e:
+                    _send_frame(self.request, 1, _error_payload(
+                        _classify_error(e), str(e)))
+                    continue
                 if payload is None:
-                    _send_frame(self.request, 1, b"pipeline empty")
+                    _send_frame(self.request, 1, _error_payload(
+                        ERR_EMPTY_PIPELINE, "pipeline empty"))
                 else:
                     _send_frame(self.request, 0, payload)
                 continue
-            if magic not in (REQUEST_MAGIC, PIPELINE_MAGIC):
-                # old/foreign framing: reply with an error and drop the
-                # connection rather than misreading lengths and hanging
-                _send_frame(self.request, 1,
-                            b"bad request magic (expected VCR1 framing)")
+            if magic not in (REQUEST_MAGIC, PIPELINE_MAGIC,
+                             SEQ_PIPELINE_MAGIC):
+                # old/foreign framing: reply with a structured fatal error
+                # and drop the connection rather than misreading lengths
+                # and hanging
+                _send_frame(self.request, 1, _error_payload(
+                    ERR_BAD_REQUEST,
+                    "bad request magic (expected VCR1 framing)"))
                 return
             try:
+                epoch = seq = None
+                if magic == SEQ_PIPELINE_MAGIC:
+                    (epoch,) = _u32.unpack(_recv_exact(self.request, 4))
+                    (seq,) = _u32.unpack(_recv_exact(self.request, 4))
                 (n,) = _u32.unpack(_recv_exact(self.request, 4))
                 (nx,) = _u32.unpack(_recv_exact(self.request, 4))
                 buf = _recv_exact(self.request, n)
                 extras = _recv_exact(self.request, nx) if nx else b""
+                if magic == SEQ_PIPELINE_MAGIC:
+                    status, payload = self.server.sidecar \
+                        .schedule_buffer_seq(epoch, seq, buf, extras)
+                    _send_frame(self.request, status, payload)
+                    continue
                 if magic == PIPELINE_MAGIC:
                     payload = self.server.sidecar \
                         .schedule_buffer_pipelined(buf, extras)
@@ -378,10 +541,14 @@ class _Handler(socketserver.BaseRequestHandler):
                     .schedule_buffer_deferred(buf, extras)
                 _send_frame(self.request, 0, payload)
                 finish()
-            except ConnectionError:
+            except (ConnectionError, OSError):
                 return
-            except Exception as e:  # report, keep serving
-                _send_frame(self.request, 1, str(e).encode())
+            except Exception as e:
+                # report a STRUCTURED error and keep serving: the handler
+                # never leaks partial state onto the wire, and the client
+                # can tell a retryable failure from a fatal one
+                _send_frame(self.request, 1, _error_payload(
+                    _classify_error(e), str(e)))
 
 
 class SidecarServer(socketserver.ThreadingTCPServer):
@@ -404,25 +571,106 @@ class SidecarServer(socketserver.ThreadingTCPServer):
         return t
 
 
+_CLIENT_EPOCHS = __import__("itertools").count(1)
+
+
 class SidecarClient:
     """The API-layer half: ships ClusterInfo snapshots, maps decisions back
-    to task/job uids (the Binder seam's input)."""
+    to task/job uids (the Binder seam's input).
+
+    Hardened (ISSUE 5): connection establishment and reconnects go through
+    a capped-exponential-backoff-with-jitter helper (runtime/backoff); a
+    socket failure mid-round reconnects and RESENDS the same frame —
+    synchronous rounds are value-idempotent (the delta diff of an
+    unchanged snapshot is empty), and pipelined rounds use the VCRQ
+    idempotency header so the server replays the cached response instead
+    of double-dispatching. ``call_timeout`` bounds each send/recv
+    separately from the (long) connect timeout, so a hung sidecar
+    surfaces as a timeout instead of a stuck API layer.
+    """
 
     def __init__(self, host: str, port: int, timeout: float = 120.0,
-                 conf=None):
+                 conf=None, call_timeout: Optional[float] = None,
+                 backoff=None, reconnect: bool = True,
+                 epoch: Optional[int] = None):
         """``conf`` (YAML text or SchedulerConfiguration) should match the
         server's --scheduler-conf: the client computes the host extras the
         conf needs (affinity masks, ports, volumes) and ships them in the
         VCX1 frame — the API-layer process owns the objects, so it owns
         the object-walking half of the cycle."""
         from ..framework.conf import parse_conf
+        from .backoff import Backoff
         self.conf = (parse_conf(conf) if isinstance(conf, str) else conf)
-        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.host, self.port = host, port
+        self.connect_timeout = timeout
+        #: per-call send/recv timeout; None keeps the connect timeout
+        self.call_timeout = call_timeout
+        self.backoff = backoff if backoff is not None else Backoff()
+        self.reconnect = reconnect
+        #: client stream epoch for the VCRQ idempotency header: unique per
+        #: client instance, so the server can tell a reconnecting client
+        #: (same epoch: replay) from a new one (new epoch: drain the stale
+        #: pipelined cycle first)
+        self._epoch = (int(epoch) if epoch is not None
+                       else ((__import__("os").getpid() << 16)
+                             ^ next(_CLIENT_EPOCHS)) & 0xFFFFFFFF)
+        self._seq = 0
+        self.sock = self._connect()
         #: uid maps of the snapshot whose decisions the NEXT pipelined
         #: response will carry (the client-side half of the one-deep
         #: pipeline: decisions arrive one round late, so they decode with
         #: the maps of the round that produced them)
         self._pipeline_maps = None
+
+    def _connect(self) -> socket.socket:
+        """Establish the connection through the backoff helper (a refused
+        or flaky endpoint is retried with capped exponential delays +
+        jitter instead of failing the constructor on the first miss)."""
+        def connect_once():
+            sock = socket.create_connection(
+                (self.host, self.port), timeout=self.connect_timeout)
+            sock.settimeout(self.call_timeout
+                            if self.call_timeout is not None
+                            else self.connect_timeout)
+            return sock
+        return self.backoff.call(connect_once)
+
+    def _reconnect(self) -> None:
+        from ..metrics import METRICS
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sock = self._connect()
+        METRICS.inc("sidecar_reconnects_total")
+
+    def _roundtrip(self, frame: bytes) -> bytes:
+        """Send one framed request and read the reply; on socket failure
+        reconnect with backoff and resend the SAME frame. A structured
+        server error (SidecarError) is NOT a socket failure and
+        propagates immediately."""
+        from ..chaos.inject import seam
+        attempt = 0
+        while True:
+            try:
+                seam("sidecar.client_send", client=self, frame=frame)
+                self.sock.sendall(frame)
+                seam("sidecar.client_recv", client=self)
+                return self._recv_payload()
+            except SidecarError:
+                raise
+            except (OSError, ConnectionError) as e:
+                attempt += 1
+                if not self.reconnect or attempt >= self.backoff.attempts:
+                    raise
+                import time as _time
+                _time.sleep(self.backoff.delay(attempt - 1))
+                try:
+                    self._reconnect()
+                except OSError as e2:
+                    raise ConnectionError(
+                        f"sidecar unreachable after {attempt} tries: "
+                        f"{e2}") from e
 
     def close(self) -> None:
         self.sock.close()
@@ -432,7 +680,14 @@ class SidecarClient:
         (n,) = _u32.unpack(_recv_exact(self.sock, 4))
         payload = _recv_exact(self.sock, n)
         if status != 0:
-            raise RuntimeError(f"sidecar error: {payload.decode()}")
+            if len(payload) >= 8 \
+                    and _u32.unpack(payload[:4])[0] == ERROR_MAGIC:
+                (code,) = _u32.unpack(payload[4:8])
+                raise SidecarError(code, payload[8:].decode("utf-8",
+                                                            "replace"))
+            # pre-VCE1 server: a bare stringified exception
+            raise SidecarError(ERR_INTERNAL, payload.decode("utf-8",
+                                                            "replace"))
         return payload
 
     @staticmethod
@@ -460,26 +715,38 @@ class SidecarClient:
             "job_pipelined": job_pipelined, "maps": maps,
         }
 
-    def _send_snapshot(self, ci, magic: int):
+    def _snapshot_frame(self, ci, magic: int, header: bytes = b""):
         from ..native.wire import serialize, serialize_extras
         buf, maps = serialize(ci)
         extras = (serialize_extras(ci, maps, self.conf)
                   if self.conf is not None else b"")
-        self.sock.sendall(_u32.pack(magic) + _u32.pack(len(buf))
-                          + _u32.pack(len(extras)) + buf + extras)
-        return maps
+        frame = (_u32.pack(magic) + header + _u32.pack(len(buf))
+                 + _u32.pack(len(extras)) + buf + extras)
+        return frame, maps
 
     def schedule(self, ci) -> Dict[str, object]:
-        maps = self._send_snapshot(ci, REQUEST_MAGIC)
-        return self._decode(self._recv_payload(), maps)
+        frame, maps = self._snapshot_frame(ci, REQUEST_MAGIC)
+        return self._decode(self._roundtrip(frame), maps)
 
     def schedule_pipelined(self, ci) -> Optional[Dict[str, object]]:
-        """One-deep pipelined round (VCRP): ship this snapshot, receive
-        the PREVIOUS round's decisions (decoded with the maps of the round
+        """One-deep pipelined round: ship this snapshot, receive the
+        PREVIOUS round's decisions (decoded with the maps of the round
         that produced them). Returns None on the priming round; finish a
-        stream with :meth:`drain_pipelined`."""
-        maps = self._send_snapshot(ci, PIPELINE_MAGIC)
-        payload = self._recv_payload()
+        stream with :meth:`drain_pipelined`.
+
+        Rounds go out as VCRQ (epoch + monotonically increasing seq): a
+        round resent after a reconnect is replayed from the server's
+        cache, so the one-deep stream survives socket loss with no
+        double-applied cycle. If the SERVER lost its pipeline (restart:
+        the cache is cold and the pipeline empty), the response degrades
+        to a priming empty payload — this round returns None and the
+        stream re-primes, which is the drain-on-reconnect rule's client
+        half."""
+        self._seq += 1
+        frame, maps = self._snapshot_frame(
+            ci, SEQ_PIPELINE_MAGIC,
+            header=_u32.pack(self._epoch) + _u32.pack(self._seq))
+        payload = self._roundtrip(frame)
         prev_maps, self._pipeline_maps = self._pipeline_maps, maps
         T, J = struct.unpack("<II", payload[4:12])
         if prev_maps is None or (T == 0 and J == 0):
@@ -487,11 +754,19 @@ class SidecarClient:
         return self._decode(payload, prev_maps)
 
     def drain_pipelined(self) -> Optional[Dict[str, object]]:
-        """Retire the in-flight pipelined round (VCRD)."""
+        """Retire the in-flight pipelined round (VCRD). Returns None when
+        nothing is in flight — including a server that lost its pipeline
+        (restart), which the structured ERR_EMPTY_PIPELINE code makes
+        distinguishable from a real failure."""
         if self._pipeline_maps is None:
             return None
-        self.sock.sendall(_u32.pack(DRAIN_MAGIC))
-        payload = self._recv_payload()
+        try:
+            payload = self._roundtrip(_u32.pack(DRAIN_MAGIC))
+        except SidecarError as e:
+            if e.code == ERR_EMPTY_PIPELINE:
+                self._pipeline_maps = None
+                return None
+            raise
         maps, self._pipeline_maps = self._pipeline_maps, None
         return self._decode(payload, maps)
 
