@@ -37,7 +37,8 @@ BENCH_LIVE_CPU=1 (measure the CPU baseline at full scale instead of using
 BENCH_BASELINE.json), BENCH_SKIP_CHECK=1 (skip the sub-scale equality
 check), BENCH_FORCE_CPU=1 (skip the TPU probe, run the degraded CPU path),
 BENCH_PROBE_TIMEOUT (seconds, default 150), BENCH_PROBE_RETRIES (default
-3, backoff 5s doubling capped at 60s).
+3, backoff 5s doubling capped at 60s), BENCH_SKIP_MULTICHIP=1 (skip the
+node-axis sharded-cycle comparison subprocess).
 """
 
 from __future__ import annotations
@@ -853,6 +854,35 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             robustness_block = None
 
+    # ---- multichip sharded-cycle block (volcano_tpu/parallel) ------------
+    # The node-axis sharded execution mode (ISSUE 7) measured per device
+    # count against the unsharded oracle on identical churned workloads:
+    # steady-cycle p50, decision-sha equality, and the live
+    # resharding-copy counter (the zero-copy out==in contract). Runs in a
+    # subprocess on the CPU backend with 8 forced virtual devices so a
+    # GSPMD compile failure (or a poisoned TPU parent) can't take the
+    # record down; BENCH_SKIP_MULTICHIP=1 skips, failure records null.
+    multichip_block = None
+    if not os.environ.get("BENCH_SKIP_MULTICHIP"):
+        try:
+            menv = dict(os.environ, JAX_PLATFORMS="cpu",
+                        XLA_FLAGS=os.environ.get(
+                            "XLA_FLAGS",
+                            "--xla_force_host_platform_device_count=8"))
+            proc = subprocess.run(
+                [sys.executable, "-m", "volcano_tpu.parallel", "--bench"],
+                capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=float(os.environ.get("BENCH_MULTICHIP_TIMEOUT",
+                                             600)), env=menv)
+            if proc.returncode in (0, 1):
+                multichip_block = json.loads(proc.stdout)
+                multichip_block["clean"] = proc.returncode == 0
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: multichip block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            multichip_block = None
+
     # ---- graphcheck static-analysis status (volcano_tpu/analysis) --------
     # The perf trajectory carries the static-analysis state alongside the
     # decision fingerprints: a record with graphcheck_clean=false (or
@@ -890,6 +920,7 @@ tiers:
         "graphcheck_sha256": graphcheck_sha,
         "telemetry": telemetry_block,
         "robustness": robustness_block,
+        "multichip": multichip_block,
     }
     if force_cpu:
         out["tpu_unavailable"] = True

@@ -2,8 +2,8 @@
 # Tier-1 verify — the EXACT pytest command from ROADMAP.md, wrapped so the
 # builder, CI, and the driver all run the identical thing, followed by the
 # graphcheck static-analysis gate (scripts/graphcheck.sh --fast — all
-# eight families incl. the telemetry and donation contracts; skip with
-# TIER1_SKIP_GRAPHCHECK=1).
+# nine families incl. the telemetry, donation, and sharded-collective
+# contracts; skip with TIER1_SKIP_GRAPHCHECK=1).
 #
 # Fast deterministic subset: excludes tests marked `slow` (registered in
 # tests/conftest.py; run `pytest -m slow` for the long tail — sharded
@@ -35,6 +35,11 @@ if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
     # decision-sha-identical to the clean run, with the planted
     # resident-state corruption provably tripping the integrity digest
     env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke || crc=$?
+    # the same storm with the node-axis sharded backend (ISSUE 7): fault
+    # recovery and digest discipline must hold per-shard too
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+        python -m volcano_tpu.chaos --smoke --sharded || crc=$?
 fi
 if [ $rc -ne 0 ]; then
     exit $rc

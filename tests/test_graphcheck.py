@@ -229,6 +229,38 @@ class TestDonationFamily:
         assert "fused_io/delta_update" in [t.name for t in graph_traces]
 
 
+class TestShardingFamily:
+    """Family 9 (ISSUE 7): collective discipline of the node-axis sharded
+    cycle. A planted O(nodes) all-gather — a node-sharded tensor forced
+    to a replicated output — must provably fire; the real compiled entry
+    stays green (also covered by the fast_report fixture)."""
+
+    def test_fires_on_planted_allgather(self):
+        from volcano_tpu.analysis.sharding import (_collective_findings,
+                                                   planted_allgather_hlo)
+        hlo = planted_allgather_hlo(n_devices=2, n_nodes=128, cols=4)
+        findings = _collective_findings(hlo, 128, "planted")
+        assert any(f.family == "sharding" and "allgather" in f.key
+                   for f in findings), hlo
+
+    def test_column_gather_is_priced_in(self):
+        """A single node-axis COLUMN all-gather (the scan-carry sync, the
+        collective analog of SelectBestNode) stays below the 2*N
+        threshold and must NOT fire."""
+        from volcano_tpu.analysis.sharding import (_collective_findings,
+                                                   planted_allgather_hlo)
+        hlo = planted_allgather_hlo(n_devices=2, n_nodes=128, cols=1)
+        assert _collective_findings(hlo, 128, "column") == []
+
+    def test_clean_on_real_sharded_entry(self):
+        from volcano_tpu.analysis.sharding import check_sharding
+        assert check_sharding(fast=True) == []
+
+    def test_family_registered(self):
+        from volcano_tpu.analysis import FAMILIES
+        assert "sharding" in FAMILIES
+
+
 class TestDeriveBatchingErrorPaths:
     """Satellite: the documented error paths of the batching authority."""
 

@@ -1,0 +1,191 @@
+"""Node-axis sharded cycle execution (ISSUE 7): ShardedDeltaKernel and
+the conf-driven ``sharding: true`` scheduler path.
+
+Tier-1 (fast) coverage on the 2-device mesh (and the degenerate 1-device
+mesh) — the 8-device sweeps live in test_sharded.py's slow tier:
+
+- scheduler-level decision identity: ``sharding: true`` runs must be
+  sha-identical to the unsharded loop, sync and pipelined, with zero
+  resharding copies recorded on every steady delta cycle,
+- the routed delta scatter: after a cross-shard mutation the resident
+  node buffers on device are bit-identical to a fresh host fuse,
+- per-shard digest discipline: a corrupted mirror block flips EXACTLY
+  its shard's digest word, and ``recover`` restores both the digest and
+  decision identity.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import pytest
+
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.ops.allocate_scan import (AllocateConfig, derive_batching,
+                                           make_allocate_cycle)
+from volcano_tpu.ops.fused_io import (DeltaKernel, ResidentState,
+                                      ShardedDeltaKernel)
+from volcano_tpu.parallel import mesh_for_nodes, node_leaf_mask
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.scheduler import Scheduler
+
+from test_delta_pipeline import decisions_sha, digest
+from test_runtime_incremental import build_cluster, churn
+
+_BODY = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+"""
+PLAIN_CONF = parse_conf(_BODY)
+SHARD1_CONF = parse_conf("sharding: true\nsharding_devices: 1\n" + _BODY)
+SHARD2_CONF = parse_conf("sharding: true\nsharding_devices: 2\n" + _BODY)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="needs the multi-device virtual mesh")
+
+
+def _run_loop(conf, pipeline, cycles=4):
+    cluster = FakeCluster(build_cluster(n_nodes=8, n_jobs=6).clone())
+    sched = Scheduler(cluster, conf=conf, incremental=True,
+                      pipeline=pipeline)
+    digests = []
+    for c in range(cycles):
+        out = sched.run_once(now=1000.0 + c)
+        rec = (sched.drain(now=1000.0 + c) or out) if pipeline else out
+        digests.append(digest(rec))
+        churn(cluster, c, arrivals=True)
+    return decisions_sha(digests), sched
+
+
+class TestShardedSchedulerIdentity:
+    def test_sharded_loops_match_unsharded_sha(self):
+        """2-device and 1-device sharded loops, sync and pipelined, all
+        sha-identical to the unsharded scheduler on identical churn."""
+        shas = {
+            "plain_sync": _run_loop(PLAIN_CONF, False)[0],
+            "shard1_sync": _run_loop(SHARD1_CONF, False)[0],
+            "shard2_sync": _run_loop(SHARD2_CONF, False)[0],
+            "shard2_pipe": _run_loop(SHARD2_CONF, True)[0],
+        }
+        assert len(set(shas.values())) == 1, shas
+
+    def test_steady_cycles_record_zero_resharding_copies(self):
+        """Every steady delta cycle runs on the declared mesh with the
+        live transfer probe reading zero — the out==in zero-copy
+        contract, recorded in the flight ring bench consumes."""
+        _sha, sched = _run_loop(SHARD2_CONF, False)
+        flight = sched.flight.snapshots()
+        deltas = [e for e in flight if e.get("cycle_kind") == "delta"]
+        assert deltas, [e.get("cycle_kind") for e in flight]
+        assert all(e["mesh_devices"] == 2 for e in deltas), flight
+        assert all(e["resharding_copies"] == 0 for e in deltas), flight
+
+    def test_sharding_requires_delta_uploads(self):
+        """``sharding: true`` with delta uploads off is documented as
+        ignored — the loop must still run (unsharded) and match."""
+        conf = parse_conf("sharding: true\ndelta_uploads: false\n" + _BODY)
+        sha, sched = _run_loop(conf, False)
+        assert sha == _run_loop(PLAIN_CONF, False)[0]
+        assert all(e.get("mesh_devices") is None
+                   for e in sched.flight.snapshots())
+
+
+def _kernel_pair():
+    """A 2-device ShardedDeltaKernel + unsharded DeltaKernel oracle over
+    the same small real snapshot."""
+    from volcano_tpu.analysis.entrypoints import _snap_extras
+    snap, extras = _snap_extras((30, 6, 2))
+    cfg = dataclasses.replace(
+        derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+                        has_proportion=False), use_pallas=False)
+    cycle = make_allocate_cycle(cfg)
+    tree = (snap, extras)
+    mesh = mesh_for_nodes(
+        int(np.asarray(snap.nodes.valid).shape[0]), 2)
+    sharded = ShardedDeltaKernel(cycle, tree, mesh, node_leaf_mask(tree),
+                                 entry="fused_cycle_sharded_test")
+    return sharded, DeltaKernel(cycle, tree), tree, snap
+
+
+class TestShardedDeltaScatter:
+    def test_cross_shard_scatter_reproduces_full_fuse(self):
+        """Mutations landing in BOTH shards (plus a replicated rest
+        leaf): the routed scatter must leave the device node buffers
+        bit-identical to a fresh host fuse of the mutated tree."""
+        kernel, oracle, tree, snap = _kernel_pair()
+        state = ResidentState()
+        kernel.run(state, tree)                       # cold full upload
+        idle = np.asarray(snap.nodes.idle)
+        half = kernel.rows_per
+        idle[0] = idle[0] * 0.5                       # shard 0
+        idle[half] = idle[half] * 0.25                # shard 1
+        idle[-1] = idle[-1] + 1.0                     # last row, shard 1
+        prio = np.asarray(snap.tasks.priority)        # rest (replicated)
+        prio[3] = prio[3] + 2
+        packed = np.asarray(kernel.run(state, tree))
+        assert state.last_kind == "delta"
+        fresh = kernel._fuse_sharded(tree)
+        for i, (dev, want) in enumerate(zip(state.device, fresh)):
+            np.testing.assert_array_equal(np.asarray(dev), want,
+                                          err_msg=f"resident {i}")
+        # and the decisions equal the unsharded kernel on the same tree
+        ref = np.asarray(oracle.run(ResidentState(), tree))
+        dec, _ = kernel.split_digest(packed)
+        ref_dec, _ = oracle.split_digest(ref)
+        np.testing.assert_array_equal(dec, ref_dec)
+        idle[0] = idle[0] * 2.0
+        idle[half] = idle[half] * 4.0
+        idle[-1] = idle[-1] - 1.0
+        prio[3] = prio[3] - 2                         # restore shared snap
+
+    def test_empty_shard_padding_is_decision_neutral(self):
+        """A delta touching only ONE shard: the other shard receives pure
+        padding rows, which must scatter to nothing."""
+        kernel, _oracle, tree, snap = _kernel_pair()
+        state = ResidentState()
+        kernel.run(state, tree)
+        idle = np.asarray(snap.nodes.idle)
+        idle[1] = idle[1] * 0.5                       # shard 0 only
+        kernel.run(state, tree)
+        assert state.last_kind == "delta"
+        fresh = kernel._fuse_sharded(tree)
+        for dev, want in zip(state.device, fresh):
+            np.testing.assert_array_equal(np.asarray(dev), want)
+        idle[1] = idle[1] * 2.0
+
+
+class TestPerShardDigestRecovery:
+    def test_corrupt_shard_flips_exactly_its_digest_word(self):
+        """Corrupt one row of the f32 node mirror inside shard 1: only
+        that shard's f-group digest word may change — the per-shard
+        digest localizes corruption without any gather."""
+        kernel, _oracle, tree, _snap = _kernel_pair()
+        state = ResidentState()
+        packed = np.asarray(kernel.run(state, tree))
+        _dec, device_tail = kernel.split_digest(packed)
+        before = kernel.mirror_digest(state)
+        np.testing.assert_array_equal(before, device_tail)
+        # post-dispatch corruption: the mirror drifts from device truth
+        state.mirror[0][kernel.rows_per + 1, 0] += 3.0
+        after = kernel.mirror_digest(state)
+        diff = np.nonzero(before != after)[0]
+        np.testing.assert_array_equal(diff, [1])      # f-group, shard 1
+        assert not np.array_equal(after, device_tail)
+
+    def test_recover_restores_digest_and_decisions(self):
+        kernel, oracle, tree, _snap = _kernel_pair()
+        state = ResidentState()
+        packed0 = np.asarray(kernel.run(state, tree))
+        state.mirror[1][0, 0] += 7                    # i-group, shard 0
+        _dec0, tail0 = kernel.split_digest(packed0)
+        assert not np.array_equal(kernel.mirror_digest(state), tail0)
+        packed = np.asarray(kernel.recover(state, tree))
+        assert state.last_kind == "recovery"
+        dec, tail = kernel.split_digest(packed)
+        np.testing.assert_array_equal(kernel.mirror_digest(state), tail)
+        ref_dec, _ = oracle.split_digest(
+            np.asarray(oracle.run(ResidentState(), tree)))
+        np.testing.assert_array_equal(dec, ref_dec)

@@ -14,7 +14,7 @@ compiled_session conf presets, the ops/ cycle functions, both Pallas
 kernel builders) and turns each class into a CI failure instead of a
 driver-TPU surprise.
 
-Check families (all eight run by default):
+Check families (all nine run by default):
 
 - ``purity``       — no pure_callback/io_callback/debug_callback
                      primitives anywhere in a compiled cycle.
@@ -59,6 +59,16 @@ Check families (all eight run by default):
                      aliased post-scatter memory on TPU), the delta
                      scatter stays device-pure, and delta-ingested
                      decisions are byte-identical to a full upload.
+- ``sharding``     — the node-axis sharded execution mode
+                     (ops/fused_io.ShardedDeltaKernel): the compiled
+                     GSPMD module contains no all-gather whose output
+                     re-materializes O(nodes) state (mesh-sized digest
+                     gathers and single node-axis column syncs are
+                     priced in), the packed decisions leave the entry
+                     fully replicated, and every resident output keeps
+                     its declared input sharding (out == in: the
+                     zero-resharding steady-state contract). Reports
+                     nothing when fewer than two devices are visible.
 
 Run ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh)
 for the CLI; tier-1 runs the same pass via tests/test_graphcheck.py.
@@ -75,7 +85,7 @@ import time
 from typing import List, Optional, Sequence
 
 FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations",
-            "telemetry", "donation")
+            "telemetry", "donation", "sharding")
 
 
 @dataclasses.dataclass
@@ -166,6 +176,10 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     if "donation" in families:
         from .donation import check_donation
         findings += check_donation(fast=fast)
+
+    if "sharding" in families:
+        from .sharding import check_sharding
+        findings += check_sharding(fast=fast)
 
     findings = apply_allowlist(findings)
     blocking = [f for f in findings if not f.allowlisted]

@@ -1,0 +1,174 @@
+"""Graphcheck family 9: node-axis sharded-cycle collective discipline.
+
+The pod-scale execution mode (ops/fused_io.ShardedDeltaKernel +
+parallel/sharding) splits the resident snapshot buffers along the node
+axis and lets GSPMD partition the SAME cycle program the single-device
+jit runs. Correctness is cheap to keep (decisions are bit-identical by
+construction); what silently rots is the *communication volume*: one
+mis-sharded intermediate and the partitioner inserts an all-gather that
+re-materializes an O(nodes) tensor on every device, every cycle — the
+distributed analog of the [M, N] gather class, and invisible to every
+numeric test because the gathered values are correct.
+
+This family compiles the REAL sharded update+cycle entry on a small
+real snapshot and enforces two invariants on the compiled module:
+
+- **no O(nodes) all-gather** — the compiled HLO may contain mesh-sized
+  gathers (per-shard digests, routed-delta bookkeeping) and single
+  node-axis COLUMN gathers (the scan carry syncing one f32[N, 1] score
+  column is the collective analog of SelectBestNode and is priced into
+  the design), but any all-gather whose output reaches 2x the node axis
+  re-materializes multi-column node state and is flagged.
+- **replicated decisions** — the packed decision vector must leave the
+  entry fully replicated: every host reads the same bytes without a
+  collective at readback time, and the per-shard digest tail stays
+  comparable shard-local. Resident outputs must keep their declared
+  input shardings (out == in: the zero inter-iteration resharding
+  contract the live probe in ResidentState counts against).
+
+With fewer than two local devices there is no mesh to audit and the
+family reports nothing (the tier-1 test environment forces 8 virtual
+CPU devices; scripts/graphcheck.sh exports the same default).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from . import Finding
+
+#: all-gather (or its async start half) with its HLO output shape, e.g.
+#: ``%all-gather = f32[128,4]{1,0} all-gather(...`` — shape dims group 1
+_ALLGATHER_RE = re.compile(
+    r"=\s*[a-z0-9]+\[([0-9,]*)\][^ ]*\s+all-gather(?:-start)?\(")
+
+
+def _collective_findings(hlo_text: str, n_nodes: int,
+                         where: str) -> List[Finding]:
+    """Scan compiled HLO text for all-gathers whose output re-materializes
+    O(nodes) state (output elements >= 2 * n_nodes). Shared by the live
+    check and the planted-violation test."""
+    findings: List[Finding] = []
+    seen = set()
+    for m in _ALLGATHER_RE.finditer(hlo_text):
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        elems = 1
+        for d in dims:
+            elems *= d
+        if elems < 2 * n_nodes:
+            continue        # mesh-sized / single-column gathers are priced in
+        shape = "x".join(str(d) for d in dims) or "scalar"
+        if shape in seen:
+            continue
+        seen.add(shape)
+        findings.append(Finding(
+            family="sharding",
+            key=f"sharding:allgather:{where}:{shape}",
+            where=where,
+            what=(f"compiled sharded cycle contains an all-gather with "
+                  f"output shape [{shape}] ({elems} elements >= "
+                  f"2*{n_nodes} nodes) — an O(nodes) re-materialization "
+                  "on every device, every cycle; reshard the producing "
+                  "intermediate instead of gathering it")))
+    return findings
+
+
+def planted_allgather_hlo(n_devices: int = 2, n_nodes: int = 32,
+                          cols: int = 4) -> str:
+    """Compile a deliberately mis-sharded program — a node-sharded
+    (N, cols) input forced to a replicated output — and return its HLO
+    text. The partitioner must insert a full [N, cols] all-gather, which
+    ``_collective_findings`` provably flags (tests/test_graphcheck.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("nodes",))
+    node = NamedSharding(mesh, PartitionSpec("nodes", None))
+    rep = NamedSharding(mesh, PartitionSpec())
+    fn = jax.jit(lambda x: x + 1.0, in_shardings=node, out_shardings=rep)
+    return fn.lower(jax.ShapeDtypeStruct((n_nodes, cols),
+                                         jnp.float32)).compile().as_text()
+
+
+def _audit_kernel(mesh, entry: str):
+    """Build the real sharded update+cycle entry on a small real snapshot
+    (same pack path production uses) over ``mesh``."""
+    import dataclasses
+
+    from ..ops.allocate_scan import (AllocateConfig, derive_batching,
+                                     make_allocate_cycle)
+    from ..ops.fused_io import ShardedDeltaKernel
+    from ..parallel import node_leaf_mask
+    from .entrypoints import _snap_extras
+
+    # the standard audit size (N=128): the node axis must dominate the
+    # task/job axes so the O(tasks+jobs) packed-decision replication
+    # stays clearly below the 2*N threshold
+    snap, extras = _snap_extras()
+    cfg = dataclasses.replace(
+        derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+                        has_proportion=False), use_pallas=False)
+    cycle = make_allocate_cycle(cfg)
+    return ShardedDeltaKernel(cycle, (snap, extras), mesh,
+                              node_leaf_mask((snap, extras)), entry=entry)
+
+
+def check_sharding(fast: bool = False) -> List[Finding]:
+    import jax
+
+    from ..parallel import mesh_for_nodes
+
+    if jax.device_count() < 2:
+        return []               # no mesh to audit on a single device
+    findings: List[Finding] = []
+
+    # fast: the 2-device mesh (cheapest GSPMD compile that still
+    # partitions); full: additionally the widest mesh the node axis
+    # admits, where a mis-sharded intermediate costs the most
+    kernel2 = _audit_kernel(mesh_for_nodes(128, 2), "fused_cycle_shardaudit2")
+    meshes = [(2, kernel2)]
+    if not fast and jax.device_count() >= 4:
+        wide = mesh_for_nodes(128, jax.device_count())
+        d = int(wide.devices.size)
+        if d > 2:
+            meshes.append((d, _audit_kernel(
+                wide, f"fused_cycle_shardaudit{d}")))
+
+    for d, kernel in meshes:
+        where = f"ops/fused_io.ShardedDeltaKernel[{d}dev]"
+        # steady-state delta signature: what every warm cycle compiles
+        compiled = kernel._fn.lower(
+            *kernel.example_delta_args(256)).compile()
+        findings += _collective_findings(compiled.as_text(),
+                                         kernel.n_nodes, where)
+
+        # replicated-decision + out==in resident-sharding discipline
+        out_sh = compiled.output_shardings
+        packed_sh = out_sh[-1]
+        if not packed_sh.is_fully_replicated:
+            findings.append(Finding(
+                family="sharding",
+                key=f"sharding:decisions-not-replicated:{d}dev",
+                where=where,
+                what=("the packed decision output is not fully replicated "
+                      f"(sharding {packed_sh}) — hosts would need a "
+                      "collective (or a cross-device copy) at readback, "
+                      "and per-shard digest words would not be comparable "
+                      "shard-local")))
+        for i, (got, want) in enumerate(zip(out_sh[:6],
+                                            kernel.resident_shardings)):
+            ndim = 2 if i < 3 else 1
+            if not got.is_equivalent_to(want, ndim):
+                findings.append(Finding(
+                    family="sharding",
+                    key=f"sharding:resident-resharded:{d}dev:buf{i}",
+                    where=where,
+                    what=(f"resident output {i} leaves the entry with "
+                          f"sharding {got} instead of its declared input "
+                          f"sharding {want} — every iteration pays a "
+                          "resharding copy, breaking the zero-copy "
+                          "steady-state contract")))
+    return findings

@@ -29,11 +29,15 @@ def main(argv=None) -> int:
     parser.add_argument("--deadline-ms", type=float, default=None,
                         help="per-cycle watchdog deadline (default: off — "
                              "CI machines vary too much for a fixed one)")
+    parser.add_argument("--sharded", action="store_true",
+                        help="run the storm on the node-axis sharded "
+                             "backend (conf sharding: true)")
     args = parser.parse_args(argv)
     from . import run_chaos_probe
     try:
         report = run_chaos_probe(seed=args.seed, cycles=args.cycles,
-                                 deadline_ms=args.deadline_ms)
+                                 deadline_ms=args.deadline_ms,
+                                 sharding=args.sharded)
     except Exception as e:  # harness failure, not a chaos verdict
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
         return 2

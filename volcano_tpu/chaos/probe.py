@@ -95,13 +95,19 @@ def _cycle_digest(rec) -> tuple:
 def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
                     kinds=RECOVERABLE_KINDS,
                     deadline_ms: Optional[float] = None,
-                    slow_s: float = 0.25) -> Dict[str, object]:
-    """Run the probe; returns a JSON-ready robustness report."""
+                    slow_s: float = 0.25,
+                    sharding: bool = False) -> Dict[str, object]:
+    """Run the probe; returns a JSON-ready robustness report.
+
+    ``sharding`` runs both the clean and the fault runs on the node-axis
+    sharded backend (conf ``sharding: true``): fault recovery and the
+    per-shard digest discipline must hold there exactly as on the
+    single-device path."""
     from ..framework.conf import parse_conf
     from ..metrics import METRICS
     from ..runtime.fake_cluster import FakeCluster
     from ..runtime.scheduler import Scheduler
-    conf = parse_conf(_PROBE_CONF)
+    conf = parse_conf(("sharding: true\n" if sharding else "") + _PROBE_CONF)
     base = _small_cluster()
 
     def run(injector):
@@ -136,6 +142,10 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
         "seed": seed,
         "cycles": cycles,
         "pipeline": pipeline,
+        "sharding": sharding,
+        "mesh_devices": next(
+            (int(e["mesh_devices"]) for e in reversed(flight)
+             if e.get("mesh_devices") is not None), None),
         "kinds": list(kinds),
         "fault_schedule_sha": plan.schedule_sha(),
         "faults_fired": len(injector.fired),

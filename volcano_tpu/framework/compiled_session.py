@@ -81,7 +81,8 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
         **weights), has_proportion=has_proportion)
 
 
-def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):
+def make_conf_cycle(conf: Optional[object] = None, hierarchy=None,
+                    cfg_overrides: Optional[dict] = None):
     """conf (SchedulerConfiguration | YAML text | None) -> jittable
     cycle(snap, hierarchy=None, base_extras=None) -> AllocateResult with
     in-graph plugin extras.
@@ -103,6 +104,12 @@ def make_conf_cycle(conf: Optional[object] = None, hierarchy=None):
         sc = conf
     options = {opt.name: opt for opt in _plugin_options(sc)}
     cfg = allocate_config_from_conf(sc)
+    if cfg_overrides:
+        # the sharded sidecar path forces use_pallas=False here: GSPMD
+        # has no partitioning rule for the pallas custom call (see
+        # parallel/sharding.make_sharded_allocate)
+        import dataclasses as _dc
+        cfg = _dc.replace(cfg, **cfg_overrides)
     allocate = make_allocate_cycle(cfg)
     proportion_on = "proportion" in options
     baked_hierarchy = hierarchy

@@ -111,6 +111,20 @@ class SchedulerConfiguration:
     #: must exceed the cold-compile cycle). YAML: top-level
     #: ``cycle_deadline_ms: 500``.
     cycle_deadline_ms: Optional[float] = None
+    #: node-axis sharded execution over a device mesh (ISSUE 7,
+    #: parallel/sharding + ops/fused_io.ShardedDeltaKernel): the resident
+    #: snapshot buffers split along the node axis, deltas route to the
+    #: owning shard, and the cycle runs under GSPMD with
+    #: out_shardings == in_shardings across iterations. Decisions are
+    #: bit-identical to the unsharded path. Requires the delta path
+    #: (``delta_uploads: true``, the default) — with delta uploads off the
+    #: knob is ignored. YAML: top-level ``sharding: true``.
+    sharding: bool = False
+    #: device-count cap for the sharded mesh (None = all local devices);
+    #: the effective mesh is the largest power of two <= this that divides
+    #: the packed node axis (parallel/sharding.mesh_for_nodes). YAML:
+    #: top-level ``sharding_devices: 8``.
+    sharding_devices: Optional[int] = None
 
     def plugin_option(self, name: str) -> Optional[PluginOption]:
         for tier in self.tiers:
@@ -162,6 +176,9 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     sc.compilation_cache_dir = str(cache_dir) if cache_dir else None
     ddl = data.get("cycle_deadline_ms")
     sc.cycle_deadline_ms = float(ddl) if ddl else None
+    sc.sharding = bool(data.get("sharding", False))
+    sd = data.get("sharding_devices")
+    sc.sharding_devices = int(sd) if sd is not None else None
     raw_actions = data.get("actions", "enqueue, allocate, backfill")
     if isinstance(raw_actions, str):
         sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]

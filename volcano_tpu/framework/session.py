@@ -94,6 +94,18 @@ def _delta_allocate(cfg: AllocateConfig, snap, extras):
                               _DELTA_CACHE, key_extra=cfg)
 
 
+#: same key + mesh identity -> ShardedDeltaKernel (conf ``sharding: true``):
+#: node-axis residents over a device mesh, deltas routed to owning shards
+#: (ops/fused_io.ShardedDeltaKernel via parallel/sharding)
+_SHARDED_DELTA_CACHE: Dict[tuple, object] = {}
+
+
+def _sharded_delta_allocate(cfg: AllocateConfig, snap, extras, mesh):
+    from ..parallel.sharding import sharded_delta_allocate_cached
+    return sharded_delta_allocate_cached(cfg, (snap, extras), mesh,
+                                         _SHARDED_DELTA_CACHE)
+
+
 @dataclasses.dataclass
 class PendingAllocate:
     """An in-flight dispatched allocate cycle: the device handle of the
@@ -788,13 +800,31 @@ class Session:
             cfg = dataclasses.replace(cfg, enable_gpu=False)
         return cfg, extras
 
+    def _sharding_mesh(self):
+        """The device mesh the allocate cycle runs on, or None when the
+        conf leaves sharding off (or the delta path — the only residency
+        the sharded kernel supports — is disabled). Sized per the CURRENT
+        snapshot's node bucket (parallel/sharding.mesh_for_nodes), so a
+        shape-bucket change re-picks a dividing mesh."""
+        if not bool(getattr(self.conf, "sharding", False)):
+            return None
+        if not bool(getattr(self.conf, "delta_uploads", True)):
+            return None
+        from ..parallel.sharding import mesh_for_nodes
+        n_nodes = int(np.asarray(self.snap.nodes.valid).shape[0])
+        return mesh_for_nodes(n_nodes,
+                              getattr(self.conf, "sharding_devices", None))
+
     def warm_allocate(self) -> None:
         """AOT-compile the allocate entry for the current shape bucket
         WITHOUT executing a cycle — the cold-start hook (pair with
         framework/compile_cache: a restarted scheduler stops paying
         ``compile_s`` on its first real cycle)."""
         cfg, extras = self._derived_allocate_inputs()
-        if bool(getattr(self.conf, "delta_uploads", True)):
+        mesh = self._sharding_mesh()
+        if mesh is not None:
+            _sharded_delta_allocate(cfg, self.snap, extras, mesh).warm()
+        elif bool(getattr(self.conf, "delta_uploads", True)):
             _delta_allocate(cfg, self.snap, extras).warm()
         else:
             from ..ops.fused_io import _TARGETS, fuse_spec, group_sizes
@@ -826,8 +856,18 @@ class Session:
         if bool(getattr(self.conf, "delta_uploads", True)):
             # device-resident buffers + packed delta scatter: steady-state
             # upload is O(changed elements); full re-fuse only on the
-            # first cycle of a shape bucket or when the diff is huge
-            kernel = _delta_allocate(cfg, self.snap, extras)
+            # first cycle of a shape bucket or when the diff is huge.
+            # With conf ``sharding: true`` the residents split along the
+            # node axis over a device mesh (ShardedDeltaKernel): deltas
+            # route to the owning shard, the digest verifies per shard,
+            # and out_shardings == in_shardings keeps the steady loop
+            # free of resharding copies (probe-counted below).
+            mesh = self._sharding_mesh()
+            if mesh is not None:
+                kernel = _sharded_delta_allocate(cfg, self.snap, extras,
+                                                 mesh)
+            else:
+                kernel = _delta_allocate(cfg, self.snap, extras)
             state = self._resident.get(id(kernel))
             if state is None:
                 from ..ops.fused_io import ResidentState
@@ -836,6 +876,10 @@ class Session:
             self.stats["upload_bytes"] = float(state.last_upload_bytes)
             self.stats["upload_bytes_full"] = float(state.full_upload_bytes)
             self.stats["delta_cycle"] = float(state.last_kind == "delta")
+            if mesh is not None:
+                self.stats["mesh_devices"] = float(mesh.devices.size)
+                self.stats["resharding_copies"] = float(
+                    state.resharding_copies)
             from ..metrics import METRICS
             METRICS.inc("cycle_upload_bytes", state.last_upload_bytes,
                         labels={"kind": state.last_kind})

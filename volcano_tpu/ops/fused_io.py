@@ -256,9 +256,13 @@ def _device_digest(fbuf, ibuf, bbuf) -> jax.Array:
     return jax.lax.bitcast_convert_type(jnp.stack(words), jnp.int32)
 
 
-def donation_for_backend(platform: Optional[str] = None) -> tuple:
+def donation_for_backend(platform: Optional[str] = None,
+                         n_residents: int = 3) -> tuple:
     """The donate_argnums the delta update+cycle entry uses on this
-    backend: the three resident buffers on accelerators, nothing on CPU.
+    backend: the resident buffers on accelerators, nothing on CPU
+    (``n_residents`` is 3 for the flat :class:`DeltaKernel`, 6 for the
+    node/rest split of :class:`ShardedDeltaKernel` — the contract is the
+    same either way, and pjit threads the donation through per-shard).
 
     On TPU/GPU, execution is stream-async regardless and donation lets XLA
     scatter into the resident buffers in place — the whole point of
@@ -271,7 +275,7 @@ def donation_for_backend(platform: Optional[str] = None) -> tuple:
     if platform is None:
         import jax
         platform = jax.default_backend()
-    return () if platform == "cpu" else (0, 1, 2)
+    return () if platform == "cpu" else tuple(range(n_residents))
 
 
 class ResidentState:
@@ -291,7 +295,7 @@ class ResidentState:
 
     __slots__ = ("mirror", "scratch", "device", "retiring", "full_cycles",
                  "delta_cycles", "last_kind", "last_upload_bytes",
-                 "full_upload_bytes")
+                 "full_upload_bytes", "resharding_copies")
 
     def __init__(self):
         self.mirror: Optional[tuple] = None
@@ -309,6 +313,12 @@ class ResidentState:
         #: what a full upload of this shape bucket ships (the comparison
         #: column bench records next to the delta bytes)
         self.full_upload_bytes = 0
+        #: live transfer probe (ShardedDeltaKernel): number of delta
+        #: dispatches whose resident inputs did NOT already carry the
+        #: declared in_shardings — each one is a resharding copy pjit
+        #: would silently insert. Steady-state contract: stays 0, because
+        #: out_shardings == in_shardings across iterations.
+        self.resharding_copies = 0
 
 
 class DeltaKernel:
@@ -543,5 +553,443 @@ def delta_cycle_cached(cycle_fn, tree, cache: Dict, key_extra=None,
     hit = cache.get(key)
     if hit is None:
         hit = DeltaKernel(cycle_fn, tree, entry=entry)
+        cache[key] = hit
+    return hit
+
+
+# --------------------------------------------------------------------------
+# Sharded delta path: node-axis residents over a device mesh (ISSUE 7)
+# --------------------------------------------------------------------------
+# The flat DeltaKernel assumes one addressable buffer per dtype group; a
+# device mesh breaks all three of its contracts at once (the scatter would
+# gather, the digest would all-gather, the donation would alias across
+# shards). ShardedDeltaKernel re-cuts the residency along the node axis:
+#
+# - each dtype group splits into a NODE buffer shaped (N, C_g) — row n is
+#   the concatenation of every node leaf's row n — sharded
+#   ``P(nodes, None)``, plus a flat replicated REST buffer for the
+#   task/job/queue leaves (6 residents total);
+# - packed (idx, vals) deltas for the node region are ROUTED host-side to
+#   the owning shard: a (D, B) array sharded ``P(nodes, None)`` ships each
+#   shard only its own rows' updates, and a shard_map scatter applies them
+#   with local row offsets (an out-of-shard index maps to the
+#   positive-out-of-bounds row so drop-mode discards it — negative indices
+#   WRAP in XLA scatter, so they are never used as the discard);
+# - the integrity digest becomes a per-shard digest VECTOR: each shard
+#   digests its local block with shard-local positions, so verification
+#   never all-gathers a node buffer (the (D,) digest words riding the
+#   packed readback are O(mesh), not O(nodes));
+# - the 6 residents are donated through pjit on accelerator backends
+#   (donation_for_backend with n_residents=6), and
+#   out_shardings == in_shardings for every resident, so the steady loop
+#   never reshard-copies — verified live by the resharding probe
+#   (ResidentState.resharding_copies).
+
+def sharded_fuse_spec(tree, node_mask):
+    """(treedef, per-leaf (group, region, offset, shape, dtype),
+    n_nodes, node_cols{g}, rest_sizes{g}) for a pytree whose leaves are
+    flagged node-axis (True) or replicated (False) by ``node_mask``.
+    Node offsets are COLUMN offsets into the (N, C_g) node buffer; rest
+    offsets are element offsets into the flat rest buffer — the single
+    source of truth for the sharded full and delta paths."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if len(leaves) != len(node_mask):
+        raise ValueError(f"node_mask has {len(node_mask)} entries for "
+                         f"{len(leaves)} leaves")
+    node_cols = {g: 0 for g in _GROUPS}
+    rest_off = {g: 0 for g in _GROUPS}
+    n_nodes = None
+    spec = []
+    for leaf, is_node in zip(leaves, node_mask):
+        arr = np.asarray(leaf)
+        g = _group_of(arr.dtype)
+        if is_node:
+            if arr.ndim == 0 or arr.shape[0] == 0:
+                raise ValueError("node leaf must have a leading node axis")
+            if n_nodes is None:
+                n_nodes = int(arr.shape[0])
+            elif int(arr.shape[0]) != n_nodes:
+                raise ValueError("node leaves disagree on the node axis: "
+                                 f"{arr.shape[0]} vs {n_nodes}")
+            cols = arr.size // n_nodes
+            spec.append((g, "node", node_cols[g], arr.shape, arr.dtype))
+            node_cols[g] += cols
+        else:
+            spec.append((g, "rest", rest_off[g], arr.shape, arr.dtype))
+            rest_off[g] += arr.size
+    if n_nodes is None:
+        raise ValueError("node_mask marks no leaves as node-axis")
+    return treedef, spec, n_nodes, node_cols, rest_off
+
+
+class ShardedDeltaKernel:
+    """Node-axis sharded delta-update + cycle entry over a device mesh.
+
+    Duck-type compatible with :class:`DeltaKernel` (run / warm / recover /
+    split_digest / mirror_digest / traceable / example_delta_args /
+    digest_words / donate_argnums), so the Session, the pipelined
+    Scheduler, and the sidecar swap it in by construction alone. The
+    jitted entry takes the six residents (node f/i/b sharded
+    ``P(nodes, None)``, rest f/i/b replicated; all donated on
+    accelerators) plus per-group routed node deltas and replicated rest
+    deltas:
+
+        (fnode', inode', bnode', frest', irest', brest', packed) = fn(
+            fnode, inode, bnode, frest, irest, brest,
+            fn_idx, fn_vals, in_idx, in_vals, bn_idx, bn_vals,
+            fr_idx, fr_vals, ir_idx, ir_vals, br_idx, br_vals)
+
+    Decisions are bit-identical to the unsharded path by construction:
+    the routed scatter reproduces exactly the elements the host diff
+    found changed, and GSPMD partitions the same cycle program the
+    single-device jit runs.
+    """
+
+    def __init__(self, cycle_fn, example_tree, mesh, node_mask,
+                 entry: str = "fused_cycle_sharded", integrity: bool = True):
+        from jax.sharding import NamedSharding, PartitionSpec
+        self.mesh = mesh
+        self.axis = mesh.axis_names[0]
+        self.n_shards = D = int(np.prod(mesh.devices.shape))
+        (self.treedef, self.spec, self.n_nodes, self.node_cols,
+         self.rest_sizes) = sharded_fuse_spec(example_tree, node_mask)
+        if self.n_nodes % D != 0:
+            raise ValueError(
+                f"node axis {self.n_nodes} does not divide the "
+                f"{D}-device mesh — pick a mesh size via "
+                "parallel.sharding.mesh_for_nodes")
+        self.rows_per = self.n_nodes // D
+        self.entry = entry
+        #: i32 words on the packed readback: one digest word per dtype
+        #: group PER SHARD for the node residents (compared shard-local —
+        #: never an O(N) all-gather) plus the 3 flat rest words
+        self.digest_words = (3 * D + DIGEST_WORDS) if integrity else 0
+        self.donate_argnums = donation_for_backend(n_residents=6)
+        self._node_sh = NamedSharding(mesh, PartitionSpec(self.axis, None))
+        self._rep_sh = NamedSharding(mesh, PartitionSpec())
+        #: declared shardings of the six residents, in argument order —
+        #: the live resharding probe compares dispatched handles against
+        #: exactly these
+        self.resident_shardings = (self._node_sh,) * 3 + (self._rep_sh,) * 3
+        self._total_elems = int(
+            sum(self.n_nodes * self.node_cols[g] + self.rest_sizes[g]
+                for g in _GROUPS))
+        unfuse = self._make_unfuse()
+        scatters = {g: self._make_node_scatter(g) for g in _GROUPS}
+
+        def _update_cycle(fnode, inode, bnode, frest, irest, brest,
+                          fn_idx, fn_vals, in_idx, in_vals, bn_idx, bn_vals,
+                          fr_idx, fr_vals, ir_idx, ir_vals, br_idx, br_vals):
+            fnode, fdig = scatters["f"](fnode, fn_idx, fn_vals)
+            inode, idig = scatters["i"](inode, in_idx, in_vals)
+            bnode, bdig = scatters["b"](bnode, bn_idx, bn_vals)
+            frest = frest.at[fr_idx].set(fr_vals)
+            irest = irest.at[ir_idx].set(ir_vals)
+            brest = brest.at[br_idx].set(br_vals)
+            args = unfuse(fnode, inode, bnode, frest, irest, brest)
+            packed = cycle_fn(*args).packed_decisions()
+            if integrity:
+                node_tail = jax.lax.bitcast_convert_type(
+                    jnp.concatenate([fdig, idig, bdig]), jnp.int32)
+                packed = jnp.concatenate(
+                    [packed, node_tail,
+                     _device_digest(frest, irest, brest)])
+            return fnode, inode, bnode, frest, irest, brest, packed
+
+        in_sh = (self.resident_shardings
+                 + (self._node_sh, self._node_sh) * 3
+                 + (self._rep_sh, self._rep_sh) * 3)
+        #: out_shardings == in_shardings for every resident — the zero
+        #: inter-iteration resharding contract the probe verifies live
+        out_sh = self.resident_shardings + (self._rep_sh,)
+        from ..telemetry import counted_jit
+        self._fn = counted_jit(_update_cycle, entry,
+                               donate_argnums=self.donate_argnums,
+                               in_shardings=in_sh, out_shardings=out_sh)
+
+    # ------------------------------------------------------------ programs
+    def _make_unfuse(self) -> Callable:
+        """Device-side: six residents -> pytree. Node leaves are COLUMN
+        slices of the (N, C_g) node buffer — a column slice of a
+        row-sharded array stays row-sharded, so the cycle's node tensors
+        enter GSPMD split exactly as make_sharded_allocate declares."""
+        spec, treedef, N = self.spec, self.treedef, self.n_nodes
+
+        def unfuse(fnode, inode, bnode, frest, irest, brest):
+            node = {"f": fnode, "i": inode, "b": bnode}
+            rest = {"f": frest, "i": irest, "b": brest}
+            leaves = []
+            for g, region, off, shape, dtype in spec:
+                size = int(np.prod(shape)) if shape else 1
+                if region == "node":
+                    cols = size // N
+                    leaf = (node[g][:, off:off + cols]
+                            .reshape(shape).astype(dtype))
+                else:
+                    leaf = (rest[g][off:off + size]
+                            .reshape(shape).astype(dtype))
+                leaves.append(leaf)
+            return jax.tree.unflatten(treedef, leaves)
+
+        return unfuse
+
+    def _make_node_scatter(self, g: str) -> Callable:
+        """shard_map scatter + per-shard digest for one node buffer.
+
+        Each shard receives ONLY its routed (1, B) delta rows, rebases the
+        global flat indices to local (row, col), and scatters into its
+        local block; the per-shard digest uses LOCAL positions so the host
+        can recompute it per mirror block without any gather."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        C, rows_per, axis = self.node_cols[g], self.rows_per, self.axis
+
+        def local(nb, idx, vals):
+            idx, vals = idx[0], vals[0]
+            if C:
+                base = (jax.lax.axis_index(axis) * rows_per).astype(idx.dtype)
+                r = idx // C - base
+                c = idx % C
+                # out-of-shard (and padding) rows map to the positive
+                # out-of-bounds row: drop-mode discards them. Negative
+                # indices WRAP in XLA scatter — never rely on them to drop.
+                r = jnp.where((r >= 0) & (r < rows_per), r, rows_per)
+                nb = nb.at[r, c].set(vals, mode="drop")
+            if nb.dtype == jnp.bool_:
+                w = nb.reshape(-1).astype(jnp.uint32)
+            else:
+                w = jax.lax.bitcast_convert_type(nb.reshape(-1), jnp.uint32)
+            pos = jnp.arange(w.shape[0], dtype=jnp.uint32)
+            dig = jnp.sum(w * (pos * _DIGEST_MUL + _DIGEST_ADD),
+                          dtype=jnp.uint32)
+            return nb, dig[None]
+
+        return shard_map(local, mesh=self.mesh,
+                         in_specs=(P(self.axis, None), P(self.axis, None),
+                                   P(self.axis, None)),
+                         out_specs=(P(self.axis, None), P(self.axis)))
+
+    # --------------------------------------------------------------- fuse
+    def _fuse_sharded(self, tree, out=None):
+        """Host-side pack into the six buffers (node buffers node-major
+        2-D, rest flat); ``out`` reuses the ping-pong scratch."""
+        N = self.n_nodes
+        if out is None:
+            out = tuple(
+                [np.empty((N, self.node_cols[g]), _TARGETS[g])
+                 for g in _GROUPS]
+                + [np.empty(self.rest_sizes[g], _TARGETS[g])
+                   for g in _GROUPS])
+        node = dict(zip(_GROUPS, out[:3]))
+        rest = dict(zip(_GROUPS, out[3:]))
+        for leaf, (g, region, off, _shape, _dtype) in zip(
+                jax.tree.leaves(tree), self.spec):
+            arr = np.asarray(leaf)
+            if region == "node":
+                cols = arr.size // N
+                node[g][:, off:off + cols] = arr.reshape(N, cols)
+            else:
+                rest[g][off:off + arr.size] = arr.ravel()
+        return out
+
+    def _route(self, idx: np.ndarray, vals: np.ndarray, g: str):
+        """Route a node-region flat delta to owning shards: (D, B) idx and
+        vals arrays whose row s holds ONLY shard s's updates (padded by
+        repeating the shard's last real pair, or — for an empty shard —
+        by an index that rebases to the local out-of-bounds row, which
+        drop-mode discards). Uploaded ``P(nodes, None)``, each device
+        receives exactly its own row."""
+        D, C, rows_per = self.n_shards, self.node_cols[g], self.rows_per
+        if idx.size == 0 or C == 0:
+            return (np.zeros((D, 0), np.int32),
+                    np.zeros((D, 0), _TARGETS[g]))
+        shard = (idx // C) // rows_per
+        counts = np.bincount(shard, minlength=D)
+        B = delta_bucket(int(counts.max()))
+        pidx = np.empty((D, B), np.int32)
+        pvals = np.empty((D, B), _TARGETS[g])
+        for s in range(D):
+            m = shard == s
+            si, sv = idx[m], vals[m]
+            if si.size:
+                fi, fv = _pad_delta(si, sv, B)
+            else:
+                # local row == rows_per after rebasing -> dropped
+                fi = np.full(B, (s + 1) * rows_per * C, np.int32)
+                fv = np.zeros(B, _TARGETS[g])
+            pidx[s], pvals[s] = fi, fv
+        return pidx, pvals
+
+    # ---------------------------------------------------------- graphcheck
+    @property
+    def traceable(self) -> Callable:
+        """The raw (unjitted) update+cycle body, for jaxpr-level analysis."""
+        return self._fn.__wrapped__
+
+    def example_delta_args(self, bucket: int = _DELTA_MIN_BUCKET):
+        """Concrete example inputs for tracing/compiling the entry:
+        zero residents plus ``bucket``-sized no-op deltas per non-empty
+        region (``bucket=0`` is the full-upload signature)."""
+        N, D = self.n_nodes, self.n_shards
+        args = [np.zeros((N, self.node_cols[g]), _TARGETS[g])
+                for g in _GROUPS]
+        args += [np.zeros(self.rest_sizes[g], _TARGETS[g]) for g in _GROUPS]
+        for g in _GROUPS:
+            b = bucket if self.node_cols[g] else 0
+            args.append(np.zeros((D, b), np.int32))
+            args.append(np.zeros((D, b), _TARGETS[g]))
+        for g in _GROUPS:
+            b = bucket if self.rest_sizes[g] else 0
+            args.append(np.zeros(b, np.int32))
+            args.append(np.zeros(b, _TARGETS[g]))
+        return tuple(args)
+
+    def warm(self, bucket: int = 0) -> None:
+        """AOT-compile the sharded entry for this shape bucket."""
+        avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in self.example_delta_args(bucket))
+        self._fn.lower(*avals).compile()
+
+    # ----------------------------------------------- integrity + recovery
+    def split_digest(self, packed: np.ndarray):
+        """Split a host readback into (decisions, u32[3D+3] digest
+        vector: per-shard node words then the flat rest words)."""
+        if not self.digest_words:
+            return packed, None
+        tail = np.ascontiguousarray(packed[-self.digest_words:])
+        return packed[:-self.digest_words], tail.view(np.uint32)
+
+    def mirror_digest(self, state: "ResidentState"):
+        """Host half of the per-shard integrity check: digest each
+        shard's block of the mirrored node buffers with SHARD-LOCAL
+        positions (mirroring the shard_map computation exactly), then the
+        flat rest buffers."""
+        if state.mirror is None:
+            return None
+        D, rows_per = self.n_shards, self.rows_per
+        words = []
+        for nb in state.mirror[:3]:
+            for s in range(D):
+                blk = nb[s * rows_per:(s + 1) * rows_per].ravel()
+                w = (blk.astype(np.uint32) if blk.dtype == np.bool_
+                     else np.ascontiguousarray(blk).view(np.uint32))
+                pos = np.arange(w.size, dtype=np.uint32)
+                words.append(np.sum(w * (pos * _DIGEST_MUL + _DIGEST_ADD),
+                                    dtype=np.uint32))
+        return np.concatenate([np.array(words, np.uint32),
+                               host_digest(state.mirror[3:])])
+
+    def recover(self, state: "ResidentState", tree):
+        """Integrity recovery: full re-fuse from SOURCE truth +
+        recompute, same contract as :meth:`DeltaKernel.recover` (heals
+        both a corrupted shard and a drifted mirror; decision-neutral)."""
+        if state.device is not None:
+            self._invalidate(state.device)
+            state.device = None
+        state.mirror = None
+        packed = self.run(state, tree, force_full=True)
+        state.last_kind = "recovery"
+        return packed
+
+    _reset_state = DeltaKernel._reset_state
+    _invalidate = DeltaKernel._invalidate
+
+    # ------------------------------------------------------------- running
+    def _probe_resharding(self, state: "ResidentState") -> None:
+        """Live transfer probe: a resident about to be re-dispatched whose
+        device sharding is not the declared in_sharding means pjit will
+        insert a resharding copy this cycle. Counted, never raised — the
+        cycle is still correct, just not zero-copy."""
+        copies = 0
+        for h, sh in zip(state.device, self.resident_shardings):
+            try:
+                if not h.sharding.is_equivalent_to(sh, h.ndim):
+                    copies += 1
+            except Exception:  # non-array handle: let the dispatch decide
+                pass
+        if copies:
+            state.resharding_copies += copies
+            from ..metrics import METRICS
+            METRICS.inc("sharded_resharding_copies_total", copies)
+
+    def run(self, state: ResidentState, tree, force_full: bool = False):
+        """One sharded cycle: pack ``tree``, ship full residents (explicit
+        device_put per declared sharding) or routed deltas, shard-local
+        scatter + cycle on device. Same residency/invalidate/ping-pong
+        contract as :meth:`DeltaKernel.run`."""
+        seam("delta.run", kernel=self, state=state)
+        self._invalidate(state.retiring)
+        state.retiring = ()
+        bufs = self._fuse_sharded(tree, out=state.scratch)
+        state.scratch = None
+        full_bytes = int(sum(b.nbytes for b in bufs))
+        deltas = None
+        if state.mirror is not None and state.device is not None \
+                and not force_full:
+            deltas = []
+            total = 0
+            for new, old in zip(bufs, state.mirror):
+                idx = np.flatnonzero(new.ravel() != old.ravel()) \
+                        .astype(np.int32)
+                deltas.append((idx, new.ravel()[idx]))
+                total += int(idx.size)
+            if 2 * total >= self._total_elems:
+                deltas = None
+        if deltas is None:
+            if state.device is not None:
+                self._invalidate(state.device)
+            dev = tuple(jax.device_put(b, sh)
+                        for b, sh in zip(bufs, self.resident_shardings))
+            args = []
+            for g in _GROUPS:
+                args += [np.zeros((self.n_shards, 0), np.int32),
+                         np.zeros((self.n_shards, 0), _TARGETS[g])]
+            for g in _GROUPS:
+                args += [np.zeros(0, np.int32), np.zeros(0, _TARGETS[g])]
+            state.full_cycles += 1
+            state.last_kind = "full"
+            state.last_upload_bytes = full_bytes
+        else:
+            self._probe_resharding(state)
+            dev = state.device
+            args = []
+            upload = 0
+            for (idx, vals), g in zip(deltas[:3], _GROUPS):
+                pidx, pvals = self._route(idx, vals, g)
+                args += [pidx, pvals]
+                upload += int(pidx.nbytes + pvals.nbytes)
+            for (idx, vals) in deltas[3:]:
+                pidx, pvals = _pad_delta(idx, vals, delta_bucket(idx.size))
+                args += [pidx, pvals]
+                upload += int(pidx.nbytes + pvals.nbytes)
+            state.delta_cycles += 1
+            state.last_kind = "delta"
+            state.last_upload_bytes = upload
+        state.full_upload_bytes = full_bytes
+        try:
+            out = self._fn(*dev, *args)
+        except Exception:
+            self._reset_state(state)
+            raise
+        packed = out[-1]
+        state.retiring = dev
+        state.device = tuple(out[:-1])
+        state.scratch, state.mirror = state.mirror, bufs
+        return packed
+
+
+def sharded_delta_cycle_cached(cycle_fn, tree, mesh, node_mask, cache: Dict,
+                               key_extra=None,
+                               entry: str = "fused_cycle_sharded"
+                               ) -> ShardedDeltaKernel:
+    """Shape-signature-memoized ShardedDeltaKernel; the cache key extends
+    :func:`_shape_key` with the mesh's device identity so two meshes never
+    share a kernel (their shardings — and compiled programs — differ)."""
+    mesh_key = tuple(d.id for d in mesh.devices.ravel())
+    key = _shape_key(tree, (key_extra, mesh_key))
+    hit = cache.get(key)
+    if hit is None:
+        hit = ShardedDeltaKernel(cycle_fn, tree, mesh, node_mask,
+                                 entry=entry)
         cache[key] = hit
     return hit

@@ -1,8 +1,11 @@
 """Device-mesh parallelism for the scheduling cycle."""
 
-from .sharding import (make_sharded_allocate, make_sharded_preempt,
-                       node_sharding_specs,
-                       scheduler_mesh)
+from .sharding import (make_sharded_allocate, make_sharded_delta,
+                       make_sharded_preempt, mesh_for_nodes, node_leaf_mask,
+                       node_sharding_specs, scheduler_mesh,
+                       sharded_delta_allocate_cached)
 
-__all__ = ["make_sharded_allocate", "make_sharded_preempt",
-           "node_sharding_specs", "scheduler_mesh"]
+__all__ = ["make_sharded_allocate", "make_sharded_delta",
+           "make_sharded_preempt", "mesh_for_nodes", "node_leaf_mask",
+           "node_sharding_specs", "scheduler_mesh",
+           "sharded_delta_allocate_cached"]

@@ -1,0 +1,133 @@
+"""``python -m volcano_tpu.parallel --bench`` — the multichip bench probe.
+
+Runs the SAME multi-cycle churned scheduler workload once unsharded and
+once per requested device count on the node-axis sharded backend
+(``sharding: true``), and prints one JSON report:
+
+- per-device-count steady-state cycle p50 (warm delta cycles only),
+- ``decisions_equal_unsharded`` — the sha over every cycle's decision
+  digest must match the unsharded run bit-for-bit,
+- ``resharding_copies`` — the live transfer-counter probe's total over
+  the steady cycles; the zero-copy out==in contract means 0.
+
+bench.py shells out to this module (fail-soft, BENCH_SKIP_MULTICHIP=1
+skips) so a GSPMD-poisoned compile can never take the bench record down
+with it; the CLI is equally usable standalone on a real TPU pod slice.
+Exit 0 with the report on stdout; exit 2 on harness error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+import time
+
+
+def _run_variant(conf_text: str, base, cycles: int, pipeline: bool):
+    from ..framework.conf import parse_conf
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+    from ..chaos.probe import _churn, _cycle_digest
+    cluster = FakeCluster(base.clone())
+    sched = Scheduler(cluster, conf=parse_conf(conf_text), pipeline=pipeline)
+    digests, wall_ms = [], []
+    for c in range(cycles):
+        t0 = time.perf_counter()
+        out = sched.run_once(now=1000.0 + c)
+        rec = (sched.drain(now=1000.0 + c) or out) if pipeline else out
+        wall_ms.append((time.perf_counter() - t0) * 1e3)
+        digests.append(_cycle_digest(rec))
+        _churn(cluster, c)
+    sha = hashlib.sha256(repr(digests).encode()).hexdigest()[:16]
+    flight = sched.flight.snapshots()
+    steady = sorted(ms for c, ms in enumerate(wall_ms) if c >= 2)
+    return {
+        "decisions_sha": sha,
+        "steady_p50_ms": (round(steady[len(steady) // 2], 2)
+                          if steady else None),
+        "delta_cycles": sum(1 for e in flight
+                            if e.get("cycle_kind") == "delta"),
+        "mesh_devices": next(
+            (int(e["mesh_devices"]) for e in reversed(flight)
+             if e.get("mesh_devices") is not None), None),
+        "resharding_copies": sum(
+            int(e["resharding_copies"]) for e in flight
+            if e.get("resharding_copies") is not None),
+    }
+
+
+def run_multichip(device_counts, cycles: int = 6, n_nodes: int = 16,
+                  pipeline: bool = False) -> dict:
+    """The comparison matrix: unsharded oracle + one sharded run per
+    device count, all over identical churned clusters."""
+    import jax
+
+    from ..chaos.probe import _small_cluster
+    base = _small_cluster(n_nodes=n_nodes, n_jobs=12, tasks_per_job=3)
+    body = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: binpack
+"""
+    oracle = _run_variant(body, base, cycles, pipeline)
+    per_device = {}
+    for d in device_counts:
+        if d > jax.device_count():
+            per_device[str(d)] = {"skipped": f"only {jax.device_count()} "
+                                             "devices visible"}
+            continue
+        r = _run_variant(f"sharding: true\nsharding_devices: {d}\n" + body,
+                         base, cycles, pipeline)
+        r["decisions_equal_unsharded"] = (
+            r.pop("decisions_sha") == oracle["decisions_sha"])
+        per_device[str(d)] = r
+    return {
+        "cycles": cycles,
+        "n_nodes": n_nodes,
+        "pipeline": pipeline,
+        "devices_visible": jax.device_count(),
+        "unsharded_steady_p50_ms": oracle["steady_p50_ms"],
+        "unsharded_sha": oracle["decisions_sha"],
+        "per_device_count": per_device,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="multichip probe: sharded cycle vs unsharded oracle")
+    parser.add_argument("--bench", action="store_true",
+                        help="run the comparison matrix and print JSON")
+    parser.add_argument("--devices", default="1,2,8",
+                        help="comma-separated device counts to try")
+    parser.add_argument("--cycles", type=int, default=6)
+    parser.add_argument("--nodes", type=int, default=16)
+    parser.add_argument("--pipeline", action="store_true",
+                        help="drive the pipelined loop instead of sync")
+    args = parser.parse_args(argv)
+    counts = [int(d) for d in args.devices.split(",") if d.strip()]
+    try:
+        report = run_multichip(counts, cycles=args.cycles,
+                               n_nodes=args.nodes, pipeline=args.pipeline)
+    except Exception as e:  # harness failure, not a measurement
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    print(json.dumps(report, indent=2))
+    ok = all(r.get("decisions_equal_unsharded", True)
+             and r.get("resharding_copies", 0) == 0
+             for r in report["per_device_count"].values())
+    if not ok:
+        print("multichip probe FAILED: sharded decisions diverged or "
+              "steady cycles paid resharding copies", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,13 +21,14 @@ control-plane workload runs on (the 16-goroutine analog, SURVEY section 2.5).
 
 from __future__ import annotations
 
-from typing import Optional
+import dataclasses
+from typing import Dict, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..arrays.schema import NodeArrays, SnapshotArrays
+from ..arrays.schema import SnapshotArrays
 from ..ops.allocate_scan import AllocateConfig, make_allocate_cycle
 
 NODE_AXIS = "nodes"
@@ -40,23 +41,55 @@ def scheduler_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices), (NODE_AXIS,))
 
 
+#: shard-count -> Mesh, so every kernel over the same device prefix shares
+#: one Mesh object (NamedShardings compare equal, jit caches stay shared)
+_MESH_CACHE: Dict[int, Mesh] = {}
+
+
+def mesh_for_nodes(n_nodes: int, requested: Optional[int] = None) -> Mesh:
+    """The production mesh for a snapshot with ``n_nodes`` packed node
+    rows: the largest power-of-two device count <= ``requested`` (default:
+    all local devices) that divides the node axis. The bucket grid
+    (arrays/schema.bucket) keeps n_nodes a power of two up to 1024 and a
+    multiple of 1024 above, so any pow2 mesh up to 1024 divides it; the
+    clamp only bites on sub-bucket test snapshots."""
+    avail = len(jax.devices())
+    want = avail if requested is None else max(1, min(int(requested), avail))
+    d = 1
+    while d * 2 <= want and n_nodes % (d * 2) == 0:
+        d *= 2
+    mesh = _MESH_CACHE.get(d)
+    if mesh is None or mesh.devices.size != d:
+        mesh = _MESH_CACHE[d] = scheduler_mesh(d)
+    return mesh
+
+
+def node_leaf_mask(tree) -> tuple:
+    """bool per flattened leaf of a cycle argument tree — True exactly for
+    the leaves of ``tree[0].nodes`` (the NodeArrays block of the leading
+    SnapshotArrays). Computed STRUCTURALLY (a mask pytree of the same
+    shape), so a new NodeArrays field can never silently classify as
+    replicated — the same can't-drift guarantee node_sharding_specs gets
+    from its jax.tree.map."""
+    snap = tree[0]
+    if not isinstance(snap, SnapshotArrays):
+        raise TypeError("cycle tree must lead with SnapshotArrays, got "
+                        f"{type(snap).__name__}")
+    mask = list(jax.tree.map(lambda _: False, tuple(tree)))
+    mask[0] = dataclasses.replace(
+        mask[0], nodes=jax.tree.map(lambda _: True, snap.nodes))
+    return tuple(jax.tree.leaves(tuple(mask)))
+
+
 def node_sharding_specs(mesh: Mesh, snap: SnapshotArrays):
     """(in_shardings for snap, replicated spec) — node tensors split on the
-    node axis, everything else replicated."""
+    node axis, everything else replicated. The node block maps EVERY
+    NodeArrays field to the row spec via jax.tree.map, so a new node
+    field can't silently ship replicated."""
     rep = NamedSharding(mesh, P())
     row = NamedSharding(mesh, P(NODE_AXIS))
-
-    def node_spec(leaf_name: str):
-        return row
-
-    node_shardings = NodeArrays(
-        idle=row, used=row, releasing=row, pipelined=row, allocatable=row,
-        capability=row, labels=row, taint_kv=row, taint_key=row,
-        taint_effect=row, pod_count=row, max_pods=row,
-        gpu_memory=row, gpu_used=row, schedulable=row,
-        valid=row)
     snap_shardings = SnapshotArrays(
-        nodes=node_shardings,
+        nodes=jax.tree.map(lambda _: row, snap.nodes),
         tasks=jax.tree.map(lambda _: rep, snap.tasks),
         jobs=jax.tree.map(lambda _: rep, snap.jobs),
         queues=jax.tree.map(lambda _: rep, snap.queues),
@@ -96,3 +129,33 @@ def make_sharded_preempt(pcfg, mesh: Mesh, snap: SnapshotArrays):
     fn = make_preempt_cycle(pcfg)
     return jax.jit(fn, in_shardings=(snap_shardings, None, None, None),
                    out_shardings=rep)
+
+
+# --------------------------------------------------------------------------
+# Production execution mode: sharded device-resident delta cycle (ISSUE 7)
+# --------------------------------------------------------------------------
+
+def make_sharded_delta(cfg: AllocateConfig, mesh: Mesh, tree,
+                       entry: str = "fused_cycle_sharded"):
+    """ShardedDeltaKernel for the allocate cycle over ``mesh``: node-axis
+    residents, routed deltas, per-shard digests, donation through pjit.
+
+    Forces the pure-XLA scan path for the same reason
+    :func:`make_sharded_allocate` does — GSPMD has no partitioning rule
+    for the pallas custom call, so use_pallas under sharding would at
+    best replicate the node axis and at worst fail to compile."""
+    from ..ops.fused_io import ShardedDeltaKernel
+    cfg = dataclasses.replace(cfg, use_pallas=False)
+    return ShardedDeltaKernel(make_allocate_cycle(cfg), tree, mesh,
+                              node_leaf_mask(tree), entry=entry)
+
+
+def sharded_delta_allocate_cached(cfg: AllocateConfig, tree, mesh,
+                                  cache: Dict):
+    """Shape+mesh-memoized :func:`make_sharded_delta` (the sharded analog
+    of fused_io.delta_cycle_cached, same key construction)."""
+    from ..ops.fused_io import sharded_delta_cycle_cached
+    cfg = dataclasses.replace(cfg, use_pallas=False)
+    return sharded_delta_cycle_cached(make_allocate_cycle(cfg), tree, mesh,
+                                      node_leaf_mask(tree), cache,
+                                      key_extra=cfg)

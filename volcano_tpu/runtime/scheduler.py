@@ -452,6 +452,14 @@ class Scheduler:
                         "full" if "delta_cycle" in stats else None),
             upload_bytes=stats.get("upload_bytes"),
             upload_bytes_full=stats.get("upload_bytes_full"),
+            # sharded-cycle observability (conf sharding: true): mesh
+            # width and the live resharding probe — a nonzero copy count
+            # means a pjit input lost its declared sharding, i.e. the
+            # zero-copy steady-loop contract broke this cycle
+            mesh_devices=(int(stats["mesh_devices"])
+                          if "mesh_devices" in stats else None),
+            resharding_copies=(int(stats["resharding_copies"])
+                               if "resharding_copies" in stats else None),
             dirty_jobs=self._last_dirty[0], dirty_nodes=self._last_dirty[1],
             stats={k: round(float(v), 3) for k, v in stats.items()},
             telemetry=ssn.last_telemetry or None)

@@ -168,6 +168,36 @@ class SchedulerSidecar:
                 from ..framework.conf import parse_conf as _pc
                 delta_uploads = delta_uploads and _pc(conf).delta_uploads
         self.delta_uploads = bool(delta_uploads)
+        # node-axis sharded serving (ISSUE 7): conf ``sharding: true`` (or
+        # env VOLCANO_SIDECAR_SHARDING=1 in bare-cfg mode) runs the served
+        # cycle as a ShardedDeltaKernel over a device mesh. Rides the
+        # resident delta path, so delta_uploads off disables it too.
+        self.sharding = os.environ.get("VOLCANO_SIDECAR_SHARDING") == "1"
+        self._sharding_devices = None
+        if conf is not None:
+            from ..framework.conf import parse_conf as _pcs
+            _sc = _pcs(conf)
+            self.sharding = self.sharding or bool(
+                getattr(_sc, "sharding", False))
+            self._sharding_devices = getattr(_sc, "sharding_devices", None)
+        self.sharding = self.sharding and self.delta_uploads
+        self._cycle_sharded = None
+        if self.sharding:
+            # the sharded cycle variant forces the pure-XLA scan path:
+            # GSPMD has no partitioning rule for the pallas custom call
+            if conf is not None:
+                from ..framework.compiled_session import make_conf_cycle \
+                    as _mcc
+                self._cycle_sharded = _mcc(
+                    conf, cfg_overrides={"use_pallas": False})
+            else:
+                import dataclasses as _dc
+                from ..ops.allocate_scan import make_allocate_cycle as _mac
+                self._cycle_sharded = _mac(
+                    _dc.replace(self.cfg, use_pallas=False))
+        #: shape+mesh signature -> ShardedDeltaKernel (same residency and
+        #: invalidation contract as _delta, per-shard residents)
+        self._sharded_delta: Dict[tuple, object] = {}
         #: shape signature -> DeltaKernel, plus per-kernel ResidentState —
         #: the sidecar owns the returned (donated) buffers; nothing may
         #: re-read a handle after a cycle consumed it (graphcheck donation
@@ -246,6 +276,20 @@ class SchedulerSidecar:
             tree_in = (snap, base)
         return tree_in, snap, T, J
 
+    def _sharded_kernel(self, tree_in):
+        """The ShardedDeltaKernel serving this snapshot's shape bucket:
+        mesh sized per the bucket's node axis (parallel/sharding
+        .mesh_for_nodes), NamedShardings threaded through the served
+        cycle with out_shardings == in_shardings across rounds. Caller
+        holds _serve_lock."""
+        from ..ops.fused_io import sharded_delta_cycle_cached
+        from ..parallel.sharding import mesh_for_nodes, node_leaf_mask
+        n_nodes = int(np.asarray(tree_in[0].nodes.valid).shape[0])
+        mesh = mesh_for_nodes(n_nodes, self._sharding_devices)
+        return sharded_delta_cycle_cached(self._cycle_sharded, tree_in,
+                                          mesh, node_leaf_mask(tree_in),
+                                          self._sharded_delta)
+
     def _dispatch_cycle(self, tree_in):
         """Dispatch the compiled cycle over the fused tree WITHOUT reading
         the decisions back, taking the device-resident delta path when
@@ -257,7 +301,11 @@ class SchedulerSidecar:
         seam("sidecar.dispatch", sidecar=self)
         if self.delta_uploads:
             from ..ops.fused_io import ResidentState, delta_cycle_cached
-            kernel = delta_cycle_cached(self._cycle, tree_in, self._delta)
+            if self.sharding:
+                kernel = self._sharded_kernel(tree_in)
+            else:
+                kernel = delta_cycle_cached(self._cycle, tree_in,
+                                            self._delta)
             state = self._states.get(id(kernel))
             if state is None:
                 state = self._states[id(kernel)] = ResidentState()
@@ -320,7 +368,9 @@ class SchedulerSidecar:
         its first request at steady-state latency."""
         tree_in, _snap, _T, _J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
-            if self.delta_uploads:
+            if self.delta_uploads and self.sharding:
+                self._sharded_kernel(tree_in).warm()
+            elif self.delta_uploads:
                 from ..ops.fused_io import delta_cycle_cached
                 delta_cycle_cached(self._cycle, tree_in, self._delta).warm()
             else:
