@@ -162,6 +162,49 @@ def _time_device(cycle_fn, snap, extras, reps):
     return result, min(times) * 1000, compile_s
 
 
+def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms):
+    """Compare this run's steady-loop and sub-scale kernel timings against
+    the most recent BENCH_r*.json recorded on the SAME backend label
+    (tpu vs cpu — cross-backend ratios are meaningless). Returns a
+    fail-soft block with per-metric baseline/ratio and a ``regression``
+    flag (ratio above BENCH_REGRESSION_THRESHOLD, default 1.5×), or None
+    when no comparable baseline exists. Never raises, never exits
+    nonzero — the guard annotates the record, the trajectory tooling
+    decides what to do about it."""
+    import glob
+    threshold = float(os.environ.get("BENCH_REGRESSION_THRESHOLD", 1.5))
+    here = os.path.dirname(os.path.abspath(__file__))
+    my_label = "cpu" if force_cpu else "tpu"
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                parsed = (json.load(f) or {}).get("parsed") or {}
+        except Exception:
+            continue
+        label = ("cpu" if parsed.get("tpu_unavailable")
+                 or "cpu" in str(parsed.get("device", "")).lower()
+                 else "tpu")
+        if label != my_label:
+            continue
+        block = {"baseline": os.path.basename(path), "backend": my_label,
+                 "threshold": threshold, "regression": False}
+        found = False
+        for key, cur in (("steady_loop_ms", steady_loop_ms),
+                         ("sub_tpu_ms", sub_tpu_ms)):
+            base = parsed.get(key)
+            if cur is None or not base:
+                continue
+            ratio = round(float(cur) / float(base), 2)
+            block[key + "_baseline"] = base
+            block[key + "_ratio"] = ratio
+            if ratio > threshold:
+                block["regression"] = True
+            found = True
+        return block if found else None
+    return None
+
+
 def _run(force_cpu: bool):
     if force_cpu:
         # Degraded mode: the jitted cycle runs on the CPU backend. The
@@ -249,6 +292,7 @@ def _run(force_cpu: bool):
     steady_delta_fraction = None
     steady_upload_full = steady_upload_delta = None
     loop_incremental = None
+    latency_phases = latency_occ = None
     if not os.environ.get("BENCH_SKIP_SESSION"):
         from __graft_entry__ import _synthetic_cluster
         from volcano_tpu.framework import parse_conf
@@ -322,12 +366,20 @@ tiers:
         for w in range(3):
             loop_churn(w)
             sched.run_once()
+        # span rings restart here so the latency_breakdown block reports
+        # STEADY phase stats, not compile-tainted warmup durations
+        from volcano_tpu.telemetry import spans as _spans
+        _spans.reset()
         times_steady = []
         times_total = []
         steady_reps = int(os.environ.get("BENCH_STEADY_REPS", 5))
         for r in range(max(steady_reps, 1)):
             t_all = time.time()
-            loop_churn(3 + r)
+            # the churn IS the host's inter-cycle ingest work: spanning it
+            # lets the occupancy analyzer credit it against the in-flight
+            # device window (the overlap the pipeline buys)
+            with _spans.span("loop.ingest", cat="ingest"):
+                loop_churn(3 + r)
             # in production the 1 s schedule period lets the in-flight
             # cycle's device compute finish during event ingestion; the
             # bench's churn is faster than a real period, so wait here —
@@ -342,6 +394,10 @@ tiers:
             times_steady.append((now - t0) * 1000)
             times_total.append((now - t_all) * 1000)
         sched.drain()           # retire the final in-flight cycle
+        # snapshot the steady loop's span rings BEFORE later blocks (the
+        # sidecar and chaos probe run their own cycles on the same rings)
+        latency_phases = _spans.phase_stats()
+        latency_occ = _spans.occupancy()
         ts = sorted(times_steady)
         steady_p50 = ts[len(ts) // 2]
         steady_p95 = ts[min(len(ts) - 1, int(round(0.95 * (len(ts) - 1))))]
@@ -911,6 +967,47 @@ tiers:
         except Exception:  # noqa: BLE001 — the record ships regardless
             pass
 
+    # ---- cycle latency breakdown (volcano_tpu/telemetry/spans) -----------
+    # The steady loop's per-phase span rings + pipeline occupancy, and the
+    # headline host_overhead_ratio = steady_cycle_total_p50 / sub_tpu_ms —
+    # the number the deep-async-pipeline item must drive toward ~1.2.
+    # Fail-soft: BENCH_SKIP_LATENCY=1 (or any failure) records null.
+    latency_block = None
+    if not os.environ.get("BENCH_SKIP_LATENCY"):
+        try:
+            if latency_phases:
+                latency_block = {
+                    "phases": {ph: {q: st[q] for q in
+                                    ("count", "p50", "p95", "p99")}
+                               for ph, st in latency_phases.items()},
+                }
+                if latency_occ is not None:
+                    latency_block["pipeline_overlap_fraction"] = \
+                        latency_occ.get("pipeline_overlap_fraction")
+                    latency_block["bubble_ms"] = latency_occ.get("bubble_ms")
+                    latency_block["device_windows"] = \
+                        latency_occ.get("windows")
+                if steady_total_p50 is not None and sub_speedup is not None \
+                        and stpu_ms:
+                    latency_block["host_overhead_ratio"] = round(
+                        steady_total_p50 / stpu_ms, 2)
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: latency block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            latency_block = None
+
+    # ---- perf regression guard vs the last same-backend BENCH record -----
+    regression_block = None
+    if not os.environ.get("BENCH_SKIP_REGRESSION"):
+        try:
+            regression_block = _regression_guard(
+                force_cpu, steady_ms,
+                stpu_ms if sub_speedup is not None else None)
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: regression guard failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            regression_block = None
+
     out = {
         "metric": f"schedule_cycle_ms_{n_nodes}nodes_{n_tasks}tasks",
         "value": round(dev_ms, 3),
@@ -921,6 +1018,8 @@ tiers:
         "telemetry": telemetry_block,
         "robustness": robustness_block,
         "multichip": multichip_block,
+        "latency_breakdown": latency_block,
+        "regression": regression_block,
     }
     if force_cpu:
         out["tpu_unavailable"] = True

@@ -28,6 +28,21 @@ if [ "${TIER1_SKIP_GRAPHCHECK:-0}" != "1" ]; then
     # report + stable exit code without parsing pytest output
     bash scripts/graphcheck.sh --fast || grc=$?
 fi
+trc=0
+if [ "${TIER1_SKIP_TRACE:-0}" != "1" ]; then
+    # span-trace smoke (volcano_tpu/telemetry/spans): a short pipelined
+    # loop must export Chrome trace-event JSON that parses, and its
+    # pipeline-occupancy analysis must show nonzero host/device overlap
+    # (the sync loop's window is ~all blocked readback; the pipelined
+    # loop's ingest work overlaps the in-flight device window)
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.telemetry \
+        --trace /tmp/_t1_trace.json --cycles 12 \
+        > /tmp/_t1_trace_summary.json || trc=$?
+    if [ $trc -eq 0 ]; then
+        python scripts/trace_check.py /tmp/_t1_trace.json \
+            /tmp/_t1_trace_summary.json || trc=$?
+    fi
+fi
 crc=0
 if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
     # fast chaos smoke (volcano_tpu/chaos): a seeded storm of every
@@ -47,4 +62,7 @@ fi
 if [ $grc -ne 0 ]; then
     exit $grc
 fi
-exit $crc
+if [ $crc -ne 0 ]; then
+    exit $crc
+fi
+exit $trc

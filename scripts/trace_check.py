@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Validate a Chrome trace export + its summary (the tier-1 trace smoke).
+
+Usage: python scripts/trace_check.py TRACE.json SUMMARY.json
+
+Checks, exit 0 when all hold / 1 with a message when any fails:
+- the trace file is valid JSON with a nonempty ``traceEvents`` list,
+- every complete ("X") event carries the schema Perfetto needs
+  (name/cat/ph/ts/dur/pid/tid, numeric timestamps),
+- the summary (``python -m volcano_tpu.telemetry`` stdout) reports at
+  least one in-flight device window with
+  ``pipeline_overlap_fraction > 0`` — the pipelined loop's ingest work
+  must actually overlap the device window, else the pipeline is lying.
+
+Pure stdlib on purpose: the smoke proves the EXPORT is consumable
+without the exporting process's imports.
+"""
+
+import json
+import sys
+
+
+def fail(msg):
+    print("trace_check: FAIL: %s" % msg, file=sys.stderr)
+    return 1
+
+
+def main(argv):
+    if len(argv) != 3:
+        return fail("usage: trace_check.py TRACE.json SUMMARY.json")
+    try:
+        with open(argv[1]) as f:
+            trace = json.load(f)
+    except Exception as e:
+        return fail("trace does not parse: %s: %s" % (type(e).__name__, e))
+    events = trace.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return fail("traceEvents missing or empty")
+    complete = [e for e in events if e.get("ph") == "X"]
+    if not complete:
+        return fail("no complete ('X') span events")
+    for e in complete:
+        for key in ("name", "cat", "ts", "dur", "pid", "tid"):
+            if key not in e:
+                return fail("X event missing %r: %r" % (key, e))
+        if not isinstance(e["ts"], (int, float)) \
+                or not isinstance(e["dur"], (int, float)):
+            return fail("non-numeric ts/dur: %r" % e)
+    if not any(e.get("cat") == "device" for e in complete):
+        return fail("no device-window events in the trace")
+    try:
+        with open(argv[2]) as f:
+            summary = json.load(f)
+    except Exception as e:
+        return fail("summary does not parse: %s: %s"
+                    % (type(e).__name__, e))
+    occ = summary.get("occupancy") or {}
+    if not occ.get("windows"):
+        return fail("occupancy reports zero device windows")
+    frac = occ.get("pipeline_overlap_fraction")
+    if summary.get("pipeline") and not (frac and frac > 0):
+        return fail("pipelined run but pipeline_overlap_fraction=%r" % frac)
+    print("trace_check: OK: %d events, %d windows, overlap %.3f"
+          % (len(events), occ["windows"], frac or 0.0))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

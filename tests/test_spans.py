@@ -1,0 +1,323 @@
+"""Cycle timeline profiler (ISSUE 8): host span tracing, pipeline
+occupancy, the Chrome trace export, and the structured event log.
+
+The load-bearing contract first: spans are HOST-ONLY, so scheduler
+decisions must be bit-identical with tracing on and off — pinned here on
+the sync, pipelined, and sharded 2-device loops. Then the observability
+surfaces themselves: occupancy math on synthetic spans with known
+overlap (including the wait-subtraction that keeps a blocked readback
+from masquerading as useful overlap), trace-event JSON schema, latency
+ring quantiles, and event-log emission on a planted digest trip.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import pytest
+
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.scheduler import Scheduler
+from volcano_tpu.telemetry import spans
+
+from test_delta_pipeline import decisions_sha, digest
+from test_runtime_incremental import build_cluster, churn
+
+_BODY = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+"""
+PLAIN_CONF = parse_conf(_BODY)
+SHARD2_CONF = parse_conf("sharding: true\nsharding_devices: 2\n" + _BODY)
+
+
+@pytest.fixture(autouse=True)
+def _clean_spans():
+    spans.reset()
+    spans.set_enabled(True)
+    yield
+    spans.set_enabled(True)
+    spans.reset()
+
+
+def _run_loop(conf, pipeline, cycles=4):
+    cluster = FakeCluster(build_cluster(n_nodes=8, n_jobs=6).clone())
+    sched = Scheduler(cluster, conf=conf, incremental=True,
+                      pipeline=pipeline)
+    digests = []
+    for c in range(cycles):
+        out = sched.run_once(now=1000.0 + c)
+        rec = (sched.drain(now=1000.0 + c) or out) if pipeline else out
+        digests.append(digest(rec))
+        churn(cluster, c, arrivals=True)
+    return decisions_sha(digests), sched
+
+
+class TestDecisionIdentity:
+    """Tracing on vs off: the decision sha must not move — spans wrap
+    host code only, never a traced function."""
+
+    def test_sync_loop_identical_on_off(self):
+        on, _ = _run_loop(PLAIN_CONF, pipeline=False)
+        spans.reset()
+        prev = spans.set_enabled(False)
+        try:
+            off, _ = _run_loop(PLAIN_CONF, pipeline=False)
+        finally:
+            spans.set_enabled(prev)
+        assert on == off
+
+    def test_pipelined_loop_identical_on_off(self):
+        on, _ = _run_loop(PLAIN_CONF, pipeline=True)
+        spans.reset()
+        prev = spans.set_enabled(False)
+        try:
+            off, _ = _run_loop(PLAIN_CONF, pipeline=True)
+        finally:
+            spans.set_enabled(prev)
+        assert on == off
+
+    @pytest.mark.slow  # GSPMD compile dominates; tier-1 budget (PR 1/3/5
+    # pattern) — the sync + pipelined identity rows above stay tier-1
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs the multi-device virtual mesh")
+    def test_sharded_pipelined_loop_identical_on_off(self):
+        on, _ = _run_loop(SHARD2_CONF, pipeline=True)
+        spans.reset()
+        prev = spans.set_enabled(False)
+        try:
+            off, _ = _run_loop(SHARD2_CONF, pipeline=True)
+        finally:
+            spans.set_enabled(prev)
+        assert on == off
+
+    def test_disabled_records_nothing(self):
+        prev = spans.set_enabled(False)
+        try:
+            with spans.span("x"):
+                pass
+            spans.device_window(0.0, 1.0)
+            spans.log_event("digest_trip")
+        finally:
+            spans.set_enabled(prev)
+        assert spans.phase_stats() == {}
+        assert spans.events() == []
+
+
+class TestOccupancyMath:
+    """compute_occupancy on synthetic spans with hand-checked overlap."""
+
+    @staticmethod
+    def _ev(name, cat, ts, dur, **kw):
+        return dict(name=name, cat=cat, ts=ts, dur=dur, tid=1, **kw)
+
+    def test_known_overlap(self):
+        # window [0, 10); host work [2, 5) and [8, 12) -> 3 + 2 = 5s in
+        evts = [
+            self._ev("device_window", "device", 0.0, 10.0, shards=1),
+            self._ev("ingest", "ingest", 2.0, 3.0),
+            self._ev("open", "host", 8.0, 4.0),
+        ]
+        occ = spans.compute_occupancy(evts)
+        assert occ["windows"] == 1
+        assert occ["window_ms"] == 10000.0
+        assert occ["overlap_ms"] == 5000.0
+        assert occ["bubble_ms"] == 5000.0
+        assert occ["pipeline_overlap_fraction"] == 0.5
+
+    def test_wait_subtraction_and_nesting(self):
+        # an OUTER host span covering the whole window would naively give
+        # overlap 1.0; the inner wait (blocked readback) must be carved
+        # out, and the nested inner host span must not double-count
+        evts = [
+            self._ev("device_window", "device", 0.0, 10.0),
+            self._ev("cycle", "host", 0.0, 10.0),     # outer
+            self._ev("apply", "host", 1.0, 2.0),      # nested in outer
+            self._ev("readback", "wait", 4.0, 6.0),   # blocked 4..10
+        ]
+        occ = spans.compute_occupancy(evts)
+        assert occ["overlap_ms"] == 4000.0            # [0,4) only
+        assert occ["pipeline_overlap_fraction"] == 0.4
+
+    def test_all_wait_window_is_zero(self):
+        # the synchronous loop: window interior fully blocked -> ~0
+        evts = [
+            self._ev("device_window", "device", 0.0, 5.0),
+            self._ev("cycle", "host", 0.0, 5.0),
+            self._ev("readback", "wait", 0.0, 5.0),
+        ]
+        occ = spans.compute_occupancy(evts)
+        assert occ["overlap_ms"] == 0.0
+        assert occ["pipeline_overlap_fraction"] == 0.0
+
+    def test_per_shard_views(self):
+        # one common GSPMD window over 2 shards plus a shard-1-only
+        # window: shard 0 sees 1 window, shard 1 sees 2
+        evts = [
+            self._ev("device_window", "device", 0.0, 4.0,
+                     shard=None, shards=2),
+            self._ev("device_window", "device", 6.0, 2.0,
+                     shard=1, shards=2),
+            self._ev("ingest", "ingest", 0.0, 2.0),
+            self._ev("ingest", "ingest", 6.0, 1.0),
+        ]
+        occ = spans.compute_occupancy(evts)
+        per = occ["per_shard"]
+        assert set(per) == {"1"}  # explicit shard ids win
+        assert per["1"]["windows"] == 2
+        assert per["1"]["overlap_ms"] == 3000.0
+        # shards=2 with no explicit ids -> synthesized per-shard views
+        occ2 = spans.compute_occupancy(evts[:1] + evts[2:3])
+        assert set(occ2["per_shard"]) == {"0", "1"}
+        assert occ2["per_shard"]["0"] == occ2["per_shard"]["1"]
+
+    def test_live_rings_feed_occupancy(self):
+        with spans.span("work"):
+            pass
+        spans.device_window(0.0, spans.now() + 1.0)
+        occ = spans.occupancy()
+        assert occ["windows"] == 1
+        assert occ["pipeline_overlap_fraction"] is not None
+
+
+class TestTraceExport:
+    def test_chrome_trace_schema(self, tmp_path):
+        with spans.span("outer"):
+            with spans.span("inner", cat="dispatch", detail=7):
+                pass
+        spans.device_window(0.0, 0.001)
+        spans.log_event("digest_trip", source="test")
+        path = tmp_path / "trace.json"
+        trace = spans.export_chrome_trace(str(path))
+        on_disk = json.loads(path.read_text())
+        assert on_disk["displayTimeUnit"] == "ms"
+        evts = on_disk["traceEvents"]
+        assert evts == trace["traceEvents"]
+        complete = [e for e in evts if e["ph"] == "X"]
+        assert {e["name"] for e in complete} >= {"outer", "inner",
+                                                "device_window"}
+        for e in complete:
+            assert {"name", "cat", "ph", "ts", "dur", "pid",
+                    "tid"} <= set(e)
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        inner = next(e for e in complete if e["name"] == "inner")
+        assert inner["args"] == {"detail": 7}
+        # metadata names for host threads AND the device track
+        meta = [e for e in evts if e["ph"] == "M"
+                and e["name"] == "thread_name"]
+        assert any(m["args"]["name"] == "device" for m in meta)
+        # the planted event rides along as an instant on track 0
+        assert any(e["ph"] == "i" and e["name"] == "digest_trip"
+                   for e in evts)
+
+    def test_merge_appends_foreign_events(self):
+        with spans.span("mine"):
+            pass
+        foreign = {"traceEvents": [{"name": "theirs", "ph": "X", "ts": 0,
+                                    "dur": 1, "pid": 9, "tid": 9,
+                                    "cat": "device"}]}
+        trace = spans.export_chrome_trace(merge=foreign)
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert {"mine", "theirs"} <= names
+
+    def test_phase_stats_quantiles(self):
+        for _ in range(10):
+            with spans.span("pack"):
+                pass
+        st = spans.phase_stats()["pack"]
+        assert st["count"] == 10
+        assert 0 <= st["p50"] <= st["p95"] <= st["p99"]
+        assert st["total_ms"] >= st["last"] >= 0
+
+    def test_cycle_summary_drains(self):
+        with spans.span("pack"):
+            pass
+        acc = spans.drain_cycle_summary()
+        assert acc is not None and "pack" in acc
+        assert spans.drain_cycle_summary() is None  # drained
+
+
+class TestEventLog:
+    @pytest.mark.slow  # full chaos probe (~6 s compile); tier1.sh's chaos
+    # smoke exercises the same storm with the event log live
+    def test_digest_trip_emits_event(self):
+        """The chaos probe's planted resident-state corruption must land
+        a digest_trip (and a recovery) in the structured event log."""
+        from volcano_tpu.chaos import run_chaos_probe
+        rpt = run_chaos_probe(seed=7, cycles=6)
+        assert rpt["digest_mismatches"] >= 1  # the probe planted one
+        kinds = [e["kind"] for e in spans.events()]
+        assert "digest_trip" in kinds
+        assert "recovery" in kinds
+        trip = next(e for e in spans.events()
+                    if e["kind"] == "digest_trip")
+        assert trip["source"] in ("session", "sidecar")
+        assert trip["ts_ms"] >= 0 and trip["wall_ts"] > 0
+
+    def test_event_log_jsonl_export(self, tmp_path):
+        spans.log_event("degradation", level_from=0, level_to=1)
+        spans.log_event("recovery", mode="refuse")
+        path = tmp_path / "events.jsonl"
+        n = spans.export_event_log(str(path))
+        lines = [json.loads(ln) for ln in
+                 path.read_text().splitlines() if ln]
+        assert n == len(lines) == 2
+        assert lines[0]["kind"] == "degradation"
+        assert lines[0]["level_to"] == 1
+
+    def test_write_through_env(self, tmp_path, monkeypatch):
+        path = tmp_path / "wt.jsonl"
+        monkeypatch.setenv("VOLCANO_EVENT_LOG", str(path))
+        spans.log_event("digest_trip", source="test")
+        entry = json.loads(path.read_text().splitlines()[0])
+        assert entry["kind"] == "digest_trip"
+
+
+class TestSchedulerWiring:
+    def test_flight_entries_carry_span_summary(self):
+        _sha, sched = _run_loop(PLAIN_CONF, pipeline=True)
+        entries = sched.flight.snapshots()
+        summed = [e for e in entries if e.get("spans")]
+        assert summed, entries
+        assert any("session.dispatch" in e["spans"] for e in summed)
+        json.dumps(entries)  # JSON-clean with the summary attached
+
+    def test_metrics_gauges_published(self):
+        from volcano_tpu.metrics import METRICS
+        METRICS.reset()
+        _run_loop(PLAIN_CONF, pipeline=False)
+        text = METRICS.exposition()
+        assert "volcano_span_phase_ms{" in text
+        assert 'phase="session.dispatch"' in text
+
+    def test_dashboard_tables_and_trace_route(self):
+        import urllib.request
+        _sha, sched = _run_loop(PLAIN_CONF, pipeline=True)
+
+        class _Api:          # empty stores: only the telemetry/latency
+            def list(self, kind):  # tables matter to this test
+                return []
+
+        class _Sys:
+            scheduler = sched
+            api = _Api()
+        from volcano_tpu.runtime.dashboard import Dashboard, build_page
+        page = build_page(_Sys())
+        assert "latency" in page.tables
+        assert page.tables["latency"]["rows"]
+        tel = page.tables["telemetry"]
+        assert tel["headers"][-3:] == ["Mesh", "Reshard", "Degr"]
+        assert all(len(r) == len(tel["headers"]) for r in tel["rows"])
+        dash = Dashboard(_Sys())
+        port = dash.serve(port=0)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/trace").read())
+            assert body["traceEvents"]
+        finally:
+            dash.shutdown()

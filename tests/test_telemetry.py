@@ -268,6 +268,53 @@ class TestFlightRecorder:
         with pytest.raises(ValueError):
             FlightRecorder(capacity=0)
 
+    def test_concurrent_append_is_thread_safe(self):
+        """The pipelined loop's drain/dispatch split and the sidecar's
+        deferred finish() append from different threads: N writers x M
+        records must lose nothing, keep the ring bounded, and hand out
+        unique seq numbers."""
+        import threading
+        fr = FlightRecorder(capacity=64)
+        n_threads, per_thread = 8, 50
+        start = threading.Barrier(n_threads)
+
+        def writer(t):
+            start.wait()
+            for i in range(per_thread):
+                fr.record(now=float(i), thread=t, i=i)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert fr.recorded_total == n_threads * per_thread
+        snaps = fr.snapshots()
+        assert len(snaps) == 64
+        seqs = [e["seq"] for e in snaps]
+        assert len(set(seqs)) == len(seqs)       # no duplicated slot
+        json.dumps(snaps)                        # still JSON-clean
+
+    def test_pickle_roundtrip_with_span_summary(self):
+        """vcctl --state pickles the recorder; entries carrying the span
+        summary (plain {phase: ms} dicts from drain_cycle_summary) must
+        survive the round trip, and the restored recorder must record
+        again (its lock is recreated, not pickled)."""
+        import pickle
+        from volcano_tpu.telemetry import spans
+        spans.reset()
+        with spans.span("pack"):
+            pass
+        fr = FlightRecorder(capacity=4)
+        fr.record(now=1.0, cycle=1, spans=spans.drain_cycle_summary())
+        clone = pickle.loads(pickle.dumps(fr))
+        entry = clone.snapshots()[-1]
+        assert entry["cycle"] == 1
+        assert isinstance(entry["spans"], dict) and "pack" in entry["spans"]
+        clone.record(now=2.0, cycle=2)           # lock usable post-restore
+        assert clone.recorded_total == 2
+
 
 TELEMETRY_CONF = """
 telemetry: true

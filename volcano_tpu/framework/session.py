@@ -29,6 +29,7 @@ from ..ops.allocate_scan import (MODE_ALLOCATED, MODE_PIPELINED,
                                  AllocateResult, make_allocate_cycle)
 from ..ops.backfill import make_backfill_pass
 from ..ops.enqueue import EnqueueConfig, make_enqueue_pass
+from ..telemetry import spans as _spans
 from .conf import SchedulerConfiguration, parse_conf
 
 
@@ -127,6 +128,12 @@ class PendingAllocate:
     kernel: object = None
     state: object = None
     tree: object = None
+    #: span-clock time (telemetry.spans.now) when the dispatch returned —
+    #: the in-flight device window opens here; the drain's readback closes
+    #: it (telemetry.spans.device_window, the occupancy analyzer's input)
+    dispatched_at: float = 0.0
+    #: mesh width of the dispatch (1 when unsharded) — per-shard occupancy
+    shards: int = 1
 
 
 @lru_cache(maxsize=64)
@@ -820,21 +827,22 @@ class Session:
         WITHOUT executing a cycle — the cold-start hook (pair with
         framework/compile_cache: a restarted scheduler stops paying
         ``compile_s`` on its first real cycle)."""
-        cfg, extras = self._derived_allocate_inputs()
-        mesh = self._sharding_mesh()
-        if mesh is not None:
-            _sharded_delta_allocate(cfg, self.snap, extras, mesh).warm()
-        elif bool(getattr(self.conf, "delta_uploads", True)):
-            _delta_allocate(cfg, self.snap, extras).warm()
-        else:
-            from ..ops.fused_io import _TARGETS, fuse_spec, group_sizes
-            fn, _fuse = _fused_allocate(cfg, self.snap, extras)
-            _td, spec = fuse_spec((self.snap, extras))
-            import jax
-            avals = tuple(jax.ShapeDtypeStruct((n,), _TARGETS[g])
-                          for g, n in zip(("f", "i", "b"),
-                                          group_sizes(spec)))
-            fn.lower(*avals).compile()
+        with _spans.span("session.warm"):
+            cfg, extras = self._derived_allocate_inputs()
+            mesh = self._sharding_mesh()
+            if mesh is not None:
+                _sharded_delta_allocate(cfg, self.snap, extras, mesh).warm()
+            elif bool(getattr(self.conf, "delta_uploads", True)):
+                _delta_allocate(cfg, self.snap, extras).warm()
+            else:
+                from ..ops.fused_io import _TARGETS, fuse_spec, group_sizes
+                fn, _fuse = _fused_allocate(cfg, self.snap, extras)
+                _td, spec = fuse_spec((self.snap, extras))
+                import jax
+                avals = tuple(jax.ShapeDtypeStruct((n,), _TARGETS[g])
+                              for g, n in zip(("f", "i", "b"),
+                                              group_sizes(spec)))
+                fn.lower(*avals).compile()
 
     def dispatch_allocate(self) -> PendingAllocate:
         """Upload (full or delta) + dispatch the compiled allocate cycle
@@ -844,7 +852,8 @@ class Session:
         loop holds the pending across one run_once boundary so device
         compute overlaps host event ingestion."""
         t0 = time.time()
-        cfg, extras = self._derived_allocate_inputs()
+        with _spans.span("session.extras"):
+            cfg, extras = self._derived_allocate_inputs()
         self.stats["extras_ms"] = (time.time() - t0) * 1000
         t0 = time.time()
         # fault-injection seam (chaos backend-loss / slow-dispatch faults
@@ -852,43 +861,46 @@ class Session:
         # real accelerator loss surfaces)
         from ..chaos.inject import seam
         seam("session.dispatch", session=self)
-        kernel = state = None
-        if bool(getattr(self.conf, "delta_uploads", True)):
-            # device-resident buffers + packed delta scatter: steady-state
-            # upload is O(changed elements); full re-fuse only on the
-            # first cycle of a shape bucket or when the diff is huge.
-            # With conf ``sharding: true`` the residents split along the
-            # node axis over a device mesh (ShardedDeltaKernel): deltas
-            # route to the owning shard, the digest verifies per shard,
-            # and out_shardings == in_shardings keeps the steady loop
-            # free of resharding copies (probe-counted below).
-            mesh = self._sharding_mesh()
-            if mesh is not None:
-                kernel = _sharded_delta_allocate(cfg, self.snap, extras,
-                                                 mesh)
+        kernel = state = mesh = None
+        with _spans.span("session.dispatch", cat="dispatch"):
+            if bool(getattr(self.conf, "delta_uploads", True)):
+                # device-resident buffers + packed delta scatter:
+                # steady-state upload is O(changed elements); full re-fuse
+                # only on the first cycle of a shape bucket or when the
+                # diff is huge. With conf ``sharding: true`` the residents
+                # split along the node axis over a device mesh
+                # (ShardedDeltaKernel): deltas route to the owning shard,
+                # the digest verifies per shard, and out_shardings ==
+                # in_shardings keeps the steady loop free of resharding
+                # copies (probe-counted below).
+                mesh = self._sharding_mesh()
+                if mesh is not None:
+                    kernel = _sharded_delta_allocate(cfg, self.snap, extras,
+                                                     mesh)
+                else:
+                    kernel = _delta_allocate(cfg, self.snap, extras)
+                state = self._resident.get(id(kernel))
+                if state is None:
+                    from ..ops.fused_io import ResidentState
+                    state = self._resident[id(kernel)] = ResidentState()
+                packed = kernel.run(state, (self.snap, extras))
+                self.stats["upload_bytes"] = float(state.last_upload_bytes)
+                self.stats["upload_bytes_full"] = float(
+                    state.full_upload_bytes)
+                self.stats["delta_cycle"] = float(state.last_kind == "delta")
+                if mesh is not None:
+                    self.stats["mesh_devices"] = float(mesh.devices.size)
+                    self.stats["resharding_copies"] = float(
+                        state.resharding_copies)
+                from ..metrics import METRICS
+                METRICS.inc("cycle_upload_bytes", state.last_upload_bytes,
+                            labels={"kind": state.last_kind})
             else:
-                kernel = _delta_allocate(cfg, self.snap, extras)
-            state = self._resident.get(id(kernel))
-            if state is None:
-                from ..ops.fused_io import ResidentState
-                state = self._resident[id(kernel)] = ResidentState()
-            packed = kernel.run(state, (self.snap, extras))
-            self.stats["upload_bytes"] = float(state.last_upload_bytes)
-            self.stats["upload_bytes_full"] = float(state.full_upload_bytes)
-            self.stats["delta_cycle"] = float(state.last_kind == "delta")
-            if mesh is not None:
-                self.stats["mesh_devices"] = float(mesh.devices.size)
-                self.stats["resharding_copies"] = float(
-                    state.resharding_copies)
-            from ..metrics import METRICS
-            METRICS.inc("cycle_upload_bytes", state.last_upload_bytes,
-                        labels={"kind": state.last_kind})
-        else:
-            # fused 3-buffer full upload + single packed readback (the
-            # per-leaf transfer cost over the axon tunnel dominated at
-            # scale; conf delta_uploads: false)
-            fn, fuse = _fused_allocate(cfg, self.snap, extras)
-            packed = fn(*fuse((self.snap, extras)))
+                # fused 3-buffer full upload + single packed readback (the
+                # per-leaf transfer cost over the axon tunnel dominated at
+                # scale; conf delta_uploads: false)
+                fn, fuse = _fused_allocate(cfg, self.snap, extras)
+                packed = fn(*fuse((self.snap, extras)))
         T = int(np.asarray(self.snap.tasks.status).shape[0])
         J = int(np.asarray(self.snap.jobs.valid).shape[0])
         R = int(np.asarray(self.snap.nodes.idle).shape[1])
@@ -896,7 +908,10 @@ class Session:
         self.stats["dispatch_ms"] = dispatch_ms
         return PendingAllocate(packed=packed, cfg=cfg, T=T, J=J, R=R,
                                dispatch_ms=dispatch_ms, kernel=kernel,
-                               state=state, tree=(self.snap, extras))
+                               state=state, tree=(self.snap, extras),
+                               dispatched_at=_spans.now(),
+                               shards=(int(mesh.devices.size)
+                                       if mesh is not None else 1))
 
     def _oracle_packed(self, pending: PendingAllocate) -> np.ndarray:
         """Last rung of the degradation ladder: decisions from the
@@ -936,7 +951,13 @@ class Session:
         reason = None
         packed = None
         try:
-            packed = np.asarray(pending.packed)
+            with _spans.span("session.readback", cat="wait"):
+                packed = np.asarray(pending.packed)
+            if pending.dispatched_at:
+                # close this cycle's in-flight device window for the
+                # pipeline-occupancy analyzer
+                _spans.device_window(pending.dispatched_at, _spans.now(),
+                                     shards=pending.shards)
         except Exception as e:
             if kernel is None or pending.tree is None:
                 raise
@@ -945,29 +966,34 @@ class Session:
             # chaos mirror-drift faults fire here: after the dispatch,
             # before the compare — the point where a real desync sits
             seam("session.complete", state=state)
-            packed, dev_digest = kernel.split_digest(packed)
-            host_digest = kernel.mirror_digest(state)
+            with _spans.span("session.digest"):
+                packed, dev_digest = kernel.split_digest(packed)
+                host_digest = kernel.mirror_digest(state)
             if host_digest is not None and not np.array_equal(dev_digest,
                                                               host_digest):
                 reason = "digest"
                 METRICS.inc("resident_digest_mismatch_total")
+                _spans.log_event("digest_trip", source="session")
                 packed = None
         if reason is None:
             return packed
         t0 = time.time()
-        try:
-            packed = np.asarray(kernel.recover(state, pending.tree))
-            packed, _dig = kernel.split_digest(packed)
-            mode = "refuse"
-        except Exception:
-            packed = self._oracle_packed(pending)
-            mode = "cpu_oracle"
+        with _spans.span("session.recovery", cat="recovery"):
+            try:
+                packed = np.asarray(kernel.recover(state, pending.tree))
+                packed, _dig = kernel.split_digest(packed)
+                mode = "refuse"
+            except Exception:
+                packed = self._oracle_packed(pending)
+                mode = "cpu_oracle"
         ms = (time.time() - t0) * 1000
         METRICS.inc("cycle_recoveries_total",
                     labels={"reason": reason.split(":")[0], "mode": mode})
         self.stats["recovery_ms"] = ms
         self.last_telemetry["integrity"] = dict(
             reason=reason, mode=mode, recovery_ms=round(ms, 3))
+        _spans.log_event("recovery", source="session", reason=reason,
+                         mode=mode, recovery_ms=round(ms, 3))
         return packed
 
     def complete_allocate(self, pending: PendingAllocate):
@@ -979,8 +1005,9 @@ class Session:
         cfg, T, J = pending.cfg, pending.T, pending.J
         packed = self._readback_packed(pending)
         from ..ops.allocate_scan import unpack_decisions
-        (task_node, task_mode, task_gpu, job_ready, job_pipelined,
-         job_attempted) = unpack_decisions(packed, T, J)
+        with _spans.span("session.unpack"):
+            (task_node, task_mode, task_gpu, job_ready, job_pipelined,
+             job_attempted) = unpack_decisions(packed, T, J)
         self.stats["kernel_ms"] = (pending.dispatch_ms
                                    + (time.time() - t0) * 1000)
         if cfg.telemetry and packed.shape[0] > 3 * T + 3 * J:
@@ -999,9 +1026,10 @@ class Session:
             job_attempted=job_attempted)
         self.last_allocate = result
         t0 = time.time()
-        self.apply_allocate(
-            result, host=(task_node, task_mode, task_gpu, job_ready,
-                          job_pipelined))
+        with _spans.span("session.apply"):
+            self.apply_allocate(
+                result, host=(task_node, task_mode, task_gpu, job_ready,
+                              job_pipelined))
         self.stats["apply_ms"] = (time.time() - t0) * 1000
         return result
 

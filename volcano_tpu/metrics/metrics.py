@@ -72,6 +72,15 @@ _HELP = {
         "Sidecar client reconnects after a socket failure",
     "sidecar_replayed_rounds_total":
         "VCRQ rounds served from the idempotent replay cache",
+    "span_phase_ms":
+        "Host span duration quantiles per cycle phase (ring-buffered "
+        "p50/p95/p99 from telemetry.spans — the SLO latency surface)",
+    "pipeline_overlap_fraction":
+        "Fraction of the in-flight device window covered by non-blocked "
+        "host work (telemetry.spans occupancy; ~0 when synchronous)",
+    "pipeline_bubble_ms":
+        "In-flight device window time the host spent idle or blocked "
+        "(the pipeline bubble the deep-async item must shrink)",
 }
 
 

@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..chaos.inject import seam
+from ..telemetry import spans as _spans
 
 _GROUPS = ("f", "i", "b")
 _TARGETS = {"f": np.float32, "i": np.int32, "b": np.bool_}
@@ -432,13 +433,14 @@ class DeltaKernel:
         caller's next rung on the degradation ladder (the CPU oracle)."""
         # the suspect residents feed nothing anymore — the failed cycle
         # has been read back, so the deletes are free
-        if state.device is not None:
-            self._invalidate(state.device)
-            state.device = None
-        state.mirror = None     # force_full below; never diff vs a suspect
-        packed = self.run(state, tree, force_full=True)
-        state.last_kind = "recovery"
-        return packed
+        with _spans.span("delta.recover", cat="recovery"):
+            if state.device is not None:
+                self._invalidate(state.device)
+                state.device = None
+            state.mirror = None  # force_full below; never diff vs a suspect
+            packed = self.run(state, tree, force_full=True)
+            state.last_kind = "recovery"
+            return packed
 
     def _reset_state(self, state: "ResidentState") -> None:
         """After a failed dispatch the runtime may or may not have consumed
@@ -485,18 +487,20 @@ class DeltaKernel:
         # where donation was honored the runtime killed them at dispatch
         self._invalidate(state.retiring)
         state.retiring = ()
-        bufs = fuse_into(tree, self.spec, self.sizes, out=state.scratch)
+        with _spans.span("delta.pack"):
+            bufs = fuse_into(tree, self.spec, self.sizes, out=state.scratch)
         state.scratch = None
         full_bytes = int(sum(b.nbytes for b in bufs))
         deltas = None
         if state.mirror is not None and state.device is not None \
                 and not force_full:
-            deltas = []
-            total = 0
-            for new, old in zip(bufs, state.mirror):
-                idx = np.flatnonzero(new != old).astype(np.int32)
-                deltas.append((idx, new[idx]))
-                total += int(idx.size)
+            with _spans.span("delta.diff"):
+                deltas = []
+                total = 0
+                for new, old in zip(bufs, state.mirror):
+                    idx = np.flatnonzero(new != old).astype(np.int32)
+                    deltas.append((idx, new[idx]))
+                    total += int(idx.size)
             if 2 * total >= sum(self.sizes):
                 # a delta this large ships more bytes than the buffers:
                 # take the full path (decisions identical either way)
@@ -507,7 +511,8 @@ class DeltaKernel:
                 # computation, so dropping them NOW is free and keeps TPU
                 # memory from holding both generations
                 self._invalidate(state.device)
-            dev = tuple(jax.device_put(b) for b in bufs)
+            with _spans.span("delta.upload"):
+                dev = tuple(jax.device_put(b) for b in bufs)
             args = []
             for g, n in zip(_GROUPS, self.sizes):
                 args += [np.zeros(0, np.int32), np.zeros(0, _TARGETS[g])]
@@ -527,7 +532,8 @@ class DeltaKernel:
             state.last_upload_bytes = upload
         state.full_upload_bytes = full_bytes
         try:
-            fnew, inew, bnew, packed = self._fn(*dev, *args)
+            with _spans.span("delta.dispatch", cat="dispatch"):
+                fnew, inew, bnew, packed = self._fn(*dev, *args)
         except Exception:
             self._reset_state(state)
             raise
@@ -883,13 +889,14 @@ class ShardedDeltaKernel:
         """Integrity recovery: full re-fuse from SOURCE truth +
         recompute, same contract as :meth:`DeltaKernel.recover` (heals
         both a corrupted shard and a drifted mirror; decision-neutral)."""
-        if state.device is not None:
-            self._invalidate(state.device)
-            state.device = None
-        state.mirror = None
-        packed = self.run(state, tree, force_full=True)
-        state.last_kind = "recovery"
-        return packed
+        with _spans.span("delta.recover", cat="recovery"):
+            if state.device is not None:
+                self._invalidate(state.device)
+                state.device = None
+            state.mirror = None
+            packed = self.run(state, tree, force_full=True)
+            state.last_kind = "recovery"
+            return packed
 
     _reset_state = DeltaKernel._reset_state
     _invalidate = DeltaKernel._invalidate
@@ -920,26 +927,29 @@ class ShardedDeltaKernel:
         seam("delta.run", kernel=self, state=state)
         self._invalidate(state.retiring)
         state.retiring = ()
-        bufs = self._fuse_sharded(tree, out=state.scratch)
+        with _spans.span("delta.pack"):
+            bufs = self._fuse_sharded(tree, out=state.scratch)
         state.scratch = None
         full_bytes = int(sum(b.nbytes for b in bufs))
         deltas = None
         if state.mirror is not None and state.device is not None \
                 and not force_full:
-            deltas = []
-            total = 0
-            for new, old in zip(bufs, state.mirror):
-                idx = np.flatnonzero(new.ravel() != old.ravel()) \
-                        .astype(np.int32)
-                deltas.append((idx, new.ravel()[idx]))
-                total += int(idx.size)
+            with _spans.span("delta.diff"):
+                deltas = []
+                total = 0
+                for new, old in zip(bufs, state.mirror):
+                    idx = np.flatnonzero(new.ravel() != old.ravel()) \
+                            .astype(np.int32)
+                    deltas.append((idx, new.ravel()[idx]))
+                    total += int(idx.size)
             if 2 * total >= self._total_elems:
                 deltas = None
         if deltas is None:
             if state.device is not None:
                 self._invalidate(state.device)
-            dev = tuple(jax.device_put(b, sh)
-                        for b, sh in zip(bufs, self.resident_shardings))
+            with _spans.span("delta.upload"):
+                dev = tuple(jax.device_put(b, sh)
+                            for b, sh in zip(bufs, self.resident_shardings))
             args = []
             for g in _GROUPS:
                 args += [np.zeros((self.n_shards, 0), np.int32),
@@ -954,20 +964,23 @@ class ShardedDeltaKernel:
             dev = state.device
             args = []
             upload = 0
-            for (idx, vals), g in zip(deltas[:3], _GROUPS):
-                pidx, pvals = self._route(idx, vals, g)
-                args += [pidx, pvals]
-                upload += int(pidx.nbytes + pvals.nbytes)
-            for (idx, vals) in deltas[3:]:
-                pidx, pvals = _pad_delta(idx, vals, delta_bucket(idx.size))
-                args += [pidx, pvals]
-                upload += int(pidx.nbytes + pvals.nbytes)
+            with _spans.span("delta.route"):
+                for (idx, vals), g in zip(deltas[:3], _GROUPS):
+                    pidx, pvals = self._route(idx, vals, g)
+                    args += [pidx, pvals]
+                    upload += int(pidx.nbytes + pvals.nbytes)
+                for (idx, vals) in deltas[3:]:
+                    pidx, pvals = _pad_delta(idx, vals,
+                                             delta_bucket(idx.size))
+                    args += [pidx, pvals]
+                    upload += int(pidx.nbytes + pvals.nbytes)
             state.delta_cycles += 1
             state.last_kind = "delta"
             state.last_upload_bytes = upload
         state.full_upload_bytes = full_bytes
         try:
-            out = self._fn(*dev, *args)
+            with _spans.span("delta.dispatch", cat="dispatch"):
+                out = self._fn(*dev, *args)
         except Exception:
             self._reset_state(state)
             raise

@@ -8,7 +8,9 @@ page is built straight from the in-memory API server, cached with a TTL
 JSON API (``/api/page``), a Prometheus exposition passthrough
 (``/metrics``), the scheduler's flight-recorder ring as JSON
 (``/api/telemetry`` — per-cycle snapshots; /metrics stays cumulative),
-and ``/healthz``.
+``/healthz``, and the span tracer's Chrome trace-event export
+(``/api/trace`` — load it in Perfetto; the ``latency``/``pipeline``
+tables below render the same rings server-side).
 """
 
 from __future__ import annotations
@@ -21,6 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from ..metrics import METRICS
+from ..telemetry import spans as _spans
 
 DEFAULT_REFRESH_SECONDS = 5.0
 
@@ -99,6 +102,10 @@ def build_page(system, now: Optional[float] = None) -> Page:
             alloc = tel.get("allocate") or {}
             rej = alloc.get("pred_reject") or {}
             unp = alloc.get("unplaced") or {}
+            # sharded-cycle / fault-ladder columns (PR 7): None -> "-"
+            mesh = e.get("mesh_devices")
+            reshard = e.get("resharding_copies")
+            degr = e.get("degradation")
             rows.append([
                 e.get("cycle", "-"),
                 time.strftime("%H:%M:%S",
@@ -109,12 +116,39 @@ def build_page(system, now: Optional[float] = None) -> Page:
                 sum(rej.values()) if rej else "-",
                 sum(unp.values()) if unp else "-",
                 alloc.get("argmax_ties", "-"),
+                mesh if mesh is not None else "-",
+                reshard if reshard is not None else "-",
+                degr if degr is not None else "-",
             ])
         page.tables["telemetry"] = {
             "headers": ["Cycle", "Time", "ms", "Binds", "Evictions",
                         "Result", "Rounds", "Pops", "PredRejects",
-                        "Unplaced", "ArgmaxTies"],
+                        "Unplaced", "ArgmaxTies", "Mesh", "Reshard",
+                        "Degr"],
             "rows": rows}
+
+    # ---- latency breakdown (span rings) + pipeline occupancy -------------
+    stats = _spans.phase_stats()
+    if stats:
+        page.tables["latency"] = {
+            "headers": ["Phase", "Count", "p50 ms", "p95 ms", "p99 ms",
+                        "Last ms"],
+            "rows": [[ph, st["count"], st["p50"], st["p95"], st["p99"],
+                      st["last"]] for ph, st in stats.items()]}
+        occ = _spans.occupancy()
+        if occ.get("windows"):
+            occ_rows = [["all", occ["windows"], occ["window_ms"],
+                         occ["overlap_ms"], occ["bubble_ms"],
+                         occ["pipeline_overlap_fraction"]]]
+            for shard, o in (occ.get("per_shard") or {}).items():
+                occ_rows.append([f"shard {shard}", o["windows"],
+                                 o["window_ms"], o["overlap_ms"],
+                                 o["bubble_ms"],
+                                 o["pipeline_overlap_fraction"]])
+            page.tables["pipeline"] = {
+                "headers": ["Scope", "Windows", "Window ms", "Overlap ms",
+                            "Bubble ms", "Overlap fraction"],
+                "rows": occ_rows}
     return page
 
 
@@ -203,6 +237,11 @@ class Dashboard:
                                              "recorded_total": 0,
                                              "cycles": []}))
                     self._send(body, "application/json")
+                elif self.path == "/api/trace":
+                    # the span tracer's Chrome trace-event export, always
+                    # live — save it and load in Perfetto/chrome://tracing
+                    self._send(json.dumps(_spans.export_chrome_trace()),
+                               "application/json")
                 elif self.path in ("/", "/index.html"):
                     self._send(render_html(dashboard.page()), "text/html")
                 else:

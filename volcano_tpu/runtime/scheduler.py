@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 from ..framework.conf import SchedulerConfiguration, parse_conf
 from ..framework.session import Session
 from ..metrics import METRICS
+from ..telemetry import spans
 from .fake_cluster import FakeCluster
 
 
@@ -249,6 +250,8 @@ class Scheduler:
         # degradation de-escalation probe: after the cooldown window of
         # clean cycles, climb back to the configured mode
         if self.degradation_level and self.cycles >= self._degrade_until:
+            spans.log_event("degradation", level_from=self.degradation_level,
+                            level_to=0, cycle=self.cycles)
             self.degradation_level = 0
             METRICS.set_gauge("degradation_level", None, 0)
         completed = self._drain_pending(wall)
@@ -262,7 +265,8 @@ class Scheduler:
             METRICS.inc("resync_dropped", rs["dropped"])
             if rs["dead_lettered"]:
                 METRICS.inc("resync_dead_letter_total", rs["dead_lettered"])
-        ssn = self._open_session(now)
+        with spans.span("cycle.open"):
+            ssn = self._open_session(now)
         from ..actions import get_action
         actions = list(self.conf.actions)
         # the pipeline defers the allocate readback across the run_once
@@ -275,14 +279,16 @@ class Scheduler:
                      and actions and actions[-1] == "allocate")
         for name in (actions[:-1] if pipelined else actions):
             ta = time.time()
-            try:
-                get_action(name).execute(ssn)
-            except Exception as e:
-                if name != "allocate":
-                    raise
-                # the compiled allocate failed mid-action: walk the ladder
-                self._note_fault("allocate", e)
-                self._allocate_degraded(ssn)
+            with spans.span(f"action.{name}"):
+                try:
+                    get_action(name).execute(ssn)
+                except Exception as e:
+                    if name != "allocate":
+                        raise
+                    # the compiled allocate failed mid-action: walk the
+                    # ladder
+                    self._note_fault("allocate", e)
+                    self._allocate_degraded(ssn)
             METRICS.observe_action(name, time.time() - ta)
         if pipelined:
             ta = time.time()
@@ -323,7 +329,12 @@ class Scheduler:
 
     def _degrade(self, level: int) -> None:
         """Escalate the degradation ladder and (re)start the cooldown."""
+        prev = self.degradation_level
         self.degradation_level = max(self.degradation_level, level)
+        if self.degradation_level != prev:
+            spans.log_event("degradation", level_from=prev,
+                            level_to=self.degradation_level,
+                            cycle=self.cycles)
         self._degrade_until = self.cycles + self.fault_cooldown
         METRICS.set_gauge("degradation_level", None, self.degradation_level)
 
@@ -336,15 +347,16 @@ class Scheduler:
         equality reference), so a recovered fault is decision-neutral."""
         import numpy as np
         t0 = time.time()
-        try:
-            result = ssn.run_allocate()
-            mode = "sync"
-            self._degrade(1)
-        except Exception as e:
-            self._note_fault("sync_retry", e)
-            result = ssn.run_allocate_oracle()
-            mode = "cpu_oracle"
-            self._degrade(2)
+        with spans.span("cycle.recovery", cat="recovery"):
+            try:
+                result = ssn.run_allocate()
+                mode = "sync"
+                self._degrade(1)
+            except Exception as e:
+                self._note_fault("sync_retry", e)
+                result = ssn.run_allocate_oracle()
+                mode = "cpu_oracle"
+                self._degrade(2)
         ssn.stats["allocated_binds"] = len(ssn.binds)
         ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
         ssn.stats["jobs_pipelined"] = int(
@@ -352,6 +364,9 @@ class Scheduler:
         ssn.stats.setdefault("recovery_ms", (time.time() - t0) * 1000)
         METRICS.inc("cycle_recoveries_total",
                     labels={"reason": "dispatch", "mode": mode})
+        spans.log_event("recovery", stage="dispatch", mode=mode,
+                        cycle=self.cycles,
+                        recovery_ms=round((time.time() - t0) * 1000, 3))
 
     def _drain_pending(self, wall: float):
         """Drain the one-deep pipeline: read the in-flight cycle's packed
@@ -366,7 +381,8 @@ class Scheduler:
         self._pending = None
         t0 = time.time()
         try:
-            result = ssn.complete_allocate(pending)
+            with spans.span("cycle.drain"):
+                result = ssn.complete_allocate(pending)
         except Exception as e:
             # complete_allocate already walked re-fuse -> cpu-oracle; if it
             # STILL raised the cycle is unrecoverable. Keep serving: retire
@@ -402,23 +418,25 @@ class Scheduler:
         """Everything after the last action: close, write back, flush
         intents, metrics, flight record — shared by the synchronous path
         and the pipelined drain."""
-        ssn.close()
+        with spans.span("cycle.finish"):
+            ssn.close()
 
-        # PodGroup status write-back at session close (the jobUpdater's
-        # parallel UpdatePodGroup flush, framework/job_updater.go:66-108)
-        self.cluster.update_podgroup_phases(ssn.phase_updates)
+            # PodGroup status write-back at session close (the jobUpdater's
+            # parallel UpdatePodGroup flush, framework/job_updater.go:66-108)
+            self.cluster.update_podgroup_phases(ssn.phase_updates)
 
-        for intent in ssn.evictions:
-            if not self.cluster.evict(intent):
-                METRICS.inc("resync_tasks")
-                self.resync.add(intent, "evict", wall)
-        for intent in ssn.binds:
-            if not self.cluster.bind(intent):
-                METRICS.inc("resync_tasks")
-                # hold the Binding state so later cycles don't re-decide
-                # while the rate-limited retry works (cache.go:549-560)
-                self.cluster.hold_binding(intent)
-                self.resync.add(intent, "bind", wall)
+            for intent in ssn.evictions:
+                if not self.cluster.evict(intent):
+                    METRICS.inc("resync_tasks")
+                    self.resync.add(intent, "evict", wall)
+            for intent in ssn.binds:
+                if not self.cluster.bind(intent):
+                    METRICS.inc("resync_tasks")
+                    # hold the Binding state so later cycles don't
+                    # re-decide while the rate-limited retry works
+                    # (cache.go:549-560)
+                    self.cluster.hold_binding(intent)
+                    self.resync.add(intent, "bind", wall)
         METRICS.observe_cycle(host_s)
         METRICS.inc("schedule_attempts")
         # reference vocabulary: schedule_attempts_total{result=...}
@@ -433,6 +451,7 @@ class Scheduler:
         # retrace incident
         from ..telemetry import publish_gauges
         publish_gauges(METRICS)
+        spans.publish_gauges(METRICS)
         self.cycles += 1
         stats = ssn.stats
         faults, self._cycle_faults = self._cycle_faults, []
@@ -462,7 +481,10 @@ class Scheduler:
                                if "resharding_copies" in stats else None),
             dirty_jobs=self._last_dirty[0], dirty_nodes=self._last_dirty[1],
             stats={k: round(float(v), 3) for k, v in stats.items()},
-            telemetry=ssn.last_telemetry or None)
+            telemetry=ssn.last_telemetry or None,
+            # per-cycle span summary (plain {phase: ms} dict — pickle- and
+            # JSON-safe for vcctl --state)
+            spans=spans.drain_cycle_summary())
         return ssn
 
     def drain(self, now: Optional[float] = None):
@@ -479,7 +501,8 @@ class Scheduler:
         if self._pending is None:
             return False
         import jax
-        jax.block_until_ready(self._pending[1].packed)
+        with spans.span("cycle.wait_device", cat="wait"):
+            jax.block_until_ready(self._pending[1].packed)
         return True
 
     def run(self, cycles: int = 1, sleep: bool = False) -> List[Session]:

@@ -45,6 +45,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from ..ops.allocate_scan import MODE_ALLOCATED, AllocateConfig, AllocateExtras
+from ..telemetry import spans as _spans
 
 DECISION_MAGIC = 0x31444356  # "VCD1"
 REQUEST_MAGIC = 0x31524356   # "VCR1" — leads every request frame so a
@@ -309,12 +310,14 @@ class SchedulerSidecar:
             state = self._states.get(id(kernel))
             if state is None:
                 state = self._states[id(kernel)] = ResidentState()
-            packed = kernel.run(state, tree_in)
+            with _spans.span("sidecar.dispatch", cat="dispatch"):
+                packed = kernel.run(state, tree_in)
             return (packed, state.last_kind, state.last_upload_bytes,
                     kernel, state)
         from ..ops.fused_io import fused_cycle_cached
         fn, fuse = fused_cycle_cached(self._cycle, tree_in, self._fused)
-        return fn(*fuse(tree_in)), None, None, None, None
+        with _spans.span("sidecar.dispatch", cat="dispatch"):
+            return fn(*fuse(tree_in)), None, None, None, None
 
     def _verify_integrity(self, packed: np.ndarray, kernel, state, tree_in,
                           kind, upload):
@@ -327,22 +330,31 @@ class SchedulerSidecar:
         from ..chaos.inject import seam
         from ..metrics import METRICS
         seam("sidecar.complete", state=state)
-        dec, dev_digest = kernel.split_digest(packed)
-        host_digest = kernel.mirror_digest(state)
+        with _spans.span("sidecar.digest"):
+            dec, dev_digest = kernel.split_digest(packed)
+            host_digest = kernel.mirror_digest(state)
         if host_digest is None or np.array_equal(dev_digest, host_digest):
             return dec, kind, upload
         METRICS.inc("resident_digest_mismatch_total")
-        packed = np.asarray(kernel.recover(state, tree_in), dtype=np.int32)
-        dec, _dig = kernel.split_digest(packed)
+        _spans.log_event("digest_trip", source="sidecar")
+        with _spans.span("sidecar.recovery", cat="recovery"):
+            packed = np.asarray(kernel.recover(state, tree_in),
+                                dtype=np.int32)
+            dec, _dig = kernel.split_digest(packed)
         METRICS.inc("cycle_recoveries_total",
                     labels={"reason": "digest", "mode": "refuse"})
+        _spans.log_event("recovery", source="sidecar", reason="digest",
+                         mode="refuse")
         return dec, "recovery", state.last_upload_bytes
 
     def _run_cycle(self, tree_in):
         """_dispatch_cycle + synchronous readback + integrity verify (the
         VCR1 path)."""
         packed, kind, upload, kernel, state = self._dispatch_cycle(tree_in)
-        packed = np.asarray(packed, dtype=np.int32)
+        t_d = _spans.now()
+        with _spans.span("sidecar.readback", cat="wait"):
+            packed = np.asarray(packed, dtype=np.int32)
+        _spans.device_window(t_d, _spans.now())
         return self._verify_integrity(packed, kernel, state, tree_in,
                                       kind, upload)
 
@@ -406,7 +418,8 @@ class SchedulerSidecar:
         t_start = _time.time()
         self._rounds_served += 1
         seam("sidecar.round", round=self._rounds_served)
-        tree_in, snap, T, J = self._build_tree(buf, extras_buf)
+        with _spans.span("sidecar.build"):
+            tree_in, snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
             self._drain_locked()        # a VCRP round must not be orphaned
             packed, cycle_kind, upload_bytes = self._run_cycle(tree_in)
@@ -426,7 +439,8 @@ class SchedulerSidecar:
             self.flight.record(
                 buffer_bytes=len(buf) + len(extras_buf), tasks=T, jobs=J,
                 cycle_ms=cycle_ms, cycle_kind=cycle_kind,
-                upload_bytes=upload_bytes, telemetry=tel)
+                upload_bytes=upload_bytes, telemetry=tel,
+                spans=_spans.drain_cycle_summary())
 
         return payload, finish
 
@@ -439,7 +453,10 @@ class SchedulerSidecar:
             return None
         self._pending = None
         import time as _time
-        packed = np.asarray(pending["packed"], dtype=np.int32)
+        with _spans.span("sidecar.drain", cat="wait"):
+            packed = np.asarray(pending["packed"], dtype=np.int32)
+        if pending.get("dispatched_at"):
+            _spans.device_window(pending["dispatched_at"], _spans.now())
         packed, kind, upload = self._verify_integrity(
             packed, pending["kernel"], pending["state"], pending["tree"],
             pending["kind"], pending["upload"])
@@ -450,7 +467,8 @@ class SchedulerSidecar:
             jobs=pending["J"], pipelined_round=True,
             cycle_ms=round((_time.time() - pending["t0"]) * 1000, 3),
             cycle_kind=kind, upload_bytes=upload,
-            recovered=(kind == "recovery") or None)
+            recovered=(kind == "recovery") or None,
+            spans=_spans.drain_cycle_summary())
         return payload
 
     def schedule_buffer_pipelined(self, buf: bytes,
@@ -468,7 +486,8 @@ class SchedulerSidecar:
         from ..chaos.inject import seam
         self._rounds_served += 1
         seam("sidecar.round", round=self._rounds_served)
-        tree_in, _snap, T, J = self._build_tree(buf, extras_buf)
+        with _spans.span("sidecar.build"):
+            tree_in, _snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
             prev_payload = self._drain_locked()
             packed, kind, upload, kernel, state = \
@@ -476,7 +495,8 @@ class SchedulerSidecar:
             self._pending = dict(packed=packed, T=T, J=J, kind=kind,
                                  upload=upload, t0=_time.time(),
                                  buffer_bytes=len(buf) + len(extras_buf),
-                                 kernel=kernel, state=state, tree=tree_in)
+                                 kernel=kernel, state=state, tree=tree_in,
+                                 dispatched_at=_spans.now())
         if prev_payload is None:
             # priming round: an explicit empty decision payload
             prev_payload = self._decisions_payload(

@@ -1,6 +1,6 @@
 """Observability for the compiled scheduling cycle (ISSUE 3 tentpole).
 
-Three layers, all host-callback-free on the hot path:
+Four layers, all host-callback-free on the hot path:
 
 - :mod:`.cycle` — ``CycleTelemetry`` and friends: pure i32/f32 counter
   pytrees accumulated INSIDE the compiled cycle (per-predicate-family
@@ -16,6 +16,14 @@ Three layers, all host-callback-free on the hot path:
 - :mod:`.tracecount` — jit trace-vs-call counters for the compiled entry
   points, exported as ``volcano_jit_*`` gauges (a live retrace is the
   production analog of the graphcheck recompile family).
+- :mod:`.spans` — host-side span tracing of the steady cycle (ISSUE 8):
+  per-phase p50/p95/p99 latency rings, the pipeline-occupancy analyzer
+  (``pipeline_overlap_fraction`` / ``bubble_ms`` against the in-flight
+  device window), a Chrome trace-event exporter
+  (``python -m volcano_tpu.telemetry --trace out.json``), and the
+  structured event log for degradation transitions, digest trips, and
+  recoveries. Host-only by construction: jaxprs and decisions are
+  bit-identical with tracing on or off.
 
 ``/metrics`` keeps the cumulative prometheus families (the reference's
 surface); ``/api/telemetry`` serves the per-cycle flight record — see
@@ -24,6 +32,7 @@ docs/architecture.md "Observability".
 
 from __future__ import annotations
 
+from . import spans
 from .cycle import (PRED_FAMILIES, UNPLACED_REASONS, BackfillTelemetry,
                     CycleTelemetry, PreemptTelemetry, cycle_telemetry_size,
                     unpack_cycle_telemetry)
@@ -34,7 +43,7 @@ __all__ = [
     "PRED_FAMILIES", "UNPLACED_REASONS", "BackfillTelemetry",
     "CycleTelemetry", "PreemptTelemetry", "cycle_telemetry_size",
     "unpack_cycle_telemetry", "FlightRecorder", "counted_jit",
-    "publish_gauges", "publish_cycle_telemetry",
+    "publish_gauges", "publish_cycle_telemetry", "spans",
 ]
 
 

@@ -1,0 +1,84 @@
+"""Cycle timeline profiler CLI: ``python -m volcano_tpu.telemetry``.
+
+Runs a short self-contained scheduler loop (the chaos probe's cluster and
+churn, no faults) with span tracing on, then exports the Chrome
+trace-event JSON (``--trace out.json``, loadable in Perfetto /
+chrome://tracing) and optionally the structured event log
+(``--events out.jsonl``). A summary — phase p50/p95/p99, pipeline
+occupancy, event counts — is printed to stdout as JSON.
+
+The loop churns AFTER run_once returns, i.e. while the one-deep
+pipeline's dispatched cycle is still in flight: that ingest work is
+exactly the host/device overlap the occupancy analyzer prices, so the
+pipelined run reports a genuinely nonzero ``pipeline_overlap_fraction``
+while ``--sync`` honestly reports ~0 (the window interior is all blocked
+readback). scripts/tier1.sh's trace smoke pins both.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.telemetry",
+        description="span-trace a short scheduler loop and export it")
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="write Chrome trace-event JSON here")
+    ap.add_argument("--events", metavar="OUT.jsonl",
+                    help="write the structured event log (JSONL) here")
+    ap.add_argument("--merge", metavar="TRACE.json",
+                    help="merge another trace's traceEvents (e.g. a "
+                         "converted jax.profiler device trace)")
+    ap.add_argument("--cycles", type=int, default=50)
+    ap.add_argument("--sync", action="store_true",
+                    help="synchronous loop (no pipeline) — occupancy ~0")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run with sharding: true (per-shard occupancy)")
+    args = ap.parse_args(argv)
+
+    from . import spans
+    from ..chaos.probe import _PROBE_CONF, _churn, _small_cluster
+    from ..framework.conf import parse_conf
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+
+    spans.reset()
+    conf = parse_conf(("sharding: true\n" if args.sharded else "")
+                      + _PROBE_CONF)
+    pipeline = not args.sync
+    cluster = FakeCluster(_small_cluster())
+    sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
+    for c in range(args.cycles):
+        sched.run_once(now=1000.0 + c)
+        # ingest while the dispatched cycle is in flight — the overlap
+        # the pipeline exists to buy
+        with spans.span("loop.ingest", cat="ingest"):
+            _churn(cluster, c)
+        if pipeline:
+            sched.drain(now=1000.0 + c)
+
+    trace = spans.export_chrome_trace(args.trace, merge=args.merge)
+    events_written = spans.export_event_log(args.events) \
+        if args.events else None
+    summary = {
+        "cycles": args.cycles,
+        "pipeline": pipeline,
+        "sharded": args.sharded,
+        "trace_path": args.trace,
+        "trace_events": len(trace["traceEvents"]),
+        "phases": spans.phase_stats(),
+        "occupancy": spans.occupancy(),
+        "events_logged": len(spans.events()),
+        "events_written": events_written,
+    }
+    json.dump(summary, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

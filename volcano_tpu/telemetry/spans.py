@@ -1,0 +1,432 @@
+"""Host-side span tracing for the steady cycle (ISSUE 8 tentpole).
+
+BENCH_r05 put the steady cycle's total p50 at ~129 ms against ~42 ms of
+raw loop time, and nothing in the repo could say where the gap goes: the
+flight recorder (PR 3) keeps per-cycle COUNTERS, not time. This module is
+the wall-clock attribution layer — a low-overhead monotonic-clock span
+API instrumenting every real seam of the steady cycle (scheduler
+drain/open/actions, session extras/dispatch/readback/digest/apply, the
+delta kernels' pack/diff/route/dispatch, sidecar serve/drain, and the
+chaos recovery/degradation paths) — feeding three surfaces:
+
+- **Latency rings.** Every completed span lands its duration in a bounded
+  per-phase ring; :func:`phase_stats` serves p50/p95/p99 per phase — the
+  SLO surface the multi-tenant item will reuse.
+- **Pipeline occupancy.** The owners of the one-deep pipeline record the
+  in-flight DEVICE window (dispatch→drain) per cycle;
+  :func:`occupancy` intersects the union of non-``wait`` host spans with
+  those windows to compute ``pipeline_overlap_fraction`` (how much of the
+  device's flight time the host spent doing useful work) and
+  ``bubble_ms`` (flight time the host sat idle or blocked) — per shard
+  when the cycle runs sharded.
+- **Exporters.** :func:`export_chrome_trace` emits Chrome trace-event
+  JSON (Perfetto-loadable, ``python -m volcano_tpu.telemetry --trace
+  out.json``; mergeable with a device-side trace via ``merge=``), and
+  :func:`log_event` keeps a structured JSONL-ready event log for
+  degradation-ladder transitions, digest trips, and recoveries
+  (write-through to ``$VOLCANO_EVENT_LOG`` when set).
+
+The hard constraint, shared with the in-graph telemetry block: spans are
+HOST-ONLY. Nothing here touches a traced function, so every compiled
+entry point's jaxpr is bit-identical with tracing on or off, and so are
+the decisions (tests/test_spans.py pins the sha on the sync, pipelined,
+and sharded loops). Default-on cheap: a disabled ``span()`` returns a
+shared no-op context; an enabled one costs two ``perf_counter`` reads and
+one deque append under a lock. ``VOLCANO_SPANS=0`` disables at import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, Iterable, List, Optional
+
+_ENABLED = os.environ.get("VOLCANO_SPANS", "1").lower() not in (
+    "0", "false", "off")
+
+#: bounded buffers — memory is O(cap), never O(uptime)
+_MAX_EVENTS = int(os.environ.get("VOLCANO_SPAN_EVENTS", 8192))
+_RING = int(os.environ.get("VOLCANO_SPAN_RING", 512))
+_MAX_LOG = int(os.environ.get("VOLCANO_EVENT_LOG_CAP", 1024))
+
+#: device-window events ride a dedicated trace track (tid) per shard so
+#: Perfetto renders them as their own lane under the host threads
+_DEVICE_TID = 900
+
+_LOCK = threading.Lock()
+_EVENTS: deque = deque(maxlen=_MAX_EVENTS)
+_PHASES: Dict[str, deque] = defaultdict(lambda: deque(maxlen=_RING))
+_CYCLE_ACC: Dict[str, float] = defaultdict(float)
+_EVENT_LOG: deque = deque(maxlen=_MAX_LOG)
+_TIDS: Dict[int, int] = {}
+_TID_NAMES: Dict[int, str] = {}
+
+#: one monotonic epoch per process; the wall anchor lets exporters (and a
+#: device-trace merge) map span timestamps back to wall time
+_T0 = time.perf_counter()
+_WALL0 = time.time()
+
+
+def now() -> float:
+    """Seconds on the span clock (monotonic, process epoch)."""
+    return time.perf_counter() - _T0
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip tracing at runtime (tests; ops kill-switch). Returns the
+    previous state. Buffers are kept — call :func:`reset` to drop them."""
+    global _ENABLED
+    prev, _ENABLED = _ENABLED, bool(on)
+    return prev
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _TIDS.get(ident)
+    if t is None:
+        with _LOCK:
+            t = _TIDS.setdefault(ident, len(_TIDS) + 1)
+            _TID_NAMES.setdefault(t, threading.current_thread().name)
+    return t
+
+
+class _NullSpan:
+    """The disabled-path singleton: a no-op context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "t0")
+
+    def __init__(self, name: str, cat: str, args):
+        self.name = name
+        self.cat = cat
+        self.args = args or None
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter() - _T0
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter() - _T0
+        dur = t1 - self.t0
+        tid = _tid()
+        ms = dur * 1000.0
+        with _LOCK:
+            _EVENTS.append({"name": self.name, "cat": self.cat,
+                            "ts": self.t0, "dur": dur, "tid": tid,
+                            "args": self.args})
+            _PHASES[self.name].append(ms)
+            _CYCLE_ACC[self.name] += ms
+        return False
+
+
+def span(name: str, cat: str = "host", **args):
+    """A nestable, thread-aware timing span: ``with span("pack"): ...``.
+
+    ``cat`` tags the occupancy treatment: ``"wait"`` marks time the host
+    is BLOCKED (device readback, ``block_until_ready``) — subtracted from
+    the host-work union so a synchronous loop honestly reports ~zero
+    pipeline overlap; ``"device"`` is reserved for device windows. Any
+    other category counts as host work."""
+    if not _ENABLED:
+        return _NULL
+    return _Span(name, cat, args)
+
+
+def device_window(t0: float, t1: float, shard: Optional[int] = None,
+                  shards: int = 1, **args) -> None:
+    """Record one cycle's in-flight DEVICE window (dispatch→drain), in
+    span-clock seconds (:func:`now`). The window deliberately runs to the
+    DRAIN, not to device completion — it is the interval the pipeline has
+    available for host/device overlap, which is what the occupancy
+    analyzer prices. With ``shards > 1`` the single GSPMD launch covers
+    every shard, so one call records the common window; pass ``shard=``
+    if a path ever gets genuinely per-shard windows."""
+    if not _ENABLED:
+        return
+    dur = max(float(t1) - float(t0), 0.0)
+    a = dict(args)
+    if shards and shards > 1:
+        a["shards"] = int(shards)
+    with _LOCK:
+        _EVENTS.append({"name": "device_window", "cat": "device",
+                        "ts": float(t0), "dur": dur,
+                        "tid": _DEVICE_TID + (shard or 0),
+                        "shard": shard, "shards": int(shards or 1),
+                        "args": a or None})
+        _PHASES["device.window"].append(dur * 1000.0)
+
+
+# --------------------------------------------------------------- accessors
+def _pct(sorted_vals: List[float], q: float) -> float:
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(round(q * (len(sorted_vals) - 1))))]
+
+
+def phase_stats() -> Dict[str, Dict[str, float]]:
+    """{phase: {count, p50, p95, p99, mean, last, total_ms}} over each
+    phase's duration ring (ms) — the SLO latency surface."""
+    with _LOCK:
+        rings = {k: list(v) for k, v in _PHASES.items() if v}
+    out = {}
+    for k in sorted(rings):
+        vals = rings[k]
+        s = sorted(vals)
+        out[k] = {"count": len(s),
+                  "p50": round(_pct(s, 0.50), 3),
+                  "p95": round(_pct(s, 0.95), 3),
+                  "p99": round(_pct(s, 0.99), 3),
+                  "mean": round(sum(s) / len(s), 3),
+                  "last": round(vals[-1], 3),
+                  "total_ms": round(sum(s), 3)}
+    return out
+
+
+def drain_cycle_summary() -> Optional[Dict[str, float]]:
+    """Per-phase ms accumulated since the last drain, then reset — the
+    flight-recorder's per-cycle span summary (plain floats: JSON- and
+    pickle-safe by construction). Under the one-deep pipeline a cycle's
+    summary covers the host work performed during ITS run_once, which
+    mixes the tail of the previous cycle's drain — that is the honest
+    attribution of what the loop actually paid that turn."""
+    with _LOCK:
+        if not _CYCLE_ACC:
+            return None
+        acc = {k: round(v, 3) for k, v in _CYCLE_ACC.items()}
+        _CYCLE_ACC.clear()
+    return acc
+
+
+def events() -> List[dict]:
+    """Copies of the structured event log entries (oldest first)."""
+    with _LOCK:
+        return [dict(e) for e in _EVENT_LOG]
+
+
+def log_event(kind: str, **fields) -> Optional[dict]:
+    """Append one structured event (degradation transition, digest trip,
+    recovery) to the bounded log; write-through as one JSON line to
+    ``$VOLCANO_EVENT_LOG`` when set (best-effort — the log must never
+    take the cycle down)."""
+    if not _ENABLED:
+        return None
+    entry = dict(fields)
+    entry["kind"] = kind
+    entry["ts_ms"] = round(now() * 1000.0, 3)
+    entry["wall_ts"] = round(_WALL0 + entry["ts_ms"] / 1000.0, 6)
+    with _LOCK:
+        _EVENT_LOG.append(entry)
+    path = os.environ.get("VOLCANO_EVENT_LOG")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(entry, default=str) + "\n")
+        except OSError:
+            pass
+    return entry
+
+
+# --------------------------------------------------------------- occupancy
+def _merge(iv: List[tuple]) -> List[tuple]:
+    """Coalesce [start, end) intervals into a sorted disjoint union."""
+    out: List[List[float]] = []
+    for s, e in sorted(iv):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [tuple(x) for x in out]
+
+
+def _subtract(a: List[tuple], b: List[tuple]) -> List[tuple]:
+    """Disjoint-union ``a`` minus disjoint-union ``b``."""
+    out = []
+    for s, e in a:
+        cur = s
+        for bs, be in b:
+            if be <= cur or bs >= e:
+                continue
+            if bs > cur:
+                out.append((cur, bs))
+            cur = max(cur, be)
+            if cur >= e:
+                break
+        if cur < e:
+            out.append((cur, e))
+    return out
+
+
+def compute_occupancy(evts: Iterable[dict]) -> Dict[str, object]:
+    """Pure occupancy math over span/window event dicts (unit-testable on
+    synthetic inputs). Host work = union of non-``wait``/non-``device``
+    spans MINUS the union of ``wait`` spans — nesting never double-counts
+    and an outer span covering a blocked readback doesn't masquerade as
+    overlap (the synchronous loop's window is ~all wait, so it honestly
+    reports ~0). For each device window: ``overlap`` is the host-work
+    time inside it, ``bubble`` the remainder."""
+    evts = list(evts)
+    windows = [e for e in evts if e.get("cat") == "device"]
+    host = _merge([(e["ts"], e["ts"] + e["dur"]) for e in evts
+                   if e.get("cat") not in ("device", "wait")])
+    waits = _merge([(e["ts"], e["ts"] + e["dur"]) for e in evts
+                    if e.get("cat") == "wait"])
+    busy = _subtract(host, waits)
+
+    def analyze(ws):
+        w_s = o_s = 0.0
+        for w in ws:
+            a, b = w["ts"], w["ts"] + w["dur"]
+            w_s += b - a
+            o_s += sum(min(b, e) - max(a, s)
+                       for s, e in busy if e > a and s < b)
+        return {"windows": len(ws),
+                "window_ms": round(w_s * 1000.0, 3),
+                "overlap_ms": round(o_s * 1000.0, 3),
+                "bubble_ms": round((w_s - o_s) * 1000.0, 3),
+                "pipeline_overlap_fraction":
+                    (round(o_s / w_s, 4) if w_s > 0 else None)}
+
+    out = analyze(windows)
+    shard_ids = sorted({w.get("shard") for w in windows
+                        if w.get("shard") is not None})
+    n_shards = max([int(w.get("shards") or 1) for w in windows], default=1)
+    per_shard = None
+    if shard_ids or n_shards > 1:
+        ids = shard_ids or list(range(n_shards))
+        # a shard=None window is the common GSPMD launch: it covers every
+        # shard, so it contributes to each shard's view
+        per_shard = {str(s): analyze([w for w in windows
+                                      if w.get("shard") in (None, s)])
+                     for s in ids}
+    out["per_shard"] = per_shard
+    return out
+
+
+def occupancy() -> Dict[str, object]:
+    """Occupancy analysis over the live event ring: how much of the
+    in-flight device windows the host covered with real (non-wait) work,
+    aggregate and per shard."""
+    with _LOCK:
+        evts = [dict(e) for e in _EVENTS]
+    return compute_occupancy(evts)
+
+
+# --------------------------------------------------------------- exporters
+def export_chrome_trace(path: Optional[str] = None,
+                        merge=None) -> Dict[str, object]:
+    """The span + device-window rings as Chrome trace-event JSON
+    (Perfetto / chrome://tracing loadable): complete ("X") events in
+    microseconds on the span clock, with thread/track-name metadata.
+    ``merge`` accepts another trace dict or a path to one (e.g. a
+    converted ``jax.profiler`` device trace) whose ``traceEvents`` are
+    appended under their own pid. Writes to ``path`` when given; returns
+    the trace dict either way."""
+    with _LOCK:
+        evts = [dict(e) for e in _EVENTS]
+        tid_names = dict(_TID_NAMES)
+        log = [dict(e) for e in _EVENT_LOG]
+    tev: List[dict] = [{"name": "process_name", "ph": "M", "pid": 1,
+                        "args": {"name": "volcano_tpu host"}}]
+    device_tids = {}
+    for e in evts:
+        ev = {"name": e["name"], "cat": e["cat"], "ph": "X",
+              "ts": round(e["ts"] * 1e6, 3), "dur": round(e["dur"] * 1e6, 3),
+              "pid": 1, "tid": e["tid"]}
+        if e.get("args"):
+            ev["args"] = e["args"]
+        if e.get("cat") == "device":
+            shard = e.get("shard")
+            device_tids[e["tid"]] = ("device" if shard is None
+                                     else f"device shard {shard}")
+        tev.append(ev)
+    # degradation / digest-trip / recovery events as instants on track 0
+    for e in log:
+        tev.append({"name": e.get("kind", "event"), "cat": "event",
+                    "ph": "i", "s": "p",
+                    "ts": round(e.get("ts_ms", 0.0) * 1e3, 3),
+                    "pid": 1, "tid": 0,
+                    "args": {k: v for k, v in e.items()
+                             if k not in ("ts_ms",)}})
+    for tid, name in tid_names.items():
+        tev.append({"name": "thread_name", "ph": "M", "pid": 1,
+                    "tid": tid, "args": {"name": name}})
+    for tid, name in device_tids.items():
+        tev.append({"name": "thread_name", "ph": "M", "pid": 1,
+                    "tid": tid, "args": {"name": name}})
+    trace = {"traceEvents": tev, "displayTimeUnit": "ms",
+             "otherData": {"clock": "perf_counter",
+                           "wall_epoch": round(_WALL0, 6)}}
+    if merge is not None:
+        try:
+            if isinstance(merge, str):
+                with open(merge) as f:
+                    merge = json.load(f)
+            extra = merge.get("traceEvents", merge) \
+                if isinstance(merge, dict) else merge
+            trace["traceEvents"] = list(trace["traceEvents"]) + list(extra)
+        except Exception:  # merge is best-effort, never fatal
+            pass
+    if path:
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def export_event_log(path: str) -> int:
+    """Dump the structured event log as JSONL; returns the line count."""
+    entries = events()
+    with open(path, "w") as f:
+        for e in entries:
+            f.write(json.dumps(e, default=str) + "\n")
+    return len(entries)
+
+
+def publish_gauges(metrics=None, include_occupancy: bool = False) -> None:
+    """Export the phase rings as ``span_phase_ms{phase=...,q=...}`` gauges
+    (and, when asked, the occupancy numbers) into the METRICS registry.
+    Occupancy is opt-in because it scans the whole event ring — the
+    per-cycle scheduler publish sticks to the cheap phase stats; bench,
+    the CLI, and the dashboard ask for the full picture."""
+    if metrics is None:
+        from ..metrics import METRICS as metrics
+    for phase, st in phase_stats().items():
+        for q in ("p50", "p95", "p99"):
+            metrics.set_gauge("span_phase_ms",
+                              {"phase": phase, "q": q}, st[q])
+    if include_occupancy:
+        occ = occupancy()
+        if occ.get("pipeline_overlap_fraction") is not None:
+            metrics.set_gauge("pipeline_overlap_fraction", None,
+                              occ["pipeline_overlap_fraction"])
+            metrics.set_gauge("pipeline_bubble_ms", None, occ["bubble_ms"])
+
+
+def reset() -> None:
+    """Drop every buffer (tests / bench isolation). Thread-id mappings
+    are kept — they are stable identities, not measurements."""
+    with _LOCK:
+        _EVENTS.clear()
+        _PHASES.clear()
+        _CYCLE_ACC.clear()
+        _EVENT_LOG.clear()
