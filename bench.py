@@ -38,7 +38,9 @@ BENCH_BASELINE.json), BENCH_SKIP_CHECK=1 (skip the sub-scale equality
 check), BENCH_FORCE_CPU=1 (skip the TPU probe, run the degraded CPU path),
 BENCH_PROBE_TIMEOUT (seconds, default 150), BENCH_PROBE_RETRIES (default
 3, backoff 5s doubling capped at 60s), BENCH_SKIP_MULTICHIP=1 (skip the
-node-axis sharded-cycle comparison subprocess).
+node-axis sharded-cycle comparison subprocess), BENCH_SKIP_SCENARIOS=1
+(skip the scheduling-quality scenario block; BENCH_SCENARIO_CYCLES sets
+its horizon, default 16).
 """
 
 from __future__ import annotations
@@ -162,19 +164,23 @@ def _time_device(cycle_fn, snap, extras, reps):
     return result, min(times) * 1000, compile_s
 
 
-def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms):
-    """Compare this run's steady-loop and sub-scale kernel timings against
-    the most recent BENCH_r*.json recorded on the SAME backend label
-    (tpu vs cpu — cross-backend ratios are meaningless). Returns a
-    fail-soft block with per-metric baseline/ratio and a ``regression``
-    flag (ratio above BENCH_REGRESSION_THRESHOLD, default 1.5×), or None
-    when no comparable baseline exists. Never raises, never exits
-    nonzero — the guard annotates the record, the trajectory tooling
-    decides what to do about it."""
+def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None):
+    """Compare this run's steady-loop and sub-scale kernel timings — and,
+    when available, the scheduling-quality scorecard numbers (DRF share
+    error, node utilization) — against the most recent BENCH_r*.json
+    recorded on the SAME backend label (tpu vs cpu — cross-backend ratios
+    are meaningless). Returns a fail-soft block with per-metric
+    baseline/ratio and a ``regression`` flag (ratio above
+    BENCH_REGRESSION_THRESHOLD, default 1.5×), or None when no comparable
+    baseline exists. Every ratio is oriented so >1 means WORSE
+    (utilization, where lower is worse, is inverted). Never raises, never
+    exits nonzero — the guard annotates the record, the trajectory
+    tooling decides what to do about it."""
     import glob
     threshold = float(os.environ.get("BENCH_REGRESSION_THRESHOLD", 1.5))
     here = os.path.dirname(os.path.abspath(__file__))
     my_label = "cpu" if force_cpu else "tpu"
+    quality = quality or {}
     for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
                        reverse=True):
         try:
@@ -190,12 +196,18 @@ def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms):
         block = {"baseline": os.path.basename(path), "backend": my_label,
                  "threshold": threshold, "regression": False}
         found = False
-        for key, cur in (("steady_loop_ms", steady_loop_ms),
-                         ("sub_tpu_ms", sub_tpu_ms)):
+        for key, cur, invert in (
+                ("steady_loop_ms", steady_loop_ms, False),
+                ("sub_tpu_ms", sub_tpu_ms, False),
+                ("scenario_drf_share_error",
+                 quality.get("scenario_drf_share_error"), False),
+                ("scenario_node_utilization",
+                 quality.get("scenario_node_utilization"), True)):
             base = parsed.get(key)
-            if cur is None or not base:
+            if cur is None or not base or (invert and not cur):
                 continue
-            ratio = round(float(cur) / float(base), 2)
+            ratio = round(float(base) / float(cur) if invert
+                          else float(cur) / float(base), 2)
             block[key + "_baseline"] = base
             block[key + "_ratio"] = ratio
             if ratio > threshold:
@@ -996,13 +1008,56 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             latency_block = None
 
+    # ---- scheduling-quality scenario block (volcano_tpu/scenarios) -------
+    # A short seeded trace-replay scenario scored end to end: the record
+    # carries WHAT the scheduler decided (DRF share error, utilization,
+    # makespan, wait quantiles) next to how fast it decided it, so a perf
+    # win that quietly worsens placement quality shows up in the same
+    # trajectory. Drift spot-checks pin the compiled path to the CPU
+    # oracle inside the bench too. BENCH_SKIP_SCENARIOS=1 skips; failure
+    # records null, never kills the bench.
+    scenario_block = None
+    if not os.environ.get("BENCH_SKIP_SCENARIOS"):
+        try:
+            from volcano_tpu.scenarios import get_scenario, run_scenario
+            sres = run_scenario(
+                get_scenario("trace-replay"),
+                cycles=int(os.environ.get("BENCH_SCENARIO_CYCLES", 16)),
+                observe=False, drift_check_every=4)
+            scard = sres.scorecard
+            scenario_block = {
+                "scenario": scard.scenario,
+                "seed": scard.seed,
+                "cycles": scard.cycles,
+                "jobs_completed": scard.jobs_completed,
+                "makespan_cycles": scard.makespan_cycles,
+                "drf_share_error": scard.drf_share_error,
+                "node_utilization": scard.node_utilization,
+                "preemption_churn_total": scard.preemption_churn_total,
+                "wait_cycles": scard.wait_cycles,
+                "event_sha": scard.event_sha,
+                "decisions_sha": scard.decisions_sha,
+                "drift_checks": scard.drift_checks,
+                "drift_failures": scard.drift_failures,
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: scenarios block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            scenario_block = None
+
     # ---- perf regression guard vs the last same-backend BENCH record -----
     regression_block = None
     if not os.environ.get("BENCH_SKIP_REGRESSION"):
         try:
             regression_block = _regression_guard(
                 force_cpu, steady_ms,
-                stpu_ms if sub_speedup is not None else None)
+                stpu_ms if sub_speedup is not None else None,
+                quality={
+                    "scenario_drf_share_error":
+                        (scenario_block or {}).get("drf_share_error"),
+                    "scenario_node_utilization":
+                        (scenario_block or {}).get("node_utilization"),
+                })
         except Exception as e:  # noqa: BLE001 — fail-soft contract
             print("bench: regression guard failed: %s: %s"
                   % (type(e).__name__, e), file=sys.stderr)
@@ -1019,6 +1074,7 @@ tiers:
         "robustness": robustness_block,
         "multichip": multichip_block,
         "latency_breakdown": latency_block,
+        "scenarios": scenario_block,
         "regression": regression_block,
     }
     if force_cpu:
@@ -1096,6 +1152,14 @@ tiers:
         "speedup_1024n_10240t": sub_speedup,
         "sub_tpu_ms": round(stpu_ms, 3) if sub_speedup is not None else None,
         "sub_cpu_ms": round(scpu_ms, 1) if sub_speedup is not None else None,
+        # scenario quality numbers in the parsed block so future runs'
+        # regression guard has a same-backend quality baseline to ratio
+        # against (see _regression_guard)
+        "scenario_drf_share_error":
+            (scenario_block or {}).get("drf_share_error"),
+        "scenario_node_utilization":
+            (scenario_block or {}).get("node_utilization"),
+        "scenario_event_sha": (scenario_block or {}).get("event_sha"),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))

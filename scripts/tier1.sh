@@ -56,6 +56,15 @@ if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
         XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
         python -m volcano_tpu.chaos --smoke --sharded || crc=$?
 fi
+qrc=0
+if [ "${TIER1_SKIP_SCENARIO:-0}" != "1" ]; then
+    # scheduling-quality smoke (volcano_tpu/scenarios): a short seeded
+    # trace-replay run must produce a COMPLETE scorecard (non-null
+    # makespan / DRF share error / utilization / wait quantiles) and its
+    # CPU-oracle drift spot-checks must pass over real placements
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.scenarios --smoke \
+        > /tmp/_t1_scenario.json || qrc=$?
+fi
 if [ $rc -ne 0 ]; then
     exit $rc
 fi
@@ -64,5 +73,8 @@ if [ $grc -ne 0 ]; then
 fi
 if [ $crc -ne 0 ]; then
     exit $crc
+fi
+if [ $qrc -ne 0 ]; then
+    exit $qrc
 fi
 exit $trc

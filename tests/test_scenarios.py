@@ -1,0 +1,239 @@
+"""Scenario engine & quality scorecard tests (ISSUE 9).
+
+Three layers:
+
+- exact quality math on hand-computable fixtures (nearest-rank quantiles,
+  weighted water-fill incl. the zero-deserved queue, DRF share error,
+  collector scorecards with pinned numbers);
+- engine contracts: seed determinism (same seed -> same event sha -> same
+  scorecard), observe on/off decision-sha identity (the purity contract),
+  CPU-oracle drift checks covering real placements, and the
+  reclaim-pressure scenario driving reclaim/reserve/elect through the
+  compiled path with scorecard-visible effects;
+- surfaces: volcano_quality_* gauges, the dashboard ``scenarios`` table,
+  /api/scenarios, and the CLI.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from volcano_tpu.scenarios import quality
+from volcano_tpu.scenarios.quality import (CycleSample, QualityCollector,
+                                           Scorecard, nearest_rank,
+                                           share_error, weighted_water_fill)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    quality.reset_results()
+    yield
+    quality.reset_results()
+
+
+# ------------------------------------------------------------ exact math
+class TestQuantiles:
+    def test_nearest_rank_exact(self):
+        assert nearest_rank([3.0, 1.0, 2.0], 50) == 2.0
+        assert nearest_rank([3.0, 1.0, 2.0], 1) == 1.0
+        assert nearest_rank([3.0, 1.0, 2.0], 100) == 3.0
+        # n=4: p50 -> rank ceil(2)=2, p95 -> rank ceil(3.8)=4
+        assert nearest_rank([0.0, 1.0, 2.0, 5.0], 50) == 1.0
+        assert nearest_rank([0.0, 1.0, 2.0, 5.0], 95) == 5.0
+        assert nearest_rank([7.0], 99) == 7.0
+
+    def test_empty_and_out_of_range(self):
+        assert nearest_rank([], 50) is None
+        with pytest.raises(ValueError):
+            nearest_rank([1.0], 0)
+        with pytest.raises(ValueError):
+            nearest_rank([1.0], 101)
+
+
+class TestWaterFill:
+    def test_caps_by_demand(self):
+        # b saturates at its demand 2; a absorbs the remainder
+        assert weighted_water_fill(10, {"a": 1, "b": 1},
+                                   {"a": 10, "b": 2}) == {"a": 8.0, "b": 2.0}
+
+    def test_weight_proportional_when_oversubscribed(self):
+        # nobody saturates: pure weight split 2:1 of capacity 6
+        assert weighted_water_fill(6, {"a": 2, "b": 1},
+                                   {"a": 100, "b": 100}) == {"a": 4.0,
+                                                             "b": 2.0}
+
+    def test_zero_weight_and_zero_demand_deserve_zero(self):
+        d = weighted_water_fill(10, {"a": 1, "z": 0}, {"a": 5, "z": 5})
+        assert d == {"a": 5.0, "z": 0.0}
+        assert weighted_water_fill(10, {"a": 1}, {"a": 0}) == {"a": 0.0}
+
+
+class TestShareError:
+    def test_zero_deserved_queue_is_pure_error(self):
+        # queue z holds the whole cluster but deserves nothing; queue a
+        # deserves it all and holds nothing: |8-0|/8 + |0-8|/8 = 2.0
+        assert share_error({"z": 8.0}, {"a": 8.0, "z": 0.0}, 8.0) == 2.0
+
+    def test_perfect_and_degenerate(self):
+        assert share_error({"a": 4.0}, {"a": 4.0}, 8.0) == 0.0
+        assert share_error({"a": 4.0}, {}, 0.0) == 0.0
+
+
+class TestCollector:
+    def test_exact_scorecard(self):
+        col = QualityCollector("fix", seed=7)
+        col.note_arrival(0, jobs=2)
+        col.note_arrival(3)
+        col.note_completion(10)
+        for w in (0.0, 1.0, 2.0, 5.0):
+            col.note_wait(w)
+        # deserved(8000, 1:1, {a:8000,b:2000}) = {a:6000,b:2000}
+        col.add(CycleSample(
+            cycle=0, capacity_milli_cpu=8000.0,
+            allocated_milli_cpu={"a": 4000.0, "b": 2000.0},
+            demand_milli_cpu={"a": 8000.0, "b": 2000.0},
+            queue_weights={"a": 1.0, "b": 1.0}, evictions=3, binds=2,
+            action_effects={"reclaim_evictions": 3.0,
+                            "reserve_locked_total": 2.0}))
+        col.add(CycleSample(
+            cycle=1, capacity_milli_cpu=8000.0,
+            allocated_milli_cpu={"a": 6000.0, "b": 2000.0},
+            demand_milli_cpu={"a": 8000.0, "b": 2000.0},
+            queue_weights={"a": 1.0, "b": 1.0}, evictions=0, binds=1,
+            action_effects={"reclaim_evictions": 1.0,
+                            "reserve_locked_total": 5.0}))
+        card = col.scorecard(cycles=12)
+        assert card.jobs_submitted == 3 and card.jobs_completed == 1
+        assert card.makespan_cycles == 10
+        assert card.drf_share_error == 0.125        # mean(0.25, 0.0)
+        assert card.drf_share_error_max == 0.25
+        assert card.node_utilization == 0.875       # mean(0.75, 1.0)
+        assert card.preemption_churn_total == 3 and card.tasks_bound == 3
+        assert card.wait_cycles == {"p50": 1.0, "p95": 5.0, "p99": 5.0}
+        # sums for plain effects, running max for *_total effects
+        assert card.action_effects == {"reclaim_evictions": 4.0,
+                                       "reserve_locked_total": 5.0}
+        assert card.complete()
+
+    def test_incomplete_scorecard(self):
+        card = QualityCollector("fix", seed=0).scorecard(cycles=4)
+        assert card.makespan_cycles is None
+        assert card.drf_share_error is None
+        assert not card.complete()
+
+
+# --------------------------------------------------------- engine contracts
+def _run(name, **kw):
+    from volcano_tpu.scenarios import get_scenario, run_scenario
+    return run_scenario(get_scenario(name), **kw)
+
+
+class TestEngine:
+    # the multi-run scenario tests sit in the `slow` tail (tier-1 budget
+    # recalibration, same pattern as PR 1/3/5/8); tier1.sh still gates the
+    # engine every run via `python -m volcano_tpu.scenarios --smoke`
+    @pytest.mark.slow
+    def test_seed_determinism_and_drift_coverage(self):
+        a = _run("trace-replay", cycles=12, observe=False,
+                 drift_check_every=4)
+        b = _run("trace-replay", cycles=12, observe=False,
+                 drift_check_every=4)
+        assert a.scorecard.event_sha == b.scorecard.event_sha
+        assert a.scorecard.decisions_sha == b.scorecard.decisions_sha
+        assert a.scorecard.to_dict() == b.scorecard.to_dict()
+        assert a.events == b.events
+        # the CPU-oracle spot-checks pass AND cover real placements
+        assert a.ok and a.drift
+        assert sum(d.placed for d in a.drift) > 0
+        assert a.scorecard.complete()
+        other = _run("trace-replay", cycles=12, observe=False,
+                     drift_check_every=4, seed=99)
+        assert other.scorecard.event_sha != a.scorecard.event_sha
+
+    @pytest.mark.slow
+    def test_observe_on_off_sha_identity(self):
+        on = _run("trace-replay", cycles=10, observe=True,
+                  drift_check_every=0)
+        off = _run("trace-replay", cycles=10, observe=False,
+                   drift_check_every=0)
+        assert on.scorecard.decisions_sha == off.scorecard.decisions_sha
+        assert on.scorecard.event_sha == off.scorecard.event_sha
+        # only the observed run published to the results registry
+        assert [c["scenario"] for c in quality.results()] == ["trace-replay"]
+
+    @pytest.mark.slow
+    def test_reclaim_pressure_fires_compiled_actions(self):
+        r = _run("reclaim-pressure", cycles=8, observe=False,
+                 drift_check_every=0)
+        eff = r.scorecard.action_effects
+        assert eff.get("reclaim_evictions", 0) > 0
+        assert eff.get("elect_count", 0) > 0
+        assert eff.get("reserve_count", 0) > 0
+        assert r.scorecard.preemption_churn_total > 0
+
+    def test_catalog(self):
+        from volcano_tpu.scenarios import get_scenario, list_scenarios
+        names = [s.name for s in list_scenarios()]
+        assert {"trace-replay", "diurnal-churn", "hetero-pools",
+                "failure-storm", "reclaim-pressure"} <= set(names)
+        with pytest.raises(KeyError):
+            get_scenario("no-such-scenario")
+
+
+# ----------------------------------------------------------------- surfaces
+def _card(**kw):
+    base = dict(scenario="t", seed=1, cycles=4, jobs_completed=2,
+                makespan_cycles=3, drf_share_error=0.1,
+                node_utilization=0.5, preemption_churn_total=6,
+                wait_cycles={"p50": 0.0, "p95": 1.0, "p99": 1.0},
+                drift_checks=2, drift_failures=0, event_sha="abc123")
+    base.update(kw)
+    return Scorecard(**base)
+
+
+class TestSurfaces:
+    def test_quality_gauges(self):
+        from volcano_tpu.metrics import Metrics
+        reg = Metrics()
+        quality.publish_quality_gauges(_card(), registry=reg)
+        text = reg.exposition()
+        assert 'quality_drf_share_error{scenario="t"} 0.1' in text
+        assert 'quality_makespan_cycles{scenario="t"} 3' in text
+        assert 'quality_queue_wait_cycles{quantile="p95",scenario="t"} 1' \
+            in text or 'quantile="p95"' in text
+        assert "quality_drift_failures" in text
+
+    def test_dashboard_table_and_api(self):
+        quality.record_result(_card())
+
+        class _Api:
+            def list(self, kind):
+                return []
+
+        class _Sys:
+            api = _Api()
+
+        from volcano_tpu.runtime.dashboard import Dashboard, build_page
+        page = build_page(_Sys())
+        tbl = page.tables["scenarios"]
+        assert tbl["headers"][0] == "Scenario"
+        assert all(len(r) == len(tbl["headers"]) for r in tbl["rows"])
+        row = tbl["rows"][0]
+        assert row[0] == "t" and row[-1] == "abc123"
+        assert row[tbl["headers"].index("Drift ok")] == "2/2"
+        dash = Dashboard(_Sys())
+        port = dash.serve(port=0)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/scenarios").read())
+            assert body["scorecards"][-1]["scenario"] == "t"
+            assert body["scorecards"][-1]["wait_cycles"]["p95"] == 1.0
+        finally:
+            dash.shutdown()
+
+    def test_cli_list(self, capsys):
+        from volcano_tpu.scenarios.__main__ import main
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        assert "trace-replay" in out and "reclaim-pressure" in out

@@ -25,3 +25,7 @@ class ElectAction(Action):
                 state.reset()
         if state.target_job_uid is None:
             state.target_job_uid = plugin.elect_target(ssn)
+        # per-cycle effect attribution: the elected target (held or fresh)
+        # for the flight ring / scenario scorecards
+        ssn.last_telemetry.setdefault("actions", {})["elect"] = {
+            "elected_job": state.target_job_uid}

@@ -14,5 +14,15 @@ class ReclaimAction(Action):
 
     def execute(self, ssn) -> None:
         result = ssn.run_preempt(mode="reclaim")
-        ssn.stats["reclaim_evictions"] = int(
-            np.asarray(result.evicted).sum()) if result is not None else 0
+        evicted = int(np.asarray(result.evicted).sum()) \
+            if result is not None else 0
+        ssn.stats["reclaim_evictions"] = evicted
+        # per-cycle effect attribution for the flight ring / scenario
+        # scorecards: WHICH tasks this action evicted, not just how many
+        victims = []
+        if result is not None and evicted:
+            uids = ssn.maps.task_uids
+            for ti in np.nonzero(np.asarray(result.evicted))[0]:
+                victims.append(uids[int(ti)])
+        ssn.last_telemetry.setdefault("actions", {})["reclaim"] = {
+            "evictions": evicted, "victims": sorted(victims)}

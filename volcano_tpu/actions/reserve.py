@@ -25,3 +25,8 @@ class ReserveAction(Action):
         node = plugin.reserve_node(ssn)
         if node is not None:
             plugin.state.locked_nodes.add(node)
+        # per-cycle effect attribution: the node locked THIS cycle and the
+        # running lock total, for the flight ring / scenario scorecards
+        ssn.last_telemetry.setdefault("actions", {})["reserve"] = {
+            "locked_node": node,
+            "locked_total": len(plugin.state.locked_nodes)}

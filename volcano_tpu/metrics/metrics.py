@@ -81,6 +81,28 @@ _HELP = {
     "pipeline_bubble_ms":
         "In-flight device window time the host spent idle or blocked "
         "(the pipeline bubble the deep-async item must shrink)",
+    # scheduling-quality scorecards (volcano_tpu/scenarios): one gauge set
+    # per scenario run, the same numbers /api/scenarios and the bench
+    # `scenarios` block carry
+    "quality_makespan_cycles":
+        "Scenario makespan in virtual cycles (first arrival to last "
+        "job completion)",
+    "quality_drf_share_error":
+        "Mean per-cycle DRF share error: |allocated - deserved| summed "
+        "over queues, normalized by cluster capacity (0 = fair)",
+    "quality_node_utilization":
+        "Mean per-cycle allocated/capacity cpu fraction over the "
+        "scenario run",
+    "quality_preemption_churn_total":
+        "Evictions the scenario run produced (preempt + reclaim churn)",
+    "quality_queue_wait_cycles":
+        "Queue-wait quantiles in virtual cycles (arrival to first bind), "
+        "nearest-rank p50/p95/p99",
+    "quality_jobs_completed":
+        "Jobs that ran to completion inside the scenario horizon",
+    "quality_drift_failures":
+        "Soak-mode CPU-oracle drift spot-checks where compiled decisions "
+        "diverged from the oracle (must stay 0)",
 }
 
 

@@ -8,9 +8,12 @@ page is built straight from the in-memory API server, cached with a TTL
 JSON API (``/api/page``), a Prometheus exposition passthrough
 (``/metrics``), the scheduler's flight-recorder ring as JSON
 (``/api/telemetry`` — per-cycle snapshots; /metrics stays cumulative),
-``/healthz``, and the span tracer's Chrome trace-event export
+``/healthz``, the span tracer's Chrome trace-event export
 (``/api/trace`` — load it in Perfetto; the ``latency``/``pipeline``
-tables below render the same rings server-side).
+tables below render the same rings server-side), and the scenario
+quality registry (``/api/scenarios`` — one scorecard per scenario run,
+mirrored by the ``scenarios`` table and the ``volcano_quality_*``
+gauges).
 """
 
 from __future__ import annotations
@@ -127,6 +130,33 @@ def build_page(system, now: Optional[float] = None) -> Page:
                         "Degr"],
             "rows": rows}
 
+    # ---- scheduling-quality scorecards (volcano_tpu/scenarios) ----------
+    cards = _scenario_results()
+    if cards:
+        rows = []
+        for c in reversed(cards[-16:]):
+            waits = c.get("wait_cycles") or {}
+            rows.append([
+                c.get("scenario", "-"), c.get("seed", "-"),
+                c.get("cycles", "-"),
+                c.get("jobs_completed", "-"),
+                c.get("makespan_cycles", "-"),
+                c.get("drf_share_error", "-"),
+                c.get("node_utilization", "-"),
+                c.get("preemption_churn_total", "-"),
+                waits.get("p50", "-"), waits.get("p95", "-"),
+                waits.get("p99", "-"),
+                f"{c.get('drift_checks', 0) - c.get('drift_failures', 0)}"
+                f"/{c.get('drift_checks', 0)}",
+                c.get("event_sha", "-"),
+            ])
+        page.tables["scenarios"] = {
+            "headers": ["Scenario", "Seed", "Cycles", "Completed",
+                        "Makespan", "DRF err", "Util", "Churn",
+                        "Wait p50", "Wait p95", "Wait p99", "Drift ok",
+                        "Event sha"],
+            "rows": rows}
+
     # ---- latency breakdown (span rings) + pipeline occupancy -------------
     stats = _spans.phase_stats()
     if stats:
@@ -150,6 +180,17 @@ def build_page(system, now: Optional[float] = None) -> Page:
                             "Bubble ms", "Overlap fraction"],
                 "rows": occ_rows}
     return page
+
+
+def _scenario_results():
+    """The scenario quality registry (bounded), empty when the scenarios
+    package never ran. Function-local import: the dashboard must not pull
+    the scenario engine (and its scheduler import) at module load."""
+    try:
+        from ..scenarios import quality as _quality
+        return _quality.results()
+    except Exception:  # noqa: BLE001 — observability must not 500 the page
+        return []
 
 
 def _flight_of(system):
@@ -237,6 +278,13 @@ class Dashboard:
                                              "recorded_total": 0,
                                              "cycles": []}))
                     self._send(body, "application/json")
+                elif self.path == "/api/scenarios":
+                    # the scenario quality registry, always live: one
+                    # scorecard per run, same numbers as the
+                    # volcano_quality_* gauges on /metrics
+                    self._send(json.dumps(
+                        {"scorecards": _scenario_results()}),
+                        "application/json")
                 elif self.path == "/api/trace":
                     # the span tracer's Chrome trace-event export, always
                     # live — save it and load in Perfetto/chrome://tracing

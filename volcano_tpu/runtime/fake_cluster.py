@@ -207,6 +207,36 @@ class FakeCluster:
                 self.dirty_jobs.add(uid)
 
     # --------------------------------------------------- lifecycle helpers
+    def add_node(self, node) -> None:
+        """Autoscaler-style node arrival: register + structural mark."""
+        self.ci.add_node(node)
+        self.mark_dirty(node_name=node.name, structural=True)
+
+    def remove_node(self, name: str) -> bool:
+        """Autoscaler-style node departure. Refuses a node still carrying
+        tasks (a real autoscaler drains first); returns whether removed."""
+        node = self.ci.nodes.get(name)
+        if node is None or node.tasks:
+            return False
+        del self.ci.nodes[name]
+        self.mark_dirty(structural=True)
+        return True
+
+    def remove_job(self, job_uid: str) -> bool:
+        """Retire a job: free its tasks' node accounting, drop the job,
+        raise the structural mark. Returns whether the job existed."""
+        job = self.ci.jobs.get(job_uid)
+        if job is None:
+            return False
+        for task in job.tasks.values():
+            node = self.ci.nodes.get(task.node_name)
+            if node is not None and task.uid in node.tasks:
+                node.remove_task(task)
+                self.mark_dirty(node_name=node.name)
+        del self.ci.jobs[job_uid]
+        self.mark_dirty(job_uid=job_uid, structural=True)
+        return True
+
     def run_task(self, task_uid: str) -> None:
         """Kubelet-style transition Bound -> Running."""
         for job in self.ci.jobs.values():

@@ -1,0 +1,24 @@
+"""Scheduling-quality observability: scenario engine, quality scorecards,
+and the soak-mode CPU-oracle drift watch (ISSUE 9).
+
+CLI: ``python -m volcano_tpu.scenarios --list`` / ``--run NAME [--soak]``.
+"""
+
+from __future__ import annotations
+
+from .catalog import SCENARIOS, get_scenario, list_scenarios
+from .engine import (DriftCheck, ScenarioResult, oracle_drift_check,
+                     run_scenario)
+from .quality import (QualityCollector, Scorecard, nearest_rank,
+                      publish_quality_gauges, record_result, reset_results,
+                      results, share_error, weighted_water_fill)
+from .workload import QueueSpec, WorkloadSpec
+
+__all__ = [
+    "SCENARIOS", "get_scenario", "list_scenarios",
+    "DriftCheck", "ScenarioResult", "oracle_drift_check", "run_scenario",
+    "QualityCollector", "Scorecard", "nearest_rank",
+    "publish_quality_gauges", "record_result", "reset_results", "results",
+    "share_error", "weighted_water_fill",
+    "QueueSpec", "WorkloadSpec",
+]

@@ -1,0 +1,91 @@
+"""``python -m volcano_tpu.scenarios`` — run quality scenarios.
+
+``--list`` prints the catalog; ``--run NAME`` runs one scenario and prints
+its scorecard as JSON (bit-reproducible from ``--seed``); ``--soak``
+stretches the horizon to >= 500 cycles with continuous CPU-oracle drift
+spot-checks. ``--smoke`` is the tier-1 gate: a short trace-replay run must
+produce a COMPLETE scorecard (non-null headline metrics) and pass its
+oracle drift spot-check.
+
+Exit 0 on success, 1 on a failed claim (drift mismatch / incomplete smoke
+scorecard), 2 on harness error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="scheduling-quality scenarios: trace replay, "
+                    "scorecards, soak-mode drift watch")
+    parser.add_argument("--list", action="store_true",
+                        help="list the scenario catalog")
+    parser.add_argument("--run", metavar="NAME",
+                        help="run one named scenario")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="override the scenario's seed")
+    parser.add_argument("--cycles", type=int, default=None,
+                        help="override the scenario's horizon")
+    parser.add_argument("--soak", action="store_true",
+                        help="long-horizon soak (>= 500 cycles) with "
+                             "continuous oracle drift spot-checks")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1 smoke: short trace-replay run, "
+                             "complete scorecard + drift check required")
+    parser.add_argument("--events", action="store_true",
+                        help="include the full event stream in the JSON")
+    args = parser.parse_args(argv)
+
+    from . import get_scenario, list_scenarios, run_scenario
+    if args.list:
+        for spec in list_scenarios():
+            print(f"{spec.name:18s} {spec.description}")
+        return 0
+    if args.smoke:
+        # every=4 lands checks both while the cluster is filling and once
+        # it is saturated, so at least one check scores real placements
+        name, cycles, every = "trace-replay", args.cycles or 16, 4
+    elif args.run:
+        name, cycles, every = args.run, args.cycles, None
+    else:
+        parser.print_usage()
+        return 2
+    try:
+        spec = get_scenario(name)
+        result = run_scenario(spec, seed=args.seed, cycles=cycles,
+                              soak=args.soak, drift_check_every=every)
+    except KeyError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    except Exception as e:  # harness failure, not a quality verdict
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    out = {"scenario": spec.name, "scorecard": result.scorecard.to_dict(),
+           "drift": [{"cycle": d.cycle, "ok": d.ok, "placed": d.placed,
+                      "compiled_sha": d.compiled_sha,
+                      "oracle_sha": d.oracle_sha} for d in result.drift]}
+    if args.events:
+        out["events"] = result.events
+    print(json.dumps(out, indent=2, default=str))
+    ok = result.ok
+    if args.smoke or args.soak:
+        ok = ok and result.drift and result.scorecard.complete()
+        if args.smoke and not result.scorecard.complete():
+            print("scenario smoke FAILED: incomplete scorecard "
+                  "(a headline metric is null)", file=sys.stderr)
+        if args.smoke and not any(d.placed for d in result.drift):
+            ok = False
+            print("scenario smoke FAILED: every drift check was vacuous "
+                  "(no placements compared)", file=sys.stderr)
+    if not result.ok:
+        print("scenario FAILED: CPU-oracle drift detected "
+              "(compiled decisions diverged)", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,161 @@
+"""The named scenario catalog.
+
+Each entry is a :class:`~.workload.WorkloadSpec`; ``run_scenario`` turns a
+name into a scored run. Conf strings keep ``allocate`` as the cycle's last
+action (the pipeline-compatible shape every preset pins) and drive the
+compiled path — the scenario layer itself never touches ops/.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .workload import QueueSpec, WorkloadSpec
+
+_BASE_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+#: gpu-sharing + TDM revocable zones together (the hetero pool); the
+#: window spans the whole virtual day so placement stays deterministic
+_HETERO_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: binpack
+  - name: tdm
+    arguments:
+      tdm.revocable-zone.z1: "00:00-23:59"
+"""
+
+#: reclaim + reserve + elect all through the compiled path: reclaim runs
+#: the compiled preempt cycle (mode="reclaim"); elect/reserve feed the
+#: compiled allocate via AllocateExtras.target_job / node_locked
+_RECLAIM_CONF = """
+actions: "enqueue, elect, reserve, reclaim, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: proportion
+  - name: predicates
+  - name: nodeorder
+  - name: reservation
+"""
+
+SCENARIOS: Dict[str, WorkloadSpec] = {}
+
+
+def _register(spec: WorkloadSpec) -> WorkloadSpec:
+    SCENARIOS[spec.name] = spec
+    return spec
+
+
+_register(WorkloadSpec(
+    name="trace-replay",
+    description="Trace-shaped open workload: Poisson arrivals, "
+                "heavy-tailed durations, two weighted queues — the "
+                "baseline quality scorecard (and the tier-1 smoke).",
+    conf=_BASE_CONF,
+    cycles=48,
+    n_nodes=6,
+    queues=(QueueSpec("batch", 1), QueueSpec("svc", 2)),
+    arrival_rate=0.8,
+    drift_check_every=16,
+))
+
+_register(WorkloadSpec(
+    name="diurnal-churn",
+    description="Diurnal load curve with autoscaler node add/remove "
+                "churn tracking it (structural epochs every swing).",
+    conf=_BASE_CONF,
+    cycles=96,
+    n_nodes=6,
+    queues=(QueueSpec("batch", 1), QueueSpec("svc", 2)),
+    arrival_rate=0.7,
+    diurnal_amplitude=0.8,
+    diurnal_period=32,
+    autoscale=True,
+    min_nodes=4,
+    max_nodes=9,
+    drift_check_every=24,
+))
+
+_register(WorkloadSpec(
+    name="hetero-pools",
+    description="Heterogeneous pool: shared-GPU nodes carrying TDM "
+                "revocable-zone windows next to general nodes, one "
+                "cluster, both plugin families live.",
+    conf=_HETERO_CONF,
+    cycles=48,
+    n_nodes=6,
+    hetero=True,
+    queues=(QueueSpec("batch", 1), QueueSpec("svc", 2)),
+    arrival_rate=0.7,
+    drift_check_every=16,
+))
+
+_register(WorkloadSpec(
+    name="failure-storm",
+    description="Trace-shaped load under a seeded chaos FaultPlan storm "
+                "of every recoverable kind — quality under recovery, "
+                "decisions still oracle-clean.",
+    conf=_BASE_CONF,
+    cycles=48,
+    n_nodes=6,
+    queues=(QueueSpec("batch", 1), QueueSpec("svc", 2)),
+    arrival_rate=0.6,
+    fault_kinds=("backend_loss", "resident_corrupt", "mirror_drift",
+                 "bind_fail", "evict_fail"),
+    faults_per_kind=1,
+    drift_check_every=16,
+))
+
+_register(WorkloadSpec(
+    name="reclaim-pressure",
+    description="Over-served greedy queue vs starving weighted queue "
+                "plus a wide high-priority target: reclaim, reserve, "
+                "and elect all fire through the compiled path with "
+                "effects in the scorecard.",
+    conf=_RECLAIM_CONF,
+    cycles=32,
+    n_nodes=4,
+    node_cpu="8",
+    queues=(QueueSpec("greedy", 1, reclaimable=True),
+            QueueSpec("starved", 4)),
+    arrival_rate=0.0,
+    initial="reclaim_pressure",
+    duration_min=6,
+    duration_max=64,
+    drift_check_every=8,
+))
+
+
+def list_scenarios() -> List[WorkloadSpec]:
+    return [SCENARIOS[k] for k in sorted(SCENARIOS)]
+
+
+def get_scenario(name: str) -> WorkloadSpec:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        known = ", ".join(sorted(SCENARIOS))
+        raise KeyError(f"unknown scenario {name!r} (known: {known})") \
+            from None

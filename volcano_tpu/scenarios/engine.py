@@ -1,0 +1,410 @@
+"""Scenario engine: drive a Scheduler over a FakeCluster with a virtual
+clock and score what it decides.
+
+The loop shape follows ``chaos/probe.py`` (deterministic virtual
+timestamps through ``run_once(now=...)``, per-cycle decision digests,
+sha256 fingerprints) but the churn is a trace-shaped workload instead of
+a fault storm: seeded arrivals/durations (``workload.py``), diurnal
+autoscaler node add/remove, heterogeneous pools, and optional failure
+storms reusing the chaos ``FaultPlan``/``FaultInjector``. Observation is
+host-only BY CONSTRUCTION — no ops/ changes, no in-graph code — so
+decision sha256s are bit-identical with the scenario layer on or off
+(``observe=False`` skips every publication and nothing else; pinned by
+tests/test_scenarios.py).
+
+Soak mode stretches the horizon and runs continuous CPU-oracle drift
+spot-checks: every K cycles two fresh Sessions are built over deep-copy
+snapshots of the live cluster and the compiled allocate's decisions must
+sha-match ``runtime/cpu_reference.allocate_cpu`` exactly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import random
+from typing import Dict, List, Optional
+
+from .quality import CycleSample, QualityCollector, Scorecard
+from .workload import (VT_BASE, WorkloadSpec, arrival_rate_at, build_cluster,
+                       build_node, draw_job, node_target_at, poisson)
+
+
+@dataclasses.dataclass
+class DriftCheck:
+    """One CPU-oracle spot-check: compiled vs pure-host decisions over the
+    same snapshot. ``placed`` is the compiled pass's placement count —
+    all-zero decision arrays would compare equal vacuously, so the engine
+    runs the check ahead of the cycle (pending arrivals still unplaced)
+    and records how much work the comparison actually covered."""
+
+    cycle: int
+    compiled_sha: str
+    oracle_sha: str
+    placed: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.compiled_sha == self.oracle_sha
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    spec: WorkloadSpec
+    scorecard: Scorecard
+    events: List[dict]
+    drift: List[DriftCheck]
+
+    @property
+    def ok(self) -> bool:
+        return all(d.ok for d in self.drift)
+
+
+# ----------------------------------------------------------- fingerprints
+def _cycle_digest(rec) -> tuple:
+    """Decision digest of one cycle (the chaos probe's shape)."""
+    return (sorted((b.task_uid, b.node_name, b.gpu_index)
+                   for b in rec.binds),
+            sorted(e.task_uid for e in rec.evictions),
+            sorted(rec.pipelined.items()),
+            sorted((u, str(p)) for u, p in rec.phase_updates.items()))
+
+
+def _sha(payload) -> str:
+    return hashlib.sha256(repr(payload).encode()).hexdigest()[:16]
+
+
+def _decisions_sha(result) -> str:
+    import numpy as np
+    return hashlib.sha256(
+        np.asarray(result.task_node).tobytes()
+        + np.asarray(result.task_mode).tobytes()).hexdigest()[:16]
+
+
+def oracle_drift_check(cluster, conf, now: float, cycle: int) -> DriftCheck:
+    """Build two fresh Sessions over deep-copy snapshots of the live
+    cluster and compare the compiled allocate's decisions against the
+    pure-host CPU oracle, bit for bit. Non-perturbing: both sessions work
+    on clones; the live run never sees them. Both sessions run the same
+    enqueue pass first so freshly-arrived PodGroups are in allocate scope
+    (otherwise the comparison can only cover an empty decision vector)."""
+    import numpy as np
+
+    from ..actions import get_action
+    from ..framework.session import Session
+    compiled = Session(cluster.snapshot(), conf, now=now)
+    oracle = Session(cluster.snapshot(), conf, now=now)
+    if "enqueue" in conf.actions:
+        get_action("enqueue").execute(compiled)
+        get_action("enqueue").execute(oracle)
+    result = compiled.run_allocate()
+    return DriftCheck(cycle=cycle,
+                      compiled_sha=_decisions_sha(result),
+                      oracle_sha=_decisions_sha(
+                          oracle.run_allocate_oracle()),
+                      placed=int(np.asarray(result.task_mode > 0).sum()))
+
+
+# -------------------------------------------------------- initial layouts
+def _initial_reclaim_pressure(ci, spec: WorkloadSpec,
+                              rng: random.Random) -> Dict[str, int]:
+    """Pre-placed pressure so reclaim, reserve, and elect all fire through
+    the compiled path from cycle 0:
+
+    - the ``greedy`` queue runs 1-cpu tasks on every node, far over its
+      deserved share (the reclaim donor — tests/test_session_e2e.py's
+      underserved-queue shape, scaled up);
+    - ``starved`` carries pending gangs whose deserved share the donor
+      holds (the reclaimers);
+    - one high-priority wide job is the elect target; reserve locks nodes
+      for it while it stays unready.
+
+    Returns {job uid -> duration} for the engine's completion clock."""
+    from ..api import (JobInfo, PodGroupPhase, Resource, TaskInfo,
+                       TaskStatus)
+    durations: Dict[str, int] = {}
+    greedy = JobInfo(uid="default/greedy", name="greedy",
+                     namespace="default", queue="greedy", min_available=1,
+                     priority=0, creation_timestamp=VT_BASE,
+                     pod_group_phase=PodGroupPhase.RUNNING)
+    i = 0
+    for node in ci.nodes.values():
+        per_node = int(node.allocatable.milli_cpu // 1000)
+        for _ in range(per_node):
+            t = TaskInfo(uid=f"default/greedy-t{i}", name=f"greedy-t{i}",
+                         namespace="default",
+                         resreq=Resource.from_resource_list({"cpu": "1"}),
+                         status=TaskStatus.RUNNING)
+            greedy.add_task(t)
+            node.add_task(t)
+            i += 1
+    ci.add_job(greedy)
+    durations[greedy.uid] = spec.duration_max
+    for j in range(3):
+        starv = JobInfo(uid=f"default/starv{j}", name=f"starv{j}",
+                        namespace="default", queue="starved",
+                        min_available=1, priority=1,
+                        creation_timestamp=VT_BASE + j,
+                        pod_group_phase=PodGroupPhase.PENDING)
+        for t in range(2):
+            starv.add_task(TaskInfo(
+                uid=f"default/starv{j}-t{t}", name=f"starv{j}-t{t}",
+                namespace="default",
+                resreq=Resource.from_resource_list({"cpu": "1"})))
+        ci.add_job(starv)
+        durations[starv.uid] = spec.duration_min + j
+    target = JobInfo(uid="default/target", name="target",
+                     namespace="default", queue="starved", min_available=1,
+                     priority=10, creation_timestamp=VT_BASE,
+                     pod_group_phase=PodGroupPhase.PENDING)
+    target.add_task(TaskInfo(
+        uid="default/target-t0", name="target-t0", namespace="default",
+        resreq=Resource.from_resource_list(
+            {"cpu": spec.node_cpu})))
+    ci.add_job(target)
+    durations[target.uid] = spec.duration_min
+    return durations
+
+
+_INITIAL_BUILDERS = {
+    "reclaim_pressure": _initial_reclaim_pressure,
+}
+
+
+# --------------------------------------------------------------- the run
+class _Run:
+    """Mutable state of one scenario run."""
+
+    def __init__(self, spec: WorkloadSpec, seed: int):
+        self.spec = spec
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.events: List[dict] = []
+        self.collector = QualityCollector(spec.name, seed)
+        self.arrival_cycle: Dict[str, int] = {}   # job uid -> cycle
+        self.durations: Dict[str, int] = {}       # job uid -> cycles to run
+        self.running_since: Dict[str, int] = {}   # job uid -> cycle
+        self.uid_seq = 0
+        self.node_seq = 0
+        self.digests: List[tuple] = []
+
+    def event(self, cycle: int, kind: str, **fields) -> dict:
+        e = dict(sorted(fields.items()))
+        e["cycle"] = cycle
+        e["kind"] = kind
+        self.events.append(e)
+        return e
+
+
+def _complete_jobs(run: _Run, cluster, cycle: int) -> None:
+    """Retire jobs whose duration elapsed since they went fully running:
+    free their node accounting and remove the job (structural — the
+    autoscaler-era cluster genuinely shrinks)."""
+    from ..api import TaskStatus
+    ci = cluster.ci
+    done = []
+    for uid in sorted(run.running_since):
+        job = ci.jobs.get(uid)
+        if job is None:
+            run.running_since.pop(uid, None)
+            continue
+        tasks = list(job.tasks.values())
+        if not all(t.status == TaskStatus.RUNNING for t in tasks):
+            # evicted back to pending mid-run (reclaim/faults): the run
+            # restarts the clock when it becomes fully running again
+            run.running_since.pop(uid, None)
+            continue
+        if cycle - run.running_since[uid] >= run.durations.get(uid, 8):
+            done.append(uid)
+    for uid in done:
+        cluster.remove_job(uid)
+        run.running_since.pop(uid, None)
+        run.collector.note_completion(cycle)
+        run.event(cycle, "complete", job=uid,
+                  wait=cycle - run.arrival_cycle.get(uid, 0))
+
+
+def _inject_arrivals(run: _Run, cluster, cycle: int) -> None:
+    n = poisson(run.rng, arrival_rate_at(run.spec, cycle))
+    for _ in range(n):
+        job, duration = draw_job(run.spec, run.rng, run.uid_seq, cycle)
+        run.uid_seq += 1
+        cluster.ci.add_job(job)
+        cluster.mark_dirty(job_uid=job.uid, structural=True)
+        run.arrival_cycle[job.uid] = cycle
+        run.durations[job.uid] = duration
+        run.collector.note_arrival(cycle)
+        run.event(cycle, "arrival", job=job.uid, queue=job.queue,
+                  tasks=len(job.tasks), duration=duration)
+
+
+def _autoscale(run: _Run, cluster, cycle: int) -> None:
+    """Track the diurnal node target: add fresh nodes, remove empty ones
+    (a real autoscaler drains first; here only task-free nodes leave)."""
+    spec = run.spec
+    if not spec.autoscale:
+        return
+    ci = cluster.ci
+    target = node_target_at(spec, cycle)
+    while len(ci.nodes) < target:
+        idx = max(run.node_seq, len(ci.nodes))
+        run.node_seq = idx + 1
+        node = build_node(spec, idx)
+        cluster.add_node(node)
+        run.event(cycle, "node_add", node=node.name)
+    if len(ci.nodes) > target:
+        for name in sorted(ci.nodes, reverse=True):
+            if len(ci.nodes) <= target:
+                break
+            if cluster.remove_node(name):
+                run.event(cycle, "node_remove", node=name)
+
+
+def _advance_bound_tasks(run: _Run, cluster, cycle: int) -> None:
+    """Kubelet analog between cycles: Bound -> Running; record when a job
+    first becomes fully running (its duration clock starts)."""
+    from ..api import TaskStatus
+    ci = cluster.ci
+    for uid in sorted(t.uid for job in ci.jobs.values()
+                      for t in job.tasks.values()
+                      if t.status == TaskStatus.BOUND):
+        cluster.run_task(uid)
+    for uid in sorted(ci.jobs):
+        job = ci.jobs[uid]
+        tasks = list(job.tasks.values())
+        if tasks and uid not in run.running_since \
+                and all(t.status == TaskStatus.RUNNING for t in tasks):
+            run.running_since[uid] = cycle
+
+
+def _quality_sample(run: _Run, cluster, cycle: int, binds: int,
+                    evictions: int, ssn) -> None:
+    from ..api.types import ALLOCATED_STATUSES
+    ci = cluster.ci
+    capacity = sum(n.allocatable.milli_cpu for n in ci.nodes.values())
+    allocated: Dict[str, float] = {}
+    demand: Dict[str, float] = {}
+    for job in ci.jobs.values():
+        for t in job.tasks.values():
+            m = t.resreq.milli_cpu
+            demand[job.queue] = demand.get(job.queue, 0.0) + m
+            if t.status in ALLOCATED_STATUSES:
+                allocated[job.queue] = allocated.get(job.queue, 0.0) + m
+    weights = {q.name: float(q.weight) for q in ci.queues.values()}
+    effects: Dict[str, float] = {}
+    actions_tel = (ssn.last_telemetry or {}).get("actions") or {}
+    for name, block in actions_tel.items():
+        for k, v in block.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                effects[f"{name}_{k}"] = float(v)
+            elif v:
+                effects.setdefault(f"{name}_count", 0.0)
+                effects[f"{name}_count"] += 1.0
+    run.collector.add(CycleSample(
+        cycle=cycle, capacity_milli_cpu=capacity,
+        allocated_milli_cpu=allocated, demand_milli_cpu=demand,
+        queue_weights=weights, evictions=evictions, binds=binds,
+        action_effects=effects))
+
+
+def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
+                 cycles: Optional[int] = None, soak: bool = False,
+                 observe: bool = True,
+                 drift_check_every: Optional[int] = None) -> ScenarioResult:
+    """Run one named scenario end to end and score it.
+
+    ``soak`` stretches the horizon to >= 500 cycles and tightens the
+    CPU-oracle drift spot-check interval. ``observe=False`` skips every
+    publication (METRICS gauges, the dashboard registry, the JSONL event
+    log) and NOTHING else — the on/off decision-sha identity is the
+    scenario layer's purity contract."""
+    from ..chaos.inject import FaultInjector, chaos
+    from ..chaos.plan import FaultPlan
+    from ..framework.conf import parse_conf
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+    from ..telemetry import spans
+
+    seed = spec.seed if seed is None else seed
+    cycles = spec.cycles if cycles is None else cycles
+    if soak:
+        cycles = max(cycles, 500)
+    every = drift_check_every if drift_check_every is not None \
+        else (min(spec.drift_check_every, 50) if soak
+              else spec.drift_check_every)
+
+    run = _Run(spec, seed)
+    ci = build_cluster(spec)
+    run.node_seq = spec.n_nodes
+    if spec.initial:
+        durations = _INITIAL_BUILDERS[spec.initial](ci, spec, run.rng)
+        run.durations.update(durations)
+        for uid in durations:
+            run.arrival_cycle[uid] = 0
+            run.collector.note_arrival(0)
+    cluster = FakeCluster(ci)
+    conf = parse_conf(spec.conf)
+    sched = Scheduler(cluster, conf=conf, pipeline=False)
+
+    injector = None
+    if spec.fault_kinds:
+        plan = FaultPlan(seed=seed, cycles=cycles, kinds=spec.fault_kinds,
+                         per_kind=spec.faults_per_kind)
+        injector = FaultInjector(plan)
+    drift: List[DriftCheck] = []
+    ctx = chaos(injector) if injector is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        for c in range(cycles):
+            vt = VT_BASE + c
+            _complete_jobs(run, cluster, c)
+            _inject_arrivals(run, cluster, c)
+            _autoscale(run, cluster, c)
+            if every and c and c % every == 0:
+                # spot-check BEFORE the cycle: this cycle's arrivals are
+                # still pending, so the compared decision vector carries
+                # real placements, not the post-cycle empty remainder
+                check = oracle_drift_check(cluster, conf, vt, c)
+                drift.append(check)
+                run.event(c, "drift_check", ok=check.ok,
+                          placed=check.placed,
+                          compiled_sha=check.compiled_sha,
+                          oracle_sha=check.oracle_sha)
+            binds0 = len(cluster.binds)
+            evicts0 = len(cluster.evictions)
+            ssn = sched.run_once(now=vt)
+            run.digests.append(_cycle_digest(ssn))
+            new_binds = cluster.binds[binds0:]
+            for task_uid, _node in new_binds:
+                job_uid = task_uid.rsplit("-t", 1)[0]
+                if job_uid in run.arrival_cycle:
+                    run.collector.note_wait(c - run.arrival_cycle[job_uid])
+            evictions = len(cluster.evictions) - evicts0
+            _quality_sample(run, cluster, c, len(new_binds), evictions, ssn)
+            _advance_bound_tasks(run, cluster, c)
+            if observe:
+                spans.log_event("scenario_cycle", scenario=spec.name,
+                                seed=seed, cycle=c, binds=len(new_binds),
+                                evictions=evictions,
+                                jobs=len(cluster.ci.jobs),
+                                nodes=len(cluster.ci.nodes))
+
+    card = run.collector.scorecard(cycles)
+    card.event_sha = _sha(run.events)
+    card.decisions_sha = _sha(run.digests)
+    card.drift_checks = len(drift)
+    card.drift_failures = sum(1 for d in drift if not d.ok)
+    card.faults_fired = len(injector.fired) if injector is not None else 0
+    if observe:
+        from .quality import publish_quality_gauges, record_result
+        publish_quality_gauges(card)
+        record_result(card)
+        spans.log_event("scenario_done", scenario=spec.name, seed=seed,
+                        cycles=cycles, event_sha=card.event_sha,
+                        decisions_sha=card.decisions_sha,
+                        drift_failures=card.drift_failures,
+                        drf_share_error=card.drf_share_error,
+                        makespan_cycles=card.makespan_cycles)
+    return ScenarioResult(spec=spec, scorecard=card, events=run.events,
+                          drift=drift)

@@ -1,0 +1,260 @@
+"""Scheduling-quality scorecard math and the scenario results registry.
+
+Every function here is pure host arithmetic over plain Python values —
+deliberately hand-computable so tests can pin exact numbers (ISSUE 9
+satellite: exact makespan, exact DRF share error including the
+zero-deserved queue edge case, exact wait-time quantiles). The scenario
+engine feeds it per-cycle samples; the output is one :class:`Scorecard`
+per run, published three ways with the SAME numbers:
+
+- ``volcano_quality_*`` gauges on the process-global METRICS registry
+  (the /metrics exposition),
+- the bounded module-level results registry the dashboard serves as the
+  ``scenarios`` table / ``/api/scenarios``,
+- the bench ``scenarios`` block (bench.py, fail-soft).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+from collections import deque
+from typing import Dict, List, Mapping, Optional
+
+#: quantiles every wait-time surface reports, in order
+WAIT_QUANTILES = (50, 95, 99)
+
+
+# ------------------------------------------------------------- primitives
+def nearest_rank(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank quantile (the textbook definition: the smallest value
+    with at least ``q``% of the sample at or below it). Exact on tiny
+    fixtures — no interpolation, so hand computation matches to the bit."""
+    if not values:
+        return None
+    if not 0 < q <= 100:
+        raise ValueError(f"quantile out of range: {q}")
+    ordered = sorted(values)
+    rank = math.ceil(q / 100.0 * len(ordered))
+    return ordered[rank - 1]
+
+
+def weighted_water_fill(capacity: float, weights: Mapping[str, float],
+                        demands: Mapping[str, float]) -> Dict[str, float]:
+    """Weight-proportional deserved shares capped by demand — the host-side
+    mirror of the proportion plugin's water-filling (proportion.go:213-240,
+    ops/fairshare.proportion_deserved), reduced to the scorecard's single
+    dominant dimension. A queue with zero weight or zero demand deserves
+    exactly 0 (the zero-deserved edge case the DRF error must still score:
+    anything it holds is pure error)."""
+    deserved = {q: 0.0 for q in demands}
+    active = {q for q in demands
+              if demands[q] > 0 and weights.get(q, 0) > 0}
+    remaining = float(capacity)
+    while active and remaining > 1e-9:
+        total_w = sum(weights[q] for q in active)
+        share = {q: remaining * weights[q] / total_w for q in active}
+        saturated = {q for q in active
+                     if deserved[q] + share[q] >= demands[q] - 1e-9}
+        if not saturated:
+            for q in active:
+                deserved[q] += share[q]
+            break
+        for q in saturated:
+            remaining -= demands[q] - deserved[q]
+            deserved[q] = demands[q]
+        active -= saturated
+    return deserved
+
+
+def share_error(allocated: Mapping[str, float],
+                deserved: Mapping[str, float],
+                capacity: float) -> float:
+    """DRF share error for one cycle: total absolute deviation between the
+    allocation each queue holds and the share it deserves, normalized by
+    cluster capacity (so 0 = perfectly fair, and an entire cluster held by
+    a zero-deserved queue scores 1 on that queue alone)."""
+    if capacity <= 0:
+        return 0.0
+    keys = set(allocated) | set(deserved)
+    return sum(abs(allocated.get(q, 0.0) - deserved.get(q, 0.0))
+               for q in keys) / float(capacity)
+
+
+# ------------------------------------------------------------- collector
+@dataclasses.dataclass
+class CycleSample:
+    """What the engine observes after one scheduling cycle (virtual time)."""
+
+    cycle: int
+    capacity_milli_cpu: float
+    allocated_milli_cpu: Dict[str, float]    # per queue
+    demand_milli_cpu: Dict[str, float]       # per queue (unfinished work)
+    queue_weights: Dict[str, float]
+    evictions: int = 0
+    binds: int = 0
+    action_effects: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Scorecard:
+    """One scenario run's quality scorecard — plain JSON-safe values."""
+
+    scenario: str
+    seed: int
+    cycles: int
+    jobs_submitted: int = 0
+    jobs_completed: int = 0
+    tasks_bound: int = 0
+    #: virtual cycles from first arrival to last job completion (None
+    #: until at least one job completed)
+    makespan_cycles: Optional[int] = None
+    drf_share_error: Optional[float] = None       # mean over cycles
+    drf_share_error_max: Optional[float] = None
+    preemption_churn_total: int = 0
+    node_utilization: Optional[float] = None      # mean over cycles
+    wait_cycles: Dict[str, Optional[float]] = dataclasses.field(
+        default_factory=dict)                      # {"p50": ..., ...}
+    action_effects: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    event_sha: Optional[str] = None
+    decisions_sha: Optional[str] = None
+    drift_checks: int = 0
+    drift_failures: int = 0
+    faults_fired: int = 0
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def complete(self) -> bool:
+        """A full scorecard: every headline metric non-null (the tier-1
+        smoke's acceptance predicate)."""
+        return (self.drf_share_error is not None
+                and self.node_utilization is not None
+                and self.makespan_cycles is not None
+                and all(self.wait_cycles.get(f"p{q}") is not None
+                        for q in WAIT_QUANTILES))
+
+
+class QualityCollector:
+    """Accumulates per-cycle samples + lifecycle marks into a Scorecard."""
+
+    def __init__(self, scenario: str, seed: int):
+        self.scenario = scenario
+        self.seed = seed
+        self.samples: List[CycleSample] = []
+        self._first_arrival: Optional[int] = None
+        self._last_completion: Optional[int] = None
+        self.jobs_submitted = 0
+        self.jobs_completed = 0
+        self.tasks_bound = 0
+        self.wait_samples: List[float] = []
+        self.action_effects: Dict[str, float] = {}
+
+    # lifecycle marks, all in virtual cycles -----------------------------
+    def note_arrival(self, cycle: int, jobs: int = 1) -> None:
+        self.jobs_submitted += jobs
+        if self._first_arrival is None:
+            self._first_arrival = cycle
+
+    def note_completion(self, cycle: int, jobs: int = 1) -> None:
+        self.jobs_completed += jobs
+        self._last_completion = cycle
+
+    def note_wait(self, wait_cycles: float) -> None:
+        self.wait_samples.append(float(wait_cycles))
+
+    def add(self, sample: CycleSample) -> None:
+        self.samples.append(sample)
+        self.tasks_bound += sample.binds
+        for k, v in sample.action_effects.items():
+            if k.endswith("_total"):
+                # running-total effects (e.g. reserve's locked_total):
+                # the peak is the meaningful scorecard number, not a sum
+                # of per-cycle totals
+                self.action_effects[k] = max(
+                    self.action_effects.get(k, 0.0), v)
+            else:
+                self.action_effects[k] = self.action_effects.get(k, 0.0) + v
+
+    # readout ------------------------------------------------------------
+    def scorecard(self, cycles: int) -> Scorecard:
+        card = Scorecard(scenario=self.scenario, seed=self.seed,
+                         cycles=cycles,
+                         jobs_submitted=self.jobs_submitted,
+                         jobs_completed=self.jobs_completed,
+                         tasks_bound=self.tasks_bound,
+                         preemption_churn_total=sum(
+                             s.evictions for s in self.samples),
+                         action_effects={k: round(v, 3) for k, v in
+                                         sorted(self.action_effects.items())})
+        if self._first_arrival is not None \
+                and self._last_completion is not None:
+            card.makespan_cycles = self._last_completion - self._first_arrival
+        if self.samples:
+            errors = []
+            utils = []
+            for s in self.samples:
+                deserved = weighted_water_fill(
+                    s.capacity_milli_cpu, s.queue_weights,
+                    s.demand_milli_cpu)
+                errors.append(share_error(s.allocated_milli_cpu, deserved,
+                                          s.capacity_milli_cpu))
+                if s.capacity_milli_cpu > 0:
+                    utils.append(sum(s.allocated_milli_cpu.values())
+                                 / s.capacity_milli_cpu)
+            card.drf_share_error = round(sum(errors) / len(errors), 6)
+            card.drf_share_error_max = round(max(errors), 6)
+            if utils:
+                card.node_utilization = round(sum(utils) / len(utils), 6)
+        card.wait_cycles = {
+            f"p{q}": nearest_rank(self.wait_samples, q)
+            for q in WAIT_QUANTILES}
+        return card
+
+
+# ---------------------------------------------------- results + /metrics
+_LOCK = threading.Lock()
+_RESULTS: deque = deque(maxlen=32)
+
+
+def record_result(card: Scorecard) -> None:
+    """Keep the run's scorecard in the bounded registry the dashboard's
+    ``scenarios`` table and ``/api/scenarios`` serve."""
+    with _LOCK:
+        _RESULTS.append(card.to_dict())
+
+
+def results() -> List[Dict[str, object]]:
+    with _LOCK:
+        return [dict(r) for r in _RESULTS]
+
+
+def reset_results() -> None:
+    with _LOCK:
+        _RESULTS.clear()
+
+
+def publish_quality_gauges(card: Scorecard, registry=None) -> None:
+    """Mirror the scorecard onto ``volcano_quality_*`` gauges — the same
+    numbers /api/scenarios serves, on the cumulative /metrics surface."""
+    if registry is None:
+        from ..metrics import METRICS as registry
+    labels = {"scenario": card.scenario}
+    g = registry.set_gauge
+    if card.makespan_cycles is not None:
+        g("quality_makespan_cycles", labels, card.makespan_cycles)
+    if card.drf_share_error is not None:
+        g("quality_drf_share_error", labels, card.drf_share_error)
+    if card.node_utilization is not None:
+        g("quality_node_utilization", labels, card.node_utilization)
+    g("quality_preemption_churn_total", labels,
+      card.preemption_churn_total)
+    g("quality_jobs_completed", labels, card.jobs_completed)
+    g("quality_drift_failures", labels, card.drift_failures)
+    for q in WAIT_QUANTILES:
+        v = card.wait_cycles.get(f"p{q}")
+        if v is not None:
+            g("quality_queue_wait_cycles",
+              {"scenario": card.scenario, "quantile": f"p{q}"}, v)

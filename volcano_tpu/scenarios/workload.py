@@ -1,0 +1,174 @@
+"""Seed-deterministic workload generation for quality scenarios.
+
+Shapes follow the published cluster traces the batch-scheduling literature
+benchmarks against (Google ClusterData 2019, Alibaba cluster-trace-v2018):
+bursty arrivals (Poisson base rate with diurnal modulation), heavy-tailed
+job durations (bounded Pareto), small gang sizes with a fat tail, and a
+mix of narrow/wide resource requests. Everything derives from ONE private
+``random.Random(seed)`` — the same discipline as chaos ``FaultPlan`` — so
+a scenario's event stream and scorecard are bit-reproducible from its
+seed (tests/test_scenarios.py pins this).
+
+No wall clock anywhere: time is the engine's virtual cycle counter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: virtual-time origin — nonzero so JobInfo's ``creation_timestamp or
+#: time.time()`` fallback can never smuggle wall time into the run
+VT_BASE = 1000.0
+
+#: gang sizes with a fat tail (trace-shaped: mostly small, few wide)
+_GANG_SIZES = (1, 1, 2, 2, 3, 4, 6, 8)
+
+#: per-task cpu requests in millicores (narrow-heavy mix)
+_TASK_CPU_M = (500, 1000, 1000, 2000, 2000, 4000)
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueSpec:
+    name: str
+    weight: int = 1
+    reclaimable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """Declarative scenario shape; ``catalog.py`` holds the named ones."""
+
+    name: str
+    description: str
+    conf: str                      # scheduler YAML conf for the run
+    cycles: int = 64               # default horizon (CLI/soak override)
+    seed: int = 0
+    n_nodes: int = 6
+    node_cpu: str = "8"
+    node_mem: str = "16Gi"
+    queues: Tuple[QueueSpec, ...] = (QueueSpec("default", 1),)
+    #: mean arrivals per cycle (Poisson base rate; 0 = closed workload)
+    arrival_rate: float = 0.6
+    #: diurnal modulation amplitude in [0, 1) over ``diurnal_period``
+    diurnal_amplitude: float = 0.0
+    diurnal_period: int = 48
+    #: autoscaler node churn: track the diurnal curve between bounds
+    autoscale: bool = False
+    min_nodes: int = 4
+    max_nodes: int = 10
+    #: heterogeneous pool: every third node carries shared-GPU cards and
+    #: a TDM revocable-zone window (gpu-sharing + tdm together)
+    hetero: bool = False
+    #: failure storm composed from the chaos FaultPlan (empty = no faults)
+    fault_kinds: Tuple[str, ...] = ()
+    faults_per_kind: int = 1
+    #: bounded-Pareto duration parameters, in cycles
+    duration_min: int = 4
+    duration_max: int = 40
+    duration_alpha: float = 1.5
+    #: name of a builder in engine._INITIAL_BUILDERS seeding the cluster
+    #: with pre-placed work (the reclaim-pressure setup)
+    initial: Optional[str] = None
+    #: CPU-oracle drift spot-check interval (cycles); soak may tighten
+    drift_check_every: int = 16
+
+
+# ------------------------------------------------------------ generators
+def poisson(rng: random.Random, lam: float) -> int:
+    """Knuth's algorithm off the scenario's private Random — deterministic
+    per (seed, draw index), unlike numpy's global state."""
+    if lam <= 0:
+        return 0
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def arrival_rate_at(spec: WorkloadSpec, cycle: int) -> float:
+    """Diurnal modulation of the base Poisson rate."""
+    if spec.diurnal_amplitude <= 0:
+        return spec.arrival_rate
+    phase = 2.0 * math.pi * cycle / max(spec.diurnal_period, 1)
+    return spec.arrival_rate * (1.0 + spec.diurnal_amplitude
+                                * math.sin(phase))
+
+
+def node_target_at(spec: WorkloadSpec, cycle: int) -> int:
+    """Autoscaler target node count: tracks the diurnal load curve between
+    ``min_nodes`` and ``max_nodes`` (the add/remove churn source)."""
+    if not spec.autoscale:
+        return spec.n_nodes
+    phase = 2.0 * math.pi * cycle / max(spec.diurnal_period, 1)
+    frac = 0.5 * (1.0 + math.sin(phase))
+    return spec.min_nodes + int(round(
+        frac * (spec.max_nodes - spec.min_nodes)))
+
+
+def draw_duration(spec: WorkloadSpec, rng: random.Random) -> int:
+    """Bounded Pareto in cycles — the heavy-tailed duration mix."""
+    d = spec.duration_min * rng.paretovariate(spec.duration_alpha)
+    return int(min(max(d, spec.duration_min), spec.duration_max))
+
+
+def draw_job(spec: WorkloadSpec, rng: random.Random, uid_seq: int,
+             cycle: int):
+    """One arriving job (PodGroup phase Pending: the enqueue action must
+    admit it, like a freshly created PodGroup)."""
+    from ..api import JobInfo, PodGroupPhase, Resource, TaskInfo
+    queue = spec.queues[rng.randrange(len(spec.queues))].name
+    gang = rng.choice(_GANG_SIZES)
+    cpu_m = rng.choice(_TASK_CPU_M)
+    uid = f"default/s{uid_seq}"
+    job = JobInfo(uid=uid, name=f"s{uid_seq}", namespace="default",
+                  queue=queue, min_available=max(1, gang // 2),
+                  priority=rng.randrange(3),
+                  creation_timestamp=VT_BASE + cycle,
+                  pod_group_phase=PodGroupPhase.PENDING)
+    rl: Dict[str, str] = {"cpu": f"{cpu_m}m", "memory": "1Gi"}
+    for t in range(gang):
+        job.add_task(TaskInfo(
+            uid=f"{uid}-t{t}", name=f"s{uid_seq}-t{t}",
+            namespace="default",
+            resreq=Resource.from_resource_list(dict(rl))))
+    return job, draw_duration(spec, rng)
+
+
+def build_node(spec: WorkloadSpec, index: int):
+    """One cluster node; in hetero mode every third node is a shared-GPU
+    node carrying a TDM revocable-zone window (both plugin families in one
+    pool)."""
+    from ..api import NodeInfo, Resource
+    rl = {"cpu": spec.node_cpu, "memory": spec.node_mem, "pods": "110"}
+    labels: Dict[str, str] = {}
+    if spec.hetero and index % 3 == 2:
+        from ..api import GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE
+        from ..plugins.tdm import REVOCABLE_ZONE_LABEL
+        rl[GPU_MEMORY_RESOURCE] = "16"
+        rl[GPU_NUMBER_RESOURCE] = "2"
+        labels[REVOCABLE_ZONE_LABEL] = "z1"
+        labels["pool"] = "accel"
+    else:
+        labels["pool"] = "general"
+    return NodeInfo(f"n{index}",
+                    allocatable=Resource.from_resource_list(rl),
+                    labels=labels)
+
+
+def build_cluster(spec: WorkloadSpec):
+    """The scenario's starting ClusterInfo: nodes + queues, no jobs (the
+    ``initial`` builder, when named, seeds pre-placed work afterwards)."""
+    from ..api import ClusterInfo, QueueInfo
+    ci = ClusterInfo()
+    for i in range(spec.n_nodes):
+        ci.add_node(build_node(spec, i))
+    for q in spec.queues:
+        ci.add_queue(QueueInfo(q.name, weight=q.weight,
+                               reclaimable=q.reclaimable))
+    return ci

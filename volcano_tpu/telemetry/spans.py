@@ -224,9 +224,10 @@ def events() -> List[dict]:
 
 def log_event(kind: str, **fields) -> Optional[dict]:
     """Append one structured event (degradation transition, digest trip,
-    recovery) to the bounded log; write-through as one JSON line to
-    ``$VOLCANO_EVENT_LOG`` when set (best-effort — the log must never
-    take the cycle down)."""
+    recovery; the scenario engine's per-cycle ``scenario_cycle`` and
+    end-of-run ``scenario_done`` quality records) to the bounded log;
+    write-through as one JSON line to ``$VOLCANO_EVENT_LOG`` when set
+    (best-effort — the log must never take the cycle down)."""
     if not _ENABLED:
         return None
     entry = dict(fields)
