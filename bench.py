@@ -40,7 +40,8 @@ BENCH_PROBE_TIMEOUT (seconds, default 150), BENCH_PROBE_RETRIES (default
 3, backoff 5s doubling capped at 60s), BENCH_SKIP_MULTICHIP=1 (skip the
 node-axis sharded-cycle comparison subprocess), BENCH_SKIP_SCENARIOS=1
 (skip the scheduling-quality scenario block; BENCH_SCENARIO_CYCLES sets
-its horizon, default 16).
+its horizon, default 16), BENCH_SKIP_RESTART=1 (skip the crash-consistent
+checkpoint/restore restart block).
 """
 
 from __future__ import annotations
@@ -922,6 +923,39 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             robustness_block = None
 
+    # ---- crash-consistent restart block (volcano_tpu/chaos/restart) ------
+    # The restart probe: process_kill at all three phases (pre-dispatch /
+    # in-flight / post-drain), each restored from the crash-consistent
+    # checkpoint (runtime/checkpoint.py), verified decision-identical to
+    # the uninterrupted run — plus a corrupt-checkpoint leg that must land
+    # on the fallback ladder rung and still finish identical. The record
+    # carries restore latency and warm-restart quality (cycles until the
+    # upload path is a delta again). BENCH_SKIP_RESTART=1 skips; a probe
+    # failure records null, never kills the bench.
+    restart_block = None
+    if not os.environ.get("BENCH_SKIP_RESTART"):
+        try:
+            from volcano_tpu.chaos import run_restart_probe
+            rrpt = run_restart_probe(
+                seed=int(os.environ.get("BENCH_CHAOS_SEED", 7)), cycles=8)
+            restart_block = {
+                "decisions_equal_clean": rrpt["decisions_equal_clean"],
+                "kills": rrpt["kills"],
+                "kill_schedule_sha": rrpt["kill_schedule_sha"],
+                "restore_outcomes": rrpt["restore_outcomes"],
+                "restore_ms_p50": rrpt["restore_ms_p50"],
+                "cycles_to_steady": rrpt["cycles_to_steady"],
+                "warm_refuses": rrpt["warm_refuses"],
+                "corrupt_decisions_equal_clean":
+                    rrpt["corrupt"]["decisions_equal_clean"],
+                "corrupt_fallbacks_visible":
+                    rrpt["corrupt"]["fallbacks_visible"],
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: restart block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            restart_block = None
+
     # ---- multichip sharded-cycle block (volcano_tpu/parallel) ------------
     # The node-axis sharded execution mode (ISSUE 7) measured per device
     # count against the unsharded oracle on identical churned workloads:
@@ -1072,6 +1106,7 @@ tiers:
         "graphcheck_sha256": graphcheck_sha,
         "telemetry": telemetry_block,
         "robustness": robustness_block,
+        "restart": restart_block,
         "multichip": multichip_block,
         "latency_breakdown": latency_block,
         "scenarios": scenario_block,
@@ -1160,6 +1195,14 @@ tiers:
         "scenario_node_utilization":
             (scenario_block or {}).get("node_utilization"),
         "scenario_event_sha": (scenario_block or {}).get("event_sha"),
+        # restart-quality numbers in the parsed block: restore latency and
+        # warm-restart health over the bench trajectory
+        "restart_restore_ms_p50":
+            (restart_block or {}).get("restore_ms_p50"),
+        "restart_decisions_equal_clean":
+            (restart_block or {}).get("decisions_equal_clean"),
+        "restart_cycles_to_steady":
+            (restart_block or {}).get("cycles_to_steady"),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))

@@ -56,6 +56,15 @@ if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
         XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
         python -m volcano_tpu.chaos --smoke --sharded || crc=$?
 fi
+rrc=0
+if [ "${TIER1_SKIP_RESTART:-0}" != "1" ]; then
+    # restart smoke (volcano_tpu/chaos/restart): process_kill at all
+    # three phases, each restored from the crash-consistent checkpoint
+    # (runtime/checkpoint.py), decision-identical to the uninterrupted
+    # run — plus the corrupt-checkpoint leg landing on the fallback rung
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --restart \
+        > /tmp/_t1_restart.json || rrc=$?
+fi
 qrc=0
 if [ "${TIER1_SKIP_SCENARIO:-0}" != "1" ]; then
     # scheduling-quality smoke (volcano_tpu/scenarios): a short seeded
@@ -73,6 +82,9 @@ if [ $grc -ne 0 ]; then
 fi
 if [ $crc -ne 0 ]; then
     exit $crc
+fi
+if [ $rrc -ne 0 ]; then
+    exit $rrc
 fi
 if [ $qrc -ne 0 ]; then
     exit $qrc

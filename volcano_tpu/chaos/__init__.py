@@ -13,6 +13,13 @@ Three pieces:
   multi-cycle scheduler run compared against the clean run, shared by
   the tier-1 smoke CLI (``python -m volcano_tpu.chaos --smoke``) and
   bench.py's ``robustness`` block.
+- :mod:`.restart` — :func:`run_restart_probe`: the ``process_kill``
+  storm (ISSUE 10): kill the scheduler at pre-dispatch / in-flight /
+  post-drain phases, restore from the crash-consistent checkpoint
+  (:mod:`..runtime.checkpoint`), and prove the applied-decision log
+  matches the uninterrupted run — tier-1 smoke
+  ``python -m volcano_tpu.chaos --smoke --restart`` and bench.py's
+  ``restart`` block.
 
 The hardening the faults exercise lives where it belongs: the in-graph
 integrity digest and mirror-rebuild recovery in :mod:`..ops.fused_io`,
@@ -24,13 +31,14 @@ in :mod:`..runtime.sidecar` — see docs/architecture.md "Fault tolerance
 
 from __future__ import annotations
 
-from .inject import (ChaosError, FaultInjector, active, chaos, install,
-                     seam, uninstall)
+from .inject import (KILL_PHASES, ChaosError, FaultInjector, active, chaos,
+                     install, seam, uninstall)
 from .plan import FAULT_KINDS, RECOVERABLE_KINDS, Fault, FaultPlan
 from .probe import run_chaos_probe
+from .restart import run_restart_probe
 
 __all__ = [
-    "FAULT_KINDS", "RECOVERABLE_KINDS", "Fault", "FaultPlan",
+    "FAULT_KINDS", "RECOVERABLE_KINDS", "KILL_PHASES", "Fault", "FaultPlan",
     "FaultInjector", "ChaosError", "seam", "active", "install",
-    "uninstall", "chaos", "run_chaos_probe",
+    "uninstall", "chaos", "run_chaos_probe", "run_restart_probe",
 ]

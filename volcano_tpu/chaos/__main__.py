@@ -8,6 +8,12 @@ multi-cycle pipelined scheduler run on the current backend and verifies:
   decision-neutral),
 - the planted resident-state corruption tripped the integrity digest.
 
+``--restart`` runs the restart smoke instead (chaos/restart.py): kill the
+scheduler at all three process_kill phases mid-run, restore each time from
+the crash-consistent checkpoint, and verify the applied-decision log
+matches the uninterrupted run — including a corrupt-checkpoint leg that
+must land on the ``fallback`` ladder rung and STILL finish identical.
+
 Exit 0 on success, 1 on any violated claim, 2 on harness error. The JSON
 report prints either way so CI logs carry the evidence.
 """
@@ -17,6 +23,36 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _restart_smoke(args) -> int:
+    from .restart import run_restart_probe
+    try:
+        report = run_restart_probe(seed=args.seed,
+                                   cycles=max(args.cycles, 8))
+    except Exception as e:  # harness failure, not a chaos verdict
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    print(json.dumps(report, indent=2, default=str))
+    corrupt = report.get("corrupt") or {}
+    ok = (report["decisions_equal_clean"]
+          and report["restore_outcomes"].get("restored", 0) >= 3
+          and len({p for _, p in report["kills"]}) >= 3
+          and corrupt.get("decisions_equal_clean", False)
+          and corrupt.get("fallbacks_visible", 0) >= 1)
+    if not ok:
+        print("restart smoke FAILED: "
+              + ("decision log diverged from the clean run; "
+                 if not report["decisions_equal_clean"] else "")
+              + ("not every kill restored; "
+                 if report["restore_outcomes"].get("restored", 0) < 3
+                 else "")
+              + ("corrupt-checkpoint leg diverged; "
+                 if not corrupt.get("decisions_equal_clean", False) else "")
+              + ("fallback outcome never counted"
+                 if corrupt.get("fallbacks_visible", 0) < 1 else ""),
+              file=sys.stderr)
+    return 0 if ok else 1
 
 
 def main(argv=None) -> int:
@@ -32,7 +68,13 @@ def main(argv=None) -> int:
     parser.add_argument("--sharded", action="store_true",
                         help="run the storm on the node-axis sharded "
                              "backend (conf sharding: true)")
+    parser.add_argument("--restart", action="store_true",
+                        help="run the restart smoke: process_kill at "
+                             "every phase, checkpoint restore, decision "
+                             "identity vs the uninterrupted run")
     args = parser.parse_args(argv)
+    if args.restart:
+        return _restart_smoke(args)
     from . import run_chaos_probe
     try:
         report = run_chaos_probe(seed=args.seed, cycles=args.cycles,

@@ -28,6 +28,13 @@ seam point              caller
                         (partial-frame injection)
 ``sidecar.client_recv`` SidecarClient, before reading the response
                         (socket drop after the request landed)
+``harness.kill``        chaos/restart.py at each process-kill phase
+                        (pre-dispatch / in-flight / post-drain): the
+                        harness polls for an armed ``process_kill`` fault
+                        — the kill itself is performed by the harness
+                        (tear down + checkpoint restore), since a real
+                        SIGKILL is not an exception the runtime's
+                        fail-soft handlers could be allowed to swallow
 ======================  ====================================================
 
 With no injector installed every seam is a module-global ``None`` check —
@@ -44,6 +51,11 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .plan import Fault, FaultPlan
+
+#: the three distinct points a process death is injected at, relative to
+#: the cycle the kill is scheduled in; a process_kill fault's ``param``
+#: picks one (param % 3)
+KILL_PHASES = ("pre_dispatch", "in_flight", "post_drain")
 
 
 class ChaosError(RuntimeError):
@@ -228,6 +240,22 @@ class FaultInjector:
             client.sock.close()
             raise ConnectionResetError("chaos: partial frame, socket died "
                                        "mid-send")
+
+    def _on_harness_kill(self, phase: Optional[str] = None, **_):
+        """Consume an armed ``process_kill`` fault whose param selects
+        ``phase``. Returns the Fault (the harness then performs the kill:
+        discard the process's runtime objects and restore from the
+        checkpoint) or None. Only the restart harness calls this seam —
+        the production runtime cannot inject its own death."""
+        with self._lock:
+            for f in self._pool:
+                if f.kind == "process_kill" \
+                        and KILL_PHASES[f.param % len(KILL_PHASES)] == phase:
+                    self._pool.remove(f)
+                    self.fired.append((self.cycle, "process_kill",
+                                       f"harness.kill:{phase}"))
+                    return f
+        return None
 
     def _on_sidecar_client_recv(self, client=None, **_):
         f = self._take("socket_drop", "sidecar.client_recv")

@@ -27,6 +27,14 @@ Fault kinds (the seams they fire at live in :mod:`.inject`):
 - ``evict_fail``       — an evict dispatch fails once
 - ``lease_expiry``     — the leader lease is stolen by a rival that then
                          lets it expire
+- ``process_kill``     — the scheduler/sidecar process dies outright at a
+                         kill phase (pre-dispatch, in-flight, post-drain;
+                         param picks which) and is restarted from its
+                         crash-consistent checkpoint. Performed BY the
+                         restart harness (chaos/restart.py) — a SIGKILL
+                         is not an exception the runtime's fail-soft
+                         handlers could be allowed to swallow — so the
+                         injector only arms and logs it.
 """
 
 from __future__ import annotations
@@ -40,7 +48,7 @@ from typing import Iterable, List, Optional, Tuple
 FAULT_KINDS = (
     "socket_drop", "partial_frame", "backend_loss", "resident_corrupt",
     "mirror_drift", "slow_dispatch", "bind_fail", "evict_fail",
-    "lease_expiry",
+    "lease_expiry", "process_kill",
 )
 
 #: kinds whose recovery must keep the decision sequence bit-identical to

@@ -1,0 +1,256 @@
+"""Restart probe: process_kill -> checkpoint restore -> decision identity.
+
+The restart analog of :mod:`.probe`'s fault storm: a clean multi-cycle
+scheduler run is compared against the identical run interrupted by
+``process_kill`` faults at three distinct cycle phases —
+
+- ``pre_dispatch``  — death between cycles; nothing in flight,
+- ``in_flight``     — death with a dispatched-but-undrained pipelined
+                      cycle; its decisions die with the process and the
+                      restored scheduler re-decides them identically,
+- ``post_drain``    — death after the cycle's decisions were applied to
+                      the (external, crash-surviving) cluster truth but
+                      before the next checkpoint; the restored scheduler
+                      re-runs the cycle as a no-op, never re-applying —
+                      the never-double-dispatch half of the claim.
+
+Each kill discards the Scheduler outright (the harness plays the OS: a
+SIGKILL is not an exception the runtime's fail-soft handlers could be
+allowed to swallow), builds a fresh one over the same cluster, and calls
+:meth:`Scheduler.restore` on the last checkpoint. Identity is judged on
+what actually reached the cluster: the ordered log of applied bind/evict
+dispatches plus the final task/podgroup state — per-cycle scheduler
+records would misreport the post-drain case, where the legitimate no-op
+re-run cycle exists only in the interrupted timeline.
+
+A ``corrupt`` leg flips a byte in every checkpoint before restoring:
+each restore must land on the ``fallback`` ladder rung
+(checkpoint_restore_total) and the run must STILL finish
+decision-identical — cold re-fuse from external truth is decision-
+correct; the checkpoint only restores warmth and counters.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .inject import KILL_PHASES, FaultInjector, chaos, seam
+from .plan import Fault, FaultPlan
+from .probe import _PROBE_CONF, _churn, _small_cluster
+
+#: virtual-clock base, matching the chaos probe (no wall clock in
+#: decision paths)
+_VT = 1000.0
+
+#: default kill matrix: every phase exercised once, spread across the run
+_DEFAULT_KILLS = ((2, "pre_dispatch"), (4, "in_flight"), (6, "post_drain"))
+
+
+def _instrument(cluster) -> List[tuple]:
+    """Wrap the cluster's bind/evict dispatch with an applied-decision
+    log — the ground truth of what the scheduler DID to the external
+    world, which is what must stay identical across restarts."""
+    applied: List[tuple] = []
+    orig_bind, orig_evict = cluster.bind, cluster.evict
+
+    def bind(intent):
+        ok = orig_bind(intent)
+        if ok:
+            applied.append(("bind", intent.task_uid, intent.node_name,
+                            int(getattr(intent, "gpu_index", -1) or 0)))
+        return ok
+
+    def evict(intent):
+        ok = orig_evict(intent)
+        if ok:
+            applied.append(("evict", intent.task_uid))
+        return ok
+
+    cluster.bind = bind
+    cluster.evict = evict
+    return applied
+
+
+def _final_state(cluster) -> tuple:
+    ci = cluster.ci
+    tasks = sorted((t.uid, str(t.status), t.node_name or "")
+                   for job in ci.jobs.values()
+                   for t in job.tasks.values())
+    phases = sorted((uid, str(j.pod_group_phase))
+                    for uid, j in ci.jobs.items())
+    return (tasks, phases)
+
+
+def _flip_byte(path: str) -> None:
+    """Damage a checkpoint in place: flip the last byte (inside the
+    pickled body, so the content sha must catch it)."""
+    if not os.path.exists(path):
+        return
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _kill_restore(cluster, conf, pipeline, ckpt_path, cycle, phase,
+                  restores, corrupt):
+    """The kill: the old Scheduler object is simply dropped (its pending
+    cycle, session, and residents die with it); a fresh one over the same
+    external cluster truth restores from the last checkpoint."""
+    from ..runtime.scheduler import Scheduler
+    seam("harness.kill", phase=phase)
+    if corrupt:
+        _flip_byte(ckpt_path)
+    t0 = time.time()
+    sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
+    outcome = sched.restore(ckpt_path, now=_VT + cycle)
+    restores.append(dict(cycle=cycle, phase=phase, outcome=outcome,
+                         restore_ms=round((time.time() - t0) * 1000, 3)))
+    return sched
+
+
+def run_restart_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
+                      kills: Optional[Sequence[Tuple[int, str]]] = None,
+                      corrupt_leg: bool = True) -> Dict[str, object]:
+    """Run the probe; returns a JSON-ready restart report.
+
+    ``kills`` is a sequence of (cycle, phase) pairs; the default matrix
+    exercises all three phases. The kill schedule is armed through a
+    FaultPlan/FaultInjector (the ``process_kill`` kind), so the fired log
+    and schedule sha follow the same replayable-chaos contract as every
+    other fault kind."""
+    from ..framework.conf import parse_conf
+    from ..metrics import METRICS
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+
+    conf = parse_conf(_PROBE_CONF)
+    base = _small_cluster()
+    kills = tuple(kills) if kills is not None else tuple(
+        (c, p) for c, p in _DEFAULT_KILLS if c < cycles)
+    bad = [p for _, p in kills if p not in KILL_PHASES]
+    if bad:
+        raise ValueError(f"unknown kill phases: {bad}")
+
+    def make_injector():
+        # an explicit schedule in FaultPlan clothing: param selects the
+        # phase, so the injector's arm/consume/fired-log machinery (and
+        # schedule_sha fingerprint) is the same as any seeded storm
+        plan = FaultPlan(seed=seed, cycles=cycles, kinds=())
+        plan.faults = tuple(sorted(
+            (Fault(kind="process_kill", cycle=c,
+                   param=KILL_PHASES.index(p)) for c, p in kills),
+            key=lambda f: (f.cycle, f.kind, f.param)))
+        return plan, FaultInjector(plan)
+
+    def run(kill_run: bool, corrupt: bool = False):
+        cluster = FakeCluster(base.clone())
+        applied = _instrument(cluster)
+        sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
+        restores: List[dict] = []
+        plan = injector = None
+        ckpt_path = None
+        tmpdir = None
+        if kill_run:
+            plan, injector = make_injector()
+            tmpdir = tempfile.TemporaryDirectory(prefix="vckp-probe-")
+            ckpt_path = os.path.join(tmpdir.name, "sched.vckp")
+        kill_map: Dict[int, List[str]] = {}
+        for c, p in kills if kill_run else ():
+            kill_map.setdefault(c, []).append(p)
+        ctx = chaos(injector) if injector is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            for c in range(cycles):
+                if injector is not None:
+                    injector.begin_cycle(c)
+                phases = set(kill_map.get(c, ()))
+                if "pre_dispatch" in phases:
+                    sched = _kill_restore(cluster, conf, pipeline,
+                                          ckpt_path, c, "pre_dispatch",
+                                          restores, corrupt)
+                out = sched.run_once(now=_VT + c)
+                if pipeline and "in_flight" in phases:
+                    # the dispatched-but-undrained cycle dies with the
+                    # process; the restored scheduler re-decides it from
+                    # the same (unchanged) cluster truth
+                    sched = _kill_restore(cluster, conf, pipeline,
+                                          ckpt_path, c, "in_flight",
+                                          restores, corrupt)
+                    out = sched.run_once(now=_VT + c)
+                if pipeline:
+                    sched.drain(now=_VT + c)
+                if "post_drain" in phases:
+                    # this cycle's decisions already reached external
+                    # truth; the restored scheduler re-runs it as a no-op
+                    # (nothing pending is re-decided) — never re-applied
+                    sched = _kill_restore(cluster, conf, pipeline,
+                                          ckpt_path, c, "post_drain",
+                                          restores, corrupt)
+                    sched.run_once(now=_VT + c)
+                    if pipeline:
+                        sched.drain(now=_VT + c)
+                if ckpt_path is not None:
+                    sched.checkpoint(ckpt_path, now=_VT + c)
+                _churn(cluster, c)
+        sha = hashlib.sha256(
+            repr((applied, _final_state(cluster))).encode()).hexdigest()[:16]
+        if tmpdir is not None:
+            tmpdir.cleanup()
+        return dict(sha=sha, restores=restores, sched=sched, plan=plan,
+                    injector=injector)
+
+    clean = run(kill_run=False)
+
+    def outcomes(restores):
+        out: Dict[str, int] = {}
+        for r in restores:
+            out[r["outcome"]] = out.get(r["outcome"], 0) + 1
+        return out
+
+    warm0 = METRICS.counter_value("checkpoint_warm_refuse_total")
+    kill = run(kill_run=True)
+    restore_ms = sorted(r["restore_ms"] for r in kill["restores"])
+    # cycles after the LAST restore until the upload path is a delta
+    # again (flight isn't checkpointed, so the final scheduler's ring
+    # holds exactly the post-restore cycles)
+    kinds = [e.get("cycle_kind") for e in kill["sched"].flight.snapshots()]
+    cycles_to_steady = next(
+        (i for i, k in enumerate(kinds) if k == "delta"), None)
+    report: Dict[str, object] = {
+        "seed": seed,
+        "cycles": cycles,
+        "pipeline": pipeline,
+        "kills": [[c, p] for c, p in kills],
+        "kill_schedule_sha": kill["plan"].schedule_sha(),
+        "fault_log": [list(f) for f in kill["injector"].fired],
+        "clean_sha": clean["sha"],
+        "decisions_sha": kill["sha"],
+        "decisions_equal_clean": kill["sha"] == clean["sha"],
+        "restores": kill["restores"],
+        "restore_outcomes": outcomes(kill["restores"]),
+        "restore_ms_p50": (restore_ms[len(restore_ms) // 2]
+                           if restore_ms else None),
+        "cycles_to_steady": cycles_to_steady,
+        "warm_refuses": METRICS.counter_value(
+            "checkpoint_warm_refuse_total") - warm0,
+    }
+    if corrupt_leg:
+        fb0 = METRICS.counter_value("checkpoint_restore_total",
+                                    {"outcome": "fallback"})
+        corrupt = run(kill_run=True, corrupt=True)
+        report["corrupt"] = {
+            "decisions_sha": corrupt["sha"],
+            "decisions_equal_clean": corrupt["sha"] == clean["sha"],
+            "restore_outcomes": outcomes(corrupt["restores"]),
+            "fallbacks_visible": METRICS.counter_value(
+                "checkpoint_restore_total",
+                {"outcome": "fallback"}) - fb0,
+        }
+    return report

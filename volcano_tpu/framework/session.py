@@ -883,6 +883,20 @@ class Session:
                 if state is None:
                     from ..ops.fused_io import ResidentState
                     state = self._resident[id(kernel)] = ResidentState()
+                    warm = getattr(self, "_warm_mirrors", None)
+                    if warm and mesh is None:
+                        # warm restart (runtime/checkpoint): a digest-
+                        # verified pre-crash mirror for this shape bucket
+                        # becomes the residency, so this first run ships
+                        # a delta instead of the cold full upload.
+                        # Sharded residents always cold-fuse (mesh-
+                        # dependent placement is not checkpointed).
+                        from ..ops.fused_io import _shape_key
+                        mir = warm.pop(
+                            _shape_key((self.snap, extras), cfg), None)
+                        if mir is not None:
+                            from ..runtime.checkpoint import adopt_mirror
+                            adopt_mirror(state, mir)
                 packed = kernel.run(state, (self.snap, extras))
                 self.stats["upload_bytes"] = float(state.last_upload_bytes)
                 self.stats["upload_bytes_full"] = float(

@@ -72,6 +72,29 @@ _HELP = {
         "Sidecar client reconnects after a socket failure",
     "sidecar_replayed_rounds_total":
         "VCRQ rounds served from the idempotent replay cache",
+    "sidecar_epoch_restored_total":
+        "Mid-stream rounds rejected with ERR_EPOCH_RESTORED after a "
+        "server restart (side=server) and client re-primes that followed "
+        "(side=client)",
+    # crash-consistent checkpoint/restore (runtime/checkpoint.py)
+    "checkpoint_write_total":
+        "Crash-consistent checkpoints written (atomic tmp+fsync+rename), "
+        "by kind (scheduler / sidecar)",
+    "checkpoint_restore_total":
+        "Restore attempts by outcome: restored (warm), cold (no "
+        "checkpoint), fallback (corrupt / version-skewed / mismatched "
+        "checkpoint degraded to a fresh-fuse cold start)",
+    "checkpoint_mirror_invalid_total":
+        "Checkpointed host mirrors dropped at restore because their "
+        "integrity digest no longer matched (cold re-fuse instead)",
+    "checkpoint_warm_refuse_total":
+        "Resident states re-fused warm from a restored checkpoint mirror "
+        "(the delta path survived the restart)",
+    "crash_loop_restarts_total":
+        "Supervised serve-loop restarts after a crash (capped backoff)",
+    "resync_redrive_total":
+        "Dead-letter resync intents re-driven back to pending after a "
+        "successful restore",
     "span_phase_ms":
         "Host span duration quantiles per cycle phase (ring-buffered "
         "p50/p95/p99 from telemetry.spans — the SLO latency surface)",

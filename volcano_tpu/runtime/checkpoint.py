@@ -1,0 +1,291 @@
+"""Crash-consistent checkpoint/restore for the scheduler and sidecar.
+
+PR 5 made the cycle runtime survive every *in-process* fault; a process
+death still lost the host-side truth that is NOT re-derivable from the
+cluster source: the sidecar's per-epoch replay cache and seq watermarks,
+the ResyncQueue's pending retries and dead letters, cumulative metrics,
+and the resident-state mirrors that make the first post-restart cycle a
+delta instead of a full re-fuse. This module serializes exactly that
+state — and nothing the runtime can rebuild cheaper than it can reload
+(device buffers, compiled programs, flight rings) — as an atomic
+tmp+fsync+rename file:
+
+    VCKP | u32 schema | sha256(body) | body (pickle of the envelope)
+
+The envelope is stamped twice: the content sha over the whole body
+(truncation/flip detection) and the PR 5 integrity-digest words of every
+checkpointed resident mirror (``ops/fused_io.host_digest`` — the same
+3-word formula the in-graph digest computes), verified again at restore
+before a mirror is re-adopted onto the device.
+
+Restore ladder (``checkpoint_restore_total{outcome=...}``):
+
+- valid file, matching conf  -> ``restored`` — warm restart: state
+  reloaded, residents re-fused from restored truth, the stream resumes
+  decision-identically to an uninterrupted run;
+- no file                    -> ``cold`` — the ordinary fresh start;
+- truncated / flipped byte / version skew / conf mismatch ->
+  ``fallback`` — degrade gracefully to the fresh-fuse cold start. Still
+  decision-identical: the authoritative cluster state lives OUTSIDE the
+  process (the reference's API-server posture, PAPER.md §1), so re-fuse
+  from source truth is always a correct recovery primitive; the
+  checkpoint only buys back warmth and stream continuity.
+
+:class:`CrashLoopSupervisor` is the serve-loop half: capped-backoff
+restarts of a crashing target, so a sidecar wedged in a crash loop
+flaps with bounded frequency and eventually surfaces the error instead
+of burning the host.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import struct
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import METRICS
+from ..telemetry import spans
+
+#: file magic — fails fast on foreign files instead of unpickling them
+MAGIC = b"VCKP"
+#: bump on envelope layout changes; a FUTURE schema restores as fallback
+#: (an older binary must never guess at a newer layout)
+SCHEMA_VERSION = 1
+_HEADER = struct.Struct("<4sI32s")  # magic | schema | sha256(body)
+
+
+# --------------------------------------------------------------- envelope
+def conf_fingerprint(conf) -> str:
+    """Stable fingerprint of a SchedulerConfiguration (or AllocateConfig):
+    a checkpoint taken under one policy must not resume under another —
+    the decision stream would silently diverge."""
+    try:
+        blob = pickle.dumps(conf, protocol=4)
+    except Exception:  # unpicklable conf: fall back to its repr
+        blob = repr(conf).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def fold_digest(records: List[dict]) -> List[int]:
+    """XOR-fold of the per-mirror integrity-digest words — the envelope's
+    PR 5 stamp (order-independent, so record ordering can't perturb it)."""
+    from ..ops.fused_io import DIGEST_WORDS
+    out = np.zeros(DIGEST_WORDS, np.uint32)
+    for r in records:
+        out ^= np.asarray(r["digest"], np.uint32)
+    return [int(x) for x in out]
+
+
+def write_checkpoint(path: str, kind: str, state: dict,
+                     mirrors: Optional[List[dict]] = None) -> dict:
+    """Atomically write a checkpoint file.
+
+    tmp file in the SAME directory (rename must not cross filesystems),
+    flush + fsync before the rename, rename over the destination, then a
+    best-effort directory fsync — a crash at any point leaves either the
+    old complete file or the new complete file, never a torn one."""
+    mirrors = mirrors or []
+    envelope = {
+        "kind": kind,
+        "state": state,
+        "mirrors": mirrors,
+        "digest_words": fold_digest(mirrors),
+        "written_at": time.time(),
+    }
+    body = pickle.dumps(envelope, protocol=4)
+    sha = hashlib.sha256(body).digest()
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".vckp.", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(_HEADER.pack(MAGIC, SCHEMA_VERSION, sha))
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:  # persist the rename itself (best-effort: not all FSes allow it)
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    METRICS.inc("checkpoint_write_total", labels={"kind": kind})
+    spans.log_event("checkpoint", ckpt_kind=kind, path=path,
+                    bytes=len(body) + _HEADER.size,
+                    sha=sha.hex()[:16], mirrors=len(mirrors))
+    return {"path": path, "sha": sha.hex(), "bytes": len(body) + _HEADER.size}
+
+
+def load_checkpoint(path: str, kind: str) -> Tuple[Optional[dict], str]:
+    """Read + verify a checkpoint file. Returns ``(envelope, "ok")`` or
+    ``(None, reason)`` where reason is one of ``missing | truncated |
+    bad_magic | version_skew | sha_mismatch | corrupt | kind_mismatch``.
+    Never raises on a damaged file — a bad checkpoint must degrade to a
+    cold start, not take the restart down."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        return None, "missing"
+    except OSError:
+        return None, "corrupt"
+    if len(raw) < _HEADER.size:
+        return None, "truncated"
+    magic, schema, sha = _HEADER.unpack_from(raw)
+    if magic != MAGIC:
+        return None, "bad_magic"
+    if schema > SCHEMA_VERSION:
+        return None, "version_skew"
+    body = raw[_HEADER.size:]
+    if hashlib.sha256(body).digest() != sha:
+        return None, "sha_mismatch"
+    try:
+        envelope = pickle.loads(body)
+    except Exception:
+        return None, "corrupt"
+    if envelope.get("kind") != kind:
+        return None, "kind_mismatch"
+    return envelope, "ok"
+
+
+def record_restore(outcome: str, reason: str, source: str,
+                   restore_ms: Optional[float] = None) -> None:
+    """The one place the restore ladder lands: the labeled counter plus a
+    ``restore`` event in the JSONL log / event ring."""
+    METRICS.inc("checkpoint_restore_total", labels={"outcome": outcome})
+    spans.log_event("restore", outcome=outcome, reason=reason,
+                    source=source,
+                    restore_ms=(round(restore_ms, 3)
+                                if restore_ms is not None else None))
+
+
+# ------------------------------------------------------- cumulative metrics
+def metrics_snapshot() -> List[list]:
+    """Serializable view of the cumulative counters: [name, labelstr,
+    value] triples (the registry's native key shape)."""
+    return [[name, labels, float(v)]
+            for (name, labels), v in sorted(METRICS.counters.items())]
+
+
+def merge_metrics(saved: List[list]) -> None:
+    """Resume cumulative counters from the checkpointed watermark. A fresh
+    process starts at zero, so the saved value wins; an in-process restore
+    (tests, the restart-storm engine) keeps whichever is larger — counters
+    are monotonic and must never step backwards."""
+    for name, labels, v in saved or []:
+        key = (str(name), str(labels))
+        if float(v) > METRICS.counters.get(key, 0.0):
+            METRICS.counters[key] = float(v)
+
+
+# ------------------------------------------------- resident mirror records
+def mirror_records(kernels: Dict[tuple, object],
+                   states: Dict[int, object]) -> List[dict]:
+    """Snapshot the host mirrors of device truth for every flat DeltaKernel
+    shape bucket: (shape key, copied mirror buffers, integrity-digest
+    words). Sharded residents are deliberately NOT checkpointed — their
+    per-shard placement is mesh-dependent, and a restarted process
+    re-fuses them from source truth in one full upload."""
+    from ..ops.fused_io import host_digest
+    out = []
+    for key, kernel in kernels.items():
+        state = states.get(id(kernel))
+        if state is None or state.mirror is None:
+            continue
+        mirror = tuple(np.array(b, copy=True) for b in state.mirror)
+        out.append({"key": key, "mirror": mirror,
+                    "digest": [int(x) for x in host_digest(mirror)]})
+    return out
+
+
+def verify_mirrors(records: List[dict]) -> Dict[tuple, tuple]:
+    """Re-verify each checkpointed mirror against its stamped digest words
+    (the PR 5 formula, recomputed over the rehydrated buffers). A record
+    that fails verification is dropped — that shape bucket cold-fuses —
+    and counted, never adopted."""
+    from ..ops.fused_io import host_digest
+    out: Dict[tuple, tuple] = {}
+    for r in records or []:
+        mirror = r["mirror"]
+        if [int(x) for x in host_digest(mirror)] != list(r["digest"]):
+            METRICS.inc("checkpoint_mirror_invalid_total")
+            spans.log_event("restore_mirror_invalid")
+            continue
+        out[_freeze_key(r["key"])] = mirror
+    return out
+
+
+def _freeze_key(key):
+    """Shape keys round-trip through pickle as nested tuples already; this
+    normalizes any list contamination so dict lookups match _shape_key."""
+    if isinstance(key, (list, tuple)):
+        return tuple(_freeze_key(k) for k in key)
+    return key
+
+
+def adopt_mirror(state, mirror) -> None:
+    """Warm re-fuse: put the verified mirror back on the device and adopt
+    it as residency, so the next :meth:`DeltaKernel.run` diffs against it
+    and ships O(churn) — the warm-restart payoff. device == mirror exactly
+    by construction, so the next in-graph digest check still holds."""
+    import jax
+    state.mirror = tuple(np.asarray(b) for b in mirror)
+    state.device = tuple(jax.device_put(b) for b in state.mirror)
+    state.scratch = None
+    state.retiring = ()
+    METRICS.inc("checkpoint_warm_refuse_total")
+
+
+# ------------------------------------------------------ crash-loop policy
+class CrashLoopSupervisor:
+    """Capped-backoff restart policy for a serve loop.
+
+    Runs ``target()`` until it returns cleanly. When it raises, the
+    supervisor restarts it after a capped-exponential backoff delay
+    (runtime/backoff.Backoff — the same discipline the sidecar client
+    reconnect uses), up to ``max_restarts`` times; then the last error
+    propagates, because a crash loop must eventually surface instead of
+    flapping forever. KeyboardInterrupt and SystemExit always propagate —
+    a clean shutdown is not a crash."""
+
+    def __init__(self, target, max_restarts: int = 5, backoff=None,
+                 sleep=time.sleep):
+        from .backoff import Backoff
+        self.target = target
+        self.max_restarts = int(max_restarts)
+        self.backoff = backoff if backoff is not None \
+            else Backoff(base=0.5, cap=30.0, attempts=max_restarts + 1)
+        self.restarts = 0
+        self._sleep = sleep
+
+    def run(self):
+        while True:
+            try:
+                return self.target()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise
+                delay = self.backoff.delay(self.restarts - 1)
+                METRICS.inc("crash_loop_restarts_total")
+                spans.log_event("restart", source="supervisor",
+                                error=f"{type(e).__name__}: {e}",
+                                restarts=self.restarts,
+                                delay_s=round(delay, 3))
+                self._sleep(delay)

@@ -56,6 +56,20 @@ class ResyncQueue:
         attempts, gave_up_at). Never mutated by later processing."""
         return [dict(e) for e in self.dead]
 
+    def redrive(self, now: float = 0.0) -> int:
+        """Dead letters back to pending with attempts reset — the second
+        life a restart grants: the crash that stranded these intents also
+        reset whatever condition exhausted their retries (a wedged node
+        agent, a stale hold). Called once after a successful restore;
+        counted as ``resync_redrive_total``."""
+        dead, self.dead = self.dead, []
+        for e in dead:
+            self.add(e["intent"], e["kind"], now, attempts=1)
+        if dead:
+            METRICS.inc("resync_redrive_total", len(dead))
+            spans.log_event("resync_redrive", count=len(dead))
+        return len(dead)
+
     def process(self, cluster, now: float) -> Dict[str, int]:
         """Retry every due entry against the cluster. Returns counters.
         An entry that exhausts ``max_attempts`` is never dropped silently:
@@ -202,6 +216,14 @@ class Scheduler:
                           plugin_overrides=overrides)
             self._session = ssn
             self.full_packs += 1
+            warm = getattr(self, "_restored_mirrors", None)
+            if warm:
+                # warm restart: the freshly packed session adopts the
+                # checkpointed (digest-verified) mirrors, so its first
+                # allocate ships a delta against pre-crash residency
+                # instead of the full cold upload
+                ssn._warm_mirrors = warm
+                self._restored_mirrors = None
             return ssn
         for uid in dj:
             ssn._dirty_jobs.add(uid)
@@ -491,6 +513,92 @@ class Scheduler:
         """Retire the in-flight pipelined cycle, if any: readback, apply,
         flush. Returns the completed cycle's record or None."""
         return self._drain_pending(now if now is not None else time.time())
+
+    # ----------------------------------------- crash-consistent restarts
+    def checkpoint(self, path: str, now: Optional[float] = None) -> dict:
+        """Serialize the scheduler's host-side truth to ``path``
+        (atomic tmp+fsync+rename; see runtime/checkpoint.py).
+
+        The in-flight pipelined cycle is DRAINED first — its decisions
+        apply to the cluster before the snapshot is cut, so a restore can
+        never replay a half-applied bind (the depth-1 contract makes the
+        early drain decision-neutral). Cluster state itself is not
+        checkpointed: the cluster source is external authoritative truth
+        that survives the process, exactly like the reference's API
+        server."""
+        from . import checkpoint as ckpt
+        wall = now if now is not None else time.time()
+        self._drain_pending(wall)
+        mirrors = []
+        if self._session is not None:
+            # resident mirrors of the persistent session's flat kernels
+            # (kernels are shared in the module cache; residency is per
+            # session): lets a warm restore skip the full re-upload — the
+            # re-fuse from truth still happens, as deltas against these
+            # mirrors
+            from ..framework.session import _DELTA_CACHE
+            mirrors = ckpt.mirror_records(_DELTA_CACHE,
+                                          self._session._resident)
+        state = dict(
+            cycles=self.cycles,
+            full_packs=self.full_packs,
+            incremental_cycles=self.incremental_cycles,
+            degradation_level=self.degradation_level,
+            degrade_until=self._degrade_until,
+            conf_fingerprint=ckpt.conf_fingerprint(self.conf),
+            resync_entries=[dict(e) for e in self.resync.entries],
+            resync_dead=[dict(e) for e in self.resync.dead],
+            metrics=ckpt.metrics_snapshot(),
+        )
+        return ckpt.write_checkpoint(path, "scheduler", state,
+                                     mirrors=mirrors)
+
+    def restore(self, path: str, now: Optional[float] = None) -> str:
+        """Reload a checkpoint into this (fresh) scheduler and resume
+        decision-identically. Returns the restore-ladder outcome:
+        ``restored`` | ``cold`` (no file) | ``fallback`` (damaged or
+        mismatched file — this scheduler simply stays a fresh-fuse cold
+        start, which is itself decision-correct because the cluster
+        source is the authority; the checkpoint only restores warmth,
+        counters, and retry state)."""
+        from . import checkpoint as ckpt
+        wall = now if now is not None else time.time()
+        t0 = time.time()
+        with spans.span("cycle.restore", cat="recovery"):
+            env, reason = ckpt.load_checkpoint(path, "scheduler")
+            if env is None:
+                outcome = "cold" if reason == "missing" else "fallback"
+                ckpt.record_restore(outcome, reason, "scheduler",
+                                    (time.time() - t0) * 1000)
+                return outcome
+            state = env["state"]
+            if state.get("conf_fingerprint") != \
+                    ckpt.conf_fingerprint(self.conf):
+                ckpt.record_restore("fallback", "conf_mismatch",
+                                    "scheduler", (time.time() - t0) * 1000)
+                return "fallback"
+            self.cycles = int(state["cycles"])
+            self.full_packs = int(state["full_packs"])
+            self.incremental_cycles = int(state["incremental_cycles"])
+            self.degradation_level = int(state["degradation_level"])
+            self._degrade_until = int(state["degrade_until"])
+            self.resync.entries = [dict(e)
+                                   for e in state["resync_entries"]]
+            self.resync.dead = [dict(e) for e in state["resync_dead"]]
+            ckpt.merge_metrics(state.get("metrics"))
+            # the next _open_session full-packs from the cluster's live
+            # view — re-fuse from truth is the recovery primitive; the
+            # checkpointed mirrors make that re-fuse warm (delta, not
+            # full upload) once the session's kernels come back up
+            self._session = None
+            self._pending = None
+            self._restored_mirrors = ckpt.verify_mirrors(
+                env.get("mirrors"))
+            # intents stranded by the crash get a second life
+            self.resync.redrive(wall)
+        ckpt.record_restore("restored", "ok", "scheduler",
+                            (time.time() - t0) * 1000)
+        return "restored"
 
     def wait_pending(self) -> bool:
         """Block until the in-flight cycle's DEVICE work has finished,

@@ -75,6 +75,12 @@ ERR_BAD_REQUEST = 2      # fatal: framing/protocol/snapshot decode error
 ERR_INTERNAL = 3         # retryable: the handler failed, state rolled back
 ERR_BACKEND = 4          # retryable after degrade: the accelerator is gone
 ERR_EMPTY_PIPELINE = 5   # benign: VCRD with nothing in flight
+ERR_EPOCH_RESTORED = 6   # retryable: a seq>1 round named a stream epoch
+#                          this (restarted) server never served — the
+#                          client must adopt a fresh epoch and re-prime.
+#                          Structured, so a restart storm costs each
+#                          client one extra roundtrip instead of a
+#                          timeout discovery per restart.
 _u32 = struct.Struct("<I")
 
 
@@ -223,6 +229,26 @@ class SchedulerSidecar:
         self._seq_lock = threading.Lock()
         #: served-round counter, arming per-round chaos faults
         self._rounds_served = 0
+        #: client stream epochs this process has served (a stream's first
+        #: round registers it; checkpoint/restore carries the set): a
+        #: seq>1 round naming an UNKNOWN epoch means we restarted under
+        #: the client's feet — answered with ERR_EPOCH_RESTORED instead
+        #: of a misleading prime payload or a timeout discovery
+        self._known_epochs: set = set()
+        #: decision payload staged for the next drain: set when a
+        #: checkpoint retires the in-flight cycle early (early readback is
+        #: decision-neutral; the payload must still reach the client) or
+        #: when a restore rehydrates the pre-crash cycle's decisions —
+        #: keeps the served stream bit-identical to an uninterrupted run
+        self._staged_payload: Optional[bytes] = None
+        #: digest-verified pre-crash mirrors (shape key -> host buffers)
+        #: awaiting adoption by their shape bucket's first dispatch
+        self._restored_mirrors: Dict[tuple, tuple] = {}
+        #: policy identity stamped into checkpoints — a checkpoint taken
+        #: under a different policy must not restore into this process
+        from .checkpoint import conf_fingerprint
+        self._ckpt_fingerprint = conf_fingerprint(
+            conf if conf is not None else self.cfg)
         # opt-in persistent compilation cache ($VOLCANO_JAX_CACHE_DIR or
         # the conf's compilation_cache_dir): restarts stop paying compile_s
         from ..framework.compile_cache import enable_compilation_cache
@@ -310,6 +336,18 @@ class SchedulerSidecar:
             state = self._states.get(id(kernel))
             if state is None:
                 state = self._states[id(kernel)] = ResidentState()
+                if self._restored_mirrors and not self.sharding:
+                    # warm restart (runtime/checkpoint): a digest-verified
+                    # pre-crash mirror for this shape bucket becomes the
+                    # residency, so the first restored round ships a delta
+                    # instead of the cold full upload. Sharded residents
+                    # always cold-fuse (mesh placement isn't checkpointed).
+                    from ..ops.fused_io import _shape_key
+                    mir = self._restored_mirrors.pop(_shape_key(tree_in),
+                                                     None)
+                    if mir is not None:
+                        from .checkpoint import adopt_mirror
+                        adopt_mirror(state, mir)
             with _spans.span("sidecar.dispatch", cat="dispatch"):
                 packed = kernel.run(state, tree_in)
             return (packed, state.last_kind, state.last_upload_bytes,
@@ -450,7 +488,11 @@ class SchedulerSidecar:
         _serve_lock). Returns None when nothing is pending."""
         pending = self._pending
         if pending is None:
-            return None
+            # a checkpoint or restore may have staged the retired cycle's
+            # payload here — hand it to the stream exactly where the live
+            # pending cycle's drain would have
+            payload, self._staged_payload = self._staged_payload, None
+            return payload
         self._pending = None
         import time as _time
         with _spans.span("sidecar.drain", cat="wait"):
@@ -529,6 +571,20 @@ class SchedulerSidecar:
                 return cached[2]
             if cached is not None and cached[0] != epoch:
                 self.drain_pending()    # retire the stale stream's cycle
+            if seq > 1 and epoch not in self._known_epochs:
+                # mid-stream round from a stream this process never
+                # served: we restarted without checkpoint state under the
+                # client's feet. Say so in-band (retryable) — the client
+                # adopts a fresh epoch and re-primes in one roundtrip.
+                # Not cached: the client abandons this epoch.
+                from ..metrics import METRICS
+                METRICS.inc("sidecar_epoch_restored_total",
+                            labels={"side": "server"})
+                return (1, _error_payload(
+                    ERR_EPOCH_RESTORED,
+                    f"stream epoch {epoch} unknown after restart; "
+                    f"re-prime with a new epoch"))
+            self._known_epochs.add(epoch)
             try:
                 payload = self.schedule_buffer_pipelined(buf, extras_buf)
                 resp = (0, payload)
@@ -542,6 +598,70 @@ class SchedulerSidecar:
         payload, or None when the pipeline is empty."""
         with self._serve_lock:
             return self._drain_locked()
+
+    # ----------------------------------------- crash-consistent restarts
+    def checkpoint(self, path: str) -> dict:
+        """Serialize the sidecar's host-side truth to ``path`` (atomic
+        tmp+fsync+rename; runtime/checkpoint.py): the VCRQ replay cache
+        and seq watermarks, known stream epochs, the in-flight cycle's
+        decisions, cumulative metrics, and the digest-stamped resident
+        mirrors. The pending cycle is read back early — decision-neutral
+        (its decisions were fixed at dispatch) — and its payload STAGED,
+        both in the checkpoint and in-process, so the client's next round
+        still receives it."""
+        from . import checkpoint as ckpt
+        with self._seq_lock:
+            with self._serve_lock:
+                payload = self._drain_locked()
+                self._staged_payload = payload
+                mirrors = ckpt.mirror_records(self._delta, self._states)
+            state = dict(
+                conf_fingerprint=self._ckpt_fingerprint,
+                round_cache=self._round_cache,
+                rounds_served=self._rounds_served,
+                known_epochs=sorted(self._known_epochs),
+                pending_payload=payload,
+                metrics=ckpt.metrics_snapshot(),
+            )
+        return ckpt.write_checkpoint(path, "sidecar", state,
+                                     mirrors=mirrors)
+
+    def restore(self, path: str) -> str:
+        """Reload a checkpoint into this (fresh) sidecar. Returns the
+        restore-ladder outcome (``restored`` | ``cold`` | ``fallback`` —
+        the latter two leave this process a correct fresh-fuse cold
+        start; clients discover it via ERR_EPOCH_RESTORED and re-prime).
+        On success the replay cache, epoch set, and staged decisions
+        resume the stream exactly where the crash cut it, and each
+        resident mirror is re-verified against its stamped PR 5 digest
+        words before the next dispatch adopts it onto the device."""
+        import time as _time
+        from . import checkpoint as ckpt
+        t0 = _time.time()
+        with _spans.span("cycle.restore", cat="recovery"):
+            env, reason = ckpt.load_checkpoint(path, "sidecar")
+            if env is None:
+                outcome = "cold" if reason == "missing" else "fallback"
+                ckpt.record_restore(outcome, reason, "sidecar",
+                                    (_time.time() - t0) * 1000)
+                return outcome
+            state = env["state"]
+            if state.get("conf_fingerprint") != self._ckpt_fingerprint:
+                ckpt.record_restore("fallback", "conf_mismatch", "sidecar",
+                                    (_time.time() - t0) * 1000)
+                return "fallback"
+            with self._seq_lock:
+                with self._serve_lock:
+                    self._round_cache = state["round_cache"]
+                    self._rounds_served = int(state["rounds_served"])
+                    self._known_epochs = set(state["known_epochs"])
+                    self._staged_payload = state["pending_payload"]
+                    self._restored_mirrors = ckpt.verify_mirrors(
+                        env.get("mirrors"))
+                    ckpt.merge_metrics(state.get("metrics"))
+        ckpt.record_restore("restored", "ok", "sidecar",
+                            (_time.time() - t0) * 1000)
+        return "restored"
 
     def wait_idle(self) -> bool:
         """Block until the in-flight pipelined cycle's device work is done
@@ -816,7 +936,28 @@ class SidecarClient:
         frame, maps = self._snapshot_frame(
             ci, SEQ_PIPELINE_MAGIC,
             header=_u32.pack(self._epoch) + _u32.pack(self._seq))
-        payload = self._roundtrip(frame)
+        try:
+            payload = self._roundtrip(frame)
+        except SidecarError as e:
+            if e.code != ERR_EPOCH_RESTORED:
+                raise
+            # the server restarted without our stream's state: adopt a
+            # fresh epoch and re-prime with this same snapshot NOW — one
+            # extra roundtrip per restart instead of an error surfaced to
+            # the caller or a timeout discovery. The in-flight cycle's
+            # decisions died with the old server (drain-on-reconnect).
+            from ..metrics import METRICS
+            METRICS.inc("sidecar_epoch_restored_total",
+                        labels={"side": "client"})
+            self._epoch = ((__import__("os").getpid() << 16)
+                           ^ next(_CLIENT_EPOCHS)) & 0xFFFFFFFF
+            self._seq = 1
+            frame, maps = self._snapshot_frame(
+                ci, SEQ_PIPELINE_MAGIC,
+                header=_u32.pack(self._epoch) + _u32.pack(self._seq))
+            self._roundtrip(frame)
+            self._pipeline_maps = maps
+            return None
         prev_maps, self._pipeline_maps = self._pipeline_maps, maps
         T, J = struct.unpack("<II", payload[4:12])
         if prev_maps is None or (T == 0 and J == 0):
@@ -852,6 +993,17 @@ def main(argv=None) -> int:
     parser.add_argument("--scheduler-conf", default=None,
                         help="policy YAML (conf/*.conf); compiles the full "
                              "session policy into the served program")
+    parser.add_argument("--checkpoint-path", default=None,
+                        help="crash-consistent checkpoint file: restored "
+                             "at startup, written every --checkpoint-every "
+                             "seconds and at clean shutdown")
+    parser.add_argument("--checkpoint-every", type=float, default=30.0,
+                        help="seconds between periodic checkpoints "
+                             "(0 disables the periodic writer)")
+    parser.add_argument("--supervise", type=int, default=0, metavar="N",
+                        help="crash-loop supervisor: restart a crashed "
+                             "serve loop up to N times with capped "
+                             "backoff, restoring from --checkpoint-path")
     args = parser.parse_args(argv)
     conf_text = None
     if args.scheduler_conf:
@@ -861,10 +1013,40 @@ def main(argv=None) -> int:
     # bare-cycle mode (passing both would silently drop the flag otherwise)
     cfg = (None if conf_text is not None
            else AllocateConfig(binpack_weight=args.binpack_weight))
-    server = SidecarServer(args.host, args.port, cfg, conf=conf_text)
-    print(f"sidecar listening on {server.address[0]}:{server.address[1]}")
+
+    def serve_once():
+        server = SidecarServer(args.host, args.port, cfg, conf=conf_text)
+        if args.checkpoint_path:
+            server.sidecar.restore(args.checkpoint_path)
+        stop = threading.Event()
+        if args.checkpoint_path and args.checkpoint_every > 0:
+            def periodic():
+                while not stop.wait(args.checkpoint_every):
+                    try:
+                        server.sidecar.checkpoint(args.checkpoint_path)
+                    except Exception:
+                        pass  # fail-soft: a failed write must not stop serving
+            threading.Thread(target=periodic, daemon=True).start()
+        print(f"sidecar listening on "
+              f"{server.address[0]}:{server.address[1]}")
+        try:
+            server.serve_forever()
+        finally:
+            stop.set()
+            if args.checkpoint_path:  # clean-shutdown checkpoint
+                try:
+                    server.sidecar.checkpoint(args.checkpoint_path)
+                except Exception:
+                    pass
+            server.server_close()
+
     try:
-        server.serve_forever()
+        if args.supervise > 0:
+            from .checkpoint import CrashLoopSupervisor
+            CrashLoopSupervisor(serve_once,
+                                max_restarts=args.supervise).run()
+        else:
+            serve_once()
     except KeyboardInterrupt:
         pass
     return 0

@@ -36,6 +36,10 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="tier-1 smoke: short trace-replay run, "
                              "complete scorecard + drift check required")
+    parser.add_argument("--sharded", action="store_true",
+                        help="run on the node-axis sharded backend (conf "
+                             "sharding: true); decisions must sha-match "
+                             "the unsharded run")
     parser.add_argument("--events", action="store_true",
                         help="include the full event stream in the JSON")
     args = parser.parse_args(argv)
@@ -57,7 +61,8 @@ def main(argv=None) -> int:
     try:
         spec = get_scenario(name)
         result = run_scenario(spec, seed=args.seed, cycles=cycles,
-                              soak=args.soak, drift_check_every=every)
+                              soak=args.soak, drift_check_every=every,
+                              sharded=args.sharded)
     except KeyError as e:
         print(str(e), file=sys.stderr)
         return 2

@@ -129,6 +129,21 @@ _register(WorkloadSpec(
 ))
 
 _register(WorkloadSpec(
+    name="restart-storm",
+    description="Trace-shaped load under a restart storm: the scheduler "
+                "dies every few cycles and warm-restarts from its "
+                "crash-consistent checkpoint; decisions and the "
+                "scorecard must survive every restart.",
+    conf=_BASE_CONF,
+    cycles=48,
+    n_nodes=6,
+    queues=(QueueSpec("batch", 1), QueueSpec("svc", 2)),
+    arrival_rate=0.7,
+    restart_every=6,
+    drift_check_every=16,
+))
+
+_register(WorkloadSpec(
     name="reclaim-pressure",
     description="Over-served greedy queue vs starving weighted queue "
                 "plus a wide high-priority target: reclaim, reserve, "

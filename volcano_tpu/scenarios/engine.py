@@ -311,14 +311,23 @@ def _quality_sample(run: _Run, cluster, cycle: int, binds: int,
 def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
                  cycles: Optional[int] = None, soak: bool = False,
                  observe: bool = True,
-                 drift_check_every: Optional[int] = None) -> ScenarioResult:
+                 drift_check_every: Optional[int] = None,
+                 sharded: bool = False) -> ScenarioResult:
     """Run one named scenario end to end and score it.
 
     ``soak`` stretches the horizon to >= 500 cycles and tightens the
     CPU-oracle drift spot-check interval. ``observe=False`` skips every
     publication (METRICS gauges, the dashboard registry, the JSONL event
     log) and NOTHING else — the on/off decision-sha identity is the
-    scenario layer's purity contract."""
+    scenario layer's purity contract. ``sharded`` runs the scheduler on
+    the node-axis sharded backend (conf ``sharding: true``); decisions
+    must sha-match the unsharded run (tests/test_checkpoint.py pins
+    trace-replay). ``spec.restart_every`` (when > 0) kills the scheduler
+    every N cycles and restores a fresh one from its crash-consistent
+    checkpoint — the restart-storm scenario."""
+    import os
+    import tempfile
+
     from ..chaos.inject import FaultInjector, chaos
     from ..chaos.plan import FaultPlan
     from ..framework.conf import parse_conf
@@ -344,7 +353,7 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
             run.arrival_cycle[uid] = 0
             run.collector.note_arrival(0)
     cluster = FakeCluster(ci)
-    conf = parse_conf(spec.conf)
+    conf = parse_conf(("sharding: true\n" if sharded else "") + spec.conf)
     sched = Scheduler(cluster, conf=conf, pipeline=False)
 
     injector = None
@@ -353,6 +362,10 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
                          per_kind=spec.faults_per_kind)
         injector = FaultInjector(plan)
     drift: List[DriftCheck] = []
+    ckpt_dir = ckpt_path = None
+    if spec.restart_every > 0:
+        ckpt_dir = tempfile.TemporaryDirectory(prefix="vckp-scenario-")
+        ckpt_path = os.path.join(ckpt_dir.name, "sched.vckp")
     ctx = chaos(injector) if injector is not None \
         else contextlib.nullcontext()
     with ctx:
@@ -361,6 +374,16 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
             _complete_jobs(run, cluster, c)
             _inject_arrivals(run, cluster, c)
             _autoscale(run, cluster, c)
+            if ckpt_path and c and c % spec.restart_every == 0:
+                # the restart storm: the scheduler "process" dies between
+                # cycles and a fresh one restores from the last checkpoint
+                # (decision-neutral — truth is the external cluster)
+                sched = Scheduler(cluster, conf=conf, pipeline=False)
+                outcome = sched.restore(ckpt_path, now=vt)
+                run.event(c, "restart", outcome=outcome)
+                if observe:
+                    spans.log_event("scenario_restart", scenario=spec.name,
+                                    seed=seed, cycle=c, outcome=outcome)
             if every and c and c % every == 0:
                 # spot-check BEFORE the cycle: this cycle's arrivals are
                 # still pending, so the compared decision vector carries
@@ -383,6 +406,8 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
             evictions = len(cluster.evictions) - evicts0
             _quality_sample(run, cluster, c, len(new_binds), evictions, ssn)
             _advance_bound_tasks(run, cluster, c)
+            if ckpt_path:
+                sched.checkpoint(ckpt_path, now=vt)
             if observe:
                 spans.log_event("scenario_cycle", scenario=spec.name,
                                 seed=seed, cycle=c, binds=len(new_binds),
@@ -390,6 +415,8 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
                                 jobs=len(cluster.ci.jobs),
                                 nodes=len(cluster.ci.nodes))
 
+    if ckpt_dir is not None:
+        ckpt_dir.cleanup()
     card = run.collector.scorecard(cycles)
     card.event_sha = _sha(run.events)
     card.decisions_sha = _sha(run.digests)

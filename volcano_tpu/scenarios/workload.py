@@ -72,6 +72,10 @@ class WorkloadSpec:
     #: name of a builder in engine._INITIAL_BUILDERS seeding the cluster
     #: with pre-placed work (the reclaim-pressure setup)
     initial: Optional[str] = None
+    #: restart storm: every N cycles the scheduler process "dies" and a
+    #: fresh one restores from its crash-consistent checkpoint
+    #: (runtime/checkpoint.py); 0 = never
+    restart_every: int = 0
     #: CPU-oracle drift spot-check interval (cycles); soak may tighten
     drift_check_every: int = 16
 
