@@ -41,7 +41,8 @@ BENCH_PROBE_TIMEOUT (seconds, default 150), BENCH_PROBE_RETRIES (default
 node-axis sharded-cycle comparison subprocess), BENCH_SKIP_SCENARIOS=1
 (skip the scheduling-quality scenario block; BENCH_SCENARIO_CYCLES sets
 its horizon, default 16), BENCH_SKIP_RESTART=1 (skip the crash-consistent
-checkpoint/restore restart block).
+checkpoint/restore restart block), BENCH_SKIP_FAILOVER=1 (skip the
+warm-standby HA failover block).
 """
 
 from __future__ import annotations
@@ -203,7 +204,9 @@ def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None):
                 ("scenario_drf_share_error",
                  quality.get("scenario_drf_share_error"), False),
                 ("scenario_node_utilization",
-                 quality.get("scenario_node_utilization"), True)):
+                 quality.get("scenario_node_utilization"), True),
+                ("failover_promote_ms_p50",
+                 quality.get("failover_promote_ms_p50"), False)):
             base = parsed.get(key)
             if cur is None or not base or (invert and not cur):
                 continue
@@ -956,6 +959,45 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             restart_block = None
 
+    # ---- warm-standby failover block (volcano_tpu/chaos/failover) --------
+    # The HA probe: leader_kill at all three phases, each promoting the
+    # warm standby fed by checkpoint streaming (runtime/replication.py)
+    # behind a fresh lease-generation fence, verified decision-identical
+    # to the uninterrupted run at a cost of at most one cycle — plus the
+    # split-brain leg whose deposed-leader writes must be fence-rejected
+    # and the partition leg that promotes from stale replicated state and
+    # must still converge. BENCH_SKIP_FAILOVER=1 skips; a probe failure
+    # records null, never kills the bench.
+    failover_block = None
+    if not os.environ.get("BENCH_SKIP_FAILOVER"):
+        try:
+            from volcano_tpu.chaos import run_failover_probe
+            frpt = run_failover_probe(
+                seed=int(os.environ.get("BENCH_CHAOS_SEED", 7)), cycles=8)
+            fsb = frpt.get("split_brain") or {}
+            failover_block = {
+                "decisions_equal_clean": frpt["decisions_equal_clean"],
+                "calm_equal_clean": frpt["calm_equal_clean"],
+                "kills": frpt["kills"],
+                "kill_schedule_sha": frpt["kill_schedule_sha"],
+                "promote_ms_p50": frpt["promote_ms_p50"],
+                "warm_promotions": frpt["warm_promotions"],
+                "cycles_lost": frpt["cycles_lost"],
+                "cycles_to_steady": frpt["cycles_to_steady"],
+                "split_brain_decisions_equal_clean":
+                    fsb.get("decisions_equal_clean"),
+                "fenced_writes_rejected":
+                    fsb.get("fenced_writes_rejected"),
+                "duplicate_binds": fsb.get("duplicate_binds"),
+                "partition_decisions_equal_clean":
+                    (frpt.get("partition") or {}).get(
+                        "decisions_equal_clean"),
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: failover block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            failover_block = None
+
     # ---- multichip sharded-cycle block (volcano_tpu/parallel) ------------
     # The node-axis sharded execution mode (ISSUE 7) measured per device
     # count against the unsharded oracle on identical churned workloads:
@@ -1091,6 +1133,8 @@ tiers:
                         (scenario_block or {}).get("drf_share_error"),
                     "scenario_node_utilization":
                         (scenario_block or {}).get("node_utilization"),
+                    "failover_promote_ms_p50":
+                        (failover_block or {}).get("promote_ms_p50"),
                 })
         except Exception as e:  # noqa: BLE001 — fail-soft contract
             print("bench: regression guard failed: %s: %s"
@@ -1107,6 +1151,7 @@ tiers:
         "telemetry": telemetry_block,
         "robustness": robustness_block,
         "restart": restart_block,
+        "failover": failover_block,
         "multichip": multichip_block,
         "latency_breakdown": latency_block,
         "scenarios": scenario_block,
@@ -1203,6 +1248,17 @@ tiers:
             (restart_block or {}).get("decisions_equal_clean"),
         "restart_cycles_to_steady":
             (restart_block or {}).get("cycles_to_steady"),
+        # failover-quality numbers in the parsed block: promotion latency
+        # and handoff cost over the bench trajectory, baselines for the
+        # regression guard
+        "failover_promote_ms_p50":
+            (failover_block or {}).get("promote_ms_p50"),
+        "failover_cycles_lost":
+            (failover_block or {}).get("cycles_lost"),
+        "failover_decisions_equal_clean":
+            (failover_block or {}).get("decisions_equal_clean"),
+        "failover_fenced_writes_rejected":
+            (failover_block or {}).get("fenced_writes_rejected"),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))

@@ -65,6 +65,17 @@ if [ "${TIER1_SKIP_RESTART:-0}" != "1" ]; then
     env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --restart \
         > /tmp/_t1_restart.json || rrc=$?
 fi
+frc=0
+if [ "${TIER1_SKIP_FAILOVER:-0}" != "1" ]; then
+    # failover smoke (volcano_tpu/chaos/failover): leader_kill at all
+    # three phases, each promoting the warm standby fed by checkpoint
+    # streaming (runtime/replication.py) — the promotion must land warm
+    # (cycles_to_steady == 0), decisions stay sha-identical to the
+    # uninterrupted run costing at most one cycle, and the split-brain
+    # leg's deposed-leader writes are fence-rejected, not applied
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --failover \
+        > /tmp/_t1_failover.json || frc=$?
+fi
 qrc=0
 if [ "${TIER1_SKIP_SCENARIO:-0}" != "1" ]; then
     # scheduling-quality smoke (volcano_tpu/scenarios): a short seeded
@@ -85,6 +96,9 @@ if [ $crc -ne 0 ]; then
 fi
 if [ $rrc -ne 0 ]; then
     exit $rrc
+fi
+if [ $frc -ne 0 ]; then
+    exit $frc
 fi
 if [ $qrc -ne 0 ]; then
     exit $qrc

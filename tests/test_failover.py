@@ -1,0 +1,350 @@
+"""Warm-standby HA: checkpoint streaming, lease-fenced failover (ISSUE 11).
+
+- Delta records are bit-exact (NaN payloads, -0.0) and reconstruct the
+  full mirror; unchanged mirrors ship nothing.
+- The standby verifies every record's integrity digest before adopting
+  it, applies envelopes atomically, and answers gap/invalid so the
+  sender repairs with one full resync; a LOST envelope needs no repair.
+- The fencing token: writes stamped with a superseded lease generation
+  are rejected structurally — no split-brain double-bind — and the
+  promotion announces the new fence before the first write.
+- A follower scheduler refuses to dispatch; the promotion ladder lands
+  warm/cold/fallback; the failover probe proves decision identity at
+  every kill phase (slow tail — tier1.sh runs the same probe as the
+  failover smoke on every tier-1 invocation).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from volcano_tpu.chaos import FaultInjector, FaultPlan, chaos
+from volcano_tpu.chaos.plan import Fault
+from volcano_tpu.metrics import METRICS
+from volcano_tpu.ops.fused_io import host_digest
+from volcano_tpu.runtime import checkpoint as ckpt
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.leader import DEFAULT_LEASE_DURATION, LeaderElector
+from volcano_tpu.runtime.replication import (REPL_KIND, WarmStandby,
+                                             apply_delta, delta_record,
+                                             replica_pair)
+from volcano_tpu.runtime.scheduler import Scheduler
+from volcano_tpu.runtime.system import VolcanoSystem
+from volcano_tpu.framework.session import BindIntent
+
+from test_delta_pipeline import PARITY_CONF
+from test_runtime_incremental import build_cluster, churn
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _mirror(*vals, dtype=np.float32):
+    return (np.array(vals, dtype=dtype),)
+
+
+# --------------------------------------------------------- delta records
+class TestDeltaRecords:
+    def test_full_copy_without_base_then_delta(self):
+        cur = _mirror(1.0, 2.0, 3.0)
+        rec = delta_record(("k",), None, cur, [1, 2, 3])
+        assert rec["mirror"] is not None and rec["delta"] is None
+        nxt = _mirror(1.0, 9.0, 3.0)
+        rec2 = delta_record(("k",), cur, nxt, [4, 5, 6])
+        assert rec2["mirror"] is None
+        (idx, vals), = rec2["delta"]
+        assert idx.tolist() == [1]
+        out = apply_delta(cur, rec2["delta"])
+        np.testing.assert_array_equal(out[0], nxt[0])
+
+    def test_unchanged_mirror_ships_nothing(self):
+        cur = _mirror(1.0, 2.0)
+        assert delta_record(("k",), cur, _mirror(1.0, 2.0), [0, 0, 0]) \
+            is None
+
+    def test_nan_payloads_and_negative_zero_roundtrip_bitexact(self):
+        """The diff/apply path works on u32 views: a NaN position is
+        neither eternally re-sent (NaN != NaN would re-flag it) nor
+        flattened to a canonical NaN; -0.0 survives its sign."""
+        base = _mirror(0.0, 1.0, 2.0)
+        nan_payload = np.array([np.float32(np.nan)], np.float32)
+        nan_payload.view(np.uint32)[0] |= 0x1234        # non-canonical NaN
+        cur = (np.array([-0.0, nan_payload[0], 2.0], np.float32),)
+        rec = delta_record(("k",), base, cur, [0, 0, 0])
+        out = apply_delta(base, rec["delta"])
+        np.testing.assert_array_equal(out[0].view(np.uint32),
+                                      cur[0].view(np.uint32))
+        # the NaN position is now identical bits: no further edits
+        assert delta_record(("k",), out, cur, [0, 0, 0]) is None
+
+    def test_shape_change_falls_back_to_full_copy(self):
+        rec = delta_record(("k",), _mirror(1.0, 2.0),
+                           _mirror(1.0, 2.0, 3.0), [0, 0, 0])
+        assert rec["mirror"] is not None and rec["delta"] is None
+
+
+# ------------------------------------------------------- standby apply
+def _envelope(mirror, seq=1, since=0, digest=None, state=None):
+    return {"kind": REPL_KIND, "seq": seq, "since": since,
+            "state": state or {"cycles": 1},
+            "mirrors": [{"key": ("k",), "mirror": mirror, "delta": None,
+                         "digest": (digest if digest is not None else
+                                    [int(x) for x in host_digest(mirror)])}],
+            "digest_words": [0, 0, 0]}
+
+
+class TestWarmStandbyApply:
+    def test_wrong_kind_is_invalid(self):
+        assert WarmStandby().apply({"kind": "nope"}) == "invalid"
+
+    def test_since_mismatch_is_gap(self):
+        sb = WarmStandby()
+        assert sb.apply(_envelope(_mirror(1.0), seq=5, since=4)) == "gap"
+        assert sb.applied_seq == 0
+
+    def test_tampered_digest_refused_atomically(self):
+        sb = WarmStandby()
+        assert sb.apply(_envelope(_mirror(1.0, 2.0))) == "applied"
+        before = METRICS.counter_value("replication_mirror_invalid_total")
+        bad = _envelope(_mirror(9.0, 9.0), seq=2, since=1,
+                        digest=[1, 2, 3])
+        assert sb.apply(bad) == "invalid"
+        assert METRICS.counter_value(
+            "replication_mirror_invalid_total") == before + 1
+        # nothing adopted: position and mirrors unchanged
+        assert sb.applied_seq == 1
+        np.testing.assert_array_equal(
+            sb.mirrors[ckpt._freeze_key(("k",))][0],
+            np.array([1.0, 2.0], np.float32))
+
+    def test_full_resync_replaces_world(self):
+        sb = WarmStandby()
+        sb.apply(_envelope(_mirror(1.0)))
+        stale_key = ckpt._freeze_key(("k",))
+        env = _envelope(_mirror(5.0), seq=7, since=0)
+        env["mirrors"][0]["key"] = ("k2",)
+        env["mirrors"][0]["digest"] = [
+            int(x) for x in host_digest(_mirror(5.0))]
+        assert sb.apply(env) == "applied"
+        assert stale_key not in sb.mirrors          # no lingering keys
+        assert sb.applied_seq == 7
+
+
+# ------------------------------------------------- streaming over a run
+def _ha_sched(cycles=0, pipeline=True):
+    cluster = FakeCluster(build_cluster(n_nodes=8, n_jobs=10))
+    clock = FakeClock()
+    api = VolcanoSystem().api
+    elector = LeaderElector(api, identity="leader-0", clock=clock)
+    elector.tick()
+    sched = Scheduler(cluster, conf=PARITY_CONF, pipeline=pipeline,
+                      elector=elector)
+    sender, standby = replica_pair(sched)
+    for c in range(cycles):
+        clock.now += 1.0
+        sched.run_once(now=1000.0 + c)
+        if pipeline:
+            sched.drain(now=1000.0 + c)
+        assert sender.stream() == "applied"
+        churn(cluster, c, arrivals=True)
+    return cluster, clock, api, sched, sender, standby
+
+
+class TestStreamRepair:
+    def test_steady_stream_applies_and_tracks_seq(self):
+        _, _, _, sched, sender, standby = _ha_sched(cycles=3)
+        assert standby.applied_seq == sender.seq == 3
+        assert standby.state["cycles"] == sched.cycles
+        assert standby.mirrors                      # mirrors replicated
+
+    def test_lost_envelope_needs_no_repair(self):
+        cluster, clock, _, sched, sender, standby = _ha_sched(cycles=2)
+        plan = FaultPlan(seed=1, cycles=8, kinds=())
+        plan.faults = (Fault(kind="replication_partition", cycle=2,
+                             param=0),)
+        inj = FaultInjector(plan)
+        with chaos(inj):
+            inj.begin_cycle(2)
+            clock.now += 1.0
+            sched.run_once(now=1002.0)
+            sched.drain(now=1002.0)
+            assert sender.stream() == "lost"        # dropped at the seam
+            churn(cluster, 2, arrivals=True)
+            inj.begin_cycle(3)
+            clock.now += 1.0
+            sched.run_once(now=1003.0)
+            sched.drain(now=1003.0)
+            # the un-advanced ack base keeps the next delta applicable
+            assert sender.stream() == "applied"
+        assert standby.applied_seq == sender.seq
+        assert [k for _, k, _s in inj.fired] == ["replication_partition"]
+
+    def test_desynced_standby_repaired_with_full_resync(self):
+        _, clock, _, sched, sender, standby = _ha_sched(cycles=2)
+        standby.applied_seq = 99                    # restarted standby
+        clock.now += 1.0
+        sched.run_once(now=1002.0)
+        sched.drain(now=1002.0)
+        assert sender.stream() == "applied"         # gap -> full resend
+        assert standby.applied_seq == sender.seq
+
+
+# ----------------------------------------------------------- the fence
+class TestFencing:
+    def _intent(self, cluster):
+        job = next(iter(cluster.ci.jobs.values()))
+        task = next(t for t in job.tasks.values())
+        node = next(iter(cluster.ci.nodes.values()))
+        return BindIntent(task_uid=task.uid, job_uid=job.uid,
+                          node_name=node.name)
+
+    def test_stale_token_rejected_before_any_validity_check(self):
+        cluster = FakeCluster(build_cluster(n_nodes=4, n_jobs=4))
+        cluster.advance_fence(3)
+        assert cluster.fence_admits(3) and not cluster.fence_admits(2)
+        before = METRICS.counter_total("fenced_writes_rejected_total")
+        intent = self._intent(cluster)
+        assert not cluster.bind(intent, fence=2)
+        assert cluster.fenced_rejections[-1][0] == "bind"
+        assert cluster.fenced_rejections[-1][2:] == (2, 3)
+        assert METRICS.counter_total(
+            "fenced_writes_rejected_total") == before + 1
+        assert not cluster.binds                    # nothing applied
+        # a rejection is permanent for that token; unfenced callers and
+        # the current token still pass the fence
+        assert cluster._check_fence("bind", "t", None)
+        assert cluster._check_fence("bind", "t", 3)
+
+    def test_admission_ratchets_the_fence(self):
+        cluster = FakeCluster(build_cluster(n_nodes=4, n_jobs=4))
+        intent = self._intent(cluster)
+        assert cluster.bind(intent, fence=5)        # admits + ratchets
+        assert cluster.fence_generation == 5
+        from volcano_tpu.framework.session import EvictIntent
+        ev = EvictIntent(task_uid=intent.task_uid, job_uid=intent.job_uid)
+        assert not cluster.evict(ev, fence=4)       # older token: fenced
+        assert cluster.evict(ev, fence=6)
+
+
+# --------------------------------------------- follower + promotion
+class TestFollowerAndPromotion:
+    def test_follower_refuses_to_dispatch(self):
+        cluster, clock, api, sched, sender, standby = _ha_sched(cycles=1)
+        rival = LeaderElector(api, identity="rival", clock=clock)
+        clock.now += DEFAULT_LEASE_DURATION + 1.0
+        assert rival.tick()                         # steals the lease
+        fol0 = METRICS.counter_value("leader_transitions_total",
+                                     {"to": "follower"})
+        assert sched.run_once(now=1001.0) is None   # follower: no cycle
+        assert not sched.elector.is_leader
+        assert METRICS.counter_value(
+            "leader_transitions_total", {"to": "follower"}) == fol0 + 1
+        assert METRICS.gauges.get(("is_leader", "")) == 0
+
+    def test_promote_warm_first_cycle_is_delta(self):
+        cluster, clock, api, sched, sender, standby = _ha_sched(cycles=3)
+        clock.now += DEFAULT_LEASE_DURATION + 1.0
+        el = LeaderElector(api, identity="standby-1", clock=clock)
+        warm0 = METRICS.counter_value("failover_promotions_total",
+                                      {"outcome": "warm"})
+        sched2 = standby.promote(cluster, conf=sched.conf, pipeline=True,
+                                 now=1003.0, elector=el)
+        assert standby.last_outcome == "warm"
+        assert METRICS.counter_value("failover_promotions_total",
+                                     {"outcome": "warm"}) == warm0 + 1
+        assert sched2.cycles == sched.cycles        # counters carried over
+        assert el.generation == 2
+        assert cluster.fence_generation == 2        # fence pre-announced
+        sched2.run_once(now=1003.0)
+        sched2.drain(now=1003.0)
+        snaps = sched2.flight.snapshots()
+        assert snaps and snaps[0]["cycle_kind"] == "delta"
+
+    def test_promote_cold_and_fallback_rungs(self):
+        cluster = FakeCluster(build_cluster(n_nodes=4, n_jobs=4))
+        empty = WarmStandby(conf=PARITY_CONF)
+        empty.promote(cluster, pipeline=False, now=1000.0)
+        assert empty.last_outcome == "cold"
+        _, _, _, sched, sender, standby = _ha_sched(cycles=1)
+        from volcano_tpu.chaos.probe import _PROBE_CONF
+        from volcano_tpu.framework import parse_conf
+        other = parse_conf(_PROBE_CONF)
+        standby.promote(cluster, conf=other, pipeline=False, now=1001.0)
+        assert standby.last_outcome == "fallback"
+
+    def test_deposed_leader_split_brain_writes_fenced(self):
+        """The planted split-brain: the deposed leader survives promotion
+        and replays a write with its stale token — rejected, zero
+        duplicate binds."""
+        cluster, clock, api, sched, sender, standby = _ha_sched(cycles=2)
+        deposed = sched
+        clock.now += DEFAULT_LEASE_DURATION + 1.0
+        el = LeaderElector(api, identity="standby-1", clock=clock)
+        standby.promote(cluster, conf=sched.conf, pipeline=True,
+                        now=1002.0, elector=el)
+        binds0 = list(cluster.binds)
+        task_uid, node = binds0[0]
+        job_uid = next(j.uid for j in cluster.ci.jobs.values()
+                       if task_uid in j.tasks)
+        replay = BindIntent(task_uid=task_uid, job_uid=job_uid,
+                            node_name=node)
+        assert not cluster.bind(replay, fence=deposed.elector.generation)
+        assert cluster.binds == binds0              # no duplicate bind
+        assert cluster.fenced_rejections[-1][1] == task_uid
+
+
+# ------------------------------------------------- the probe (slow tail)
+class TestFailoverProbe:
+    # slow tail (tier-1 budget): tier1.sh runs this EXACT probe with the
+    # same acceptance checks as the failover smoke on every invocation
+    @pytest.mark.slow
+    def test_kill_every_phase_decision_identical(self):
+        from volcano_tpu.chaos import run_failover_probe
+        rpt = run_failover_probe(seed=7, cycles=8)
+        assert rpt["calm_equal_clean"]              # replication invisible
+        assert rpt["decisions_equal_clean"]
+        assert {p for _, p in rpt["kills"]} == {"pre_dispatch",
+                                                "in_flight", "post_drain"}
+        assert rpt["warm_promotions"] == 3
+        assert rpt["cycles_lost"] <= 1
+        assert rpt["cycles_to_steady"] == 0
+        sb = rpt["split_brain"]
+        assert sb["decisions_equal_clean"]
+        assert sb["fenced_writes_rejected"] >= 1
+        assert sb["applied_by_deposed"] == 0
+        assert sb["duplicate_binds"] == 0
+        assert sb["replays_rejected"]
+        assert rpt["partition"]["decisions_equal_clean"]
+        assert rpt["partition"]["envelopes_dropped"] >= 1
+
+    @pytest.mark.slow
+    def test_pallas_interpret_path_identical(self):
+        from volcano_tpu.chaos import run_failover_probe
+        rpt = run_failover_probe(seed=7, cycles=8, use_pallas="interpret",
+                                 partition_leg=False)
+        assert rpt["calm_equal_clean"]
+        assert rpt["decisions_equal_clean"]
+        assert rpt["split_brain"]["decisions_equal_clean"]
+        assert rpt["cycles_to_steady"] == 0
+
+
+# ---------------------------------------------- failover-storm scenario
+class TestFailoverStormScenario:
+    # slow tail (tier-1 budget): two scenario engine runs; the failover
+    # path itself is gated every tier-1 run by the failover smoke
+    @pytest.mark.slow
+    def test_failover_storm_decision_identical_to_calm_run(self):
+        from volcano_tpu.scenarios import get_scenario, run_scenario
+        spec = get_scenario("failover-storm")
+        storm = run_scenario(spec, cycles=18, observe=False)
+        calm = run_scenario(dataclasses.replace(spec, failover_every=0),
+                            cycles=18, observe=False)
+        fo = [e for e in storm.events if e["kind"] == "failover"]
+        assert [e["outcome"] for e in fo] == ["warm"] * 2
+        assert storm.scorecard.decisions_sha == calm.scorecard.decisions_sha

@@ -1,0 +1,141 @@
+"""Leader-election edge cases (ISSUE 11 satellite).
+
+The happy-path election dance lives in test_runtime_aux.py; this suite
+pins the edges the HA failover machinery leans on:
+
+- a lease stolen mid-renew forces an immediate step-down and the deposed
+  replica KEEPS its old fencing token (the fence must reject it),
+- clock skew past renew_deadline steps the leader down even with no
+  rival (the silent-renewal-stall rule),
+- lease transitions and the fencing generation are strictly monotonic
+  across steals and never move on renewals,
+- a replica re-acquires after its rival's lease expires, with a fresh
+  (higher) generation.
+"""
+
+from volcano_tpu.runtime.leader import (DEFAULT_LEASE_DURATION,
+                                        DEFAULT_RENEW_DEADLINE,
+                                        LeaderElector)
+from volcano_tpu.runtime.system import VolcanoSystem
+
+LEASE_KEY = "volcano-system/vc-scheduler"
+
+
+class FakeClock:
+    def __init__(self, now: float = 100.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _pair(events=None):
+    api = VolcanoSystem().api
+    clock = FakeClock()
+    ev = events if events is not None else []
+    a = LeaderElector(api, identity="a", clock=clock,
+                      on_started_leading=lambda: ev.append("a+"),
+                      on_stopped_leading=lambda: ev.append("a-"))
+    b = LeaderElector(api, identity="b", clock=clock,
+                      on_started_leading=lambda: ev.append("b+"),
+                      on_stopped_leading=lambda: ev.append("b-"))
+    return api, clock, a, b
+
+
+class TestLeaseStolenMidRenew:
+    def test_steps_down_and_keeps_old_fencing_token(self):
+        events = []
+        api, clock, a, b = _pair(events)
+        assert a.tick() and a.generation == 1
+        # a rival rewrites the lease out from under the live leader (an
+        # operator force-steal / a partitioned store healing the wrong
+        # way): holder flips while a still believes it leads
+        lease = api.get("leases", LEASE_KEY)
+        lease.holder = "b"
+        lease.renew_time = clock.now
+        lease.transitions += 1
+        lease.generation += 1
+        api.update("leases", lease)
+        clock.now += 1.0
+        assert not a.tick() and not a.is_leader     # immediate step-down
+        # the deposed replica presents its OLD token — that is the whole
+        # point of keeping it: the fence rejects generation 1 < 2
+        assert a.generation == 1
+        assert api.get("leases", LEASE_KEY).generation == 2
+        assert events == ["a+", "a-"]
+
+    def test_stolen_lease_blocks_until_expiry(self):
+        api, clock, a, b = _pair()
+        assert a.tick()
+        assert not b.tick()                          # live lease blocks b
+        clock.now += DEFAULT_LEASE_DURATION - 1.0
+        assert not b.tick()                          # still not expired
+        clock.now += 1.1
+        assert b.tick() and b.is_leader
+        assert b.generation == 2 > a.generation
+
+
+class TestRenewDeadlineSkew:
+    def test_clock_jump_past_renew_deadline_steps_down(self):
+        """A leader whose renewals stalled longer than renew_deadline
+        must step down even though nobody else took the lock — the
+        client-go rule that bounds how stale a leader's view can be."""
+        api, clock, a, _ = _pair()
+        assert a.tick()
+        clock.now += DEFAULT_RENEW_DEADLINE + 0.1    # < lease_duration
+        assert not a.tick() and not a.is_leader
+        # the lease is still ours and unexpired: the NEXT tick re-renews
+        # and resumes leadership — same holder, so no generation bump
+        assert a.tick() and a.is_leader
+        assert a.generation == 1
+
+    def test_skew_past_lease_duration_lets_rival_win(self):
+        api, clock, a, b = _pair()
+        assert a.tick()
+        clock.now += DEFAULT_LEASE_DURATION + 0.1
+        assert b.tick() and b.is_leader              # expired: b takes it
+        assert not a.tick() and not a.is_leader      # a observes the loss
+        assert b.generation == 2 and a.generation == 1
+
+
+class TestMonotonicity:
+    def test_transitions_and_generation_strictly_increase(self):
+        api, clock, a, b = _pair()
+        seen_gen, seen_tr = [], []
+        holders = (a, b, a, b)
+        for el in holders:
+            clock.now += DEFAULT_LEASE_DURATION + 1.0
+            assert el.tick() and el.is_leader
+            lease = api.get("leases", LEASE_KEY)
+            seen_gen.append(lease.generation)
+            seen_tr.append(lease.transitions)
+        assert seen_gen == sorted(set(seen_gen))     # strictly increasing
+        assert seen_tr == sorted(set(seen_tr))
+        assert seen_gen[-1] == len(holders)          # one bump per steal
+
+    def test_renew_never_bumps_generation_or_transitions(self):
+        api, clock, a, _ = _pair()
+        assert a.tick()
+        for _ in range(5):
+            clock.now += 1.0
+            assert a.tick()                          # renewals
+        lease = api.get("leases", LEASE_KEY)
+        assert lease.generation == 1 and lease.transitions == 0
+
+
+class TestReacquireAfterRivalExpiry:
+    def test_original_leader_wins_back_with_fresh_token(self):
+        events = []
+        api, clock, a, b = _pair(events)
+        assert a.tick()
+        clock.now += DEFAULT_LEASE_DURATION + 1.0
+        assert b.tick()                              # b steals (gen 2)
+        clock.now += 1.0
+        assert not a.tick()                          # a steps down
+        # b dies (never renews); its lease expires and a wins it back
+        clock.now += DEFAULT_LEASE_DURATION + 1.0
+        assert a.tick() and a.is_leader
+        assert a.generation == 3                     # fresh fencing token
+        lease = api.get("leases", LEASE_KEY)
+        assert lease.holder == "a" and lease.transitions == 2
+        assert events == ["a+", "b+", "a-", "a+"]

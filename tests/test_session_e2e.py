@@ -430,7 +430,8 @@ class TestResyncRetry:
         c = Never()
         q.add(BindIntent("t", "j", "n"), "bind", now=0.0)
         assert q.process(c, now=0.5) == dict(retried=0, succeeded=0,
-                                             dropped=0, dead_lettered=0)
+                                             dropped=0, dead_lettered=0,
+                                             fenced=0)
         assert q.process(c, now=1.0)["retried"] == 1      # after base delay
         # second attempt backs off exponentially (2s, not 1s)
         assert q.process(c, now=2.0)["retried"] == 0

@@ -20,6 +20,15 @@ Three pieces:
   matches the uninterrupted run — tier-1 smoke
   ``python -m volcano_tpu.chaos --smoke --restart`` and bench.py's
   ``restart`` block.
+- :mod:`.failover` — :func:`run_failover_probe`: the HA storm
+  (ISSUE 11): ``leader_kill`` at every phase promotes the warm standby
+  (:mod:`..runtime.replication`) and the run must stay decision-
+  identical costing at most one cycle; ``split_brain`` lets the deposed
+  leader flush late and every write must bounce off the lease-
+  generation fence; ``replication_partition`` drops stream envelopes
+  and the stale promotion must self-heal — tier-1 smoke
+  ``python -m volcano_tpu.chaos --smoke --failover`` and bench.py's
+  ``failover`` block.
 
 The hardening the faults exercise lives where it belongs: the in-graph
 integrity digest and mirror-rebuild recovery in :mod:`..ops.fused_io`,
@@ -31,6 +40,7 @@ in :mod:`..runtime.sidecar` — see docs/architecture.md "Fault tolerance
 
 from __future__ import annotations
 
+from .failover import run_failover_probe
 from .inject import (KILL_PHASES, ChaosError, FaultInjector, active, chaos,
                      install, seam, uninstall)
 from .plan import FAULT_KINDS, RECOVERABLE_KINDS, Fault, FaultPlan
@@ -41,4 +51,5 @@ __all__ = [
     "FAULT_KINDS", "RECOVERABLE_KINDS", "KILL_PHASES", "Fault", "FaultPlan",
     "FaultInjector", "ChaosError", "seam", "active", "install",
     "uninstall", "chaos", "run_chaos_probe", "run_restart_probe",
+    "run_failover_probe",
 ]

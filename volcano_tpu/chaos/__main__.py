@@ -14,6 +14,13 @@ the crash-consistent checkpoint, and verify the applied-decision log
 matches the uninterrupted run — including a corrupt-checkpoint leg that
 must land on the ``fallback`` ladder rung and STILL finish identical.
 
+``--failover`` runs the HA smoke instead (chaos/failover.py): kill the
+leader at all three phases, promote the warm standby each time, and verify
+the promotion lands warm (``cycles_to_steady == 0``), the decisions stay
+sha-identical to the uninterrupted run at a cost of at most one cycle, and
+the split-brain leg's deposed-leader writes are rejected by the fencing
+token — not applied.
+
 Exit 0 on success, 1 on any violated claim, 2 on harness error. The JSON
 report prints either way so CI logs carry the evidence.
 """
@@ -55,6 +62,51 @@ def _restart_smoke(args) -> int:
     return 0 if ok else 1
 
 
+def _failover_smoke(args) -> int:
+    from .failover import run_failover_probe
+    try:
+        report = run_failover_probe(seed=args.seed,
+                                    cycles=max(args.cycles, 8))
+    except Exception as e:  # harness failure, not a chaos verdict
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    print(json.dumps(report, indent=2, default=str))
+    sb = report.get("split_brain") or {}
+    part = report.get("partition") or {}
+    ok = (report["calm_equal_clean"]
+          and report["decisions_equal_clean"]
+          and len({p for _, p in report["kills"]}) >= 3
+          and report["cycles_lost"] <= 1
+          and report["cycles_to_steady"] == 0
+          and sb.get("decisions_equal_clean", False)
+          and sb.get("fenced_writes_rejected", 0) >= 1
+          and sb.get("applied_by_deposed", 1) == 0
+          and sb.get("duplicate_binds", 1) == 0
+          and part.get("decisions_equal_clean", False))
+    if not ok:
+        print("failover smoke FAILED: "
+              + ("replication was not decision-invisible; "
+                 if not report["calm_equal_clean"] else "")
+              + ("decision log diverged from the clean run; "
+                 if not report["decisions_equal_clean"] else "")
+              + ("not every kill phase exercised; "
+                 if len({p for _, p in report["kills"]}) < 3 else "")
+              + ("failover cost more than one cycle; "
+                 if report["cycles_lost"] > 1 else "")
+              + ("promotion landed cold, not warm; "
+                 if report["cycles_to_steady"] != 0 else "")
+              + ("split-brain leg diverged; "
+                 if not sb.get("decisions_equal_clean", False) else "")
+              + ("deposed leader's writes were not fence-rejected; "
+                 if sb.get("fenced_writes_rejected", 0) < 1
+                 or sb.get("applied_by_deposed", 1) != 0
+                 or sb.get("duplicate_binds", 1) != 0 else "")
+              + ("partition leg diverged"
+                 if not part.get("decisions_equal_clean", False) else ""),
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="chaos smoke: seeded fault storm + recovery check")
@@ -72,9 +124,16 @@ def main(argv=None) -> int:
                         help="run the restart smoke: process_kill at "
                              "every phase, checkpoint restore, decision "
                              "identity vs the uninterrupted run")
+    parser.add_argument("--failover", action="store_true",
+                        help="run the HA smoke: leader_kill at every "
+                             "phase, warm-standby promotion, fence-"
+                             "rejected split-brain writes, decision "
+                             "identity vs the uninterrupted run")
     args = parser.parse_args(argv)
     if args.restart:
         return _restart_smoke(args)
+    if args.failover:
+        return _failover_smoke(args)
     from . import run_chaos_probe
     try:
         report = run_chaos_probe(seed=args.seed, cycles=args.cycles,

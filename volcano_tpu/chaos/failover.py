@@ -1,0 +1,360 @@
+"""Failover probe: leader_kill -> warm-standby promotion -> decision identity.
+
+The HA analog of :mod:`.restart`: a clean single-replica run is compared
+against the identical run served by an HA replica pair (leader with a
+``LeaderElector`` + a ``WarmStandby`` fed by checkpoint streaming,
+runtime/replication.py) interrupted by ``leader_kill`` faults at three
+distinct cycle phases —
+
+- ``pre_dispatch``  — leader death between cycles; nothing in flight,
+- ``in_flight``     — death with a dispatched-but-undrained pipelined
+                      cycle; its decisions die with the leader and the
+                      promoted standby re-decides them identically from
+                      the same external truth (the one cycle a failover
+                      may cost),
+- ``post_drain``    — death after the cycle's decisions reached the
+                      (external, leader-surviving) cluster truth; the
+                      promoted standby re-runs the cycle as a no-op,
+                      never re-applying.
+
+Each kill discards the leader Scheduler outright (the harness plays the
+OS), advances the shared fake clock past the lease duration so the dead
+leader's lease expires, and promotes the standby: its elector's tick
+wins the lease — bumping the generation, which IS the fencing token —
+and :meth:`WarmStandby.promote` builds the new active scheduler with its
+replicated mirrors adopted, so the first post-failover cycle ships a
+delta (``cycles_to_steady == 0``).
+
+Identity is judged exactly like the restart probe: the ordered log of
+applied bind/evict dispatches plus the final task/podgroup state, sha'd
+and compared against the clean run. Three extra legs:
+
+- ``calm``        — the HA pair runs with NO kill: replication on/off
+                    must be decision-invisible (the graphcheck claim),
+- ``split_brain`` — the deposed leader is kept alive and flushes its
+                    in-flight writes AFTER the promotion; every one must
+                    be rejected by the fencing token (zero duplicate
+                    binds, the applied log unchanged),
+- ``partition``   — ``replication_partition`` drops stream envelopes
+                    before the kill; the standby promotes from
+                    stale-but-intact state and the run must STILL finish
+                    decision-identical (the value diff vs external truth
+                    self-heals staleness, the same principle that makes
+                    a cold promotion decision-correct).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .inject import KILL_PHASES, FaultInjector, chaos, seam
+from .plan import Fault, FaultPlan
+from .probe import _PROBE_CONF, _churn, _small_cluster
+
+#: virtual-clock base for decision timestamps, matching the chaos probe
+_VT = 1000.0
+
+#: default kill matrix: every phase exercised once, spread across the run
+_DEFAULT_KILLS = ((2, "pre_dispatch"), (4, "in_flight"), (6, "post_drain"))
+
+
+class _Clock:
+    """Shared fake monotonic clock for every elector in a probe run —
+    lease expiry is driven by explicit advances, never by wall time."""
+
+    def __init__(self, now: float = 100.0):
+        self.now = float(now)
+
+    def __call__(self) -> float:
+        return self.now
+
+
+def _probe_conf(use_pallas: Optional[str]) -> str:
+    """The probe policy, optionally on the pallas kernel path
+    (``use_pallas: interpret`` runs the same kernel in interpreter mode —
+    any N, CPU-friendly)."""
+    if use_pallas is None:
+        return _PROBE_CONF
+    return f"use_pallas: {use_pallas}\n" + _PROBE_CONF
+
+
+def _instrument(cluster) -> Tuple[List[tuple], List[object]]:
+    """Fence-aware applied-decision log: what the scheduler DID to the
+    external world (the restart probe's wrappers are single-arg; the HA
+    path threads ``fence=`` through, so these accept it). Also keeps
+    every attempted BindIntent — the split-brain leg replays the
+    deposed leader's last one against the advanced fence."""
+    applied: List[tuple] = []
+    intents: List[object] = []
+    orig_bind, orig_evict = cluster.bind, cluster.evict
+
+    def bind(intent, fence=None):
+        intents.append(intent)
+        ok = orig_bind(intent, fence=fence)
+        if ok:
+            applied.append(("bind", intent.task_uid, intent.node_name,
+                            int(getattr(intent, "gpu_index", -1) or 0)))
+        return ok
+
+    def evict(intent, fence=None):
+        ok = orig_evict(intent, fence=fence)
+        if ok:
+            applied.append(("evict", intent.task_uid))
+        return ok
+
+    cluster.bind = bind
+    cluster.evict = evict
+    return applied, intents
+
+
+def _final_state(cluster) -> tuple:
+    ci = cluster.ci
+    tasks = sorted((t.uid, str(t.status), t.node_name or "")
+                   for job in ci.jobs.values()
+                   for t in job.tasks.values())
+    phases = sorted((uid, str(j.pod_group_phase))
+                    for uid, j in ci.jobs.items())
+    return (tasks, phases)
+
+
+def run_failover_probe(seed: int = 7, cycles: int = 8,
+                       pipeline: bool = True,
+                       kills: Optional[Sequence[Tuple[int, str]]] = None,
+                       split_brain_leg: bool = True,
+                       partition_leg: bool = True,
+                       use_pallas: Optional[str] = None
+                       ) -> Dict[str, object]:
+    """Run the probe; returns a JSON-ready failover report.
+
+    ``kills`` is a sequence of (cycle, phase) pairs; the default matrix
+    exercises all three phases. Kill and split-brain schedules are armed
+    through a FaultPlan/FaultInjector (``leader_kill`` / ``split_brain``
+    kinds consumed at the ``harness.failover`` seam), so the fired log
+    and schedule sha follow the replayable-chaos contract."""
+    from ..framework.conf import parse_conf
+    from ..metrics import METRICS
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.leader import DEFAULT_LEASE_DURATION, LeaderElector
+    from ..runtime.replication import replica_pair
+    from ..runtime.scheduler import Scheduler
+    from ..runtime.system import VolcanoSystem
+
+    conf = parse_conf(_probe_conf(use_pallas))
+    base = _small_cluster()
+    kills = tuple(kills) if kills is not None else tuple(
+        (c, p) for c, p in _DEFAULT_KILLS if c < cycles)
+    bad = [p for _, p in kills if p not in KILL_PHASES]
+    if bad:
+        raise ValueError(f"unknown kill phases: {bad}")
+
+    def make_injector(kill_kind: str, kill_list, extra=()):
+        plan = FaultPlan(seed=seed, cycles=cycles, kinds=())
+        plan.faults = tuple(sorted(
+            [Fault(kind=kill_kind, cycle=c,
+                   param=KILL_PHASES.index(p)) for c, p in kill_list]
+            + list(extra),
+            key=lambda f: (f.cycle, f.kind, f.param)))
+        return plan, FaultInjector(plan)
+
+    def run(ha: bool, kill_kind: Optional[str] = None,
+            kill_list=(), extra_faults=()):
+        """One probe timeline. ``ha=False`` is the plain single-replica
+        clean run; ``ha=True`` wires elector + replication, and
+        ``kill_kind`` selects what the armed faults do at the harness
+        seam (``leader_kill``: drop + promote; ``split_brain``: promote
+        AND let the deposed leader flush)."""
+        cluster = FakeCluster(base.clone())
+        applied, intents = _instrument(cluster)
+        clock = _Clock()
+        api = VolcanoSystem().api
+        elector = None
+        if ha:
+            elector = LeaderElector(api, identity="leader-0", clock=clock)
+            elector.tick()          # acquire before the first cycle
+        sched = Scheduler(cluster, conf=conf, pipeline=pipeline,
+                          elector=elector)
+        sender = standby = None
+        if ha:
+            sender, standby = replica_pair(sched, conf)
+        promotions: List[dict] = []
+        split_checks: List[dict] = []
+        standby_n = [0]
+        plan = injector = None
+        if kill_kind is not None:
+            plan, injector = make_injector(kill_kind, kill_list,
+                                           extra_faults)
+        kill_map: Dict[int, List[str]] = {}
+        for c, p in kill_list:
+            kill_map.setdefault(c, []).append(p)
+
+        def kill_promote(phase: str, c: int, keep_deposed: bool):
+            """The leader death + warm-standby promotion. Returns
+            (new_sched, deposed-or-None)."""
+            nonlocal sched, sender, standby
+            deposed = sched
+            # the dead leader stops renewing; its lease must EXPIRE
+            # before the standby's tick can win it (the fencing window)
+            clock.now += DEFAULT_LEASE_DURATION + 1.0
+            standby_n[0] += 1
+            el = LeaderElector(api, identity=f"standby-{standby_n[0]}",
+                               clock=clock)
+            t0 = time.time()
+            sched = standby.promote(cluster, conf=conf, pipeline=pipeline,
+                                    now=_VT + c, elector=el)
+            promote_ms = round((time.time() - t0) * 1000, 3)
+            promotions.append(dict(
+                cycle=c, phase=phase, promote_ms=promote_ms,
+                generation=el.generation,
+                seq=standby.applied_seq))
+            # the promoted leader streams to a FRESH standby; the old
+            # replica object became the leader
+            sender, standby = replica_pair(sched, conf)
+            return deposed if keep_deposed else None
+
+        ctx = chaos(injector) if injector is not None \
+            else contextlib.nullcontext()
+        cycles_lost = 0
+        with ctx:
+            for c in range(cycles):
+                if injector is not None:
+                    injector.begin_cycle(c)
+                clock.now += 1.0
+                deposed = None
+                for phase in ("pre_dispatch",):
+                    if seam("harness.failover", kind=kill_kind,
+                            phase=phase) is not None:
+                        kill_promote(phase, c, keep_deposed=False)
+                out = sched.run_once(now=_VT + c)
+                if pipeline and seam("harness.failover", kind=kill_kind,
+                                     phase="in_flight") is not None:
+                    # the dispatched-but-undrained cycle dies with the
+                    # leader (split_brain: survives IN the deposed
+                    # object, to be flushed late); the promoted standby
+                    # re-decides it from the same truth — the one cycle
+                    # a failover may cost
+                    deposed = kill_promote(
+                        "in_flight", c,
+                        keep_deposed=(kill_kind == "split_brain"))
+                    cycles_lost += 1
+                    out = sched.run_once(now=_VT + c)
+                if pipeline:
+                    sched.drain(now=_VT + c)
+                if deposed is not None:
+                    # split brain: the deposed leader flushes its
+                    # in-flight cycle AFTER the new leader applied its
+                    # re-decision — every write must bounce off the fence
+                    before = (len(applied), len(cluster.binds),
+                              len(cluster.fenced_rejections))
+                    deposed.drain(now=_VT + c)
+                    # ...and its retry loop re-sends the most recent
+                    # bind it ever dispatched, stamped with its stale
+                    # token. The intent itself is perfectly well-formed;
+                    # only the fence stands between it and a double
+                    # bind, so the rejection must be structural.
+                    replay_rejected = None
+                    if intents:
+                        replay_rejected = not cluster.bind(
+                            intents[-1],
+                            fence=deposed.elector.generation)
+                    split_checks.append(dict(
+                        cycle=c,
+                        applied_by_deposed=len(applied) - before[0],
+                        duplicate_binds=len(cluster.binds) - before[1],
+                        fenced_rejections=(len(cluster.fenced_rejections)
+                                           - before[2]),
+                        replay_rejected=replay_rejected,
+                        deposed_generation=deposed.elector.generation,
+                        fence_generation=cluster.fence_generation))
+                if seam("harness.failover", kind=kill_kind,
+                        phase="post_drain") is not None:
+                    # this cycle's decisions already reached external
+                    # truth; the promoted standby re-runs it as a no-op
+                    kill_promote("post_drain", c, keep_deposed=False)
+                    sched.run_once(now=_VT + c)
+                    if pipeline:
+                        sched.drain(now=_VT + c)
+                if sender is not None:
+                    sender.stream()
+                _churn(cluster, c)
+        sha = hashlib.sha256(
+            repr((applied, _final_state(cluster))).encode()).hexdigest()[:16]
+        return dict(sha=sha, promotions=promotions, sched=sched,
+                    plan=plan, injector=injector, cluster=cluster,
+                    split_checks=split_checks, cycles_lost=cycles_lost,
+                    link=(sender.link if sender is not None else None))
+
+    clean = run(ha=False)
+    calm = run(ha=True)
+
+    warm0 = METRICS.counter_value("failover_promotions_total",
+                                  {"outcome": "warm"})
+    kill = run(ha=True, kill_kind="leader_kill", kill_list=kills)
+    promote_ms = sorted(p["promote_ms"] for p in kill["promotions"])
+    kinds = [e.get("cycle_kind") for e in kill["sched"].flight.snapshots()]
+    cycles_to_steady = next(
+        (i for i, k in enumerate(kinds) if k == "delta"), None)
+    report: Dict[str, object] = {
+        "seed": seed,
+        "cycles": cycles,
+        "pipeline": pipeline,
+        "use_pallas": use_pallas,
+        "kills": [[c, p] for c, p in kills],
+        "kill_schedule_sha": kill["plan"].schedule_sha(),
+        "fault_log": [list(f) for f in kill["injector"].fired],
+        "clean_sha": clean["sha"],
+        "calm_sha": calm["sha"],
+        "calm_equal_clean": calm["sha"] == clean["sha"],
+        "decisions_sha": kill["sha"],
+        "decisions_equal_clean": kill["sha"] == clean["sha"],
+        "promotions": kill["promotions"],
+        "promote_ms_p50": (promote_ms[len(promote_ms) // 2]
+                           if promote_ms else None),
+        "warm_promotions": METRICS.counter_value(
+            "failover_promotions_total", {"outcome": "warm"}) - warm0,
+        "cycles_lost": kill["cycles_lost"],
+        "cycles_to_steady": cycles_to_steady,
+        "fenced_writes_rejected": len(
+            kill["cluster"].fenced_rejections),
+    }
+    if split_brain_leg:
+        sb_kills = tuple((c, p) for c, p in kills if p == "in_flight") \
+            or ((min(3, cycles - 1), "in_flight"),)
+        sb = run(ha=True, kill_kind="split_brain", kill_list=sb_kills)
+        checks = sb["split_checks"]
+        report["split_brain"] = {
+            "decisions_sha": sb["sha"],
+            "decisions_equal_clean": sb["sha"] == clean["sha"],
+            "fenced_writes_rejected": sum(
+                ch["fenced_rejections"] for ch in checks),
+            "applied_by_deposed": sum(
+                ch["applied_by_deposed"] for ch in checks),
+            "duplicate_binds": sum(
+                ch["duplicate_binds"] for ch in checks),
+            "replays_rejected": bool(checks) and all(
+                ch["replay_rejected"] is True for ch in checks),
+            "checks": checks,
+        }
+    if partition_leg:
+        # drop stream envelopes on cycles before a late in_flight kill:
+        # the standby promotes from stale-but-intact state and the run
+        # must still finish decision-identical
+        pk = min(max(2, cycles - 2), cycles - 1)
+        drops = tuple(Fault(kind="replication_partition", cycle=c,
+                            param=c) for c in (1, pk - 1) if 0 < c < pk)
+        lost0 = METRICS.counter_value("replication_envelopes_total",
+                                      {"result": "lost"})
+        part = run(ha=True, kill_kind="leader_kill",
+                   kill_list=((pk, "in_flight"),) if pipeline
+                   else ((pk, "pre_dispatch"),),
+                   extra_faults=drops)
+        report["partition"] = {
+            "decisions_sha": part["sha"],
+            "decisions_equal_clean": part["sha"] == clean["sha"],
+            "envelopes_dropped": METRICS.counter_value(
+                "replication_envelopes_total", {"result": "lost"}) - lost0,
+            "promotions": part["promotions"],
+        }
+    return report

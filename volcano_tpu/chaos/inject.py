@@ -35,6 +35,13 @@ seam point              caller
                         (tear down + checkpoint restore), since a real
                         SIGKILL is not an exception the runtime's
                         fail-soft handlers could be allowed to swallow
+``harness.failover``    chaos/failover.py at each kill phase: the harness
+                        polls for an armed ``leader_kill`` or
+                        ``split_brain`` fault and performs the leader
+                        death / deposed-leader write replay itself (same
+                        rationale as ``harness.kill``)
+``replication.send``    runtime/replication.ReplicationLink.deliver
+                        (``replication_partition`` drops the envelope)
 ======================  ====================================================
 
 With no injector installed every seam is a module-global ``None`` check —
@@ -229,6 +236,10 @@ class FaultInjector:
             lease.acquire_time = now
             lease.renew_time = now
             lease.transitions += 1
+            # every holder transition bumps the fencing token (ISSUE 11):
+            # the rival's tenure deposes the elector's generation, so a
+            # re-acquisition after expiry wins a HIGHER one
+            lease.generation += 1
 
     def _on_sidecar_client_send(self, client=None, frame: bytes = b"", **_):
         f = self._take("partial_frame", "sidecar.client_send")
@@ -256,6 +267,28 @@ class FaultInjector:
                                        f"harness.kill:{phase}"))
                     return f
         return None
+
+    def _on_harness_failover(self, kind: Optional[str] = None,
+                             phase: Optional[str] = None, **_):
+        """Consume an armed ``leader_kill`` or ``split_brain`` fault whose
+        param selects ``phase``. Returns the Fault (the failover harness
+        then performs the leader death / the deposed leader's write
+        replay) or None. Only chaos/failover.py calls this seam — the
+        production runtime cannot inject its own death."""
+        with self._lock:
+            for f in self._pool:
+                if f.kind == kind \
+                        and KILL_PHASES[f.param % len(KILL_PHASES)] == phase:
+                    self._pool.remove(f)
+                    self.fired.append((self.cycle, kind,
+                                       f"harness.failover:{phase}"))
+                    return f
+        return None
+
+    def _on_replication_send(self, envelope=None, link=None, **_):
+        if self._take("replication_partition",
+                      "replication.send") is not None:
+            return "drop"
 
     def _on_sidecar_client_recv(self, client=None, **_):
         f = self._take("socket_drop", "sidecar.client_recv")

@@ -35,6 +35,21 @@ Fault kinds (the seams they fire at live in :mod:`.inject`):
                          is not an exception the runtime's fail-soft
                          handlers could be allowed to swallow — so the
                          injector only arms and logs it.
+- ``leader_kill``      — the ACTIVE leader of an HA replica pair dies at
+                         a kill phase (param picks which); the warm
+                         standby wins the lease and promotes
+                         (runtime/replication.py). Harness-performed,
+                         like process_kill (chaos/failover.py).
+- ``split_brain``      — the deposed leader of a failover does NOT know
+                         it lost: it keeps flushing its in-flight writes
+                         after the new leader took over. The fencing
+                         token must reject every one (zero duplicate
+                         binds). Harness-performed.
+- ``replication_partition`` — the leader->standby checkpoint stream
+                         drops one envelope on the floor (the
+                         ``replication.send`` seam); the stream must
+                         self-repair and a later failover must still
+                         promote decision-identically.
 """
 
 from __future__ import annotations
@@ -48,7 +63,8 @@ from typing import Iterable, List, Optional, Tuple
 FAULT_KINDS = (
     "socket_drop", "partial_frame", "backend_loss", "resident_corrupt",
     "mirror_drift", "slow_dispatch", "bind_fail", "evict_fail",
-    "lease_expiry", "process_kill",
+    "lease_expiry", "process_kill", "leader_kill", "split_brain",
+    "replication_partition",
 )
 
 #: kinds whose recovery must keep the decision sequence bit-identical to

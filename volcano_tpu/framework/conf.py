@@ -125,6 +125,13 @@ class SchedulerConfiguration:
     #: the packed node axis (parallel/sharding.mesh_for_nodes). YAML:
     #: top-level ``sharding_devices: 8``.
     sharding_devices: Optional[int] = None
+    #: kernel-path override threaded into AllocateConfig.use_pallas:
+    #: ``true`` compiles the allocate sweep as the pallas kernel,
+    #: ``"interpret"`` runs the same kernel in interpreter mode (any N,
+    #: CPU-friendly — what the chaos/failover probe's second leg uses),
+    #: None (default) keeps the pure-XLA scan. YAML: top-level
+    #: ``use_pallas: interpret``.
+    use_pallas: Optional[object] = None
 
     def plugin_option(self, name: str) -> Optional[PluginOption]:
         for tier in self.tiers:
@@ -179,6 +186,7 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     sc.sharding = bool(data.get("sharding", False))
     sd = data.get("sharding_devices")
     sc.sharding_devices = int(sd) if sd is not None else None
+    sc.use_pallas = data.get("use_pallas")
     raw_actions = data.get("actions", "enqueue, allocate, backfill")
     if isinstance(raw_actions, str):
         sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]

@@ -620,6 +620,8 @@ class Session:
         tdm = self.plugin("tdm")
         return AllocateConfig(telemetry=bool(getattr(self.conf, "telemetry",
                                                      False)),
+                              use_pallas=getattr(self.conf, "use_pallas",
+                                                 None),
                               enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,
                               enable_host_ports=enable_ports,

@@ -126,6 +126,36 @@ _HELP = {
     "quality_drift_failures":
         "Soak-mode CPU-oracle drift spot-checks where compiled decisions "
         "diverged from the oracle (must stay 0)",
+    # high availability: leader election, lease fencing, checkpoint
+    # streaming, warm-standby failover (runtime/{leader,replication}.py)
+    "leader_transitions_total":
+        "Leadership transitions observed by this scheduler, by "
+        "destination role (to=leader / to=follower)",
+    "is_leader":
+        "1 while this scheduler holds the leader lease, else 0",
+    "fenced_writes_rejected_total":
+        "Bind/evict writes rejected because they carried a superseded "
+        "lease-generation fencing token (a deposed leader's late "
+        "writes), by kind",
+    "replication_envelopes_total":
+        "Checkpoint-stream envelopes by delivery result (applied / lost "
+        "/ resync_gap / resync_invalid / resync_applied ...)",
+    "replication_mirror_invalid_total":
+        "Streamed mirror records the standby refused because their "
+        "integrity digest did not match (never adopted)",
+    "replication_lag_seq":
+        "Envelopes the warm standby lags behind the leader's stream "
+        "(0 in the steady state)",
+    "failover_promotions_total":
+        "Warm-standby promotions by ladder rung: warm (replicated "
+        "state + mirrors adopted), cold (nothing replicated), fallback "
+        "(conf-fingerprint mismatch, fresh cold start)",
+    "sidecar_failovers_total":
+        "Sidecar client reconnects that landed on a DIFFERENT endpoint "
+        "of the replica set (each costs one pipeline re-prime)",
+    "sidecar_not_leader_total":
+        "Sidecar rounds rejected with ERR_NOT_LEADER because their "
+        "fencing token was superseded",
 }
 
 

@@ -157,6 +157,13 @@ def build_page(system, now: Optional[float] = None) -> Page:
                         "Event sha"],
             "rows": rows}
 
+    # ---- high availability (leader lease / replication / failover) ------
+    ha_rows = _ha_rows()
+    if ha_rows:
+        page.tables["ha"] = {
+            "headers": ["Signal", "Value"],
+            "rows": ha_rows}
+
     # ---- latency breakdown (span rings) + pipeline occupancy -------------
     stats = _spans.phase_stats()
     if stats:
@@ -180,6 +187,40 @@ def build_page(system, now: Optional[float] = None) -> Page:
                             "Bubble ms", "Overlap fraction"],
                 "rows": occ_rows}
     return page
+
+
+def _ha_rows():
+    """The high-availability surface: leader lease state, checkpoint-
+    stream health, failover ladder outcomes, fence rejections. Empty
+    (table omitted) until any HA signal has ever fired — a single-replica
+    deployment's dashboard stays unchanged."""
+    g = METRICS.gauges
+    rows = [
+        ["is_leader", g.get(("is_leader", ""), "-")],
+        ["leader transitions (to leader)", METRICS.counter_value(
+            "leader_transitions_total", {"to": "leader"})],
+        ["leader transitions (to follower)", METRICS.counter_value(
+            "leader_transitions_total", {"to": "follower"})],
+        ["replication envelopes applied", METRICS.counter_value(
+            "replication_envelopes_total", {"result": "applied"})],
+        ["replication envelopes lost", METRICS.counter_value(
+            "replication_envelopes_total", {"result": "lost"})],
+        ["replication lag (seq)", g.get(("replication_lag_seq", ""), "-")],
+        ["promotions (warm)", METRICS.counter_value(
+            "failover_promotions_total", {"outcome": "warm"})],
+        ["promotions (cold)", METRICS.counter_value(
+            "failover_promotions_total", {"outcome": "cold"})],
+        ["promotions (fallback)", METRICS.counter_value(
+            "failover_promotions_total", {"outcome": "fallback"})],
+        ["fenced writes rejected", METRICS.counter_total(
+            "fenced_writes_rejected_total")],
+        ["sidecar endpoint failovers", METRICS.counter_value(
+            "sidecar_failovers_total")],
+        ["sidecar rounds fenced (ERR_NOT_LEADER)", METRICS.counter_value(
+            "sidecar_not_leader_total")],
+    ]
+    live = any(v not in ("-", 0.0) for _, v in rows)
+    return rows if live else []
 
 
 def _scenario_results():

@@ -23,6 +23,15 @@ class FakeCluster:
         self.ci = ci or ClusterInfo()
         self.binds: List[Tuple[str, str]] = []      # (task uid, node)
         self.evictions: List[str] = []              # task uid
+        # HA fencing (ISSUE 11): the highest lease generation any writer
+        # has presented. A bind/evict stamped with an OLDER token comes
+        # from a deposed leader — reject it structurally (the split-brain
+        # window can never double-bind). None-fenced writes (tests, the
+        # single-replica loop) bypass the check entirely.
+        self.fence_generation: int = 0
+        #: rejected stale writes, for assertions: (kind, task_uid,
+        #: presented_generation, fence_generation)
+        self.fenced_rejections: List[Tuple[str, str, int, int]] = []
         self.bind_failures: Dict[str, str] = {}     # task uid -> error to inject
         self.volume_bind_failures: set = set()      # claim names failing
         #                                             BindVolumes at dispatch
@@ -69,8 +78,43 @@ class FakeCluster:
         self.structural = False
         return dj, dn, st
 
+    # -------------------------------------------------------------- fencing
+    def advance_fence(self, generation: Optional[int]) -> None:
+        """Explicit fence announcement — the promoted leader's FIRST act
+        (runtime/replication.WarmStandby.promote). Ratchets the fence
+        without a data write, closing the window where a deposed leader's
+        late write could land before the new leader's first bind."""
+        if generation is not None:
+            self.fence_generation = max(self.fence_generation,
+                                        int(generation))
+
+    def fence_admits(self, generation: Optional[int]) -> bool:
+        """Read-only fence probe: would a write stamped ``generation`` be
+        admitted right now? (None = unfenced caller, always admitted.)"""
+        return generation is None or generation >= self.fence_generation
+
+    def _check_fence(self, kind: str, task_uid: str,
+                     generation: Optional[int]) -> bool:
+        """Admit-or-reject a fenced write. Admission ratchets the fence
+        forward (the new leader's first write deposes every older token);
+        rejection is counted and logged — it is a permanent verdict for
+        that token, not a retryable flake."""
+        if generation is None:
+            return True
+        if generation < self.fence_generation:
+            from ..metrics import METRICS
+            METRICS.inc("fenced_writes_rejected_total",
+                        labels={"kind": kind})
+            self.fenced_rejections.append(
+                (kind, task_uid, int(generation),
+                 int(self.fence_generation)))
+            return False
+        self.fence_generation = int(generation)
+        return True
+
     # ----------------------------------------------------------- bind/evict
-    def bind(self, intent: BindIntent) -> bool:
+    def bind(self, intent: BindIntent,
+             fence: Optional[int] = None) -> bool:
         """Apply a bind: task becomes Bound on the node (defaultBinder.Bind,
         cache.go:123-143). Injectable failures exercise the resync path: a
         string value fails every attempt, an int value fails that many
@@ -78,6 +122,8 @@ class FakeCluster:
         # fault-injection seam: a chaos bind_fail fault is a one-shot API
         # rejection, landing the intent in the scheduler's resync path
         if seam("cluster.bind", intent=intent) == "fail":
+            return False
+        if not self._check_fence("bind", intent.task_uid, fence):
             return False
         fail = self.bind_failures.get(intent.task_uid)
         if fail is not None:
@@ -136,10 +182,13 @@ class FakeCluster:
             self.dirty_nodes.add(removed_from.name)
         return True
 
-    def evict(self, intent: EvictIntent) -> bool:
+    def evict(self, intent: EvictIntent,
+              fence: Optional[int] = None) -> bool:
         """Apply an eviction: task goes back to Pending off-node
         (defaultEvictor.Evict, cache.go:145-175)."""
         if seam("cluster.evict", intent=intent) == "fail":
+            return False
+        if not self._check_fence("evict", intent.task_uid, fence):
             return False
         job = self.ci.jobs.get(intent.job_uid)
         if job is None:

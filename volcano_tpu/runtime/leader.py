@@ -6,7 +6,19 @@ against a coordination lease so only one replica acts at a time
 server.go:78-120, client-go leaderelection).  Here the lock object lives in
 the in-memory API server's ``leases`` store; replicas call :meth:`tick`
 periodically (the retry loop) and consult :attr:`is_leader` before running
-their cycle.  Timing is injectable so tests drive expiry deterministically.
+their cycle.  Timing is injectable so tests drive expiry deterministically;
+the default is :func:`time.monotonic` — lease arithmetic is pure intervals,
+and a wall clock stepping backwards (NTP slew) must never un-expire a
+lease.
+
+HA fencing (ISSUE 11): every holder transition bumps the lease's
+``generation`` — a monotonically increasing fencing token. The current
+leader threads its generation into every external write (cluster
+bind/evict, sidecar rounds); the write target rejects any token below the
+highest it has seen, so a deposed leader's in-flight writes land as
+structured rejections (``ERR_NOT_LEADER`` / ``fenced_writes_rejected``)
+instead of split-brain double-binds. See docs/architecture.md "High
+availability & failover".
 """
 
 from __future__ import annotations
@@ -32,6 +44,10 @@ class Lease:
     renew_time: float = 0.0
     lease_duration: float = DEFAULT_LEASE_DURATION
     transitions: int = 0
+    #: fencing token: strictly increases on every holder transition
+    #: (acquire, steal, re-acquire). Writes stamped with an older
+    #: generation are stale by construction and must be rejected.
+    generation: int = 0
 
     def expired(self, now: float) -> bool:
         return now >= self.renew_time + self.lease_duration
@@ -60,9 +76,13 @@ class LeaderElector:
     retry_period: float = DEFAULT_RETRY_PERIOD
     on_started_leading: Optional[Callable[[], None]] = None
     on_stopped_leading: Optional[Callable[[], None]] = None
-    clock: Callable[[], float] = time.time
+    clock: Callable[[], float] = time.monotonic
     is_leader: bool = field(default=False, init=False)
     _last_renew: float = field(default=0.0, init=False)
+    #: the generation of the last lease this replica HELD — its fencing
+    #: token. Deliberately kept after a step-down: a deposed leader's
+    #: late writes must present the OLD token so the fence rejects them.
+    generation: int = field(default=0, init=False)
 
     @property
     def _key(self) -> str:
@@ -83,9 +103,10 @@ class LeaderElector:
         if lease is None:
             lease = Lease(name=self.lock_name, namespace=self.namespace,
                           holder=self.identity, acquire_time=now,
-                          renew_time=now, lease_duration=self.lease_duration)
+                          renew_time=now, lease_duration=self.lease_duration,
+                          generation=1)
             self.api.create("leases", lease)
-            self._become_leader(now)
+            self._become_leader(now, lease.generation)
             return True
         if lease.holder == self.identity:
             # Renew; if we could not renew within renew_deadline we must
@@ -96,7 +117,7 @@ class LeaderElector:
             lease.renew_time = now
             self.api.update("leases", lease)
             if not self.is_leader:
-                self._become_leader(now)
+                self._become_leader(now, lease.generation)
             self._last_renew = now
             return True
         if lease.expired(now):
@@ -104,8 +125,9 @@ class LeaderElector:
             lease.acquire_time = now
             lease.renew_time = now
             lease.transitions += 1
+            lease.generation += 1
             self.api.update("leases", lease)
-            self._become_leader(now)
+            self._become_leader(now, lease.generation)
             return True
         if self.is_leader:
             # someone else holds a live lease (we lost it)
@@ -122,9 +144,12 @@ class LeaderElector:
         if self.is_leader:
             self._step_down()
 
-    def _become_leader(self, now: float) -> None:
+    def _become_leader(self, now: float,
+                       generation: Optional[int] = None) -> None:
         self.is_leader = True
         self._last_renew = now
+        if generation is not None:
+            self.generation = int(generation)
         if self.on_started_leading:
             self.on_started_leading()
 

@@ -1,0 +1,355 @@
+"""Warm-standby replication: checkpoint streaming + lease-fenced promotion.
+
+PR 10 made a single process crash-consistent; the runtime was still a
+single point of failure — leader death stops serving until a restart
+finishes. This module closes that gap with an HA replica pair, the
+ROADMAP's "checkpoint streaming to a warm standby with ``LeaderElector``
+handoff so failover costs at most one cycle":
+
+- :class:`ReplicationSender` — the active leader's half. After each
+  cycle it cuts the same (state, mirror-records) envelope a checkpoint
+  file would hold (``Scheduler.checkpoint_state`` — single authority)
+  and streams it as an INCREMENTAL envelope: mirror records become
+  ``since``-sequence deltas — per-buffer (index, value) edits against
+  the last envelope the standby acknowledged — each still stamped with
+  the PR 5 integrity-digest words of the FULL resulting mirror, so the
+  receiving side re-verifies end-state integrity, not just the edits.
+- :class:`WarmStandby` — the passive half: continuously applies
+  envelopes (digest-verified, ``since``/``seq``-disciplined — a gap or
+  tamper is reported back and repaired with a full resync, never
+  silently applied) and keeps a promotion-ready copy of the leader's
+  host truth.
+- :meth:`WarmStandby.promote` — on leader loss the standby wins the
+  lease (its elector's tick past ``lease_duration``; the new lease
+  generation IS the fencing token) and builds a fresh Scheduler whose
+  first ``_open_session`` full pack adopts the replicated mirrors via
+  ``adopt_mirror`` — the first post-failover cycle ships a delta, not a
+  cold upload (``cycles_to_steady == 0``).
+- :class:`ReplicationLink` — the in-memory transport, with the
+  ``replication.send`` chaos seam: a ``replication_partition`` fault
+  drops envelopes on the floor. Loss is tolerated by construction —
+  deltas are built against the last ACKED envelope, so the next
+  envelope still applies cleanly and a kill during the partition
+  promotes from a slightly stale mirror, which the first delta cycle's
+  value diff self-heals against external truth.
+
+Decision correctness never depends on replication: the cluster source is
+external authoritative truth (the PR 10 posture), so a cold or stale
+standby re-fuses from truth and decides identically. Replication buys
+back WARMTH (first cycle on the delta path) and continuity (counters,
+resync retries, dead letters). Everything here is host-side — zero
+in-graph ops — so decisions are bit-identical with replication on or
+off (graphcheck stays CLEAN; chaos/failover.py proves the sha).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import METRICS
+from ..telemetry import spans
+from . import checkpoint as ckpt
+
+#: envelope kind tag — replication envelopes reuse the checkpoint
+#: envelope shape (kind/state/mirrors/digest_words) with stream fields
+REPL_KIND = "scheduler-repl"
+
+
+# ----------------------------------------------------------- delta records
+def _as_flat_u32(buf: np.ndarray) -> np.ndarray:
+    """Bit-level flat view of a 4-byte buffer (f32/i32): delta compare and
+    apply are done on raw bits, so NaN payloads round-trip exactly and a
+    NaN==NaN position is not eternally re-sent."""
+    return buf.reshape(-1).view(np.uint32)
+
+
+def _copy_mirror(mirror) -> Tuple[np.ndarray, ...]:
+    return tuple(np.array(b, copy=True) for b in mirror)
+
+
+def _compatible(prev, cur) -> bool:
+    return (prev is not None and len(prev) == len(cur)
+            and all(p.shape == c.shape and p.dtype == c.dtype
+                    for p, c in zip(prev, cur)))
+
+
+def delta_record(key, prev, cur, digest: List[int]) -> Optional[dict]:
+    """One mirror record for the stream: a full copy when the standby has
+    no compatible base, else per-buffer (index, value) edits. Returns
+    None when nothing changed (the standby's copy is already current).
+    ``digest`` is always the host-digest of the FULL current mirror — the
+    apply side verifies the reconstructed end state, not the edit list."""
+    if not _compatible(prev, cur):
+        return {"key": key, "mirror": _copy_mirror(cur), "delta": None,
+                "digest": digest}
+    edits = []
+    changed = 0
+    for p, c in zip(prev, cur):
+        if p.dtype == np.bool_:
+            pf, cf = p.reshape(-1), c.reshape(-1)
+        else:
+            pf, cf = _as_flat_u32(p), _as_flat_u32(c)
+        idx = np.flatnonzero(pf != cf).astype(np.int32)
+        edits.append((idx, np.array(cf[idx], copy=True)))
+        changed += int(idx.size)
+    if not changed:
+        return None
+    return {"key": key, "mirror": None, "delta": tuple(edits),
+            "digest": digest}
+
+
+def apply_delta(prev, edits) -> Tuple[np.ndarray, ...]:
+    """Rebuild the current mirror from the standby's base copy + edits."""
+    mirror = _copy_mirror(prev)
+    for buf, (idx, vals) in zip(mirror, edits):
+        if idx.size == 0:
+            continue
+        if buf.dtype == np.bool_:
+            buf.reshape(-1)[idx] = vals
+        else:
+            _as_flat_u32(buf)[idx] = vals
+    return mirror
+
+
+# ------------------------------------------------------------ leader half
+class ReplicationSender:
+    """The leader's streaming half: cut an envelope after each cycle and
+    push it down the link; track what the standby ACKED so the next
+    envelope's deltas have the right base (a lost envelope simply leaves
+    the base where it was — the stream self-repairs without a gap)."""
+
+    def __init__(self, scheduler, link: "ReplicationLink"):
+        self.scheduler = scheduler
+        self.link = link
+        self.seq = 0
+        self._acked_seq = 0
+        #: per shape-key copy of the mirror as of the last ACKED envelope
+        self._acked: Dict[tuple, tuple] = {}
+
+    def envelope(self) -> dict:
+        """The next incremental envelope: PR 10's checkpoint shape plus
+        the stream fields (``seq``, ``since``) and delta-form mirrors."""
+        self.seq += 1
+        state, records = self.scheduler.checkpoint_state()
+        mirrors = []
+        for r in records:
+            key = ckpt._freeze_key(r["key"])
+            rec = delta_record(key, self._acked.get(key), r["mirror"],
+                               r["digest"])
+            if rec is not None:
+                mirrors.append(rec)
+        return {
+            "kind": REPL_KIND,
+            "seq": self.seq,
+            "since": self._acked_seq,
+            "state": state,
+            "mirrors": mirrors,
+            "digest_words": ckpt.fold_digest(mirrors),
+        }
+
+    def _ack(self, env: dict) -> None:
+        self._acked_seq = env["seq"]
+        for rec in env["mirrors"]:
+            key = ckpt._freeze_key(rec["key"])
+            if rec["mirror"] is not None:
+                self._acked[key] = _copy_mirror(rec["mirror"])
+            else:
+                self._acked[key] = apply_delta(self._acked[key],
+                                               rec["delta"])
+
+    def stream(self) -> str:
+        """Send one envelope; returns the delivery result
+        (``applied | lost | gap | invalid``). A ``gap`` (standby lost
+        its position) or ``invalid`` (a record failed its digest check)
+        is repaired immediately with one full resync envelope; ``lost``
+        (partition) needs no repair — the un-advanced ack base keeps the
+        next delta applicable."""
+        env = self.envelope()
+        result = self.link.deliver(env)
+        METRICS.inc("replication_envelopes_total",
+                    labels={"result": result})
+        if result == "applied":
+            self._ack(env)
+            return result
+        if result in ("gap", "invalid"):
+            # full resync: forget the acked base so every record ships
+            # whole, and mark since=0 so the standby accepts it at any
+            # position
+            self._acked, self._acked_seq = {}, 0
+            full = self.envelope()
+            full["since"] = 0
+            retry = self.link.deliver(full)
+            METRICS.inc("replication_envelopes_total",
+                        labels={"result": "resync_" + retry})
+            if retry == "applied":
+                self._ack(full)
+            return retry
+        return result
+
+
+# ----------------------------------------------------------- standby half
+class WarmStandby:
+    """The passive replica: applies the leader's envelope stream and holds
+    a promotion-ready copy of its host truth."""
+
+    def __init__(self, conf=None):
+        self.conf = conf
+        self.applied_seq = 0
+        self.state: Optional[dict] = None
+        self.mirrors: Dict[tuple, tuple] = {}
+        self.envelopes_applied = 0
+        self.last_outcome: Optional[str] = None   # set by promote()
+
+    # -------------------------------------------------------------- apply
+    def apply(self, env: dict) -> str:
+        """Apply one envelope. Returns ``applied``, or ``gap`` when the
+        envelope's ``since`` does not match our position (a dropped
+        full-resync or a restarted standby), or ``invalid`` when a record
+        fails its integrity digest — tampered or desynced payloads are
+        counted and NEVER adopted; the sender answers both with a full
+        resync."""
+        if env.get("kind") != REPL_KIND:
+            return "invalid"
+        since = int(env.get("since", 0))
+        if since not in (0, self.applied_seq):
+            return "gap"
+        if since == 0:
+            # full resync replaces our world (mirror keys the leader no
+            # longer tracks must not linger)
+            staged: Dict[tuple, tuple] = {}
+        else:
+            staged = dict(self.mirrors)
+        from ..ops.fused_io import host_digest
+        for rec in env.get("mirrors", []):
+            key = ckpt._freeze_key(rec["key"])
+            if rec.get("mirror") is not None:
+                mirror = _copy_mirror(rec["mirror"])
+            else:
+                base = staged.get(key)
+                if base is None or len(base) != len(rec["delta"]):
+                    # delta against a base we don't hold — our position
+                    # desynced from the sender's ack view
+                    return "gap"
+                mirror = apply_delta(base, rec["delta"])
+            if [int(x) for x in host_digest(mirror)] != list(rec["digest"]):
+                METRICS.inc("replication_mirror_invalid_total")
+                spans.log_event("replication_mirror_invalid")
+                return "invalid"
+            staged[key] = mirror
+        # all records verified: commit atomically (a failed record above
+        # must not leave a half-applied envelope behind)
+        self.mirrors = staged
+        self.state = env["state"]
+        self.applied_seq = int(env["seq"])
+        self.envelopes_applied += 1
+        return "applied"
+
+    @property
+    def lag(self) -> Optional[int]:
+        """Envelopes the standby is behind the last seq it saw applied —
+        0 in the steady state (published as ``replication_lag_seq``)."""
+        return self.applied_seq
+
+    # ------------------------------------------------------------ promote
+    def promote(self, cluster, conf=None, pipeline: bool = True,
+                now: Optional[float] = None, elector=None):
+        """Leader loss: build the new active Scheduler from the replica
+        state. Promotion ladder (``failover_promotions_total``):
+
+        - ``warm``     — replicated state + verified mirrors adopted; the
+                         first cycle ships a delta (cycles_to_steady=0),
+        - ``cold``     — nothing replicated yet: fresh cold start,
+        - ``fallback`` — replicated state was cut under a different conf
+                         fingerprint: refuse it, fresh cold start.
+
+        When ``elector`` is given it is ticked once first — the natural
+        call site is AFTER the dead leader's lease expired, so this tick
+        wins the lease and bumps the generation (the fencing token the
+        promoted scheduler stamps on every write). Returns the new
+        Scheduler."""
+        from .scheduler import Scheduler
+        conf = conf if conf is not None else self.conf
+        t0 = time.time()
+        wall = now if now is not None else t0
+        if elector is not None:
+            elector.tick()
+            # announce the new fencing token to the write target BEFORE
+            # the first cycle: the deposed leader's late writes are
+            # rejected from this instant, not from our first bind
+            if hasattr(cluster, "advance_fence"):
+                cluster.advance_fence(elector.generation)
+        sched = Scheduler(cluster, conf=conf, pipeline=pipeline,
+                          elector=elector)
+        outcome = "warm"
+        st = self.state
+        if st is None:
+            outcome = "cold"
+        elif st.get("conf_fingerprint") != ckpt.conf_fingerprint(conf):
+            outcome = "fallback"
+        if outcome == "warm":
+            sched.cycles = int(st["cycles"])
+            sched.full_packs = int(st["full_packs"])
+            sched.incremental_cycles = int(st["incremental_cycles"])
+            sched.degradation_level = int(st["degradation_level"])
+            sched._degrade_until = int(st["degrade_until"])
+            sched.resync.entries = [dict(e) for e in st["resync_entries"]]
+            sched.resync.dead = [dict(e) for e in st["resync_dead"]]
+            ckpt.merge_metrics(st.get("metrics"))
+            sched._restored_mirrors = {k: m for k, m in
+                                       self.mirrors.items()}
+            # intents stranded by the dead leader get a second life, the
+            # same redrive rule a file restore applies
+            sched.resync.redrive(wall)
+        promote_ms = (time.time() - t0) * 1000
+        #: which ladder rung the promotion landed on, for callers that
+        #: surface it (the failover-storm scenario event)
+        self.last_outcome = outcome
+        METRICS.inc("failover_promotions_total",
+                    labels={"outcome": outcome})
+        METRICS.set_gauge("replication_lag_seq", None, 0)
+        spans.log_event("promotion", outcome=outcome,
+                        seq=self.applied_seq,
+                        mirrors=len(self.mirrors),
+                        leader=bool(elector.is_leader) if elector else None,
+                        generation=(elector.generation if elector
+                                    else None),
+                        promote_ms=round(promote_ms, 3))
+        return sched
+
+
+# --------------------------------------------------------------- transport
+class ReplicationLink:
+    """In-memory leader->standby transport. A real deployment would put a
+    socket here; the protocol contract (deliver -> applied/gap/invalid,
+    loss possible) is what the sender is written against. The
+    ``replication.send`` seam lets chaos drop envelopes
+    (``replication_partition``)."""
+
+    def __init__(self, standby: WarmStandby):
+        self.standby = standby
+        self.delivered = 0
+        self.lost = 0
+
+    def deliver(self, env: dict) -> str:
+        from ..chaos.inject import seam
+        if seam("replication.send", envelope=env, link=self) == "drop":
+            self.lost += 1
+            return "lost"
+        self.delivered += 1
+        result = self.standby.apply(env)
+        METRICS.set_gauge("replication_lag_seq", None,
+                          max(0, int(env["seq"])
+                              - self.standby.applied_seq))
+        return result
+
+
+def replica_pair(scheduler, conf=None) -> Tuple[ReplicationSender,
+                                                WarmStandby]:
+    """Wire a leader scheduler to a fresh warm standby; returns
+    (sender, standby). The caller streams after each drained cycle:
+    ``sender.stream()``."""
+    standby = WarmStandby(conf if conf is not None else scheduler.conf)
+    return ReplicationSender(scheduler, ReplicationLink(standby)), standby

@@ -70,18 +70,30 @@ class ResyncQueue:
             spans.log_event("resync_redrive", count=len(dead))
         return len(dead)
 
-    def process(self, cluster, now: float) -> Dict[str, int]:
+    def process(self, cluster, now: float,
+                fence: Optional[int] = None) -> Dict[str, int]:
         """Retry every due entry against the cluster. Returns counters.
         An entry that exhausts ``max_attempts`` is never dropped silently:
         it moves to the dead-letter list (and a bind additionally resyncs
-        the task back to Pending, the syncTask give-up)."""
+        the task back to Pending, the syncTask give-up). A ``fence`` that
+        the cluster no longer admits (this replica was deposed) drops the
+        due entries outright — a deposed leader must not keep retrying
+        writes the fencing token already rejected."""
         due = [e for e in self.entries if e["next_try"] <= now]
         self.entries = [e for e in self.entries if e["next_try"] > now]
-        stats = dict(retried=0, succeeded=0, dropped=0, dead_lettered=0)
+        stats = dict(retried=0, succeeded=0, dropped=0, dead_lettered=0,
+                     fenced=0)
         for e in due:
+            if fence is not None and not cluster.fence_admits(fence):
+                stats["fenced"] += 1
+                continue
             stats["retried"] += 1
-            ok = (cluster.bind(e["intent"]) if e["kind"] == "bind"
-                  else cluster.evict(e["intent"]))
+            ok = ((cluster.bind(e["intent"], fence=fence)
+                   if e["kind"] == "bind"
+                   else cluster.evict(e["intent"], fence=fence))
+                  if fence is not None
+                  else (cluster.bind(e["intent"]) if e["kind"] == "bind"
+                        else cluster.evict(e["intent"])))
             if ok:
                 stats["succeeded"] += 1
             elif e["attempts"] >= self.max_attempts:
@@ -101,8 +113,18 @@ class Scheduler:
                  conf_path: Optional[str] = None,
                  schedule_period: float = 1.0,
                  incremental: bool = True,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 elector=None):
         self.cluster = cluster
+        # HA leader election (ISSUE 11): when an elector is attached the
+        # scheduler OWNS the leadership check — run_once ticks it, skips
+        # dispatch as a follower (the silent-lease-loss fix: callers no
+        # longer have to poll tick() themselves), surfaces transitions as
+        # leader_transitions_total + a JSONL `leadership` event, and
+        # stamps every cluster write with the lease generation (the
+        # fencing token).
+        self.elector = elector
+        self._was_leader = bool(elector.is_leader) if elector else False
         self.conf_path = conf_path
         self._conf_mtime = 0.0
         self.conf = conf or self._load_conf() or parse_conf()
@@ -269,6 +291,22 @@ class Scheduler:
         # fault-injection seam: arms this cycle's scheduled faults
         from ..chaos.inject import seam
         seam("scheduler.cycle", cycle=self.cycles, scheduler=self)
+        if self.elector is not None:
+            leader = self.elector.tick()
+            if leader != self._was_leader:
+                self._note_leadership(leader)
+            if not leader:
+                # follower: no dispatch, and a cycle left in flight from
+                # our leader tenure is DISCARDED unapplied — its writes
+                # would be fenced off anyway; the new leader re-decides
+                # from the same external truth
+                if self._pending is not None:
+                    self._pending = None
+                    METRICS.inc("cycle_dropped_total")
+                    spans.log_event("leadership", action="pending_dropped",
+                                    identity=self.elector.identity,
+                                    cycle=self.cycles)
+                return None
         # degradation de-escalation probe: after the cooldown window of
         # clean cycles, climb back to the configured mode
         if self.degradation_level and self.cycles >= self._degrade_until:
@@ -281,7 +319,8 @@ class Scheduler:
         # their outcomes (the errTasks worker runs alongside the loop,
         # cache.go:687-709)
         if len(self.resync):
-            rs = self.resync.process(self.cluster, wall)
+            rs = self.resync.process(self.cluster, wall,
+                                     fence=self._fence())
             METRICS.inc("resync_retried", rs["retried"])
             METRICS.inc("resync_succeeded", rs["succeeded"])
             METRICS.inc("resync_dropped", rs["dropped"])
@@ -340,6 +379,29 @@ class Scheduler:
             self._pending = (ssn, pending, time.time() - t0, wall)
             return completed if completed is not None else ssn
         return self._finish_cycle(ssn, time.time() - t0, wall)
+
+    # ------------------------------------------------ HA leadership / fence
+    def _fence(self) -> Optional[int]:
+        """The fencing token this scheduler stamps on cluster writes: the
+        generation of the last lease its elector held. Deliberately NOT
+        refreshed on step-down — a deposed leader keeps presenting its
+        old token so the fence rejects its late writes. None (no elector)
+        keeps every legacy caller unfenced."""
+        return None if self.elector is None else self.elector.generation
+
+    def _note_leadership(self, leader: bool) -> None:
+        """A leadership transition observed by run_once: counter, gauge,
+        and a JSONL ``leadership`` event (the PR 8 event log)."""
+        self._was_leader = leader
+        METRICS.inc("leader_transitions_total",
+                    labels={"to": "leader" if leader else "follower"})
+        METRICS.set_gauge("is_leader", None, 1 if leader else 0)
+        spans.log_event("leadership", leader=leader,
+                        identity=self.elector.identity,
+                        generation=self.elector.generation,
+                        transitions=METRICS.counter_total(
+                            "leader_transitions_total"),
+                        cycle=self.cycles)
 
     # -------------------------------------------- fault handling / ladder
     def _note_fault(self, stage: str, exc: BaseException) -> None:
@@ -443,16 +505,35 @@ class Scheduler:
         with spans.span("cycle.finish"):
             ssn.close()
 
+            fence = self._fence()
+
+            def _fenced_off() -> bool:
+                # the cluster refused our token: this replica was deposed
+                # mid-flight. The rejection is permanent for this token —
+                # never resync it (the new leader owns the decision now).
+                return fence is not None \
+                    and not self.cluster.fence_admits(fence)
+
             # PodGroup status write-back at session close (the jobUpdater's
             # parallel UpdatePodGroup flush, framework/job_updater.go:66-108)
-            self.cluster.update_podgroup_phases(ssn.phase_updates)
+            # — a deposed leader's late flush must not touch phases either
+            if not _fenced_off():
+                self.cluster.update_podgroup_phases(ssn.phase_updates)
 
             for intent in ssn.evictions:
-                if not self.cluster.evict(intent):
+                ok = (self.cluster.evict(intent, fence=fence)
+                      if fence is not None else self.cluster.evict(intent))
+                if not ok:
+                    if _fenced_off():
+                        continue
                     METRICS.inc("resync_tasks")
                     self.resync.add(intent, "evict", wall)
             for intent in ssn.binds:
-                if not self.cluster.bind(intent):
+                ok = (self.cluster.bind(intent, fence=fence)
+                      if fence is not None else self.cluster.bind(intent))
+                if not ok:
+                    if _fenced_off():
+                        continue
                     METRICS.inc("resync_tasks")
                     # hold the Binding state so later cycles don't
                     # re-decide while the rate-limited retry works
@@ -529,6 +610,17 @@ class Scheduler:
         from . import checkpoint as ckpt
         wall = now if now is not None else time.time()
         self._drain_pending(wall)
+        state, mirrors = self.checkpoint_state()
+        return ckpt.write_checkpoint(path, "scheduler", state,
+                                     mirrors=mirrors)
+
+    def checkpoint_state(self) -> tuple:
+        """The (state, mirror records) pair a checkpoint or replication
+        envelope serializes — the single authority for WHAT host-side
+        truth leaves the process. Does NOT drain the pipeline; callers
+        that need the depth-1 drain-first rule (checkpoint files) drain
+        before calling."""
+        from . import checkpoint as ckpt
         mirrors = []
         if self._session is not None:
             # resident mirrors of the persistent session's flat kernels
@@ -550,8 +642,7 @@ class Scheduler:
             resync_dead=[dict(e) for e in self.resync.dead],
             metrics=ckpt.metrics_snapshot(),
         )
-        return ckpt.write_checkpoint(path, "scheduler", state,
-                                     mirrors=mirrors)
+        return state, mirrors
 
     def restore(self, path: str, now: Optional[float] = None) -> str:
         """Reload a checkpoint into this (fresh) scheduler and resume

@@ -57,6 +57,16 @@ PIPELINE_MAGIC = 0x50524356  # "VCRP" — one-deep pipelined round: the
 #                              pipeline on the first round)
 DRAIN_MAGIC = 0x44524356     # "VCRD" — drain the pending pipelined cycle
 #                              (no snapshot payload)
+FENCED_MAGIC = 0x46524356    # "VCRF" — HA fencing prefix (ISSUE 11):
+#                              u32 magic | u32 lease_generation, followed
+#                              by an ordinary request frame. The server
+#                              admits the round only if the generation is
+#                              >= the highest it has seen (admission
+#                              ratchets the fence forward); an older token
+#                              is a deposed leader's in-flight write and
+#                              is answered ERR_NOT_LEADER without
+#                              dispatching — the split-brain window can
+#                              never double-dispatch a cycle.
 SEQ_PIPELINE_MAGIC = 0x51524356  # "VCRQ" — pipelined round with an
 #                              idempotency header (u32 epoch | u32 seq)
 #                              ahead of the VCRP payload: the server caches
@@ -81,6 +91,13 @@ ERR_EPOCH_RESTORED = 6   # retryable: a seq>1 round named a stream epoch
 #                          Structured, so a restart storm costs each
 #                          client one extra roundtrip instead of a
 #                          timeout discovery per restart.
+ERR_NOT_LEADER = 7       # structured, like ERR_EPOCH_RESTORED: a VCRF
+#                          round presented a lease generation below the
+#                          server's fence — the caller was deposed. The
+#                          correct reaction is to stop writing (step
+#                          down), not to resend with the same token; a
+#                          RE-ELECTED caller retries with its new,
+#                          higher generation and is admitted.
 _u32 = struct.Struct("<I")
 
 
@@ -229,6 +246,10 @@ class SchedulerSidecar:
         self._seq_lock = threading.Lock()
         #: served-round counter, arming per-round chaos faults
         self._rounds_served = 0
+        #: HA fence (ISSUE 11): the highest lease generation any VCRF
+        #: round has presented. Unfenced rounds (no VCRF prefix — the
+        #: single-replica deployment) bypass the check entirely.
+        self._fence_generation = 0
         #: client stream epochs this process has served (a stream's first
         #: round registers it; checkpoint/restore carries the set): a
         #: seq>1 round naming an UNKNOWN epoch means we restarted under
@@ -621,6 +642,7 @@ class SchedulerSidecar:
                 rounds_served=self._rounds_served,
                 known_epochs=sorted(self._known_epochs),
                 pending_payload=payload,
+                fence_generation=self._fence_generation,
                 metrics=ckpt.metrics_snapshot(),
             )
         return ckpt.write_checkpoint(path, "sidecar", state,
@@ -656,12 +678,31 @@ class SchedulerSidecar:
                     self._rounds_served = int(state["rounds_served"])
                     self._known_epochs = set(state["known_epochs"])
                     self._staged_payload = state["pending_payload"]
+                    # pre-fence checkpoints restore with the fence open
+                    self._fence_generation = int(
+                        state.get("fence_generation", 0))
                     self._restored_mirrors = ckpt.verify_mirrors(
                         env.get("mirrors"))
                     ckpt.merge_metrics(state.get("metrics"))
         ckpt.record_restore("restored", "ok", "sidecar",
                             (_time.time() - t0) * 1000)
         return "restored"
+
+    def fence_admit(self, generation: int) -> bool:
+        """Admit-or-reject a VCRF round's fencing token. Admission
+        ratchets the fence forward (the newly elected leader's first
+        round deposes every older token); rejection is the permanent
+        ERR_NOT_LEADER verdict for that token."""
+        with self._seq_lock:
+            if generation < self._fence_generation:
+                from ..metrics import METRICS
+                METRICS.inc("sidecar_not_leader_total")
+                _spans.log_event("sidecar_fence_reject",
+                                 presented=int(generation),
+                                 fence=int(self._fence_generation))
+                return False
+            self._fence_generation = int(generation)
+            return True
 
     def wait_idle(self) -> bool:
         """Block until the in-flight pipelined cycle's device work is done
@@ -683,7 +724,22 @@ class _Handler(socketserver.BaseRequestHandler):
                 (magic,) = _u32.unpack(_recv_exact(self.request, 4))
             except (ConnectionError, OSError):
                 return
+            fence_ok = True
+            if magic == FENCED_MAGIC:
+                # HA fencing prefix: u32 generation, then the real frame.
+                # The inner frame is ALWAYS read fully (framing must stay
+                # aligned); a stale token skips the dispatch, not the read.
+                try:
+                    (gen,) = _u32.unpack(_recv_exact(self.request, 4))
+                    (magic,) = _u32.unpack(_recv_exact(self.request, 4))
+                except (ConnectionError, OSError):
+                    return
+                fence_ok = self.server.sidecar.fence_admit(gen)
             if magic == DRAIN_MAGIC:
+                if not fence_ok:
+                    _send_frame(self.request, 1, _error_payload(
+                        ERR_NOT_LEADER, "fencing token superseded"))
+                    continue
                 # drain-only round: retire the pending pipelined cycle
                 try:
                     payload = self.server.sidecar.drain_pending()
@@ -715,6 +771,13 @@ class _Handler(socketserver.BaseRequestHandler):
                 (nx,) = _u32.unpack(_recv_exact(self.request, 4))
                 buf = _recv_exact(self.request, n)
                 extras = _recv_exact(self.request, nx) if nx else b""
+                if not fence_ok:
+                    # deposed leader: the frame was consumed, the round is
+                    # NOT dispatched — the structured verdict replaces a
+                    # would-be split-brain double-dispatch
+                    _send_frame(self.request, 1, _error_payload(
+                        ERR_NOT_LEADER, "fencing token superseded"))
+                    continue
                 if magic == SEQ_PIPELINE_MAGIC:
                     status, payload = self.server.sidecar \
                         .schedule_buffer_seq(epoch, seq, buf, extras)
@@ -782,16 +845,30 @@ class SidecarClient:
     def __init__(self, host: str, port: int, timeout: float = 120.0,
                  conf=None, call_timeout: Optional[float] = None,
                  backoff=None, reconnect: bool = True,
-                 epoch: Optional[int] = None):
+                 epoch: Optional[int] = None,
+                 endpoints=None, fence_token: Optional[int] = None):
         """``conf`` (YAML text or SchedulerConfiguration) should match the
         server's --scheduler-conf: the client computes the host extras the
         conf needs (affinity masks, ports, volumes) and ships them in the
         VCX1 frame — the API-layer process owns the objects, so it owns
-        the object-walking half of the cycle."""
+        the object-walking half of the cycle.
+
+        HA (ISSUE 11): ``endpoints`` is an ordered ``[(host, port), ...]``
+        list of replica sidecars; a connect failure rotates to the next
+        endpoint (``sidecar_failovers_total``) and, because the new
+        server holds none of the old stream's state, adopts a fresh
+        epoch and re-primes — a sidecar failover costs the stream one
+        priming round, the same bill as a server restart. ``fence_token``
+        (the caller's lease generation) wraps every frame in a VCRF
+        prefix; a deposed caller's rounds come back ERR_NOT_LEADER."""
         from ..framework.conf import parse_conf
         from .backoff import Backoff
         self.conf = (parse_conf(conf) if isinstance(conf, str) else conf)
-        self.host, self.port = host, port
+        self.endpoints = ([(h, int(p)) for h, p in endpoints]
+                          if endpoints else [(host, port)])
+        self._endpoint_i = 0
+        self.fence_token = fence_token
+        self.host, self.port = self.endpoints[0]
         self.connect_timeout = timeout
         #: per-call send/recv timeout; None keeps the connect timeout
         self.call_timeout = call_timeout
@@ -815,13 +892,37 @@ class SidecarClient:
     def _connect(self) -> socket.socket:
         """Establish the connection through the backoff helper (a refused
         or flaky endpoint is retried with capped exponential delays +
-        jitter instead of failing the constructor on the first miss)."""
+        jitter instead of failing the constructor on the first miss).
+        With a multi-endpoint list, each failed attempt ROTATES to the
+        next endpoint, so the backoff retries walk the replica set; a
+        connection landing on a DIFFERENT endpoint than the last live one
+        is a failover — the new server holds none of the old stream's
+        pipelined state, so the client adopts a fresh epoch and lets the
+        next pipelined round re-prime (one round lost, never a
+        double-dispatch)."""
         def connect_once():
-            sock = socket.create_connection(
-                (self.host, self.port), timeout=self.connect_timeout)
+            host, port = self.endpoints[self._endpoint_i
+                                        % len(self.endpoints)]
+            try:
+                sock = socket.create_connection(
+                    (host, port), timeout=self.connect_timeout)
+            except OSError:
+                self._endpoint_i += 1   # next attempt, next replica
+                raise
             sock.settimeout(self.call_timeout
                             if self.call_timeout is not None
                             else self.connect_timeout)
+            if (host, port) != (self.host, self.port):
+                from ..metrics import METRICS
+                METRICS.inc("sidecar_failovers_total")
+                _spans.log_event("sidecar_failover",
+                                 endpoint=f"{host}:{port}",
+                                 prev=f"{self.host}:{self.port}")
+                self.host, self.port = host, port
+                self._epoch = ((__import__("os").getpid() << 16)
+                               ^ next(_CLIENT_EPOCHS)) & 0xFFFFFFFF
+                self._seq = 0
+                self._pipeline_maps = None
             return sock
         return self.backoff.call(connect_once)
 
@@ -905,13 +1006,23 @@ class SidecarClient:
             "job_pipelined": job_pipelined, "maps": maps,
         }
 
+    def _fence_prefix(self) -> bytes:
+        """The VCRF wrapper for every frame when a fencing token is set
+        (the HA deployment); empty otherwise — single-replica clients
+        speak the unfenced protocol unchanged."""
+        if self.fence_token is None:
+            return b""
+        return _u32.pack(FENCED_MAGIC) + _u32.pack(
+            int(self.fence_token) & 0xFFFFFFFF)
+
     def _snapshot_frame(self, ci, magic: int, header: bytes = b""):
         from ..native.wire import serialize, serialize_extras
         buf, maps = serialize(ci)
         extras = (serialize_extras(ci, maps, self.conf)
                   if self.conf is not None else b"")
-        frame = (_u32.pack(magic) + header + _u32.pack(len(buf))
-                 + _u32.pack(len(extras)) + buf + extras)
+        frame = (self._fence_prefix() + _u32.pack(magic) + header
+                 + _u32.pack(len(buf)) + _u32.pack(len(extras))
+                 + buf + extras)
         return frame, maps
 
     def schedule(self, ci) -> Dict[str, object]:
@@ -972,7 +1083,8 @@ class SidecarClient:
         if self._pipeline_maps is None:
             return None
         try:
-            payload = self._roundtrip(_u32.pack(DRAIN_MAGIC))
+            payload = self._roundtrip(self._fence_prefix()
+                                      + _u32.pack(DRAIN_MAGIC))
         except SidecarError as e:
             if e.code == ERR_EMPTY_PIPELINE:
                 self._pipeline_maps = None

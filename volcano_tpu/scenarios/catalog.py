@@ -144,6 +144,23 @@ _register(WorkloadSpec(
 ))
 
 _register(WorkloadSpec(
+    name="failover-storm",
+    description="Trace-shaped load served by an HA replica pair under "
+                "repeated leader kills: every few cycles the leader "
+                "dies, its lease expires, and the warm standby promotes "
+                "behind a fresh fence generation; decisions and the "
+                "scorecard must survive every handoff (storm sha == "
+                "calm sha).",
+    conf=_BASE_CONF,
+    cycles=48,
+    n_nodes=6,
+    queues=(QueueSpec("batch", 1), QueueSpec("svc", 2)),
+    arrival_rate=0.7,
+    failover_every=6,
+    drift_check_every=16,
+))
+
+_register(WorkloadSpec(
     name="reclaim-pressure",
     description="Over-served greedy queue vs starving weighted queue "
                 "plus a wide high-priority target: reclaim, reserve, "

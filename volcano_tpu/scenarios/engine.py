@@ -324,7 +324,12 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
     must sha-match the unsharded run (tests/test_checkpoint.py pins
     trace-replay). ``spec.restart_every`` (when > 0) kills the scheduler
     every N cycles and restores a fresh one from its crash-consistent
-    checkpoint — the restart-storm scenario."""
+    checkpoint — the restart-storm scenario. ``spec.failover_every``
+    (when > 0) serves the run from an HA replica pair instead: the
+    leader streams checkpoint envelopes to a warm standby every cycle,
+    and every N cycles it is killed and the standby promoted behind the
+    lease-generation fence — the failover-storm scenario (decision-
+    neutral like restarts: truth is the external cluster)."""
     import os
     import tempfile
 
@@ -354,7 +359,29 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
             run.collector.note_arrival(0)
     cluster = FakeCluster(ci)
     conf = parse_conf(("sharding: true\n" if sharded else "") + spec.conf)
-    sched = Scheduler(cluster, conf=conf, pipeline=False)
+    elector = sender = standby = None
+    fo_clock = None
+    standby_n = 0
+    if spec.failover_every > 0:
+        from ..runtime.leader import (DEFAULT_LEASE_DURATION,
+                                      LeaderElector)
+        from ..runtime.replication import replica_pair
+        from ..runtime.system import VolcanoSystem
+
+        class _FoClock:  # fake monotonic clock, like chaos/failover.py
+            now = 100.0
+
+            def __call__(self):
+                return self.now
+
+        fo_clock = _FoClock()
+        fo_api = VolcanoSystem().api
+        elector = LeaderElector(fo_api, identity="leader-0",
+                                clock=fo_clock)
+        elector.tick()
+    sched = Scheduler(cluster, conf=conf, pipeline=False, elector=elector)
+    if spec.failover_every > 0:
+        sender, standby = replica_pair(sched, conf)
 
     injector = None
     if spec.fault_kinds:
@@ -384,6 +411,29 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
                 if observe:
                     spans.log_event("scenario_restart", scenario=spec.name,
                                     seed=seed, cycle=c, outcome=outcome)
+            if fo_clock is not None:
+                fo_clock.now += 1.0
+            if standby is not None and c and c % spec.failover_every == 0:
+                # the failover storm: the leader dies between cycles; its
+                # lease expires and the warm standby promotes behind a
+                # fresh fence generation (decision-neutral, like restarts)
+                fo_clock.now += DEFAULT_LEASE_DURATION + 1.0
+                standby_n += 1
+                el = LeaderElector(fo_api,
+                                   identity=f"standby-{standby_n}",
+                                   clock=fo_clock)
+                sched = standby.promote(cluster, conf=conf,
+                                        pipeline=False, now=vt,
+                                        elector=el)
+                outcome = standby.last_outcome
+                run.event(c, "failover", outcome=outcome,
+                          generation=el.generation)
+                sender, standby = replica_pair(sched, conf)
+                if observe:
+                    spans.log_event("scenario_failover",
+                                    scenario=spec.name, seed=seed,
+                                    cycle=c, outcome=outcome,
+                                    generation=el.generation)
             if every and c and c % every == 0:
                 # spot-check BEFORE the cycle: this cycle's arrivals are
                 # still pending, so the compared decision vector carries
@@ -408,6 +458,8 @@ def run_scenario(spec: WorkloadSpec, seed: Optional[int] = None,
             _advance_bound_tasks(run, cluster, c)
             if ckpt_path:
                 sched.checkpoint(ckpt_path, now=vt)
+            if sender is not None:
+                sender.stream()
             if observe:
                 spans.log_event("scenario_cycle", scenario=spec.name,
                                 seed=seed, cycle=c, binds=len(new_binds),

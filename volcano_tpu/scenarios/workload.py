@@ -76,6 +76,11 @@ class WorkloadSpec:
     #: fresh one restores from its crash-consistent checkpoint
     #: (runtime/checkpoint.py); 0 = never
     restart_every: int = 0
+    #: failover storm: the run is served by an HA replica pair
+    #: (runtime/replication.py) and every N cycles the leader is killed
+    #: and the warm standby promoted behind a lease-generation fence;
+    #: 0 = single replica, no HA wiring at all
+    failover_every: int = 0
     #: CPU-oracle drift spot-check interval (cycles); soak may tighten
     drift_check_every: int = 16
 
