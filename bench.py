@@ -42,7 +42,9 @@ node-axis sharded-cycle comparison subprocess), BENCH_SKIP_SCENARIOS=1
 (skip the scheduling-quality scenario block; BENCH_SCENARIO_CYCLES sets
 its horizon, default 16), BENCH_SKIP_RESTART=1 (skip the crash-consistent
 checkpoint/restore restart block), BENCH_SKIP_FAILOVER=1 (skip the
-warm-standby HA failover block).
+warm-standby HA failover block), BENCH_SKIP_FLEET=1 (skip the
+multi-tenant fleet serving block; BENCH_FLEET_TENANTS / BENCH_FLEET_CYCLES
+size it).
 """
 
 from __future__ import annotations
@@ -206,7 +208,11 @@ def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None):
                 ("scenario_node_utilization",
                  quality.get("scenario_node_utilization"), True),
                 ("failover_promote_ms_p50",
-                 quality.get("failover_promote_ms_p50"), False)):
+                 quality.get("failover_promote_ms_p50"), False),
+                ("fleet_cycle_ms_p99",
+                 quality.get("fleet_cycle_ms_p99"), False),
+                ("fleet_tenants_per_s",
+                 quality.get("fleet_tenants_per_s"), True)):
             base = parsed.get(key)
             if cur is None or not base or (invert and not cur):
                 continue
@@ -1121,6 +1127,64 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             scenario_block = None
 
+    # ---- multi-tenant fleet serving block (volcano_tpu/fleet) ------------
+    # The fleet throughput claim measured end to end: N same-shape tenants
+    # served through ONE batched vmapped dispatch per cycle (fleet/pool
+    # shape buckets), warmed past the compile, then timed over a churned
+    # multi-cycle run. The record carries per-cycle p50/p99 wall latency
+    # and the headline tenants-served-per-second at the p99 cycle — the
+    # number the batching transparency layer exists to move.
+    # BENCH_SKIP_FLEET=1 skips; a failure records null, never kills the
+    # bench.
+    fleet_block = None
+    if not os.environ.get("BENCH_SKIP_FLEET"):
+        try:
+            from volcano_tpu.chaos.probe import _PROBE_CONF as _fconf
+            from volcano_tpu.chaos.probe import _churn as _fchurn
+            from volcano_tpu.chaos.probe import _small_cluster as _fsmall
+            from volcano_tpu.fleet import FleetScheduler
+            from volcano_tpu.framework import parse_conf as _fparse
+            from volcano_tpu.runtime.fake_cluster import FakeCluster as _FCl
+            f_tenants = int(os.environ.get("BENCH_FLEET_TENANTS", 4))
+            f_cycles = int(os.environ.get("BENCH_FLEET_CYCLES", 8))
+            flt = FleetScheduler(conf=_fparse(_fconf))
+            fcls = {}
+            for i in range(f_tenants):
+                name = f"bench-t{i}"
+                fcls[name] = _FCl(_fsmall(n_nodes=6, n_jobs=8,
+                                          tasks_per_job=3))
+                flt.admit(name, fcls[name], conf=_fparse(_fconf))
+            for w in range(2):              # warm: compile + first deltas
+                flt.run_once(now=1000.0 + w)
+                for n in flt.tenants:
+                    _fchurn(fcls[n], w)
+            f_times = []
+            for c in range(f_cycles):
+                t0 = time.time()
+                flt.run_once(now=1002.0 + c)
+                f_times.append(time.time() - t0)
+                for n in flt.tenants:
+                    _fchurn(fcls[n], 2 + c)
+            f_times.sort()
+            f_p50 = f_times[len(f_times) // 2]
+            f_p99 = f_times[min(len(f_times) - 1,
+                                int(len(f_times) * 0.99))]
+            fleet_block = {
+                "tenants": f_tenants,
+                "cycles": f_cycles,
+                "buckets": len(flt.pool.buckets),
+                "cycle_ms_p50": round(f_p50 * 1000, 1),
+                "cycle_ms_p99": round(f_p99 * 1000, 1),
+                "tenants_per_s_at_p99": round(f_tenants / f_p99, 1),
+                "degraded_tenants": sum(
+                    1 for t in flt.tenants.values()
+                    if t.degradation_level),
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: fleet block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            fleet_block = None
+
     # ---- perf regression guard vs the last same-backend BENCH record -----
     regression_block = None
     if not os.environ.get("BENCH_SKIP_REGRESSION"):
@@ -1135,6 +1199,10 @@ tiers:
                         (scenario_block or {}).get("node_utilization"),
                     "failover_promote_ms_p50":
                         (failover_block or {}).get("promote_ms_p50"),
+                    "fleet_cycle_ms_p99":
+                        (fleet_block or {}).get("cycle_ms_p99"),
+                    "fleet_tenants_per_s":
+                        (fleet_block or {}).get("tenants_per_s_at_p99"),
                 })
         except Exception as e:  # noqa: BLE001 — fail-soft contract
             print("bench: regression guard failed: %s: %s"
@@ -1155,6 +1223,7 @@ tiers:
         "multichip": multichip_block,
         "latency_breakdown": latency_block,
         "scenarios": scenario_block,
+        "fleet": fleet_block,
         "regression": regression_block,
     }
     if force_cpu:
@@ -1259,6 +1328,12 @@ tiers:
             (failover_block or {}).get("decisions_equal_clean"),
         "failover_fenced_writes_rejected":
             (failover_block or {}).get("fenced_writes_rejected"),
+        # fleet-serving numbers in the parsed block: batched-cycle
+        # latency and tenants/sec, baselines for the regression guard
+        "fleet_cycle_ms_p99": (fleet_block or {}).get("cycle_ms_p99"),
+        "fleet_tenants_per_s":
+            (fleet_block or {}).get("tenants_per_s_at_p99"),
+        "fleet_buckets": (fleet_block or {}).get("buckets"),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))

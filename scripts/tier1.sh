@@ -76,6 +76,16 @@ if [ "${TIER1_SKIP_FAILOVER:-0}" != "1" ]; then
     env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --failover \
         > /tmp/_t1_failover.json || frc=$?
 fi
+flrc=0
+if [ "${TIER1_SKIP_FLEET:-0}" != "1" ]; then
+    # fleet smoke (volcano_tpu/fleet): N tenants served through one
+    # batched vmapped dispatch per shape bucket — with churn, a mid-run
+    # admission, and a mid-run eviction — must be decision-sha-identical
+    # per tenant to N independent single-tenant runs, with the jit trace
+    # counters proving one compiled program per (bucket, width)
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.fleet --smoke \
+        > /tmp/_t1_fleet.json || flrc=$?
+fi
 qrc=0
 if [ "${TIER1_SKIP_SCENARIO:-0}" != "1" ]; then
     # scheduling-quality smoke (volcano_tpu/scenarios): a short seeded
@@ -99,6 +109,9 @@ if [ $rrc -ne 0 ]; then
 fi
 if [ $frc -ne 0 ]; then
     exit $frc
+fi
+if [ $flrc -ne 0 ]; then
+    exit $flrc
 fi
 if [ $qrc -ne 0 ]; then
     exit $qrc

@@ -193,6 +193,12 @@ class TestIntegrityDigest:
         before = METRICS.counter_value("resident_digest_mismatch_total")
         pending = ssn2.dispatch_allocate()
         assert pending.state is not None and pending.state.mirror is not None
+        # drain the async dispatch BEFORE planting the drift: on the CPU
+        # backend device_put can zero-copy alias the mirror's memory, so
+        # a flip landing while the compute is still queued corrupts the
+        # INPUT — both digests then see the flipped value and agree
+        import jax
+        jax.block_until_ready(pending.state.device)
         # the mirror drifts from device truth after dispatch (a bit-level
         # flip: value-level nudges can vanish in f32 precision)
         pending.state.mirror[0].view(np.uint32)[3] ^= np.uint32(0x5A5A5A5A)

@@ -1,0 +1,334 @@
+"""Multi-tenant fleet runtime (ISSUE 12 acceptance).
+
+- Transparency: B tenants served through one batched vmapped dispatch per
+  shape bucket make bit-identical decisions to B independent single-tenant
+  Schedulers over multi-cycle runs with churn — on the scan path and
+  against pallas-interpret solo references — and the jit trace counters
+  prove ONE compiled program per (bucket, width), never one per tenant.
+- Chaos isolation: a fault plan targeting one tenant (resident corruption,
+  dispatch failure) leaves every tenant's decision digests bit-identical
+  to the clean run, and only the targeted tenant walks its ladder.
+- Checkpoint isolation: one corrupt per-tenant envelope cold-starts only
+  its owner; the fleet restores everyone else warm and keeps serving.
+- Sidecar tenancy: VCRT-prefixed streams interleave pipelined rounds from
+  two tenants on one server without cross-talk, and the per-tenant epoch
+  LRU evicts (counted) instead of growing without bound.
+- Graphcheck family ``fleet`` is clean on the repo and provably fires on
+  a planted cross-tenant leak.
+"""
+
+import contextlib
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from volcano_tpu.chaos import FaultInjector, FaultPlan, chaos
+from volcano_tpu.chaos.probe import (_PROBE_CONF, _churn, _cycle_digest,
+                                     _small_cluster)
+from volcano_tpu.fleet import FleetScheduler
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.metrics import METRICS
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.scheduler import Scheduler
+from volcano_tpu.telemetry import tracecount
+
+SPECS = {
+    "tenant-a": dict(n_nodes=5, n_jobs=6, tasks_per_job=2, weight=2.0),
+    "tenant-b": dict(n_nodes=5, n_jobs=6, tasks_per_job=2, weight=1.0),
+    # the pow2 padding of the node/job/task axes collapses small size
+    # differences into one bucket; this shape pads distinctly from
+    # (5, 6, 2) — the same two-bucket split the module smoke proves
+    "tenant-c": dict(n_nodes=6, n_jobs=8, tasks_per_job=3, weight=1.0),
+}
+
+
+def _bases(specs=SPECS):
+    return {n: _small_cluster(**{k: v for k, v in s.items()
+                                 if k != "weight"})
+            for n, s in specs.items()}
+
+
+def run_fleet(bases, cycles=4, conf_text=_PROBE_CONF, injector=None,
+              specs=SPECS):
+    """Drive a FleetScheduler over cloned bases with per-cycle churn;
+    returns ({tenant: [digest, ...]}, fleet)."""
+    fleet = FleetScheduler(conf=parse_conf(conf_text))
+    clusters = {n: FakeCluster(bases[n].clone()) for n in specs}
+    for n, s in specs.items():
+        fleet.admit(n, clusters[n], conf=parse_conf(conf_text),
+                    weight=s["weight"])
+    digests = {n: [] for n in specs}
+    ctx = chaos(injector) if injector is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        for c in range(cycles):
+            served = fleet.run_once(now=1000.0 + c)
+            for n, ssn in served.items():
+                digests[n].append(_cycle_digest(ssn))
+            for n in fleet.tenants:
+                _churn(clusters[n], c)
+    return digests, fleet
+
+
+def run_solo(bases, cycles=4, conf_text=_PROBE_CONF, specs=SPECS):
+    """N independent single-tenant reference runs over the same bases."""
+    out = {}
+    for n in specs:
+        cluster = FakeCluster(bases[n].clone())
+        sched = Scheduler(cluster, conf=parse_conf(conf_text))
+        ds = []
+        for c in range(cycles):
+            ssn = sched.run_once(now=1000.0 + c)
+            ds.append(_cycle_digest(ssn))
+            _churn(cluster, c)
+        out[n] = ds
+    return out
+
+
+# The equivalence matrix, the targeted-fault isolation runs, and the
+# fleet restore legs are multi-run probes (a fleet run PLUS N solo
+# reference runs each): they sit in the slow tail — tier-1 budget
+# recalibration, the PR 1/3/5/8/9/10/11 pattern — while the tier1.sh
+# fleet smoke (`python -m volcano_tpu.fleet --smoke`) gates the
+# decision-sha matrix + one-trace-per-bucket proof every tier-1 run.
+@pytest.mark.slow
+class TestFleetEquivalence:
+    def test_batched_equals_solo_scan_one_trace_per_bucket(self):
+        before = {e: v["traces"] for e, v in tracecount.counts().items()}
+        bases = _bases()
+        fleet_d, fleet = run_fleet(bases)
+        solo_d = run_solo(bases)
+        for n in SPECS:
+            assert fleet_d[n] == solo_d[n], n
+        assert len(fleet.pool.buckets) == 2
+        # compile discipline: one program per (bucket, width), each inside
+        # the flat kernel's trace budget — never one trace per tenant
+        traced = {e: v["traces"] - before.get(e, 0)
+                  for e, v in tracecount.counts().items()
+                  if e.startswith("fleet_cycle/")
+                  and v["traces"] > before.get(e, 0)}
+        assert len(traced) == len(fleet.pool.buckets), traced
+        assert all(v <= 3 for v in traced.values()), traced
+
+    def test_batched_equals_pallas_interpret_solo(self):
+        """The fleet's batched entry (scan by construction — vmap does not
+        compose with pallas_call) must match solo references running the
+        pallas-interpret cycle: decisions are backend-identical."""
+        specs = {n: SPECS[n] for n in ("tenant-a", "tenant-c")}
+        bases = _bases(specs)
+        fleet_d, _ = run_fleet(bases, cycles=3, specs=specs)
+        solo_d = run_solo(bases, cycles=3, specs=specs,
+                          conf_text=_PROBE_CONF + 'use_pallas: "interpret"\n')
+        for n in specs:
+            assert fleet_d[n] == solo_d[n], n
+
+    def test_smoke_with_admission_and_eviction(self):
+        """The module smoke (what tier1.sh runs): mid-run admission, a
+        mid-run eviction, two shape buckets, sha matrix + trace proof."""
+        from volcano_tpu.fleet.__main__ import run_fleet_smoke
+        tracecount.reset()      # the smoke asserts absolute trace counts
+        report = run_fleet_smoke(cycles=4)
+        assert report["decisions_ok"], report["matrix"]
+        assert report["trace_ok"], report["fleet_entries"]
+        assert report["buckets"] == 2
+
+
+@pytest.mark.slow
+class TestFleetChaosIsolation:
+    @pytest.mark.parametrize("conf_text", [
+        _PROBE_CONF,
+        pytest.param(_PROBE_CONF + 'use_pallas: "interpret"\n',
+                     id="pallas-interpret"),
+    ])
+    def test_targeted_faults_leave_other_tenants_bit_identical(
+            self, conf_text):
+        bases = _bases()
+        clean_d, _ = run_fleet(bases, conf_text=conf_text)
+        plan = FaultPlan(seed=9, cycles=4,
+                         kinds=("resident_corrupt", "backend_loss"))
+        injector = FaultInjector(plan, target_tenant="tenant-a")
+        mism0 = METRICS.counter_value("resident_digest_mismatch_total")
+        chaos_d, fleet = run_fleet(bases, conf_text=conf_text,
+                                   injector=injector)
+        assert injector.fired, "fault plan never fired (vacuous test)"
+        kinds_fired = {f[1] for f in injector.fired}
+        # every tenant bit-identical to clean: the untargeted tenants by
+        # isolation, the targeted one by decision-neutral recovery
+        for n in SPECS:
+            assert chaos_d[n] == clean_d[n], (n, injector.fired)
+        # only the targeted tenant saw any of it
+        for n in ("tenant-b", "tenant-c"):
+            flight = fleet.tenants[n].flight.snapshots()
+            assert all((e.get("degradation") or 0) == 0 for e in flight), n
+            assert all(e.get("faults") is None for e in flight), n
+        if "resident_corrupt" in kinds_fired:
+            assert METRICS.counter_value(
+                "resident_digest_mismatch_total") > mism0
+        if "backend_loss" in kinds_fired:
+            a_flight = fleet.tenants["tenant-a"].flight.snapshots()
+            assert any((e.get("degradation") or 0) > 0 for e in a_flight)
+
+
+@pytest.mark.slow
+class TestFleetCheckpoint:
+    def test_corrupt_tenant_envelope_never_stalls_fleet(self, tmp_path):
+        from volcano_tpu.runtime.checkpoint import tenant_checkpoint_path
+        bases = _bases()
+        _, fleet = run_fleet(bases, cycles=2)
+        fleet.checkpoint(str(tmp_path))
+        victim = tenant_checkpoint_path(str(tmp_path), "tenant-a")
+        assert os.path.exists(victim)
+        blob = bytearray(open(victim, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(blob))
+
+        fleet2 = FleetScheduler(conf=parse_conf(_PROBE_CONF))
+        clusters = {n: FakeCluster(bases[n].clone()) for n in SPECS}
+        for n, s in SPECS.items():
+            fleet2.admit(n, clusters[n], conf=parse_conf(_PROBE_CONF),
+                         weight=s["weight"])
+        outcomes = fleet2.restore(str(tmp_path))
+        assert outcomes["tenant-a"] == "fallback"
+        assert outcomes["tenant-b"] == "restored"
+        assert outcomes["tenant-c"] == "restored"
+        assert fleet2.tenants["tenant-b"].cycles == 2
+        # the fleet keeps serving: every tenant, including the cold one
+        served = fleet2.run_once(now=2000.0)
+        assert set(served) == set(SPECS)
+
+    def test_restore_missing_directory_is_cold_everywhere(self, tmp_path):
+        bases = _bases()
+        fleet = FleetScheduler(conf=parse_conf(_PROBE_CONF))
+        for n, s in SPECS.items():
+            fleet.admit(n, FakeCluster(bases[n].clone()),
+                        conf=parse_conf(_PROBE_CONF), weight=s["weight"])
+        outcomes = fleet.restore(str(tmp_path / "never-written"))
+        assert set(outcomes.values()) == {"cold"}
+        assert set(fleet.run_once(now=2000.0)) == set(SPECS)
+
+
+class TestSidecarTenancy:
+    @pytest.fixture(autouse=True)
+    def _native(self):
+        from volcano_tpu import native
+        if not native.available():
+            pytest.skip(f"native packer unavailable: "
+                        f"{native.build_error()}")
+
+    def _cluster(self, n_jobs=3):
+        from fixtures import build_job, build_task, simple_cluster
+        ci = simple_cluster(n_nodes=3)
+        for j in range(n_jobs):
+            job = build_job(f"default/j{j}", min_available=2)
+            for t in range(2):
+                job.add_task(build_task(f"j{j}-t{t}", cpu="1",
+                                        memory="1Gi"))
+            ci.add_job(job)
+        return ci
+
+    def test_interleaved_tenant_streams_no_cross_talk(self):
+        """Two VCRT tenants pipelining through ONE server: each stream's
+        responses match its own sync reference shifted by one, with the
+        rounds fully interleaved (every dispatch retires the other
+        tenant's in-flight cycle into its staged slot)."""
+        from volcano_tpu.runtime.sidecar import SidecarClient, SidecarServer
+        server = SidecarServer()
+        server.serve_in_thread()
+        try:
+            cis_a = [self._cluster(n_jobs=2 + k) for k in range(3)]
+            cis_b = [self._cluster(n_jobs=3) for _ in range(3)]
+            sync = SidecarClient(*server.address)
+            want_a = [sync.schedule(ci) for ci in cis_a]
+            want_b = [sync.schedule(ci) for ci in cis_b]
+            sync.close()
+
+            ca = SidecarClient(*server.address, tenant_id="tenant-a")
+            cb = SidecarClient(*server.address, tenant_id="tenant-b")
+            assert ca.tenant_id != cb.tenant_id
+            assert ca.schedule_pipelined(cis_a[0]) is None   # prime a
+            assert cb.schedule_pipelined(cis_b[0]) is None   # prime b
+            got_a, got_b = [], []
+            for k in range(1, 3):
+                got_a.append(ca.schedule_pipelined(cis_a[k]))
+                got_b.append(cb.schedule_pipelined(cis_b[k]))
+            got_a.append(ca.drain_pipelined())
+            got_b.append(cb.drain_pipelined())
+            for k in range(3):
+                np.testing.assert_array_equal(
+                    want_a[k]["task_node"], got_a[k]["task_node"],
+                    f"tenant-a round {k}")
+                assert want_a[k]["binds"] == got_a[k]["binds"]
+                np.testing.assert_array_equal(
+                    want_b[k]["task_node"], got_b[k]["task_node"],
+                    f"tenant-b round {k}")
+                assert want_b[k]["binds"] == got_b[k]["binds"]
+            ca.close()
+            cb.close()
+        finally:
+            server.shutdown()
+
+    def test_epoch_lru_evicts_and_counts(self, monkeypatch):
+        """A tenant's known-epoch set is a bounded LRU: pushing more
+        client epochs than the cap evicts the oldest (counted on
+        ``sidecar_replay_evictions_total``) and a replay under the
+        evicted epoch re-primes via ERR_EPOCH_RESTORED instead of
+        silently double-dispatching."""
+        monkeypatch.setenv("VOLCANO_SIDECAR_EPOCH_CAP", "2")
+        from volcano_tpu.runtime.sidecar import (SidecarClient,
+                                                 SidecarServer,
+                                                 tenant_wire_id)
+        server = SidecarServer()
+        server.serve_in_thread()
+        ev0 = METRICS.counter_value("sidecar_replay_evictions_total")
+        try:
+            ci = self._cluster()
+            clients = [SidecarClient(*server.address, tenant_id="tenant-a",
+                                     epoch=100 + k) for k in range(3)]
+            for c in clients:
+                assert c.schedule_pipelined(ci) is None   # prime: seq 1
+            # cap 2, three epochs seen -> epoch 100 evicted, counted
+            assert METRICS.counter_value(
+                "sidecar_replay_evictions_total") == ev0 + 1
+            st = server.sidecar._stream(tenant_wire_id("tenant-a"))
+            assert list(st.known_epochs) == [101, 102]
+            # the evicted client's next round (seq 2, unknown epoch) gets
+            # ERR_EPOCH_RESTORED and transparently re-primes under a new
+            # epoch — schedule_pipelined returns None for that round
+            assert clients[0].schedule_pipelined(ci) is None
+            assert len(st.known_epochs) == 2
+            for c in clients:
+                c.close()
+        finally:
+            server.shutdown()
+
+
+# Slow tail: tier1.sh's standalone `graphcheck.sh --fast` gate already
+# compiles and audits the fleet family every run; these add the planted
+# cross-tenant-leak proof on top.
+@pytest.mark.slow
+class TestGraphcheckFleet:
+    def test_family_registered_and_clean(self):
+        from volcano_tpu.analysis import FAMILIES, run_graphcheck
+        assert "fleet" in FAMILIES
+        report = run_graphcheck(families=["fleet"], fast=True)
+        assert report["clean"], report["findings"]
+
+    def test_planted_cross_tenant_leak_fires(self, monkeypatch):
+        from volcano_tpu.analysis.fleet import check_fleet
+        from volcano_tpu.fleet import pool
+        monkeypatch.setattr(pool, "_LEAK_FOR_TESTS", True)
+        findings = check_fleet(fast=True)
+        assert any("cross-tenant-flow" in f.key for f in findings), \
+            [f.key for f in findings]
+
+
+class TestFleetWire:
+    def test_tenant_wire_id_stable_nonzero(self):
+        from volcano_tpu.runtime.sidecar import TENANT_MAGIC, tenant_wire_id
+        assert struct.pack("<I", TENANT_MAGIC) == b"VCRT"
+        a, b = tenant_wire_id("tenant-a"), tenant_wire_id("tenant-b")
+        assert a == tenant_wire_id("tenant-a")      # deterministic
+        assert a != b
+        assert a != 0 and b != 0                    # 0 = legacy stream

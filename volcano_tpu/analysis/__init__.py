@@ -14,7 +14,7 @@ compiled_session conf presets, the ops/ cycle functions, both Pallas
 kernel builders) and turns each class into a CI failure instead of a
 driver-TPU surprise.
 
-Check families (all nine run by default):
+Check families (all ten run by default):
 
 - ``purity``       — no pure_callback/io_callback/debug_callback
                      primitives anywhere in a compiled cycle.
@@ -69,6 +69,14 @@ Check families (all nine run by default):
                      its declared input sharding (out == in: the
                      zero-resharding steady-state contract). Reports
                      nothing when fewer than two devices are visible.
+- ``fleet``        — the multi-tenant batched cycle
+                     (fleet/pool.FleetDeltaKernel): the vmapped entry
+                     stays callback-free, every decision output carries
+                     the leading tenant axis at the bucket width, and a
+                     value-level probe proves NO cross-tenant data flow —
+                     perturbing one tenant's stacked inputs leaves every
+                     other tenant's packed decisions (digest included)
+                     bit-identical.
 
 Run ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh)
 for the CLI; tier-1 runs the same pass via tests/test_graphcheck.py.
@@ -85,7 +93,7 @@ import time
 from typing import List, Optional, Sequence
 
 FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations",
-            "telemetry", "donation", "sharding")
+            "telemetry", "donation", "sharding", "fleet")
 
 
 @dataclasses.dataclass
@@ -180,6 +188,10 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     if "sharding" in families:
         from .sharding import check_sharding
         findings += check_sharding(fast=fast)
+
+    if "fleet" in families:
+        from .fleet import check_fleet
+        findings += check_fleet(fast=fast)
 
     findings = apply_allowlist(findings)
     blocking = [f for f in findings if not f.allowlisted]

@@ -1,0 +1,132 @@
+"""Graphcheck family 10: multi-tenant batched-cycle isolation.
+
+The fleet runtime (fleet/pool.py) serves B same-bucket tenants through
+ONE compiled entry: stacked residents ``(B, n_g)``, one flat global-index
+delta scatter, and the allocate cycle vmapped over the tenant axis. The
+whole multi-tenancy contract rests on that entry never mixing tenant
+rows — a reduction, broadcast, or reshape that crosses the leading axis
+would leak one tenant's cluster state into another tenant's decisions
+while every unit test on the flat cycle stays green. This family audits
+the REAL batched entry three ways:
+
+- **purity** — the batched jaxpr contains no host-callback primitives
+  (the vmapped cycle must stay as device-pure as the flat one; the walk
+  is scoped here so a planted violation is attributable to the fleet
+  path).
+- **tenant axis** — every output of the entry (the three scattered
+  residents AND the packed decisions) carries the leading tenant axis at
+  the bucket width: a dropped or transposed axis means rows are being
+  flattened somewhere before the readback split.
+- **value isolation** — the decisive check, at value level rather than
+  graph level: run the entry on two stacked rows built from the same
+  REAL packed snapshot, perturb ONE element of tenant row 1's input,
+  and require tenant row 0's packed decisions — integrity digest words
+  included — to stay bit-identical. vmap guarantees this by
+  construction; the probe proves the guarantee survived whatever was
+  composed around the vmap (the flat scatter, the digest concat, future
+  edits). The planted-leak test (tests/test_fleet.py) flips
+  ``fleet.pool._LEAK_FOR_TESTS`` and requires this probe to FIRE, so
+  the check is known to be live.
+
+Runs on CPU with small real snapshots through the same ``arrays.pack``
+path production uses; reports nothing only if the fleet module is
+absent.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import Finding
+
+
+def check_fleet(fast: bool = False) -> List[Finding]:
+    import jax
+    import numpy as np
+
+    from ..fleet.pool import FleetDeltaKernel, normalize_config
+    from ..ops.allocate_scan import (AllocateConfig, derive_batching,
+                                     make_allocate_cycle)
+    from ..ops.fused_io import fuse_into
+    from .entrypoints import _snap_extras
+    from .jaxpr_audit import CALLBACK_PRIMITIVES, _loc, iter_eqns
+
+    findings: List[Finding] = []
+    snap, extras = _snap_extras()
+    tree = (snap, extras)
+    cfg = normalize_config(derive_batching(
+        AllocateConfig(binpack_weight=1.0, enable_gpu=False),
+        has_proportion=False))
+    width = 2
+    kernel = FleetDeltaKernel(make_allocate_cycle(cfg), tree, width,
+                              entry="graphcheck/fleet", integrity=True)
+
+    # ---- purity of the batched entry (traced on the REAL entry) -----------
+    closed = jax.make_jaxpr(kernel.traceable)(
+        *kernel.example_batched_args())
+    seen = set()
+    for eqn in iter_eqns(closed.jaxpr):
+        pname = eqn.primitive.name
+        if pname in CALLBACK_PRIMITIVES and pname not in seen:
+            seen.add(pname)
+            findings.append(Finding(
+                family="fleet",
+                key=f"fleet:batched-entry:callback:{pname}",
+                where=f"fleet/pool batched entry @ {_loc(eqn)}",
+                what=(f"host callback primitive '{pname}' in the batched "
+                      "fleet entry — the vmapped cycle must stay "
+                      "device-pure (a callback re-serializes every "
+                      "fleet cycle, for every tenant, on a host "
+                      "round-trip)")))
+
+    # ---- every output carries the leading tenant axis ---------------------
+    out_names = ("fbuf", "ibuf", "bbuf", "packed_decisions")
+    for name, var in zip(out_names, closed.jaxpr.outvars):
+        shape = tuple(getattr(var.aval, "shape", ()))
+        if len(shape) < 2 or shape[0] != width:
+            findings.append(Finding(
+                family="fleet",
+                key=f"fleet:batched-entry:axis:{name}:{shape}",
+                where="fleet/pool.FleetDeltaKernel",
+                what=(f"batched entry output '{name}' has shape {shape} — "
+                      f"expected a leading tenant axis of width {width}; "
+                      "a dropped axis means tenant rows are flattened "
+                      "before the per-tenant readback split")))
+
+    # ---- value-level cross-tenant isolation probe -------------------------
+    bufs = fuse_into(tree, kernel.spec, kernel.sizes)
+    stacked = [np.stack([b, b]) for b in bufs]
+    no_delta = []
+    for b in bufs:
+        no_delta += [np.zeros(0, np.int32), np.zeros(0, b.dtype)]
+
+    def run(args):
+        import jax.numpy as jnp
+        outs = kernel.traceable(*(jnp.asarray(a) for a in args),
+                                *(jnp.asarray(d) for d in no_delta))
+        return np.asarray(outs[3])
+
+    base_packed = run(stacked)
+    perturbed = [s.copy() for s in stacked]
+    # flip one element of tenant row 1 in EVERY non-empty group: an
+    # arbitrary value change in ONE tenant's inputs — row 0's decisions
+    # (and row-0 digest words) must not move
+    for s in perturbed:
+        if s.shape[1]:
+            if s.dtype == np.bool_:
+                s[1, 0] = ~s[1, 0]
+            else:
+                s[1, 0] = s[1, 0] + s.dtype.type(1)
+    pert_packed = run(perturbed)
+    if not np.array_equal(base_packed[0], pert_packed[0]):
+        moved = int(np.sum(base_packed[0] != pert_packed[0]))
+        findings.append(Finding(
+            family="fleet",
+            key=f"fleet:batched-entry:cross-tenant-flow:{moved}",
+            where="fleet/pool.FleetDeltaKernel",
+            what=(f"perturbing one element of tenant row 1's stacked "
+                  f"inputs moved {moved} element(s) of tenant row 0's "
+                  "packed decisions — cross-tenant data flow in the "
+                  "batched entry; one tenant's cluster state is leaking "
+                  "into another tenant's scheduling decisions")))
+    return findings

@@ -42,6 +42,19 @@ seam point              caller
                         rationale as ``harness.kill``)
 ``replication.send``    runtime/replication.ReplicationLink.deliver
                         (``replication_partition`` drops the envelope)
+``fleet.cycle``         fleet/scheduler.FleetScheduler.run_once (cycle
+                        start; arms this fleet cycle's faults)
+``fleet.tenant``        fleet/pool.TenantPool.run_bucket, inside ONE
+                        tenant's pack step — per-tenant faults
+                        (``resident_corrupt`` of that tenant's stacked
+                        device row, targeted ``backend_loss``) fire here,
+                        scoped by the injector's ``target_tenant`` so the
+                        isolation tests can prove a fault in one tenant
+                        never moves another tenant's decisions
+``fleet.dispatch``      fleet/pool.TenantPool.run_bucket, before the one
+                        batched dispatch (whole-bucket backend loss /
+                        slow dispatch; skipped when ``target_tenant``
+                        scopes the plan to a single tenant)
 ======================  ====================================================
 
 With no injector installed every seam is a module-global ``None`` check —
@@ -127,11 +140,18 @@ class FaultInjector:
     logs, which tests/test_chaos.py pins.
     """
 
-    def __init__(self, plan: FaultPlan, slow_s: float = 0.25):
+    def __init__(self, plan: FaultPlan, slow_s: float = 0.25,
+                 target_tenant: Optional[str] = None):
         self.plan = plan
         #: how long a ``slow_dispatch`` fault stalls (must exceed the
         #: scheduler's cycle deadline for the watchdog to trip)
         self.slow_s = slow_s
+        #: fleet scoping (ISSUE 12): when set, per-tenant fleet faults
+        #: fire ONLY inside this tenant's pack step, and whole-bucket
+        #: fleet.dispatch faults are suppressed — the chaos isolation
+        #: tests inject into one tenant and require every other tenant's
+        #: decision stream to stay bit-identical to the clean run
+        self.target_tenant = target_tenant
         self.cycle = -1
         self.fired: List[Tuple[int, str, str]] = []
         self._pool: List[Fault] = []
@@ -289,6 +309,51 @@ class FaultInjector:
         if self._take("replication_partition",
                       "replication.send") is not None:
             return "drop"
+
+    # ------------------------------------------------- fleet seam handlers
+    def _on_fleet_cycle(self, cycle: int = 0, **_):
+        self.begin_cycle(cycle)
+
+    def _on_fleet_tenant(self, pool=None, bucket=None, tenant=None,
+                         resident=None, **_):
+        if self.target_tenant is not None and tenant != self.target_tenant:
+            return
+        if (bucket is not None and bucket.device is not None
+                and tenant in bucket.stacked_names):
+            f = self._take("resident_corrupt", "fleet.tenant")
+            if f is not None:
+                # corrupt ONE element of THIS tenant's row of the stacked
+                # device residency, behind the pool's back: the tenant's
+                # in-graph digest trips at the next dispatch and the
+                # bucket recovers by a full re-stack from source truth —
+                # decision-neutral for every tenant (the flat kernel's
+                # recovery argument, per row)
+                import jax
+
+                from ..fleet.pool import _invalidate
+                r = bucket.stacked_names.index(tenant)
+                host = [np.array(b, copy=True) for b in bucket.device]
+                _flip_host(tuple(h[r] for h in host), f.param)
+                _invalidate(bucket.device)
+                bucket.device = tuple(jax.device_put(h) for h in host)
+                # one fault per seam visit: a backend loss in the SAME
+                # pack step would exclude this tenant from the batch and
+                # the structural restack would wipe the corruption before
+                # any digest verify ran — the loss stays armed for the
+                # next reachable seam instead
+                return
+        f = self._take("backend_loss", "fleet.tenant")
+        if f is not None:
+            # surfaces inside the tenant's pack step: run_bucket excludes
+            # ONLY this tenant from the batch and the caller serves it
+            # through the per-tenant fallback ladder
+            raise ChaosError("injected backend loss (tenant pack)",
+                             kind="backend_loss")
+
+    def _on_fleet_dispatch(self, pool=None, bucket=None, tenants=(), **_):
+        if self.target_tenant is not None:
+            return  # targeted plans never fault the whole bucket
+        self._dispatch_faults("fleet.dispatch")
 
     def _on_sidecar_client_recv(self, client=None, **_):
         f = self._take("socket_drop", "sidecar.client_recv")

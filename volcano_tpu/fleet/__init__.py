@@ -1,0 +1,21 @@
+"""Multi-tenant fleet runtime: one compiled cycle serving many clusters.
+
+- :mod:`.pool` — TenantPool / FleetDeltaKernel: per-tenant resident
+  state stacked along a vmapped tenant axis, pow2 shape buckets, one
+  dispatch per bucket;
+- :mod:`.fairness` — cross-tenant cycle-slot fairness (the proportion
+  plugin's water-fill lifted one level up);
+- :mod:`.scheduler` — FleetScheduler: N full scheduling loops sharing
+  the batched device dispatch, with per-tenant fault isolation,
+  checkpoints, and observability;
+- ``python -m volcano_tpu.fleet --smoke`` — the tier-1 equivalence
+  smoke: a batched fleet's per-tenant decision stream must be
+  bit-identical to N independent single-tenant schedulers.
+
+See docs/architecture.md, "Fleet serving".
+"""
+
+from .fairness import pick_served, record_served, tenant_deserved  # noqa: F401
+from .pool import (FleetDeltaKernel, TenantPool, bucket_key,  # noqa: F401
+                   normalize_config)
+from .scheduler import FleetScheduler, Tenant  # noqa: F401

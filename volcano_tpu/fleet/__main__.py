@@ -1,0 +1,142 @@
+"""Fleet equivalence smoke: ``python -m volcano_tpu.fleet --smoke``.
+
+The claim under test is the fleet's transparency contract: B tenants
+served through ONE batched vmapped dispatch per shape bucket make
+bit-identical decisions to B independent single-tenant schedulers run
+over identically-seeded clusters — across multi-cycle runs with churn
+(gang completions + re-arrivals), a mid-run eviction, and a mid-run
+admission. The per-(tenant, cycle) sha matrix must match entry for
+entry, and the jit trace counters must show ONE trace per
+(bucket, width) program — never one per tenant.
+
+Exit 0 on equivalence, 1 with the failing matrix on stderr otherwise.
+Wired into scripts/tier1.sh (skip: TIER1_SKIP_FLEET=1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+import time
+
+
+def _sha(digests) -> str:
+    return hashlib.sha256(repr(digests).encode()).hexdigest()[:16]
+
+
+def run_fleet_smoke(cycles: int = 6, verbose: bool = False) -> dict:
+    from ..chaos.probe import _PROBE_CONF, _churn, _cycle_digest, _small_cluster
+    from ..framework.conf import parse_conf
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+    from ..telemetry.tracecount import counts
+    from .scheduler import FleetScheduler
+
+    # two shape buckets: a/b share one, c/d share the other
+    specs = {
+        "tenant-a": dict(n_nodes=6, n_jobs=8, tasks_per_job=3, weight=2.0),
+        "tenant-b": dict(n_nodes=6, n_jobs=8, tasks_per_job=3, weight=1.0),
+        "tenant-c": dict(n_nodes=5, n_jobs=6, tasks_per_job=2, weight=1.0),
+        "tenant-d": dict(n_nodes=5, n_jobs=6, tasks_per_job=2, weight=1.0),
+    }
+    evict_at = {"tenant-b": cycles - 2}     # mid-run eviction
+    admit_at = {"tenant-d": 2}              # mid-run admission
+    bases = {n: _small_cluster(**{k: v for k, v in s.items()
+                                  if k != "weight"})
+             for n, s in specs.items()}
+
+    # ---- batched fleet run ---------------------------------------------
+    t0 = time.time()
+    fleet = FleetScheduler(conf=parse_conf(_PROBE_CONF))
+    fleet_clusters = {n: FakeCluster(bases[n].clone()) for n in specs}
+    for n, s in specs.items():
+        if admit_at.get(n, 0) == 0:
+            fleet.admit(n, fleet_clusters[n], conf=parse_conf(_PROBE_CONF),
+                        weight=s["weight"])
+    fleet_digests = {n: [] for n in specs}
+    for c in range(cycles):
+        for n in specs:
+            if admit_at.get(n, 0) == c and n not in fleet.tenants:
+                fleet.admit(n, fleet_clusters[n],
+                            conf=parse_conf(_PROBE_CONF),
+                            weight=specs[n]["weight"])
+            if evict_at.get(n) == c:
+                fleet.evict(n)
+        served = fleet.run_once(now=1000.0 + c)
+        for n, ssn in served.items():
+            fleet_digests[n].append(_cycle_digest(ssn))
+        for n in fleet.tenants:
+            _churn(fleet_clusters[n], c)
+    fleet_s = time.time() - t0
+    fleet_entries = {e: v["traces"] for e, v in counts().items()
+                     if e.startswith("fleet_cycle/")}
+
+    # ---- N independent single-tenant reference runs --------------------
+    t0 = time.time()
+    solo_digests = {n: [] for n in specs}
+    for n, s in specs.items():
+        cluster = FakeCluster(bases[n].clone())
+        sched = Scheduler(cluster, conf=parse_conf(_PROBE_CONF))
+        first = admit_at.get(n, 0)
+        last = evict_at.get(n, cycles)
+        for c in range(cycles):
+            if c < first or c >= last:
+                continue
+            ssn = sched.run_once(now=1000.0 + c)
+            solo_digests[n].append(_cycle_digest(ssn))
+            _churn(cluster, c)
+    solo_s = time.time() - t0
+
+    # ---- the sha matrix -------------------------------------------------
+    matrix, ok = {}, True
+    for n in sorted(specs):
+        f_sha, s_sha = _sha(fleet_digests[n]), _sha(solo_digests[n])
+        match = (fleet_digests[n] == solo_digests[n])
+        ok = ok and match
+        matrix[n] = dict(fleet_sha=f_sha, solo_sha=s_sha, match=match,
+                         cycles=len(fleet_digests[n]))
+        if not match and verbose:
+            for c, (a, b) in enumerate(zip(fleet_digests[n],
+                                           solo_digests[n])):
+                if a != b:
+                    print(f"  {n} cycle {c}: fleet={a!r} solo={b!r}",
+                          file=sys.stderr)
+    # compile discipline: one program per (bucket, width) — never per
+    # tenant — with the flat kernel's O(log) delta-bucket trace budget
+    # per program (full-stack signature + a few pow2 delta signatures)
+    trace_ok = (len(fleet_entries) > 0
+                and all(v <= 3 for v in fleet_entries.values())
+                and len(fleet_entries) <= 2 * len(specs))
+    return dict(ok=bool(ok and trace_ok), decisions_ok=bool(ok),
+                trace_ok=bool(trace_ok), cycles=cycles,
+                tenants=len(specs), matrix=matrix,
+                fleet_entries=fleet_entries,
+                buckets=len(fleet.pool.buckets),
+                fleet_s=round(fleet_s, 3), solo_s=round(solo_s, 3),
+                snapshot=fleet.fleet_snapshot())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m volcano_tpu.fleet")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the fleet-vs-independent equivalence smoke")
+    ap.add_argument("--cycles", type=int, default=6)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.smoke:
+        ap.print_help()
+        return 2
+    report = run_fleet_smoke(cycles=args.cycles, verbose=args.verbose)
+    print(json.dumps(report, indent=2, default=str))
+    if not report["ok"]:
+        print("FLEET SMOKE FAILED: "
+              + ("decision divergence" if not report["decisions_ok"]
+                 else "trace-count violation"), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

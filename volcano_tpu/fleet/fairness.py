@@ -1,0 +1,86 @@
+"""Cross-tenant cycle fairness: the proportion plugin's qshare machinery
+lifted one level up.
+
+Inside one cluster the proportion plugin water-fills cluster capacity over
+queues by weight (ops/fairshare.proportion_deserved). The fleet has the
+same shape one level up: the contended resource is CYCLE SLOTS (how many
+tenants the batched runtime serves per fleet cycle, conf ``fleet_slots``),
+the actors are tenants, and the weights are admission weights. This
+module is the single-resource host-side form of the same fixed point —
+repeatedly hand each unmet tenant ``remaining * w / sum(unmet weights)``,
+clamp by request, recycle the clamped-off remainder — plus the
+deficit-counter serving order that turns long-run deserved shares into a
+deterministic per-cycle pick.
+
+With ``fleet_slots`` unset (the default) every tenant is served every
+cycle and this module is a no-op passthrough — which is what keeps the
+fleet's decision stream bit-identical to N independent schedulers; the
+fairness pass only bites under load.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+_EPS = 1e-9
+
+
+def tenant_deserved(weights: Dict[str, float], slots: float,
+                    requests: Dict[str, float] = None,
+                    max_iters: int = 16) -> Dict[str, float]:
+    """Each tenant's deserved cycle-slot share by weighted water-filling —
+    the proportion fixed point (proportion.go:140-197 / ops/fairshare) in
+    its 1-resource host form. ``requests`` caps a tenant's useful share
+    (a tenant can't use more than one slot per cycle: default 1.0)."""
+    names = sorted(weights)
+    if not names:
+        return {}
+    req = {n: (requests or {}).get(n, 1.0) for n in names}
+    deserved = {n: 0.0 for n in names}
+    meet = {n: weights[n] <= 0 for n in names}
+    remaining = float(slots)
+    for _ in range(max_iters):
+        unmet_w = sum(weights[n] for n in names if not meet[n])
+        if unmet_w <= _EPS or remaining <= _EPS:
+            break
+        changed = False
+        for n in names:
+            if meet[n]:
+                continue
+            proposed = deserved[n] + remaining * weights[n] / unmet_w
+            new = min(proposed, req[n])
+            if new > deserved[n] + _EPS:
+                changed = True
+            if new >= req[n] - _EPS:
+                meet[n] = True
+            deserved[n] = new
+        handed = sum(deserved.values())
+        remaining = float(slots) - handed
+        if not changed:
+            break
+    return deserved
+
+
+def pick_served(weights: Dict[str, float], served: Dict[str, float],
+                slots: int) -> List[str]:
+    """The tenants to serve this fleet cycle: the ``slots`` highest
+    deficits, where a tenant's deficit is its deserved share of all slots
+    handed out so far minus what it actually got. Deterministic: ties
+    break by tenant name, so two runs of the same admission/weight history
+    serve identical sequences (the fleet smoke pins this)."""
+    names = sorted(weights)
+    if slots is None or slots >= len(names):
+        return names
+    slots = max(0, int(slots))
+    total_handed = sum(served.get(n, 0.0) for n in names) + slots
+    shares = tenant_deserved(weights, float(total_handed))
+    ranked = sorted(
+        names,
+        key=lambda n: (-(shares.get(n, 0.0) - served.get(n, 0.0)), n))
+    return sorted(ranked[:slots])
+
+
+def record_served(served: Dict[str, float], picked: Sequence[str]) -> None:
+    """Advance the deficit counters for a cycle's served set."""
+    for n in picked:
+        served[n] = served.get(n, 0.0) + 1.0

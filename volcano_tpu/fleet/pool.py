@@ -1,0 +1,508 @@
+"""TenantPool: many tenants' resident state through one compiled cycle.
+
+The single-tenant delta path (ops/fused_io.DeltaKernel) holds one
+(snap, extras) tree resident on the device and ships O(changed elements)
+per cycle. A fleet of B tenants run that way costs B dispatches per fleet
+cycle — the per-dispatch latency dominates long before the FLOPs do. This
+module batches them: tenants whose derived (AllocateConfig, shape
+signature) match share a SHAPE BUCKET, each bucket owns ONE
+:class:`FleetDeltaKernel` whose jitted entry stacks the three group
+buffers along a leading tenant axis, scatters every tenant's packed delta
+in one flat scatter, and vmaps the allocate cycle over the tenant axis —
+B same-bucket tenants cost one dispatch.
+
+Compile discipline (the PR 4 delta-bucket rule lifted to the tenant
+axis): the tenant axis pads to a power of two (``pow2_bucket(B, 1)``), so
+admission/eviction retraces a bucket O(log B) times, never per tenant;
+delta sizes pad with the same pow2 rule as the flat kernel. A tenant
+joining or changing bucket restacks — and possibly retraces — ONLY its
+own bucket: kernels are per-bucket objects with per-bucket jit entries
+(``fleet_cycle/<key>``), so the trace counters prove one compile per
+bucket, not per tenant.
+
+Isolation: the vmapped cycle cannot mix tenant rows by construction (vmap
+maps every operation over the leading axis), the per-tenant integrity
+digest rides each tenant's row of the packed readback, and the graphcheck
+``fleet`` family (analysis/fleet.py) audits the batched entry — no
+callbacks, every decision output carries the tenant axis, and a
+value-level probe proves perturbing one tenant's inputs cannot move
+another tenant's decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..chaos.inject import seam
+from ..metrics import METRICS
+from ..ops.fused_io import (_GROUPS, _TARGETS, DIGEST_WORDS, _device_digest,
+                            _pad_delta, _shape_key, delta_bucket,
+                            donation_for_backend, fuse_into, fuse_spec,
+                            group_sizes, host_digest, make_unfuse,
+                            pow2_bucket)
+from ..telemetry import spans as _spans
+
+#: planted cross-tenant leak for the graphcheck family-10 proof: tests set
+#: this True before building a kernel and the batched entry compiles in a
+#: deliberate reduction over the tenant axis — the fleet check must fire.
+#: NEVER set outside tests.
+_LEAK_FOR_TESTS = False
+
+
+def normalize_config(cfg):
+    """The bucket-key form of a tenant's derived AllocateConfig.
+
+    ``telemetry`` and ``use_pallas`` are decision-neutral backend/readout
+    knobs (the repo's equality suites pin scan == pallas == telemetry-on
+    decisions); normalizing them lets tenants that differ only there share
+    a bucket, and keeps the batched entry on the pure-XLA scan path — the
+    vmap-over-tenant-axis transform composes with lax control flow, not
+    with a pallas_call launch. ``use_pallas=False`` (not None: None means
+    auto-detect, which would pick the kernel on TPU) is the explicit
+    force-scan value. Everything decision-relevant (weights, gates,
+    derived batching) stays in the key, so tenants with different
+    policies never share a compiled program.
+    """
+    return dataclasses.replace(cfg, telemetry=False, use_pallas=False)
+
+
+def bucket_key(cfg, tree) -> tuple:
+    """Shape-bucket identity: the normalized config + the exact per-leaf
+    (shape, dtype) signature — the same key construction the single-tenant
+    delta cache uses (ops/fused_io._shape_key), so fleet buckets and
+    single-tenant shape buckets cannot drift."""
+    return _shape_key(tree, normalize_config(cfg))
+
+
+def _entry_name(key: tuple, width: int) -> str:
+    """Stable per-(bucket, width) jit entry name for the trace counters:
+    ``counts()['fleet_cycle/<h>w<width>']['traces']`` staying at 1 while
+    B tenants cycle is the one-compile-per-bucket proof."""
+    h = hashlib.sha256(repr(key).encode()).hexdigest()[:8]
+    return f"fleet_cycle/{h}w{width}"
+
+
+class TenantResident:
+    """Per-tenant host half of a bucket's stacked residency: the mirror of
+    this tenant's row of device truth, a ping-pong scratch, and the upload
+    accounting the flight recorder snapshots. The device rows themselves
+    are stacked per bucket (:class:`_Bucket`) — that is the point."""
+
+    __slots__ = ("mirror", "scratch", "warm_mirror", "full_cycles",
+                 "delta_cycles", "last_kind", "last_upload_bytes",
+                 "full_upload_bytes")
+
+    def __init__(self):
+        self.mirror: Optional[tuple] = None
+        self.scratch: Optional[tuple] = None
+        #: digest-verified pre-restart mirror (fleet checkpoint restore):
+        #: adopted as this tenant's row at the next stack so the first
+        #: cycle ships a delta instead of contributing to a cold restack
+        self.warm_mirror: Optional[tuple] = None
+        self.full_cycles = 0
+        self.delta_cycles = 0
+        self.last_kind: Optional[str] = None
+        self.last_upload_bytes = 0
+        self.full_upload_bytes = 0
+
+
+class FleetDeltaKernel:
+    """Compiled batched delta-update + cycle entry over tenant-stacked
+    resident buffers.
+
+    The jitted entry takes the three stacked residents ``(B, n_g)``
+    (donated on accelerators, like the flat kernel) plus per-group packed
+    ``(indices, values)`` deltas whose indices are GLOBAL — flattened
+    ``tenant_row * n_g + element`` — so every tenant's delta applies in
+    one flat scatter, then vmaps the cycle over the tenant axis:
+
+        (fbuf', ibuf', bbuf', packed) = fn(fbuf, ibuf, bbuf,
+                                           fidx, fvals, iidx, ivals,
+                                           bidx, bvals)
+
+    ``packed`` is ``(B, P [+ 3])``: each tenant's packed decisions, with
+    its own integrity digest words computed over its own rows — verified
+    per tenant against that tenant's host mirror, exactly the flat
+    kernel's formula.
+    """
+
+    def __init__(self, cycle_fn, example_tree, width: int,
+                 entry: str = "fleet_cycle", integrity: bool = True):
+        self.treedef, self.spec = fuse_spec(example_tree)
+        self.sizes = group_sizes(self.spec)
+        self.width = int(width)
+        self.entry = entry
+        self.digest_words = DIGEST_WORDS if integrity else 0
+        self.donate_argnums = donation_for_backend()
+        unfuse = make_unfuse(self.treedef, self.spec)
+        sizes = self.sizes
+
+        def _one(fbuf, ibuf, bbuf):
+            args = unfuse(fbuf, ibuf, bbuf)
+            packed = cycle_fn(*args).packed_decisions()
+            if integrity:
+                packed = jnp.concatenate(
+                    [packed, _device_digest(fbuf, ibuf, bbuf)])
+            return packed
+
+        leak = _LEAK_FOR_TESTS
+
+        def _batched_cycle(fbuf, ibuf, bbuf,
+                           fidx, fvals, iidx, ivals, bidx, bvals):
+            B = fbuf.shape[0]
+            # one flat scatter per group applies EVERY tenant's delta:
+            # indices are global (row * n + element), the stacked analog
+            # of the flat kernel's buf.at[idx].set(vals)
+            fbuf = fbuf.reshape(B * sizes[0]).at[fidx].set(
+                fvals).reshape(B, sizes[0])
+            ibuf = ibuf.reshape(B * sizes[1]).at[iidx].set(
+                ivals).reshape(B, sizes[1])
+            bbuf = bbuf.reshape(B * sizes[2]).at[bidx].set(
+                bvals).reshape(B, sizes[2])
+            packed = jax.vmap(_one)(fbuf, ibuf, bbuf)
+            if leak:
+                # test-planted cross-tenant data flow (see _LEAK_FOR_TESTS)
+                mix = (jnp.sum(ibuf, dtype=jnp.int32) % jnp.int32(7)
+                       if sizes[1] else jnp.int32(0))
+                packed = packed.at[:, 0].add(mix)
+            return fbuf, ibuf, bbuf, packed
+
+        from ..telemetry import counted_jit
+        self._fn = counted_jit(_batched_cycle, entry,
+                               donate_argnums=self.donate_argnums)
+
+    # ---------------------------------------------------------- graphcheck
+    @property
+    def traceable(self):
+        """The raw (unjitted) batched body, for jaxpr-level analysis
+        (graphcheck ``fleet`` family)."""
+        return self._fn.__wrapped__
+
+    def example_batched_args(self, bucket: int = 0):
+        """Concrete example inputs for tracing: stacked zero residents
+        plus ``bucket``-sized no-op deltas per non-empty group."""
+        args = [np.zeros((self.width, n), _TARGETS[g])
+                for g, n in zip(_GROUPS, self.sizes)]
+        for g, n in zip(_GROUPS, self.sizes):
+            b = bucket if n else 0
+            args.append(np.zeros(b, np.int32))
+            args.append(np.zeros(b, _TARGETS[g]))
+        return tuple(args)
+
+
+class _Bucket:
+    """One shape bucket's live state: the batched kernel (built lazily at
+    the current pow2 width), the ordered member residents, and the stacked
+    device handles."""
+
+    def __init__(self, key: tuple):
+        self.key = key
+        self.kernel: Optional[FleetDeltaKernel] = None
+        self.members: Dict[str, TenantResident] = {}
+        #: tenant order the CURRENT device stack was built for (row r =
+        #: stacked_names[r]); any membership change forces a restack
+        self.stacked_names: Tuple[str, ...] = ()
+        self.device: Optional[tuple] = None
+        self.retiring: tuple = ()
+        #: structural epoch: bumped on every membership/width change — the
+        #: admission/eviction observability hook (a bump never touches
+        #: OTHER buckets' kernels, which is the no-cross-retrace claim)
+        self.epoch = 0
+
+    @property
+    def width(self) -> int:
+        return self.kernel.width if self.kernel is not None else 0
+
+
+def _invalidate(handles) -> None:
+    """Kill retired device handles (the flat kernel's invalidation
+    contract: a host re-read of a consumed resident fails fast)."""
+    for h in handles or ():
+        try:
+            if not h.is_deleted():
+                h.delete()
+        except Exception:
+            pass
+
+
+class TenantPool:
+    """All buckets' resident state plus the batched run loop.
+
+    The pool is the fleet analog of the Session's ``_resident`` dict: the
+    kernels are stateless compiled programs, the residency (stacked device
+    buffers + per-tenant mirrors) lives here, owned by the fleet
+    scheduler that holds the pool.
+    """
+
+    def __init__(self, integrity: bool = True):
+        self.integrity = integrity
+        self.buckets: Dict[tuple, _Bucket] = {}
+        #: tenant name -> bucket key currently holding its residency
+        self.placement: Dict[str, tuple] = {}
+
+    # ------------------------------------------------------------ placement
+    def bucket_of(self, name: str) -> Optional[_Bucket]:
+        key = self.placement.get(name)
+        return self.buckets.get(key) if key is not None else None
+
+    def place(self, name: str, cfg, tree) -> _Bucket:
+        """Route a tenant to its shape bucket for this cycle, migrating
+        its residency if the derived key changed (a structural cluster
+        change moved it to another bucket — only the two touched buckets
+        restack; every other bucket's kernel and residents are
+        untouched)."""
+        key = bucket_key(cfg, tree)
+        old = self.placement.get(name)
+        if old is not None and old != key:
+            self.evict(name)
+        bucket = self.buckets.get(key)
+        if bucket is None:
+            bucket = self.buckets[key] = _Bucket(key)
+        if name not in bucket.members:
+            bucket.members[name] = TenantResident()
+            bucket.stacked_names = ()   # force restack at next run
+            bucket.epoch += 1
+        self.placement[name] = key
+        return bucket
+
+    def evict(self, name: str) -> None:
+        """Drop a tenant's residency (fleet eviction or bucket change).
+        The bucket restacks at its next run; an emptied bucket drops its
+        device handles immediately."""
+        key = self.placement.pop(name, None)
+        bucket = self.buckets.get(key) if key is not None else None
+        if bucket is None:
+            return
+        bucket.members.pop(name, None)
+        bucket.stacked_names = ()
+        bucket.epoch += 1
+        if not bucket.members:
+            _invalidate(bucket.retiring)
+            _invalidate(bucket.device or ())
+            bucket.device = None
+            bucket.retiring = ()
+
+    # ------------------------------------------------------------- running
+    def run_bucket(self, bucket: _Bucket, cycle_fn_builder, cfg,
+                   items: List[Tuple[str, object]],
+                   force_full: bool = False):
+        """One batched cycle for a bucket: pack every tenant's tree, ship
+        one stacked full upload or one flat global delta, dispatch ONCE,
+        verify each tenant's integrity digest, and return
+        ``(rows, failed)`` — each SERVED tenant's packed decision row
+        (digest stripped, host array) plus the tenants whose PACK phase
+        raised (chaos seam / bad tree), mapped to their exception.
+
+        ``items`` is the cycle's (tenant, tree) list in serving order; it
+        must be a subset of ``bucket.members`` (with ``fleet_slots`` the
+        fairness pass serves a rotating subset). A tenant that fails its
+        own pack is EXCLUDED from this cycle's batch — every other tenant
+        still dispatches together, which is the isolation contract: one
+        tenant's fault never costs its bucket-mates their cycle. The
+        caller serves the failed tenants through its per-tenant fallback
+        ladder. On a digest trip the whole bucket recovers in place —
+        full re-stack from the SOURCE trees + recompute, decision-neutral
+        for every tenant (the flat kernel's recovery argument, per row).
+        On a failed dispatch the bucket resets cold and the error
+        propagates to the caller's degradation ladder.
+        """
+        assert set(n for n, _t in items) <= set(bucket.members), \
+            "run_bucket items must be bucket members"
+        cfg_n = normalize_config(cfg)
+        if bucket.kernel is not None:
+            spec, sizes = bucket.kernel.spec, bucket.kernel.sizes
+        else:
+            spec = fuse_spec(items[0][1])[1]
+            sizes = group_sizes(spec)
+
+        # ---- pack (per-tenant fault isolation) ---------------------------
+        packed_bufs: Dict[str, tuple] = {}
+        failed: Dict[str, BaseException] = {}
+        good: List[Tuple[str, object]] = []
+        with _spans.span("fleet.pack"):
+            for name, tree in items:
+                res = bucket.members[name]
+                try:
+                    # per-tenant chaos seam: resident corruption / targeted
+                    # dispatch loss fire here, before this tenant's diff
+                    seam("fleet.tenant", pool=self, bucket=bucket,
+                         tenant=name, resident=res)
+                    bufs = fuse_into(tree, spec, sizes, out=res.scratch)
+                except Exception as e:
+                    failed[name] = e
+                    continue
+                res.scratch = None
+                packed_bufs[name] = bufs
+                good.append((name, tree))
+        names = tuple(n for n, _t in good)
+        if not names:
+            return {}, failed
+
+        width = pow2_bucket(len(names), 1)
+        if bucket.kernel is None or bucket.kernel.width != width:
+            bucket.kernel = FleetDeltaKernel(
+                cycle_fn_builder(cfg_n), good[0][1], width,
+                entry=_entry_name(bucket.key, width),
+                integrity=self.integrity)
+            bucket.stacked_names = ()
+            bucket.epoch += 1
+        kernel = bucket.kernel
+        _invalidate(bucket.retiring)
+        bucket.retiring = ()
+
+        # baseline[name]: the host values this tenant's device row holds
+        # BEFORE the in-graph scatter — the delta ships fresh-vs-baseline.
+        # None = the row stacks directly from the fresh pack (no delta).
+        structural = (force_full or bucket.device is None
+                      or bucket.stacked_names != names
+                      or any(bucket.members[n].mirror is None
+                             for n in names))
+        if structural:
+            baseline = {}
+            for name in names:
+                res = bucket.members[name]
+                wm = None if force_full else res.warm_mirror
+                res.warm_mirror = None
+                # a digest-verified warm mirror (fleet checkpoint restore)
+                # becomes this tenant's row; its first cycle diffs fresh
+                # truth against it — the single-tenant adopt_mirror rule,
+                # per row
+                baseline[name] = wm
+        else:
+            baseline = {n: bucket.members[n].mirror for n in names}
+
+        def _diff(baseline):
+            deltas, total = [], 0
+            for k in range(len(_GROUPS)):
+                idx_parts, val_parts = [], []
+                for r, name in enumerate(names):
+                    base = baseline[name]
+                    if base is None:
+                        continue
+                    new = packed_bufs[name][k]
+                    li = np.flatnonzero(new != base[k]).astype(np.int32)
+                    if li.size:
+                        idx_parts.append(li + np.int32(r * sizes[k]))
+                        val_parts.append(new[li])
+                        total += int(li.size)
+                if idx_parts:
+                    deltas.append((np.concatenate(idx_parts),
+                                   np.concatenate(val_parts)))
+                else:
+                    deltas.append((np.zeros(0, np.int32),
+                                   np.zeros(0, _TARGETS[_GROUPS[k]])))
+            return deltas, total
+
+        with _spans.span("fleet.diff"):
+            deltas, total = _diff(baseline)
+        if not structural and 2 * total >= len(names) * sum(sizes):
+            # a delta this large ships more bytes than a restack would:
+            # take the full path (decisions identical either way)
+            structural = True
+            baseline = {n: None for n in names}
+            deltas, total = _diff(baseline)
+
+        upload = 0
+        if structural:
+            with _spans.span("fleet.upload"):
+                stacked = []
+                for k in range(len(_GROUPS)):
+                    rows = [(baseline[n][k] if baseline[n] is not None
+                             else packed_bufs[n][k]) for n in names]
+                    # pad rows replicate row 0: their outputs are computed
+                    # and discarded; pow2 padding bounds retraces
+                    rows += [rows[0]] * (kernel.width - len(names))
+                    stacked.append(np.ascontiguousarray(np.stack(rows)))
+                _invalidate(bucket.device or ())
+                dev = tuple(jax.device_put(s) for s in stacked)
+            upload += int(sum(s.nbytes for s in stacked))
+        else:
+            dev = bucket.device
+        args = []
+        for k, (idx, vals) in enumerate(deltas):
+            pidx, pvals = _pad_delta(idx, vals, delta_bucket(idx.size))
+            args += [pidx, pvals]
+            upload += int(pidx.nbytes + pvals.nbytes)
+
+        # ---- one dispatch for the whole bucket ---------------------------
+        seam("fleet.dispatch", pool=self, bucket=bucket, tenants=names)
+        try:
+            with _spans.span("fleet.dispatch", cat="dispatch"):
+                fnew, inew, bnew, packed_dev = kernel._fn(*dev, *args)
+            with _spans.span("fleet.readback", cat="wait"):
+                packed = np.asarray(packed_dev)
+        except Exception:
+            self._reset_bucket(bucket)
+            raise
+        bucket.retiring = dev
+        bucket.device = (fnew, inew, bnew)
+        bucket.stacked_names = names
+
+        # ---- per-tenant digest verify + accounting -----------------------
+        per_tenant_upload = max(1, len(names))
+        trip = None
+        out: Dict[str, np.ndarray] = {}
+        for r, name in enumerate(names):
+            res = bucket.members[name]
+            row = packed[r]
+            if kernel.digest_words:
+                dev_digest = np.ascontiguousarray(
+                    row[-kernel.digest_words:]).view(np.uint32)
+                row = row[:-kernel.digest_words]
+                if not np.array_equal(dev_digest,
+                                      host_digest(packed_bufs[name])):
+                    trip = name
+            out[name] = row
+            # ping-pong: the old mirror becomes next cycle's scratch
+            res.scratch, res.mirror = res.mirror, packed_bufs[name]
+            res.last_kind = ("delta" if baseline.get(name) is not None
+                             else "full")
+            res.full_upload_bytes = int(sum(
+                b.nbytes for b in packed_bufs[name]))
+            res.last_upload_bytes = upload // per_tenant_upload
+            if res.last_kind == "full":
+                res.full_cycles += 1
+            else:
+                res.delta_cycles += 1
+        if trip is not None:
+            if force_full:
+                # recovery itself tripped: residency is unrecoverable in
+                # place; reset and let the caller's ladder take over
+                self._reset_bucket(bucket)
+                raise RuntimeError(
+                    f"fleet integrity digest failed for tenant {trip!r} "
+                    f"after full re-stack")
+            METRICS.inc("resident_digest_mismatch_total")
+            METRICS.inc("cycle_recoveries_total",
+                        labels={"reason": "digest", "mode": "fleet_refuse"})
+            _spans.log_event("digest_trip", source="fleet", tenant=trip)
+            with _spans.span("fleet.recover", cat="recovery"):
+                # full re-stack from SOURCE truth + recompute: heals both
+                # divergence directions and is decision-neutral for every
+                # tenant (clean rows recompute to identical decisions)
+                for name in names:
+                    bucket.members[name].mirror = None
+                rows, failed2 = self.run_bucket(
+                    bucket, cycle_fn_builder, cfg, good, force_full=True)
+                failed.update(failed2)
+                return rows, failed
+        return out, failed
+
+    def _reset_bucket(self, bucket: _Bucket) -> None:
+        """After a failed dispatch the stacked residency is indeterminate
+        (donation may or may not have consumed it): drop everything so the
+        next run pays one clean restack."""
+        _invalidate(bucket.retiring)
+        _invalidate(bucket.device or ())
+        bucket.retiring = ()
+        bucket.device = None
+        bucket.stacked_names = ()
+        for res in bucket.members.values():
+            res.mirror = None
+            res.scratch = None

@@ -1,0 +1,522 @@
+"""FleetScheduler: many clusters' scheduling loops through one batched
+dispatch.
+
+Each admitted tenant is a full single-cluster control loop — its own
+FakeCluster source, conf, persistent Session (incremental reopen, exactly
+runtime/scheduler.Scheduler's steady state), ResyncQueue, flight recorder,
+and degradation ladder. What the fleet shares is the DEVICE: every cycle
+the tenants' derived allocate inputs route to shape buckets
+(fleet/pool.TenantPool) and each bucket dispatches ONCE for all its
+members — B same-bucket tenants cost one dispatch instead of B.
+
+Isolation contract (chaos-tested in tests/test_fleet.py):
+
+- decisions: each tenant's packed row comes out of a vmapped cycle that
+  cannot mix rows by construction (graphcheck family ``fleet``), is
+  digest-verified against that tenant's own host mirror, and applies
+  through that tenant's own Session — bit-identical to N independent
+  Schedulers;
+- faults: a tenant whose pack/dispatch faults is served through the
+  per-tenant degradation ladder (sync retry -> CPU oracle, the
+  runtime/scheduler ladder) while its bucket-mates' batched cycle
+  proceeds untouched;
+- structure: admission, eviction, and bucket migration bump ONLY the
+  touched bucket's structural epoch — other buckets keep their compiled
+  kernels and stacked residents (the no-cross-retrace claim, proven by
+  the per-bucket jit trace counters);
+- state: checkpoints are one PR 10 envelope PER TENANT
+  (``tenant-<name>.vckp``); a corrupt file cold-fuses only its owner.
+
+With conf ``fleet_slots`` set, the cross-tenant fairness pass
+(fleet/fairness — the proportion plugin's water-fill lifted one level up)
+picks which tenants each cycle serves; unset, every tenant is served
+every cycle and the fleet is a pure batching transparency layer.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.conf import SchedulerConfiguration, parse_conf
+from ..framework.session import Session
+from ..metrics import METRICS
+from ..ops.allocate_scan import make_allocate_cycle
+from ..runtime.scheduler import ResyncQueue
+from ..telemetry import FlightRecorder, spans
+from . import fairness
+from .pool import TenantPool, _entry_name
+
+
+class Tenant:
+    """One admitted cluster's loop state: everything
+    runtime/scheduler.Scheduler keeps per instance, minus the parts the
+    fleet shares (the pool's device residency and the serving loop)."""
+
+    def __init__(self, name: str, cluster,
+                 conf: Optional[SchedulerConfiguration] = None,
+                 weight: float = 1.0):
+        self.name = name
+        self.cluster = cluster
+        self.conf = conf or parse_conf()
+        self.weight = float(weight)
+        self.session: Optional[Session] = None
+        self.cycles = 0
+        self.full_packs = 0
+        self.incremental_cycles = 0
+        self.incremental = hasattr(cluster, "live_view")
+        self.resync = ResyncQueue()
+        self.flight = FlightRecorder(
+            capacity=int(os.environ.get("VOLCANO_FLIGHT_CYCLES", 64)))
+        self._plugin_state: Dict[str, object] = {}
+        # per-tenant degradation ladder (the runtime/scheduler ladder,
+        # one rung counter per tenant): 0 = batched fleet path, 1 = a
+        # fault was recovered synchronously, 2 = CPU oracle
+        self.degradation_level = 0
+        self.fault_cooldown = int(os.environ.get("VOLCANO_FAULT_COOLDOWN",
+                                                 4))
+        self._degrade_until = 0
+        self._cycle_faults: List[dict] = []
+        #: digest-verified mirrors from a per-tenant checkpoint restore,
+        #: keyed by frozen bucket key; consumed at the next placement
+        self.warm_mirrors: Dict[tuple, tuple] = {}
+        self._last_dirty = (0, 0)
+
+
+class FleetScheduler:
+    """The fleet serving loop over a :class:`TenantPool`."""
+
+    def __init__(self, conf: Optional[SchedulerConfiguration] = None,
+                 integrity: bool = True):
+        #: fleet-level conf: ``fleet_slots`` / ``fleet_checkpoint_dir``
+        #: live here; each tenant still schedules under its OWN conf
+        self.conf = conf or parse_conf()
+        self.tenants: Dict[str, Tenant] = {}
+        self.pool = TenantPool(integrity=integrity)
+        self.cycles = 0
+        #: cumulative cycles served per tenant — the fairness deficit
+        #: counters (fleet/fairness.record_served)
+        self.served: Dict[str, float] = {}
+
+    # ------------------------------------------------- admission / eviction
+    def admit(self, name: str, cluster,
+              conf: Optional[SchedulerConfiguration] = None,
+              weight: float = 1.0) -> Tenant:
+        """Admit a tenant at runtime. Its bucket (joined lazily at its
+        first served cycle) restacks; no other bucket is touched."""
+        if name in self.tenants:
+            raise ValueError(f"tenant {name!r} already admitted")
+        t = Tenant(name, cluster, conf=conf, weight=weight)
+        self.tenants[name] = t
+        METRICS.inc("fleet_admissions_total", labels={"event": "admit"})
+        METRICS.set_gauge("fleet_tenants", None, len(self.tenants))
+        spans.log_event("fleet_admission", event="admit", tenant=name,
+                        weight=weight, tenants=len(self.tenants))
+        return t
+
+    def evict(self, name: str) -> None:
+        """Evict a tenant: its residency leaves its bucket (which
+        restacks); every other bucket's kernel and residents survive."""
+        t = self.tenants.pop(name, None)
+        if t is None:
+            return
+        self.pool.evict(name)
+        self.served.pop(name, None)
+        METRICS.inc("fleet_admissions_total", labels={"event": "evict"})
+        METRICS.set_gauge("fleet_tenants", None, len(self.tenants))
+        spans.log_event("fleet_admission", event="evict", tenant=name,
+                        tenants=len(self.tenants))
+
+    # --------------------------------------------------- per-tenant session
+    def _persistent_plugins(self, t: Tenant) -> Dict[str, object]:
+        from ..plugins.reservation import ReservationPlugin
+        from ..plugins.tdm import TDMPlugin
+        overrides = {}
+        for name, cls in (("reservation", ReservationPlugin),
+                          ("tdm", TDMPlugin)):
+            if t.conf.plugin_option(name) is not None:
+                if name not in t._plugin_state:
+                    t._plugin_state[name] = cls(t.conf.plugin_option(name))
+                overrides[name] = t._plugin_state[name]
+        return overrides
+
+    def _open_session(self, t: Tenant, now: Optional[float]) -> Session:
+        """Open this tenant's cycle session — Scheduler._open_session per
+        tenant: one persistent Session re-opened incrementally from the
+        cluster's dirty marks; full pack only on the first cycle, on
+        structural changes, or on a documented refresh fallback."""
+        overrides = self._persistent_plugins(t)
+        if not t.incremental:
+            return Session(t.cluster.snapshot(), t.conf, now=now,
+                           plugin_overrides=overrides)
+        dj, dn, structural = t.cluster.drain_dirty()
+        t._last_dirty = (len(dj), len(dn))
+        ssn = t.session
+        if ssn is None or structural:
+            ssn = Session(t.cluster.live_view(), t.conf, now=now,
+                          plugin_overrides=overrides)
+            t.session = ssn
+            t.full_packs += 1
+            return ssn
+        for uid in dj:
+            ssn._dirty_jobs.add(uid)
+        for name in dn:
+            ssn._dirty_nodes.add(name)
+        if ssn.reopen(now=now, conf=t.conf, plugin_overrides=overrides):
+            t.incremental_cycles += 1
+        else:
+            t.full_packs += 1
+        return ssn
+
+    # ------------------------------------------------------- fault handling
+    def _note_fault(self, t: Tenant, stage: str, exc: BaseException) -> None:
+        METRICS.inc("cycle_faults_total", labels={"stage": stage})
+        t._cycle_faults.append(
+            dict(stage=stage, error=f"{type(exc).__name__}: {exc}"))
+
+    def _degrade(self, t: Tenant, level: int) -> None:
+        prev = t.degradation_level
+        t.degradation_level = max(t.degradation_level, level)
+        if t.degradation_level != prev:
+            spans.log_event("degradation", tenant=t.name, level_from=prev,
+                            level_to=t.degradation_level, cycle=t.cycles)
+        t._degrade_until = t.cycles + t.fault_cooldown
+        METRICS.set_gauge("fleet_tenant_degradation", {"tenant": t.name},
+                          t.degradation_level)
+
+    def _allocate_fallback(self, t: Tenant, ssn: Session,
+                           exc: BaseException):
+        """This tenant's batched serving faulted (pack seam, bucket
+        dispatch, or digest-unrecoverable): walk ITS ladder alone — the
+        single-tenant compiled path, then the CPU oracle. Decisions stay
+        bit-identical on every rung, so a faulted tenant degrades in
+        latency only; its bucket-mates never see any of this."""
+        self._note_fault(t, "fleet_allocate", exc)
+        t0 = time.time()
+        with spans.span("cycle.recovery", cat="recovery"):
+            try:
+                result = ssn.run_allocate()
+                mode = "sync"
+                self._degrade(t, 1)
+            except Exception as e:
+                self._note_fault(t, "sync_retry", e)
+                result = ssn.run_allocate_oracle()
+                mode = "cpu_oracle"
+                self._degrade(t, 2)
+        METRICS.inc("cycle_recoveries_total",
+                    labels={"reason": "dispatch", "mode": mode})
+        spans.log_event("recovery", stage="fleet_allocate", mode=mode,
+                        tenant=t.name, cycle=t.cycles,
+                        recovery_ms=round((time.time() - t0) * 1000, 3))
+        return result
+
+    # ------------------------------------------------------------ the cycle
+    def run_once(self, now: Optional[float] = None) -> Dict[str, Session]:
+        """One fleet cycle: fairness pick -> per-tenant open + pre-allocate
+        actions -> bucket-grouped batched allocate (ONE dispatch per
+        bucket) -> per-tenant apply + flush. Returns {tenant: Session} for
+        the tenants served this cycle."""
+        t_open = time.time()
+        wall = now if now is not None else t_open
+        from ..chaos.inject import seam
+        seam("fleet.cycle", cycle=self.cycles, fleet=self)
+        slots = getattr(self.conf, "fleet_slots", None)
+        weights = {n: t.weight for n, t in self.tenants.items()}
+        picked = fairness.pick_served(weights, self.served, slots)
+
+        # ---- open + pre-allocate actions, group by bucket ---------------
+        from ..actions import get_action
+        entries = []            # dicts: tenant, ssn, cfg, tree, T, J, t0
+        by_bucket: Dict[tuple, list] = {}
+        for name in picked:
+            t = self.tenants[name]
+            t0 = time.time()
+            if t.degradation_level and t.cycles >= t._degrade_until:
+                spans.log_event("degradation", tenant=name,
+                                level_from=t.degradation_level, level_to=0,
+                                cycle=t.cycles)
+                t.degradation_level = 0
+                METRICS.set_gauge("fleet_tenant_degradation",
+                                  {"tenant": name}, 0)
+            if len(t.resync):
+                rs = t.resync.process(t.cluster, wall)
+                METRICS.inc("resync_retried", rs["retried"])
+                METRICS.inc("resync_succeeded", rs["succeeded"])
+                METRICS.inc("resync_dropped", rs["dropped"])
+                if rs["dead_lettered"]:
+                    METRICS.inc("resync_dead_letter_total",
+                                rs["dead_lettered"])
+            with spans.span("cycle.open", tenant=name):
+                ssn = self._open_session(t, now)
+            actions = list(t.conf.actions)
+            batched = bool(actions) and actions[-1] == "allocate"
+            entry = dict(tenant=t, ssn=ssn, t0=t0, batched=batched)
+            try:
+                for aname in (actions[:-1] if batched else actions):
+                    ta = time.time()
+                    with spans.span(f"action.{aname}", tenant=name):
+                        try:
+                            get_action(aname).execute(ssn)
+                        except Exception as e:
+                            if aname != "allocate":
+                                raise
+                            # non-batched tenant's compiled allocate
+                            # failed mid-action: its own ladder
+                            self._allocate_fallback(t, ssn, e)
+                    METRICS.observe_action(aname, time.time() - ta)
+            except Exception as e:
+                # a non-allocate action raised: this tenant's cycle is
+                # unservable — retire it without decisions; the fleet
+                # keeps serving everyone else
+                self._note_fault(t, "action", e)
+                METRICS.inc("cycle_dropped_total")
+                ssn.stats["cycle_dropped"] = 1.0
+                self._finish_tenant(t, ssn, time.time() - t0, wall)
+                continue
+            if batched:
+                with spans.span("session.extras", tenant=name):
+                    cfg, extras = ssn.allocate_inputs()
+                tree = (ssn.snap, extras)
+                entry.update(
+                    cfg=cfg, tree=tree,
+                    T=int(np.asarray(ssn.snap.tasks.status).shape[0]),
+                    J=int(np.asarray(ssn.snap.jobs.valid).shape[0]))
+                bucket = self.pool.place(name, cfg, tree)
+                if t.warm_mirrors:
+                    from ..runtime.checkpoint import _freeze_key
+                    mir = t.warm_mirrors.pop(_freeze_key(bucket.key), None)
+                    if mir is not None:
+                        bucket.members[name].warm_mirror = mir
+                by_bucket.setdefault(self.pool.placement[name],
+                                     []).append(entry)
+            entries.append(entry)
+
+        # ---- one dispatch per bucket ------------------------------------
+        for key, group in by_bucket.items():
+            bucket = self.pool.buckets[key]
+            items = [(e["tenant"].name, e["tree"]) for e in group]
+            try:
+                rows, failed = self.pool.run_bucket(
+                    bucket, make_allocate_cycle, group[0]["cfg"], items)
+            except Exception as e:
+                # the whole-bucket dispatch failed (backend loss): every
+                # member walks its own ladder; buckets are independent,
+                # so other buckets' dispatches proceed normally
+                rows, failed = {}, {e2["tenant"].name: e for e2 in group}
+            for e in group:
+                t, ssn, name = e["tenant"], e["ssn"], e["tenant"].name
+                row = rows.get(name)
+                if row is not None:
+                    try:
+                        ta = time.time()
+                        with spans.span("fleet.apply", tenant=name):
+                            result = ssn.apply_packed(
+                                np.ascontiguousarray(row), e["T"], e["J"])
+                        spans.record_tenant_phase(
+                            name, "apply", (time.time() - ta) * 1000.0)
+                    except Exception as exc:
+                        result = self._allocate_fallback(t, ssn, exc)
+                else:
+                    result = self._allocate_fallback(
+                        t, ssn, failed.get(name,
+                                           RuntimeError("not served")))
+                ssn.stats["allocated_binds"] = len(ssn.binds)
+                ssn.stats["jobs_ready"] = int(
+                    np.asarray(result.job_ready).sum())
+                ssn.stats["jobs_pipelined"] = int(
+                    np.asarray(result.job_pipelined).sum())
+
+        # ---- per-tenant flush (cluster writes never cross tenants) ------
+        out = {}
+        for e in entries:
+            t, ssn = e["tenant"], e["ssn"]
+            if ssn.stats.get("cycle_dropped"):
+                continue        # already retired above
+            self._finish_tenant(t, ssn, time.time() - e["t0"], wall)
+            out[t.name] = ssn
+        fairness.record_served(self.served, [e["tenant"].name
+                                             for e in entries])
+        self.cycles += 1
+        ckpt_dir = getattr(self.conf, "fleet_checkpoint_dir", None)
+        if ckpt_dir:
+            self.checkpoint(ckpt_dir, now=wall)
+        return out
+
+    def _finish_tenant(self, t: Tenant, ssn: Session, host_s: float,
+                       wall: float) -> None:
+        """Scheduler._finish_cycle per tenant: close, write back phases,
+        flush intents against THIS tenant's cluster (failures retry on
+        this tenant's ResyncQueue), metrics, and a flight record carrying
+        the tenant label + this tenant's share of the batched upload."""
+        with spans.span("cycle.finish", tenant=t.name):
+            ssn.close()
+            t.cluster.update_podgroup_phases(ssn.phase_updates)
+            for intent in ssn.evictions:
+                if not t.cluster.evict(intent):
+                    METRICS.inc("resync_tasks")
+                    t.resync.add(intent, "evict", wall)
+            for intent in ssn.binds:
+                if not t.cluster.bind(intent):
+                    METRICS.inc("resync_tasks")
+                    t.cluster.hold_binding(intent)
+                    t.resync.add(intent, "bind", wall)
+        METRICS.observe_cycle(host_s)
+        spans.record_tenant_phase(t.name, "tenant_cycle", host_s * 1000.0)
+        METRICS.inc("schedule_attempts")
+        result = ("error" if ssn.bind_errors
+                  else "scheduled" if (ssn.binds or ssn.pipelined)
+                  else "unschedulable")
+        METRICS.inc("schedule_attempts_total", labels={"result": result})
+        METRICS.inc("fleet_cycles_total", labels={"tenant": t.name})
+        from ..telemetry import publish_gauges
+        publish_gauges(METRICS)
+        spans.publish_gauges(METRICS)
+        t.cycles += 1
+        bucket = self.pool.bucket_of(t.name)
+        res = bucket.members.get(t.name) if bucket is not None else None
+        stats = ssn.stats
+        faults, t._cycle_faults = t._cycle_faults, []
+        t.flight.record(
+            now=wall, cycle=t.cycles, tenant=t.name,
+            cycle_ms=round(host_s * 1000, 3),
+            binds=len(ssn.binds), evictions=len(ssn.evictions),
+            pipelined=len(ssn.pipelined), bind_errors=len(ssn.bind_errors),
+            resync_pending=len(t.resync), result=result,
+            faults=faults or None, degradation=t.degradation_level,
+            resync_dead_letter=len(t.resync.dead),
+            fleet_bucket=(_entry_name(bucket.key, bucket.width)
+                          if bucket is not None and bucket.kernel else None),
+            fleet_epoch=bucket.epoch if bucket is not None else None,
+            cycle_kind=res.last_kind if res is not None else None,
+            upload_bytes=(res.last_upload_bytes if res is not None
+                          else stats.get("upload_bytes")),
+            upload_bytes_full=(res.full_upload_bytes if res is not None
+                               else stats.get("upload_bytes_full")),
+            dirty_jobs=t._last_dirty[0], dirty_nodes=t._last_dirty[1],
+            stats={k: round(float(v), 3) for k, v in stats.items()},
+            telemetry=ssn.last_telemetry or None,
+            spans=spans.drain_cycle_summary())
+
+    def run(self, cycles: int = 1,
+            now: Optional[float] = None) -> List[Dict[str, Session]]:
+        out = []
+        for i in range(cycles):
+            out.append(self.run_once(
+                now=(now + i) if now is not None else None))
+        return out
+
+    # -------------------------------------------------------- observability
+    def fleet_snapshot(self) -> dict:
+        """The dashboard's /api/fleet payload: every tenant with its
+        bucket, serving counters, and degradation rung."""
+        tenants = []
+        for name in sorted(self.tenants):
+            t = self.tenants[name]
+            bucket = self.pool.bucket_of(name)
+            res = bucket.members.get(name) if bucket is not None else None
+            tenants.append(dict(
+                tenant=name, weight=t.weight, cycles=t.cycles,
+                served=self.served.get(name, 0.0),
+                degradation=t.degradation_level,
+                bucket=(_entry_name(bucket.key, bucket.width)
+                        if bucket is not None and bucket.kernel else None),
+                bucket_width=bucket.width if bucket is not None else 0,
+                bucket_epoch=bucket.epoch if bucket is not None else None,
+                cycle_kind=res.last_kind if res is not None else None,
+                full_cycles=res.full_cycles if res is not None else 0,
+                delta_cycles=res.delta_cycles if res is not None else 0,
+                full_packs=t.full_packs,
+                incremental_cycles=t.incremental_cycles,
+                resync_pending=len(t.resync),
+                resync_dead_letter=len(t.resync.dead)))
+        return dict(cycles=self.cycles,
+                    slots=getattr(self.conf, "fleet_slots", None),
+                    buckets=len(self.pool.buckets),
+                    tenants=tenants)
+
+    # ------------------------------------------- per-tenant checkpointing
+    def checkpoint(self, directory: str,
+                   now: Optional[float] = None) -> Dict[str, dict]:
+        """One PR 10 envelope per tenant under ``directory``
+        (``tenant-<name>.vckp``): loop counters, retry state, and the
+        tenant's digest-stamped resident mirror. Independent files are
+        the isolation property: damage to one tenant's file can only
+        cold-fuse that tenant."""
+        from ..runtime import checkpoint as ckpt
+        os.makedirs(directory, exist_ok=True)
+        out = {}
+        for name in sorted(self.tenants):
+            t = self.tenants[name]
+            bucket = self.pool.bucket_of(name)
+            res = bucket.members.get(name) if bucket is not None else None
+            mirrors = []
+            if res is not None and res.mirror is not None:
+                from ..ops.fused_io import host_digest
+                mirror = tuple(np.array(b, copy=True) for b in res.mirror)
+                mirrors = [{"key": bucket.key, "mirror": mirror,
+                            "digest": [int(x) for x in host_digest(mirror)]}]
+            state = dict(
+                name=name, weight=t.weight, cycles=t.cycles,
+                full_packs=t.full_packs,
+                incremental_cycles=t.incremental_cycles,
+                degradation_level=t.degradation_level,
+                degrade_until=t._degrade_until,
+                served=self.served.get(name, 0.0),
+                conf_fingerprint=ckpt.conf_fingerprint(t.conf),
+                resync_entries=[dict(e) for e in t.resync.entries],
+                resync_dead=[dict(e) for e in t.resync.dead],
+                metrics=ckpt.metrics_snapshot())
+            out[name] = ckpt.write_checkpoint(
+                ckpt.tenant_checkpoint_path(directory, name),
+                "fleet-tenant", state, mirrors=mirrors)
+        return out
+
+    def restore(self, directory: str,
+                now: Optional[float] = None) -> Dict[str, str]:
+        """Restore every admitted tenant from its own envelope. Outcomes
+        per tenant (``checkpoint_restore_total{outcome=...}``): a missing
+        file is a cold start, a damaged or conf-mismatched file falls
+        back to cold — and ONLY that tenant does; a corrupt envelope
+        never stalls the fleet. Returns {tenant: outcome}."""
+        from ..runtime import checkpoint as ckpt
+        wall = now if now is not None else time.time()
+        out = {}
+        for name in sorted(self.tenants):
+            t = self.tenants[name]
+            t0 = time.time()
+            env, reason = ckpt.load_checkpoint(
+                ckpt.tenant_checkpoint_path(directory, name),
+                "fleet-tenant")
+            if env is None:
+                outcome = "cold" if reason == "missing" else "fallback"
+                ckpt.record_restore(outcome, reason, f"fleet:{name}",
+                                    (time.time() - t0) * 1000)
+                out[name] = outcome
+                continue
+            state = env["state"]
+            if state.get("conf_fingerprint") != \
+                    ckpt.conf_fingerprint(t.conf):
+                ckpt.record_restore("fallback", "conf_mismatch",
+                                    f"fleet:{name}",
+                                    (time.time() - t0) * 1000)
+                out[name] = "fallback"
+                continue
+            t.cycles = int(state["cycles"])
+            t.full_packs = int(state["full_packs"])
+            t.incremental_cycles = int(state["incremental_cycles"])
+            t.degradation_level = int(state["degradation_level"])
+            t._degrade_until = int(state["degrade_until"])
+            self.served[name] = float(state.get("served", 0.0))
+            t.resync.entries = [dict(e) for e in state["resync_entries"]]
+            t.resync.dead = [dict(e) for e in state["resync_dead"]]
+            ckpt.merge_metrics(state.get("metrics"))
+            t.session = None
+            t.warm_mirrors = ckpt.verify_mirrors(env.get("mirrors"))
+            t.resync.redrive(wall)
+            ckpt.record_restore("restored", "ok", f"fleet:{name}",
+                                (time.time() - t0) * 1000)
+            out[name] = "restored"
+        return out

@@ -132,6 +132,19 @@ class SchedulerConfiguration:
     #: None (default) keeps the pure-XLA scan. YAML: top-level
     #: ``use_pallas: interpret``.
     use_pallas: Optional[object] = None
+    #: fleet runtime (volcano_tpu/fleet): max tenants served per fleet
+    #: cycle. None (default) serves every admitted tenant each cycle; a
+    #: finite value makes the cross-tenant fairness pass (the proportion
+    #: plugin's weighted water-fill lifted one level up) pick the
+    #: highest-deficit tenants under load. YAML: top-level
+    #: ``fleet_slots: 8``.
+    fleet_slots: Optional[int] = None
+    #: fleet per-tenant checkpoint directory (one PR 10 envelope per
+    #: tenant, ``tenant-<name>.vckp`` — a corrupt file cold-fuses only its
+    #: own tenant). None = checkpointing only via explicit
+    #: FleetScheduler.checkpoint(dir) calls. YAML: top-level
+    #: ``fleet_checkpoint_dir: /var/run/volcano``.
+    fleet_checkpoint_dir: Optional[str] = None
 
     def plugin_option(self, name: str) -> Optional[PluginOption]:
         for tier in self.tiers:
@@ -187,6 +200,10 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     sd = data.get("sharding_devices")
     sc.sharding_devices = int(sd) if sd is not None else None
     sc.use_pallas = data.get("use_pallas")
+    fs = data.get("fleet_slots")
+    sc.fleet_slots = int(fs) if fs is not None else None
+    fcd = data.get("fleet_checkpoint_dir")
+    sc.fleet_checkpoint_dir = str(fcd) if fcd else None
     raw_actions = data.get("actions", "enqueue, allocate, backfill")
     if isinstance(raw_actions, str):
         sc.actions = [a.strip() for a in raw_actions.split(",") if a.strip()]

@@ -790,6 +790,14 @@ class Session:
                                           job_ready, job_pipelined))
         return result
 
+    def allocate_inputs(self):
+        """Public (cfg, extras) exactly as :meth:`dispatch_allocate`
+        derives them — the fleet runtime (volcano_tpu/fleet) derives its
+        shape-bucket keys and batched argument trees from this, so the
+        batched cycle consumes bit-identical inputs to the single-tenant
+        dispatch."""
+        return self._derived_allocate_inputs()
+
     def _derived_allocate_inputs(self):
         """(cfg, extras) exactly as the dispatched cycle consumes them.
 
@@ -1020,10 +1028,6 @@ class Session:
         t0 = time.time()
         cfg, T, J = pending.cfg, pending.T, pending.J
         packed = self._readback_packed(pending)
-        from ..ops.allocate_scan import unpack_decisions
-        with _spans.span("session.unpack"):
-            (task_node, task_mode, task_gpu, job_ready, job_pipelined,
-             job_attempted) = unpack_decisions(packed, T, J)
         self.stats["kernel_ms"] = (pending.dispatch_ms
                                    + (time.time() - t0) * 1000)
         if cfg.telemetry and packed.shape[0] > 3 * T + 3 * J:
@@ -1035,6 +1039,18 @@ class Session:
             tel = unpack_cycle_telemetry(packed[3 * T + 3 * J:], pending.R)
             self.last_telemetry["allocate"] = tel
             publish_cycle_telemetry(tel)
+        return self.apply_packed(packed, T, J)
+
+    def apply_packed(self, packed: np.ndarray, T: int, J: int):
+        """Decode a packed decision vector (integrity digest already
+        stripped) and apply it to this session — the shared tail of
+        :meth:`complete_allocate`, also the entry the fleet runtime
+        (volcano_tpu/fleet) uses after its batched readback handed each
+        tenant its own row of decisions."""
+        from ..ops.allocate_scan import unpack_decisions
+        with _spans.span("session.unpack"):
+            (task_node, task_mode, task_gpu, job_ready, job_pipelined,
+             job_attempted) = unpack_decisions(packed, T, J)
         import types
         result = types.SimpleNamespace(
             task_node=task_node, task_mode=task_mode, task_gpu=task_gpu,

@@ -156,6 +156,19 @@ _HELP = {
     "sidecar_not_leader_total":
         "Sidecar rounds rejected with ERR_NOT_LEADER because their "
         "fencing token was superseded",
+    # multi-tenant fleet runtime (volcano_tpu/fleet)
+    "fleet_tenants":
+        "Tenants currently admitted to the fleet scheduler",
+    "fleet_cycles_total":
+        "Fleet serving cycles completed, by tenant",
+    "fleet_admissions_total":
+        "Fleet admission-control events, by event (admit / evict)",
+    "fleet_tenant_degradation":
+        "Per-tenant degradation ladder rung: 0 batched fleet path, "
+        "1 sync retry, 2 cpu-oracle",
+    "sidecar_replay_evictions_total":
+        "Per-tenant sidecar replay-cache epochs evicted by the bounded "
+        "LRU (VOLCANO_SIDECAR_EPOCH_CAP)",
 }
 
 

@@ -184,15 +184,23 @@ def _shape_key(tree, key_extra=None):
 # Delta path: device-resident buffers, donated update+cycle, O(dirty) upload
 # --------------------------------------------------------------------------
 
-def delta_bucket(n: int) -> int:
-    """Pad a delta of ``n`` elements up to its compile bucket (0 stays 0 —
-    a zero-length scatter is a static no-op shape)."""
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power of two >= ``n``, starting from ``minimum`` (0 stays
+    0). The shared shape-bucketing rule: delta sizes pad with
+    ``minimum=_DELTA_MIN_BUCKET`` and the fleet runtime pads its tenant
+    axis with ``minimum=1`` — both bound retraces to O(log n) programs."""
     if n <= 0:
         return 0
-    b = _DELTA_MIN_BUCKET
+    b = int(minimum)
     while b < n:
         b <<= 1
     return b
+
+
+def delta_bucket(n: int) -> int:
+    """Pad a delta of ``n`` elements up to its compile bucket (0 stays 0 —
+    a zero-length scatter is a static no-op shape)."""
+    return pow2_bucket(n, _DELTA_MIN_BUCKET)
 
 
 def _pad_delta(idx: np.ndarray, vals: np.ndarray, bucket: int):

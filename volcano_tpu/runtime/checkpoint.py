@@ -162,6 +162,18 @@ def load_checkpoint(path: str, kind: str) -> Tuple[Optional[dict], str]:
     return envelope, "ok"
 
 
+def tenant_checkpoint_path(directory: str, tenant: str) -> str:
+    """Per-tenant fleet envelope path: ``<dir>/tenant-<name>.vckp``, one
+    file per tenant so a corrupt restore is contained to its owner (the
+    fleet restore ladder treats each file independently). Tenant names are
+    sanitized to a filename-safe subset; collisions after sanitization are
+    disambiguated with a short content hash."""
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in tenant)
+    if safe != tenant:
+        safe += "-" + hashlib.sha256(tenant.encode()).hexdigest()[:8]
+    return os.path.join(directory, f"tenant-{safe}.vckp")
+
+
 def record_restore(outcome: str, reason: str, source: str,
                    restore_ms: Optional[float] = None) -> None:
     """The one place the restore ladder lands: the labeled counter plus a

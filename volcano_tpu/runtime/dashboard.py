@@ -10,10 +10,12 @@ JSON API (``/api/page``), a Prometheus exposition passthrough
 (``/api/telemetry`` — per-cycle snapshots; /metrics stays cumulative),
 ``/healthz``, the span tracer's Chrome trace-event export
 (``/api/trace`` — load it in Perfetto; the ``latency``/``pipeline``
-tables below render the same rings server-side), and the scenario
+tables below render the same rings server-side), the scenario
 quality registry (``/api/scenarios`` — one scorecard per scenario run,
 mirrored by the ``scenarios`` table and the ``volcano_quality_*``
-gauges).
+gauges), and the fleet tenant roster (``/api/fleet`` — per-tenant
+bucket, serving counters, and degradation rung when the system serves
+a multi-tenant fleet; mirrored by the ``fleet`` table).
 """
 
 from __future__ import annotations
@@ -43,9 +45,16 @@ class Page:
 
 
 def build_page(system, now: Optional[float] = None) -> Page:
-    """Poll the API server's stores into display tables."""
-    api = system.api
+    """Poll the API server's stores into display tables.
+
+    ``system`` is normally a VolcanoSystem; anything duck-typed works —
+    the API-store tables need ``system.api``, and a system without one
+    (e.g. a bare FleetScheduler) still gets the telemetry / latency /
+    scenario / fleet / HA tables its surfaces feed."""
+    api = getattr(system, "api", None)
     page = Page(built_at=now if now is not None else time.time())
+    if api is None:
+        return _build_runtime_tables(system, page)
 
     jobs = []
     for job in sorted(api.list("jobs"), key=lambda j: j.key):
@@ -96,6 +105,14 @@ def build_page(system, now: Optional[float] = None) -> Page:
                     "Status"],
         "rows": nodes}
 
+    return _build_runtime_tables(system, page)
+
+
+def _build_runtime_tables(system, page: Page) -> Page:
+    """The tables fed by runtime surfaces rather than API stores:
+    flight-recorder telemetry, scenario scorecards, fleet roster, HA
+    signals, and span-ring latency/occupancy. Shared by the full
+    VolcanoSystem page and the api-less (fleet-only) page."""
     # ---- cycle telemetry (flight-recorder ring, newest first) ------------
     flight = _flight_of(system)
     if flight is not None:
@@ -111,6 +128,7 @@ def build_page(system, now: Optional[float] = None) -> Page:
             degr = e.get("degradation")
             rows.append([
                 e.get("cycle", "-"),
+                e.get("tenant", "-"),
                 time.strftime("%H:%M:%S",
                               time.localtime(e.get("wall_ts", 0))),
                 e.get("cycle_ms", "-"), e.get("binds", "-"),
@@ -124,10 +142,10 @@ def build_page(system, now: Optional[float] = None) -> Page:
                 degr if degr is not None else "-",
             ])
         page.tables["telemetry"] = {
-            "headers": ["Cycle", "Time", "ms", "Binds", "Evictions",
-                        "Result", "Rounds", "Pops", "PredRejects",
-                        "Unplaced", "ArgmaxTies", "Mesh", "Reshard",
-                        "Degr"],
+            "headers": ["Cycle", "Tenant", "Time", "ms", "Binds",
+                        "Evictions", "Result", "Rounds", "Pops",
+                        "PredRejects", "Unplaced", "ArgmaxTies", "Mesh",
+                        "Reshard", "Degr"],
             "rows": rows}
 
     # ---- scheduling-quality scorecards (volcano_tpu/scenarios) ----------
@@ -137,7 +155,8 @@ def build_page(system, now: Optional[float] = None) -> Page:
         for c in reversed(cards[-16:]):
             waits = c.get("wait_cycles") or {}
             rows.append([
-                c.get("scenario", "-"), c.get("seed", "-"),
+                c.get("scenario", "-"), c.get("tenant") or "-",
+                c.get("seed", "-"),
                 c.get("cycles", "-"),
                 c.get("jobs_completed", "-"),
                 c.get("makespan_cycles", "-"),
@@ -151,10 +170,27 @@ def build_page(system, now: Optional[float] = None) -> Page:
                 c.get("event_sha", "-"),
             ])
         page.tables["scenarios"] = {
-            "headers": ["Scenario", "Seed", "Cycles", "Completed",
-                        "Makespan", "DRF err", "Util", "Churn",
-                        "Wait p50", "Wait p95", "Wait p99", "Drift ok",
-                        "Event sha"],
+            "headers": ["Scenario", "Tenant", "Seed", "Cycles",
+                        "Completed", "Makespan", "DRF err", "Util",
+                        "Churn", "Wait p50", "Wait p95", "Wait p99",
+                        "Drift ok", "Event sha"],
+            "rows": rows}
+
+    # ---- fleet serving (multi-tenant batched cycle) ---------------------
+    fleet = _fleet_snapshot(system)
+    if fleet and fleet.get("tenants"):
+        rows = []
+        for t in fleet["tenants"]:
+            rows.append([t["tenant"], t["weight"], t["cycles"],
+                         t["served"], t["bucket"] or "-",
+                         t["bucket_width"], t["cycle_kind"] or "-",
+                         t["full_cycles"], t["delta_cycles"],
+                         t["degradation"], t["resync_pending"],
+                         t["resync_dead_letter"]])
+        page.tables["fleet"] = {
+            "headers": ["Tenant", "Weight", "Cycles", "Served", "Bucket",
+                        "Width", "Kind", "Full", "Delta", "Degr",
+                        "Resync", "DeadLetter"],
             "rows": rows}
 
     # ---- high availability (leader lease / replication / failover) ------
@@ -167,11 +203,16 @@ def build_page(system, now: Optional[float] = None) -> Page:
     # ---- latency breakdown (span rings) + pipeline occupancy -------------
     stats = _spans.phase_stats()
     if stats:
+        lat_rows = [["-", ph, st["count"], st["p50"], st["p95"],
+                     st["p99"], st["last"]] for ph, st in stats.items()]
+        for tenant, phases in _spans.tenant_phase_stats().items():
+            lat_rows.extend([tenant, ph, st["count"], st["p50"],
+                             st["p95"], st["p99"], st["last"]]
+                            for ph, st in phases.items())
         page.tables["latency"] = {
-            "headers": ["Phase", "Count", "p50 ms", "p95 ms", "p99 ms",
-                        "Last ms"],
-            "rows": [[ph, st["count"], st["p50"], st["p95"], st["p99"],
-                      st["last"]] for ph, st in stats.items()]}
+            "headers": ["Tenant", "Phase", "Count", "p50 ms", "p95 ms",
+                        "p99 ms", "Last ms"],
+            "rows": lat_rows}
         occ = _spans.occupancy()
         if occ.get("windows"):
             occ_rows = [["all", occ["windows"], occ["window_ms"],
@@ -232,6 +273,22 @@ def _scenario_results():
         return _quality.results()
     except Exception:  # noqa: BLE001 — observability must not 500 the page
         return []
+
+
+def _fleet_snapshot(system):
+    """The fleet scheduler's snapshot behind a system-ish object: a
+    FleetScheduler itself, or anything exposing one as ``.fleet`` /
+    ``.scheduler`` — empty dict when nothing fleet-shaped is present
+    (single-cluster dashboards are unchanged)."""
+    for obj in (system, getattr(system, "fleet", None),
+                getattr(system, "scheduler", None)):
+        fn = getattr(obj, "fleet_snapshot", None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:  # noqa: BLE001 — observability must not 500
+                return {}
+    return {}
 
 
 def _flight_of(system):
@@ -325,6 +382,12 @@ class Dashboard:
                     # volcano_quality_* gauges on /metrics
                     self._send(json.dumps(
                         {"scorecards": _scenario_results()}),
+                        "application/json")
+                elif self.path == "/api/fleet":
+                    # the fleet scheduler's tenant roster, always live:
+                    # per-tenant bucket, serving counters, degradation
+                    self._send(json.dumps(
+                        _fleet_snapshot(dashboard.system)),
                         "application/json")
                 elif self.path == "/api/trace":
                     # the span tracer's Chrome trace-event export, always

@@ -40,6 +40,7 @@ import socket
 import socketserver
 import struct
 import threading
+from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -98,7 +99,46 @@ ERR_NOT_LEADER = 7       # structured, like ERR_EPOCH_RESTORED: a VCRF
 #                          down), not to resend with the same token; a
 #                          RE-ELECTED caller retries with its new,
 #                          higher generation and is admitted.
+TENANT_MAGIC = 0x54524356  # "VCRT" — fleet tenancy prefix (ISSUE 12):
+#                          u32 magic | u32 tenant_id, composable with the
+#                          VCRF fence prefix (either order), followed by
+#                          an ordinary request frame. Each tenant id gets
+#                          its OWN serving stream — pipeline slot, VCRQ
+#                          replay cache, known-epoch set — so interleaved
+#                          tenants' one-deep streams can never hand one
+#                          tenant another tenant's decisions. Absent
+#                          prefix = tenant 0, the single-tenant protocol
+#                          unchanged.
 _u32 = struct.Struct("<I")
+
+
+def tenant_wire_id(name: str) -> int:
+    """Stable u32 wire id for a tenant name (sha256 prefix). 0 is
+    reserved for the un-prefixed single-tenant stream; a name that
+    hashes to 0 is nudged to 1."""
+    import hashlib
+    wid = int.from_bytes(
+        hashlib.sha256(name.encode()).digest()[:4], "little")
+    return wid or 1
+
+
+class _TenantStream:
+    """One tenant's serving stream: the one-deep pipeline slot, the VCRQ
+    replay cache, and the bounded known-epoch LRU. The sidecar keys these
+    by the VCRT tenant word (0 = the legacy un-prefixed stream)."""
+
+    __slots__ = ("pending", "staged_payload", "round_cache", "known_epochs")
+
+    def __init__(self):
+        self.pending: Optional[dict] = None
+        self.staged_payload: Optional[bytes] = None
+        #: (epoch, seq, (status, payload)) of the last served VCRQ round
+        self.round_cache: Optional[tuple] = None
+        #: epoch -> True, LRU order (ISSUE 12 satellite: the unbounded
+        #: set became a per-tenant LRU — evictions are counted, and a
+        #: client whose idle epoch aged out simply re-primes, the same
+        #: ERR_EPOCH_RESTORED path a restart takes)
+        self.known_epochs: "OrderedDict[int, bool]" = OrderedDict()
 
 
 class SidecarError(RuntimeError):
@@ -231,18 +271,25 @@ class SchedulerSidecar:
         self._delta: Dict[tuple, object] = {}
         self._states: Dict[int, object] = {}
         self._serve_lock = threading.Lock()
-        #: the one-deep pipelined serving slot (VCRP rounds): the
-        #: dispatched-but-unread cycle whose decisions the NEXT round's
-        #: response carries. Bounded depth 1 by construction — the slot is
-        #: drained before the next dispatch.
-        self._pending: Optional[dict] = None
-        #: idempotent-replay cache for VCRQ rounds: (epoch, seq,
-        #: (status, payload)) of the last served round. A client that
-        #: reconnected without its response resends the same seq and gets
-        #: this back without a second dispatch; a NEW epoch retires the
-        #: previous stream's pending cycle first (the drain-on-reconnect
-        #: rule for the one-deep stream).
-        self._round_cache: Optional[tuple] = None
+        #: per-tenant serving streams (ISSUE 12), keyed by the VCRT wire
+        #: word; tenant 0 is the legacy un-prefixed stream. Each stream
+        #: carries the one-deep pipelined slot (the dispatched-but-unread
+        #: cycle whose decisions the NEXT round's response carries), the
+        #: VCRQ replay cache — (epoch, seq, (status, payload)) so a
+        #: reconnected client resending the same seq gets the cached
+        #: response instead of a double-dispatch — a bounded known-epoch
+        #: LRU, and the staged payload slot (set when a checkpoint or a
+        #: sibling tenant's dispatch retires the in-flight cycle early —
+        #: early readback is decision-neutral; the payload must still
+        #: reach the client). At most ONE dispatched-unread cycle exists
+        #: across ALL streams: any dispatch first retires every other
+        #: stream's pending into its staged slot, preserving the resident
+        #: digest invariant the single-slot protocol had.
+        self._streams: Dict[int, _TenantStream] = {0: _TenantStream()}
+        #: per-tenant known-epoch LRU bound (satellite: the epoch set no
+        #: longer grows without bound under client churn)
+        self._epoch_cap = max(1, int(os.environ.get(
+            "VOLCANO_SIDECAR_EPOCH_CAP", "64")))
         self._seq_lock = threading.Lock()
         #: served-round counter, arming per-round chaos faults
         self._rounds_served = 0
@@ -250,18 +297,6 @@ class SchedulerSidecar:
         #: round has presented. Unfenced rounds (no VCRF prefix — the
         #: single-replica deployment) bypass the check entirely.
         self._fence_generation = 0
-        #: client stream epochs this process has served (a stream's first
-        #: round registers it; checkpoint/restore carries the set): a
-        #: seq>1 round naming an UNKNOWN epoch means we restarted under
-        #: the client's feet — answered with ERR_EPOCH_RESTORED instead
-        #: of a misleading prime payload or a timeout discovery
-        self._known_epochs: set = set()
-        #: decision payload staged for the next drain: set when a
-        #: checkpoint retires the in-flight cycle early (early readback is
-        #: decision-neutral; the payload must still reach the client) or
-        #: when a restore rehydrates the pre-crash cycle's decisions —
-        #: keeps the served stream bit-identical to an uninterrupted run
-        self._staged_payload: Optional[bytes] = None
         #: digest-verified pre-crash mirrors (shape key -> host buffers)
         #: awaiting adoption by their shape bucket's first dispatch
         self._restored_mirrors: Dict[tuple, tuple] = {}
@@ -290,6 +325,63 @@ class SchedulerSidecar:
             self._conf_telemetry = bool(parse_conf(conf).telemetry)
         else:
             self._conf_telemetry = bool(self.cfg.telemetry)
+
+    # --------------------------------------------- per-tenant streams
+    def _stream(self, tenant: int) -> _TenantStream:
+        """Get-or-create the serving stream for a VCRT tenant word.
+        Caller holds _seq_lock or _serve_lock (or is single-threaded
+        setup code)."""
+        st = self._streams.get(tenant)
+        if st is None:
+            st = self._streams[tenant] = _TenantStream()
+        return st
+
+    def _note_epoch(self, st: _TenantStream, epoch: int) -> None:
+        """Record a stream epoch in the tenant's LRU; evictions past the
+        cap are counted, and an evicted epoch takes its replay-cache
+        entry with it (a replay of an aged-out round must re-prime, not
+        silently dispatch fresh under a stale seq)."""
+        if epoch in st.known_epochs:
+            st.known_epochs.move_to_end(epoch)
+        else:
+            st.known_epochs[epoch] = True
+        while len(st.known_epochs) > self._epoch_cap:
+            old, _ = st.known_epochs.popitem(last=False)
+            from ..metrics import METRICS
+            METRICS.inc("sidecar_replay_evictions_total")
+            if st.round_cache is not None and st.round_cache[0] == old:
+                st.round_cache = None
+
+    # tenant-0 views: the single-tenant deployment's introspection
+    # surface (tests, tooling) predates the VCRT streams and keeps
+    # reading these names
+    @property
+    def _pending(self) -> Optional[dict]:
+        return self._streams[0].pending
+
+    @_pending.setter
+    def _pending(self, value: Optional[dict]) -> None:
+        self._streams[0].pending = value
+
+    @property
+    def _round_cache(self) -> Optional[tuple]:
+        return self._streams[0].round_cache
+
+    @_round_cache.setter
+    def _round_cache(self, value: Optional[tuple]) -> None:
+        self._streams[0].round_cache = value
+
+    @property
+    def _staged_payload(self) -> Optional[bytes]:
+        return self._streams[0].staged_payload
+
+    @_staged_payload.setter
+    def _staged_payload(self, value: Optional[bytes]) -> None:
+        self._streams[0].staged_payload = value
+
+    @property
+    def _known_epochs(self) -> set:
+        return set(self._streams[0].known_epochs)
 
     def _build_tree(self, buf: bytes, extras_buf: bytes):
         """Wire buffers -> the cycle's argument tree + (snap, T, J)."""
@@ -456,17 +548,20 @@ class SchedulerSidecar:
                                               group_sizes(spec)))
                 fn.lower(*avals).compile()
 
-    def schedule_buffer(self, buf: bytes, extras_buf: bytes = b"") -> bytes:
+    def schedule_buffer(self, buf: bytes, extras_buf: bytes = b"",
+                        tenant: int = 0) -> bytes:
         """VCS4 snapshot buffer (+ optional VCX1 extras frame) -> VCD1
         decision payload. Every served cycle lands one snapshot in the
         flight-recorder ring (telemetry included when the conf enables
         it); the wire response stays the fixed-layout decision prefix, so
         version-skewed clients are unaffected."""
-        payload, finish = self.schedule_buffer_deferred(buf, extras_buf)
+        payload, finish = self.schedule_buffer_deferred(buf, extras_buf,
+                                                        tenant=tenant)
         finish()
         return payload
 
-    def schedule_buffer_deferred(self, buf: bytes, extras_buf: bytes = b""):
+    def schedule_buffer_deferred(self, buf: bytes, extras_buf: bytes = b"",
+                                 tenant: int = 0):
         """Like :meth:`schedule_buffer`, but returns ``(payload, finish)``
         so the server handler can SEND the decisions first and run
         ``finish()`` — the flight-recorder append and telemetry-tail decode
@@ -480,7 +575,11 @@ class SchedulerSidecar:
         with _spans.span("sidecar.build"):
             tree_in, snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
-            self._drain_locked()        # a VCRP round must not be orphaned
+            # the tenant's own VCRP round must not be orphaned; sibling
+            # tenants' in-flight cycles are retired into their staged
+            # slots so their streams still receive them
+            self._drain_locked(self._stream(tenant))
+            self._retire_others_locked(tenant)
             packed, cycle_kind, upload_bytes = self._run_cycle(tree_in)
         cycle_ms = round((_time.time() - t_start) * 1000, 3)
         payload = self._decisions_payload(packed, T, J)
@@ -504,17 +603,20 @@ class SchedulerSidecar:
         return payload, finish
 
     # ------------------------------------------- one-deep pipelined serving
-    def _drain_locked(self) -> Optional[bytes]:
-        """Read back and payload the pending VCRP cycle (caller holds
-        _serve_lock). Returns None when nothing is pending."""
-        pending = self._pending
+    def _drain_locked(self, st: Optional[_TenantStream] = None) \
+            -> Optional[bytes]:
+        """Read back and payload the stream's pending VCRP cycle (caller
+        holds _serve_lock). Returns None when nothing is pending."""
+        if st is None:
+            st = self._streams[0]
+        pending = st.pending
         if pending is None:
-            # a checkpoint or restore may have staged the retired cycle's
-            # payload here — hand it to the stream exactly where the live
-            # pending cycle's drain would have
-            payload, self._staged_payload = self._staged_payload, None
+            # a checkpoint, restore, or sibling tenant's dispatch may have
+            # staged the retired cycle's payload here — hand it to the
+            # stream exactly where the live pending cycle's drain would
+            payload, st.staged_payload = st.staged_payload, None
             return payload
-        self._pending = None
+        st.pending = None
         import time as _time
         with _spans.span("sidecar.drain", cat="wait"):
             packed = np.asarray(pending["packed"], dtype=np.int32)
@@ -534,8 +636,21 @@ class SchedulerSidecar:
             spans=_spans.drain_cycle_summary())
         return payload
 
+    def _retire_others_locked(self, tenant: int) -> None:
+        """Early-readback every OTHER tenant's in-flight cycle before a
+        dispatch, staging each payload for its own stream's next round
+        (caller holds _serve_lock). Decision-neutral — a pending cycle's
+        decisions were fixed at dispatch — and it preserves the resident
+        digest invariant: at most one dispatched-unread cycle exists, so
+        a drain never compares a stale device digest against a mirror a
+        sibling tenant's dispatch has since advanced."""
+        for tid, st in self._streams.items():
+            if tid != tenant and st.pending is not None:
+                st.staged_payload = self._drain_locked(st)
+
     def schedule_buffer_pipelined(self, buf: bytes,
-                                  extras_buf: bytes = b"") -> bytes:
+                                  extras_buf: bytes = b"",
+                                  tenant: int = 0) -> bytes:
         """One-deep pipelined round (VCRP): dispatch THIS snapshot's cycle
         and return the PREVIOUS dispatched snapshot's decisions — the
         sidecar half of the cycle pipeline. The first round primes the
@@ -552,14 +667,16 @@ class SchedulerSidecar:
         with _spans.span("sidecar.build"):
             tree_in, _snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
-            prev_payload = self._drain_locked()
+            st = self._stream(tenant)
+            prev_payload = self._drain_locked(st)
+            self._retire_others_locked(tenant)
             packed, kind, upload, kernel, state = \
                 self._dispatch_cycle(tree_in)
-            self._pending = dict(packed=packed, T=T, J=J, kind=kind,
-                                 upload=upload, t0=_time.time(),
-                                 buffer_bytes=len(buf) + len(extras_buf),
-                                 kernel=kernel, state=state, tree=tree_in,
-                                 dispatched_at=_spans.now())
+            st.pending = dict(packed=packed, T=T, J=J, kind=kind,
+                              upload=upload, t0=_time.time(),
+                              buffer_bytes=len(buf) + len(extras_buf),
+                              kernel=kernel, state=state, tree=tree_in,
+                              dispatched_at=_spans.now())
         if prev_payload is None:
             # priming round: an explicit empty decision payload
             prev_payload = self._decisions_payload(
@@ -567,10 +684,12 @@ class SchedulerSidecar:
         return prev_payload
 
     def schedule_buffer_seq(self, epoch: int, seq: int, buf: bytes,
-                            extras_buf: bytes = b"") -> Tuple[int, bytes]:
+                            extras_buf: bytes = b"",
+                            tenant: int = 0) -> Tuple[int, bytes]:
         """One idempotent pipelined round (VCRQ): like
         :meth:`schedule_buffer_pipelined`, but keyed by the client's
-        (epoch, seq). Returns ``(status, payload)``.
+        (epoch, seq) within the tenant's stream. Returns
+        ``(status, payload)``.
 
         - A REPLAYED round (same epoch+seq as the cached one) is served
           from the cache without touching the pipeline — the reconnect
@@ -584,20 +703,23 @@ class SchedulerSidecar:
           failed round reports the same failure instead of
           double-dispatching."""
         with self._seq_lock:
-            cached = self._round_cache
+            st = self._stream(tenant)
+            cached = st.round_cache
             if cached is not None and cached[0] == epoch \
                     and cached[1] == seq:
                 from ..metrics import METRICS
                 METRICS.inc("sidecar_replayed_rounds_total")
                 return cached[2]
             if cached is not None and cached[0] != epoch:
-                self.drain_pending()    # retire the stale stream's cycle
-            if seq > 1 and epoch not in self._known_epochs:
+                # retire the stale stream's cycle (drain-on-reconnect)
+                self.drain_pending(tenant)
+            if seq > 1 and epoch not in st.known_epochs:
                 # mid-stream round from a stream this process never
                 # served: we restarted without checkpoint state under the
-                # client's feet. Say so in-band (retryable) — the client
-                # adopts a fresh epoch and re-primes in one roundtrip.
-                # Not cached: the client abandons this epoch.
+                # client's feet (or the epoch aged out of the LRU). Say
+                # so in-band (retryable) — the client adopts a fresh
+                # epoch and re-primes in one roundtrip. Not cached: the
+                # client abandons this epoch.
                 from ..metrics import METRICS
                 METRICS.inc("sidecar_epoch_restored_total",
                             labels={"side": "server"})
@@ -605,20 +727,21 @@ class SchedulerSidecar:
                     ERR_EPOCH_RESTORED,
                     f"stream epoch {epoch} unknown after restart; "
                     f"re-prime with a new epoch"))
-            self._known_epochs.add(epoch)
+            self._note_epoch(st, epoch)
             try:
-                payload = self.schedule_buffer_pipelined(buf, extras_buf)
+                payload = self.schedule_buffer_pipelined(buf, extras_buf,
+                                                         tenant=tenant)
                 resp = (0, payload)
             except Exception as e:  # cache the failure for the replay
                 resp = (1, _error_payload(_classify_error(e), str(e)))
-            self._round_cache = (epoch, seq, resp)
+            st.round_cache = (epoch, seq, resp)
             return resp
 
-    def drain_pending(self) -> Optional[bytes]:
-        """Retire the in-flight pipelined cycle (VCRD). Returns its VCD1
-        payload, or None when the pipeline is empty."""
+    def drain_pending(self, tenant: int = 0) -> Optional[bytes]:
+        """Retire the tenant's in-flight pipelined cycle (VCRD). Returns
+        its VCD1 payload, or None when the pipeline is empty."""
         with self._serve_lock:
-            return self._drain_locked()
+            return self._drain_locked(self._stream(tenant))
 
     # ----------------------------------------- crash-consistent restarts
     def checkpoint(self, path: str) -> dict:
@@ -633,16 +756,25 @@ class SchedulerSidecar:
         from . import checkpoint as ckpt
         with self._seq_lock:
             with self._serve_lock:
-                payload = self._drain_locked()
-                self._staged_payload = payload
+                for st in self._streams.values():
+                    st.staged_payload = self._drain_locked(st)
                 mirrors = ckpt.mirror_records(self._delta, self._states)
+            st0 = self._streams[0]
+            # tenant 0 keeps the legacy top-level keys, so pre-fleet
+            # checkpoints restore unchanged and pre-fleet readers of a
+            # fleet checkpoint still see the un-prefixed stream
             state = dict(
                 conf_fingerprint=self._ckpt_fingerprint,
-                round_cache=self._round_cache,
+                round_cache=st0.round_cache,
                 rounds_served=self._rounds_served,
-                known_epochs=sorted(self._known_epochs),
-                pending_payload=payload,
+                known_epochs=sorted(st0.known_epochs),
+                pending_payload=st0.staged_payload,
                 fence_generation=self._fence_generation,
+                tenant_streams={
+                    tid: dict(round_cache=st.round_cache,
+                              known_epochs=sorted(st.known_epochs),
+                              pending_payload=st.staged_payload)
+                    for tid, st in self._streams.items() if tid != 0},
                 metrics=ckpt.metrics_snapshot(),
             )
         return ckpt.write_checkpoint(path, "sidecar", state,
@@ -674,10 +806,22 @@ class SchedulerSidecar:
                 return "fallback"
             with self._seq_lock:
                 with self._serve_lock:
-                    self._round_cache = state["round_cache"]
+                    self._streams = {0: _TenantStream()}
+                    st0 = self._streams[0]
+                    st0.round_cache = state["round_cache"]
+                    st0.staged_payload = state["pending_payload"]
+                    for e in state["known_epochs"]:
+                        st0.known_epochs[e] = True
+                    # pre-fleet checkpoints carry no tenant_streams key;
+                    # they restore as the bare tenant-0 stream
+                    for tid, rec in (state.get("tenant_streams")
+                                     or {}).items():
+                        st = self._stream(int(tid))
+                        st.round_cache = rec.get("round_cache")
+                        st.staged_payload = rec.get("pending_payload")
+                        for e in rec.get("known_epochs", ()):
+                            st.known_epochs[e] = True
                     self._rounds_served = int(state["rounds_served"])
-                    self._known_epochs = set(state["known_epochs"])
-                    self._staged_payload = state["pending_payload"]
                     # pre-fence checkpoints restore with the fence open
                     self._fence_generation = int(
                         state.get("fence_generation", 0))
@@ -705,15 +849,18 @@ class SchedulerSidecar:
             return True
 
     def wait_idle(self) -> bool:
-        """Block until the in-flight pipelined cycle's device work is done
-        WITHOUT draining it. Production serving gets this wait for free
-        from the API layer's schedule period; bench calls it explicitly so
-        the measured round isolates the serving path from raw compute."""
-        pending = self._pending
-        if pending is None:
+        """Block until every in-flight pipelined cycle's device work is
+        done WITHOUT draining it. Production serving gets this wait for
+        free from the API layer's schedule period; bench calls it
+        explicitly so the measured round isolates the serving path from
+        raw compute."""
+        pendings = [st.pending for st in self._streams.values()
+                    if st.pending is not None]
+        if not pendings:
             return False
         import jax
-        jax.block_until_ready(pending["packed"])
+        for pending in pendings:
+            jax.block_until_ready(pending["packed"])
         return True
 
 
@@ -725,24 +872,31 @@ class _Handler(socketserver.BaseRequestHandler):
             except (ConnectionError, OSError):
                 return
             fence_ok = True
-            if magic == FENCED_MAGIC:
-                # HA fencing prefix: u32 generation, then the real frame.
-                # The inner frame is ALWAYS read fully (framing must stay
-                # aligned); a stale token skips the dispatch, not the read.
+            tenant = 0
+            # prefix words (composable, either order): VCRF carries the HA
+            # fencing generation, VCRT the fleet tenant id; each reads one
+            # u32 operand, then the real frame follows. The inner frame is
+            # ALWAYS read fully (framing must stay aligned); a stale fence
+            # token skips the dispatch, not the read.
+            while magic in (FENCED_MAGIC, TENANT_MAGIC):
+                prefix = magic
                 try:
-                    (gen,) = _u32.unpack(_recv_exact(self.request, 4))
+                    (word,) = _u32.unpack(_recv_exact(self.request, 4))
                     (magic,) = _u32.unpack(_recv_exact(self.request, 4))
                 except (ConnectionError, OSError):
                     return
-                fence_ok = self.server.sidecar.fence_admit(gen)
+                if prefix == FENCED_MAGIC:
+                    fence_ok = self.server.sidecar.fence_admit(word)
+                else:
+                    tenant = word
             if magic == DRAIN_MAGIC:
                 if not fence_ok:
                     _send_frame(self.request, 1, _error_payload(
                         ERR_NOT_LEADER, "fencing token superseded"))
                     continue
-                # drain-only round: retire the pending pipelined cycle
+                # drain-only round: retire the tenant's pending cycle
                 try:
-                    payload = self.server.sidecar.drain_pending()
+                    payload = self.server.sidecar.drain_pending(tenant)
                 except Exception as e:
                     _send_frame(self.request, 1, _error_payload(
                         _classify_error(e), str(e)))
@@ -780,18 +934,20 @@ class _Handler(socketserver.BaseRequestHandler):
                     continue
                 if magic == SEQ_PIPELINE_MAGIC:
                     status, payload = self.server.sidecar \
-                        .schedule_buffer_seq(epoch, seq, buf, extras)
+                        .schedule_buffer_seq(epoch, seq, buf, extras,
+                                             tenant=tenant)
                     _send_frame(self.request, status, payload)
                     continue
                 if magic == PIPELINE_MAGIC:
                     payload = self.server.sidecar \
-                        .schedule_buffer_pipelined(buf, extras)
+                        .schedule_buffer_pipelined(buf, extras,
+                                                   tenant=tenant)
                     _send_frame(self.request, 0, payload)
                     continue
                 # send the decisions first; the flight-recorder append and
                 # telemetry decode run after the client is unblocked
                 payload, finish = self.server.sidecar \
-                    .schedule_buffer_deferred(buf, extras)
+                    .schedule_buffer_deferred(buf, extras, tenant=tenant)
                 _send_frame(self.request, 0, payload)
                 finish()
             except (ConnectionError, OSError):
@@ -846,7 +1002,8 @@ class SidecarClient:
                  conf=None, call_timeout: Optional[float] = None,
                  backoff=None, reconnect: bool = True,
                  epoch: Optional[int] = None,
-                 endpoints=None, fence_token: Optional[int] = None):
+                 endpoints=None, fence_token: Optional[int] = None,
+                 tenant_id=None):
         """``conf`` (YAML text or SchedulerConfiguration) should match the
         server's --scheduler-conf: the client computes the host extras the
         conf needs (affinity masks, ports, volumes) and ships them in the
@@ -860,7 +1017,14 @@ class SidecarClient:
         epoch and re-primes — a sidecar failover costs the stream one
         priming round, the same bill as a server restart. ``fence_token``
         (the caller's lease generation) wraps every frame in a VCRF
-        prefix; a deposed caller's rounds come back ERR_NOT_LEADER."""
+        prefix; a deposed caller's rounds come back ERR_NOT_LEADER.
+
+        Fleet tenancy (ISSUE 12): ``tenant_id`` — a u32 wire id, or a
+        tenant name hashed through :func:`tenant_wire_id` — wraps every
+        frame in a VCRT prefix, so this client's pipelined stream, replay
+        cache, and epochs live in the server's per-tenant stream instead
+        of the shared tenant-0 slot. None speaks the single-tenant
+        protocol unchanged."""
         from ..framework.conf import parse_conf
         from .backoff import Backoff
         self.conf = (parse_conf(conf) if isinstance(conf, str) else conf)
@@ -868,6 +1032,8 @@ class SidecarClient:
                           if endpoints else [(host, port)])
         self._endpoint_i = 0
         self.fence_token = fence_token
+        self.tenant_id = (tenant_wire_id(tenant_id)
+                          if isinstance(tenant_id, str) else tenant_id)
         self.host, self.port = self.endpoints[0]
         self.connect_timeout = timeout
         #: per-call send/recv timeout; None keeps the connect timeout
@@ -1015,12 +1181,24 @@ class SidecarClient:
         return _u32.pack(FENCED_MAGIC) + _u32.pack(
             int(self.fence_token) & 0xFFFFFFFF)
 
+    def _tenant_prefix(self) -> bytes:
+        """The VCRT wrapper for every frame when a tenant id is set (the
+        fleet deployment); empty otherwise — single-tenant clients speak
+        the un-prefixed protocol unchanged."""
+        if self.tenant_id is None:
+            return b""
+        return _u32.pack(TENANT_MAGIC) + _u32.pack(
+            int(self.tenant_id) & 0xFFFFFFFF)
+
+    def _prefixes(self) -> bytes:
+        return self._fence_prefix() + self._tenant_prefix()
+
     def _snapshot_frame(self, ci, magic: int, header: bytes = b""):
         from ..native.wire import serialize, serialize_extras
         buf, maps = serialize(ci)
         extras = (serialize_extras(ci, maps, self.conf)
                   if self.conf is not None else b"")
-        frame = (self._fence_prefix() + _u32.pack(magic) + header
+        frame = (self._prefixes() + _u32.pack(magic) + header
                  + _u32.pack(len(buf)) + _u32.pack(len(extras))
                  + buf + extras)
         return frame, maps
@@ -1083,7 +1261,7 @@ class SidecarClient:
         if self._pipeline_maps is None:
             return None
         try:
-            payload = self._roundtrip(self._fence_prefix()
+            payload = self._roundtrip(self._prefixes()
                                       + _u32.pack(DRAIN_MAGIC))
         except SidecarError as e:
             if e.code == ERR_EMPTY_PIPELINE:

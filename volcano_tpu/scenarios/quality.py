@@ -123,6 +123,10 @@ class Scorecard:
     drift_checks: int = 0
     drift_failures: int = 0
     faults_fired: int = 0
+    #: fleet tenant the run scored (None for single-cluster runs — the
+    #: dashboard's scenarios table shows "-" and the quality gauges
+    #: carry no tenant label, so pre-fleet surfaces are unchanged)
+    tenant: Optional[str] = None
 
     def to_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -140,9 +144,11 @@ class Scorecard:
 class QualityCollector:
     """Accumulates per-cycle samples + lifecycle marks into a Scorecard."""
 
-    def __init__(self, scenario: str, seed: int):
+    def __init__(self, scenario: str, seed: int,
+                 tenant: Optional[str] = None):
         self.scenario = scenario
         self.seed = seed
+        self.tenant = tenant
         self.samples: List[CycleSample] = []
         self._first_arrival: Optional[int] = None
         self._last_completion: Optional[int] = None
@@ -181,7 +187,7 @@ class QualityCollector:
     # readout ------------------------------------------------------------
     def scorecard(self, cycles: int) -> Scorecard:
         card = Scorecard(scenario=self.scenario, seed=self.seed,
-                         cycles=cycles,
+                         tenant=self.tenant, cycles=cycles,
                          jobs_submitted=self.jobs_submitted,
                          jobs_completed=self.jobs_completed,
                          tasks_bound=self.tasks_bound,
@@ -242,6 +248,8 @@ def publish_quality_gauges(card: Scorecard, registry=None) -> None:
     if registry is None:
         from ..metrics import METRICS as registry
     labels = {"scenario": card.scenario}
+    if card.tenant:
+        labels["tenant"] = card.tenant
     g = registry.set_gauge
     if card.makespan_cycles is not None:
         g("quality_makespan_cycles", labels, card.makespan_cycles)

@@ -59,6 +59,11 @@ _DEVICE_TID = 900
 _LOCK = threading.Lock()
 _EVENTS: deque = deque(maxlen=_MAX_EVENTS)
 _PHASES: Dict[str, deque] = defaultdict(lambda: deque(maxlen=_RING))
+#: fleet (ISSUE 12): per-(tenant, phase) duration rings, fed by the fleet
+#: scheduler's per-tenant cycle accounting — the SLO latency surface cut
+#: by tenant, same bounded-memory rule as the global rings
+_TENANT_PHASES: Dict[tuple, deque] = defaultdict(
+    lambda: deque(maxlen=_RING))
 _CYCLE_ACC: Dict[str, float] = defaultdict(float)
 _EVENT_LOG: deque = deque(maxlen=_MAX_LOG)
 _TIDS: Dict[int, int] = {}
@@ -198,6 +203,36 @@ def phase_stats() -> Dict[str, Dict[str, float]]:
                   "mean": round(sum(s) / len(s), 3),
                   "last": round(vals[-1], 3),
                   "total_ms": round(sum(s), 3)}
+    return out
+
+
+def record_tenant_phase(tenant: str, phase: str, ms: float) -> None:
+    """Land one per-tenant phase duration (ms) in the tenant's ring.
+    Called by the fleet scheduler per served tenant per cycle; a plain
+    deque append, so the fleet loop pays the same O(1) the global rings
+    cost."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _TENANT_PHASES[(str(tenant), str(phase))].append(float(ms))
+
+
+def tenant_phase_stats() -> Dict[str, Dict[str, Dict[str, float]]]:
+    """{tenant: {phase: {count, p50, p95, p99, mean, last}}} over the
+    per-tenant duration rings — :func:`phase_stats` cut by tenant."""
+    with _LOCK:
+        rings = {k: list(v) for k, v in _TENANT_PHASES.items() if v}
+    out: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for (tenant, phase) in sorted(rings):
+        vals = rings[(tenant, phase)]
+        s = sorted(vals)
+        out.setdefault(tenant, {})[phase] = {
+            "count": len(s),
+            "p50": round(_pct(s, 0.50), 3),
+            "p95": round(_pct(s, 0.95), 3),
+            "p99": round(_pct(s, 0.99), 3),
+            "mean": round(sum(s) / len(s), 3),
+            "last": round(vals[-1], 3)}
     return out
 
 
@@ -415,6 +450,12 @@ def publish_gauges(metrics=None, include_occupancy: bool = False) -> None:
         for q in ("p50", "p95", "p99"):
             metrics.set_gauge("span_phase_ms",
                               {"phase": phase, "q": q}, st[q])
+    for tenant, phases in tenant_phase_stats().items():
+        for phase, st in phases.items():
+            for q in ("p50", "p95", "p99"):
+                metrics.set_gauge("span_phase_ms",
+                                  {"phase": phase, "q": q,
+                                   "tenant": tenant}, st[q])
     if include_occupancy:
         occ = occupancy()
         if occ.get("pipeline_overlap_fraction") is not None:
@@ -429,5 +470,6 @@ def reset() -> None:
     with _LOCK:
         _EVENTS.clear()
         _PHASES.clear()
+        _TENANT_PHASES.clear()
         _CYCLE_ACC.clear()
         _EVENT_LOG.clear()
