@@ -56,6 +56,17 @@ if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
         XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
         python -m volcano_tpu.chaos --smoke --sharded || crc=$?
 fi
+src=0
+if [ "${TIER1_SKIP_SPEC:-0}" != "1" ]; then
+    # speculation smoke (volcano_tpu/chaos/spec): the depth-k sha-matrix —
+    # sync vs depth-1 vs depth-k decision streams over settled-churn and
+    # mid-flight late-arrival workloads must be bit-identical on the scan
+    # AND pallas-interpret allocate paths with at least one speculative
+    # cycle invalidated and replayed, and the sidecar serving ring must
+    # hand back byte-identical payload streams at depth 1 and depth k
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --spec \
+        > /tmp/_t1_spec.json || src=$?
+fi
 rrc=0
 if [ "${TIER1_SKIP_RESTART:-0}" != "1" ]; then
     # restart smoke (volcano_tpu/chaos/restart): process_kill at all
@@ -103,6 +114,9 @@ if [ $grc -ne 0 ]; then
 fi
 if [ $crc -ne 0 ]; then
     exit $crc
+fi
+if [ $src -ne 0 ]; then
+    exit $src
 fi
 if [ $rrc -ne 0 ]; then
     exit $rrc

@@ -355,6 +355,10 @@ class TestDynamicKeyRounds:
         run_dyn_paths(dyn_cluster(0, gpus=True),
                       AllocateConfig(binpack_weight=1.0, drf_job_order=True))
 
+    # full-suite (`pytest -m slow`): the frozen-columns guard replays a
+    # whole dynamic-key round matrix; the non-slow dynamic-key tests
+    # keep the per-round semantics in tier-1 — budget calibration
+    @pytest.mark.slow
     def test_hdrf_frozen_columns_guard(self):
         """hdrf level keys are frozen per launch and guarded (a pop after
         any commit proceeds only while the eligible set spans one queue):

@@ -1,0 +1,290 @@
+"""Depth-k speculative pipeline unit + edge-case tests (ISSUE 13).
+
+Fast (non-slow) tests cover the pure host-side surfaces: conf parsing
+and clamping of ``pipeline_depth``, the per-thread/per-depth occupancy
+math on synthetic events, the shared :func:`step_cycle` driver helper,
+the sidecar ``_TenantStream`` depth-1 compat properties, and the
+``_effective_depth`` gating rules (none of these touch a JAX compile).
+
+Slow-marked tests drive a real depth-3 Scheduler through the drain()/
+wait_pending() edge cases ISSUE 13 names — double drain, drain while
+degraded, drain with an empty pipeline, checkpoint mid-ring — each
+riding the one compiled allocate the probe conf already pays for. The
+fast behavioral gate for decision identity itself is the tier-1 ``--spec``
+smoke (volcano_tpu/chaos/spec.py); these tests pin the API contracts
+around it.
+"""
+
+import pytest
+
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.runtime.driver import step_cycle
+from volcano_tpu.runtime.fake_cluster import FakeCluster
+from volcano_tpu.runtime.scheduler import Scheduler
+from volcano_tpu.telemetry import spans
+
+
+def _probe_conf(extra: str = ""):
+    from volcano_tpu.chaos import probe
+    return parse_conf(probe._PROBE_CONF + extra)
+
+
+def _probe_cluster():
+    from volcano_tpu.chaos import probe
+    return FakeCluster(probe._small_cluster().clone())
+
+
+class TestConfPipelineDepth:
+    def test_default_is_one(self):
+        assert _probe_conf().pipeline_depth == 1
+
+    def test_parse_and_clamp(self):
+        assert _probe_conf("pipeline_depth: 3\n").pipeline_depth == 3
+        # 0 / negative / null all clamp to the depth-1 contract
+        assert _probe_conf("pipeline_depth: 0\n").pipeline_depth == 1
+        assert _probe_conf("pipeline_depth: -2\n").pipeline_depth == 1
+        assert _probe_conf("pipeline_depth: null\n").pipeline_depth == 1
+
+
+class TestOccupancyPerThread:
+    def test_pack_thread_counts_while_main_blocks(self):
+        """The per-tid rule: the main thread fully blocked in a drain
+        must not blank the pack worker's real work (the global-merge
+        analyzer reported 0 overlap here)."""
+        evts = [
+            # main thread: a 10 s cycle span entirely covered by wait
+            {"name": "cycle", "cat": None, "tid": 1, "ts": 0.0,
+             "dur": 10.0},
+            {"name": "cycle.drain", "cat": "wait", "tid": 1, "ts": 0.0,
+             "dur": 10.0},
+            # pack worker: 6 s of genuine host work inside the window
+            {"name": "pack", "cat": "pack", "tid": 2, "ts": 2.0,
+             "dur": 6.0},
+            {"name": "device_window", "cat": "device", "tid": 1,
+             "ts": 0.0, "dur": 10.0, "args": {"depth": 3}},
+            {"name": "device_window", "cat": "device", "tid": 1,
+             "ts": 12.0, "dur": 2.0, "args": {"depth": 1}},
+        ]
+        out = spans.compute_occupancy(evts)
+        assert out["windows"] == 2
+        assert out["window_ms"] == 12000.0
+        assert out["overlap_ms"] == 6000.0
+        assert out["pipeline_overlap_fraction"] == 0.5
+        # windows carry depth tags != {1}: the per-depth breakdown exists
+        per_depth = out["per_depth"]
+        assert set(per_depth) == {"1", "3"}
+        assert per_depth["3"]["overlap_ms"] == 6000.0
+        assert per_depth["3"]["pipeline_overlap_fraction"] == 0.6
+        assert per_depth["1"]["overlap_ms"] == 0.0
+
+    def test_one_threads_wait_never_blanks_another(self):
+        """A wait on tid 2 must only subtract from tid 2's own work."""
+        evts = [
+            {"name": "work", "cat": None, "tid": 1, "ts": 0.0, "dur": 4.0},
+            {"name": "w", "cat": "wait", "tid": 2, "ts": 0.0, "dur": 4.0},
+            {"name": "device_window", "cat": "device", "tid": 1,
+             "ts": 0.0, "dur": 4.0},
+        ]
+        out = spans.compute_occupancy(evts)
+        assert out["overlap_ms"] == 4000.0
+        # untagged windows are depth 1 — no per-depth breakdown
+        assert out["per_depth"] is None
+
+    def test_window_depth_defensive(self):
+        assert spans._window_depth({}) == 1
+        assert spans._window_depth({"args": None}) == 1
+        assert spans._window_depth({"args": {"depth": "junk"}}) == 1
+        assert spans._window_depth({"args": {"depth": 3}}) == 3
+
+    def test_live_occupancy_backend_tag(self):
+        out = spans.occupancy()
+        assert "backend" in out
+        assert out["backend"] is None or isinstance(out["backend"], str)
+
+
+class _StubSched:
+    def __init__(self, pipeline, drain_result="drained"):
+        self.pipeline = pipeline
+        self.calls = []
+        self._drain_result = drain_result
+
+    def run_once(self, now=None):
+        self.calls.append("run_once")
+        return "live"
+
+    def drain(self, now=None):
+        self.calls.append("drain")
+        return self._drain_result
+
+
+class TestStepCycle:
+    def test_sync_returns_run_once_and_never_drains(self):
+        s = _StubSched(pipeline=False)
+        assert step_cycle(s, now=1.0) == "live"
+        assert s.calls == ["run_once"]
+
+    def test_pipelined_drains_after_ingest(self):
+        s = _StubSched(pipeline=True)
+
+        def ingest():
+            s.calls.append("ingest")
+
+        assert step_cycle(s, now=1.0, ingest=ingest) == "drained"
+        # ingest runs between dispatch and drain — that ordering IS the
+        # overlap the pipeline buys
+        assert s.calls == ["run_once", "ingest", "drain"]
+
+    def test_pipelined_empty_drain_falls_back_to_live(self):
+        s = _StubSched(pipeline=True, drain_result=None)
+        assert step_cycle(s, now=1.0) == "live"
+
+
+class TestTenantStreamCompat:
+    def test_pending_is_ring_head(self):
+        from volcano_tpu.runtime.sidecar import _TenantStream
+        st = _TenantStream()
+        assert st.pending is None
+        st.ring.append({"slot": 0})
+        st.ring.append({"slot": 1})
+        assert st.pending == {"slot": 0}
+        st.pending = None
+        assert st.ring == []
+        st.pending = {"slot": 2}
+        assert st.ring == [{"slot": 2}]
+
+    def test_staged_payload_is_staged_head(self):
+        from volcano_tpu.runtime.sidecar import _TenantStream
+        st = _TenantStream()
+        assert st.staged_payload is None
+        st.staged.extend([b"old", b"new"])
+        assert st.staged_payload == b"old"
+        st.staged_payload = None
+        assert st.staged == []
+        st.staged_payload = b"x"
+        assert st.staged == [b"x"]
+
+
+class TestEffectiveDepthGates:
+    def test_gating_rules(self):
+        conf = _probe_conf("pipeline: true\npipeline_depth: 3\n")
+        sched = Scheduler(_probe_cluster(), conf=conf)
+        assert sched._effective_depth() == 3
+        # any degradation clamps speculation to the depth-1 contract
+        sched.degradation_level = 1
+        assert sched._effective_depth() == 1
+        sched.degradation_level = 0
+        assert sched._effective_depth() == 3
+        # the speculation-ladder hold clamps too
+        sched._spec_disabled_until = sched.cycles + 5
+        assert sched._effective_depth() == 1
+
+    def test_requires_pipeline_incremental_unsharded(self):
+        conf = _probe_conf("pipeline: true\npipeline_depth: 3\n")
+        assert Scheduler(_probe_cluster(), conf=conf,
+                         pipeline=False)._effective_depth() == 1
+        assert Scheduler(_probe_cluster(), conf=conf,
+                         incremental=False)._effective_depth() == 1
+        sharded = _probe_conf(
+            "pipeline: true\npipeline_depth: 3\nsharding: true\n")
+        assert Scheduler(_probe_cluster(),
+                         conf=sharded)._effective_depth() == 1
+
+
+def _collect(digests, rec, pipeline=True):
+    """spec.py's collection rule: pipelined priming cycles return the
+    live (undrained) session — its decisions surface later via drain."""
+    from volcano_tpu.chaos import probe
+    if rec is None or (pipeline and hasattr(rec, "dispatch_allocate")):
+        return
+    digests.append(probe._cycle_digest(rec))
+
+
+@pytest.mark.slow
+class TestDrainEdgeCases:
+    """Real depth-3 Scheduler edge cases (slow: one compiled allocate
+    per conf; the decision-identity matrix itself is the tier-1 --spec
+    smoke)."""
+
+    def _sched(self, depth=3):
+        conf = _probe_conf(f"pipeline: true\npipeline_depth: {depth}\n")
+        return Scheduler(_probe_cluster(), conf=conf)
+
+    def test_drain_empty_pipeline_is_noop(self):
+        sched = self._sched()
+        assert sched.drain(now=1000.0) is None
+        assert sched.wait_pending() is False
+        # still serves normally afterwards
+        assert sched.run_once(now=1000.0) is not None
+
+    def test_ring_fills_to_depth_then_double_drain(self):
+        sched = self._sched(depth=3)
+        for c in range(3):
+            sched.run_once(now=1000.0 + c)
+        assert len(sched._ring) == 3
+        assert sched._pending is not None
+        # wait_pending blocks on device work but retires nothing
+        assert sched.wait_pending() is True
+        assert len(sched._ring) == 3
+        rec = sched.drain(now=1003.0)
+        assert rec is not None and not hasattr(rec, "dispatch_allocate")
+        assert sched._ring == [] and sched._pending is None
+        # double drain: the second call is a no-op returning None
+        assert sched.drain(now=1003.0) is None
+        assert sched.wait_pending() is False
+
+    def test_drain_while_degraded(self):
+        sched = self._sched(depth=3)
+        for c in range(3):
+            sched.run_once(now=1000.0 + c)
+        assert len(sched._ring) == 3
+        sched._degrade(1)
+        # drain retires the whole ring even on a degraded ladder rung
+        assert sched.drain(now=1003.0) is not None
+        assert sched._ring == []
+        # the degraded cycle itself runs synchronously: nothing queued
+        rec = sched.run_once(now=1004.0)
+        assert rec is not None
+        assert sched._pending is None
+        assert sched.drain(now=1004.0) is None
+
+    def test_checkpoint_mid_ring_drains_and_stays_neutral(self, tmp_path):
+        """checkpoint() with cycles in flight drains oldest-first before
+        cutting the snapshot — the decision stream must equal the
+        uninterrupted depth-3 run's, and a fresh scheduler must restore
+        from the file."""
+        path = str(tmp_path / "ring.ckpt")
+        legs = {}
+        swallowed = None
+        for label, ckpt_at in (("clean", None), ("checkpointed", 5)):
+            conf = _probe_conf("pipeline: true\npipeline_depth: 3\n")
+            sched = Scheduler(_probe_cluster(), conf=conf)
+            digests = []
+            for c in range(10):
+                if ckpt_at is not None and c == ckpt_at:
+                    assert sched._pending is not None  # mid-ring, really
+                    # the checkpoint drains (and applies) these in-flight
+                    # cycles internally; their records are not surfaced,
+                    # so the collected stream skips exactly these slots
+                    swallowed = [e.pending.slot for e in sched._ring]
+                    sched.checkpoint(path, now=1000.0 + c)
+                    # the drain-first rule: nothing left in flight
+                    assert sched._pending is None
+                _collect(digests, sched.run_once(now=1000.0 + c))
+            while sched._ring:
+                _collect(digests, sched._drain_pending(1010.0))
+            legs[label] = digests
+        # a full depth-3 ring went into the checkpoint
+        assert swallowed is not None and len(swallowed) == 3
+        # decision neutrality: the checkpoint drain retires cycles EARLY
+        # but in dispatch order — the surfaced stream must equal the
+        # clean leg's minus exactly the checkpoint-swallowed slots
+        # (every cycle pipelines here, so slot number == cycle index)
+        expected = [d for i, d in enumerate(legs["clean"])
+                    if i not in swallowed]
+        assert legs["checkpointed"] == expected
+        # and the file restores into a fresh scheduler
+        conf = _probe_conf("pipeline: true\npipeline_depth: 3\n")
+        fresh = Scheduler(_probe_cluster(), conf=conf)
+        assert fresh.restore(path, now=1010.0) == "restored"
+        assert fresh._ring == []
+        assert fresh.run_once(now=1011.0) is not None

@@ -12,6 +12,7 @@ cycle plus identical final cluster state.
 """
 
 import numpy as np
+import pytest
 
 from volcano_tpu.api import TaskStatus
 from volcano_tpu.arrays.pack import pack
@@ -140,6 +141,10 @@ class TestIncrementalLoop:
         assert sa.full_packs > 1           # arrival cycles re-packed
         assert sa.incremental_cycles >= 1  # churn-only cycles did not
 
+    # full-suite (`pytest -m slow`): multi-cycle eviction round-trip;
+    # the preempt oracle + victim-tier tests keep eviction bookkeeping
+    # in tier-1 — budget calibration
+    @pytest.mark.slow
     def test_preempt_loop_identity(self):
         """Preempt evictions + re-placements across cycles: the persistent
         session's eviction bookkeeping must round-trip exactly."""

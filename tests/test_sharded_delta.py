@@ -61,6 +61,10 @@ def _run_loop(conf, pipeline, cycles=4):
 
 
 class TestShardedSchedulerIdentity:
+    # full-suite (`pytest -m slow`): the multi-loop sha sweep; tier-1's
+    # chaos --sharded smoke proves per-shard decision identity every
+    # run — budget calibration
+    @pytest.mark.slow
     def test_sharded_loops_match_unsharded_sha(self):
         """2-device and 1-device sharded loops, sync and pipelined, all
         sha-identical to the unsharded scheduler on identical churn."""

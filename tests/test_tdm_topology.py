@@ -232,6 +232,10 @@ tiers:
         sched.run_once()
         assert len(sched.cluster.evictions) == 1
 
+    # full-suite (`pytest -m slow`): the budget variant of the sweep;
+    # test_victims_swept_outside_window keeps the sweep path itself in
+    # tier-1 — budget calibration
+    @pytest.mark.slow
     def test_sweep_respects_max_unavailable_budget(self):
         """volcano.sh/max-unavailable bounds the batch (tdm.go:318-330)."""
         ci = self._sweep_cluster(n_tasks=4, budget_max_unavailable="50%")

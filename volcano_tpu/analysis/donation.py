@@ -65,7 +65,8 @@ def check_donation(fast: bool = False) -> List[Finding]:
     # accelerators donate the three resident buffers (in-place scatter);
     # the CPU backend must NOT donate — XLA executes donated computations
     # inline there, which serializes the pipelined loop on compute
-    expected = donation_for_backend()
+    expected = donation_for_backend(
+        n_residents=getattr(kernel, "n_residents", 3))
     if tuple(kernel.donate_argnums) != tuple(expected):
         findings.append(Finding(
             family="donation",

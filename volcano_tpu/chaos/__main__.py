@@ -14,6 +14,13 @@ the crash-consistent checkpoint, and verify the applied-decision log
 matches the uninterrupted run — including a corrupt-checkpoint leg that
 must land on the ``fallback`` ladder rung and STILL finish identical.
 
+``--spec`` runs the speculation smoke instead (chaos/spec.py): the depth-k
+sha-matrix — sync vs depth-1 vs depth-k decision streams over settled and
+late-arrival workloads must be bit-identical, with at least one
+speculative cycle actually invalidated and replayed, on the scan AND
+pallas-interpret allocate paths, plus sidecar serving-ring payload
+identity at depth k.
+
 ``--failover`` runs the HA smoke instead (chaos/failover.py): kill the
 leader at all three phases, promote the warm standby each time, and verify
 the promotion lands warm (``cycles_to_steady == 0``), the decisions stay
@@ -107,6 +114,33 @@ def _failover_smoke(args) -> int:
     return 0 if ok else 1
 
 
+def _spec_smoke(args) -> int:
+    from .spec import run_spec_matrix
+    try:
+        report = run_spec_matrix(depth=args.depth)
+    except Exception as e:  # harness failure, not a chaos verdict
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    print(json.dumps(report, indent=2, default=str))
+    if not report["ok"]:
+        bad = [f"{b}/{w}" for b, legs in report["backends"].items()
+               for w in ("workload_a", "workload_b")
+               if not legs[w]["equal"]]
+        print("speculation smoke FAILED: "
+              + (f"decision sha diverged across modes ({', '.join(bad)}); "
+                 if bad else "")
+              + ("no replay ever fired (speculation untested); "
+                 if not all(l["replayed"]
+                            for l in report["backends"].values()) else "")
+              + ("scan and pallas-interpret disagree; "
+                 if not report["backends_agree"] else "")
+              + ("sidecar depth-k payload stream diverged"
+                 if not (report.get("sidecar") or {}).get(
+                     "payloads_equal", True) else ""),
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="chaos smoke: seeded fault storm + recovery check")
@@ -114,6 +148,13 @@ def main(argv=None) -> int:
                         help="run the fast tier-1 smoke plan")
     parser.add_argument("--seed", type=int, default=7)
     parser.add_argument("--cycles", type=int, default=6)
+    parser.add_argument("--spec", action="store_true",
+                        help="run the depth-k speculation sha-matrix "
+                             "(chaos/spec.py): sync vs depth-1 vs depth-k "
+                             "with replayed late-arrival invalidations, "
+                             "scan + pallas-interpret + sidecar legs")
+    parser.add_argument("--depth", type=int, default=3,
+                        help="in-flight depth for the --spec k legs")
     parser.add_argument("--deadline-ms", type=float, default=None,
                         help="per-cycle watchdog deadline (default: off — "
                              "CI machines vary too much for a fixed one)")
@@ -130,6 +171,8 @@ def main(argv=None) -> int:
                              "rejected split-brain writes, decision "
                              "identity vs the uninterrupted run")
     args = parser.parse_args(argv)
+    if args.spec:
+        return _spec_smoke(args)
     if args.restart:
         return _restart_smoke(args)
     if args.failover:

@@ -118,11 +118,10 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
         digests = []
         ctx = chaos(injector) if injector is not None \
             else contextlib.nullcontext()
+        from ..runtime.driver import step_cycle
         with ctx:
             for c in range(cycles):
-                out = sched.run_once(now=1000.0 + c)
-                rec = (sched.drain(now=1000.0 + c) or out) if pipeline \
-                    else out
+                rec = step_cycle(sched, now=1000.0 + c)
                 digests.append(_cycle_digest(rec))
                 _churn(cluster, c)
         sha = hashlib.sha256(repr(digests).encode()).hexdigest()[:16]

@@ -1,0 +1,227 @@
+"""Depth-k speculation sha-matrix: the PR 5 chaos methodology applied to
+the speculative pipeline.
+
+The depth-k ring (runtime/scheduler.py) dispatches cycles against the
+last-drained snapshot and REPLAYS any cycle whose input epoch a
+predecessor's applied decisions invalidated. The claim that makes that
+safe is decision-neutrality: over the same external event schedule the
+decision stream is bit-identical whether the loop runs synchronously,
+one deep, or k deep with speculation and replay. This module is the
+executable form of that claim, exercised on both allocate backends
+(pure-XLA scan and pallas-interpret) plus the sidecar's serving ring.
+
+Two workloads, same cluster, fixed event schedule:
+
+- **A (settled churn)** — probe-style churn bursts (bound→running, gang
+  complete+re-arrive, node add/remove, job arrival) land at BARRIER
+  cycles: the driver drains the ring before applying them, the way a
+  production loop quiesces before acting on feedback-coupled state.
+  Between bursts the pipeline runs speculative cycles; the binds each
+  burst provokes invalidate whatever is in flight, so replays fire and
+  must reproduce the synchronous decisions exactly.
+- **B (late arrivals)** — workload A plus structural arrivals injected
+  MID-FLIGHT (no barrier): a new job and a new node land while
+  speculative cycles are in the ring. Arrivals apply at cycle
+  boundaries, so dispatch visibility is identical to the sync loop; the
+  first cycle to bind the new work invalidates its in-flight successors
+  and the replays must again be decision-neutral. Injection points
+  follow quiet windows longer than the ring depth — an arrival landing
+  while an already-doomed speculation awaits replay would be visible to
+  the replay but not to the sync run, which is a DRIVER ordering bug,
+  not a scheduler property (production quiesces exactly like workload
+  A's barriers when it cannot guarantee the gap).
+
+Matrix legs per backend: sync / depth-1 / depth-k on A (three-way sha
+equality), sync / depth-k on B (equality plus ``cycle_replays_total``
+strictly positive — speculation must actually have been invalidated).
+The sidecar leg replays the same snapshot sequence through
+``schedule_buffer_pipelined`` at depth 1 and depth k and requires the
+payload streams byte-identical.
+
+``python -m volcano_tpu.chaos --smoke --spec`` runs this as the tier-1
+speculation smoke (scripts/tier1.sh, TIER1_SKIP_SPEC=1 skips).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional
+
+from . import probe
+
+#: default in-flight depth for the k legs (>= 2 or nothing speculates)
+DEFAULT_DEPTH = 3
+#: default cycles per leg; event schedule below assumes >= 24
+DEFAULT_CYCLES = 28
+#: barrier-churn cycles (workload A and B)
+BARRIER_CYCLES = (4, 12, 20)
+#: mid-flight arrival cycles (workload B only). Each sits at least
+#: depth+2 cycles past the previous structural event, so every
+#: speculation the event doomed has already been replayed and drained —
+#: an arrival landing earlier would be visible to a pre-arrival cycle's
+#: replay but not to its sync counterpart (see the module docstring)
+ARRIVAL_CYCLES = (9, 17)
+
+
+def _node(name: str):
+    from ..api import NodeInfo, Resource
+    return NodeInfo(name, allocatable=Resource.from_resource_list(
+        {"cpu": "8", "memory": "16Gi", "pods": "110"}))
+
+
+def _job(uid: str, created: float):
+    from ..api import JobInfo, PodGroupPhase, Resource, TaskInfo
+    name = uid.split("/", 1)[1]
+    job = JobInfo(uid=uid, name=name, namespace="default", queue="default",
+                  min_available=2, priority=1, creation_timestamp=created,
+                  pod_group_phase=PodGroupPhase.INQUEUE)
+    for t in range(3):
+        job.add_task(TaskInfo(
+            uid=f"{uid}-t{t}", name=f"{name}-t{t}", namespace="default",
+            resreq=Resource.from_resource_list(
+                {"cpu": "2", "memory": "2Gi"})))
+    return job
+
+
+def _barrier_churn(cluster, c: int) -> None:
+    """Feedback-coupled churn (reads bind/run state), barrier-applied."""
+    probe._churn(cluster, c)
+    if c == BARRIER_CYCLES[-1]:
+        # retire every job on the arrival node, then the node itself —
+        # the structural remove leg of the matrix
+        ci = cluster.ci
+        for uid in sorted(u for u, j in ci.jobs.items()
+                          if any(t.node_name == "nx-spec"
+                                 for t in j.tasks.values())):
+            cluster.remove_job(uid)
+        cluster.remove_node("nx-spec")
+
+
+def _arrival(cluster, c: int) -> None:
+    """Pure external arrivals — safe to land mid-flight."""
+    if c == ARRIVAL_CYCLES[0]:
+        cluster.add_node(_node("nx-spec"))
+    else:
+        job = _job(f"default/jx-spec{c}", float(c))
+        cluster.ci.add_job(job)
+        cluster.mark_dirty(job_uid=job.uid, structural=True)
+
+
+def _drive(depth: int, pipeline: bool, cycles: int, arrivals: bool,
+           conf_extra: str = "") -> Dict[str, object]:
+    """One matrix leg: drive the schedule, collect every completed
+    cycle's decision digest IN DISPATCH ORDER, and sha the stream."""
+    from ..framework.conf import parse_conf
+    from ..metrics import METRICS
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+    conf = parse_conf(
+        probe._PROBE_CONF + conf_extra
+        + (f"pipeline: true\npipeline_depth: {depth}\n" if pipeline else ""))
+    cluster = FakeCluster(probe._small_cluster().clone())
+    sched = Scheduler(cluster, conf=conf)
+    digests: List[tuple] = []
+
+    def collect(rec) -> None:
+        # pipelined priming cycles return the live (undrained) session;
+        # its decisions surface later, through the ring
+        if rec is None or (pipeline and hasattr(rec, "dispatch_allocate")):
+            return
+        digests.append(probe._cycle_digest(rec))
+
+    replays0 = METRICS.counter_total("cycle_replays_total")
+    for c in range(cycles):
+        if c in BARRIER_CYCLES:
+            while sched._ring:          # quiesce before feedback churn
+                collect(sched._drain_pending(1000.0 + c))
+            _barrier_churn(cluster, c)
+        if arrivals and c in ARRIVAL_CYCLES:
+            _arrival(cluster, c)        # mid-flight, no barrier
+        collect(sched.run_once(now=1000.0 + c))
+    while sched._ring:
+        collect(sched._drain_pending(1000.0 + cycles))
+    return {
+        "sha": hashlib.sha256(repr(digests).encode()).hexdigest()[:16],
+        "records": len(digests),
+        "replays": int(METRICS.counter_total("cycle_replays_total")
+                       - replays0),
+        "degradation": sched.degradation_level,
+    }
+
+
+def _sidecar_leg(depth: int, rounds: int = 6) -> Dict[str, object]:
+    """Serving-ring leg: the same snapshot sequence through the sidecar
+    at depth 1 and depth k must yield byte-identical payload streams."""
+    import struct
+    from ..native.wire import serialize
+    from ..runtime.sidecar import SchedulerSidecar
+    bufs = []
+    for r in range(rounds):
+        ci = probe._small_cluster()
+        for j, uid in enumerate(sorted(ci.jobs)):
+            ci.jobs[uid].priority = (j + r) % 5
+        bufs.append(serialize(ci)[0])
+
+    def serve(d: int) -> List[bytes]:
+        sc = SchedulerSidecar(conf=probe._PROBE_CONF
+                              + f"pipeline_depth: {d}\n")
+        payloads = []
+        for buf in bufs:
+            p = sc.schedule_buffer_pipelined(buf)
+            if struct.unpack("<II", p[4:12]) != (0, 0):
+                payloads.append(p)
+        while True:
+            p = sc.drain_pending()
+            if p is None:
+                break
+            payloads.append(p)
+        return payloads
+
+    shallow, deep = serve(1), serve(depth)
+    return {"rounds": rounds,
+            "payloads_equal": shallow == deep,
+            "payloads": len(shallow)}
+
+
+def run_spec_matrix(depth: int = DEFAULT_DEPTH,
+                    cycles: int = DEFAULT_CYCLES,
+                    backends: Optional[List[str]] = None,
+                    sidecar: bool = True) -> Dict[str, object]:
+    """Run the full matrix; returns a JSON-ready report with ``ok``."""
+    depth = max(2, int(depth))
+    backends = list(backends) if backends else ["scan", "pallas_interpret"]
+    conf_extra = {"scan": "", "pallas_interpret": "use_pallas: interpret\n"}
+    report: Dict[str, object] = {"depth": depth, "cycles": int(cycles),
+                                 "backends": {}}
+    ok = True
+    shas_a = []
+    for backend in backends:
+        extra = conf_extra[backend]
+        a = {mode: _drive(d, p, cycles, arrivals=False, conf_extra=extra)
+             for mode, (d, p) in (("sync", (1, False)),
+                                  ("depth1", (1, True)),
+                                  ("depthk", (depth, True)))}
+        b = {mode: _drive(d, p, cycles, arrivals=True, conf_extra=extra)
+             for mode, (d, p) in (("sync", (1, False)),
+                                  ("depthk", (depth, True)))}
+        a_equal = len({leg["sha"] for leg in a.values()}) == 1
+        b_equal = len({leg["sha"] for leg in b.values()}) == 1
+        replayed = (a["depthk"]["replays"] + b["depthk"]["replays"]) > 0
+        shas_a.append(a["sync"]["sha"])
+        report["backends"][backend] = {
+            "workload_a": dict(a, equal=a_equal),
+            "workload_b": dict(b, equal=b_equal),
+            "replayed": replayed,
+        }
+        ok = ok and a_equal and b_equal and replayed
+    # the two allocate backends must agree with each other too — the
+    # repo-wide bit-identical kernel contract, pinned here because a
+    # depth bug that broke only one backend would otherwise still pass
+    backends_agree = len(set(shas_a)) == 1
+    report["backends_agree"] = backends_agree
+    ok = ok and backends_agree
+    if sidecar:
+        report["sidecar"] = _sidecar_leg(depth)
+        ok = ok and bool(report["sidecar"]["payloads_equal"])
+    report["ok"] = ok
+    return report

@@ -99,6 +99,14 @@ class SchedulerConfiguration:
     #: pipeline" for the exact apply-ordering contract. YAML: top-level
     #: ``pipeline: true``.
     pipeline: bool = False
+    #: pipelined in-flight depth (runtime/scheduler.py pending ring): 1
+    #: (default) keeps the depth-1 contract above unchanged; k > 1 lets
+    #: up to k cycles be in flight, where cycles dispatched behind an
+    #: undrained predecessor are SPECULATIVE — replayed decision-
+    #: neutrally at drain if a predecessor applied decisions. Only
+    #: meaningful with ``pipeline: true``. YAML: top-level
+    #: ``pipeline_depth: 3``.
+    pipeline_depth: int = 1
     #: opt-in persistent XLA compilation cache directory
     #: (framework/compile_cache.enable_compilation_cache); also settable
     #: via $VOLCANO_JAX_CACHE_DIR. None = disabled.
@@ -192,6 +200,7 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     sc.telemetry = bool(data.get("telemetry", False))
     sc.delta_uploads = bool(data.get("delta_uploads", True))
     sc.pipeline = bool(data.get("pipeline", False))
+    sc.pipeline_depth = max(1, int(data.get("pipeline_depth", 1) or 1))
     cache_dir = data.get("compilation_cache_dir")
     sc.compilation_cache_dir = str(cache_dir) if cache_dir else None
     ddl = data.get("cycle_deadline_ms")

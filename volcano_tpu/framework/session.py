@@ -134,6 +134,87 @@ class PendingAllocate:
     dispatched_at: float = 0.0
     #: mesh width of the dispatch (1 when unsharded) — per-shard occupancy
     shards: int = 1
+    #: device handle of the changed-rows readback tail (delta path with
+    #: ``kernel.rb_cap``); None keeps the full-readback drain
+    tail: object = None
+    #: the HOST group buffers this dispatch packed (the mirror capture at
+    #: dispatch time). Speculative cycles recover from these via
+    #: ``kernel.host_tree`` — their ``tree`` may be refreshed in place by
+    #: the time they drain — and the digest verify falls back to
+    #: ``mirror_digest`` below when a newer dispatch advanced the live
+    #: mirror past this capture.
+    bufs: object = None
+    #: host digest of ``bufs`` frozen at dispatch (speculative dispatches
+    #: only; None = compare against the live state mirror as depth-1 does)
+    mirror_digest: object = None
+    #: the session's pack epoch when this cycle dispatched — a structural
+    #: repack while the cycle was in flight reindexes the maps, and the
+    #: drain must then apply with the capture below instead of live maps
+    epoch: int = 0
+    #: ring slot (monotonic dispatch sequence number) — per-slot device
+    #: windows in the occupancy trace
+    slot: int = 0
+    #: effective pipeline depth the ring owner dispatched this cycle under
+    #: (occupancy windows group per depth so a degenerate depth-1 overlap
+    #: is distinguishable from a real depth-k one)
+    depth: int = 1
+    #: True when this cycle dispatched against the last-drained snapshot
+    #: with predecessors still in flight (depth-k speculation)
+    speculative: bool = False
+    #: apply capture: (maps, task->job row copy) frozen at dispatch, so an
+    #: epoch-stale but otherwise valid cycle still applies its decisions
+    #: with the indexing it was computed under
+    apply_ctx: object = None
+    #: dispatch-time stats snapshot (extras_ms, upload bytes, ...) merged
+    #: back at drain — at depth k the session's cycle state has been reset
+    #: by later reopens before this cycle drains
+    stats: object = None
+    #: in-flight async dispatch handle (_AsyncDispatch); resolve() fills
+    #: packed/tail/bufs/dispatch_ms before any readback
+    future: object = None
+    #: set by the ring owner: force the full-readback drain path (the
+    #: decisions mirror chain was broken by a replay/recovery upstream)
+    rb_full: bool = False
+    #: ResidentState.dec_epoch at dispatch — the decisions-chain lineage.
+    #: A mismatch at drain means an out-of-band dispatch (recovery,
+    #: replay) rewired the device diff base after this cycle went out:
+    #: drain full, and do NOT advance dec_mirror (the entry dispatched
+    #: under the new lineage reseeds it from its own full readback)
+    dec_epoch: int = 0
+
+
+class _AsyncDispatch:
+    """Minimal single-shot future for the double-buffered pack thread: one
+    daemon thread runs the dispatch closure (diff/pack + device submit)
+    while the main thread returns to event ingestion. Deliberately not a
+    ThreadPoolExecutor — no pool state to leak across Scheduler restarts,
+    and the one-behind ring resolves every handle before the next submit,
+    so at most one worker is ever alive per scheduler."""
+
+    __slots__ = ("_done", "_result", "_exc")
+
+    def __init__(self, fn):
+        import threading
+        self._done = threading.Event()
+        self._result = None
+        self._exc = None
+
+        def _run():
+            try:
+                self._result = fn()
+            except BaseException as e:  # resurfaced on the main thread
+                self._exc = e
+            finally:
+                self._done.set()
+
+        threading.Thread(target=_run, name="volcano-pack",
+                         daemon=True).start()
+
+    def result(self):
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
 
 
 @lru_cache(maxsize=64)
@@ -200,6 +281,15 @@ class Session:
         self.pipelined: Dict[str, str] = {}     # task uid -> node name
         self.conditions: Dict[str, str] = {}    # job uid -> condition type
         self.phase_updates: Dict[str, object] = {}  # job uid -> new PG phase
+        #: the subset of phase_updates that actually CHANGES the job's
+        #: current PodGroup phase — the depth-k ring's invalidation
+        #: predicate needs effective transitions, not the steady-state
+        #: re-assertion of RUNNING every cycle
+        self.phase_changes: Dict[str, object] = {}
+        #: set by the ring owner after a drain applied intents; a second
+        #: drain without an intervening reopen resets first so each
+        #: completed cycle's record holds only its own intents
+        self._cycle_state_dirty = False
         self.last_allocate: Optional[AllocateResult] = None
         self._last_queue_deserved = None
         self.stats: Dict[str, float] = {}
@@ -254,6 +344,10 @@ class Session:
         else:
             from .. import native
             self.snap, self.maps = native.pack_best_effort(self.cluster)
+        # pack epoch: every repack reindexes the maps, so an in-flight
+        # cycle dispatched under an older epoch must apply with its own
+        # captured maps (PendingAllocate.apply_ctx), never the live ones
+        self.pack_epoch = getattr(self, "pack_epoch", 0) + 1
         self.stats["pack_ms"] = (time.time() - t0) * 1000
         # inter-pod affinity encoding rides the snapshot (the predicates
         # plugin's InterPodAffinity state, predicates.go:116-160)
@@ -854,97 +948,160 @@ class Session:
                                               group_sizes(spec)))
                 fn.lower(*avals).compile()
 
-    def dispatch_allocate(self) -> PendingAllocate:
+    def dispatch_allocate(self, speculative: bool = False,
+                          async_pack: bool = False) -> PendingAllocate:
         """Upload (full or delta) + dispatch the compiled allocate cycle
         WITHOUT reading the decisions back. Returns the pending handle;
         :meth:`complete_allocate` drains it. The synchronous path is
         ``complete_allocate(dispatch_allocate())``; the pipelined scheduler
         loop holds the pending across one run_once boundary so device
-        compute overlaps host event ingestion."""
+        compute overlaps host event ingestion.
+
+        ``speculative`` marks a depth-k dispatch with predecessors still in
+        flight: the kernel packs into a fresh scratch (keep_scratch — the
+        in-flight mirror buffers stay referenced by their pendings) and the
+        pending freezes its own mirror digest + host buffers, since the
+        live residency will have moved on by the time it drains.
+
+        ``async_pack`` moves the diff/pack + device submit onto a worker
+        thread (the double-buffered pack thread): the returned pending
+        carries a ``future``; :meth:`resolve_pending` joins it. Everything
+        epoch-sensitive (extras derivation, chaos seam, kernel/state
+        lookup, apply capture) stays on the calling thread."""
         t0 = time.time()
         with _spans.span("session.extras"):
             cfg, extras = self._derived_allocate_inputs()
-        self.stats["extras_ms"] = (time.time() - t0) * 1000
-        t0 = time.time()
+        extras_ms = (time.time() - t0) * 1000
+        self.stats["extras_ms"] = extras_ms
         # fault-injection seam (chaos backend-loss / slow-dispatch faults
         # fire here, before any resident state is touched, exactly where a
-        # real accelerator loss surfaces)
+        # real accelerator loss surfaces) — main thread, so the deadline
+        # watchdog still sees an injected slow dispatch
         from ..chaos.inject import seam
         seam("session.dispatch", session=self)
         kernel = state = mesh = None
-        with _spans.span("session.dispatch", cat="dispatch"):
-            if bool(getattr(self.conf, "delta_uploads", True)):
-                # device-resident buffers + packed delta scatter:
-                # steady-state upload is O(changed elements); full re-fuse
-                # only on the first cycle of a shape bucket or when the
-                # diff is huge. With conf ``sharding: true`` the residents
-                # split along the node axis over a device mesh
-                # (ShardedDeltaKernel): deltas route to the owning shard,
-                # the digest verifies per shard, and out_shardings ==
-                # in_shardings keeps the steady loop free of resharding
-                # copies (probe-counted below).
-                mesh = self._sharding_mesh()
-                if mesh is not None:
-                    kernel = _sharded_delta_allocate(cfg, self.snap, extras,
-                                                     mesh)
-                else:
-                    kernel = _delta_allocate(cfg, self.snap, extras)
-                state = self._resident.get(id(kernel))
-                if state is None:
-                    from ..ops.fused_io import ResidentState
-                    state = self._resident[id(kernel)] = ResidentState()
-                    warm = getattr(self, "_warm_mirrors", None)
-                    if warm and mesh is None:
-                        # warm restart (runtime/checkpoint): a digest-
-                        # verified pre-crash mirror for this shape bucket
-                        # becomes the residency, so this first run ships
-                        # a delta instead of the cold full upload.
-                        # Sharded residents always cold-fuse (mesh-
-                        # dependent placement is not checkpointed).
-                        from ..ops.fused_io import _shape_key
-                        mir = warm.pop(
-                            _shape_key((self.snap, extras), cfg), None)
-                        if mir is not None:
-                            from ..runtime.checkpoint import adopt_mirror
-                            adopt_mirror(state, mir)
-                packed = kernel.run(state, (self.snap, extras))
-                self.stats["upload_bytes"] = float(state.last_upload_bytes)
-                self.stats["upload_bytes_full"] = float(
-                    state.full_upload_bytes)
-                self.stats["delta_cycle"] = float(state.last_kind == "delta")
-                if mesh is not None:
-                    self.stats["mesh_devices"] = float(mesh.devices.size)
-                    self.stats["resharding_copies"] = float(
-                        state.resharding_copies)
-                from ..metrics import METRICS
-                METRICS.inc("cycle_upload_bytes", state.last_upload_bytes,
-                            labels={"kind": state.last_kind})
+        if bool(getattr(self.conf, "delta_uploads", True)):
+            # device-resident buffers + packed delta scatter: steady-state
+            # upload is O(changed elements); full re-fuse only on the
+            # first cycle of a shape bucket or when the diff is huge. With
+            # conf ``sharding: true`` the residents split along the node
+            # axis over a device mesh (ShardedDeltaKernel): deltas route
+            # to the owning shard, the digest verifies per shard, and
+            # out_shardings == in_shardings keeps the steady loop free of
+            # resharding copies (probe-counted below).
+            mesh = self._sharding_mesh()
+            if mesh is not None:
+                kernel = _sharded_delta_allocate(cfg, self.snap, extras,
+                                                 mesh)
             else:
-                # fused 3-buffer full upload + single packed readback (the
-                # per-leaf transfer cost over the axon tunnel dominated at
-                # scale; conf delta_uploads: false)
-                fn, fuse = _fused_allocate(cfg, self.snap, extras)
-                packed = fn(*fuse((self.snap, extras)))
-        T = int(np.asarray(self.snap.tasks.status).shape[0])
-        J = int(np.asarray(self.snap.jobs.valid).shape[0])
-        R = int(np.asarray(self.snap.nodes.idle).shape[1])
-        dispatch_ms = (time.time() - t0) * 1000
-        self.stats["dispatch_ms"] = dispatch_ms
-        return PendingAllocate(packed=packed, cfg=cfg, T=T, J=J, R=R,
-                               dispatch_ms=dispatch_ms, kernel=kernel,
-                               state=state, tree=(self.snap, extras),
-                               dispatched_at=_spans.now(),
-                               shards=(int(mesh.devices.size)
-                                       if mesh is not None else 1))
+                kernel = _delta_allocate(cfg, self.snap, extras)
+            state = self._resident.get(id(kernel))
+            if state is None:
+                from ..ops.fused_io import ResidentState
+                state = self._resident[id(kernel)] = ResidentState()
+                warm = getattr(self, "_warm_mirrors", None)
+                if warm and mesh is None:
+                    # warm restart (runtime/checkpoint): a digest-
+                    # verified pre-crash mirror for this shape bucket
+                    # becomes the residency, so this first run ships
+                    # a delta instead of the cold full upload.
+                    # Sharded residents always cold-fuse (mesh-
+                    # dependent placement is not checkpointed).
+                    from ..ops.fused_io import _shape_key
+                    mir = warm.pop(
+                        _shape_key((self.snap, extras), cfg), None)
+                    if mir is not None:
+                        from ..runtime.checkpoint import adopt_mirror
+                        adopt_mirror(state, mir)
+        snap = self.snap
+        T = int(np.asarray(snap.tasks.status).shape[0])
+        J = int(np.asarray(snap.jobs.valid).shape[0])
+        R = int(np.asarray(snap.nodes.idle).shape[1])
+        pending = PendingAllocate(
+            packed=None, cfg=cfg, T=T, J=J, R=R, kernel=kernel, state=state,
+            tree=(snap, extras),
+            shards=(int(mesh.devices.size) if mesh is not None else 1),
+            epoch=int(getattr(self, "pack_epoch", 0)),
+            dec_epoch=int(getattr(state, "dec_epoch", 0) or 0)
+            if state is not None else 0,
+            speculative=bool(speculative),
+            apply_ctx=(self.maps, np.asarray(snap.tasks.job)),
+            stats={"extras_ms": extras_ms})
+        k_run, k_mesh = kernel, mesh
 
-    def _oracle_packed(self, pending: PendingAllocate) -> np.ndarray:
+        def _dispatch():
+            t1 = time.time()
+            dstats = {}
+            with _spans.span("session.dispatch", cat="dispatch"):
+                tail = bufs = mdig = None
+                if k_run is not None:
+                    packed = k_run.run(state, (snap, extras),
+                                       keep_scratch=speculative)
+                    dstats["upload_bytes"] = float(state.last_upload_bytes)
+                    dstats["upload_bytes_full"] = float(
+                        state.full_upload_bytes)
+                    dstats["delta_cycle"] = float(
+                        state.last_kind == "delta")
+                    if k_mesh is not None:
+                        dstats["mesh_devices"] = float(k_mesh.devices.size)
+                        dstats["resharding_copies"] = float(
+                            state.resharding_copies)
+                    from ..metrics import METRICS
+                    METRICS.inc("cycle_upload_bytes",
+                                state.last_upload_bytes,
+                                labels={"kind": state.last_kind})
+                    tail = getattr(state, "last_tail", None)
+                    bufs = state.mirror
+                    if speculative:
+                        # freeze THIS dispatch's integrity digest: by its
+                        # drain the live mirror belongs to a newer dispatch
+                        mdig = k_run.mirror_digest(state)
+                else:
+                    # fused 3-buffer full upload + single packed readback
+                    # (the per-leaf transfer cost over the axon tunnel
+                    # dominated at scale; conf delta_uploads: false)
+                    fn, fuse = _fused_allocate(cfg, snap, extras)
+                    packed = fn(*fuse((snap, extras)))
+            dstats["dispatch_ms"] = (time.time() - t1) * 1000
+            return packed, tail, bufs, mdig, dstats, _spans.now()
+
+        if async_pack:
+            pending.future = _AsyncDispatch(_dispatch)
+        else:
+            self._adopt_dispatch(pending, _dispatch())
+            self.stats.update(pending.stats)
+        return pending
+
+    def _adopt_dispatch(self, pending: PendingAllocate, out) -> None:
+        packed, tail, bufs, mdig, dstats, at = out
+        pending.packed = packed
+        pending.tail = tail
+        pending.bufs = bufs
+        pending.mirror_digest = mdig
+        pending.stats.update(dstats)
+        pending.dispatch_ms = dstats.get("dispatch_ms", 0.0)
+        pending.dispatched_at = at
+        pending.future = None
+
+    def resolve_pending(self, pending: PendingAllocate) -> None:
+        """Join an async pack/dispatch (no-op for sync dispatches). Worker
+        exceptions resurface HERE, on the calling thread — the ring owner
+        maps them onto the degradation ladder like a dispatch fault."""
+        fut = pending.future
+        if fut is not None:
+            with _spans.span("session.pack_wait", cat="wait"):
+                out = fut.result()
+            self._adopt_dispatch(pending, out)
+
+    def _oracle_packed(self, pending: PendingAllocate,
+                       tree=None) -> np.ndarray:
         """Last rung of the degradation ladder: decisions from the
         pure-host CPU reference (runtime/cpu_reference.allocate_cpu — the
         decision-equality oracle of the kernel test suites), packed into
         the same 3T+3J layout so the drain path is shared. Used when the
         compiled re-dispatch itself fails, i.e. the accelerator is gone."""
         from ..runtime.cpu_reference import allocate_cpu
-        snap, extras = pending.tree
+        snap, extras = tree if tree is not None else pending.tree
         # collect_telemetry=True is NOT about telemetry here: it enables
         # the oracle's kernel-mirroring capacity-give-up short-circuit,
         # without which an already-ready gang evaluated after a stalled
@@ -974,42 +1131,137 @@ class Session:
         kernel, state = pending.kernel, pending.state
         reason = None
         packed = None
-        try:
-            with _spans.span("session.readback", cat="wait"):
-                packed = np.asarray(pending.packed)
-            if pending.dispatched_at:
+        window_closed = False
+        seam_fired = False
+        digest_checked = False
+
+        def _close_window():
+            nonlocal window_closed
+            if pending.dispatched_at and not window_closed:
                 # close this cycle's in-flight device window for the
-                # pipeline-occupancy analyzer
+                # pipeline-occupancy analyzer (per-slot at depth k)
                 _spans.device_window(pending.dispatched_at, _spans.now(),
-                                     shards=pending.shards)
-        except Exception as e:
-            if kernel is None or pending.tree is None:
-                raise
-            reason = f"readback:{type(e).__name__}"
-        if packed is not None and kernel is not None and kernel.digest_words:
-            # chaos mirror-drift faults fire here: after the dispatch,
-            # before the compare — the point where a real desync sits
-            seam("session.complete", state=state)
-            with _spans.span("session.digest"):
-                packed, dev_digest = kernel.split_digest(packed)
-                host_digest = kernel.mirror_digest(state)
-            if host_digest is not None and not np.array_equal(dev_digest,
-                                                              host_digest):
-                reason = "digest"
-                METRICS.inc("resident_digest_mismatch_total")
-                _spans.log_event("digest_trip", source="session")
-                packed = None
+                                     shards=pending.shards,
+                                     slot=pending.slot,
+                                     depth=int(pending.depth or 1))
+                window_closed = True
+
+        def _host_digest():
+            # the k-slot identity rule: a speculative pending froze its
+            # mirror digest at dispatch (the live mirror has moved on by
+            # its drain); a depth-1 pending still owns the live mirror,
+            # so the live digest keeps chaos mirror-drift trip semantics
+            if pending.mirror_digest is not None:
+                return pending.mirror_digest
+            return kernel.mirror_digest(state)
+
+        rb_cap = int(getattr(kernel, "rb_cap", 0) or 0) \
+            if kernel is not None else 0
+        dec_len = int(getattr(kernel, "dec_len", 0) or 0)
+        mirror = getattr(state, "dec_mirror", None) \
+            if state is not None else None
+        same_lineage = (state is not None and pending.dec_epoch
+                        == int(getattr(state, "dec_epoch", 0) or 0))
+        use_tail = (rb_cap > 0 and pending.tail is not None
+                    and not pending.rb_full and same_lineage
+                    and mirror is not None
+                    and mirror.shape[0] == dec_len)
+        if use_tail:
+            # O(churn) drain: read only the changed-rows tail
+            # [digest | count | idx[cap] | vals[cap]] and patch the host
+            # mirror of the last drained decisions
+            try:
+                with _spans.span("session.readback", cat="wait"):
+                    tail = np.asarray(pending.tail)
+                _close_window()
+                # chaos mirror-drift faults fire here: after the dispatch,
+                # before the compare — the point where a real desync sits
+                seam("session.complete", state=state)
+                seam_fired = True
+                with _spans.span("session.digest"):
+                    dev_digest, cnt, idx, vals = kernel.split_tail(tail)
+                    host_digest = _host_digest()
+                if host_digest is not None and not np.array_equal(
+                        dev_digest, host_digest):
+                    reason = "digest"
+                    METRICS.inc("resident_digest_mismatch_total")
+                    _spans.log_event("digest_trip", source="session")
+                elif cnt <= rb_cap:
+                    digest_checked = True
+                    packed = mirror.copy()
+                    packed[idx] = vals
+                    self.stats["drain_readback_bytes"] = float(tail.nbytes)
+                    self.stats["drain_readback_rows"] = float(cnt)
+                else:
+                    # churn burst overflowed the tail capacity — not a
+                    # fault; the digest already verified, fall through to
+                    # the full readback below
+                    digest_checked = True
+            except Exception as e:
+                if pending.tree is None and pending.bufs is None:
+                    raise
+                reason = f"readback:{type(e).__name__}"
+        if packed is None and reason is None:
+            try:
+                with _spans.span("session.readback", cat="wait"):
+                    packed = np.asarray(pending.packed)
+                _close_window()
+            except Exception as e:
+                if kernel is None or (pending.tree is None
+                                      and pending.bufs is None):
+                    raise
+                reason = f"readback:{type(e).__name__}"
+            if packed is not None and kernel is not None \
+                    and kernel.digest_words:
+                if not seam_fired:
+                    seam("session.complete", state=state)
+                    seam_fired = True
+                with _spans.span("session.digest"):
+                    packed, dev_digest = kernel.split_digest(packed)
+                    host_digest = None if digest_checked \
+                        else _host_digest()
+                if host_digest is not None and not np.array_equal(
+                        dev_digest, host_digest):
+                    reason = "digest"
+                    METRICS.inc("resident_digest_mismatch_total")
+                    _spans.log_event("digest_trip", source="session")
+                    packed = None
+                elif not digest_checked:
+                    digest_checked = True
+            if packed is not None:
+                self.stats["drain_readback_bytes"] = float(packed.nbytes)
+        if rb_cap > 0:
+            self.stats["drain_readback_bytes_full"] = float(
+                (dec_len + kernel.digest_words) * 4)
         if reason is None:
+            if rb_cap > 0 and same_lineage and packed is not None \
+                    and packed.shape[0] == dec_len:
+                # the next drain's delta base — the tail path scattered
+                # into a fresh array already; the full path's slice view
+                # copies out of the readback buffer
+                state.dec_mirror = packed if packed.flags.owndata \
+                    else np.array(packed, np.int32)
             return packed
+        src_tree = pending.tree
+        if pending.speculative and pending.bufs is not None \
+                and hasattr(kernel, "host_tree"):
+            # a speculative pending's ``tree`` may have been refreshed in
+            # place since its dispatch — recover from the host buffers the
+            # dispatch actually packed
+            src_tree = kernel.host_tree(pending.bufs)
         t0 = time.time()
         with _spans.span("session.recovery", cat="recovery"):
             try:
-                packed = np.asarray(kernel.recover(state, pending.tree))
+                packed = np.asarray(kernel.recover(state, src_tree))
                 packed, _dig = kernel.split_digest(packed)
                 mode = "refuse"
             except Exception:
-                packed = self._oracle_packed(pending)
+                packed = self._oracle_packed(pending, tree=src_tree)
                 mode = "cpu_oracle"
+        if state is not None:
+            # the decisions-mirror chain is broken either way; force the
+            # next drain onto the full readback
+            state.dec_mirror = None
         ms = (time.time() - t0) * 1000
         METRICS.inc("cycle_recoveries_total",
                     labels={"reason": reason.split(":")[0], "mode": mode})
@@ -1025,6 +1277,12 @@ class Session:
         (verifying the resident-buffer integrity digest and recovering in
         place if it trips), decode the telemetry tail, and apply
         binds/pipelines to the session."""
+        self.resolve_pending(pending)
+        if pending.stats:
+            # dispatch-time stats snapshot: at depth k this session's
+            # cycle state has been reset by later reopens since the
+            # dispatch — re-merge so the drained cycle's record is whole
+            self.stats.update(pending.stats)
         t0 = time.time()
         cfg, T, J = pending.cfg, pending.T, pending.J
         packed = self._readback_packed(pending)
@@ -1039,14 +1297,18 @@ class Session:
             tel = unpack_cycle_telemetry(packed[3 * T + 3 * J:], pending.R)
             self.last_telemetry["allocate"] = tel
             publish_cycle_telemetry(tel)
-        return self.apply_packed(packed, T, J)
+        ctx = None
+        if pending.epoch != int(getattr(self, "pack_epoch", 0)):
+            ctx = pending.apply_ctx
+        return self.apply_packed(packed, T, J, ctx=ctx)
 
-    def apply_packed(self, packed: np.ndarray, T: int, J: int):
+    def apply_packed(self, packed: np.ndarray, T: int, J: int, ctx=None):
         """Decode a packed decision vector (integrity digest already
         stripped) and apply it to this session — the shared tail of
         :meth:`complete_allocate`, also the entry the fleet runtime
         (volcano_tpu/fleet) uses after its batched readback handed each
-        tenant its own row of decisions."""
+        tenant its own row of decisions. ``ctx`` carries a stale pack
+        epoch's (maps, task->job) capture for depth-k applies."""
         from ..ops.allocate_scan import unpack_decisions
         with _spans.span("session.unpack"):
             (task_node, task_mode, task_gpu, job_ready, job_pipelined,
@@ -1061,7 +1323,7 @@ class Session:
         with _spans.span("session.apply"):
             self.apply_allocate(
                 result, host=(task_node, task_mode, task_gpu, job_ready,
-                              job_pipelined))
+                              job_pipelined), ctx=ctx)
         self.stats["apply_ms"] = (time.time() - t0) * 1000
         return result
 
@@ -1376,7 +1638,8 @@ class Session:
                                         if job_sum[ji, k] > 0}))
             self._dirty_jobs.add(job.uid)
 
-    def apply_allocate(self, result: AllocateResult, host=None) -> None:
+    def apply_allocate(self, result: AllocateResult, host=None,
+                       ctx=None) -> None:
         if host is not None:
             task_node, task_mode, task_gpu, job_ready, _ = host
         else:
@@ -1384,25 +1647,36 @@ class Session:
             task_mode = np.asarray(result.task_mode)
             task_gpu = np.asarray(result.task_gpu)
             job_ready = np.asarray(result.job_ready)
-        task_job = np.asarray(self.snap.tasks.job)
+        if ctx is not None:
+            # epoch-stale apply (depth-k ring): this cycle dispatched under
+            # an older pack epoch, so its decision rows index THAT epoch's
+            # maps — apply with the captured (maps, task->job) instead of
+            # the live ones. Binds/evictions key by uid, so cluster truth
+            # stays consistent regardless of the repack in between.
+            maps, task_job = ctx
+        else:
+            maps, task_job = self.maps, np.asarray(self.snap.tasks.job)
         from ..api import PodGroupPhase
         # touch only the decided tasks (numpy picks them; at 100k tasks the
         # all-uids python sweep was the apply bottleneck)
-        uids = self.maps.task_uids
+        uids = maps.task_uids
         bind_mask = (task_mode == MODE_ALLOCATED) & job_ready[task_job]
         bind_idx = np.nonzero(bind_mask)[0]
-        if len(bind_idx) >= 512:
+        if ctx is None and len(bind_idx) >= 512:
+            # _bulk_bind reads the CURRENT pack's object caches — only
+            # valid for same-epoch applies; stale applies take the per-task
+            # path (uid-keyed, epoch-independent)
             self._bulk_bind(bind_idx, task_node, task_gpu)
         else:
             for ti in bind_idx:
                 self._bind_task(uids[ti],
-                                self.maps.node_names[int(task_node[ti])],
+                                maps.node_names[int(task_node[ti])],
                                 int(task_gpu[ti]))
         for ti in np.nonzero((task_mode != 0) & ~bind_mask)[0]:
             # held in-session only (pipelined or allocated-but-unready):
             # no cache flush, like an uncommitted Statement
             self.pipelined[uids[ti]] = \
-                self.maps.node_names[int(task_node[ti])]
+                maps.node_names[int(task_node[ti])]
         # ready gangs' PodGroups move to Running (scheduler status updater,
         # session.go:173 jobStatus) — AFTER the bind loop so a job whose
         # bind degraded to a recorded error is not marked Running with
@@ -1412,9 +1686,16 @@ class Session:
             _job, _task = self._find_task(task_uid)
             if _job is not None:
                 failed_jobs.add(_job.uid)
-        for uid, ji in self.maps.job_index.items():
+        for uid, ji in maps.job_index.items():
             if bool(job_ready[ji]) and uid not in failed_jobs:
                 self.phase_updates[uid] = PodGroupPhase.RUNNING
+                job = self.cluster.jobs.get(uid)
+                if job is not None and job.pod_group_phase \
+                        != PodGroupPhase.RUNNING:
+                    # effective transition only — the ring's invalidation
+                    # predicate; steady re-assertion of RUNNING every
+                    # cycle must not poison speculation
+                    self.phase_changes[uid] = PodGroupPhase.RUNNING
 
     # --------------------------------------------------------------- close
     def close(self) -> None:

@@ -304,7 +304,8 @@ class ResidentState:
 
     __slots__ = ("mirror", "scratch", "device", "retiring", "full_cycles",
                  "delta_cycles", "last_kind", "last_upload_bytes",
-                 "full_upload_bytes", "resharding_copies")
+                 "full_upload_bytes", "resharding_copies",
+                 "dec_device", "dec_mirror", "last_tail", "dec_epoch")
 
     def __init__(self):
         self.mirror: Optional[tuple] = None
@@ -313,6 +314,21 @@ class ResidentState:
         #: handles consumed by the in-flight/last cycle, deleted at the
         #: next dispatch (no-op where donation already killed them)
         self.retiring: tuple = ()
+        #: device-resident copy of the previous cycle's packed decisions
+        #: (pre-digest) — the diff base for the changed-rows readback tail
+        self.dec_device: Optional[Any] = None
+        #: host mirror of the decisions the OWNER last drained; None forces
+        #: the next drain onto the full-readback path (first cycle, after
+        #: recovery, after a discarded speculative cycle)
+        self.dec_mirror: Optional[np.ndarray] = None
+        #: device handle of the changed-rows tail emitted by the most
+        #: recent run (None when the kernel's tail is disabled)
+        self.last_tail: Optional[Any] = None
+        #: decisions-chain lineage: bumped by every out-of-band dispatch
+        #: (recovery re-run, speculative replay) — a pending whose captured
+        #: epoch mismatches drains full and leaves dec_mirror alone, so the
+        #: tail diff base and the host mirror can never silently diverge
+        self.dec_epoch: int = 0
         self.full_cycles = 0
         self.delta_cycles = 0
         #: "full" | "delta" for the most recent cycle
@@ -358,23 +374,76 @@ class DeltaKernel:
         #: (see host_digest). Kernel-aware consumers strip it with
         #: :meth:`split_digest` and compare against :meth:`mirror_digest`.
         self.digest_words = DIGEST_WORDS if integrity else 0
+        unfuse = make_unfuse(self.treedef, self.spec)
+        #: decisions length (elements) of this shape bucket's packed
+        #: readback, pre-digest — sized abstractly, no compile
+        self.dec_len = 0
+        #: changed-rows capacity of the readback tail: the tail indexes up
+        #: to ``rb_cap`` decision rows that differ from the previous
+        #: cycle's, so steady-state drains transfer O(churn) bytes the way
+        #: uploads already do. 0 disables the tail (tiny buckets where the
+        #: tail would not beat the full readback keep the old entry
+        #: signature bit-for-bit).
+        self.rb_cap = 0
+        if integrity:
+            try:
+                shape = jax.eval_shape(
+                    lambda t: cycle_fn(*t).packed_decisions(), example_tree)
+                self.dec_len = int(shape.shape[0])
+            except Exception:
+                self.dec_len = 0
+            cap = pow2_bucket(max(32, self.dec_len // 16), 32)
+            if self.dec_len and 2 * cap + 1 + DIGEST_WORDS < self.dec_len:
+                self.rb_cap = cap
+        #: resident buffers threaded through the donated entry: the three
+        #: fused group buffers, plus the previous-decisions buffer when the
+        #: changed-rows tail is enabled
+        self.n_residents = 4 if self.rb_cap else 3
         #: backend-dependent donation of the resident buffers (see
         #: donation_for_backend) — the graphcheck ``donation`` family
         #: verifies this matches the platform contract
-        self.donate_argnums = donation_for_backend()
-        unfuse = make_unfuse(self.treedef, self.spec)
+        self.donate_argnums = donation_for_backend(
+            n_residents=self.n_residents)
+        rb_cap = self.rb_cap
 
-        def _update_cycle(fbuf, ibuf, bbuf,
-                          fidx, fvals, iidx, ivals, bidx, bvals):
-            fbuf = fbuf.at[fidx].set(fvals)
-            ibuf = ibuf.at[iidx].set(ivals)
-            bbuf = bbuf.at[bidx].set(bvals)
-            args = unfuse(fbuf, ibuf, bbuf)
-            packed = cycle_fn(*args).packed_decisions()
-            if integrity:
-                packed = jnp.concatenate(
-                    [packed, _device_digest(fbuf, ibuf, bbuf)])
-            return fbuf, ibuf, bbuf, packed
+        if self.rb_cap:
+            def _update_cycle(fbuf, ibuf, bbuf, dprev,
+                              fidx, fvals, iidx, ivals, bidx, bvals):
+                fbuf = fbuf.at[fidx].set(fvals)
+                ibuf = ibuf.at[iidx].set(ivals)
+                bbuf = bbuf.at[bidx].set(bvals)
+                args = unfuse(fbuf, ibuf, bbuf)
+                dec = cycle_fn(*args).packed_decisions()
+                dig = _device_digest(fbuf, ibuf, bbuf)
+                packed = jnp.concatenate([dec, dig])
+                # changed-rows tail: [digest | count | idx[cap] | vals[cap]]
+                # — fill rows repeat index 0, whose val is row 0's CURRENT
+                # value, so applying every pair is exact regardless of count
+                diff = dec != dprev
+                cnt = jnp.sum(diff, dtype=jnp.int32)
+                # first rb_cap changed rows in order (fill 0), built from
+                # int32 primitives — jnp.nonzero's platform-default index
+                # dtype would leave an x64 intermediate in the graph
+                rows = jnp.arange(dec.shape[0], dtype=jnp.int32)
+                slot = jnp.where(diff,
+                                 jnp.cumsum(diff, dtype=jnp.int32) - 1,
+                                 rb_cap)
+                idx = jnp.zeros(rb_cap, jnp.int32).at[slot].set(
+                    rows, mode="drop")
+                tail = jnp.concatenate([dig, cnt[None], idx, dec[idx]])
+                return fbuf, ibuf, bbuf, dec, packed, tail
+        else:
+            def _update_cycle(fbuf, ibuf, bbuf,
+                              fidx, fvals, iidx, ivals, bidx, bvals):
+                fbuf = fbuf.at[fidx].set(fvals)
+                ibuf = ibuf.at[iidx].set(ivals)
+                bbuf = bbuf.at[bidx].set(bvals)
+                args = unfuse(fbuf, ibuf, bbuf)
+                packed = cycle_fn(*args).packed_decisions()
+                if integrity:
+                    packed = jnp.concatenate(
+                        [packed, _device_digest(fbuf, ibuf, bbuf)])
+                return fbuf, ibuf, bbuf, packed
 
         from ..telemetry import counted_jit
         self._fn = counted_jit(_update_cycle, entry,
@@ -391,6 +460,8 @@ class DeltaKernel:
         """Concrete example inputs for tracing the entry: full-size zero
         buffers plus ``bucket``-sized no-op deltas per non-empty group."""
         args = [np.zeros(n, _TARGETS[g]) for g, n in zip(_GROUPS, self.sizes)]
+        if self.rb_cap:
+            args.append(np.zeros(self.dec_len, np.int32))
         for g, n in zip(_GROUPS, self.sizes):
             b = bucket if n else 0
             args.append(np.zeros(b, np.int32))
@@ -446,6 +517,11 @@ class DeltaKernel:
                 self._invalidate(state.device)
                 state.device = None
             state.mirror = None  # force_full below; never diff vs a suspect
+            # the drained-decisions mirror is suspect for the same reason:
+            # the next drain must read the full packed decisions, and the
+            # recovery re-run below is an out-of-band chain dispatch
+            state.dec_mirror = None
+            state.dec_epoch = getattr(state, "dec_epoch", 0) + 1
             packed = self.run(state, tree, force_full=True)
             state.last_kind = "recovery"
             return packed
@@ -456,12 +532,18 @@ class DeltaKernel:
         the next run pays one clean full upload instead of trusting a
         half-applied scatter."""
         for handles in (state.retiring,
-                        state.device if state.device is not None else ()):
+                        state.device if state.device is not None else (),
+                        (state.dec_device,)
+                        if state.dec_device is not None else ()):
             self._invalidate(handles)
         state.retiring = ()
         state.device = None
         state.mirror = None
         state.scratch = None
+        state.dec_device = None
+        state.dec_mirror = None
+        state.last_tail = None
+        state.dec_epoch = getattr(state, "dec_epoch", 0) + 1
 
     # ------------------------------------------------------------- running
     def _invalidate(self, handles) -> None:
@@ -480,11 +562,35 @@ class DeltaKernel:
             except Exception:  # already deleted by the runtime
                 pass
 
-    def run(self, state: ResidentState, tree, force_full: bool = False):
+    def host_tree(self, bufs):
+        """Rebuild the dispatched argument tree from HOST group buffers
+        (a pending cycle's ``mirror`` capture). The static-slice unfuse is
+        numpy-compatible, so this yields real host-side (snap, extras)
+        objects — the recovery source for a speculative cycle whose
+        original tree has since been refreshed in place."""
+        return make_unfuse(self.treedef, self.spec)(*bufs)
+
+    def split_tail(self, tail: np.ndarray):
+        """Split a host-read changed-rows tail into
+        (u32 device digest, changed count, row indices, row values)."""
+        dig = np.ascontiguousarray(
+            tail[:DIGEST_WORDS]).view(np.uint32)
+        cnt = int(tail[DIGEST_WORDS])
+        idx = tail[DIGEST_WORDS + 1:DIGEST_WORDS + 1 + self.rb_cap]
+        vals = tail[DIGEST_WORDS + 1 + self.rb_cap:]
+        return dig, cnt, idx, vals
+
+    def run(self, state: ResidentState, tree, force_full: bool = False,
+            keep_scratch: bool = False):
         """One cycle: pack ``tree``, ship full buffers or deltas, scatter +
         compute on device. Returns the packed-decisions DEVICE array (the
         caller owns the readback, so a pipelined loop can defer it);
-        ``state`` is updated in place with the new residency + counters."""
+        ``state`` is updated in place with the new residency + counters.
+
+        ``keep_scratch`` packs into a FRESH buffer set and leaves the
+        ping-pong scratch alone — a depth-k speculative dispatch keeps the
+        previous cycle's mirror capture alive in its pending slot, so the
+        packer must not recycle it."""
         # fault-injection seam: resident-buffer corruption faults fire
         # here, before this run diffs/dispatches — exactly where a real
         # device-side desync would sit (mirror drift fires at the owner's
@@ -496,8 +602,10 @@ class DeltaKernel:
         self._invalidate(state.retiring)
         state.retiring = ()
         with _spans.span("delta.pack"):
-            bufs = fuse_into(tree, self.spec, self.sizes, out=state.scratch)
-        state.scratch = None
+            bufs = fuse_into(tree, self.spec, self.sizes,
+                             out=None if keep_scratch else state.scratch)
+        if not keep_scratch:
+            state.scratch = None
         full_bytes = int(sum(b.nbytes for b in bufs))
         deltas = None
         if state.mirror is not None and state.device is not None \
@@ -541,7 +649,17 @@ class DeltaKernel:
         state.full_upload_bytes = full_bytes
         try:
             with _spans.span("delta.dispatch", cat="dispatch"):
-                fnew, inew, bnew, packed = self._fn(*dev, *args)
+                if self.rb_cap:
+                    dprev = state.dec_device
+                    if dprev is None:
+                        dprev = jax.device_put(
+                            np.zeros(self.dec_len, np.int32))
+                        state.dec_mirror = None
+                    fnew, inew, bnew, dnew, packed, tail = self._fn(
+                        *dev, dprev, *args)
+                else:
+                    fnew, inew, bnew, packed = self._fn(*dev, *args)
+                    dnew = tail = None
         except Exception:
             self._reset_state(state)
             raise
@@ -549,10 +667,15 @@ class DeltaKernel:
         # donation killed them at dispatch; otherwise they retire at the
         # next dispatch (deleting now would block on the in-flight
         # computation and serialize the pipeline)
-        state.retiring = dev
+        state.retiring = dev + ((dprev,) if self.rb_cap else ())
         state.device = (fnew, inew, bnew)
-        # ping-pong: the old mirror becomes next cycle's scratch
-        state.scratch, state.mirror = state.mirror, bufs
+        state.dec_device = dnew
+        state.last_tail = tail
+        if keep_scratch:
+            state.mirror = bufs
+        else:
+            # ping-pong: the old mirror becomes next cycle's scratch
+            state.scratch, state.mirror = state.mirror, bufs
         return packed
 
 
@@ -678,6 +801,12 @@ class ShardedDeltaKernel:
         #: group PER SHARD for the node residents (compared shard-local —
         #: never an O(N) all-gather) plus the 3 flat rest words
         self.digest_words = (3 * D + DIGEST_WORDS) if integrity else 0
+        #: the changed-rows readback tail is a flat-kernel feature; the
+        #: sharded path always reads the full packed decisions (its drains
+        #: are O(mesh) digest words + decisions either way)
+        self.rb_cap = 0
+        self.dec_len = 0
+        self.n_residents = 6
         self.donate_argnums = donation_for_backend(n_residents=6)
         self._node_sh = NamedSharding(mesh, PartitionSpec(self.axis, None))
         self._rep_sh = NamedSharding(mesh, PartitionSpec())
@@ -927,17 +1056,21 @@ class ShardedDeltaKernel:
             from ..metrics import METRICS
             METRICS.inc("sharded_resharding_copies_total", copies)
 
-    def run(self, state: ResidentState, tree, force_full: bool = False):
+    def run(self, state: ResidentState, tree, force_full: bool = False,
+            keep_scratch: bool = False):
         """One sharded cycle: pack ``tree``, ship full residents (explicit
         device_put per declared sharding) or routed deltas, shard-local
         scatter + cycle on device. Same residency/invalidate/ping-pong
-        contract as :meth:`DeltaKernel.run`."""
+        contract as :meth:`DeltaKernel.run` (``keep_scratch`` likewise
+        packs fresh buffers so a pending slot's mirror capture survives)."""
         seam("delta.run", kernel=self, state=state)
         self._invalidate(state.retiring)
         state.retiring = ()
         with _spans.span("delta.pack"):
-            bufs = self._fuse_sharded(tree, out=state.scratch)
-        state.scratch = None
+            bufs = self._fuse_sharded(
+                tree, out=None if keep_scratch else state.scratch)
+        if not keep_scratch:
+            state.scratch = None
         full_bytes = int(sum(b.nbytes for b in bufs))
         deltas = None
         if state.mirror is not None and state.device is not None \
@@ -995,7 +1128,10 @@ class ShardedDeltaKernel:
         packed = out[-1]
         state.retiring = dev
         state.device = tuple(out[:-1])
-        state.scratch, state.mirror = state.mirror, bufs
+        if keep_scratch:
+            state.mirror = bufs
+        else:
+            state.scratch, state.mirror = state.mirror, bufs
         return packed
 
 

@@ -28,6 +28,7 @@ import time
 def _run_variant(conf_text: str, base, cycles: int, pipeline: bool):
     from ..framework.conf import parse_conf
     from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.driver import step_cycle
     from ..runtime.scheduler import Scheduler
     from ..chaos.probe import _churn, _cycle_digest
     cluster = FakeCluster(base.clone())
@@ -35,8 +36,7 @@ def _run_variant(conf_text: str, base, cycles: int, pipeline: bool):
     digests, wall_ms = [], []
     for c in range(cycles):
         t0 = time.perf_counter()
-        out = sched.run_once(now=1000.0 + c)
-        rec = (sched.drain(now=1000.0 + c) or out) if pipeline else out
+        rec = step_cycle(sched, now=1000.0 + c)
         wall_ms.append((time.perf_counter() - t0) * 1e3)
         digests.append(_cycle_digest(rec))
         _churn(cluster, c)

@@ -1,0 +1,40 @@
+"""Shared lockstep driver step for the scheduler loop.
+
+Every in-repo driver of :class:`runtime.scheduler.Scheduler` — the chaos
+probe, the parallel sha-matrix CLI, the telemetry trace demo, tests —
+used to hand-roll the same idiom::
+
+    out = sched.run_once(now=wall)
+    rec = (sched.drain(now=wall) or out) if pipeline else out
+
+which bakes the pipeline's drain contract into every call site. With the
+depth-k ring that contract lives here instead: :func:`step_cycle` runs
+one cycle and retires WHATEVER the pipeline owes (the whole ring under
+lockstep driving), so a depth change never touches the drivers again.
+
+Overlap-measuring drivers that deliberately leave cycles in flight
+(chaos/spec.py's depth-k legs, bench's pipelined rows) keep calling
+``run_once``/``drain`` directly — lockstep is this helper's one job.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+def step_cycle(sched, now: Optional[float] = None,
+               ingest: Optional[Callable[[], None]] = None):
+    """One lockstep driver step: ``run_once`` then the pipeline's drain.
+
+    ``ingest`` (optional) runs between dispatch and drain — host event
+    ingestion placed exactly where the pipeline overlaps it with the
+    in-flight device cycle. Returns the completed record for THIS cycle:
+    the drained :class:`CompletedCycle` when the loop is pipelined, the
+    live session otherwise (both carry binds/evictions/pipelined/
+    phase_updates, the decision surface drivers digest)."""
+    out = sched.run_once(now=now)
+    if ingest is not None:
+        ingest()
+    if getattr(sched, "pipeline", False):
+        return sched.drain(now=now) or out
+    return out

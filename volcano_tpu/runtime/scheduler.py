@@ -107,6 +107,24 @@ class ResyncQueue:
         return stats
 
 
+class _InFlight:
+    """One pending-ring slot: a dispatched-but-undrained cycle. The ring
+    generalizes the depth-1 ``_pending`` tuple — slot 0 is always the
+    oldest in-flight cycle and the next to drain."""
+
+    __slots__ = ("ssn", "pending", "host_s", "wall", "invalid")
+
+    def __init__(self, ssn, pending, host_s, wall, invalid=False):
+        self.ssn = ssn
+        self.pending = pending
+        self.host_s = host_s
+        self.wall = wall
+        #: a drained predecessor applied decisions (or faulted) after this
+        #: cycle dispatched — its speculative input epoch is stale, so its
+        #: drain replays the cycle synchronously instead of applying it
+        self.invalid = invalid
+
+
 class Scheduler:
     def __init__(self, cluster: FakeCluster,
                  conf: Optional[SchedulerConfiguration] = None,
@@ -137,9 +155,17 @@ class Scheduler:
         # refreshed, so the decision sequence matches the synchronous loop
         self.pipeline = (bool(getattr(self.conf, "pipeline", False))
                          if pipeline is None else bool(pipeline))
-        #: (session, PendingAllocate, host_ms_so_far, wall) of the
-        #: dispatched-but-not-drained cycle; bounded depth 1
-        self._pending = None
+        #: the pending ring: dispatched-but-undrained cycles, oldest
+        #: first; bounded by the effective pipeline depth (conf
+        #: ``pipeline_depth``, default 1 — the legacy one-deep contract)
+        self._ring: List[_InFlight] = []
+        #: monotonic dispatch sequence — per-slot device windows in the
+        #: occupancy trace
+        self._slot_seq = 0
+        #: speculation ladder state: depth clamps to 1 until this cycle
+        #: count after a speculation fault; a repeat inside the hold
+        #: degrades to fully synchronous (level 1)
+        self._spec_disabled_until = 0
         # opt-in persistent XLA compilation cache (conf/env) — restarts
         # stop paying compile_s for already-seen shape buckets
         from ..framework.compile_cache import enable_compilation_cache
@@ -199,6 +225,75 @@ class Scheduler:
         self._conf_mtime = mtime
         with open(self.conf_path) as f:
             return parse_conf(f.read())
+
+    @property
+    def _pending(self):
+        """Depth-1 compatibility view of the pending ring: the oldest
+        in-flight entry as the legacy ``(ssn, pending, host_s, wall)``
+        tuple, or None when nothing is in flight."""
+        if not self._ring:
+            return None
+        e = self._ring[0]
+        return (e.ssn, e.pending, e.host_s, e.wall)
+
+    @_pending.setter
+    def _pending(self, value) -> None:
+        if value is None:
+            self._ring.clear()
+        else:
+            ssn, pending, host_s, wall = value
+            self._ring = [_InFlight(ssn, pending, host_s, wall)]
+
+    def _effective_depth(self) -> int:
+        """How many cycles may be in flight after this run_once's
+        dispatch. Depth > 1 (speculation) requires the full steady-state
+        stack: pipelined mode, a clean ladder, the persistent incremental
+        session (replay reopens it in place), an unsharded kernel, and no
+        active speculation hold."""
+        if not self.pipeline or self.degradation_level:
+            return 1
+        depth = max(1, int(getattr(self.conf, "pipeline_depth", 1) or 1))
+        if depth == 1:
+            return 1
+        if not self.incremental or getattr(self.conf, "sharding", False):
+            return 1
+        if self.cycles < self._spec_disabled_until:
+            return 1
+        return depth
+
+    def _spec_penalty(self) -> None:
+        """Speculation ladder: the first failure clamps the depth to 1
+        for the cooldown window; a repeat inside the hold drops to the
+        synchronous rung of the main ladder."""
+        if self.cycles < self._spec_disabled_until:
+            self._degrade(1)
+        else:
+            spans.log_event("speculation", action="disabled",
+                            cycle=self.cycles,
+                            until=self.cycles + self.fault_cooldown)
+        self._spec_disabled_until = self.cycles + self.fault_cooldown
+
+    def _invalidate_ring(self) -> None:
+        """A drained cycle applied decisions (or faulted): every still-
+        in-flight speculative cycle consumed a snapshot that predates
+        them — mark for decision-neutral replay at drain."""
+        for e in self._ring:
+            e.invalid = True
+
+    def _resolve_ring(self) -> None:
+        """Join every outstanding pack-thread future. Must run before
+        anything refreshes the session snapshot in place (reopen/replay)
+        — the worker reads the packed arrays it was handed at dispatch.
+        A worker failure invalidates its entry (replayed at drain) and
+        walks the speculation ladder."""
+        for e in self._ring:
+            if e.pending.future is not None:
+                try:
+                    e.ssn.resolve_pending(e.pending)
+                except Exception as ex:
+                    self._note_fault("pack_thread", ex)
+                    self._spec_penalty()
+                    e.invalid = True
 
     def _persistent_plugins(self) -> Dict[str, object]:
         """Plugins with cross-cycle state: the reservation singleton and
@@ -296,16 +391,18 @@ class Scheduler:
             if leader != self._was_leader:
                 self._note_leadership(leader)
             if not leader:
-                # follower: no dispatch, and a cycle left in flight from
-                # our leader tenure is DISCARDED unapplied — its writes
+                # follower: no dispatch, and cycles left in flight from
+                # our leader tenure are DISCARDED unapplied — their writes
                 # would be fenced off anyway; the new leader re-decides
                 # from the same external truth
-                if self._pending is not None:
-                    self._pending = None
-                    METRICS.inc("cycle_dropped_total")
+                if self._ring:
+                    dropped = len(self._ring)
+                    self._resolve_ring()  # join workers before discarding
+                    self._ring.clear()
+                    METRICS.inc("cycle_dropped_total", dropped)
                     spans.log_event("leadership", action="pending_dropped",
                                     identity=self.elector.identity,
-                                    cycle=self.cycles)
+                                    count=dropped, cycle=self.cycles)
                 return None
         # degradation de-escalation probe: after the cooldown window of
         # clean cycles, climb back to the configured mode
@@ -314,7 +411,29 @@ class Scheduler:
                             level_to=0, cycle=self.cycles)
             self.degradation_level = 0
             METRICS.set_gauge("degradation_level", None, 0)
-        completed = self._drain_pending(wall)
+        actions = list(self.conf.actions)
+
+        def _will_pipeline() -> bool:
+            # the pipeline defers the allocate readback across run_once
+            # boundaries, so it requires allocate to be the cycle's LAST
+            # action (anything after it would need the decisions applied);
+            # other action lists fall back to the synchronous path, as
+            # does a degraded scheduler until the cooldown expires
+            return (self.pipeline and self.degradation_level == 0
+                    and bool(actions) and actions[-1] == "allocate")
+
+        # drain until the ring has room for this cycle's dispatch (depth-1
+        # keeps today's drain-exactly-one; sync cycles drain everything).
+        # Drains can walk the ladder (integrity trips), which shrinks the
+        # effective depth — hence the recomputation inside the loop.
+        completed = None
+        while self._ring and len(self._ring) > (
+                self._effective_depth() - 1 if _will_pipeline() else 0):
+            completed = self._drain_pending(wall) or completed
+        pipelined = _will_pipeline()
+        # join any still-outstanding pack thread BEFORE the snapshot
+        # refresh below mutates the arrays it is reading
+        self._resolve_ring()
         # drain due resync retries BEFORE snapshotting so the cycle sees
         # their outcomes (the errTasks worker runs alongside the loop,
         # cache.go:687-709)
@@ -329,15 +448,6 @@ class Scheduler:
         with spans.span("cycle.open"):
             ssn = self._open_session(now)
         from ..actions import get_action
-        actions = list(self.conf.actions)
-        # the pipeline defers the allocate readback across the run_once
-        # boundary, so it requires allocate to be the cycle's LAST action
-        # (anything after it would need the decisions applied); other
-        # action lists fall back to the synchronous path. A degraded
-        # scheduler (recent fault) also runs synchronously until the
-        # cooldown expires.
-        pipelined = (self.pipeline and self.degradation_level == 0
-                     and actions and actions[-1] == "allocate")
         for name in (actions[:-1] if pipelined else actions):
             ta = time.time()
             with spans.span(f"action.{name}"):
@@ -352,15 +462,29 @@ class Scheduler:
                     self._allocate_degraded(ssn)
             METRICS.observe_action(name, time.time() - ta)
         if pipelined:
+            depth = self._effective_depth()
             ta = time.time()
+            # predecessors still in flight make this dispatch speculative:
+            # it consumes the freshest refreshed snapshot but NOT the
+            # undrained predecessors' decisions, and it must keep its own
+            # scratch (their mirror captures are still referenced)
+            spec = bool(self._ring)
             try:
-                pending = ssn.dispatch_allocate()
+                pending = ssn.dispatch_allocate(speculative=spec,
+                                                async_pack=True)
             except Exception as e:
-                # dispatch failed before anything was in flight: recover
-                # synchronously (retry -> oracle) and retire the cycle now
+                # dispatch failed on the calling thread (nothing went out
+                # for this cycle): retire any in-flight work first — the
+                # sync fallback below re-dispatches, and the decisions
+                # chain must stay in device order — then walk the ladder
                 self._note_fault("dispatch", e)
+                if self._ring:
+                    self.drain(now=wall)
                 self._allocate_degraded(ssn)
                 return self._finish_cycle(ssn, time.time() - t0, wall)
+            pending.slot = self._slot_seq
+            self._slot_seq += 1
+            pending.depth = depth
             took = time.time() - ta
             METRICS.observe_action("allocate_dispatch", took)
             if self.cycle_deadline_s is not None \
@@ -373,10 +497,12 @@ class Scheduler:
                     f"dispatch took {took * 1000:.0f} ms "
                     f"(deadline {self.cycle_deadline_s * 1000:.0f} ms)"))
                 self._degrade(1)
-                self._pending = (ssn, pending, time.time() - t0, wall)
-                completed_now = self._drain_pending(wall)
+                self._ring.append(
+                    _InFlight(ssn, pending, time.time() - t0, wall))
+                completed_now = self.drain(now=wall)
                 return completed if completed is not None else completed_now
-            self._pending = (ssn, pending, time.time() - t0, wall)
+            self._ring.append(
+                _InFlight(ssn, pending, time.time() - t0, wall))
             return completed if completed is not None else ssn
         return self._finish_cycle(ssn, time.time() - t0, wall)
 
@@ -453,26 +579,56 @@ class Scheduler:
                         recovery_ms=round((time.time() - t0) * 1000, 3))
 
     def _drain_pending(self, wall: float):
-        """Drain the one-deep pipeline: read the in-flight cycle's packed
-        decisions back, apply them, and flush its intents. Returns a
+        """Drain the OLDEST in-flight cycle: read its packed decisions
+        back (or replay it synchronously if a predecessor invalidated its
+        input epoch), apply them, and flush its intents. Returns a
         detached record of the completed cycle (the live Session object is
         re-opened for the next cycle right after, which resets its intent
         lists) or None when nothing was in flight."""
-        if self._pending is None:
+        if not self._ring:
             return None
         import numpy as np
-        ssn, pending, host_s, _wall0 = self._pending
-        self._pending = None
+        entry = self._ring.pop(0)
+        ssn, pending, host_s = entry.ssn, entry.pending, entry.host_s
+        if getattr(ssn, "_cycle_state_dirty", False):
+            # a second drain of the same session without an intervening
+            # reopen (drain-all, depth shrink): clear the previous drain's
+            # intents so this cycle's record is its own
+            ssn._reset_cycle_state()
+        ssn._cycle_state_dirty = True
         t0 = time.time()
+        replayed = False
         try:
             with spans.span("cycle.drain"):
-                result = ssn.complete_allocate(pending)
+                if entry.invalid:
+                    # the dispatched work is discarded, but the worker must
+                    # be joined first — the replay below redispatches on
+                    # the same kernel state
+                    try:
+                        ssn.resolve_pending(pending)
+                    except Exception:
+                        pass
+                    result = self._replay_entry(entry, wall)
+                    replayed = True
+                else:
+                    try:
+                        ssn.resolve_pending(pending)
+                    except Exception as e:
+                        # the pack thread failed: nothing reached the
+                        # device for this cycle — replay it synchronously
+                        self._note_fault("pack_thread", e)
+                        self._spec_penalty()
+                        result = self._replay_entry(entry, wall)
+                        replayed = True
+                    else:
+                        result = ssn.complete_allocate(pending)
         except Exception as e:
             # complete_allocate already walked re-fuse -> cpu-oracle; if it
             # STILL raised the cycle is unrecoverable. Keep serving: retire
             # it with no decisions applied instead of crashing the loop.
             self._note_fault("drain", e)
             self._degrade(2)
+            self._invalidate_ring()
             METRICS.inc("cycle_dropped_total")
             ssn.stats["cycle_dropped"] = 1.0
             self._finish_cycle(ssn, host_s + (time.time() - t0), wall)
@@ -485,10 +641,29 @@ class Scheduler:
             self._note_fault("integrity:" + str(integ.get("reason")),
                              RuntimeError(str(integ.get("mode"))))
             self._degrade(2 if integ.get("mode") == "cpu_oracle" else 1)
+        if self.cycle_deadline_s is not None \
+                and pending.dispatch_ms / 1000.0 > self.cycle_deadline_s \
+                and not replayed:
+            # the pack thread's own dispatch blew the deadline (the
+            # main-thread watchdog in run_once no longer sees worker time)
+            self._note_fault("deadline", TimeoutError(
+                f"dispatch took {pending.dispatch_ms:.0f} ms "
+                f"(deadline {self.cycle_deadline_s * 1000:.0f} ms)"))
+            self._degrade(1)
         if self.cycle_deadline_s is not None and took > self.cycle_deadline_s:
             self._note_fault("deadline_drain", TimeoutError(
                 f"drain took {took * 1000:.0f} ms"))
             self._degrade(1)
+        if replayed:
+            ssn.stats["cycle_replayed"] = 1.0
+        # epoch invalidation for the still-in-flight speculative cycles:
+        # only EFFECTIVE outputs count — binds, evictions, bind errors, or
+        # a phase transition that actually changed cluster truth. Pure
+        # structural churn never invalidates (a speculative dispatch
+        # already consumed every dirty mark at its own reopen).
+        if (ssn.binds or ssn.evictions or ssn.bind_errors
+                or ssn.phase_changes):
+            self._invalidate_ring()
         # the AllocateAction readouts the synchronous path records
         ssn.stats["allocated_binds"] = len(ssn.binds)
         ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
@@ -496,6 +671,55 @@ class Scheduler:
             np.asarray(result.job_pipelined).sum())
         self._finish_cycle(ssn, host_s + took, wall)
         return CompletedCycle(ssn)
+
+    def _replay_entry(self, entry: _InFlight, wall: float):
+        """Decision-neutral replay of an invalidated speculative cycle:
+        re-decide synchronously at the cycle's drain slot. The replay
+        merges any cluster churn, reopens the session, re-runs the cycle's
+        actions, and dispatches + completes in one breath — bit-identical
+        to the synchronous loop whenever the cluster stayed quiet during
+        the flight (the speculation probe's construction); otherwise it
+        sees strictly fresher truth than the discarded speculation did."""
+        ssn, pending = entry.ssn, entry.pending
+        METRICS.inc("cycle_replays_total")
+        spans.log_event("replay", cycle=self.cycles, slot=pending.slot,
+                        speculative=bool(pending.speculative))
+        state = pending.state
+        if state is not None:
+            # the discarded dispatch already advanced the device decisions
+            # chain, and the replay advances it again: new lineage — every
+            # older in-flight tail drains full, and the replay's own full
+            # readback reseeds the mirror for the dispatches that follow
+            state.dec_epoch = getattr(state, "dec_epoch", 0) + 1
+            state.dec_mirror = None
+        # join outstanding workers before the reopen mutates the snapshot
+        # arrays they read
+        self._resolve_ring()
+        dj, dn, _structural = self.cluster.drain_dirty()
+        for uid in dj:
+            ssn._dirty_jobs.add(uid)
+        for name in dn:
+            ssn._dirty_nodes.add(name)
+        self._last_dirty = (len(dj), len(dn))
+        overrides = self._persistent_plugins()
+        if ssn.reopen(now=entry.wall, conf=self.conf,
+                      plugin_overrides=overrides):
+            self.incremental_cycles += 1
+        else:
+            self.full_packs += 1
+        from ..actions import get_action
+        for name in list(self.conf.actions)[:-1]:
+            with spans.span(f"action.{name}"):
+                get_action(name).execute(ssn)
+        try:
+            rp = ssn.dispatch_allocate(speculative=bool(self._ring))
+            rp.slot = pending.slot
+            rp.depth = pending.depth
+            return ssn.complete_allocate(rp)
+        except Exception as e:
+            self._note_fault("replay", e)
+            self._allocate_degraded(ssn)
+            return ssn.last_allocate
 
     def _finish_cycle(self, ssn: Session, host_s: float,
                       wall: float) -> Session:
@@ -591,25 +815,32 @@ class Scheduler:
         return ssn
 
     def drain(self, now: Optional[float] = None):
-        """Retire the in-flight pipelined cycle, if any: readback, apply,
-        flush. Returns the completed cycle's record or None."""
-        return self._drain_pending(now if now is not None else time.time())
+        """Retire EVERY in-flight pipelined cycle, oldest first: readback
+        (or replay), apply, flush. Returns the newest completed cycle's
+        record, or None when nothing was in flight. Safe to call twice —
+        the second call is a no-op returning None."""
+        wall = now if now is not None else time.time()
+        out = None
+        while self._ring:
+            out = self._drain_pending(wall) or out
+        return out
 
     # ----------------------------------------- crash-consistent restarts
     def checkpoint(self, path: str, now: Optional[float] = None) -> dict:
         """Serialize the scheduler's host-side truth to ``path``
         (atomic tmp+fsync+rename; see runtime/checkpoint.py).
 
-        The in-flight pipelined cycle is DRAINED first — its decisions
-        apply to the cluster before the snapshot is cut, so a restore can
-        never replay a half-applied bind (the depth-1 contract makes the
-        early drain decision-neutral). Cluster state itself is not
-        checkpointed: the cluster source is external authoritative truth
-        that survives the process, exactly like the reference's API
-        server."""
+        The in-flight pipelined ring is DRAINED first, oldest to newest —
+        every in-flight cycle's decisions apply to the cluster before the
+        snapshot is cut, so a restore can never replay a half-applied
+        bind (the depth-1 contract, generalized: the k-slot drain is
+        decision-neutral because invalidated slots replay synchronously).
+        Cluster state itself is not checkpointed: the cluster source is
+        external authoritative truth that survives the process, exactly
+        like the reference's API server."""
         from . import checkpoint as ckpt
         wall = now if now is not None else time.time()
-        self._drain_pending(wall)
+        self.drain(now=wall)
         state, mirrors = self.checkpoint_state()
         return ckpt.write_checkpoint(path, "scheduler", state,
                                      mirrors=mirrors)
@@ -682,7 +913,7 @@ class Scheduler:
             # checkpointed mirrors make that re-fuse warm (delta, not
             # full upload) once the session's kernels come back up
             self._session = None
-            self._pending = None
+            self._ring.clear()
             self._restored_mirrors = ckpt.verify_mirrors(
                 env.get("mirrors"))
             # intents stranded by the crash get a second life
@@ -692,16 +923,20 @@ class Scheduler:
         return "restored"
 
     def wait_pending(self) -> bool:
-        """Block until the in-flight cycle's DEVICE work has finished,
-        without draining it (no readback, no apply — state unchanged).
-        In production the 1 s schedule period provides this wait for
-        free; bench and shutdown paths call it explicitly. Returns True
-        when something was in flight."""
-        if self._pending is None:
+        """Block until every in-flight cycle's DEVICE work has finished,
+        without draining (no readback, no apply — state unchanged). Joins
+        the pack thread first: device work it hadn't submitted yet cannot
+        be waited on otherwise. In production the 1 s schedule period
+        provides this wait for free; bench and shutdown paths call it
+        explicitly. Returns True when something was in flight."""
+        if not self._ring:
             return False
         import jax
+        self._resolve_ring()
         with spans.span("cycle.wait_device", cat="wait"):
-            jax.block_until_ready(self._pending[1].packed)
+            for e in self._ring:
+                if e.pending.packed is not None:
+                    jax.block_until_ready(e.pending.packed)
         return True
 
     def run(self, cycles: int = 1, sleep: bool = False) -> List[Session]:

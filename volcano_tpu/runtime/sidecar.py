@@ -123,15 +123,21 @@ def tenant_wire_id(name: str) -> int:
 
 
 class _TenantStream:
-    """One tenant's serving stream: the one-deep pipeline slot, the VCRQ
-    replay cache, and the bounded known-epoch LRU. The sidecar keys these
-    by the VCRT tenant word (0 = the legacy un-prefixed stream)."""
+    """One tenant's serving stream: the depth-k pipeline ring (oldest
+    first), the VCRQ replay cache, and the bounded known-epoch LRU. The
+    sidecar keys these by the VCRT tenant word (0 = the legacy
+    un-prefixed stream)."""
 
-    __slots__ = ("pending", "staged_payload", "round_cache", "known_epochs")
+    __slots__ = ("ring", "staged", "round_cache", "known_epochs")
 
     def __init__(self):
-        self.pending: Optional[dict] = None
-        self.staged_payload: Optional[bytes] = None
+        #: dispatched-but-unread cycles, oldest first; conf
+        #: ``pipeline_depth`` bounds its length (1 = the one-deep slot)
+        self.ring: list = []
+        #: payloads of cycles retired EARLY (checkpoint, sibling-tenant
+        #: dispatch), oldest first — always older than any ring entry,
+        #: and handed out before the ring drains
+        self.staged: list = []
         #: (epoch, seq, (status, payload)) of the last served VCRQ round
         self.round_cache: Optional[tuple] = None
         #: epoch -> True, LRU order (ISSUE 12 satellite: the unbounded
@@ -139,6 +145,24 @@ class _TenantStream:
         #: client whose idle epoch aged out simply re-primes, the same
         #: ERR_EPOCH_RESTORED path a restart takes)
         self.known_epochs: "OrderedDict[int, bool]" = OrderedDict()
+
+    # depth-1 era compat: tests and the server's introspection keep the
+    # single-slot names; "the pending cycle" is the ring's oldest entry
+    @property
+    def pending(self) -> Optional[dict]:
+        return self.ring[0] if self.ring else None
+
+    @pending.setter
+    def pending(self, value: Optional[dict]) -> None:
+        self.ring = [] if value is None else [value]
+
+    @property
+    def staged_payload(self) -> Optional[bytes]:
+        return self.staged[0] if self.staged else None
+
+    @staged_payload.setter
+    def staged_payload(self, value: Optional[bytes]) -> None:
+        self.staged = [] if value is None else [value]
 
 
 class SidecarError(RuntimeError):
@@ -238,12 +262,26 @@ class SchedulerSidecar:
         # resident delta path, so delta_uploads off disables it too.
         self.sharding = os.environ.get("VOLCANO_SIDECAR_SHARDING") == "1"
         self._sharding_devices = None
+        #: depth-k pipelined serving (conf ``pipeline_depth``, or
+        #: $VOLCANO_SIDECAR_DEPTH in bare-cfg mode): up to k VCRP rounds
+        #: in flight per tenant stream before a round's response carries
+        #: a drained predecessor. Served rounds are never speculative —
+        #: every dispatch consumes the client's own snapshot — so depth
+        #: only moves WHEN readbacks happen, never what they contain;
+        #: entries behind the head carry their dispatch-time mirror
+        #: digest so the integrity check verifies each cycle against the
+        #: mirror it actually ran against.
+        self._pipeline_depth = max(1, int(os.environ.get(
+            "VOLCANO_SIDECAR_DEPTH", "1")))
         if conf is not None:
             from ..framework.conf import parse_conf as _pcs
             _sc = _pcs(conf)
             self.sharding = self.sharding or bool(
                 getattr(_sc, "sharding", False))
             self._sharding_devices = getattr(_sc, "sharding_devices", None)
+            self._pipeline_depth = max(self._pipeline_depth,
+                                       int(getattr(_sc, "pipeline_depth",
+                                                   1) or 1))
         self.sharding = self.sharding and self.delta_uploads
         self._cycle_sharded = None
         if self.sharding:
@@ -273,18 +311,21 @@ class SchedulerSidecar:
         self._serve_lock = threading.Lock()
         #: per-tenant serving streams (ISSUE 12), keyed by the VCRT wire
         #: word; tenant 0 is the legacy un-prefixed stream. Each stream
-        #: carries the one-deep pipelined slot (the dispatched-but-unread
-        #: cycle whose decisions the NEXT round's response carries), the
+        #: carries the pipelined ring (the dispatched-but-unread cycles,
+        #: up to conf ``pipeline_depth`` of them, whose decisions later
+        #: rounds' responses carry in dispatch order), the
         #: VCRQ replay cache — (epoch, seq, (status, payload)) so a
         #: reconnected client resending the same seq gets the cached
         #: response instead of a double-dispatch — a bounded known-epoch
         #: LRU, and the staged payload slot (set when a checkpoint or a
         #: sibling tenant's dispatch retires the in-flight cycle early —
-        #: early readback is decision-neutral; the payload must still
-        #: reach the client). At most ONE dispatched-unread cycle exists
-        #: across ALL streams: any dispatch first retires every other
-        #: stream's pending into its staged slot, preserving the resident
-        #: digest invariant the single-slot protocol had.
+        #: early readback is decision-neutral; the payloads must still
+        #: reach the client, oldest first). Only ONE TENANT holds
+        #: dispatched-unread cycles at a time: any dispatch first retires
+        #: every other stream's ring into its staged queue, and ring
+        #: entries behind the head freeze their dispatch-time mirror
+        #: digest, preserving the resident digest invariant the
+        #: single-slot protocol had.
         self._streams: Dict[int, _TenantStream] = {0: _TenantStream()}
         #: per-tenant known-epoch LRU bound (satellite: the epoch set no
         #: longer grows without bound under client churn)
@@ -471,11 +512,18 @@ class SchedulerSidecar:
             return fn(*fuse(tree_in)), None, None, None, None
 
     def _verify_integrity(self, packed: np.ndarray, kernel, state, tree_in,
-                          kind, upload):
+                          kind, upload, frozen_digest=None):
         """Strip + check the in-graph integrity digest against the host
         mirror; on mismatch recover in place (full re-fuse from the round's
         tree + recompute — decision-neutral). Caller holds _serve_lock.
-        Returns (decisions, kind, upload)."""
+        Returns (decisions, kind, upload).
+
+        ``frozen_digest`` is the depth-k ring's mirror-identity rule: an
+        entry that was dispatched behind other in-flight cycles verifies
+        against the digest of the mirror AS OF ITS DISPATCH (later
+        dispatches advanced the live mirror past it); the head-of-line /
+        synchronous case passes None and keeps the live-mirror check,
+        which is what lets the chaos mirror-drift fault trip at drain."""
         if kernel is None or not kernel.digest_words:
             return packed, kind, upload
         from ..chaos.inject import seam
@@ -483,7 +531,8 @@ class SchedulerSidecar:
         seam("sidecar.complete", state=state)
         with _spans.span("sidecar.digest"):
             dec, dev_digest = kernel.split_digest(packed)
-            host_digest = kernel.mirror_digest(state)
+            host_digest = (frozen_digest if frozen_digest is not None
+                           else kernel.mirror_digest(state))
         if host_digest is None or np.array_equal(dev_digest, host_digest):
             return dec, kind, upload
         METRICS.inc("resident_digest_mismatch_total")
@@ -575,10 +624,11 @@ class SchedulerSidecar:
         with _spans.span("sidecar.build"):
             tree_in, snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
-            # the tenant's own VCRP round must not be orphaned; sibling
+            # the tenant's own VCRP rounds must not be orphaned; sibling
             # tenants' in-flight cycles are retired into their staged
             # slots so their streams still receive them
-            self._drain_locked(self._stream(tenant))
+            while self._drain_locked(self._stream(tenant)) is not None:
+                pass
             self._retire_others_locked(tenant)
             packed, cycle_kind, upload_bytes = self._run_cycle(tree_in)
         cycle_ms = round((_time.time() - t_start) * 1000, 3)
@@ -602,29 +652,21 @@ class SchedulerSidecar:
 
         return payload, finish
 
-    # ------------------------------------------- one-deep pipelined serving
-    def _drain_locked(self, st: Optional[_TenantStream] = None) \
-            -> Optional[bytes]:
-        """Read back and payload the stream's pending VCRP cycle (caller
-        holds _serve_lock). Returns None when nothing is pending."""
-        if st is None:
-            st = self._streams[0]
-        pending = st.pending
-        if pending is None:
-            # a checkpoint, restore, or sibling tenant's dispatch may have
-            # staged the retired cycle's payload here — hand it to the
-            # stream exactly where the live pending cycle's drain would
-            payload, st.staged_payload = st.staged_payload, None
-            return payload
-        st.pending = None
+    # ------------------------------------------- depth-k pipelined serving
+    def _drain_entry_locked(self, st: _TenantStream) -> bytes:
+        """Read back, verify, and payload the stream's OLDEST in-flight
+        ring entry (caller holds _serve_lock, ring non-empty)."""
+        pending = st.ring.pop(0)
         import time as _time
         with _spans.span("sidecar.drain", cat="wait"):
             packed = np.asarray(pending["packed"], dtype=np.int32)
         if pending.get("dispatched_at"):
-            _spans.device_window(pending["dispatched_at"], _spans.now())
+            _spans.device_window(pending["dispatched_at"], _spans.now(),
+                                 depth=pending.get("depth", 1))
         packed, kind, upload = self._verify_integrity(
             packed, pending["kernel"], pending["state"], pending["tree"],
-            pending["kind"], pending["upload"])
+            pending["kind"], pending["upload"],
+            frozen_digest=pending.get("host_digest"))
         payload = self._decisions_payload(packed, pending["T"],
                                           pending["J"])
         self.flight.record(
@@ -636,30 +678,49 @@ class SchedulerSidecar:
             spans=_spans.drain_cycle_summary())
         return payload
 
+    def _drain_locked(self, st: Optional[_TenantStream] = None) \
+            -> Optional[bytes]:
+        """Hand out the stream's oldest outstanding payload (caller holds
+        _serve_lock): a staged payload first — a checkpoint, restore, or
+        sibling tenant's dispatch retired those cycles early, so they
+        predate everything in the ring — else the oldest ring entry's
+        drain. Returns None when nothing is outstanding."""
+        if st is None:
+            st = self._streams[0]
+        if st.staged:
+            return st.staged.pop(0)
+        if st.ring:
+            return self._drain_entry_locked(st)
+        return None
+
     def _retire_others_locked(self, tenant: int) -> None:
-        """Early-readback every OTHER tenant's in-flight cycle before a
-        dispatch, staging each payload for its own stream's next round
+        """Early-readback every OTHER tenant's in-flight cycles before a
+        dispatch, staging each payload for its own stream's next rounds
         (caller holds _serve_lock). Decision-neutral — a pending cycle's
-        decisions were fixed at dispatch — and it preserves the resident
-        digest invariant: at most one dispatched-unread cycle exists, so
-        a drain never compares a stale device digest against a mirror a
-        sibling tenant's dispatch has since advanced."""
+        decisions were fixed at dispatch. Ring entries carry their
+        dispatch-time mirror digest, but cross-tenant retirement also
+        keeps the single-dispatched-unread invariant the head-of-line
+        (live-digest) entries rely on."""
         for tid, st in self._streams.items():
-            if tid != tenant and st.pending is not None:
-                st.staged_payload = self._drain_locked(st)
+            if tid != tenant:
+                while st.ring:
+                    st.staged.append(self._drain_entry_locked(st))
 
     def schedule_buffer_pipelined(self, buf: bytes,
                                   extras_buf: bytes = b"",
                                   tenant: int = 0) -> bytes:
-        """One-deep pipelined round (VCRP): dispatch THIS snapshot's cycle
-        and return the PREVIOUS dispatched snapshot's decisions — the
-        sidecar half of the cycle pipeline. The first round primes the
-        pipeline and returns an empty VCD1 payload (T=0, J=0); call
-        :meth:`drain_pending` (VCRD) to retire the final in-flight cycle.
-        The caller therefore runs exactly one cycle behind, which is the
-        same contract as the pipelined scheduler loop: a round's decisions
-        are always handed back (and applied by the API layer) before the
-        resident buffers can be overwritten by the round after it."""
+        """Pipelined round (VCRP): dispatch THIS snapshot's cycle and
+        return the decisions of the oldest outstanding round — the
+        sidecar half of the cycle pipeline. With the default depth 1 that
+        is the PREVIOUS dispatched snapshot's decisions; with conf
+        ``pipeline_depth: k`` up to k rounds ride in flight, so the first
+        k rounds prime the pipeline and return empty VCD1 payloads (T=0,
+        J=0) and the caller runs k cycles behind. Call
+        :meth:`drain_pending` (VCRD) repeatedly to retire the final
+        in-flight cycles. Unlike the scheduler loop's depth-k ring these
+        rounds are never speculative — each dispatch consumes the
+        client's own snapshot — so depth changes only when decisions come
+        back, never what they are."""
         import time as _time
         from ..chaos.inject import seam
         self._rounds_served += 1
@@ -668,15 +729,26 @@ class SchedulerSidecar:
             tree_in, _snap, T, J = self._build_tree(buf, extras_buf)
         with self._serve_lock:
             st = self._stream(tenant)
-            prev_payload = self._drain_locked(st)
+            prev_payload = None
+            if len(st.ring) + len(st.staged) >= self._pipeline_depth:
+                prev_payload = self._drain_locked(st)
             self._retire_others_locked(tenant)
             packed, kind, upload, kernel, state = \
                 self._dispatch_cycle(tree_in)
-            st.pending = dict(packed=packed, T=T, J=J, kind=kind,
-                              upload=upload, t0=_time.time(),
-                              buffer_bytes=len(buf) + len(extras_buf),
-                              kernel=kernel, state=state, tree=tree_in,
-                              dispatched_at=_spans.now())
+            # mirror-identity rule: an entry that will sit behind other
+            # in-flight cycles freezes the digest of the mirror it ran
+            # against; the depth-1 slot keeps None -> live-mirror check
+            hdig = None
+            if self._pipeline_depth > 1 and kernel is not None \
+                    and getattr(kernel, "digest_words", 0):
+                hdig = kernel.mirror_digest(state)
+            st.ring.append(dict(packed=packed, T=T, J=J, kind=kind,
+                                upload=upload, t0=_time.time(),
+                                buffer_bytes=len(buf) + len(extras_buf),
+                                kernel=kernel, state=state, tree=tree_in,
+                                dispatched_at=_spans.now(),
+                                host_digest=hdig,
+                                depth=self._pipeline_depth))
         if prev_payload is None:
             # priming round: an explicit empty decision payload
             prev_payload = self._decisions_payload(
@@ -711,8 +783,9 @@ class SchedulerSidecar:
                 METRICS.inc("sidecar_replayed_rounds_total")
                 return cached[2]
             if cached is not None and cached[0] != epoch:
-                # retire the stale stream's cycle (drain-on-reconnect)
-                self.drain_pending(tenant)
+                # retire the stale stream's cycles (drain-on-reconnect)
+                while self.drain_pending(tenant) is not None:
+                    pass
             if seq > 1 and epoch not in st.known_epochs:
                 # mid-stream round from a stream this process never
                 # served: we restarted without checkpoint state under the
@@ -757,23 +830,30 @@ class SchedulerSidecar:
         with self._seq_lock:
             with self._serve_lock:
                 for st in self._streams.values():
-                    st.staged_payload = self._drain_locked(st)
+                    # retire the whole ring, oldest first, behind any
+                    # payloads staged earlier (they predate the ring)
+                    while st.ring:
+                        st.staged.append(self._drain_entry_locked(st))
                 mirrors = ckpt.mirror_records(self._delta, self._states)
             st0 = self._streams[0]
             # tenant 0 keeps the legacy top-level keys, so pre-fleet
             # checkpoints restore unchanged and pre-fleet readers of a
-            # fleet checkpoint still see the un-prefixed stream
+            # fleet checkpoint still see the un-prefixed stream (its
+            # oldest staged payload; staged_payloads carries the rest of
+            # a depth-k ring)
             state = dict(
                 conf_fingerprint=self._ckpt_fingerprint,
                 round_cache=st0.round_cache,
                 rounds_served=self._rounds_served,
                 known_epochs=sorted(st0.known_epochs),
                 pending_payload=st0.staged_payload,
+                staged_payloads=list(st0.staged),
                 fence_generation=self._fence_generation,
                 tenant_streams={
                     tid: dict(round_cache=st.round_cache,
                               known_epochs=sorted(st.known_epochs),
-                              pending_payload=st.staged_payload)
+                              pending_payload=st.staged_payload,
+                              staged_payloads=list(st.staged))
                     for tid, st in self._streams.items() if tid != 0},
                 metrics=ckpt.metrics_snapshot(),
             )
@@ -807,9 +887,19 @@ class SchedulerSidecar:
             with self._seq_lock:
                 with self._serve_lock:
                     self._streams = {0: _TenantStream()}
+
+                    def _staged(rec):
+                        # depth-k checkpoints list every retired payload;
+                        # pre-depth ones carry at most the single slot
+                        sp = rec.get("staged_payloads")
+                        if sp is not None:
+                            return list(sp)
+                        pp = rec.get("pending_payload")
+                        return [pp] if pp is not None else []
+
                     st0 = self._streams[0]
                     st0.round_cache = state["round_cache"]
-                    st0.staged_payload = state["pending_payload"]
+                    st0.staged = _staged(state)
                     for e in state["known_epochs"]:
                         st0.known_epochs[e] = True
                     # pre-fleet checkpoints carry no tenant_streams key;
@@ -818,7 +908,7 @@ class SchedulerSidecar:
                                      or {}).items():
                         st = self._stream(int(tid))
                         st.round_cache = rec.get("round_cache")
-                        st.staged_payload = rec.get("pending_payload")
+                        st.staged = _staged(rec)
                         for e in rec.get("known_epochs", ()):
                             st.known_epochs[e] = True
                     self._rounds_served = int(state["rounds_served"])
@@ -854,8 +944,7 @@ class SchedulerSidecar:
         free from the API layer's schedule period; bench calls it
         explicitly so the measured round isolates the serving path from
         raw compute."""
-        pendings = [st.pending for st in self._streams.values()
-                    if st.pending is not None]
+        pendings = [e for st in self._streams.values() for e in st.ring]
         if not pendings:
             return False
         import jax

@@ -43,6 +43,7 @@ def main(argv=None) -> int:
     from . import spans
     from ..chaos.probe import _PROBE_CONF, _churn, _small_cluster
     from ..framework.conf import parse_conf
+    from ..runtime.driver import step_cycle
     from ..runtime.fake_cluster import FakeCluster
     from ..runtime.scheduler import Scheduler
 
@@ -53,13 +54,12 @@ def main(argv=None) -> int:
     cluster = FakeCluster(_small_cluster())
     sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
     for c in range(args.cycles):
-        sched.run_once(now=1000.0 + c)
-        # ingest while the dispatched cycle is in flight — the overlap
-        # the pipeline exists to buy
-        with spans.span("loop.ingest", cat="ingest"):
-            _churn(cluster, c)
-        if pipeline:
-            sched.drain(now=1000.0 + c)
+        # ingest runs while the dispatched cycle is in flight — the
+        # overlap the pipeline exists to buy
+        def _ingest(c=c):
+            with spans.span("loop.ingest", cat="ingest"):
+                _churn(cluster, c)
+        step_cycle(sched, now=1000.0 + c, ingest=_ingest)
 
     trace = spans.export_chrome_trace(args.trace, merge=args.merge)
     events_written = spans.export_event_log(args.events) \

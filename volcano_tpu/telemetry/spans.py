@@ -313,21 +313,43 @@ def _subtract(a: List[tuple], b: List[tuple]) -> List[tuple]:
     return out
 
 
+def _window_depth(w: dict) -> int:
+    """Pipeline depth a device window was dispatched under (args tag from
+    the session/sidecar drain; pre-depth events count as 1)."""
+    try:
+        return int((w.get("args") or {}).get("depth") or 1)
+    except (TypeError, ValueError):
+        return 1
+
+
 def compute_occupancy(evts: Iterable[dict]) -> Dict[str, object]:
     """Pure occupancy math over span/window event dicts (unit-testable on
-    synthetic inputs). Host work = union of non-``wait``/non-``device``
-    spans MINUS the union of ``wait`` spans — nesting never double-counts
-    and an outer span covering a blocked readback doesn't masquerade as
-    overlap (the synchronous loop's window is ~all wait, so it honestly
-    reports ~0). For each device window: ``overlap`` is the host-work
-    time inside it, ``bubble`` the remainder."""
+    synthetic inputs). Host work is computed PER THREAD — each thread's
+    non-``wait``/non-``device`` span union minus its own ``wait`` union —
+    then unioned across threads: the async pack worker's real work counts
+    as overlap even while the main thread blocks in a drain, and one
+    thread's wait never blanks another thread's work (the global merge
+    the pre-depth analyzer did). Nesting never double-counts, and an
+    outer span covering a blocked readback doesn't masquerade as overlap
+    (the synchronous loop's window is ~all wait, so it honestly reports
+    ~0). For each device window: ``overlap`` is the host-work time inside
+    it, ``bubble`` the remainder. Windows tagged with a dispatch
+    ``depth`` additionally group into ``per_depth`` (the depth-k
+    acceptance surface: overlap fraction reported per pipeline depth)."""
     evts = list(evts)
     windows = [e for e in evts if e.get("cat") == "device"]
-    host = _merge([(e["ts"], e["ts"] + e["dur"]) for e in evts
-                   if e.get("cat") not in ("device", "wait")])
-    waits = _merge([(e["ts"], e["ts"] + e["dur"]) for e in evts
-                    if e.get("cat") == "wait"])
-    busy = _subtract(host, waits)
+    host_by_tid: Dict[object, list] = {}
+    wait_by_tid: Dict[object, list] = {}
+    for e in evts:
+        cat = e.get("cat")
+        if cat == "device":
+            continue
+        dst = wait_by_tid if cat == "wait" else host_by_tid
+        dst.setdefault(e.get("tid", 0), []).append(
+            (e["ts"], e["ts"] + e["dur"]))
+    busy = _merge([iv for tid, host in host_by_tid.items()
+                   for iv in _subtract(_merge(host),
+                                       _merge(wait_by_tid.get(tid, [])))])
 
     def analyze(ws):
         w_s = o_s = 0.0
@@ -356,16 +378,28 @@ def compute_occupancy(evts: Iterable[dict]) -> Dict[str, object]:
                                       if w.get("shard") in (None, s)])
                      for s in ids}
     out["per_shard"] = per_shard
+    depths = sorted({_window_depth(w) for w in windows})
+    out["per_depth"] = (
+        {str(d): analyze([w for w in windows if _window_depth(w) == d])
+         for d in depths}
+        if depths and depths != [1] else None)
     return out
 
 
 def occupancy() -> Dict[str, object]:
     """Occupancy analysis over the live event ring: how much of the
     in-flight device windows the host covered with real (non-wait) work,
-    aggregate and per shard."""
+    aggregate, per shard, and per pipeline depth, tagged with the JAX
+    backend the windows ran on."""
     with _LOCK:
         evts = [dict(e) for e in _EVENTS]
-    return compute_occupancy(evts)
+    out = compute_occupancy(evts)
+    try:
+        import jax
+        out["backend"] = jax.default_backend()
+    except Exception:       # uninitialized/absent backend: tag stays None
+        out["backend"] = None
+    return out
 
 
 # --------------------------------------------------------------- exporters
