@@ -13,7 +13,9 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu \
+# 1500s wall cap: recalibrated for the current 1-vCPU CI box (the suite
+# passes in ~1130s there; the previous 870s cap dated from a faster host)
+timeout -k 10 1500 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly \
@@ -55,6 +57,12 @@ if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
     env JAX_PLATFORMS=cpu \
         XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
         python -m volcano_tpu.chaos --smoke --sharded || crc=$?
+    # and with the shard-local pallas candidate launch (ISSUE 14): digest
+    # trips + recoveries on the 8-device mesh, decisions equal clean
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+        python -m volcano_tpu.chaos --smoke --sharded --pallas-interpret \
+        || crc=$?
 fi
 src=0
 if [ "${TIER1_SKIP_SPEC:-0}" != "1" ]; then

@@ -1,0 +1,188 @@
+"""Multi-host mesh groundwork (ISSUE 14, parallel/distributed):
+initialize_distributed's single-process no-op contract, the host shard
+partition, and per-host delta routing — the union of every host's masked
+(D, B) upload must apply exactly the full routed delta through the real
+sharded scatter, with foreign rows inert."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from volcano_tpu.framework.conf import parse_conf
+from volcano_tpu.parallel import (host_shard_range, initialize_distributed,
+                                  mask_foreign_shards)
+
+
+class TestInitializeDistributed:
+    def test_default_is_noop(self, monkeypatch):
+        monkeypatch.delenv("VOLCANO_MESH_HOSTS", raising=False)
+        monkeypatch.delenv("VOLCANO_COORDINATOR", raising=False)
+        monkeypatch.delenv("VOLCANO_PROCESS_ID", raising=False)
+        out = initialize_distributed()
+        assert out["initialized"] is False
+        assert out["n_hosts"] == 1 and out["process_id"] == 0
+        assert "single-process" in out["reason"]
+
+    def test_conf_mesh_hosts_one_is_noop(self, monkeypatch):
+        # conf wins over env, and 1 host is explicitly single-process
+        monkeypatch.setenv("VOLCANO_MESH_HOSTS", "4")
+        conf = parse_conf("mesh_hosts: 1\n")
+        out = initialize_distributed(conf)
+        assert out["initialized"] is False and out["n_hosts"] == 1
+
+    def test_multi_host_without_coordinator_stays_single(self, monkeypatch):
+        """mesh_hosts > 1 with no coordinator env must NOT raise and must
+        NOT touch jax.distributed — fail-soft into single-process."""
+        monkeypatch.delenv("VOLCANO_COORDINATOR", raising=False)
+        monkeypatch.delenv("VOLCANO_PROCESS_ID", raising=False)
+        out = initialize_distributed(parse_conf("mesh_hosts: 2\n"))
+        assert out["initialized"] is False
+        assert out["n_hosts"] == 2
+        assert "VOLCANO_COORDINATOR" in out["reason"]
+
+    def test_env_mesh_hosts_without_conf(self, monkeypatch):
+        monkeypatch.setenv("VOLCANO_MESH_HOSTS", "2")
+        monkeypatch.delenv("VOLCANO_COORDINATOR", raising=False)
+        monkeypatch.delenv("VOLCANO_PROCESS_ID", raising=False)
+        out = initialize_distributed()
+        assert out["initialized"] is False and out["n_hosts"] == 2
+
+    def test_conf_parse_roundtrip(self):
+        assert parse_conf("mesh_hosts: 2\n").mesh_hosts == 2
+        assert parse_conf().mesh_hosts is None
+
+
+class TestHostShardRange:
+    @pytest.mark.parametrize("n_shards,n_hosts",
+                             [(8, 1), (8, 2), (8, 3), (8, 8),
+                              (2, 2), (30, 4), (5, 3)])
+    def test_partition_is_disjoint_and_complete(self, n_shards, n_hosts):
+        seen = []
+        for h in range(n_hosts):
+            lo, hi = host_shard_range(n_shards, n_hosts, h)
+            assert 0 <= lo <= hi <= n_shards
+            seen.extend(range(lo, hi))
+        assert seen == list(range(n_shards))
+
+    def test_even_split_when_divisible(self):
+        assert host_shard_range(8, 2, 0) == (0, 4)
+        assert host_shard_range(8, 2, 1) == (4, 8)
+
+    def test_bad_host_id_raises(self):
+        with pytest.raises(ValueError):
+            host_shard_range(8, 2, 2)
+        with pytest.raises(ValueError):
+            host_shard_range(8, 2, -1)
+
+
+class TestMaskForeignShards:
+    def test_own_rows_untouched_foreign_rows_drop_encoded(self):
+        D, B, rows_per, C = 4, 3, 5, 7
+        rng = np.random.default_rng(0)
+        pidx = rng.integers(0, D * rows_per * C, (D, B)).astype(np.int32)
+        pvals = rng.standard_normal((D, B)).astype(np.float32)
+        lo, hi = 1, 3
+        mi, mv = mask_foreign_shards(pidx, pvals, rows_per, C, lo, hi)
+        np.testing.assert_array_equal(mi[lo:hi], pidx[lo:hi])
+        np.testing.assert_array_equal(mv[lo:hi], pvals[lo:hi])
+        for s in (0, 3):
+            assert (mi[s] == (s + 1) * rows_per * C).all()
+            assert (mv[s] == 0).all()
+        # inputs not mutated
+        assert mi is not pidx and mv is not pvals
+
+    def test_full_range_is_identity(self):
+        pidx = np.arange(6, dtype=np.int32).reshape(2, 3)
+        pvals = np.ones((2, 3), np.float32)
+        mi, mv = mask_foreign_shards(pidx, pvals, 4, 2, 0, 2)
+        np.testing.assert_array_equal(mi, pidx)
+        np.testing.assert_array_equal(mv, pvals)
+
+    def test_empty_bucket_passthrough(self):
+        pidx = np.zeros((3, 0), np.int32)
+        pvals = np.zeros((3, 0), np.float32)
+        mi, mv = mask_foreign_shards(pidx, pvals, 4, 2, 0, 1)
+        assert mi.shape == (3, 0) and mv.shape == (3, 0)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs a >=2-device mesh "
+                           "(XLA_FLAGS=--xla_force_host_platform_device_count)")
+class TestPerHostRoutingEquivalence:
+    def _kernel(self):
+        from volcano_tpu.analysis.entrypoints import _snap_extras
+        from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                                   derive_batching,
+                                                   make_allocate_cycle)
+        from volcano_tpu.ops.fused_io import ShardedDeltaKernel
+        from volcano_tpu.parallel import mesh_for_nodes, node_leaf_mask
+        snap, extras = _snap_extras((30, 6, 2))
+        cfg = dataclasses.replace(
+            derive_batching(AllocateConfig(binpack_weight=1.0,
+                                           enable_gpu=False),
+                            has_proportion=False), use_pallas=False)
+        tree = (snap, extras)
+        mesh = mesh_for_nodes(int(np.asarray(snap.nodes.valid).shape[0]), 2)
+        kernel = ShardedDeltaKernel(make_allocate_cycle(cfg), tree, mesh,
+                                    node_leaf_mask(tree),
+                                    entry="fused_cycle_dist_test")
+        return kernel, tree
+
+    def test_union_of_host_uploads_equals_full_routing(self):
+        """Apply the full routed (D, B) delta in one scatter vs. one
+        masked scatter per host: the resident node buffer must end up
+        bit-identical — the per-host upload contract."""
+        kernel, tree = self._kernel()
+        C = kernel.node_cols["f"]
+        nb0 = kernel._fuse_sharded(tree)[0]           # f node buffer (N, C)
+        scatter = kernel._make_node_scatter("f")
+        rng = np.random.default_rng(7)
+        # unique flat indices spread over both shards (set semantics make
+        # duplicate indices order-dependent; uniqueness keeps the oracle
+        # exact)
+        idx = rng.choice(kernel.n_nodes * C, size=11,
+                         replace=False).astype(np.int32)
+        vals = rng.standard_normal(11).astype(nb0.dtype)
+        pidx, pvals = kernel._route(idx, vals, "f")
+        full, _ = scatter(nb0.copy(), pidx, pvals)
+        D = kernel.n_shards
+        for n_hosts in (1, 2):
+            nb = nb0.copy()
+            for h in range(n_hosts):
+                lo, hi = host_shard_range(D, n_hosts, h)
+                mi, mv = mask_foreign_shards(pidx, pvals, kernel.rows_per,
+                                             C, lo, hi)
+                nb, _ = scatter(np.asarray(nb), mi, mv)
+            np.testing.assert_array_equal(np.asarray(nb), np.asarray(full),
+                                          err_msg=f"n_hosts={n_hosts}")
+
+    def test_single_host_upload_leaves_foreign_shards_untouched(self):
+        """Host 0's masked upload must not materialize host 1's delta
+        content: foreign shard rows of the resident stay at their prior
+        bytes."""
+        kernel, tree = self._kernel()
+        C = kernel.node_cols["f"]
+        nb0 = kernel._fuse_sharded(tree)[0]
+        scatter = kernel._make_node_scatter("f")
+        rows_per, D = kernel.rows_per, kernel.n_shards
+        # one real update per shard
+        idx = np.array([0, rows_per * C], np.int32)
+        vals = np.array([123.0, 456.0], nb0.dtype)
+        pidx, pvals = kernel._route(idx, vals, "f")
+        lo, hi = host_shard_range(D, 2, 0)
+        mi, mv = mask_foreign_shards(pidx, pvals, rows_per, C, lo, hi)
+        nb, _ = scatter(nb0.copy(), mi, mv)
+        nb = np.asarray(nb)
+        assert nb[0, 0] == np.asarray(vals[0])        # own shard applied
+        np.testing.assert_array_equal(nb[rows_per:], nb0[rows_per:])
+
+    def test_empty_delta_routes_and_masks_cleanly(self):
+        kernel, _tree = self._kernel()
+        pidx, pvals = kernel._route(np.zeros(0, np.int32),
+                                    np.zeros(0, np.float32), "f")
+        assert pidx.shape == (kernel.n_shards, 0)
+        mi, mv = mask_foreign_shards(pidx, pvals, kernel.rows_per,
+                                     kernel.node_cols["f"], 0, 1)
+        assert mi.shape == pidx.shape and mv.shape == pvals.shape

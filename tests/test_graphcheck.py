@@ -256,6 +256,37 @@ class TestShardingFamily:
         from volcano_tpu.analysis.sharding import check_sharding
         assert check_sharding(fast=True) == []
 
+    def test_fires_on_planted_gather_feeding_pallas(self):
+        """ISSUE 14: a shard that all-gathers the full node axis and
+        feeds it to a pallas launch must trip the shard-local block
+        check."""
+        from volcano_tpu.analysis.sharding import (_pallas_findings,
+                                                   planted_gather_pallas)
+        closed, rows_per = planted_gather_pallas(n_devices=2, n_nodes=32)
+        findings = _pallas_findings(closed, 32, rows_per, "planted")
+        assert any(f.family == "sharding" and "pallas-block" in f.key
+                   for f in findings), closed
+
+    def test_shard_local_launch_does_not_fire(self):
+        """The REAL sharded+pallas entry's launches are shard-local —
+        _pallas_findings on its trace must be empty (the compiled-entry
+        sweep in test_clean_on_real_sharded_entry covers the HLO side)."""
+        import jax as _jax
+        from volcano_tpu.analysis.sharding import (_audit_kernel,
+                                                   _pallas_findings)
+        from volcano_tpu.parallel import mesh_for_nodes
+        kernel = _audit_kernel(mesh_for_nodes(128, 2),
+                               "fused_cycle_shardaudit_test_pl",
+                               use_pallas="interpret")
+        closed = _jax.make_jaxpr(kernel.traceable)(
+            *kernel.example_delta_args(256))
+        # the launch is really in the trace (the check is not vacuous)
+        from volcano_tpu.analysis.jaxpr_audit import iter_eqns
+        assert any(e.primitive.name == "pallas_call"
+                   for e in iter_eqns(closed.jaxpr))
+        assert _pallas_findings(closed, kernel.n_nodes, kernel.rows_per,
+                                "real") == []
+
     def test_family_registered(self):
         from volcano_tpu.analysis import FAMILIES
         assert "sharding" in FAMILIES

@@ -43,6 +43,13 @@ PLAIN_CONF = parse_conf(_BODY)
 SHARD1_CONF = parse_conf("sharding: true\nsharding_devices: 1\n" + _BODY)
 SHARD2_CONF = parse_conf("sharding: true\nsharding_devices: 2\n" + _BODY)
 
+
+def _pl_conf(devices: int):
+    """Sharded + shard-local pallas candidate launch (ISSUE 14), in
+    interpret mode so the matrix runs on the CPU test mesh."""
+    return parse_conf(f"sharding: true\nsharding_devices: {devices}\n"
+                      "use_pallas: interpret\n" + _BODY)
+
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 2, reason="needs the multi-device virtual mesh")
 
@@ -73,6 +80,36 @@ class TestShardedSchedulerIdentity:
             "shard1_sync": _run_loop(SHARD1_CONF, False)[0],
             "shard2_sync": _run_loop(SHARD2_CONF, False)[0],
             "shard2_pipe": _run_loop(SHARD2_CONF, True)[0],
+        }
+        assert len(set(shas.values())) == 1, shas
+
+    def test_sharded_pallas_loops_match_unsharded_sha(self):
+        """ISSUE 14 fast rows: the sharded cycle honoring ``use_pallas``
+        (shard-local candidate launch + cross-shard argmax combine) is
+        sha-identical to the unsharded scheduler on 1- and 2-device
+        meshes, with the steady delta cycles still paying zero
+        resharding copies."""
+        plain = _run_loop(PLAIN_CONF, False)[0]
+        shas = {
+            "shard1_pl_sync": _run_loop(_pl_conf(1), False)[0],
+        }
+        sha2, sched2 = _run_loop(_pl_conf(2), False)
+        shas["shard2_pl_sync"] = sha2
+        assert set(shas.values()) == {plain}, (plain, shas)
+        deltas = [e for e in sched2.flight.snapshots()
+                  if e.get("cycle_kind") == "delta"]
+        assert deltas and all(e["resharding_copies"] == 0 for e in deltas)
+
+    @pytest.mark.slow
+    @pytest.mark.skipif(len(jax.devices()) < 8,
+                        reason="needs the 8-device virtual mesh")
+    def test_sharded_pallas_wide_mesh_matches_sha(self):
+        """ISSUE 14 slow tail: the 8-device shard-local launch and the
+        pipelined 2-device row stay in the same sha class."""
+        shas = {
+            "plain_sync": _run_loop(PLAIN_CONF, False)[0],
+            "shard8_pl_sync": _run_loop(_pl_conf(8), False)[0],
+            "shard2_pl_pipe": _run_loop(_pl_conf(2), True)[0],
         }
         assert len(set(shas.values())) == 1, shas
 

@@ -131,6 +131,50 @@ class TestSidecar:
             server.shutdown()
 
 
+class TestSidecarShardedPallas:
+    """ISSUE 14: a conf-mode sidecar serving ``sharding: true`` +
+    ``use_pallas: interpret`` — the served sharded cycle runs the
+    shard-local candidate launch and must stay decision-identical to
+    the plain served conf, across the cold fuse AND a warm delta
+    cycle."""
+
+    _BODY = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: binpack
+"""
+
+    @pytest.mark.skipif(len(jax.devices()) < 2,
+                        reason="needs the multi-device virtual mesh")
+    def test_sharded_pallas_conf_matches_plain_served(self):
+        plain_srv = SidecarServer(conf=self._BODY)
+        shard_srv = SidecarServer(
+            conf="sharding: true\nsharding_devices: 2\n"
+                 "use_pallas: interpret\n" + self._BODY)
+        plain_srv.serve_in_thread()
+        shard_srv.serve_in_thread()
+        try:
+            plain = SidecarClient(*plain_srv.address)
+            shard = SidecarClient(*shard_srv.address)
+            assert shard_srv.sidecar.sharding
+            for k in range(2):      # cycle 0 cold-fuses, cycle 1 deltas
+                ci_a, ci_b = cluster(), cluster()
+                out_p = plain.schedule(ci_a)
+                out_s = shard.schedule(ci_b)
+                np.testing.assert_array_equal(
+                    out_p["task_node"], out_s["task_node"], f"cycle {k}")
+                np.testing.assert_array_equal(
+                    out_p["task_mode"], out_s["task_mode"], f"cycle {k}")
+                assert out_p["binds"] == out_s["binds"], f"cycle {k}"
+            plain.close()
+            shard.close()
+        finally:
+            plain_srv.shutdown()
+            shard_srv.shutdown()
+
+
 @pytest.mark.slow
 class TestSidecarHDRF:
     def test_wire_carries_hierarchy_tree(self):

@@ -26,6 +26,18 @@ real snapshot and enforces two invariants on the compiled module:
   input shardings (out == in: the zero inter-iteration resharding
   contract the live probe in ResidentState counts against).
 
+Since ISSUE 14 the sharded cycle also honors ``use_pallas`` (the
+shard-local candidate launch in ops/allocate_scan + ops/pallas_place),
+so the family additionally audits the sharded+pallas entry:
+
+- **shard-local pallas blocks** — every ``pallas_call`` in the traced
+  entry must operate on shard-local node blocks (NL = nodes / mesh).
+  A launch whose operand or result carries the FULL node axis means a
+  full-axis gather fed the kernel — the exact O(nodes) leak the
+  shard-local design exists to prevent (the gather itself may also trip
+  the all-gather check, but an interpreted launch can hide it behind
+  element-wise HLO, so the jaxpr-level block check is load-bearing).
+
 With fewer than two local devices there is no mesh to audit and the
 family reports nothing (the tier-1 test environment forces 8 virtual
 CPU devices; scripts/graphcheck.sh exports the same default).
@@ -74,6 +86,40 @@ def _collective_findings(hlo_text: str, n_nodes: int,
     return findings
 
 
+def _pallas_findings(closed, n_nodes: int, rows_per: int,
+                     where: str) -> List[Finding]:
+    """Walk a traced sharded entry for ``pallas_call`` eqns whose block
+    shapes exceed the shard-local row count. Under the shard_map local
+    view every node-axis operand is NL = rows_per wide; a dim equal to
+    the FULL node axis proves a full-axis gather fed the launch. Shared
+    by the live check and the planted-violation test."""
+    from .jaxpr_audit import iter_eqns
+    findings: List[Finding] = []
+    if rows_per >= n_nodes:
+        return findings         # single-shard mesh: nothing to leak
+    seen = set()
+    for eqn in iter_eqns(closed.jaxpr):
+        if eqn.primitive.name != "pallas_call":
+            continue
+        for v in list(eqn.invars) + list(eqn.outvars):
+            shape = getattr(getattr(v, "aval", None), "shape", None)
+            if not shape or n_nodes not in shape:
+                continue
+            key = f"sharding:pallas-block:{where}:{tuple(shape)}"
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                family="sharding", key=key, where=where,
+                what=(f"pallas launch operand/result of shape "
+                      f"{tuple(shape)} carries the full {n_nodes}-node "
+                      f"axis inside a {rows_per}-row shard — a full-axis "
+                      "gather is feeding the kernel; the launch must stay "
+                      "shard-local (NL = nodes / mesh) with the winner "
+                      "resolved by the in-graph cross-shard combine")))
+    return findings
+
+
 def planted_allgather_hlo(n_devices: int = 2, n_nodes: int = 32,
                           cols: int = 4) -> str:
     """Compile a deliberately mis-sharded program — a node-sharded
@@ -93,9 +139,46 @@ def planted_allgather_hlo(n_devices: int = 2, n_nodes: int = 32,
                                          jnp.float32)).compile().as_text()
 
 
-def _audit_kernel(mesh, entry: str):
+def planted_gather_pallas(n_devices: int = 2, n_nodes: int = 32,
+                          cols: int = 4):
+    """Compile a deliberately broken shard-local launch — each shard
+    all-gathers the FULL node axis and feeds it to a pallas launch —
+    and return ``(closed_jaxpr, rows_per)``. ``_pallas_findings`` must
+    flag the full-axis block (tests/test_graphcheck.py)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("nodes",))
+    rows_per = n_nodes // n_devices
+
+    def body(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    def local(x):
+        full = jax.lax.all_gather(x, "nodes", axis=0, tiled=True)
+        out = pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct(full.shape, full.dtype),
+            interpret=True)(full)
+        off = jax.lax.axis_index("nodes") * rows_per
+        return jax.lax.dynamic_slice_in_dim(out, off, rows_per)
+
+    fn = shard_map(local, mesh=mesh, in_specs=P("nodes", None),
+                   out_specs=P("nodes", None), check_rep=False)
+    closed = jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((n_nodes, cols), jnp.float32))
+    return closed, rows_per
+
+
+def _audit_kernel(mesh, entry: str, use_pallas=None):
     """Build the real sharded update+cycle entry on a small real snapshot
-    (same pack path production uses) over ``mesh``."""
+    (same pack path production uses) over ``mesh``. ``use_pallas``
+    selects the kernel path exactly like the conf knob — "interpret"
+    builds the shard-local pallas candidate launch (ISSUE 14)."""
     import dataclasses
 
     from ..ops.allocate_scan import (AllocateConfig, derive_batching,
@@ -110,8 +193,8 @@ def _audit_kernel(mesh, entry: str):
     snap, extras = _snap_extras()
     cfg = dataclasses.replace(
         derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False),
-                        has_proportion=False), use_pallas=False)
-    cycle = make_allocate_cycle(cfg)
+                        has_proportion=False), use_pallas=use_pallas)
+    cycle = make_allocate_cycle(cfg, mesh=mesh)
     return ShardedDeltaKernel(cycle, (snap, extras), mesh,
                               node_leaf_mask((snap, extras)), entry=entry)
 
@@ -126,22 +209,36 @@ def check_sharding(fast: bool = False) -> List[Finding]:
     findings: List[Finding] = []
 
     # fast: the 2-device mesh (cheapest GSPMD compile that still
-    # partitions); full: additionally the widest mesh the node axis
-    # admits, where a mis-sharded intermediate costs the most
-    kernel2 = _audit_kernel(mesh_for_nodes(128, 2), "fused_cycle_shardaudit2")
-    meshes = [(2, kernel2)]
+    # partitions), scan AND shard-local-pallas kernels; full:
+    # additionally the widest mesh the node axis admits, where a
+    # mis-sharded intermediate costs the most
+    mesh2 = mesh_for_nodes(128, 2)
+    meshes = [
+        (2, _audit_kernel(mesh2, "fused_cycle_shardaudit2"), False),
+        (2, _audit_kernel(mesh2, "fused_cycle_shardaudit2pl",
+                          use_pallas="interpret"), True),
+    ]
     if not fast and jax.device_count() >= 4:
         wide = mesh_for_nodes(128, jax.device_count())
         d = int(wide.devices.size)
         if d > 2:
             meshes.append((d, _audit_kernel(
-                wide, f"fused_cycle_shardaudit{d}")))
+                wide, f"fused_cycle_shardaudit{d}"), False))
+            meshes.append((d, _audit_kernel(
+                wide, f"fused_cycle_shardaudit{d}pl",
+                use_pallas="interpret"), True))
 
-    for d, kernel in meshes:
-        where = f"ops/fused_io.ShardedDeltaKernel[{d}dev]"
+    for d, kernel, pl_on in meshes:
+        kind = "pallas," if pl_on else ""
+        where = f"ops/fused_io.ShardedDeltaKernel[{kind}{d}dev]"
+        args = kernel.example_delta_args(256)
+        if pl_on:
+            # jaxpr-level: every pallas launch must stay shard-local
+            closed = jax.make_jaxpr(kernel.traceable)(*args)
+            findings += _pallas_findings(closed, kernel.n_nodes,
+                                         kernel.rows_per, where)
         # steady-state delta signature: what every warm cycle compiles
-        compiled = kernel._fn.lower(
-            *kernel.example_delta_args(256)).compile()
+        compiled = kernel._fn.lower(*args).compile()
         findings += _collective_findings(compiled.as_text(),
                                          kernel.n_nodes, where)
 

@@ -161,6 +161,11 @@ def main(argv=None) -> int:
     parser.add_argument("--sharded", action="store_true",
                         help="run the storm on the node-axis sharded "
                              "backend (conf sharding: true)")
+    parser.add_argument("--pallas-interpret", action="store_true",
+                        help="run the storm on the pallas kernel path in "
+                             "interpret mode (conf use_pallas: interpret); "
+                             "with --sharded this is the shard-local "
+                             "candidate launch")
     parser.add_argument("--restart", action="store_true",
                         help="run the restart smoke: process_kill at "
                              "every phase, checkpoint restore, decision "
@@ -181,7 +186,10 @@ def main(argv=None) -> int:
     try:
         report = run_chaos_probe(seed=args.seed, cycles=args.cycles,
                                  deadline_ms=args.deadline_ms,
-                                 sharding=args.sharded)
+                                 sharding=args.sharded,
+                                 use_pallas=("interpret"
+                                             if args.pallas_interpret
+                                             else None))
     except Exception as e:  # harness failure, not a chaos verdict
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
         return 2

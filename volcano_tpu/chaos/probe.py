@@ -96,18 +96,24 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
                     kinds=RECOVERABLE_KINDS,
                     deadline_ms: Optional[float] = None,
                     slow_s: float = 0.25,
-                    sharding: bool = False) -> Dict[str, object]:
+                    sharding: bool = False,
+                    use_pallas: Optional[str] = None) -> Dict[str, object]:
     """Run the probe; returns a JSON-ready robustness report.
 
     ``sharding`` runs both the clean and the fault runs on the node-axis
     sharded backend (conf ``sharding: true``): fault recovery and the
     per-shard digest discipline must hold there exactly as on the
-    single-device path."""
+    single-device path. ``use_pallas`` ("interpret" in CI) selects the
+    kernel path via the same conf knob — combined with ``sharding`` it
+    puts the storm on the shard-local pallas candidate launch
+    (ISSUE 14)."""
     from ..framework.conf import parse_conf
     from ..metrics import METRICS
     from ..runtime.fake_cluster import FakeCluster
     from ..runtime.scheduler import Scheduler
-    conf = parse_conf(("sharding: true\n" if sharding else "") + _PROBE_CONF)
+    conf = parse_conf(("sharding: true\n" if sharding else "")
+                      + (f"use_pallas: {use_pallas}\n" if use_pallas else "")
+                      + _PROBE_CONF)
     base = _small_cluster()
 
     def run(injector):
@@ -142,6 +148,7 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
         "cycles": cycles,
         "pipeline": pipeline,
         "sharding": sharding,
+        "use_pallas": use_pallas,
         "mesh_devices": next(
             (int(e["mesh_devices"]) for e in reversed(flight)
              if e.get("mesh_devices") is not None), None),

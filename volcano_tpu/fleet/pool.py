@@ -55,29 +55,34 @@ from ..telemetry import spans as _spans
 _LEAK_FOR_TESTS = False
 
 
-def normalize_config(cfg):
+def normalize_config(cfg, sharding: bool = False):
     """The bucket-key form of a tenant's derived AllocateConfig.
 
     ``telemetry`` and ``use_pallas`` are decision-neutral backend/readout
     knobs (the repo's equality suites pin scan == pallas == telemetry-on
     decisions); normalizing them lets tenants that differ only there share
-    a bucket, and keeps the batched entry on the pure-XLA scan path — the
+    a bucket. On the unsharded batched path ``use_pallas`` is stripped to
+    the explicit force-scan value (False, not None: None means
+    auto-detect, which would pick the kernel on TPU) — the
     vmap-over-tenant-axis transform composes with lax control flow, not
-    with a pallas_call launch. ``use_pallas=False`` (not None: None means
-    auto-detect, which would pick the kernel on TPU) is the explicit
-    force-scan value. Everything decision-relevant (weights, gates,
-    derived batching) stays in the key, so tenants with different
-    policies never share a compiled program.
+    with a pallas_call launch. With ``sharding`` active the knob STAYS in
+    the key: the sharded cycle dispatches per kernel mode (scan vs the
+    shard-local candidate launch), so tenants split buckets on it instead
+    of silently sharing a scan program. Everything decision-relevant
+    (weights, gates, derived batching) stays in the key either way, so
+    tenants with different policies never share a compiled program.
     """
+    if sharding:
+        return dataclasses.replace(cfg, telemetry=False)
     return dataclasses.replace(cfg, telemetry=False, use_pallas=False)
 
 
-def bucket_key(cfg, tree) -> tuple:
+def bucket_key(cfg, tree, sharding: bool = False) -> tuple:
     """Shape-bucket identity: the normalized config + the exact per-leaf
     (shape, dtype) signature — the same key construction the single-tenant
     delta cache uses (ops/fused_io._shape_key), so fleet buckets and
     single-tenant shape buckets cannot drift."""
-    return _shape_key(tree, normalize_config(cfg))
+    return _shape_key(tree, normalize_config(cfg, sharding=sharding))
 
 
 def _entry_name(key: tuple, width: int) -> str:
@@ -251,13 +256,14 @@ class TenantPool:
         key = self.placement.get(name)
         return self.buckets.get(key) if key is not None else None
 
-    def place(self, name: str, cfg, tree) -> _Bucket:
+    def place(self, name: str, cfg, tree, sharding: bool = False) -> _Bucket:
         """Route a tenant to its shape bucket for this cycle, migrating
         its residency if the derived key changed (a structural cluster
         change moved it to another bucket — only the two touched buckets
         restack; every other bucket's kernel and residents are
-        untouched)."""
-        key = bucket_key(cfg, tree)
+        untouched). ``sharding`` mirrors the tenant conf's flag: sharded
+        tenants split buckets on ``use_pallas`` (see normalize_config)."""
+        key = bucket_key(cfg, tree, sharding=sharding)
         old = self.placement.get(name)
         if old is not None and old != key:
             self.evict(name)
@@ -314,6 +320,11 @@ class TenantPool:
         """
         assert set(n for n, _t in items) <= set(bucket.members), \
             "run_bucket items must be bucket members"
+        # kernel-build normalization always forces scan (sharding=False):
+        # the batched entry vmaps the cycle over the tenant axis, which
+        # composes with lax control flow but not with a pallas_call —
+        # sharded tenants only split bucket KEYS on use_pallas (place()),
+        # the batched program itself stays pure-XLA
         cfg_n = normalize_config(cfg)
         if bucket.kernel is not None:
             spec, sizes = bucket.kernel.spec, bucket.kernel.sizes

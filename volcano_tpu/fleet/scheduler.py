@@ -284,7 +284,9 @@ class FleetScheduler:
                     cfg=cfg, tree=tree,
                     T=int(np.asarray(ssn.snap.tasks.status).shape[0]),
                     J=int(np.asarray(ssn.snap.jobs.valid).shape[0]))
-                bucket = self.pool.place(name, cfg, tree)
+                bucket = self.pool.place(
+                    name, cfg, tree,
+                    sharding=bool(getattr(t.conf, "sharding", False)))
                 if t.warm_mirrors:
                     from ..runtime.checkpoint import _freeze_key
                     mir = t.warm_mirrors.pop(_freeze_key(bucket.key), None)

@@ -78,14 +78,24 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
         # so a served sidecar cycle carries the same counter block an
         # in-process Session would
         telemetry=bool(getattr(sc, "telemetry", False)),
+        # kernel-path override (``use_pallas: true|false|interpret``) —
+        # same threading Session._allocate_config does, so a served conf
+        # selects the same kernel an in-process Session would
+        use_pallas=getattr(sc, "use_pallas", None),
         **weights), has_proportion=has_proportion)
 
 
 def make_conf_cycle(conf: Optional[object] = None, hierarchy=None,
-                    cfg_overrides: Optional[dict] = None):
+                    cfg_overrides: Optional[dict] = None, mesh=None):
     """conf (SchedulerConfiguration | YAML text | None) -> jittable
     cycle(snap, hierarchy=None, base_extras=None) -> AllocateResult with
     in-graph plugin extras.
+
+    ``mesh``: the 1-D node mesh when the caller runs this cycle sharded
+    (the sidecar's per-bucket meshes). Passed through to
+    make_allocate_cycle, which then honors ``use_pallas`` via the
+    shard-local candidate launch instead of disabling it — see
+    parallel/sharding.py.
 
     ``hierarchy`` (arrays/hierarchy.HierarchyArrays) supplies the hdrf tree
     topology when the conf enables drf hierarchy — either baked here or
@@ -105,12 +115,9 @@ def make_conf_cycle(conf: Optional[object] = None, hierarchy=None,
     options = {opt.name: opt for opt in _plugin_options(sc)}
     cfg = allocate_config_from_conf(sc)
     if cfg_overrides:
-        # the sharded sidecar path forces use_pallas=False here: GSPMD
-        # has no partitioning rule for the pallas custom call (see
-        # parallel/sharding.make_sharded_allocate)
         import dataclasses as _dc
         cfg = _dc.replace(cfg, **cfg_overrides)
-    allocate = make_allocate_cycle(cfg)
+    allocate = make_allocate_cycle(cfg, mesh=mesh)
     proportion_on = "proportion" in options
     baked_hierarchy = hierarchy
 

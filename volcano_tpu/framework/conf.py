@@ -124,7 +124,10 @@ class SchedulerConfiguration:
     #: snapshot buffers split along the node axis, deltas route to the
     #: owning shard, and the cycle runs under GSPMD with
     #: out_shardings == in_shardings across iterations. Decisions are
-    #: bit-identical to the unsharded path. Requires the delta path
+    #: bit-identical to the unsharded path. Composes with ``use_pallas``:
+    #: the placer runs as a shard-local pallas candidate launch with an
+    #: in-graph cross-shard argmax combine (ops/allocate_scan, ISSUE 14)
+    #: rather than being forced back to the scan. Requires the delta path
     #: (``delta_uploads: true``, the default) — with delta uploads off the
     #: knob is ignored. YAML: top-level ``sharding: true``.
     sharding: bool = False
@@ -133,6 +136,13 @@ class SchedulerConfiguration:
     #: the packed node axis (parallel/sharding.mesh_for_nodes). YAML:
     #: top-level ``sharding_devices: 8``.
     sharding_devices: Optional[int] = None
+    #: multi-host groundwork (parallel/distributed): number of host
+    #: processes the mesh spans. None/1 (default) = single-process —
+    #: initialize_distributed is a strict no-op. > 1 plus the
+    #: $VOLCANO_COORDINATOR / $VOLCANO_PROCESS_ID env contract calls
+    #: jax.distributed.initialize before mesh construction. YAML:
+    #: top-level ``mesh_hosts: 2``.
+    mesh_hosts: Optional[int] = None
     #: kernel-path override threaded into AllocateConfig.use_pallas:
     #: ``true`` compiles the allocate sweep as the pallas kernel,
     #: ``"interpret"`` runs the same kernel in interpreter mode (any N,
@@ -208,6 +218,8 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     sc.sharding = bool(data.get("sharding", False))
     sd = data.get("sharding_devices")
     sc.sharding_devices = int(sd) if sd is not None else None
+    mh = data.get("mesh_hosts")
+    sc.mesh_hosts = int(mh) if mh is not None else None
     sc.use_pallas = data.get("use_pallas")
     fs = data.get("fleet_slots")
     sc.fleet_slots = int(fs) if fs is not None else None

@@ -50,7 +50,7 @@ from ..arrays.schema import SnapshotArrays
 from . import predicates as P
 from . import scoring as S
 from .fairshare import drf_job_shares, hdrf_level_keys, namespace_shares
-from .select import best_node, lex_argmin
+from .select import NEG, best_node, lex_argmin
 
 #: task placement modes in the result arrays
 MODE_NONE = 0
@@ -455,7 +455,7 @@ def _affinity_place_update(aff: AffinityArrays, aff_cnt, anti_cnt, t, node,
     return aff_cnt, anti_cnt
 
 
-def make_allocate_cycle(cfg: AllocateConfig):
+def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
     """Build the jittable allocate pass for a given static config.
 
     Returned signature:
@@ -463,6 +463,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
     with all dynamic plugin contributions (drf shares, proportion deserved,
     hdrf keys, tdm gates, topology preferences, reservation locks) in
     ``extras``; use AllocateExtras.neutral(snap) when the plugins are off.
+
+    ``mesh``: when the caller runs this cycle under GSPMD node-axis
+    sharding (parallel/sharding.py), pass the 1-D node mesh. With
+    ``use_pallas`` requested the cycle then takes the sharded-pallas
+    path: the scan branch keeps pops, fairness-key recompute, and
+    capacity commits in replicated XLA, and delegates each placement
+    attempt's feasibility -> score -> argmax to a shard-local pallas
+    launch under shard_map, combined across shards by an in-graph
+    argmax (pallas_place.make_shard_candidate_placer). Decisions are
+    bit-identical to the unsharded paths.
     """
 
     def allocate(snap: SnapshotArrays,
@@ -514,7 +524,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 K, M, N, R, G, n_templates, GR,
                 *(aff_shapes if cfg.enable_pod_affinity else (0, 0, 0)),
                 J=J if KP else 0, Q=Q if KP else 0)
-            use_pallas = (backend in ("tpu", "axon") and N % 128 == 0
+            # under a mesh the launch is shard-local: the lane-tile
+            # check applies to the per-shard row count, not global N
+            n_tile = N if mesh is None else N // max(int(mesh.devices.size), 1)
+            use_pallas = (backend in ("tpu", "axon") and n_tile % 128 == 0
                           and not cfg.enable_host_ports
                           and vmem < 12 * 2 ** 20)
             interp = False
@@ -524,6 +537,18 @@ def make_allocate_cycle(cfg: AllocateConfig):
             raise ValueError(
                 "use_pallas excludes enable_host_ports: the fused round "
                 "placer carries no host-port state")
+        if mesh is not None and use_pallas:
+            # sharding x pallas composition: GSPMD still has no
+            # partitioning rule for a full-axis pallas_call, so the
+            # fused round placers stay off — instead the scan branch
+            # delegates the per-attempt candidate search to a
+            # shard-local launch (see the docstring). Pod affinity's
+            # scorer min-max normalizes over the FULL node axis (a
+            # cross-shard reduction), so it stays on the pure scan path.
+            shard_pl = not cfg.enable_pod_affinity
+            use_pallas = False
+        else:
+            shard_pl = False
         if not use_pallas:
             K = 1
             KP = 0
@@ -621,22 +646,10 @@ def make_allocate_cycle(cfg: AllocateConfig):
             return jnp.where(grp >= 0,
                              extras.or_feasible[jnp.maximum(grp, 0)], True)
 
-        if use_pallas:
-            from .pallas_place import (make_dyn_round_placer,
-                                       make_round_placer)
-            SK, ETA, SEL = aff_shapes
-            aff_dims = (SK, ETA) if cfg.enable_pod_affinity else None
-            NH = (2 * extras.hierarchy.queue_path.shape[1]
-                  if cfg.enable_hdrf else 0)
-            if dyn:
-                placer = make_dyn_round_placer(
-                    cfg, K, KP, M, N, R, G, GR, J, Q, S_ns, NH,
-                    aff_dims=aff_dims, interpret=interp)
-            else:
-                placer = make_round_placer(cfg, K, M, N, R, G, GR,
-                                           aff_dims=aff_dims,
-                                           interpret=interp)
-            relmp_t = (nodes.releasing - nodes.pipelined).T
+        if use_pallas or shard_pl:
+            # node-space env arrays shared by the fused round placers and
+            # the shard-local candidate kernel ([.., N] with the node
+            # axis last = kernel lane dimension)
             alloc_t = nodes.allocatable.T
             cnt_row = nodes.pod_count.astype(jnp.float32)[None, :]
             maxp_row = nodes.max_pods.astype(jnp.float32)[None, :]
@@ -659,6 +672,23 @@ def make_allocate_cycle(cfg: AllocateConfig):
             bonus_row = extras.tdm_bonus.astype(jnp.float32)[None, :]
             locked_row = extras.node_locked.astype(jnp.float32)[None, :]
             orfeas_f = extras.or_feasible.astype(jnp.float32)
+
+        if use_pallas:
+            from .pallas_place import (make_dyn_round_placer,
+                                       make_round_placer)
+            SK, ETA, SEL = aff_shapes
+            aff_dims = (SK, ETA) if cfg.enable_pod_affinity else None
+            NH = (2 * extras.hierarchy.queue_path.shape[1]
+                  if cfg.enable_hdrf else 0)
+            if dyn:
+                placer = make_dyn_round_placer(
+                    cfg, K, KP, M, N, R, G, GR, J, Q, S_ns, NH,
+                    aff_dims=aff_dims, interpret=interp)
+            else:
+                placer = make_round_placer(cfg, K, M, N, R, G, GR,
+                                           aff_dims=aff_dims,
+                                           interpret=interp)
+            relmp_t = (nodes.releasing - nodes.pipelined).T
 
             def node_env_args():
                 out = [tstat_f, tp_static, na_f, blocknr_row, blockall_row,
@@ -709,6 +739,120 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 def aff_state_args(st):
                     return []
                 aff_static_args = []
+
+        if shard_pl:
+            # ---- shard-local pallas candidate search (sharding x pallas) --
+            # Each shard launches the candidate kernel over its own node
+            # rows (env refs and live capacity arrive pre-sharded, no
+            # gather); the per-shard (score, global idx, found, raw ties)
+            # columns are reduced by an in-graph argmax combine that is
+            # bit-identical to select.best_node/tie_count on the full
+            # axis: f32 max is exact, the lowest-global-index tie-break
+            # is preserved by min over per-shard minima, and raw tie
+            # counts sum only across shards sitting at the global max.
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as _PS
+
+            from .pallas_place import make_shard_candidate_placer
+            axis = mesh.axis_names[0]
+            D_sh = int(mesh.devices.size)
+            if N % D_sh:
+                raise ValueError(
+                    f"sharded pallas needs nodes % mesh devices == 0 "
+                    f"(N={N}, devices={D_sh})")
+            NL_sh = N // D_sh
+            rel_t = nodes.releasing.T
+            pip_t = nodes.pipelined.T
+            _cand = make_shard_candidate_placer(cfg, NL_sh, R, G, GR,
+                                                interpret=interp)
+            env_sh = [tstat_f, tp_static, na_f, blocknr_row, blockall_row,
+                      bonus_row, locked_row, orfeas_f, rel_t, pip_t,
+                      alloc_t, cnt_row, maxp_row]
+            if cfg.enable_gpu:
+                env_sh.append(gidle0_t)
+            n_scal = 8 + (1 if cfg.enable_gpu else 0)
+
+            def _cand_region(*flat):
+                it = iter(flat)
+                rr = next(it)
+                gq = next(it) if cfg.enable_gpu else None
+                scal = [next(it) for _ in range(7)]
+                env = [next(it) for _ in range(len(env_sh))]
+                idle_s = next(it)                 # [NL, R]
+                pipe_s = next(it)                 # [NL, R]
+                pods_s = next(it)                 # [NL] i32
+                gpux_s = next(it) if cfg.enable_gpu else None
+                off = (jax.lax.axis_index(axis)
+                       * jnp.int32(NL_sh)).astype(jnp.int32).reshape(1, 1)
+                args = [rr]
+                if cfg.enable_gpu:
+                    args.append(gq)
+                args += scal + [off] + env
+                args += [idle_s.T, pipe_s.T,
+                         pods_s.astype(jnp.float32)[None, :]]
+                if cfg.enable_gpu:
+                    args.append(gpux_s.T)
+                outs = _cand(*args)
+                return tuple(o.reshape(1) for o in outs)
+
+            state_specs = [_PS(axis, None), _PS(axis, None), _PS(axis)]
+            if cfg.enable_gpu:
+                state_specs.append(_PS(axis, None))
+            # check_rep=False: shard_map has no replication rule for
+            # pallas_call (the error message prescribes exactly this);
+            # out_specs make the sharding explicit anyway
+            _cand_sm = shard_map(
+                _cand_region, mesh=mesh,
+                in_specs=tuple([_PS()] * n_scal
+                               + [_PS(None, axis)] * len(env_sh)
+                               + state_specs),
+                out_specs=(_PS(axis),) * 8,
+                check_rep=False)
+
+            def _combine(sc_d, ix_d, fn_d, tie_d):
+                """(D,) per-shard candidates -> the global winner
+                best_node would return, plus the RAW tie count at the
+                global max (tie_count applies ``max(n - 1, 0)``)."""
+                fnb = fn_d > 0
+                msc = jnp.where(fnb, sc_d, jnp.float32(NEG))
+                gmax = jnp.max(msc)
+                at = fnb & (msc == gmax)
+                found = jnp.any(fnb)
+                idx = jnp.min(jnp.where(at, ix_d, jnp.int32(N)))
+                idx = jnp.where(found, idx, jnp.int32(0))
+                ties_raw = jnp.sum(jnp.where(at, tie_d, 0),
+                                   dtype=jnp.int32)
+                return idx, found, ties_raw
+
+            def shard_candidates(t, ji, idle, pipe_extra, pods_extra,
+                                 gpu_extra):
+                i32 = jnp.int32
+                scal = [
+                    extras.task_pref_node[t].astype(i32).reshape(1, 1),
+                    jnp.maximum(tasks.template[t], 0)
+                    .astype(i32).reshape(1, 1),
+                    extras.task_or_group[t].astype(i32).reshape(1, 1),
+                    extras.task_volume_node[t].astype(i32).reshape(1, 1),
+                    extras.task_volume_ok[t].astype(i32).reshape(1, 1),
+                    extras.task_revocable[t].astype(i32).reshape(1, 1),
+                    (ji == extras.target_job).astype(i32).reshape(1, 1),
+                ]
+                args = [tasks.resreq[t][:, None]]
+                if cfg.enable_gpu:
+                    args.append(tasks.gpu_request[t]
+                                .astype(jnp.float32).reshape(1, 1))
+                args += scal + env_sh
+                args += [idle, pipe_extra, pods_extra]
+                if cfg.enable_gpu:
+                    args.append(gpu_extra)
+                (sc_n, ix_n, fn_n, tie_n,
+                 sc_f, ix_f, fn_f, tie_f) = _cand_sm(*args)
+                n_now, found_now, raw_now = _combine(sc_n, ix_n,
+                                                     fn_n, tie_n)
+                n_fut, found_fut, raw_fut = _combine(sc_f, ix_f,
+                                                     fn_f, tie_f)
+                return (n_now, found_now, raw_now,
+                        n_fut, found_fut, raw_fut)
 
         if dyn:
             # ---- static per-job inputs of the dynamic-key kernel ---------
@@ -1286,67 +1430,83 @@ def make_allocate_cycle(cfg: AllocateConfig):
                 sel = tasks.selector[t]
                 th, te, tm = tasks.tol_hash[t], tasks.tol_effect[t], tasks.tol_mode[t]
 
-                future = jnp.maximum(
-                    idle + nodes.releasing - nodes.pipelined - pipe_extra, 0.0)
-                # tdm: active-window revocable nodes only admit tasks with a
-                # revocable zone; inactive-window revocable nodes admit
-                # nothing new (tdm.go:149-167); reservation: locked nodes
-                # only admit the elected target job (reserve.go:43-77).
-                node_ok = (~(extras.block_nonrevocable
-                             & ~extras.task_revocable[t])
-                           & ~extras.block_all
-                           & or_ok_row(t)
-                           # volume-binding seam (cache.go:240-272)
-                           & extras.task_volume_ok[t]
-                           & ((extras.task_volume_node[t] < 0)
-                              | (jnp.arange(N, dtype=jnp.int32)
-                                 == extras.task_volume_node[t]))
-                           & (~extras.node_locked | (ji == extras.target_job))
-                           & tmpl_static[tasks.template[t]])
-                if cfg.enable_host_ports:
-                    # k8s NodePorts filter: conflicts against resident pods
-                    # (static) and this cycle's placements (pe_* state)
-                    tp = extras.task_ports[t]                    # [HP]
-                    act_p = tp > 0
-                    stat_conf = jnp.any(
-                        (extras.node_ports[:, :, None] == tp[None, None, :])
-                        & act_p[None, None, :]
-                        & (extras.node_ports > 0)[:, :, None], axis=(1, 2))
-                    km = jnp.any((pe_port[:, None] == tp[None, :])
-                                 & act_p[None, :], axis=1) & (pe_node >= 0)
-                    dyn_conf = jnp.zeros(N, bool).at[
-                        jnp.where(km, pe_node, N)].max(km, mode="drop")
-                    node_ok &= ~(stat_conf | dyn_conf)
-                # shared (capacity-view-independent) terms computed once, the
-                # idle/future resource fit fused into one stacked comparison
-                shared = node_ok & P.pod_count_fit(nodes, pods_extra)
-                shared &= P.gpu_fit(gpu_req, nodes, gpu_extra)
-                fit2 = jnp.all(
-                    resreq[None, None, :]
-                    <= jnp.stack([idle, future]) + 1e-5, axis=-1)
-                feas_now = shared & fit2[0]
-                feas_fut = shared & fit2[1]
-                score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
-                # static per-task extras in ONE addition so the pallas path
-                # can reproduce the exact f32 association: NodeAffinity
-                # preferred terms (nodeorder.go:255-266) + tdm's revocable
-                # steering bonus (tdm.go:170-191)
-                score += (extras.template_na_score[tasks.template[t]]
-                          + jnp.where(extras.task_revocable[t],
-                                      extras.tdm_bonus, 0.0))
-                # task-topology bucket preference (topology.go:344)
-                score += S.node_preference_score(extras.task_pref_node[t],
-                                                 score.shape[0])
-                if cfg.enable_pod_affinity:
-                    aff_feas, aff_score = _affinity_terms(
-                        extras.affinity, aff_cnt, anti_cnt, t,
-                        nodes.valid & nodes.schedulable)
-                    feas_now &= aff_feas
-                    feas_fut &= aff_feas
-                    score += cfg.pod_affinity_weight * aff_score
+                if shard_pl:
+                    # sharded pallas: feasibility -> score -> argmax runs
+                    # shard-local in the candidate kernel; only the
+                    # combined winner returns here. Commits below stay in
+                    # replicated XLA, bit-identical to the plain scan.
+                    (n_now, found_now, tie_raw_now,
+                     n_fut, found_fut, tie_raw_fut) = shard_candidates(
+                         t, ji, idle, pipe_extra, pods_extra, gpu_extra)
+                else:
+                    future = jnp.maximum(
+                        idle + nodes.releasing - nodes.pipelined
+                        - pipe_extra, 0.0)
+                    # tdm: active-window revocable nodes only admit tasks
+                    # with a revocable zone; inactive-window revocable
+                    # nodes admit nothing new (tdm.go:149-167);
+                    # reservation: locked nodes only admit the elected
+                    # target job (reserve.go:43-77).
+                    node_ok = (~(extras.block_nonrevocable
+                                 & ~extras.task_revocable[t])
+                               & ~extras.block_all
+                               & or_ok_row(t)
+                               # volume-binding seam (cache.go:240-272)
+                               & extras.task_volume_ok[t]
+                               & ((extras.task_volume_node[t] < 0)
+                                  | (jnp.arange(N, dtype=jnp.int32)
+                                     == extras.task_volume_node[t]))
+                               & (~extras.node_locked
+                                  | (ji == extras.target_job))
+                               & tmpl_static[tasks.template[t]])
+                    if cfg.enable_host_ports:
+                        # k8s NodePorts filter: conflicts against resident
+                        # pods (static) and this cycle's placements (pe_*)
+                        tp = extras.task_ports[t]                    # [HP]
+                        act_p = tp > 0
+                        stat_conf = jnp.any(
+                            (extras.node_ports[:, :, None]
+                             == tp[None, None, :])
+                            & act_p[None, None, :]
+                            & (extras.node_ports > 0)[:, :, None],
+                            axis=(1, 2))
+                        km = jnp.any((pe_port[:, None] == tp[None, :])
+                                     & act_p[None, :], axis=1) \
+                            & (pe_node >= 0)
+                        dyn_conf = jnp.zeros(N, bool).at[
+                            jnp.where(km, pe_node, N)].max(km, mode="drop")
+                        node_ok &= ~(stat_conf | dyn_conf)
+                    # shared (capacity-view-independent) terms computed
+                    # once, the idle/future resource fit fused into one
+                    # stacked comparison
+                    shared = node_ok & P.pod_count_fit(nodes, pods_extra)
+                    shared &= P.gpu_fit(gpu_req, nodes, gpu_extra)
+                    fit2 = jnp.all(
+                        resreq[None, None, :]
+                        <= jnp.stack([idle, future]) + 1e-5, axis=-1)
+                    feas_now = shared & fit2[0]
+                    feas_fut = shared & fit2[1]
+                    score = _score_fn(cfg, snap, resreq, idle, th, te, tm)
+                    # static per-task extras in ONE addition so the pallas
+                    # path can reproduce the exact f32 association:
+                    # NodeAffinity preferred terms (nodeorder.go:255-266)
+                    # + tdm's revocable steering bonus (tdm.go:170-191)
+                    score += (extras.template_na_score[tasks.template[t]]
+                              + jnp.where(extras.task_revocable[t],
+                                          extras.tdm_bonus, 0.0))
+                    # task-topology bucket preference (topology.go:344)
+                    score += S.node_preference_score(
+                        extras.task_pref_node[t], score.shape[0])
+                    if cfg.enable_pod_affinity:
+                        aff_feas, aff_score = _affinity_terms(
+                            extras.affinity, aff_cnt, anti_cnt, t,
+                            nodes.valid & nodes.schedulable)
+                        feas_now &= aff_feas
+                        feas_fut &= aff_feas
+                        score += cfg.pod_affinity_weight * aff_score
 
-                n_now, found_now = best_node(score, feas_now)
-                n_fut, found_fut = best_node(score, feas_fut)
+                    n_now, found_now = best_node(score, feas_now)
+                    n_fut, found_fut = best_node(score, feas_fut)
                 can_now = found_now & active
                 can_fut = found_fut & active & jnp.bool_(cfg.enable_pipelining)
 
@@ -1365,6 +1525,16 @@ def make_allocate_cycle(cfg: AllocateConfig):
                     from .select import tie_count
                     acti = jnp.where(active, jnp.int32(1), jnp.int32(0))
                     live = node_live
+                    if shard_pl:
+                        # the decision path skipped the global fit masks;
+                        # rebuild them here only for the counters (the
+                        # telemetry=False trace carries none of this)
+                        future = jnp.maximum(
+                            idle + nodes.releasing - nodes.pipelined
+                            - pipe_extra, 0.0)
+                        fit2 = jnp.all(
+                            resreq[None, None, :]
+                            <= jnp.stack([idle, future]) + 1e-5, axis=-1)
                     tmpl_row = tmpl_static[tasks.template[t]]
                     blk_row = ((extras.block_nonrevocable
                                 & ~extras.task_revocable[t])
@@ -1399,10 +1569,20 @@ def make_allocate_cycle(cfg: AllocateConfig):
                         P.rejection_count(live, fit2[1]),
                         aff_rej,
                     ])
-                    ties = jnp.where(
-                        do_alloc, tie_count(score, feas_now),
-                        jnp.where(do_pipe, tie_count(score, feas_fut),
-                                  jnp.int32(0)))
+                    if shard_pl:
+                        # raw per-shard counts summed at the global max;
+                        # tie_count's max(n - 1, 0) applied here
+                        ties = jnp.where(
+                            do_alloc,
+                            jnp.maximum(tie_raw_now - 1, 0),
+                            jnp.where(do_pipe,
+                                      jnp.maximum(tie_raw_fut - 1, 0),
+                                      jnp.int32(0)))
+                    else:
+                        ties = jnp.where(
+                            do_alloc, tie_count(score, feas_now),
+                            jnp.where(do_pipe, tie_count(score, feas_fut),
+                                      jnp.int32(0)))
                     tel = (tel[0] + rej * acti,
                            tel[1] + acti,
                            tel[2] + jnp.where(do_alloc, jnp.int32(1),

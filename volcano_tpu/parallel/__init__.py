@@ -1,11 +1,14 @@
 """Device-mesh parallelism for the scheduling cycle."""
 
+from .distributed import (host_shard_range, initialize_distributed,
+                          mask_foreign_shards)
 from .sharding import (make_sharded_allocate, make_sharded_delta,
                        make_sharded_preempt, mesh_for_nodes, node_leaf_mask,
                        node_sharding_specs, scheduler_mesh,
                        sharded_delta_allocate_cached)
 
-__all__ = ["make_sharded_allocate", "make_sharded_delta",
-           "make_sharded_preempt", "mesh_for_nodes", "node_leaf_mask",
-           "node_sharding_specs", "scheduler_mesh",
+__all__ = ["host_shard_range", "initialize_distributed",
+           "mask_foreign_shards", "make_sharded_allocate",
+           "make_sharded_delta", "make_sharded_preempt", "mesh_for_nodes",
+           "node_leaf_mask", "node_sharding_specs", "scheduler_mesh",
            "sharded_delta_allocate_cached"]

@@ -8,7 +8,12 @@ once per requested device count on the node-axis sharded backend
 - ``decisions_equal_unsharded`` — the sha over every cycle's decision
   digest must match the unsharded run bit-for-bit,
 - ``resharding_copies`` — the live transfer-counter probe's total over
-  the steady cycles; the zero-copy out==in contract means 0.
+  the steady cycles; the zero-copy out==in contract means 0,
+- ``pallas`` — the same sharded workload with the shard-local pallas
+  candidate kernel in interpret mode (ISSUE 14): steady p50 next to the
+  scan column plus its own ``decisions_equal`` identity gate,
+- ``scaling_efficiency`` — p50(1dev) / (D * p50(Ddev)) on the sharded
+  scan runs; 1.0 is perfect strong scaling.
 
 bench.py shells out to this module (fail-soft, BENCH_SKIP_MULTICHIP=1
 skips) so a GSPMD-poisoned compile can never take the bench record down
@@ -88,7 +93,35 @@ tiers:
                          base, cycles, pipeline)
         r["decisions_equal_unsharded"] = (
             r.pop("decisions_sha") == oracle["decisions_sha"])
+        # the shard-local pallas leg (ISSUE 14): same sharded workload
+        # with the candidate kernel in interpret mode — identity is the
+        # gate, p50 the comparison column. Fail-soft per leg: a pallas
+        # harness failure must not take the scan columns down with it.
+        try:
+            p = _run_variant(
+                f"sharding: true\nsharding_devices: {d}\n"
+                f"use_pallas: interpret\n" + body, base, cycles, pipeline)
+            r["pallas"] = {
+                "steady_p50_ms": p["steady_p50_ms"],
+                "decisions_equal": (p["decisions_sha"]
+                                    == oracle["decisions_sha"]),
+                "resharding_copies": p["resharding_copies"],
+            }
+        except Exception as e:
+            print(f"multichip pallas leg failed at {d} devices: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            r["pallas"] = {"error": f"{type(e).__name__}: {e}"}
         per_device[str(d)] = r
+    # strong-scaling efficiency of the sharded scan p50 relative to the
+    # 1-device sharded run: p50(1) / (D * p50(D)); 1.0 = perfect
+    base_p50 = per_device.get("1", {}).get("steady_p50_ms")
+    for d in device_counts:
+        rec = per_device.get(str(d), {})
+        p50 = rec.get("steady_p50_ms")
+        if base_p50 and p50:
+            rec["scaling_efficiency"] = round(base_p50 / (d * p50), 3)
+        elif "skipped" not in rec:
+            rec["scaling_efficiency"] = None
     return {
         "cycles": cycles,
         "n_nodes": n_nodes,
@@ -122,6 +155,8 @@ def main(argv=None) -> int:
     print(json.dumps(report, indent=2))
     ok = all(r.get("decisions_equal_unsharded", True)
              and r.get("resharding_copies", 0) == 0
+             and r.get("pallas", {}).get("decisions_equal", True)
+             is not False
              for r in report["per_device_count"].values())
     if not ok:
         print("multichip probe FAILED: sharded decisions diverged or "

@@ -1,0 +1,136 @@
+"""Multi-host mesh groundwork (pod-slice scale-out, ISSUE 14).
+
+Single-process CPU meshes exercise every sharded code path today; this
+module adds the two pieces a REAL pod slice needs, shaped so the driver
+is the only missing part:
+
+- :func:`initialize_distributed` — the ``jax.distributed.initialize``
+  entry point, conf (``mesh_hosts``) / env driven, and a strict no-op in
+  a single-process run: nothing in the single-host paths changes by
+  importing or calling it. It never raises on missing coordination env;
+  it reports what it did (or why it didn't) in its summary dict so the
+  runtime can log it.
+- per-host delta routing — :func:`host_shard_range` and
+  :func:`mask_foreign_shards` layer on the existing (D, B) shard-routed
+  upload (ops/fused_io.ShardedDeltaKernel._route): each process keeps
+  ONLY its own hosts' shard rows as real updates and rewrites every
+  foreign row to the router's drop encoding (the positive out-of-bounds
+  index drop-mode discards), so no host materializes another host's
+  delta content. The union of all hosts' masked uploads applies exactly
+  the full routed delta — the unit tests in tests/test_distributed.py
+  prove this equivalence.
+
+Environment contract (all optional; absent -> single-process no-op):
+
+- ``VOLCANO_MESH_HOSTS``       number of host processes (conf
+  ``mesh_hosts`` wins when both are set)
+- ``VOLCANO_COORDINATOR``      ``host:port`` of process 0
+- ``VOLCANO_PROCESS_ID``       this process's rank in [0, n_hosts)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["initialize_distributed", "host_shard_range",
+           "mask_foreign_shards"]
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def initialize_distributed(conf=None) -> dict:
+    """Initialize JAX multi-process coordination when (and only when)
+    the run is actually multi-host.
+
+    ``conf`` is a SchedulerConfiguration (or anything with a
+    ``mesh_hosts`` attribute) — ``mesh_hosts`` > 1 plus the coordinator
+    env vars select the multi-process path; everything else is a no-op.
+    Never raises on missing/partial configuration: the summary dict's
+    ``reason`` says why initialization was skipped, and the runtime
+    keeps its single-process behavior bit-for-bit.
+
+    Returns ``{"initialized", "n_hosts", "process_id", "reason"}``.
+    """
+    n_hosts = getattr(conf, "mesh_hosts", None) if conf is not None else None
+    if n_hosts is None:
+        n_hosts = _env_int("VOLCANO_MESH_HOSTS")
+    n_hosts = int(n_hosts) if n_hosts else 1
+    summary = {"initialized": False, "n_hosts": n_hosts, "process_id": 0,
+               "reason": ""}
+    if n_hosts <= 1:
+        summary["reason"] = "single-process (mesh_hosts <= 1)"
+        return summary
+    coordinator = os.environ.get("VOLCANO_COORDINATOR")
+    process_id = _env_int("VOLCANO_PROCESS_ID")
+    if not coordinator or process_id is None:
+        summary["reason"] = ("mesh_hosts > 1 but VOLCANO_COORDINATOR / "
+                             "VOLCANO_PROCESS_ID are not set; staying "
+                             "single-process")
+        return summary
+    import jax
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=n_hosts,
+                                   process_id=process_id)
+    except Exception as e:  # already-initialized or backend refusal:
+        # fail soft, the single-process paths stay fully functional
+        summary["reason"] = f"jax.distributed.initialize failed: {e}"
+        return summary
+    summary.update(initialized=True, process_id=process_id,
+                   reason="jax.distributed.initialize ok")
+    return summary
+
+
+def host_shard_range(n_shards: int, n_hosts: int,
+                     host_id: int) -> Tuple[int, int]:
+    """Contiguous [lo, hi) shard rows owned by ``host_id``.
+
+    Shards split as evenly as possible with the remainder spread over
+    the leading hosts (the same contiguous-block rule a (hosts, local
+    devices) reshape of the 1-D node mesh produces, so shard ownership
+    matches device locality on a real slice). The union over hosts is
+    exactly [0, n_shards) with no overlap — asserted by the routing
+    equivalence tests."""
+    if not 0 <= host_id < n_hosts:
+        raise ValueError(f"host_id {host_id} outside [0, {n_hosts})")
+    base, rem = divmod(n_shards, n_hosts)
+    lo = host_id * base + min(host_id, rem)
+    hi = lo + base + (1 if host_id < rem else 0)
+    return lo, hi
+
+
+def mask_foreign_shards(pidx: np.ndarray, pvals: np.ndarray,
+                        rows_per: int, n_cols: int,
+                        lo: int, hi: int):
+    """Per-host view of a (D, B) shard-routed delta: rows in [lo, hi)
+    pass through untouched; every foreign row is rewritten to the
+    router's empty-shard drop encoding (``(s + 1) * rows_per * C``
+    rebases to the local out-of-bounds row, which the scatter's
+    drop-mode discards) with zero values.
+
+    This is the per-host upload contract: a process feeds its own rows
+    real content and ships inert rows for everyone else, so the full
+    (D, B) shape (and therefore the compiled entry) is identical on
+    every host while no host materializes foreign delta content."""
+    D, B = pidx.shape
+    out_idx = pidx.copy()
+    out_vals = pvals.copy()
+    if B == 0 or n_cols == 0:
+        return out_idx, out_vals
+    for s in range(D):
+        if lo <= s < hi:
+            continue
+        out_idx[s] = (s + 1) * rows_per * n_cols
+        out_vals[s] = 0
+    return out_idx, out_vals

@@ -11,7 +11,12 @@ full cluster is scored every cycle:
 - task/job/queue state is replicated (it is small relative to nodes);
 - per-task feasibility+scoring run device-local; the argmax and the capacity
   scatter are resolved by GSPMD-inserted collectives over ICI (an
-  all-reduce-argmax per placement, the collective analog of SelectBestNode).
+  all-reduce-argmax per placement, the collective analog of SelectBestNode);
+- with ``use_pallas`` requested the cycle composes both axes: each shard
+  launches the shard-local pallas candidate kernel over its own node rows
+  under shard_map, and the per-shard winners reduce through the same
+  in-graph argmax combine (allocate_scan's sharded-pallas path). Decisions
+  stay bit-identical either way.
 
 Shapes from arrays.pack follow the graded bucket grid (arrays/schema.bucket):
 powers of two up to 1024, multiples of 1024 above — so the node axis divides
@@ -104,16 +109,14 @@ def make_sharded_allocate(cfg: AllocateConfig, mesh: Mesh,
                           snap: SnapshotArrays):
     """jit the allocate cycle with the node axis sharded over ``mesh``.
 
-    Forces the pure-XLA scan path: GSPMD has no partitioning rule for the
-    pallas custom call, so letting use_pallas auto-enable here would at best
-    replicate the full node axis on every device (defeating the sharding)
-    and at worst fail to compile.
+    ``cfg.use_pallas`` is honored: passing the mesh into
+    make_allocate_cycle selects the sharded-pallas path (shard-local
+    candidate launches under shard_map, cross-shard argmax combine)
+    instead of a full-axis pallas_call GSPMD could not partition.
     """
-    import dataclasses
-    cfg = dataclasses.replace(cfg, use_pallas=False)
     snap_shardings, rep = node_sharding_specs(mesh, snap)
     extras_rep = None  # let GSPMD replicate extras by default
-    fn = make_allocate_cycle(cfg)
+    fn = make_allocate_cycle(cfg, mesh=mesh)
     return jax.jit(fn, in_shardings=(snap_shardings, extras_rep),
                    out_shardings=rep)
 
@@ -140,14 +143,13 @@ def make_sharded_delta(cfg: AllocateConfig, mesh: Mesh, tree,
     """ShardedDeltaKernel for the allocate cycle over ``mesh``: node-axis
     residents, routed deltas, per-shard digests, donation through pjit.
 
-    Forces the pure-XLA scan path for the same reason
-    :func:`make_sharded_allocate` does — GSPMD has no partitioning rule
-    for the pallas custom call, so use_pallas under sharding would at
-    best replicate the node axis and at worst fail to compile."""
+    ``cfg.use_pallas`` is honored the same way
+    :func:`make_sharded_allocate` does it — the mesh-aware cycle runs
+    shard-local pallas candidate launches, never a full-axis
+    pallas_call."""
     from ..ops.fused_io import ShardedDeltaKernel
-    cfg = dataclasses.replace(cfg, use_pallas=False)
-    return ShardedDeltaKernel(make_allocate_cycle(cfg), tree, mesh,
-                              node_leaf_mask(tree), entry=entry)
+    return ShardedDeltaKernel(make_allocate_cycle(cfg, mesh=mesh), tree,
+                              mesh, node_leaf_mask(tree), entry=entry)
 
 
 def sharded_delta_allocate_cached(cfg: AllocateConfig, tree, mesh,
@@ -155,7 +157,6 @@ def sharded_delta_allocate_cached(cfg: AllocateConfig, tree, mesh,
     """Shape+mesh-memoized :func:`make_sharded_delta` (the sharded analog
     of fused_io.delta_cycle_cached, same key construction)."""
     from ..ops.fused_io import sharded_delta_cycle_cached
-    cfg = dataclasses.replace(cfg, use_pallas=False)
-    return sharded_delta_cycle_cached(make_allocate_cycle(cfg), tree, mesh,
-                                      node_leaf_mask(tree), cache,
-                                      key_extra=cfg)
+    return sharded_delta_cycle_cached(make_allocate_cycle(cfg, mesh=mesh),
+                                      tree, mesh, node_leaf_mask(tree),
+                                      cache, key_extra=cfg)

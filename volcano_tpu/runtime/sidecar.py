@@ -283,20 +283,21 @@ class SchedulerSidecar:
                                        int(getattr(_sc, "pipeline_depth",
                                                    1) or 1))
         self.sharding = self.sharding and self.delta_uploads
-        self._cycle_sharded = None
+        self._cycle_sharded_factory = None
         if self.sharding:
-            # the sharded cycle variant forces the pure-XLA scan path:
-            # GSPMD has no partitioning rule for the pallas custom call
+            # mesh-parameterized cycle factory: the mesh is picked per
+            # shape bucket in _sharded_kernel, and the mesh-aware cycle
+            # honors use_pallas via the shard-local candidate launch
+            # (allocate_scan's sharded-pallas path) — no force-disable
             if conf is not None:
                 from ..framework.compiled_session import make_conf_cycle \
                     as _mcc
-                self._cycle_sharded = _mcc(
-                    conf, cfg_overrides={"use_pallas": False})
+                self._cycle_sharded_factory = (
+                    lambda mesh: _mcc(conf, mesh=mesh))
             else:
-                import dataclasses as _dc
                 from ..ops.allocate_scan import make_allocate_cycle as _mac
-                self._cycle_sharded = _mac(
-                    _dc.replace(self.cfg, use_pallas=False))
+                self._cycle_sharded_factory = (
+                    lambda mesh: _mac(self.cfg, mesh=mesh))
         #: shape+mesh signature -> ShardedDeltaKernel (same residency and
         #: invalidation contract as _delta, per-shard residents)
         self._sharded_delta: Dict[tuple, object] = {}
@@ -467,9 +468,9 @@ class SchedulerSidecar:
         from ..parallel.sharding import mesh_for_nodes, node_leaf_mask
         n_nodes = int(np.asarray(tree_in[0].nodes.valid).shape[0])
         mesh = mesh_for_nodes(n_nodes, self._sharding_devices)
-        return sharded_delta_cycle_cached(self._cycle_sharded, tree_in,
-                                          mesh, node_leaf_mask(tree_in),
-                                          self._sharded_delta)
+        return sharded_delta_cycle_cached(
+            self._cycle_sharded_factory(mesh), tree_in, mesh,
+            node_leaf_mask(tree_in), self._sharded_delta)
 
     def _dispatch_cycle(self, tree_in):
         """Dispatch the compiled cycle over the fused tree WITHOUT reading
