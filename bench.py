@@ -44,7 +44,8 @@ its horizon, default 16), BENCH_SKIP_RESTART=1 (skip the crash-consistent
 checkpoint/restore restart block), BENCH_SKIP_FAILOVER=1 (skip the
 warm-standby HA failover block), BENCH_SKIP_FLEET=1 (skip the
 multi-tenant fleet serving block; BENCH_FLEET_TENANTS / BENCH_FLEET_CYCLES
-size it).
+size it), BENCH_SKIP_WAVEFRONT=1 (skip the wavefront width sweep;
+BENCH_WAVE_NODES / BENCH_WAVE_JOBS size its churn workload).
 """
 
 from __future__ import annotations
@@ -168,6 +169,20 @@ def _time_device(cycle_fn, snap, extras, reps):
     return result, min(times) * 1000, compile_s
 
 
+def _emit_child_stderr(tag, text):
+    """Re-emit a child process's captured stderr on the bench's stderr,
+    dropping XLA/absl host-backend boilerplate (the CPU-features warning
+    class) so the captured bench tail — the parsed-extra JSON line — stays
+    machine-readable while real child diagnostics still surface."""
+    drop = ("cpu_feature_guard", "oneDNN", "TfrtCpuClient",
+            "absl::InitializeLog", "computation_placer",
+            "CPU Frequency:", "external/local_xla")
+    for line in (text or "").splitlines():
+        s = line.strip()
+        if s and not any(m in s for m in drop):
+            print("bench[%s]: %s" % (tag, s), file=sys.stderr)
+
+
 def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None,
                       steady_total_ms=None):
     """Compare this run's steady-loop and sub-scale kernel timings — and,
@@ -221,7 +236,12 @@ def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None,
                 ("fleet_cycle_ms_p99",
                  quality.get("fleet_cycle_ms_p99"), False, None),
                 ("fleet_tenants_per_s",
-                 quality.get("fleet_tenants_per_s"), True, None)):
+                 quality.get("fleet_tenants_per_s"), True, None),
+                # wavefront win at the best width: higher is better, so
+                # the ratio is inverted (a future change that erodes the
+                # batched-sweep speedup trips the guard)
+                ("wavefront_speedup",
+                 quality.get("wavefront_speedup"), True, None)):
             base = parsed.get(key)
             if cur is None or not base or (invert and not cur):
                 continue
@@ -1096,6 +1116,7 @@ tiers:
                 cwd=os.path.dirname(os.path.abspath(__file__)),
                 timeout=float(os.environ.get("BENCH_MULTICHIP_TIMEOUT",
                                              600)), env=menv)
+            _emit_child_stderr("multichip", proc.stderr)
             if proc.returncode in (0, 1):
                 multichip_block = json.loads(proc.stdout)
                 multichip_block["clean"] = proc.returncode == 0
@@ -1124,6 +1145,7 @@ tiers:
                 cwd=os.path.dirname(os.path.abspath(__file__)),
                 timeout=float(os.environ.get("BENCH_GRAPHCHECK_TIMEOUT",
                                              300)), env=genv)
+            _emit_child_stderr("graphcheck", proc.stderr)
             if proc.returncode in (0, 1):
                 with open(rpt) as f:
                     grpt = json.load(f)
@@ -1275,6 +1297,85 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             fleet_block = None
 
+    # ---- wavefront placement block (ISSUE 16) ----------------------------
+    # W tasks per device sweep with the order-preserving in-graph conflict
+    # commit: steady cycle time at W in {1, 4, 8, 16} on the churn
+    # workload under spread scoring (least_allocated + balanced — binpack
+    # funnels every slot onto one node and collapses the wave), with
+    # decision-sha equality vs the W=1 sequential sweep at EVERY width
+    # (the tentpole claim, re-proved where it is priced), the telemetry
+    # wave_commit_ratio at the winning width, and the winning width's
+    # speedup fed into the regression guard below so a change that erodes
+    # the batched-sweep win shows in the trajectory.
+    # BENCH_SKIP_WAVEFRONT=1 skips; a failure records null.
+    wavefront_block = None
+    if not os.environ.get("BENCH_SKIP_WAVEFRONT"):
+        try:
+            import dataclasses as _dcw
+            import hashlib as _hlw
+            from volcano_tpu.ops.fused_io import make_fused_cycle as _mfcw
+            wsnap, wextras, wcfg0 = _build(
+                int(os.environ.get("BENCH_WAVE_NODES", 2048)),
+                int(os.environ.get("BENCH_WAVE_JOBS", 1280)),
+                tasks_per_job,
+                dict(cfg_kwargs, binpack_weight=0.0,
+                     least_allocated_weight=1.0, balanced_weight=1.0))
+            widths = {}
+            ref_sha = None
+            sha_equal = True
+            for ww in (1, 4, 8, 16):
+                wcyc = make_allocate_cycle(
+                    _dcw.replace(wcfg0, wave_width=ww))
+                wfnp, wfuse = _mfcw(wcyc, (wsnap, wextras))
+                wpd = np.asarray(wfnp(*wfuse((wsnap, wextras))))  # compile
+                wts = []
+                for _ in range(max(3, min(reps, 5))):
+                    t0 = time.time()
+                    np.asarray(wfnp(*wfuse((wsnap, wextras))))
+                    wts.append((time.time() - t0) * 1000)
+                wts.sort()
+                # the packed readback IS the decision block (telemetry
+                # off), so its bytes are the decision fingerprint
+                wsha = _hlw.sha256(wpd.tobytes()).hexdigest()[:16]
+                if ww == 1:
+                    ref_sha = wsha
+                elif wsha != ref_sha:
+                    sha_equal = False
+                widths[ww] = {"cycle_ms": round(wts[0], 1),
+                              "cycle_ms_p50": round(
+                                  wts[len(wts) // 2], 1),
+                              "decisions_sha256": wsha}
+            best_w = min((w for w in widths if w != 1),
+                         key=lambda w: widths[w]["cycle_ms"])
+            wave_speedup = round(
+                widths[1]["cycle_ms"] / widths[best_w]["cycle_ms"], 2)
+            # commit ratio at the winning width from a telemetry build on
+            # the same snapshot (counters are oracle-pinned at sub-scale
+            # by tests/test_wavefront.py; here they price the workload)
+            from volcano_tpu.telemetry import (
+                unpack_cycle_telemetry as _uctw)
+            wtres = jax.jit(make_allocate_cycle(_dcw.replace(
+                wcfg0, wave_width=best_w, telemetry=True)))(wsnap, wextras)
+            wtel = _uctw(np.asarray(wtres.telemetry.packed()),
+                         int(np.asarray(wsnap.nodes.idle).shape[1]))
+            wcommits = int(wtel["wave_commits"])
+            wreplays = int(wtel["wave_replays"])
+            wavefront_block = {
+                "widths": {str(k): v for k, v in widths.items()},
+                "best_width": best_w,
+                "speedup_vs_sequential": wave_speedup,
+                "decisions_sha_equal_all_widths": sha_equal,
+                "wave_commit_ratio": round(
+                    wcommits / max(wcommits + wreplays, 1), 4),
+                "wave_truncations": int(wtel["wave_truncations"]),
+                "wave_replays": wreplays,
+                "waves": int(wtel["waves"]),
+            }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: wavefront block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            wavefront_block = None
+
     # ---- perf regression guard vs the last same-backend BENCH record -----
     regression_block = None
     if not os.environ.get("BENCH_SKIP_REGRESSION"):
@@ -1294,6 +1395,8 @@ tiers:
                         (fleet_block or {}).get("cycle_ms_p99"),
                     "fleet_tenants_per_s":
                         (fleet_block or {}).get("tenants_per_s_at_p99"),
+                    "wavefront_speedup":
+                        (wavefront_block or {}).get("speedup_vs_sequential"),
                 })
         except Exception as e:  # noqa: BLE001 — fail-soft contract
             print("bench: regression guard failed: %s: %s"
@@ -1315,6 +1418,7 @@ tiers:
         "latency_breakdown": latency_block,
         "scenarios": scenario_block,
         "fleet": fleet_block,
+        "wavefront": wavefront_block,
         "regression": regression_block,
     }
     if force_cpu:
@@ -1430,6 +1534,15 @@ tiers:
         "fleet_tenants_per_s":
             (fleet_block or {}).get("tenants_per_s_at_p99"),
         "fleet_buckets": (fleet_block or {}).get("buckets"),
+        # wavefront numbers in the parsed block: the winning width's
+        # speedup is the regression-guard baseline for future runs
+        "wavefront_speedup":
+            (wavefront_block or {}).get("speedup_vs_sequential"),
+        "wavefront_best_width": (wavefront_block or {}).get("best_width"),
+        "wavefront_sha_equal_all_widths":
+            (wavefront_block or {}).get("decisions_sha_equal_all_widths"),
+        "wave_commit_ratio":
+            (wavefront_block or {}).get("wave_commit_ratio"),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))

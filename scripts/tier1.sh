@@ -63,6 +63,11 @@ if [ "${TIER1_SKIP_CHAOS:-0}" != "1" ]; then
         XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
         python -m volcano_tpu.chaos --smoke --sharded --pallas-interpret \
         || crc=$?
+    # and on the wavefront placement path (ISSUE 16, wave_width > 1):
+    # faults land mid-wave, the digest still trips, and the
+    # order-preserving commit rule keeps decisions equal to the clean run
+    env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --wave 4 \
+        || crc=$?
 fi
 src=0
 if [ "${TIER1_SKIP_SPEC:-0}" != "1" ]; then

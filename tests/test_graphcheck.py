@@ -22,7 +22,7 @@ import pytest
 from volcano_tpu.analysis import apply_allowlist, report_sha, run_graphcheck
 from volcano_tpu.analysis.entrypoints import EntryTrace
 from volcano_tpu.analysis.jaxpr_audit import (check_dtype, check_gather,
-                                              check_purity)
+                                              check_purity, check_wavefront)
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -145,6 +145,69 @@ class TestPlantedViolations:
         """))
         findings = scan_file(str(mod), "splat.py")
         assert findings and "dict" in findings[0].key
+
+
+class TestWavefrontFamily:
+    """Family 4 (ISSUE 16): the (W, N) sweep discipline of wave entries.
+    A planted (W, task, N) re-materialization must fire; the proper
+    gathered-rows sweep and non-wave entries must not. The real wave
+    entries stay green via the fast_report fixture (allocate/wave4 is in
+    the fast trace set; wave16 in the full CLI set)."""
+
+    def _wave_cfg(self, w):
+        from volcano_tpu.ops.allocate_scan import AllocateConfig
+        return AllocateConfig(wave_width=w)
+
+    def test_fires_on_planted_wtn_materialization(self):
+        W, T, N = 4, 5, 7
+
+        def regress(req, cap):
+            # the violation class: every wave slot re-broadcasts the FULL
+            # task table against the node axis instead of gathering its
+            # own W candidate rows first
+            fit = req[None, :, None] <= cap[None, None, :]
+            return jnp.sum(jnp.broadcast_to(fit, (W, T, N)), axis=(1, 2))
+
+        findings = check_wavefront(_trace(
+            regress, np.ones(T, np.float32), np.ones(N, np.float32),
+            dims={"N": N, "task_dims": {T}}, cfg=self._wave_cfg(W)))
+        assert findings and str((W, T, N)) in findings[0].what
+
+    def test_clean_on_gathered_wn_sweep(self):
+        W, T, N = 4, 5, 7
+
+        def ok(req, cap):
+            rows = req[:W]                      # gather the wave's rows
+            return jnp.sum(rows[:, None] <= cap[None, :], axis=1)
+
+        assert check_wavefront(_trace(
+            ok, np.ones(T, np.float32), np.ones(N, np.float32),
+            dims={"N": N, "task_dims": {T}}, cfg=self._wave_cfg(W))) == []
+
+    def test_skips_non_wave_entries(self):
+        # the identical planted violation with wave_width=1 (or no cfg at
+        # all) is the plain gather family's business, not this one's
+        W, T, N = 4, 5, 7
+
+        def regress(req, cap):
+            fit = req[None, :, None] <= cap[None, None, :]
+            return jnp.sum(jnp.broadcast_to(fit, (W, T, N)), axis=(1, 2))
+
+        args = (np.ones(T, np.float32), np.ones(N, np.float32))
+        dims = {"N": N, "task_dims": {T}}
+        assert check_wavefront(_trace(regress, *args, dims=dims,
+                                      cfg=self._wave_cfg(1))) == []
+        assert check_wavefront(_trace(regress, *args, dims=dims)) == []
+
+    def test_wave_entry_in_trace_set(self, graph_traces):
+        names = [t.name for t in graph_traces]
+        assert "allocate/wave4" in names
+        tr = next(t for t in graph_traces if t.name == "allocate/wave4")
+        assert tr.cfg is not None and tr.cfg.wave_width == 4
+
+    def test_family_registered(self):
+        from volcano_tpu.analysis import FAMILIES
+        assert "wavefront" in FAMILIES
 
 
 class TestTelemetryFamily:

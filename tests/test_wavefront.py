@@ -1,0 +1,230 @@
+"""Wavefront task placement (ISSUE 16 acceptance).
+
+The tentpole claim is strict decision equivalence: with ``wave_width`` W,
+each scan iteration evaluates the next W eligible tasks against the SAME
+capacity snapshot in one batched (W, N) sweep, then commits in-graph in
+strict task order — the first conflicting task truncates the wave and
+replays — so the committed decisions are bit-identical to the W=1
+sequential sweep at EVERY width, on every execution path:
+
+- plain scan (fast, with the CPU oracle reproducing the wave telemetry
+  counters exactly),
+- the fused pallas paths (W clamps to 1 — byte-identical program),
+- the 2-device node-sharded pallas-interpret path (slow),
+- the depth-k speculative pipeline with mid-flight arrivals (slow),
+- the fleet-batched multi-tenant dispatch (slow).
+
+Plus the non-vacuity leg: a planted same-node-contention fixture where
+W=16 provably truncates and replays (W=8 stays conflict-free — the
+candidate depth covers the contention), so the commit rule is exercised,
+not just traced.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from volcano_tpu.arrays import pack
+from volcano_tpu.ops import AllocateConfig, make_allocate_cycle
+from volcano_tpu.ops.allocate_scan import (AllocateExtras, normalize_wave,
+                                           wave_candidate_depth)
+from volcano_tpu.runtime.cpu_reference import allocate_cpu
+from volcano_tpu.telemetry.cycle import unpack_cycle_telemetry
+
+from fixtures import build_job, build_task, make_cluster, simple_cluster
+
+WIDTHS = (4, 16)
+
+
+def _decisions(r):
+    return (np.asarray(r.task_node).tolist(),
+            np.asarray(r.task_mode).tolist(),
+            np.asarray(r.task_gpu).tolist(),
+            np.asarray(r.job_ready).tolist(),
+            np.asarray(r.job_pipelined).tolist())
+
+
+def _kernel_tel(r, snap):
+    """Unpack the CycleTelemetry block from the packed readback."""
+    T = np.asarray(snap.tasks.resreq).shape[0]
+    J = np.asarray(snap.jobs.task_table).shape[0]
+    R = np.asarray(snap.nodes.idle).shape[1]
+    return unpack_cycle_telemetry(
+        np.asarray(r.packed_decisions())[3 * T + 3 * J:], R)
+
+
+def _run_widths(ci, base, widths=WIDTHS):
+    """Run W=1 and each wave width on one snapshot; assert decisions
+    equal W=1 and kernel telemetry == CPU-oracle telemetry at each W.
+    Returns {W: kernel telemetry dict} for width-specific claims."""
+    snap, _ = pack(ci)
+    extras = AllocateExtras.neutral(snap)
+    ref = _decisions(jax.jit(make_allocate_cycle(base))(snap, extras))
+    tels = {}
+    for w in widths:
+        cfg = dataclasses.replace(base, wave_width=w)
+        rw = jax.jit(make_allocate_cycle(cfg))(snap, extras)
+        assert _decisions(rw) == ref, f"W={w} diverged from sequential"
+        cpu = allocate_cpu(snap, extras, cfg, collect_telemetry=True)
+        assert np.array_equal(cpu["task_node"], ref[0]), f"W={w} oracle"
+        assert np.array_equal(cpu["task_mode"], ref[1]), f"W={w} oracle"
+        ktel = _kernel_tel(rw, snap)
+        assert ktel == cpu["telemetry"], (
+            f"W={w} counter drift: "
+            + str({k: (v, cpu['telemetry'].get(k))
+                   for k, v in ktel.items()
+                   if v != cpu['telemetry'].get(k)}))
+        tels[w] = ktel
+    return tels
+
+
+class TestScanShaIdentity:
+    """Fast legs: the plain-scan path, oracle-checked at every width."""
+
+    def test_mixed_cluster_identity_and_oracle(self):
+        tels = _run_widths(make_cluster(),
+                           AllocateConfig(telemetry=True))
+        for w in WIDTHS:
+            assert tels[w]["waves"] > 0
+            assert tels[w]["wave_commits"] == sum(
+                i * n for i, n in enumerate(tels[w]["wave_hist"]))
+
+    def test_pallas_fused_clamps_to_sequential(self):
+        """The fused pallas paths force W=1 (normalize happens inside the
+        cycle builder): wave_width on a pallas conf is decision-inert."""
+        snap, _ = pack(make_cluster())
+        extras = AllocateExtras.neutral(snap)
+        base = AllocateConfig(use_pallas="interpret")
+        ref = _decisions(jax.jit(make_allocate_cycle(base))(snap, extras))
+        wide = dataclasses.replace(base, wave_width=4)
+        assert _decisions(
+            jax.jit(make_allocate_cycle(wide))(snap, extras)) == ref
+
+    def test_normalize_wave_authority(self):
+        assert normalize_wave(AllocateConfig(wave_width=0)).wave_width == 1
+        assert normalize_wave(AllocateConfig(wave_width=8)).wave_width == 8
+        # the serialized-predicate paths opt out: pod affinity and host
+        # ports both consume per-commit state the window sweep can't see
+        assert normalize_wave(AllocateConfig(
+            wave_width=8, enable_pod_affinity=True)).wave_width == 1
+        assert normalize_wave(AllocateConfig(
+            wave_width=8, enable_host_ports=True)).wave_width == 1
+        assert wave_candidate_depth(1) == 1
+        assert wave_candidate_depth(4) == 4
+        assert wave_candidate_depth(16) == 8      # clamps at 8
+
+
+class TestPlantedContention:
+    """Non-vacuity: same-node contention must actually truncate/replay."""
+
+    def _contended(self):
+        # 16 identical nodes, one 16-task gang of identical tasks, spread
+        # scoring: every wave slot's top candidate list is the SAME node
+        # ordering, so at W=16 (candidate depth 8) the tail slots exhaust
+        # their lists once 8+ nodes are touched — truncation + replay
+        ci = simple_cluster(n_nodes=16, node_cpu="8", node_mem="16Gi")
+        job = build_job("default/big", min_available=16)
+        for i in range(16):
+            job.add_task(build_task(f"p{i}", cpu="2", memory="2Gi"))
+        ci.add_job(job)
+        return ci
+
+    def test_truncation_and_replay_fire_at_w16(self):
+        base = AllocateConfig(telemetry=True, least_allocated_weight=1.0)
+        tels = _run_widths(self._contended(), base, widths=(8, 16))
+        # W=8: candidate depth == W covers the contention — clean sweep
+        assert tels[8]["wave_truncations"] == 0
+        # W=16: depth clamps at 8 < W, the commit rule must fire
+        assert tels[16]["wave_truncations"] > 0, "vacuous planted fixture"
+        assert tels[16]["wave_replays"] > 0
+        assert tels[16]["wave_commits"] == tels[8]["wave_commits"]
+
+    def test_pipelined_decisions_survive_waving(self):
+        """Future-capacity (MODE_PIPELINED) commits ride the same wave
+        commit rule: scarce now-capacity + releasing nodes."""
+        from volcano_tpu.api import TaskStatus
+        ci = simple_cluster(n_nodes=4, node_cpu="4", node_mem="8Gi")
+        jobr = build_job("default/running", min_available=1)
+        for i in range(4):
+            t = build_task(f"r{i}", cpu="3", memory="6Gi",
+                           status=TaskStatus.RELEASING)
+            t.node_name = f"n{i}"
+            jobr.add_task(t)
+            ci.nodes[f"n{i}"].add_task(t)
+        ci.add_job(jobr)
+        jobp = build_job("default/pend", min_available=2)
+        for i in range(6):
+            jobp.add_task(build_task(f"q{i}", cpu="2", memory="2Gi"))
+        ci.add_job(jobp)
+        base = AllocateConfig(telemetry=True, enable_pipelining=True,
+                              enable_gang=True, least_allocated_weight=1.0)
+        tels = _run_widths(ci, base)
+        for w in WIDTHS:
+            assert tels[w]["placed_future"] > 0, "no pipelined commits"
+
+
+@pytest.mark.slow
+class TestShardedShaIdentity:
+    """The shard-local pallas-interpret path on a 2-device mesh: wave
+    decisions bitwise equal to the unsharded W=1 scan, oracle-checked."""
+
+    @pytest.mark.parametrize("width", [4, 16])
+    def test_sharded_wave_equals_unsharded_scan(self, width):
+        import jax.numpy as jnp
+        from test_sharded import _random_cluster
+        from volcano_tpu.parallel import make_sharded_allocate, scheduler_mesh
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        mesh = scheduler_mesh(2)
+        ci = _random_cluster(5, n_nodes=64, n_jobs=16)
+        snap, _ = pack(ci)
+        extras = AllocateExtras.neutral(snap)
+        base = AllocateConfig(least_allocated_weight=1.0,
+                              balanced_weight=1.0,
+                              use_pallas="interpret", telemetry=True)
+        single = jax.jit(make_allocate_cycle(
+            dataclasses.replace(base, use_pallas=False)))(
+                jax.tree.map(jnp.asarray, snap), extras)
+        cfg = dataclasses.replace(base, wave_width=width)
+        fn = make_sharded_allocate(cfg, mesh, snap)
+        with mesh:
+            sh = fn(snap, extras)
+            sh.task_node.block_until_ready()
+        assert _decisions(sh) == _decisions(single)
+        cpu = allocate_cpu(snap, extras, cfg, collect_telemetry=True)
+        assert _kernel_tel(sh, snap) == cpu["telemetry"]
+
+
+@pytest.mark.slow
+class TestPipelinedDepthK:
+    """The depth-k speculative pipeline with barriers and mid-flight
+    arrivals: the wave run's dispatch-ordered decision stream must sha
+    exactly as the W=1 run's (chaos/spec.py's matrix harness)."""
+
+    def test_depthk_stream_sha_identical(self):
+        from volcano_tpu.chaos import spec
+        ref = spec._drive(depth=3, pipeline=True, cycles=28,
+                          arrivals=True)
+        wav = spec._drive(depth=3, pipeline=True, cycles=28,
+                          arrivals=True, conf_extra="wave_width: 4\n")
+        assert wav["records"] == ref["records"]
+        assert wav["sha"] == ref["sha"]
+
+
+@pytest.mark.slow
+class TestFleetShaIdentity:
+    """The multi-tenant batched dispatch with per-tenant wave_width: the
+    fleet's digests must equal N independent W=1 solo references (wave
+    neutrality AND batch transparency in one matrix)."""
+
+    def test_fleet_wave_equals_solo_sequential(self):
+        from test_fleet import _PROBE_CONF, SPECS, _bases, run_fleet, run_solo
+        specs = {n: SPECS[n] for n in ("tenant-a", "tenant-c")}
+        bases = _bases(specs)
+        fleet_d, _ = run_fleet(bases, cycles=3, specs=specs,
+                               conf_text=_PROBE_CONF + "wave_width: 4\n")
+        solo_d = run_solo(bases, cycles=3, specs=specs)
+        for n in specs:
+            assert fleet_d[n] == solo_d[n], n

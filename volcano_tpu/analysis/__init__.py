@@ -14,7 +14,7 @@ compiled_session conf presets, the ops/ cycle functions, both Pallas
 kernel builders) and turns each class into a CI failure instead of a
 driver-TPU surprise.
 
-Check families (all ten run by default):
+Check families (all eleven run by default):
 
 - ``purity``       — no pure_callback/io_callback/debug_callback
                      primitives anywhere in a compiled cycle.
@@ -27,6 +27,12 @@ Check families (all ten run by default):
                      the node-axis dim (the [M, N] gather
                      re-materialization class; shapes are made
                      distinguishable by construction, see entrypoints).
+- ``wavefront``    — wave entries (``wave_width`` > 1, ISSUE 16) sweep
+                     their W candidate tasks as (W, N) intermediates:
+                     no rank-3 intermediate may combine the wave axis,
+                     a task axis, AND the node axis — the O(W*T*N)
+                     re-materialization that would erase the batched
+                     sweep's arithmetic-intensity win.
 - ``recompile``    — each jitted entry point compiles exactly once per
                      problem-size bucket: re-invoking with fresh
                      same-shaped inputs must not retrace.
@@ -92,8 +98,8 @@ import json
 import time
 from typing import List, Optional, Sequence
 
-FAMILIES = ("purity", "dtype", "gather", "recompile", "vmem", "obligations",
-            "telemetry", "donation", "sharding", "fleet")
+FAMILIES = ("purity", "dtype", "gather", "wavefront", "recompile", "vmem",
+            "obligations", "telemetry", "donation", "sharding", "fleet")
 
 
 @dataclasses.dataclass
@@ -147,15 +153,18 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     findings: List[Finding] = []
     fam_meta = {}
 
-    need_traces = bool({"purity", "dtype", "gather", "vmem"} & set(families))
+    need_traces = bool({"purity", "dtype", "gather", "wavefront", "vmem"}
+                       & set(families))
     traces = []
     if need_traces:
         from .entrypoints import build_traces
         traces = build_traces(fast=fast)
         fam_meta["traced_entry_points"] = [t.name for t in traces]
 
-    if "purity" in families or "dtype" in families or "gather" in families:
-        from .jaxpr_audit import check_dtype, check_gather, check_purity
+    jaxpr_fams = {"purity", "dtype", "gather", "wavefront"} & set(families)
+    if jaxpr_fams:
+        from .jaxpr_audit import (check_dtype, check_gather, check_purity,
+                                  check_wavefront)
         for tr in traces:
             if "purity" in families:
                 findings += check_purity(tr)
@@ -163,6 +172,8 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
                 findings += check_dtype(tr)
             if "gather" in families:
                 findings += check_gather(tr)
+            if "wavefront" in families:
+                findings += check_wavefront(tr)
 
     if "vmem" in families:
         from .vmem import check_vmem

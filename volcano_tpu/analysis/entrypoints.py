@@ -145,12 +145,27 @@ def _allocate_cfgs(fast: bool):
             derive_batching(dc.replace(base, drf_job_order=True),
                             has_proportion=False),
             use_pallas="interpret")),
+        # wavefront placement (ISSUE 16): the W>1 while_loop body under
+        # every jaxpr family, plus the wavefront-specific (W, task, N)
+        # materialization check. W=4 collides with NO audit dim that
+        # matters (task_dims={T=32, J*M=64}; N=128), so the wave axis is
+        # distinguishable by construction like everything else here.
+        ("allocate/wave4", dc.replace(
+            derive_batching(dc.replace(base, wave_width=4),
+                            has_proportion=False),
+            use_pallas=False)),
     ]
     if not fast:
         cfgs.append(("allocate/pallas_affinity", dc.replace(
             derive_batching(dc.replace(base, enable_pod_affinity=True),
                             has_proportion=False),
             use_pallas="interpret")))
+        # the widest supported wave (candidate depth clamps at 8 < W, so
+        # the truncation/replay arm of the commit rule is in the trace)
+        cfgs.append(("allocate/wave16", dc.replace(
+            derive_batching(dc.replace(base, wave_width=16),
+                            has_proportion=False),
+            use_pallas=False)))
     return cfgs
 
 

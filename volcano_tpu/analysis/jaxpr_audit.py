@@ -112,6 +112,55 @@ def check_dtype(trace) -> List[Finding]:
     return out
 
 
+def check_wavefront(trace) -> List[Finding]:
+    """Wave entries (``wave_width`` > 1) must sweep as (W, N) — never a
+    (W, task-axis, N) rank-3 re-materialization.
+
+    The wavefront sweep's whole bargain (ISSUE 16) is that widening the
+    per-iteration front from 1 task to W costs O(W*N), not O(W*T*N): the
+    W candidate rows are gathered to (W, R)/(W, N) operands and swept
+    against the node axis directly. An intermediate carrying the wave
+    axis AND a task axis AND the node axis on three distinct axes means
+    some per-slot computation re-materialized the full task table per
+    node — the O(M*N) gather class with an extra W multiplier on top.
+    Applies only to traces whose cfg has ``wave_width`` > 1; the audit
+    fixture sizes keep W numerically distinct from every task dim and N.
+    """
+    cfg = trace.cfg
+    W = int(getattr(cfg, "wave_width", 1) or 1) if cfg is not None else 1
+    if W <= 1:
+        return []
+    N = trace.dims["N"]
+    task_dims = set(trace.dims["task_dims"]) - {N, W}
+    out = []
+    seen = set()
+    for eqn in iter_eqns(trace.closed.jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            shape = getattr(aval, "shape", None)
+            if not shape or len(shape) < 3:
+                continue
+            dims = list(shape)
+            if W in dims and N in dims \
+                    and any(d in task_dims for d in dims):
+                loc = _loc(eqn)
+                key = (f"wavefront:{trace.name}:{loc}:"
+                       f"{eqn.primitive.name}:{tuple(shape)}")
+                dedup = (loc, tuple(shape))
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                out.append(Finding(
+                    family="wavefront", key=key,
+                    where=f"{trace.name} @ {loc}",
+                    what=(f"O(W*T*N) intermediate of shape {tuple(shape)} "
+                          f"({eqn.primitive.name}) in '{trace.name}': the "
+                          f"wave sweep must stay (W={W}, N) — gather the "
+                          "W candidate rows first, never broadcast the "
+                          "full task axis against the node axis")))
+    return out
+
+
 def check_gather(trace) -> List[Finding]:
     """No intermediate carrying BOTH a task-axis dim and the node-axis
     dim — the O(M*N) jobs-x-nodes re-materialization class the PR 1

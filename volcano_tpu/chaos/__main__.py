@@ -166,6 +166,12 @@ def main(argv=None) -> int:
                              "interpret mode (conf use_pallas: interpret); "
                              "with --sharded this is the shard-local "
                              "candidate launch")
+    parser.add_argument("--wave", type=int, nargs="?", const=4, default=None,
+                        metavar="W",
+                        help="run the storm on the wavefront placement "
+                             "path (conf wave_width: W, default 4): "
+                             "faults land mid-wave and decisions must "
+                             "still equal the clean run")
     parser.add_argument("--restart", action="store_true",
                         help="run the restart smoke: process_kill at "
                              "every phase, checkpoint restore, decision "
@@ -189,7 +195,8 @@ def main(argv=None) -> int:
                                  sharding=args.sharded,
                                  use_pallas=("interpret"
                                              if args.pallas_interpret
-                                             else None))
+                                             else None),
+                                 wave_width=args.wave)
     except Exception as e:  # harness failure, not a chaos verdict
         print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
         return 2

@@ -97,7 +97,8 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
                     deadline_ms: Optional[float] = None,
                     slow_s: float = 0.25,
                     sharding: bool = False,
-                    use_pallas: Optional[str] = None) -> Dict[str, object]:
+                    use_pallas: Optional[str] = None,
+                    wave_width: Optional[int] = None) -> Dict[str, object]:
     """Run the probe; returns a JSON-ready robustness report.
 
     ``sharding`` runs both the clean and the fault runs on the node-axis
@@ -106,13 +107,18 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
     single-device path. ``use_pallas`` ("interpret" in CI) selects the
     kernel path via the same conf knob — combined with ``sharding`` it
     puts the storm on the shard-local pallas candidate launch
-    (ISSUE 14)."""
+    (ISSUE 14). ``wave_width`` (> 1) runs the storm on the wavefront
+    placement path (ISSUE 16, conf ``wave_width: W``): faults land
+    mid-wave, and the order-preserving commit rule must keep the fault
+    run's decisions bit-identical to the clean run anyway."""
     from ..framework.conf import parse_conf
     from ..metrics import METRICS
     from ..runtime.fake_cluster import FakeCluster
     from ..runtime.scheduler import Scheduler
     conf = parse_conf(("sharding: true\n" if sharding else "")
                       + (f"use_pallas: {use_pallas}\n" if use_pallas else "")
+                      + (f"wave_width: {int(wave_width)}\n"
+                         if wave_width else "")
                       + _PROBE_CONF)
     base = _small_cluster()
 
@@ -149,6 +155,7 @@ def run_chaos_probe(seed: int = 7, cycles: int = 8, pipeline: bool = True,
         "pipeline": pipeline,
         "sharding": sharding,
         "use_pallas": use_pallas,
+        "wave_width": wave_width,
         "mesh_devices": next(
             (int(e["mesh_devices"]) for e in reversed(flight)
              if e.get("mesh_devices") is not None), None),

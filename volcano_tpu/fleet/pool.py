@@ -71,6 +71,12 @@ def normalize_config(cfg, sharding: bool = False):
     of silently sharing a scan program. Everything decision-relevant
     (weights, gates, derived batching) stays in the key either way, so
     tenants with different policies never share a compiled program.
+
+    ``wave_width`` (ISSUE 16) deliberately STAYS in the key despite being
+    decision-neutral: W > 1 swaps the inner section scan for the
+    wavefront while_loop, a different program shape, and the wave
+    telemetry counters are only meaningful per width — sharing a bucket
+    across widths would silently serve one width's program to both.
     """
     if sharding:
         return dataclasses.replace(cfg, telemetry=False)

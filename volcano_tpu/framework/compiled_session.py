@@ -82,6 +82,10 @@ def allocate_config_from_conf(sc: SchedulerConfiguration) -> AllocateConfig:
         # same threading Session._allocate_config does, so a served conf
         # selects the same kernel an in-process Session would
         use_pallas=getattr(sc, "use_pallas", None),
+        # wavefront width (top-level ``wave_width: 8``) — decision-
+        # neutral by the order-preserving commit rule, validated/clamped
+        # by derive_batching's normalize_wave pass
+        wave_width=int(getattr(sc, "wave_width", 1)),
         **weights), has_proportion=has_proportion)
 
 

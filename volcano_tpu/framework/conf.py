@@ -150,6 +150,16 @@ class SchedulerConfiguration:
     #: None (default) keeps the pure-XLA scan. YAML: top-level
     #: ``use_pallas: interpret``.
     use_pallas: Optional[object] = None
+    #: wavefront task placement width (ISSUE 16), threaded into
+    #: AllocateConfig.wave_width: each inner iteration evaluates the next
+    #: W eligible tasks of the popped job against the same capacity
+    #: snapshot in one batched sweep, then commits in strict task order
+    #: with an in-graph conflict rule — the committed decision sequence
+    #: is identical to W=1 at every width. 1 (default) keeps the per-task
+    #: sweep byte-for-byte unchanged; normalize_wave clamps illegal
+    #: combinations (pod affinity / host ports force 1). YAML: top-level
+    #: ``wave_width: 8``.
+    wave_width: int = 1
     #: fleet runtime (volcano_tpu/fleet): max tenants served per fleet
     #: cycle. None (default) serves every admitted tenant each cycle; a
     #: finite value makes the cross-tenant fairness pass (the proportion
@@ -221,6 +231,7 @@ def parse_conf(text: Optional[str] = None) -> SchedulerConfiguration:
     mh = data.get("mesh_hosts")
     sc.mesh_hosts = int(mh) if mh is not None else None
     sc.use_pallas = data.get("use_pallas")
+    sc.wave_width = max(1, int(data.get("wave_width", 1) or 1))
     fs = data.get("fleet_slots")
     sc.fleet_slots = int(fs) if fs is not None else None
     fcd = data.get("fleet_checkpoint_dir")

@@ -716,6 +716,8 @@ class Session:
                                                      False)),
                               use_pallas=getattr(self.conf, "use_pallas",
                                                  None),
+                              wave_width=int(getattr(self.conf,
+                                                     "wave_width", 1)),
                               enable_gang=self.plugin("gang") is not None,
                               enable_pod_affinity=enable_aff,
                               enable_host_ports=enable_ports,

@@ -49,6 +49,16 @@ _HELP = {
         "Number of tasks that could not be scheduled, by reason",
     "cycle_predicate_rejections":
         "In-graph per-predicate-family node rejection counts",
+    "wave_commits_total":
+        "Tasks committed by wavefront placement waves (wave_width > 1)",
+    "wave_truncations_total":
+        "Wavefront waves cut short by the in-graph conflict rule "
+        "(pre-wave candidate list exhausted by same-wave commits)",
+    "wave_replays_total":
+        "Task attempts deferred to the next wave by a truncation",
+    "wave_commit_ratio":
+        "Last cycle's wavefront commit efficiency: commits / (commits + "
+        "replays); 1.0 = every wave slot committed first try",
     "jit_traces": "Times each jitted cycle entry point was traced",
     "jit_calls": "Times each jitted cycle entry point was called",
     "jit_cache_hits": "Jit calls served from the compile cache",

@@ -70,6 +70,50 @@ DEFAULT_BATCH_JOBS = 8
 DEFAULT_BATCH_ROUNDS = 32
 
 
+def wave_candidate_depth(wave_width: int) -> int:
+    """Candidate-list depth C of the wavefront pre-sweep (ISSUE 16).
+
+    Each wave task carries its top-C feasible nodes by (score desc, index
+    asc) out of the shared pre-wave sweep; the in-order commit pass walks
+    the list for the first node no earlier wave task touched and exactly
+    rescores the touched ones. A wave of W tasks touches at most W - 1
+    nodes before task w commits, so C = min(W, 8) makes list exhaustion
+    (the only truncation trigger) impossible below W = 8 while bounding
+    the per-task candidate state the sweep ships. Shared by the compiled
+    cycle, the CPU oracle's wave counter mirror, and the wide shard
+    kernel — one authority, identical truncation behavior everywhere.
+    """
+    return min(max(1, int(wave_width)), 8)
+
+
+def normalize_wave(cfg: "AllocateConfig") -> "AllocateConfig":
+    """THE single authority for legal ``wave_width`` combinations.
+
+    Wavefront waves live INSIDE one popped job section, so every dynamic
+    fairness key (drf/hdrf shares, proportion qshare/overused) is frozen
+    across a wave by construction — the keys only move at pop boundaries,
+    the same static-segment rule ``derive_batching`` leans on for
+    ``batch_jobs``. Two features DO mutate mid-section state that a wave's
+    row-local conflict rescore cannot see, and force W back to 1:
+
+    - ``enable_pod_affinity``: a commit moves domain-global affinity
+      counts, shifting EVERY node's affinity score/mask for the next task;
+    - ``enable_host_ports``: the in-cycle port placement buffer is
+      append-ordered state read by every subsequent attempt.
+
+    The fused pallas round placers (``use_pallas`` without a mesh) already
+    batch whole job sections in-kernel, so ``make_allocate_cycle``
+    additionally ignores W there; W takes effect on the plain XLA scan
+    path and the sharded shard-local candidate path. W < 1 clamps to 1.
+    """
+    W = max(1, int(cfg.wave_width))
+    if cfg.enable_pod_affinity or cfg.enable_host_ports:
+        W = 1
+    if W != cfg.wave_width:
+        return dataclasses.replace(cfg, wave_width=W)
+    return cfg
+
+
 def derive_batching(cfg: "AllocateConfig", queue_deserved=None,
                     has_proportion: bool = None) -> "AllocateConfig":
     """THE single authority for the auto-batching preconditions.
@@ -89,6 +133,7 @@ def derive_batching(cfg: "AllocateConfig", queue_deserved=None,
     ``has_proportion`` (no proportion plugin == deserved stays neutral for
     the whole cycle). Explicit manual settings are respected untouched.
     """
+    cfg = normalize_wave(cfg)
     if cfg.batch_jobs != 1 or cfg.batch_rounds:
         return cfg          # manually set — caller owns the precondition
     if has_proportion is None:
@@ -173,6 +218,17 @@ class AllocateConfig:
     #: identical to a build without telemetry (graphcheck family 7);
     #: decisions are bit-identical either way.
     telemetry: bool = False
+    #: Wavefront placement width (ISSUE 16): on the XLA scan path and the
+    #: sharded candidate path, each iteration over a popped job section
+    #: evaluates the next W task attempts against the SAME capacity
+    #: snapshot in one batched (W, N) predicate x score sweep, then commits
+    #: the conflict-free prefix in strict task order (see the wavefront
+    #: block in make_allocate_cycle for the exact commit rule). 1 = today's
+    #: per-task sweep, byte-for-byte unchanged. Decisions are identical at
+    #: any width by construction; :func:`normalize_wave` (called from
+    #: derive_batching) is the single authority for legal W x feature
+    #: combinations.
+    wave_width: int = 1
 
 
 @jax.tree_util.register_dataclass
@@ -560,6 +616,17 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
         if TEL:
             from ..telemetry.cycle import CycleTelemetry
 
+        # ---- wavefront width (ISSUE 16) ------------------------------
+        # normalize_wave is the single authority; re-clamp defensively for
+        # raw configs that skipped derive_batching, and ignore W on the
+        # fused round placers (they batch whole job sections in-kernel).
+        W = max(1, int(cfg.wave_width))
+        if cfg.enable_pod_affinity or cfg.enable_host_ports:
+            W = 1
+        if use_pallas:
+            W = 1
+        WC = wave_candidate_depth(W)
+
         if use_pallas:
             # node-axis state lives transposed ([R, N] / [G, N] / [1, N]) so
             # the node axis is the TPU lane dimension inside the kernel.
@@ -646,14 +713,11 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
             return jnp.where(grp >= 0,
                              extras.or_feasible[jnp.maximum(grp, 0)], True)
 
-        if use_pallas or shard_pl:
-            # node-space env arrays shared by the fused round placers and
-            # the shard-local candidate kernel ([.., N] with the node
-            # axis last = kernel lane dimension)
-            alloc_t = nodes.allocatable.T
-            cnt_row = nodes.pod_count.astype(jnp.float32)[None, :]
-            maxp_row = nodes.max_pods.astype(jnp.float32)[None, :]
-            gidle0_t = (nodes.gpu_memory - nodes.gpu_used).T
+        if use_pallas or shard_pl or W > 1:
+            # per-template taint-prefer rows: the one score family with a
+            # cross-node reduction (max intolerable count), so the
+            # wavefront commit rescore gathers it from this static map
+            # exactly like the pallas kernels do
             if cfg.taint_prefer_weight:
                 rep = jnp.maximum(snap.template_rep, 0)
                 tp_static = cfg.taint_prefer_weight * jax.vmap(
@@ -662,6 +726,14 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
                         tasks.tol_mode[ti], nodes))(rep)
             else:
                 tp_static = jnp.zeros((tmpl_static.shape[0], N), jnp.float32)
+        if use_pallas or shard_pl:
+            # node-space env arrays shared by the fused round placers and
+            # the shard-local candidate kernel ([.., N] with the node
+            # axis last = kernel lane dimension)
+            alloc_t = nodes.allocatable.T
+            cnt_row = nodes.pod_count.astype(jnp.float32)[None, :]
+            maxp_row = nodes.max_pods.astype(jnp.float32)[None, :]
+            gidle0_t = (nodes.gpu_memory - nodes.gpu_used).T
             # static-per-cycle node maps consumed in-kernel via dynamic
             # sublane row reads (no per-round [M, N] materialization)
             tstat_f = tmpl_static.astype(jnp.float32)
@@ -923,6 +995,358 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
         jobs_min_req = jnp.min(
             jnp.where(_slot_ok, _slot_req, jnp.inf), axis=1)  # [J, R]
         node_live = (nodes.valid & nodes.schedulable)
+
+        if W > 1:
+            # ---- wavefront placement (ISSUE 16) --------------------------
+            # Each wave evaluates the next W task attempts of the popped
+            # section against the SAME capacity snapshot in one batched
+            # (W, N) sweep, reduced per task and capacity view to a top-C
+            # candidate list (C = wave_candidate_depth(W), exact
+            # (score desc, index asc) order). The commit pass then walks the
+            # wave in strict task order, re-resolving each slot's winner at
+            # the CURRENT mid-wave state from its list plus an exact O(C*R)
+            # rescore of every node the wave already touched. This is
+            # decision-identical to the sequential scan because capacity is
+            # monotone non-increasing within a section:
+            #   - untouched rows keep their pre-wave feasibility AND score
+            #     bitwise (every score family is per-node elementwise; the
+            #     one cross-node term, taint-prefer's max count, is static
+            #     per template — tp_static), so the first untouched list
+            #     entry dominates every untouched node that fell off the
+            #     list;
+            #   - touched rows are re-evaluated exactly at the current
+            #     state (scores can RISE under binpack/most-allocated, so
+            #     all touched nodes are rescored, on-list or not);
+            #   - only when the slot's list is exhausted (every entry
+            #     touched AND more feasible nodes existed than the list
+            #     held) can the true winner hide off-list: the wave
+            #     truncates there and the slot replays next wave. A slot at
+            #     wave position 0 has an empty touched set and is always
+            #     decidable, so every wave advances >= 1 slot.
+            NEGf = jnp.float32(NEG)
+            iota_n = jnp.arange(N, dtype=jnp.int32)
+            # block width for topc's two-level extraction: the widest
+            # divisor of N near sqrt(N), falling back to one block (the
+            # degenerate B=1 shape still beats a full-N pass per entry)
+            NB = next((c for c in (64, 32, 16, 8, 4, 2)
+                       if N % c == 0 and c * c <= 4 * N), N)
+
+            def _wave_rej1(t_idx, ji, idle0, pipe0, pods0, gpux0):
+                """Per-family rejection row (telemetry/cycle.PRED_FAMILIES)
+                for one attempt against the WINDOW-START state — the
+                wave-view analog of the sequential TEL block. ports and
+                pod_affinity are structurally zero: both force W == 1."""
+                t = jnp.maximum(t_idx, 0)
+                resreq = tasks.resreq[t]
+                gpu_req = tasks.gpu_request[t]
+                live = node_live
+                future = jnp.maximum(
+                    idle0 + nodes.releasing - nodes.pipelined - pipe0, 0.0)
+                fit2 = jnp.all(
+                    resreq[None, None, :]
+                    <= jnp.stack([idle0, future]) + 1e-5, axis=-1)
+                blk_row = ((extras.block_nonrevocable
+                            & ~extras.task_revocable[t])
+                           | extras.block_all)
+                vol_row = (extras.task_volume_ok[t]
+                           & ((extras.task_volume_node[t] < 0)
+                              | (iota_n == extras.task_volume_node[t])))
+                lock_row = (extras.node_locked
+                            & ~(ji == extras.target_job))
+                return jnp.stack([
+                    P.rejection_count(live, tmpl_static[tasks.template[t]]),
+                    P.rejection_count(live, ~blk_row),
+                    P.rejection_count(live, or_ok_row(t)),
+                    P.rejection_count(live, vol_row),
+                    P.rejection_count(live, ~lock_row),
+                    jnp.int32(0),                  # ports: forces W == 1
+                    P.rejection_count(
+                        live, P.pod_count_fit(nodes, pods0)),
+                    P.rejection_count(
+                        live, P.gpu_fit(gpu_req, nodes, gpux0)),
+                    P.rejection_count(live, fit2[0]),
+                    P.rejection_count(live, fit2[1]),
+                    jnp.int32(0),                  # affinity: forces W == 1
+                ])
+
+            def _wave_sweep1(t_idx, ji, idle0, pipe0, pods0, gpux0):
+                """Pre-wave full-N sweep for ONE slot: the task_step
+                feasibility conjunction and score fold, op-for-op, against
+                the window-start snapshot — reduced per capacity view to
+                the top-WC candidate list plus feasible count and raw tie
+                count at the best."""
+                t = jnp.maximum(t_idx, 0)
+                resreq = tasks.resreq[t]
+                gpu_req = tasks.gpu_request[t]
+                future = jnp.maximum(
+                    idle0 + nodes.releasing - nodes.pipelined - pipe0, 0.0)
+                node_ok = (~(extras.block_nonrevocable
+                             & ~extras.task_revocable[t])
+                           & ~extras.block_all
+                           & or_ok_row(t)
+                           & extras.task_volume_ok[t]
+                           & ((extras.task_volume_node[t] < 0)
+                              | (iota_n == extras.task_volume_node[t]))
+                           & (~extras.node_locked
+                              | (ji == extras.target_job))
+                           & tmpl_static[tasks.template[t]])
+                shared = node_ok & P.pod_count_fit(nodes, pods0)
+                shared &= P.gpu_fit(gpu_req, nodes, gpux0)
+                fit2 = jnp.all(
+                    resreq[None, None, :]
+                    <= jnp.stack([idle0, future]) + 1e-5, axis=-1)
+                feas_now = shared & fit2[0]
+                feas_fut = shared & fit2[1]
+                score = _score_fn(cfg, snap, resreq, idle0,
+                                  tasks.tol_hash[t], tasks.tol_effect[t],
+                                  tasks.tol_mode[t])
+                score += (extras.template_na_score[tasks.template[t]]
+                          + jnp.where(extras.task_revocable[t],
+                                      extras.tdm_bonus, 0.0))
+                score += S.node_preference_score(
+                    extras.task_pref_node[t], score.shape[0])
+
+                def topc(feas):
+                    masked0 = jnp.where(feas, score, NEGf)
+                    best0 = jnp.max(masked0)
+                    tie = jnp.sum((masked0 == best0) & feas,
+                                  dtype=jnp.int32)
+                    n_f = jnp.sum(feas, dtype=jnp.int32)
+                    # Blocked iterative extraction: one O(N) block-max
+                    # reduce, then WC rounds touching only the winning
+                    # block — O(N/NB + NB) each instead of a full-N
+                    # argmax pass per entry (the naive WC*N form made
+                    # W > 2 a net LOSS at bench scale). -inf masking
+                    # keeps the list feasible-only; entry order is still
+                    # (score desc, global index asc): lowest block at
+                    # the max, then lowest in-block index at the max.
+                    # Max is exact over f32, so entry values are bitwise
+                    # what the full-N pass produced.
+                    ninf = jnp.float32(-jnp.inf)
+                    m2 = jnp.where(feas, score, ninf).reshape(N // NB, NB)
+                    bm = jnp.max(m2, axis=1)                  # [N/NB]
+                    iota_nb = jnp.arange(NB, dtype=jnp.int32)
+                    iota_blk = jnp.arange(N // NB, dtype=jnp.int32)
+                    e_i, e_v, e_o = [], [], []
+                    for _ in range(WC):
+                        best = jnp.max(bm)
+                        # first-index-at-max via where+min keeps every
+                        # index intermediate i32 (argmax mints i64
+                        # indices under the x64 audit trace)
+                        blk = jnp.min(jnp.where(bm == best, iota_blk,
+                                                jnp.int32(N // NB)))
+                        row = jax.lax.dynamic_index_in_dim(
+                            m2, blk, 0, keepdims=False)       # [NB]
+                        within = jnp.min(jnp.where(row == best, iota_nb,
+                                                   jnp.int32(NB)))
+                        found = best > ninf      # any feasible remaining
+                        e_i.append(jnp.where(found, blk * NB + within,
+                                             jnp.int32(N)))
+                        e_v.append(best)
+                        e_o.append(found)
+                        row = jnp.where(iota_nb == within, ninf, row)
+                        m2 = jax.lax.dynamic_update_index_in_dim(
+                            m2, row, blk, 0)
+                        bm = bm.at[blk].set(jnp.max(row))
+                    return (jnp.stack(e_i), jnp.stack(e_v),
+                            jnp.stack(e_o), n_f, tie)
+
+                ein, evn, eon, cntn, tien = topc(feas_now)
+                eif, evf, eof, cntf, tief = topc(feas_fut)
+                return (ein, evn, eon, cntn, tien,
+                        eif, evf, eof, cntf, tief)
+
+            def _wave_rescore(t_idx, ji, rows, idle, pipe_extra,
+                              pods_extra, gpu_extra):
+                """Exact row-gathered re-evaluation of feasibility + score
+                at the CURRENT mid-wave state for the given node rows —
+                bitwise-equal to the full-N sweep restricted to those rows
+                (see the block comment above). O(len(rows) * R); rows may
+                carry the N sentinel (caller masks those results)."""
+                t = jnp.maximum(t_idx, 0)
+                r = jnp.minimum(jnp.maximum(rows, 0), N - 1)
+                resreq = tasks.resreq[t]
+                gpu_req = tasks.gpu_request[t]
+                idle_r = idle[r]                              # [C, R]
+                alloc_r = nodes.allocatable[r]
+                fut_r = jnp.maximum(
+                    idle_r + nodes.releasing[r] - nodes.pipelined[r]
+                    - pipe_extra[r], 0.0)
+                node_ok = (~(extras.block_nonrevocable[r]
+                             & ~extras.task_revocable[t])
+                           & ~extras.block_all[r]
+                           & or_ok_row(t)[r]
+                           & extras.task_volume_ok[t]
+                           & ((extras.task_volume_node[t] < 0)
+                              | (r == extras.task_volume_node[t]))
+                           & (~extras.node_locked[r]
+                              | (ji == extras.target_job))
+                           & tmpl_static[tasks.template[t]][r])
+                pods_ok = (nodes.pod_count[r] + pods_extra[r]
+                           < nodes.max_pods[r])
+                gidle_r = (nodes.gpu_memory[r] - nodes.gpu_used[r]
+                           - gpu_extra[r])
+                gpu_ok = (gpu_req <= 0) | jnp.any(
+                    gidle_r >= gpu_req - 1e-5, axis=-1)
+                fit_now = jnp.all(resreq[None, :] <= idle_r + 1e-5,
+                                  axis=-1)
+                fit_fut = jnp.all(resreq[None, :] <= fut_r + 1e-5,
+                                  axis=-1)
+                # _score_fn's weighted fold, row-shaped, same f32 order;
+                # taint-prefer from the static per-template map
+                used_r = alloc_r - idle_r
+                rw = jnp.ones_like(resreq)
+                s = jnp.zeros(r.shape[0], jnp.float32)
+                if cfg.binpack_weight:
+                    s += cfg.binpack_weight * S.binpack_score(
+                        used_r, alloc_r, resreq, rw)
+                if cfg.least_allocated_weight:
+                    s += cfg.least_allocated_weight \
+                        * S.least_allocated_score(used_r, alloc_r, resreq)
+                if cfg.most_allocated_weight:
+                    s += cfg.most_allocated_weight \
+                        * S.most_allocated_score(used_r, alloc_r, resreq)
+                if cfg.balanced_weight:
+                    s += cfg.balanced_weight \
+                        * S.balanced_allocation_score(used_r, alloc_r,
+                                                      resreq)
+                if cfg.taint_prefer_weight:
+                    s += tp_static[tasks.template[t]][r]
+                s += (extras.template_na_score[tasks.template[t]][r]
+                      + jnp.where(extras.task_revocable[t],
+                                  extras.tdm_bonus[r], 0.0))
+                pref = extras.task_pref_node[t]
+                s += jnp.where((pref >= 0) & (r == pref),
+                               jnp.float32(100.0), jnp.float32(0.0))
+                ok_shared = node_ok & pods_ok & gpu_ok
+                return ok_shared & fit_now, ok_shared & fit_fut, s
+
+            def _wave_resolve(e_i, e_v, e_o, cnt, touched, t_ok, t_s):
+                """Winner of the full-N argmax at the current state, from
+                the slot's pre-wave top-C list plus the rescored touched
+                rows — or decidable=False when the list is exhausted (wave
+                truncation). Tie-break is lowest global index at the max,
+                exactly select.best_node's."""
+                unt = e_o & ~jnp.any(
+                    e_i[:, None] == touched[None, :], axis=1)
+                has_unt = jnp.any(unt)
+                fc = jax.lax.argmax(unt, 0, jnp.int32)
+                tset = touched < N
+                cand_i = jnp.concatenate([e_i[fc][None], touched])
+                cand_v = jnp.concatenate([e_v[fc][None], t_s])
+                cand_ok = jnp.concatenate([has_unt[None], tset & t_ok])
+                decidable = has_unt | (cnt <= WC)
+                mv = jnp.where(cand_ok, cand_v, NEGf)
+                mx = jnp.max(mv)
+                at = cand_ok & (mv == mx)
+                win = jnp.min(jnp.where(at, cand_i, jnp.int32(N)))
+                found = jnp.any(cand_ok)
+                win = jnp.where(found, win, jnp.int32(0))
+                return win, found, decidable
+
+            if shard_pl:
+                # wide shard-local sweep: one kernel launch scores all W
+                # columns against this shard's rows, the cross-shard merge
+                # rebuilds the global top-C per column (the global c-th
+                # best row is always within its own shard's top-c)
+                from .pallas_place import make_shard_wave_placer
+                _wcand = make_shard_wave_placer(cfg, NL_sh, R, G, GR,
+                                                W, WC, interpret=interp)
+
+                def _wcand_region(*flat):
+                    it = iter(flat)
+                    rr = next(it)
+                    gq = next(it) if cfg.enable_gpu else None
+                    scal = [next(it) for _ in range(7)]
+                    env = [next(it) for _ in range(len(env_sh))]
+                    idle_s = next(it)             # [NL, R]
+                    pipe_s = next(it)             # [NL, R]
+                    pods_s = next(it)             # [NL] i32
+                    gpux_s = next(it) if cfg.enable_gpu else None
+                    off = (jax.lax.axis_index(axis)
+                           * jnp.int32(NL_sh)).astype(
+                               jnp.int32).reshape(1, 1)
+                    args = [rr]
+                    if cfg.enable_gpu:
+                        args.append(gq)
+                    args += scal + [off] + env
+                    args += [idle_s.T, pipe_s.T,
+                             pods_s.astype(jnp.float32)[None, :]]
+                    if cfg.enable_gpu:
+                        args.append(gpux_s.T)
+                    return _wcand(*args)
+
+                _wcand_sm = shard_map(
+                    _wcand_region, mesh=mesh,
+                    in_specs=tuple([_PS()] * n_scal
+                                   + [_PS(None, axis)] * len(env_sh)
+                                   + state_specs),
+                    out_specs=(_PS(axis, None),) * 8,
+                    check_rep=False)
+
+                def _wave_combine(sc_d, ix_d, cn_d, ti_d):
+                    """Stacked per-shard lists ((D*C, W) entries, (D, W)
+                    counts/ties) -> the global top-C per column, same
+                    (score desc, global index asc) order as the scan
+                    sweep, counts summed, raw ties summed across shards
+                    sitting at the global max (the narrow _combine rule,
+                    entry 0 being each shard's local best)."""
+                    erow = jnp.tile(jnp.arange(WC, dtype=jnp.int32),
+                                    D_sh)[:, None]            # [D*C, 1]
+                    ok = erow < jnp.repeat(cn_d, WC, axis=0)
+                    e_i, e_v, e_o = [], [], []
+                    for _ in range(WC):
+                        mv = jnp.where(ok, sc_d, NEGf)
+                        mx = jnp.max(mv, axis=0)              # [W]
+                        at = ok & (mv == mx[None, :])
+                        fnd = jnp.any(ok, axis=0)
+                        pick = jnp.min(jnp.where(at, ix_d, jnp.int32(N)),
+                                       axis=0)
+                        pick = jnp.where(fnd, pick, jnp.int32(N))
+                        e_i.append(pick)
+                        e_v.append(mx)
+                        e_o.append(fnd)
+                        ok = ok & (ix_d != pick[None, :])
+                    cnt = jnp.sum(cn_d, axis=0, dtype=jnp.int32)
+                    ties = jnp.sum(
+                        jnp.where((cn_d > 0)
+                                  & (sc_d[0::WC] == e_v[0][None, :]),
+                                  ti_d, 0),
+                        axis=0, dtype=jnp.int32)
+                    return (jnp.stack(e_i, axis=1), jnp.stack(e_v, axis=1),
+                            jnp.stack(e_o, axis=1), cnt, ties)
+
+                def wave_sweep(ts, ji, idle0, pipe0, pods0, gpux0):
+                    i32 = jnp.int32
+                    tcl = jnp.maximum(ts, 0)
+                    scal = [
+                        extras.task_pref_node[tcl].astype(i32)[None, :],
+                        jnp.maximum(tasks.template[tcl], 0)
+                        .astype(i32)[None, :],
+                        extras.task_or_group[tcl].astype(i32)[None, :],
+                        extras.task_volume_node[tcl].astype(i32)[None, :],
+                        extras.task_volume_ok[tcl].astype(i32)[None, :],
+                        extras.task_revocable[tcl].astype(i32)[None, :],
+                        jnp.broadcast_to(
+                            (ji == extras.target_job).astype(i32), (1, W)),
+                    ]
+                    args = [tasks.resreq[tcl].T]              # [R, W]
+                    if cfg.enable_gpu:
+                        args.append(tasks.gpu_request[tcl]
+                                    .astype(jnp.float32)[None, :])
+                    args += scal + env_sh
+                    args += [idle0, pipe0, pods0]
+                    if cfg.enable_gpu:
+                        args.append(gpux0)
+                    (sc_n, ix_n, cn_n, ti_n,
+                     sc_f, ix_f, cn_f, ti_f) = _wcand_sm(*args)
+                    return (*_wave_combine(sc_n, ix_n, cn_n, ti_n),
+                            *_wave_combine(sc_f, ix_f, cn_f, ti_f))
+            else:
+                def wave_sweep(ts, ji, idle0, pipe0, pods0, gpux0):
+                    return jax.vmap(
+                        lambda t: _wave_sweep1(t, ji, idle0, pipe0,
+                                               pods0, gpux0))(ts)
 
         def hopeless_jobs(st, elig):
             """bool[J]: eligible jobs whose CHEAPEST pending request exceeds
@@ -1662,11 +2086,353 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
                 carry0 = (carry0, (tel0.pred_reject, tel0.attempts,
                                    tel0.placed_now, tel0.placed_future,
                                    tel0.argmax_ties))
-            carry_fin, _ = jax.lax.scan(
-                task_step, carry0, (task_ids, slots, suffix_after),
-                unroll=min(int(M), 16))
-            if TEL:
-                carry_fin, tel_fin = carry_fin
+            if W == 1:
+                carry_fin, _ = jax.lax.scan(
+                    task_step, carry0, (task_ids, slots, suffix_after),
+                    unroll=min(int(M), 16))
+                if TEL:
+                    carry_fin, tel_fin = carry_fin
+            else:
+                # ---- wavefront section walk (ISSUE 16) -------------------
+                # One while_loop over waves replaces the per-slot scan: a
+                # batched pre-wave sweep of the next W slots, then a
+                # Python-unrolled in-order commit pass (see the wavefront
+                # block above for the exactness argument). The carry is the
+                # scan's 18-tuple plus the window cursor (and the TEL
+                # tuple + wave counters when telemetry is on).
+                if TEL:
+                    from ..telemetry.cycle import WAVE_BINS
+                    carry0, wtel0 = carry0
+
+                def _wave_cond(wst):
+                    stopped, broke = wst["carry"][16], wst["carry"][17]
+                    return (wst["pos"] < M) & ~stopped & ~broke
+
+                def _wave_body(wst):
+                    (idle, pipe_extra, pods_extra, gpu_extra,
+                     t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                     aff_cnt, anti_cnt, pe_node, pe_port, pe_cnt,
+                     placed_sum, n_adv, stopped, broke) = wst["carry"]
+                    pos = wst["pos"]
+                    widx = pos + jnp.arange(W, dtype=jnp.int32)
+                    in_rng = widx < M
+                    wslot = jnp.minimum(widx, M - 1)
+                    t_w = jnp.where(in_rng, task_ids[wslot], -1)
+                    suf_w = jnp.where(in_rng, suffix_after[wslot], 0)
+                    (ein, evn, eon, cntn, tien,
+                     eif, evf, eof, cntf, tief) = wave_sweep(
+                         t_w, ji, idle, pipe_extra, pods_extra, gpu_extra)
+                    if TEL:
+                        whist, wcom, wtru, wrep, wnum = wst["wave"]
+                        rej_w = jax.vmap(lambda t: _wave_rej1(
+                            t, ji, idle, pipe_extra, pods_extra,
+                            gpu_extra))(t_w)
+
+                    # ---- optimistic batched commit --------------------
+                    # The unrolled in-order commit below is exact but its
+                    # per-slot cost is O(W) (rescore over the touched
+                    # set), so the wave body grows O(W^2) and the CPU
+                    # backend loses the whole sweep win past W=4. The
+                    # common wave, though, is conflict-free, and its
+                    # outcome is PREDICTABLE from the pre-wave entry
+                    # lists in one of two shapes:
+                    #   * heterogeneous slots — every slot's entry-0
+                    #     differs: each slot commits its own entry-0;
+                    #   * shared list (the spread-scoring canon: similar
+                    #     tasks see the SAME node ordering) — slot w's
+                    #     first w entries are exactly the earlier slots'
+                    #     picks, so slot w commits its entry-w.
+                    # Either way the predicted picks Pk are pairwise
+                    # distinct, so each pick row's live state at any
+                    # later slot equals its post-commit state (only its
+                    # own slot touched it) — ONE batched rescore of all
+                    # picks against all slots reproduces, bitwise, every
+                    # per-slot rescore of the sequential walk.  The wave
+                    # takes the batched branch of lax.cond only when
+                    #   * all W slots are in-window, active,
+                    #     non-best-effort, with a valid predicted entry
+                    #     (untouched => dec_n holds),
+                    #   * no earlier pick beats a later slot's predicted
+                    #     entry at the rescored state (strictly, or by
+                    #     the lower-node-index tie rule) => the resolve
+                    #     winner IS the predicted entry for every slot,
+                    #   * no mid-wave gang stop before the last slot
+                    #     (a stop at the last slot lands in the carry,
+                    #     exactly as the sequential walk leaves it);
+                    # anything else replays through the sequential
+                    # chain.  The batched state writes then touch the
+                    # same rows with the same one-add deltas as the walk
+                    # (f32 placed_sum still folds in slot order).
+                    t_cl = jnp.maximum(t_w, 0)
+                    iw = jnp.arange(W, dtype=jnp.int32)
+                    eye_w = iw[:, None] == iw[None, :]
+                    ltri = iw[None, :] < iw[:, None]    # [w, v]: v < w
+                    d0 = ein[:, 0]
+                    use0 = jnp.all(eon[:, 0]) & ~jnp.any(
+                        (d0[:, None] == d0[None, :]) & ~eye_w)
+                    if W <= WC:
+                        shared = (jnp.all(ein[:, :W] == ein[0:1, :W])
+                                  & jnp.all(eon[iw, iw]))
+                        struct_ok = use0 | shared
+                        Pk = jnp.where(use0, d0, ein[iw, iw])
+                        EVp = jnp.where(use0, evn[:, 0], evn[iw, iw])
+                    else:
+                        # the shared-list shape needs W predicted
+                        # entries per slot; the candidate depth only
+                        # keeps WC < W of them
+                        struct_ok = use0
+                        Pk = d0
+                        EVp = evn[:, 0]
+                    req_all = tasks.resreq[t_cl]
+                    gpu_all = tasks.gpu_request[t_cl]
+                    idle_post = idle.at[Pk].add(-req_all)
+                    pods_post = pods_extra.at[Pk].add(jnp.int32(1))
+                    card0 = jax.vmap(P.pick_gpu_row)(
+                        gpu_all, nodes.gpu_memory[Pk],
+                        nodes.gpu_used[Pk], gpu_extra[Pk])
+                    charge0 = card0 >= 0
+                    gpux_post = gpu_extra.at[
+                        Pk, jnp.maximum(card0, 0)].add(
+                            jnp.where(charge0, gpu_all, 0.0))
+                    okn2, _okf2, s2 = jax.vmap(
+                        lambda tt: _wave_rescore(
+                            tt, ji, Pk, idle_post, pipe_extra,
+                            pods_post, gpux_post))(t_w)     # [W, W]
+                    act_all = jnp.all((t_w >= 0)
+                                      & ~tasks.best_effort[t_cl])
+                    beat = okn2 & ((s2 > EVp[:, None])
+                                   | ((s2 == EVp[:, None])
+                                      & (Pk[None, :] < Pk[:, None])))
+                    nobeat = ~jnp.any(beat & ltri)
+                    if cfg.enable_gang:
+                        ready_seq = (ready0 + n_alloc + jnp.int32(1)
+                                     + jnp.arange(W, dtype=jnp.int32)
+                                     ) >= min_avail
+                    else:
+                        ready_seq = jnp.ones((W,), jnp.bool_)
+                    stop_seq = ready_seq & (suf_w > 0) & ~can_batch
+                    nostop = ~jnp.any(stop_seq[:-1])
+                    fast_ok = struct_ok & act_all & nobeat & nostop
+
+                    cstate = (idle, pipe_extra, pods_extra, gpu_extra,
+                              t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                              placed_sum, n_adv, stopped, broke)
+                    if TEL:
+                        cstate = cstate + (wst["tel"],)
+
+                    def _commit_fast(state):
+                        if TEL:
+                            (idle, pipe_extra, pods_extra, gpu_extra,
+                             t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                             placed_sum, n_adv, stopped, broke,
+                             tel) = state
+                        else:
+                            (idle, pipe_extra, pods_extra, gpu_extra,
+                             t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                             placed_sum, n_adv, stopped, broke) = state
+                        idle = idle_post
+                        pods_extra = pods_post
+                        gpu_extra = gpux_post
+                        t_gpu = t_gpu.at[t_cl].set(
+                            jnp.where(charge0, card0, t_gpu[t_cl]))
+                        t_node = t_node.at[t_cl].set(Pk)
+                        t_mode = t_mode.at[t_cl].set(
+                            jnp.full((W,), MODE_ALLOCATED,
+                                     t_mode.dtype))
+                        n_alloc = n_alloc + jnp.int32(W)
+                        for w in range(W):      # f32 fold in slot order
+                            placed_sum = placed_sum + req_all[w]
+                        n_adv = n_adv + jnp.int32(W)
+                        stopped = stopped | stop_seq[W - 1]
+                        ret = (idle, pipe_extra, pods_extra, gpu_extra,
+                               t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                               placed_sum, n_adv, stopped, broke,
+                               pos + jnp.int32(W))
+                        if TEL:
+                            tel = (tel[0] + jnp.sum(rej_w, axis=0),
+                                   tel[1] + jnp.int32(W),
+                                   tel[2] + jnp.int32(W),
+                                   tel[3],
+                                   tel[4] + jnp.sum(
+                                       jnp.maximum(
+                                           tien - jnp.int32(1),
+                                           jnp.int32(0)),
+                                       dtype=jnp.int32))
+                            wave_t = (
+                                whist.at[min(W, WAVE_BINS - 1)].add(1),
+                                wcom + jnp.int32(W), wtru, wrep,
+                                wnum + jnp.int32(1))
+                            ret = ret + (tel, wave_t)
+                        return ret
+
+                    def _commit_slow(state):
+                        if TEL:
+                            (idle, pipe_extra, pods_extra, gpu_extra,
+                             t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                             placed_sum, n_adv, stopped, broke,
+                             tel) = state
+                            replays_w = jnp.int32(0)
+                        else:
+                            (idle, pipe_extra, pods_extra, gpu_extra,
+                             t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                             placed_sum, n_adv, stopped, broke) = state
+                        touched = jnp.full((W,), N, jnp.int32)
+                        tcount = jnp.int32(0)
+                        trunc = jnp.bool_(False)
+                        trunc_pos = jnp.int32(W)
+                        for w in range(W):
+                            t_idx = t_w[w]
+                            can_run = (t_idx >= 0) & ~stopped & ~broke
+                            t = jnp.maximum(t_idx, 0)
+                            resreq = tasks.resreq[t]
+                            gpu_req = tasks.gpu_request[t]
+                            active = can_run & ~tasks.best_effort[t]
+                            trunc_pre = trunc
+                            eligw = active & ~trunc
+                            ok_n_t, ok_f_t, s_t = _wave_rescore(
+                                t_idx, ji, touched, idle, pipe_extra,
+                                pods_extra, gpu_extra)
+                            win_n, fnd_n, dec_n = _wave_resolve(
+                                ein[w], evn[w], eon[w], cntn[w], touched,
+                                ok_n_t, s_t)
+                            win_f, fnd_f, dec_f = _wave_resolve(
+                                eif[w], evf[w], eof[w], cntf[w], touched,
+                                ok_f_t, s_t)
+                            do_alloc = eligw & dec_n & fnd_n
+                            if cfg.enable_pipelining:
+                                do_pipe = (eligw & dec_n & ~fnd_n
+                                           & dec_f & fnd_f)
+                                conflict = eligw & (~dec_n
+                                                    | (dec_n & ~fnd_n
+                                                       & ~dec_f))
+                            else:
+                                do_pipe = jnp.bool_(False)
+                                conflict = eligw & ~dec_n
+                            placed = do_alloc | do_pipe
+                            node = jnp.where(do_alloc, win_n,
+                                             jnp.where(do_pipe, win_f, 0))
+                            brk = eligw & ~conflict & ~placed
+                            proc = can_run & ~trunc_pre & ~conflict
+
+                            if TEL:
+                                acti_b = proc & active
+                                acti = jnp.where(acti_b, jnp.int32(1),
+                                                 jnp.int32(0))
+                                ties = jnp.where(
+                                    do_alloc,
+                                    jnp.maximum(tien[w] - 1, 0),
+                                    jnp.where(do_pipe,
+                                              jnp.maximum(tief[w] - 1, 0),
+                                              jnp.int32(0)))
+                                tel = (tel[0] + rej_w[w] * acti,
+                                       tel[1] + acti,
+                                       tel[2] + jnp.where(do_alloc,
+                                                          jnp.int32(1),
+                                                          jnp.int32(0)),
+                                       tel[3] + jnp.where(do_pipe,
+                                                          jnp.int32(1),
+                                                          jnp.int32(0)),
+                                       tel[4] + ties)
+                                replays_w += jnp.where(
+                                    active & (trunc_pre | conflict),
+                                    jnp.int32(1), jnp.int32(0))
+
+                            # commit bookkeeping — masked exactly like task_step
+                            delta = jnp.where(do_alloc, jnp.float32(1.0),
+                                              jnp.float32(0.0)) * resreq
+                            idle = idle.at[node].add(-delta)
+                            pipe_delta = jnp.where(do_pipe, jnp.float32(1.0),
+                                                   jnp.float32(0.0)) * resreq
+                            pipe_extra = pipe_extra.at[node].add(pipe_delta)
+                            pods_extra = pods_extra.at[node].add(
+                                jnp.where(placed, jnp.int32(1), jnp.int32(0)))
+                            card = P.pick_gpu_row(
+                                gpu_req, nodes.gpu_memory[node],
+                                nodes.gpu_used[node], gpu_extra[node])
+                            charge = placed & (card >= 0)
+                            gpu_extra = gpu_extra.at[
+                                node, jnp.maximum(card, 0)].add(
+                                    jnp.where(charge, gpu_req, 0.0))
+                            t_gpu = t_gpu.at[t].set(
+                                jnp.where(charge, card, t_gpu[t]))
+                            t_node = t_node.at[t].set(
+                                jnp.where(placed, node, t_node[t]))
+                            t_mode = t_mode.at[t].set(
+                                jnp.where(do_alloc, MODE_ALLOCATED,
+                                          jnp.where(do_pipe, MODE_PIPELINED,
+                                                    t_mode[t])))
+                            n_alloc += jnp.where(do_alloc, jnp.int32(1),
+                                                 jnp.int32(0))
+                            n_pipe += jnp.where(do_pipe, jnp.int32(1),
+                                                jnp.int32(0))
+                            placed_sum = placed_sum + jnp.where(
+                                placed, jnp.float32(1.0),
+                                jnp.float32(0.0)) * resreq
+                            # a truncated slot advances nothing: it replays at
+                            # the head of the next wave's window
+                            n_adv += jnp.where(proc, jnp.int32(1),
+                                               jnp.int32(0))
+                            if cfg.enable_gang:
+                                ready_aft = (ready0 + n_alloc) >= min_avail
+                            else:
+                                ready_aft = jnp.bool_(True)
+                            stopped |= (placed & ready_aft & (suf_w[w] > 0)
+                                        & ~can_batch)
+                            broke |= brk
+                            touched = touched.at[
+                                jnp.where(placed, tcount, jnp.int32(W))].set(
+                                    node, mode="drop")
+                            tcount += jnp.where(placed, jnp.int32(1),
+                                                jnp.int32(0))
+                            trunc_pos = jnp.where(conflict, jnp.int32(w),
+                                                  trunc_pos)
+                            trunc |= conflict
+
+                        ret = (idle, pipe_extra, pods_extra, gpu_extra,
+                               t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                               placed_sum, n_adv, stopped, broke,
+                               pos + jnp.where(trunc, trunc_pos,
+                                               jnp.int32(W)))
+                        if TEL:
+                            wave_t = (
+                                whist.at[jnp.minimum(
+                                    tcount, WAVE_BINS - 1)].add(1),
+                                wcom + tcount,
+                                wtru + jnp.where(trunc, jnp.int32(1),
+                                                 jnp.int32(0)),
+                                wrep + replays_w,
+                                wnum + jnp.int32(1))
+                            ret = ret + (tel, wave_t)
+                        return ret
+
+                    ret = jax.lax.cond(fast_ok, _commit_fast,
+                                       _commit_slow, cstate)
+                    (idle, pipe_extra, pods_extra, gpu_extra, t_node,
+                     t_mode, t_gpu, n_alloc, n_pipe, placed_sum, n_adv,
+                     stopped, broke, pos_new) = ret[:14]
+                    out = dict(
+                        carry=(idle, pipe_extra, pods_extra, gpu_extra,
+                               t_node, t_mode, t_gpu, n_alloc, n_pipe,
+                               aff_cnt, anti_cnt, pe_node, pe_port,
+                               pe_cnt, placed_sum, n_adv, stopped, broke),
+                        pos=pos_new)
+                    if TEL:
+                        out["tel"] = ret[14]
+                        out["wave"] = ret[15]
+                    return out
+
+                wst0 = dict(carry=carry0, pos=cur)
+                if TEL:
+                    t0w = st["telemetry"]
+                    wst0["tel"] = wtel0
+                    wst0["wave"] = (t0w.wave_hist, t0w.wave_commits,
+                                    t0w.wave_truncations,
+                                    t0w.wave_replays, t0w.waves)
+                wfin = jax.lax.while_loop(_wave_cond, _wave_body, wst0)
+                carry_fin = wfin["carry"]
+                if TEL:
+                    tel_fin = wfin["tel"]
+                    wave_fin = wfin["wave"]
             (idle, pipe_extra, pods_extra, gpu_extra, t_node, t_mode,
              t_gpu, n_alloc, n_pipe, aff_cnt, anti_cnt,
              pe_node, pe_port, pe_cnt, placed_sum,
@@ -1727,6 +2493,15 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
             tel_upd = {}
             if TEL:
                 t0 = st["telemetry"]
+                wave_kw = {}
+                if W > 1:
+                    # wave counters survive a gang discard: they measure
+                    # the wave mechanics (the oracle mirrors this)
+                    wave_kw = dict(wave_hist=wave_fin[0],
+                                   wave_commits=wave_fin[1],
+                                   wave_truncations=wave_fin[2],
+                                   wave_replays=wave_fin[3],
+                                   waves=wave_fin[4])
                 tel_upd["telemetry"] = dataclasses.replace(
                     t0,
                     pred_reject=tel_fin[0],
@@ -1738,7 +2513,8 @@ def make_allocate_cycle(cfg: AllocateConfig, mesh=None):
                         keep, jnp.int32(0), n_alloc + n_pipe),
                     committed=t0.committed + committed,
                     rounds=t0.rounds + jnp.int32(1),
-                    pops=t0.pops + jnp.int32(1))
+                    pops=t0.pops + jnp.int32(1),
+                    **wave_kw)
 
             return dict(
                 **tel_upd,

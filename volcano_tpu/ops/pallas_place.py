@@ -1257,3 +1257,197 @@ def make_shard_candidate_placer(cfg, NL: int, R: int, G: int, GR: int,
             )(*args)
 
     return place
+
+
+# --------------------------------------------------------------------------
+# wide wavefront candidate kernel: W placement attempts per shard per launch
+# --------------------------------------------------------------------------
+
+def _shard_wave_kernel(cfg, NL, R, G, GR, W, C, refs):
+    """W placement attempts over this shard's NL node rows, one launch.
+
+    The wavefront sweep (allocate_scan, ``wave_width`` > 1 under a mesh)
+    evaluates the next W task attempts of a popped job section against the
+    SAME capacity snapshot; this kernel is the shard-local sweep. Per task
+    column it reproduces _shard_cand_kernel's feasibility conjunction and
+    f32 score fold exactly, then extracts the column's top-C feasible rows
+    by (score desc, global index asc) via C masked (max, min-index-at-max)
+    reductions — the per-shard candidate lists the in-graph cross-shard
+    merge (allocate_scan._wave_combine) reduces to the global top-C, which
+    is exact because the global c-th best row is always within its own
+    shard's top-c. Env/state refs are identical to _shard_cand_kernel;
+    the per-task scalars widen to [1, W] ([R, W] for the request).
+
+    Outputs per capacity view: (C, W) entry scores (NEG-filled past the
+    shard's feasible count), (C, W) global row indices (the shard
+    sentinel off+NL past them), and (1, W) feasible-count and
+    raw-tie-at-local-best rows.
+    """
+    gpu = bool(cfg.enable_gpu)
+    it = iter(refs)
+    nxt = lambda: next(it)
+
+    rr_ref = nxt()                      # [R, W] f32 resource requests
+    gq_ref = nxt() if gpu else None     # [1, W] f32 gpu requests
+    pref_ref = nxt()                    # [1, W] i32 preferred node (-1)
+    tmpl_ref = nxt()                    # [1, W] i32 template id (clamped)
+    grp_ref = nxt()                     # [1, W] i32 OR-group id (-1 none)
+    voln_ref = nxt()                    # [1, W] i32 volume node pin (-1)
+    volok_ref = nxt()                   # [1, W] i32 volume feasible
+    rev_ref = nxt()                     # [1, W] i32 revocable flag
+    istgt_ref = nxt()                   # [1, W] i32 job == resv target
+    off_ref = nxt()                     # [1, 1] i32 shard global row base
+    tstat_ref = nxt()                   # [P, NL] template feasibility
+    tscore_ref = nxt()                  # [P, NL] taint-prefer score
+    nascore_ref = nxt()                 # [P, NL] NodeAffinity score
+    blocknr = nxt()[:] > 0              # [1, NL] tdm block-nonrevocable
+    blockall = nxt()[:] > 0             # [1, NL] tdm block-all
+    bonus = nxt()[:]                    # [1, NL] f32 tdm revocable bonus
+    locked = nxt()[:] > 0               # [1, NL] reservation locks
+    orfeas_ref = nxt()                  # [GR, NL] OR-group feasibility
+    rel_ref = nxt()                     # [R, NL] releasing
+    pip_ref = nxt()                     # [R, NL] pipelined
+    alo_ref = nxt()                     # [R, NL] allocatable capacity
+    cnt_ref = nxt()                     # [1, NL] pod counts
+    maxp_ref = nxt()                    # [1, NL] max pods
+    gid0_ref = nxt() if gpu else None   # [G, NL] gpu idle baseline
+    idle_ref = nxt()                    # [R, NL] live idle
+    pipe_ref = nxt()                    # [R, NL] live pipe_extra
+    podsx_ref = nxt()                   # [1, NL] f32 pods this cycle
+    gpux_ref = nxt() if gpu else None   # [G, NL] gpu charged this cycle
+    scn_o, ixn_o, cnn_o, tin_o = nxt(), nxt(), nxt(), nxt()
+    scf_o, ixf_o, cnf_o, tif_o = nxt(), nxt(), nxt(), nxt()
+
+    off = jnp.sum(off_ref[:], dtype=jnp.int32)
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, NL), 1) + off
+    big_i = off + jnp.int32(NL)         # sentinel past this shard's rows
+    idle = idle_ref[:]
+    pipe = pipe_ref[:]
+    podsx = podsx_ref[:]
+    alo = alo_ref[:]
+    rr_all = rr_ref[:]
+    prefs, tmpls, grps = pref_ref[:], tmpl_ref[:], grp_ref[:]
+    volns, voloks = voln_ref[:], volok_ref[:]
+    revs, istgts = rev_ref[:], istgt_ref[:]
+    if gpu:
+        gqs = gq_ref[:]
+        gidle = gid0_ref[:] - gpux_ref[:]
+
+    # wave-shared (task-independent) capacity terms, computed once
+    future = jnp.maximum(idle + rel_ref[:] - pip_ref[:] - pipe, 0.0)
+    pods_ok = (cnt_ref[:] + podsx) < maxp_ref[:]
+
+    outs = {k: [] for k in ("scn", "ixn", "cnn", "tin",
+                            "scf", "ixf", "cnf", "tif")}
+    for w in range(W):
+        pref = jnp.sum(prefs[:, w:w + 1], dtype=jnp.int32)
+        tmpl = jnp.sum(tmpls[:, w:w + 1], dtype=jnp.int32)
+        grp = jnp.sum(grps[:, w:w + 1], dtype=jnp.int32)
+        voln = jnp.sum(volns[:, w:w + 1], dtype=jnp.int32)
+        volok = jnp.sum(voloks[:, w:w + 1], dtype=jnp.int32) > 0
+        rev = jnp.sum(revs[:, w:w + 1], dtype=jnp.int32) > 0
+        is_tgt = jnp.sum(istgts[:, w:w + 1], dtype=jnp.int32) > 0
+        rr_col = rr_all[:, w:w + 1]                           # [R, 1]
+
+        trow = (pl.dslice(tmpl, 1), slice(None))
+        sfeas = tstat_ref[trow] > 0                           # [1, NL]
+        sfeas &= ~(blocknr & ~rev) & ~blockall
+        orrow = orfeas_ref[(pl.dslice(jnp.maximum(grp, 0), 1),
+                            slice(None))] > 0
+        sfeas &= orrow | (grp < 0)
+        sfeas &= volok & ((voln < 0) | (iota_n == voln))
+        sfeas &= ~locked | is_tgt
+        shared = sfeas & pods_ok
+        if gpu:
+            gr = gqs[:, w:w + 1]                              # [1, 1]
+            gpu_ok = (gr <= 0) | jnp.any(gidle >= gr - _EPS_FIT,
+                                         axis=0, keepdims=True)
+            shared &= gpu_ok
+        fit_now = jnp.all(rr_col <= idle + _EPS_FIT, axis=0, keepdims=True)
+        fit_fut = jnp.all(rr_col <= future + _EPS_FIT, axis=0,
+                          keepdims=True)
+        feas_now = shared & fit_now
+        feas_fut = shared & fit_fut
+
+        # f32 addition order matches allocate_scan exactly
+        score = _dyn_score(cfg, idle, alo, rr_col)
+        score = score + tscore_ref[trow]
+        score = score + (nascore_ref[trow] + jnp.where(rev, bonus, 0.0))
+        score = score + jnp.where((pref >= 0) & (iota_n == pref),
+                                  jnp.float32(100.0), jnp.float32(0.0))
+
+        def topc(feas):
+            masked0 = jnp.where(feas, score, NEG)
+            best0 = jnp.max(masked0, axis=1, keepdims=True)
+            tie = jnp.sum(((masked0 == best0) & feas).astype(jnp.int32),
+                          axis=1, keepdims=True)
+            n_f = jnp.sum(feas.astype(jnp.int32), axis=1, keepdims=True)
+            f = feas
+            sc_e, ix_e = [], []
+            for _ in range(C):
+                masked = jnp.where(f, score, NEG)
+                best = jnp.max(masked, axis=1, keepdims=True)
+                idx = jnp.min(jnp.where((masked == best) & f,
+                                        iota_n, big_i),
+                              axis=1, keepdims=True)
+                sc_e.append(best)
+                ix_e.append(idx)
+                f = f & (iota_n != idx)
+            return (jnp.concatenate(sc_e, axis=0),            # [C, 1]
+                    jnp.concatenate(ix_e, axis=0), n_f, tie)
+
+        sc, ix, n_f, tie = topc(feas_now)
+        outs["scn"].append(sc)
+        outs["ixn"].append(ix)
+        outs["cnn"].append(n_f)
+        outs["tin"].append(tie)
+        sc, ix, n_f, tie = topc(feas_fut)
+        outs["scf"].append(sc)
+        outs["ixf"].append(ix)
+        outs["cnf"].append(n_f)
+        outs["tif"].append(tie)
+
+    scn_o[:] = jnp.concatenate(outs["scn"], axis=1)
+    ixn_o[:] = jnp.concatenate(outs["ixn"], axis=1)
+    cnn_o[:] = jnp.concatenate(outs["cnn"], axis=1)
+    tin_o[:] = jnp.concatenate(outs["tin"], axis=1)
+    scf_o[:] = jnp.concatenate(outs["scf"], axis=1)
+    ixf_o[:] = jnp.concatenate(outs["ixf"], axis=1)
+    cnf_o[:] = jnp.concatenate(outs["cnf"], axis=1)
+    tif_o[:] = jnp.concatenate(outs["tif"], axis=1)
+
+
+def make_shard_wave_placer(cfg, NL: int, R: int, G: int, GR: int,
+                           W: int, C: int, interpret: bool = False):
+    """Build the wide wavefront candidate placer (sharding x wavefront).
+
+    Returns place(args...) with the input order documented in
+    _shard_wave_kernel; outputs the 8-tuple of per-view candidate lists:
+    (C, W) scores, (C, W) global indices, (1, W) feasible counts, (1, W)
+    raw ties for the now view, then the same for the future view. GPU
+    refs are absent when cfg.enable_gpu is False. ``NL`` is the
+    SHARD-LOCAL row count, ``W`` the wave width, ``C`` the candidate
+    depth (allocate_scan.wave_candidate_depth).
+    """
+    kernel = functools.partial(_shard_wave_kernel, cfg, NL, R, G, GR, W, C)
+    f32, i32 = jnp.float32, jnp.int32
+    out_shape = [
+        jax.ShapeDtypeStruct((C, W), f32),    # entry scores, now view
+        jax.ShapeDtypeStruct((C, W), i32),    # entry global rows, now
+        jax.ShapeDtypeStruct((1, W), i32),    # feasible count, now
+        jax.ShapeDtypeStruct((1, W), i32),    # raw ties at best, now
+        jax.ShapeDtypeStruct((C, W), f32),    # entry scores, future view
+        jax.ShapeDtypeStruct((C, W), i32),    # entry global rows, future
+        jax.ShapeDtypeStruct((1, W), i32),    # feasible count, future
+        jax.ShapeDtypeStruct((1, W), i32),    # raw ties at best, future
+    ]
+
+    def place(*args):
+        with jax.named_scope("volcano/pallas/shard_wave_candidates"):
+            return pl.pallas_call(
+                lambda *refs: kernel(refs),
+                out_shape=tuple(out_shape),
+                interpret=interpret,
+            )(*args)
+
+    return place

@@ -23,7 +23,8 @@ from ..arrays.labels import (EFFECT_NO_EXECUTE, EFFECT_NO_SCHEDULE,
                              TOL_EXISTS_ALL, TOL_EXISTS_KEY)
 from ..arrays.schema import SnapshotArrays
 from ..ops.allocate_scan import (MODE_ALLOCATED, MODE_NONE, MODE_PIPELINED,
-                                 AllocateConfig, AllocateExtras)
+                                 AllocateConfig, AllocateExtras,
+                                 normalize_wave, wave_candidate_depth)
 
 _EPS = 1e-5
 
@@ -317,6 +318,12 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
     flag off the oracle's historical behavior is byte-identical."""
     if extras is None:
         extras = AllocateExtras.neutral(snap)
+    # wavefront width (ISSUE 16): normalize_wave is the single authority
+    # for legal widths (pod-affinity / host-ports force W back to 1, like
+    # the kernel); W > 1 swaps the section walk for the wave mirror below
+    cfg = normalize_wave(cfg)
+    wave_w = int(cfg.wave_width)
+    wave_c = wave_candidate_depth(wave_w)
     job_share = np.asarray(extras.job_share)
     queue_deserved = np.asarray(extras.queue_deserved)
     ns_share = np.asarray(extras.ns_share)
@@ -401,15 +408,207 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
                 return g
         return -1
 
+    def _wave_eval(ji, t, idle_v, pipe_v, pods_v, gpux_v):
+        """Both feasibility views + score of task t against an arbitrary
+        capacity state (the wave mirror evaluates every slot twice: the
+        window-start snapshot for the candidate lists / TEL rows, the
+        live state for the actual commit decision)."""
+        sel, th = t_selector[t], t_tol_hash[t]
+        te, tm = t_tol_effect[t], t_tol_mode[t]
+        req, greq = resreq[t], t_gpu_req[t]
+        node_ok = (~(block_nonrevocable & ~task_revocable[t])
+                   & ~block_all
+                   & (or_feasible[task_or_group[t]][:N]
+                      if task_or_group[t] >= 0 else True)
+                   & vol_ok[t]
+                   & ((vol_node[t] < 0) | (np.arange(N) == vol_node[t]))
+                   & (~node_locked | (ji == target_job)))
+        f_now = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm,
+                                        idle_v, pods_v, greq, gpux_v)
+        fut_v = np.maximum(idle_v + releasing - pipelined0 - pipe_v, 0.0)
+        f_fut = node_ok & _feasible_one(nodes_np, req, sel, th, te, tm,
+                                        fut_v, pods_v, greq, gpux_v)
+        score = _score_one(cfg, nodes_np, req, idle_v, th, te, tm)
+        score = score + (template_na[t_template[t]]
+                         + (tdm_bonus if task_revocable[t]
+                            else np.float32(0.0)))
+        if task_pref_node[t] >= 0:
+            score = score + 100.0 * (np.arange(N) == task_pref_node[t])
+        return f_now, f_fut, score
+
+    def _wave_tel_row(ji, t, idle_v, pipe_v, pods_v, gpux_v):
+        """The sequential loop's per-family rejection block, against the
+        wave's window-start snapshot (kernel _wave_rej1: a replayed slot
+        is counted in the wave that finally processes it, vs THAT wave's
+        start state). Ports/affinity slots are structurally 0: both
+        features force wave_width back to 1 (normalize_wave)."""
+        sel, th = t_selector[t], t_tol_hash[t]
+        te, tm = t_tol_effect[t], t_tol_mode[t]
+        req, greq = resreq[t], t_gpu_req[t]
+        live = valid_sched
+        tmpl = _tmpl_ok(nodes_np, sel, th, te, tm)
+        blk = (block_nonrevocable & ~task_revocable[t]) | block_all
+        orr = (or_feasible[task_or_group[t]][:N]
+               if task_or_group[t] >= 0 else np.ones(N, bool))
+        volr = vol_ok[t] & ((vol_node[t] < 0)
+                            | (np.arange(N) == vol_node[t]))
+        lockr = node_locked & ~(ji == target_job)
+        pcf = (nodes_np.pod_count + pods_v) < nodes_np.max_pods
+        gidle2 = nodes_np.gpu_memory - nodes_np.gpu_used - gpux_v
+        gfit = (greq <= 0) | (gidle2 >= greq - _EPS).any(axis=-1)
+        fit_n = np.all(req[None, :] <= idle_v + _EPS, axis=-1)
+        fut_v = np.maximum(idle_v + releasing - pipelined0 - pipe_v, 0.0)
+        fit_f = np.all(req[None, :] <= fut_v + _EPS, axis=-1)
+        tel["pred_reject"] += np.asarray([
+            int((live & ~tmpl).sum()), int((live & blk).sum()),
+            int((live & ~orr).sum()), int((live & ~volr).sum()),
+            int((live & lockr).sum()), 0,
+            int((live & ~pcf).sum()), int((live & ~gfit).sum()),
+            int((live & ~fit_n).sum()), int((live & ~fit_f).sum()), 0])
+        tel["attempts"] += 1
+
+    def _wave_section(ji, slot0, ready0_dyn, can_batch, placed):
+        """Wavefront transliteration of one popped job's section walk
+        (ISSUE 16). Decision-wise this IS the sequential walk: the wave
+        commit rule is order-preserving by construction (capacity is
+        monotone non-increasing inside a section, so untouched rows keep
+        their window-start feasibility/score exactly; touched nodes are
+        rescored at the live state; a slot whose pre-wave top-C list is
+        exhausted by same-wave commits truncates the wave and replays),
+        which lets the mirror commit via the plain live-state argmax.
+        What the wave structure adds is the COUNTERS: waves / commits /
+        truncations / replays / the per-wave histogram exist only here,
+        and TEL rows are counted in the wave that finally processes a
+        slot, against that wave's window-start snapshot — exactly like
+        the kernel's _wave_body. Returns the new absolute cursor plus
+        the section tallies the gang finalize consumes."""
+        placed_sum32 = np.zeros(len(total_cap), np.float32)
+        n_alloc = n_pipe = 0
+        stopped = broke = False
+        wpos = slot0
+        n_adv = 0
+        while wpos < M and not stopped and not broke:
+            idle0 = idle.copy()
+            pipe0 = pipe_extra.copy()
+            pods0 = pods_extra.copy()
+            gpux0 = gpu_extra.copy()
+            touched: List[int] = []
+            trunc = False
+            trunc_pos = wave_w
+            commits = 0
+            for w in range(wave_w):
+                s_abs = wpos + w
+                if s_abs >= M or stopped or broke:
+                    continue
+                t = int(table[ji, s_abs])
+                if t < 0:
+                    continue
+                if best_effort[t]:
+                    if not trunc:
+                        n_adv += 1     # consumed, never queued
+                    continue
+                if trunc:
+                    # deferred: replays at the next wave's window head
+                    if collect_telemetry:
+                        tel["wave_replays"] += 1
+                    continue
+                # pre-wave candidate lists vs the window-start snapshot:
+                # feasible nodes by (score desc, index asc), top-C kept
+                f_n0, f_f0, sc0 = _wave_eval(ji, t, idle0, pipe0,
+                                             pods0, gpux0)
+                order = np.lexsort((np.arange(N), -sc0))
+                lst_n = [int(i) for i in order if f_n0[i]]
+                tset = set(touched)
+                dec_n = (any(e not in tset for e in lst_n[:wave_c])
+                         or len(lst_n) <= wave_c)
+                if cfg.enable_pipelining:
+                    lst_f = [int(i) for i in order if f_f0[i]]
+                    dec_f = (any(e not in tset for e in lst_f[:wave_c])
+                             or len(lst_f) <= wave_c)
+                # live-state views (== the kernel's list resolve: first
+                # untouched entry vs every touched node rescored)
+                f_nc, f_fc, scc = _wave_eval(ji, t, idle, pipe_extra,
+                                             pods_extra, gpu_extra)
+                fnd_n = bool(f_nc.any())
+                if cfg.enable_pipelining:
+                    conflict = (not dec_n) or (not fnd_n and not dec_f)
+                else:
+                    conflict = not dec_n
+                if conflict:
+                    trunc = True
+                    trunc_pos = w
+                    if collect_telemetry:
+                        tel["wave_replays"] += 1
+                    continue
+                do_alloc = fnd_n
+                do_pipe = (not fnd_n and cfg.enable_pipelining
+                           and bool(f_fc.any()))
+                if collect_telemetry:
+                    _wave_tel_row(ji, t, idle0, pipe0, pods0, gpux0)
+                n_adv += 1
+                if not (do_alloc or do_pipe):
+                    broke = True        # allocate.go:210-214
+                    continue
+                req, greq = resreq[t], t_gpu_req[t]
+                feas_c = f_nc if do_alloc else f_fc
+                node = int(np.argmax(np.where(feas_c, scc, -np.inf)))
+                if do_alloc:
+                    idle[node] -= req
+                    task_mode[t] = MODE_ALLOCATED
+                    n_alloc += 1
+                else:
+                    pipe_extra[node] += req
+                    task_mode[t] = MODE_PIPELINED
+                    n_pipe += 1
+                pods_extra[node] += 1
+                card = _pick_gpu(node, greq)
+                if card >= 0:
+                    gpu_extra[node, card] += greq
+                    task_gpu[t] = card
+                task_node[t] = node
+                placed.append(t)
+                placed_sum32 = placed_sum32 + resreq32[t]
+                touched.append(node)
+                commits += 1
+                if collect_telemetry:
+                    # ties of the fired view, pre-wave raw count (the
+                    # kernel reports the sweep's count; exact at the
+                    # window head, a cheap upper bound after commits)
+                    if do_alloc:
+                        tel["placed_now"] += 1
+                        tel["argmax_ties"] += _tie_count(sc0, f_n0)
+                    else:
+                        tel["placed_future"] += 1
+                        tel["argmax_ties"] += _tie_count(sc0, f_f0)
+                ready_aft = (not cfg.enable_gang
+                             or (ready0_dyn + n_alloc) >= jmin[ji])
+                remaining = any(table[ji, s] >= 0
+                                and not best_effort[table[ji, s]]
+                                for s in range(s_abs + 1, M))
+                if ready_aft and remaining and not can_batch:
+                    stopped = True      # yield (allocate.go:262-265)
+            if collect_telemetry:
+                tel["wave_hist"][min(commits,
+                                     len(tel["wave_hist"]) - 1)] += 1
+                tel["wave_commits"] += commits
+                if trunc:
+                    tel["wave_truncations"] += 1
+                tel["waves"] += 1
+            wpos += trunc_pos if trunc else wave_w
+        return slot0 + n_adv, stopped, placed_sum32, n_alloc, n_pipe
+
     # telemetry mirror state (telemetry/cycle.CycleTelemetry, kernel order)
     tel = None
     progressed = True
     if collect_telemetry:
-        from ..telemetry.cycle import PRED_FAMILIES
+        from ..telemetry.cycle import PRED_FAMILIES, WAVE_BINS
         tel = dict(pred_reject=np.zeros(len(PRED_FAMILIES), np.int64),
                    attempts=0, placed_now=0, placed_future=0,
                    gang_discarded=0, argmax_ties=0, rounds=0, pops=0,
-                   committed=np.zeros(len(total_cap), np.float32))
+                   committed=np.zeros(len(total_cap), np.float32),
+                   wave_hist=np.zeros(WAVE_BINS, np.int64),
+                   wave_commits=0, wave_truncations=0, wave_replays=0,
+                   waves=0)
         # cheapest pending request per job per dim (the kernel's
         # jobs_min_req): min over ALL real table slots, f32
         jobs_min_req = np.where(
@@ -504,7 +703,11 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
         ready0_dyn = int(jready0[ji] + job_alloc_count[ji])
         stopped = False
         slot = int(job_cursor[ji])
-        while slot < M:
+        if wave_w > 1:
+            (slot, stopped, placed_sum32,
+             n_alloc, n_pipe) = _wave_section(ji, slot, ready0_dyn,
+                                              can_batch, placed)
+        while wave_w == 1 and slot < M:
             t = table[ji, slot]
             if t < 0:
                 break               # past the row's real entries
@@ -720,6 +923,11 @@ def allocate_cpu(snap: SnapshotArrays, extras: AllocateExtras = None,
             "argmax_ties": tel["argmax_ties"],
             "rounds": tel["rounds"], "pops": tel["pops"],
             "dyn_launches": 0, "dyn_pops": 0, "dyn_early_stops": 0,
+            "wave_commits": int(tel["wave_commits"]),
+            "wave_truncations": int(tel["wave_truncations"]),
+            "wave_replays": int(tel["wave_replays"]),
+            "waves": int(tel["waves"]),
+            "wave_hist": [int(v) for v in tel["wave_hist"]],
         }
     return out
 

@@ -71,3 +71,14 @@ def publish_cycle_telemetry(tel: dict, metrics=None) -> None:
     metrics.set_gauge("cycle_dyn_launches", None, tel.get("dyn_launches", 0))
     metrics.set_gauge("cycle_dyn_early_stops", None,
                       tel.get("dyn_early_stops", 0))
+    # wavefront placement stats (ISSUE 16): counters for the totals, one
+    # gauge for the last cycle's commit efficiency — commits out of
+    # commit-or-replay attempts, the number the bench regression-guards
+    metrics.inc("wave_commits_total", tel.get("wave_commits", 0))
+    metrics.inc("wave_truncations_total", tel.get("wave_truncations", 0))
+    metrics.inc("wave_replays_total", tel.get("wave_replays", 0))
+    commits = tel.get("wave_commits", 0)
+    if tel.get("waves", 0):
+        metrics.set_gauge(
+            "wave_commit_ratio", None,
+            commits / max(commits + tel.get("wave_replays", 0), 1))

@@ -71,7 +71,13 @@ UNPLACED_REASONS = (
     #                       was still beyond the cursor when the cycle ended
 )
 
-_N_SCALARS = 10
+_N_SCALARS = 14
+
+#: committed-per-wave histogram width of the wavefront placement stats
+#: (ISSUE 16): bucket b counts waves that committed exactly b tasks, the
+#: last bucket saturating (``min(commits, WAVE_BINS - 1)``). 17 covers the
+#: full 0..16 range of every supported ``wave_width``.
+WAVE_BINS = 17
 
 
 @jax.tree_util.register_dataclass
@@ -105,6 +111,22 @@ class CycleTelemetry:
     dyn_early_stops: jax.Array  # i32: launches that popped fewer than the
     #                             requested budget (candidate miss / hdrf
     #                             guard / work exhausted)
+    wave_commits: jax.Array    # i32: tasks committed by wavefront waves
+    #                            (wave_width > 1 scan/sharded paths; 0
+    #                            elsewhere). Counted when made, like
+    #                            placed_now — a later gang discard does not
+    #                            uncount (the counters measure the wave
+    #                            mechanics, not the committed outcome).
+    wave_truncations: jax.Array  # i32: waves cut short because a slot's
+    #                              pre-wave top-C candidate list was
+    #                              exhausted by earlier same-wave commits
+    #                              (the in-graph conflict rule)
+    wave_replays: jax.Array    # i32: task attempts deferred to the next
+    #                            wave by a truncation (the conflicting slot
+    #                            and every active successor in its window)
+    waves: jax.Array           # i32: wavefront sweeps launched
+    wave_hist: jax.Array       # i32[WAVE_BINS]: committed-per-wave
+    #                            histogram (bucket min(commits, 16))
 
     @classmethod
     def zeros(cls, n_res: int) -> "CycleTelemetry":
@@ -115,7 +137,9 @@ class CycleTelemetry:
             committed=jnp.zeros(n_res, _F32),
             attempts=z, placed_now=z, placed_future=z, gang_discarded=z,
             argmax_ties=z, rounds=z, pops=z,
-            dyn_launches=z, dyn_pops=z, dyn_early_stops=z)
+            dyn_launches=z, dyn_pops=z, dyn_early_stops=z,
+            wave_commits=z, wave_truncations=z, wave_replays=z, waves=z,
+            wave_hist=jnp.zeros(WAVE_BINS, _I32))
 
     def packed(self) -> jax.Array:
         """i32[cycle_telemetry_size(R)]: the block as one i32 vector,
@@ -124,18 +148,22 @@ class CycleTelemetry:
         scalars = jnp.stack([
             self.attempts, self.placed_now, self.placed_future,
             self.gang_discarded, self.argmax_ties, self.rounds, self.pops,
-            self.dyn_launches, self.dyn_pops, self.dyn_early_stops])
+            self.dyn_launches, self.dyn_pops, self.dyn_early_stops,
+            self.wave_commits, self.wave_truncations, self.wave_replays,
+            self.waves])
         return jnp.concatenate([
             self.pred_reject.astype(jnp.int32),
             self.unplaced.astype(jnp.int32),
             jax.lax.bitcast_convert_type(self.committed.astype(jnp.float32),
                                          jnp.int32),
-            scalars.astype(jnp.int32)])
+            scalars.astype(jnp.int32),
+            self.wave_hist.astype(jnp.int32)])
 
 
 def cycle_telemetry_size(n_res: int) -> int:
     """Element count of CycleTelemetry.packed for an R-dim snapshot."""
-    return len(PRED_FAMILIES) + len(UNPLACED_REASONS) + n_res + _N_SCALARS
+    return (len(PRED_FAMILIES) + len(UNPLACED_REASONS) + n_res
+            + _N_SCALARS + WAVE_BINS)
 
 
 def unpack_cycle_telemetry(vec, n_res: int) -> dict:
@@ -149,7 +177,8 @@ def unpack_cycle_telemetry(vec, n_res: int) -> dict:
     committed = vec[off:off + n_res].view(np.float32); off += n_res
     names = ("attempts", "placed_now", "placed_future", "gang_discarded",
              "argmax_ties", "rounds", "pops", "dyn_launches", "dyn_pops",
-             "dyn_early_stops")
+             "dyn_early_stops", "wave_commits", "wave_truncations",
+             "wave_replays", "waves")
     out = {
         "pred_reject": {f: int(v) for f, v in zip(PRED_FAMILIES, pred)},
         "unplaced": {r: int(v) for r, v in zip(UNPLACED_REASONS, unpl)},
@@ -157,6 +186,8 @@ def unpack_cycle_telemetry(vec, n_res: int) -> dict:
     }
     for k, v in zip(names, vec[off:off + _N_SCALARS]):
         out[k] = int(v)
+    off += _N_SCALARS
+    out["wave_hist"] = [int(v) for v in vec[off:off + WAVE_BINS]]
     return out
 
 
