@@ -241,7 +241,13 @@ def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None,
                 # the ratio is inverted (a future change that erodes the
                 # batched-sweep speedup trips the guard)
                 ("wavefront_speedup",
-                 quality.get("wavefront_speedup"), True, None)):
+                 quality.get("wavefront_speedup"), True, None),
+                # static cost model: peak-live HBM and per-cycle
+                # collective bytes must not creep (>1 = footprint grew)
+                ("cost_peak_live_bytes",
+                 quality.get("cost_peak_live_bytes"), False, None),
+                ("cost_collective_bytes",
+                 quality.get("cost_collective_bytes"), False, None)):
             base = parsed.get(key)
             if cur is None or not base or (invert and not cur):
                 continue
@@ -1132,7 +1138,7 @@ tiers:
     # measured on a cycle violating a framework invariant. Subprocess on
     # the CPU backend so a TPU-poisoned parent process can't block it;
     # fail-soft like everything else in this script.
-    graphcheck_clean = graphcheck_sha = None
+    graphcheck_clean = graphcheck_sha = grpt = None
     if not os.environ.get("BENCH_SKIP_GRAPHCHECK"):
         import tempfile
         rpt = os.path.join(tempfile.gettempdir(), "graphcheck_bench.json")
@@ -1152,7 +1158,42 @@ tiers:
                 graphcheck_clean = bool(grpt["clean"])
                 graphcheck_sha = grpt["report_sha256"]
         except Exception:  # noqa: BLE001 — the record ships regardless
-            pass
+            grpt = None
+
+    # ---- static cost model (graphcheck `cost` family, ISSUE 17) ----------
+    # The north-star scoreboard: static peak-live HBM, per-cycle collective
+    # bytes, and their 100k-node / 1M-task projections travel with every
+    # bench record so a perf PR that regresses the static footprint is
+    # visible even when wall-clock numbers hold. Reuses the graphcheck
+    # subprocess's report when it ran (the full pass includes `cost`);
+    # otherwise runs the family alone. Fail-soft: BENCH_SKIP_COST=1 (or
+    # any failure) records null.
+    cost_block = None
+    if not os.environ.get("BENCH_SKIP_COST"):
+        import tempfile
+        try:
+            from volcano_tpu.analysis.costmodel import bench_cost_meta
+            cost_block = bench_cost_meta((grpt or {}).get("meta"))
+            if cost_block is None:
+                crpt = os.path.join(tempfile.gettempdir(),
+                                    "graphcheck_cost_bench.json")
+                genv = dict(os.environ, JAX_PLATFORMS="cpu")
+                proc = subprocess.run(
+                    [sys.executable, "-m", "volcano_tpu.analysis",
+                     "--fast", "--families", "cost", "--json", crpt],
+                    capture_output=True, text=True,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                    timeout=float(os.environ.get("BENCH_COST_TIMEOUT",
+                                                 300)), env=genv)
+                _emit_child_stderr("cost", proc.stderr)
+                if proc.returncode in (0, 1):
+                    with open(crpt) as f:
+                        cost_block = bench_cost_meta(
+                            (json.load(f) or {}).get("meta"))
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: cost block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+            cost_block = None
 
     # ---- cycle latency breakdown (volcano_tpu/telemetry/spans) -----------
     # The steady loop's per-phase span rings + pipeline occupancy, and the
@@ -1397,6 +1438,11 @@ tiers:
                         (fleet_block or {}).get("tenants_per_s_at_p99"),
                     "wavefront_speedup":
                         (wavefront_block or {}).get("speedup_vs_sequential"),
+                    "cost_peak_live_bytes":
+                        (cost_block or {}).get("peak_live_bytes"),
+                    "cost_collective_bytes":
+                        (cost_block or {}).get(
+                            "collective_bytes_per_cycle"),
                 })
         except Exception as e:  # noqa: BLE001 — fail-soft contract
             print("bench: regression guard failed: %s: %s"
@@ -1419,6 +1465,7 @@ tiers:
         "scenarios": scenario_block,
         "fleet": fleet_block,
         "wavefront": wavefront_block,
+        "cost": cost_block,
         "regression": regression_block,
     }
     if force_cpu:
@@ -1543,6 +1590,20 @@ tiers:
             (wavefront_block or {}).get("decisions_sha_equal_all_widths"),
         "wave_commit_ratio":
             (wavefront_block or {}).get("wave_commit_ratio"),
+        # static cost-model numbers in the parsed block: the regression
+        # guard ratios future runs against these same-backend baselines
+        "cost_peak_live_bytes": (cost_block or {}).get("peak_live_bytes"),
+        "cost_collective_bytes":
+            (cost_block or {}).get("collective_bytes_per_cycle"),
+        "cost_peak_live_northstar_bytes":
+            ((cost_block or {}).get("northstar") or {}).get(
+                "peak_live_bytes"),
+        "cost_collective_northstar_bytes":
+            ((cost_block or {}).get("northstar") or {}).get(
+                "collective_bytes"),
+        "cost_northstar_within_budget":
+            ((cost_block or {}).get("northstar") or {}).get(
+                "within_budget"),
         "device": str(jax.devices()[0]),
     }
     print(json.dumps(out))

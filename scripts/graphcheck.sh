@@ -12,10 +12,11 @@
 set -o pipefail
 cd "$(dirname "$0")/.."
 REPORT="${GRAPHCHECK_REPORT:-/tmp/graphcheck_report.json}"
-# the sharding family audits the compiled GSPMD module, which needs a
-# multi-device mesh — give the CPU backend the same 8 virtual devices
-# the test suite forces (tests/conftest.py) unless the caller already
-# set XLA_FLAGS
+# the sharding family audits the compiled GSPMD module and the cost
+# family's collective audit compiles the sharded entry at two node
+# widths — both need a multi-device mesh, so give the CPU backend the
+# same 8 virtual devices the test suite forces (tests/conftest.py)
+# unless the caller already set XLA_FLAGS
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 JAX_PLATFORMS=cpu python -m volcano_tpu.analysis --json "$REPORT" "$@"
 rc=$?

@@ -1,9 +1,10 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the EXACT pytest command from ROADMAP.md, wrapped so the
 # builder, CI, and the driver all run the identical thing, followed by the
-# graphcheck static-analysis gate (scripts/graphcheck.sh --fast — all
-# nine families incl. the telemetry, donation, and sharded-collective
-# contracts; skip with TIER1_SKIP_GRAPHCHECK=1).
+# graphcheck static-analysis gate (scripts/graphcheck.sh --fast — every
+# family in analysis.FAMILIES, incl. the telemetry, donation,
+# sharded-collective, cost-model, and metrics-hygiene contracts; skip
+# with TIER1_SKIP_GRAPHCHECK=1).
 #
 # Fast deterministic subset: excludes tests marked `slow` (registered in
 # tests/conftest.py; run `pytest -m slow` for the long tail — sharded

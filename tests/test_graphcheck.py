@@ -355,6 +355,239 @@ class TestShardingFamily:
         assert "sharding" in FAMILIES
 
 
+class TestCostFamily:
+    """Family `cost` (ISSUE 17): the whole-cycle static cost model. The
+    FLOP table and liveness sweep are pinned to hand-computable fixtures;
+    a planted O(N^2) node x node broadcast must trip the north-star HBM
+    projection gate and a planted full-node-axis all_gather the
+    collective gate; the real entries stay green (fast_report) with the
+    projection numbers in the report meta."""
+
+    def test_matmul_flops_match_textbook(self):
+        from volcano_tpu.analysis.costmodel import jaxpr_cost
+        A = 64
+        closed = jax.make_jaxpr(lambda a, b: a @ b)(
+            np.ones((A, A), np.float32), np.ones((A, A), np.float32))
+        # 2 * M * N * K — exactly what XLA's cost_analysis reports
+        assert jaxpr_cost(closed.jaxpr).flops == 2 * A ** 3
+
+    def test_liveness_fixture_hand_computed(self):
+        """(a + b) * 2.0 over 1000-element f32 vectors: with caller-owned
+        inputs the peak is inputs (8000) + tmp (4000) + out (4000) =
+        16000 bytes at the multiply; donating both inputs lets them die
+        at the add, so the multiply holds tmp + out over the still-live
+        donated sum = 12000."""
+        from volcano_tpu.analysis.costmodel import peak_live_bytes
+        a = np.ones(1000, np.float32)
+        closed = jax.make_jaxpr(lambda a, b: (a + b) * 2.0)(a, a)
+        assert peak_live_bytes(closed) == 16000
+        assert peak_live_bytes(closed, donated=(0, 1)) == 12000
+
+    def test_scan_cost_is_trip_aware(self):
+        from volcano_tpu.analysis.costmodel import jaxpr_cost
+
+        def loop(c):
+            def body(carry, _):
+                carry = carry + 1.0         # 1 flop / iteration
+                return carry * 2.0, None    # 1 flop / iteration
+            out, _ = jax.lax.scan(body, c, None, length=10)
+            return out
+
+        closed = jax.make_jaxpr(loop)(np.float32(0.0))
+        assert jaxpr_cost(closed.jaxpr).flops == 20
+
+    def test_planted_quadratic_trips_northstar_gate(self):
+        """The violation class the gate exists for: an intermediate
+        holding the full node x node product. At the audit sizes it is
+        tiny (256^2 f32 = 256 KiB) — only the fitted projection to the
+        100k-node north star exposes it (~52 TiB >> 16 GiB)."""
+        from volcano_tpu.analysis.costmodel import (_projection_findings,
+                                                    peak_live_bytes)
+
+        def quad(x):
+            return jnp.sum(x[:, None] * x[None, :])
+
+        pts = [(n, peak_live_bytes(jax.make_jaxpr(quad)(
+            np.ones(n, np.float32)))) for n in (128, 256)]
+        findings = _projection_findings("planted/quad", pts, 16 * 2 ** 30)
+        assert findings and "cost:northstar:planted/quad" in findings[0].key
+        from volcano_tpu.analysis.costmodel import fit_power
+        exponent, _ = fit_power(pts)
+        assert exponent > 1.8
+
+    def test_linear_entry_clears_northstar_gate(self):
+        from volcano_tpu.analysis.costmodel import (_projection_findings,
+                                                    peak_live_bytes)
+        pts = [(n, peak_live_bytes(jax.make_jaxpr(lambda x: x * 2.0)(
+            np.ones(n, np.float32)))) for n in (128, 256)]
+        assert _projection_findings("planted/linear", pts,
+                                    16 * 2 ** 30) == []
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs >= 2 devices for a mesh axis")
+    def test_planted_full_node_allgather_trips_collective_gate(self):
+        """A shard that re-gathers the FULL node block every scan
+        iteration: the all_gather output carries 2x the node axis, and
+        the trip-aware walk scales its per-cycle bytes by the scan
+        length."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from volcano_tpu.analysis.costmodel import (_site_findings,
+                                                    jaxpr_cost)
+        N, C, T = 32, 4, 5
+        mesh = Mesh(np.array(jax.devices()[:2]), ("nodes",))
+
+        def local(x):
+            def body(carry, _):
+                full = jax.lax.all_gather(x, "nodes", axis=0, tiled=True)
+                return carry + jnp.sum(full), None
+            s, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=T)
+            return x + s
+
+        fn = shard_map(local, mesh=mesh, in_specs=P("nodes", None),
+                       out_specs=P("nodes", None), check_rep=False)
+        closed = jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((N, C), jnp.float32))
+        cost = jaxpr_cost(closed.jaxpr)
+        findings = _site_findings(cost.sites, N, "planted")
+        assert findings and "cost:allgather:planted" in findings[0].key
+        # ring total per invocation: out_bytes * (D-1) = N*C*4 * 1,
+        # trip-scaled by the scan length
+        site = next(s for s in cost.sites if s.prim == "all_gather")
+        assert site.out_elems == N * C
+        assert site.bytes_per_cycle == N * C * 4 * (2 - 1) * T
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs >= 2 devices for a mesh axis")
+    def test_column_allgather_is_priced_in(self):
+        """A single node-axis COLUMN gather (the scan-carry sync the
+        design prices in) stays under the 2*N threshold."""
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+        from volcano_tpu.analysis.costmodel import (_site_findings,
+                                                    jaxpr_cost)
+        N = 32
+        mesh = Mesh(np.array(jax.devices()[:2]), ("nodes",))
+
+        def local(x):
+            col = jax.lax.all_gather(x[:, 0], "nodes", axis=0, tiled=True)
+            return x + jnp.sum(col)
+
+        fn = shard_map(local, mesh=mesh, in_specs=P("nodes", None),
+                       out_specs=P("nodes", None), check_rep=False)
+        closed = jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((N, 4), jnp.float32))
+        cost = jaxpr_cost(closed.jaxpr)
+        assert any(s.prim == "all_gather" for s in cost.sites)
+        assert _site_findings(cost.sites, N, "column") == []
+
+    def test_hlo_collective_bytes_counts_planted_allgather(self):
+        from volcano_tpu.analysis.costmodel import hlo_collective_bytes
+        from volcano_tpu.analysis.sharding import planted_allgather_hlo
+        hlo = planted_allgather_hlo(n_devices=2, n_nodes=128, cols=4)
+        # the partitioner must insert a full [128, 4] f32 gather; the
+        # ring total is out_bytes * (D-1) — at least that much traffic
+        assert hlo_collective_bytes(hlo, 2) >= 128 * 4 * 4 * (2 - 1)
+
+    def test_real_entry_projection_in_report(self, fast_report):
+        cost = fast_report["meta"]["cost"]
+        assert "allocate/scan" in cost["entries"]
+        ec = cost["entries"]["allocate/scan"]
+        assert ec["flops"] > 0 and ec["peak_live_bytes"] > 0
+        proj = cost["projection"]["allocate/scan"]
+        # the cycle's resident state is O(N): the fitted exponent must
+        # say so, and the north-star watermark must clear the budget
+        assert 0.5 < proj["peak_live_exponent"] < 1.3
+        assert proj["within_budget"]
+        ns = cost["northstar"]
+        assert ns["nodes"] == 100_000 and ns["tasks"] == 1_000_000
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="collective audit needs a mesh")
+    def test_real_collective_audit_in_report(self, fast_report):
+        coll = fast_report["meta"]["cost"]["collectives"]
+        assert coll["audited"]
+        # cross-shard bytes scale with devices/wave width, not the node
+        # axis: the fitted node exponent stays ~linear-or-below
+        assert coll["node_exponent"] < 1.3
+        assert coll["within_budget"]
+        assert coll["per_cycle_bytes"] > 0
+
+    def test_family_stats_in_report(self, fast_report):
+        from volcano_tpu.analysis import FAMILIES
+        stats = fast_report["meta"]["family_stats"]
+        assert set(stats) == set(FAMILIES)
+        assert all("elapsed_s" in s and "findings" in s
+                   for s in stats.values())
+
+    def test_bench_cost_meta_flattens_report(self, fast_report):
+        from volcano_tpu.analysis.costmodel import bench_cost_meta
+        block = bench_cost_meta(fast_report["meta"])
+        assert block["peak_live_bytes"] > 0
+        assert block["scan_flops"] > 0
+        assert block["northstar"]["peak_live_bytes"] > 0
+        assert block["northstar"]["within_budget"] is True
+        # fail-soft contract: no meta, no block — never a raise
+        assert bench_cost_meta(None) is None
+        assert bench_cost_meta({}) is None
+
+    def test_family_registered(self):
+        from volcano_tpu.analysis import FAMILIES
+        assert "cost" in FAMILIES
+
+
+class TestHygieneFamily:
+    """Family `hygiene` (ISSUE 17 satellite): every statically-named
+    metric emission has an explicit _HELP entry and the exposition keeps
+    the # HELP / # TYPE pair ahead of every sample family."""
+
+    def test_fires_on_planted_unhelped_gauge(self):
+        from volcano_tpu.analysis.hygiene import _coverage_findings
+        from volcano_tpu.metrics.metrics import _HELP
+        findings = _coverage_findings(
+            {"my_planted_gauge": "planted.py:1"}, _HELP)
+        assert findings and \
+            "hygiene:help-missing:my_planted_gauge" in findings[0].key
+
+    def test_fires_when_help_entry_removed(self, monkeypatch):
+        from volcano_tpu.analysis.hygiene import check_hygiene
+        from volcano_tpu.metrics import metrics as m
+        monkeypatch.delitem(m._HELP, "queue_share")
+        findings = check_hygiene()
+        assert any("hygiene:help-missing:queue_share" in f.key
+                   for f in findings)
+
+    def test_exposition_pair_check_fires_on_bare_sample(self):
+        from volcano_tpu.analysis.hygiene import _exposition_findings
+
+        class Stub:
+            def exposition(self):
+                return ("# HELP volcano_ok_total fine\n"
+                        "# TYPE volcano_ok_total counter\n"
+                        "volcano_ok_total 1\n"
+                        "volcano_rogue_total 1\n")
+
+        findings = _exposition_findings(Stub())
+        assert [f.key for f in findings] == \
+            ["hygiene:pair-missing:rogue_total"]
+
+    def test_discovers_alias_and_direct_emissions(self):
+        from volcano_tpu.analysis.hygiene import discovered_metric_names
+        names = discovered_metric_names()
+        # direct self.inc(...) site
+        assert "schedule_attempts_total" in names
+        # the g = self.set_gauge local-alias idiom (update_queue_family)
+        assert "queue_share" in names
+
+    def test_clean_on_live_repo(self):
+        from volcano_tpu.analysis.hygiene import check_hygiene
+        assert check_hygiene() == []
+
+    def test_family_registered(self):
+        from volcano_tpu.analysis import FAMILIES
+        assert "hygiene" in FAMILIES
+
+
 class TestDeriveBatchingErrorPaths:
     """Satellite: the documented error paths of the batching authority."""
 
@@ -447,9 +680,39 @@ class TestAllowlistPlumbing:
 
 
 @pytest.mark.slow
+def test_cost_flops_cross_check_xla_cost_analysis():
+    """Fidelity: the cost table's dot_general count matches XLA's own
+    Compiled.cost_analysis() exactly on a plain matmul, and stays
+    within an order of magnitude on a dot+transcendental composite
+    (our 10-flops/element transcendental convention vs XLA's)."""
+    from volcano_tpu.analysis.costmodel import jaxpr_cost
+
+    def _xla_flops(compiled):
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float((ca or {}).get("flops", 0.0))
+
+    A = 64
+    args = (np.ones((A, A), np.float32), np.ones((A, A), np.float32))
+    xla = _xla_flops(jax.jit(lambda a, b: a @ b).lower(*args).compile())
+    ours = jaxpr_cost(jax.make_jaxpr(lambda a, b: a @ b)(*args).jaxpr).flops
+    if xla:                         # backend may not report the counter
+        assert ours == int(xla)
+
+    comp = lambda a, b: jnp.sum(jnp.tanh(a @ b))        # noqa: E731
+    xla = _xla_flops(jax.jit(comp).lower(*args).compile())
+    ours = jaxpr_cost(jax.make_jaxpr(comp)(*args).jaxpr).flops
+    assert ours > 0
+    if xla:
+        assert xla / 10 <= ours <= xla * 10
+
+
+@pytest.mark.slow
 def test_full_graphcheck_cli_exits_zero(tmp_path):
     """Acceptance: `python -m volcano_tpu.analysis` exits 0 on the repo
-    with all six families enabled (full entry set, CLI surface)."""
+    with every registered family enabled (full entry set, CLI
+    surface)."""
     rpt = tmp_path / "graphcheck.json"
     proc = subprocess.run(
         [sys.executable, "-m", "volcano_tpu.analysis", "--json", str(rpt)],

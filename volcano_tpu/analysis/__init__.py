@@ -14,7 +14,8 @@ compiled_session conf presets, the ops/ cycle functions, both Pallas
 kernel builders) and turns each class into a CI failure instead of a
 driver-TPU surprise.
 
-Check families (all eleven run by default):
+Check families (all run by default; the authoritative list is the
+``FAMILIES`` tuple below — the CLI derives its help text from it):
 
 - ``purity``       — no pure_callback/io_callback/debug_callback
                      primitives anywhere in a compiled cycle.
@@ -41,6 +42,19 @@ Check families (all eleven run by default):
                      the per-core budget, the ``vmem_estimate_bytes``
                      gate never understates the traced truth, and the
                      north-star-scale projection clears the budget.
+- ``cost``         — the whole-cycle static cost model (costmodel.py):
+                     per-entry FLOPs / unfused HBM bytes / arithmetic
+                     intensity from a trip-count-aware per-primitive
+                     table, a donation-aware liveness sweep yielding the
+                     static peak-live HBM watermark per entry (gated
+                     against a per-chip budget, default 16 GiB), a
+                     collective-bytes audit of the sharded cycle (jaxpr
+                     collectives + GSPMD-inserted HLO collectives, with
+                     the cross-shard bytes' node-axis growth exponent
+                     gated), and a north-star projection: each entry
+                     traced at 2-3 problem sizes, power-law growth
+                     fitted, peak HBM + collective bytes projected to
+                     100k nodes / 1M tasks against the budget.
 - ``obligations``  — ``derive_batching`` stays the single authority for
                      the static-segment batching rule: the rule itself is
                      re-derived and re-verified, the illegal static-K +
@@ -83,6 +97,12 @@ Check families (all eleven run by default):
                      perturbing one tenant's stacked inputs leaves every
                      other tenant's packed decisions (digest included)
                      bit-identical.
+- ``hygiene``      — metrics exposition hygiene: an AST scan over the
+                     package finds every statically-named metric
+                     emission and requires an explicit ``_HELP`` entry
+                     (no generated filler text on /metrics), and a live
+                     exposition is checked for the ``# HELP``/``# TYPE``
+                     pair ahead of every sample family.
 
 Run ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh)
 for the CLI; tier-1 runs the same pass via tests/test_graphcheck.py.
@@ -99,7 +119,8 @@ import time
 from typing import List, Optional, Sequence
 
 FAMILIES = ("purity", "dtype", "gather", "wavefront", "recompile", "vmem",
-            "obligations", "telemetry", "donation", "sharding", "fleet")
+            "cost", "obligations", "telemetry", "donation", "sharding",
+            "fleet", "hygiene")
 
 
 @dataclasses.dataclass
@@ -135,6 +156,7 @@ def apply_allowlist(findings: Sequence[Finding]) -> List[Finding]:
 def run_graphcheck(families: Optional[Sequence[str]] = None,
                    fast: bool = False,
                    vmem_budget_bytes: Optional[int] = None,
+                   cost_hbm_budget_bytes: Optional[int] = None,
                    repo_root: Optional[str] = None) -> dict:
     """Run the requested check families and assemble the report dict.
 
@@ -143,6 +165,8 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     the full set. The report is machine-readable (see schema below) and
     carries a content sha so bench records can fingerprint the
     static-analysis state alongside the decision fingerprints.
+    ``meta["family_stats"]`` records per-family wall time and finding
+    counts so a creeping CI budget is attributable to one family.
     """
     families = list(families) if families else list(FAMILIES)
     unknown = [f for f in families if f not in FAMILIES]
@@ -152,14 +176,25 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
     t0 = time.time()
     findings: List[Finding] = []
     fam_meta = {}
+    fam_stats = {f: {"elapsed_s": 0.0, "findings": 0}
+                 for f in FAMILIES if f in families}
 
-    need_traces = bool({"purity", "dtype", "gather", "wavefront", "vmem"}
-                       & set(families))
+    def _timed(fam, check, *args, **kwargs):
+        ts = time.time()
+        out = check(*args, **kwargs)
+        fam_stats[fam]["elapsed_s"] += time.time() - ts
+        fam_stats[fam]["findings"] += len(out)
+        return out
+
+    need_traces = bool({"purity", "dtype", "gather", "wavefront", "vmem",
+                        "cost"} & set(families))
     traces = []
     if need_traces:
         from .entrypoints import build_traces
+        ts = time.time()
         traces = build_traces(fast=fast)
         fam_meta["traced_entry_points"] = [t.name for t in traces]
+        fam_meta["trace_build_s"] = round(time.time() - ts, 2)
 
     jaxpr_fams = {"purity", "dtype", "gather", "wavefront"} & set(families)
     if jaxpr_fams:
@@ -167,42 +202,58 @@ def run_graphcheck(families: Optional[Sequence[str]] = None,
                                   check_wavefront)
         for tr in traces:
             if "purity" in families:
-                findings += check_purity(tr)
+                findings += _timed("purity", check_purity, tr)
             if "dtype" in families:
-                findings += check_dtype(tr)
+                findings += _timed("dtype", check_dtype, tr)
             if "gather" in families:
-                findings += check_gather(tr)
+                findings += _timed("gather", check_gather, tr)
             if "wavefront" in families:
-                findings += check_wavefront(tr)
+                findings += _timed("wavefront", check_wavefront, tr)
 
     if "vmem" in families:
         from .vmem import check_vmem
-        findings += check_vmem(traces,
-                               budget_bytes=vmem_budget_bytes)
+        findings += _timed("vmem", check_vmem, traces,
+                           budget_bytes=vmem_budget_bytes)
+
+    if "cost" in families:
+        from .costmodel import check_cost
+        cost_meta = fam_meta.setdefault("cost", {})
+        findings += _timed("cost", check_cost, traces, fast=fast,
+                           hbm_budget_bytes=cost_hbm_budget_bytes,
+                           meta=cost_meta)
 
     if "recompile" in families:
         from .recompile import check_recompile
-        findings += check_recompile(fast=fast)
+        findings += _timed("recompile", check_recompile, fast=fast)
 
     if "obligations" in families:
         from .obligations import check_obligations
-        findings += check_obligations(repo_root=repo_root)
+        findings += _timed("obligations", check_obligations,
+                           repo_root=repo_root)
 
     if "telemetry" in families:
         from .telemetry import check_telemetry
-        findings += check_telemetry(fast=fast)
+        findings += _timed("telemetry", check_telemetry, fast=fast)
 
     if "donation" in families:
         from .donation import check_donation
-        findings += check_donation(fast=fast)
+        findings += _timed("donation", check_donation, fast=fast)
 
     if "sharding" in families:
         from .sharding import check_sharding
-        findings += check_sharding(fast=fast)
+        findings += _timed("sharding", check_sharding, fast=fast)
 
     if "fleet" in families:
         from .fleet import check_fleet
-        findings += check_fleet(fast=fast)
+        findings += _timed("fleet", check_fleet, fast=fast)
+
+    if "hygiene" in families:
+        from .hygiene import check_hygiene
+        findings += _timed("hygiene", check_hygiene, repo_root=repo_root)
+
+    for st in fam_stats.values():
+        st["elapsed_s"] = round(st["elapsed_s"], 2)
+    fam_meta["family_stats"] = fam_stats
 
     findings = apply_allowlist(findings)
     blocking = [f for f in findings if not f.allowlisted]

@@ -1,8 +1,10 @@
 """CLI: ``python -m volcano_tpu.analysis`` (wrapped by scripts/graphcheck.sh).
 
-Runs the eight graphcheck families over the repo's real entry points on the
-CPU backend, writes a machine-readable JSON report, prints human-readable
-findings, and exits with a stable code:
+Runs the graphcheck families (all of ``analysis.FAMILIES`` by default —
+the help text is derived from the tuple so it cannot drift) over the
+repo's real entry points on the CPU backend, writes a machine-readable
+JSON report, prints human-readable findings, and exits with a stable
+code:
 
     0  clean (no non-allowlisted findings)
     1  findings
@@ -18,6 +20,7 @@ import sys
 
 
 def main(argv=None) -> int:
+    from . import FAMILIES, run_graphcheck
     parser = argparse.ArgumentParser(
         prog="python -m volcano_tpu.analysis",
         description="graphcheck: trace-time static analysis of the "
@@ -30,7 +33,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--families", default=None,
         help="comma-separated subset of check families "
-             "(default: all eight)")
+             f"(default: all {len(FAMILIES)}: {', '.join(FAMILIES)})")
     parser.add_argument(
         "--fast", action="store_true",
         help="prune the traced-entry set to a representative subset "
@@ -40,11 +43,14 @@ def main(argv=None) -> int:
         help="override the per-core VMEM budget (default 12 MiB, the "
              "runtime auto-gate's bound)")
     parser.add_argument(
+        "--cost-hbm-budget-bytes", type=int, default=None,
+        help="override the cost family's per-chip HBM budget for the "
+             "peak-live and north-star projection gates (default 16 GiB)")
+    parser.add_argument(
         "--list-families", action="store_true",
         help="print the known families and exit")
     args = parser.parse_args(argv)
 
-    from . import FAMILIES, run_graphcheck
     if args.list_families:
         print("\n".join(FAMILIES))
         return 0
@@ -59,8 +65,10 @@ def main(argv=None) -> int:
     families = ([f.strip() for f in args.families.split(",") if f.strip()]
                 if args.families else None)
     try:
-        report = run_graphcheck(families=families, fast=args.fast,
-                                vmem_budget_bytes=args.vmem_budget_bytes)
+        report = run_graphcheck(
+            families=families, fast=args.fast,
+            vmem_budget_bytes=args.vmem_budget_bytes,
+            cost_hbm_budget_bytes=args.cost_hbm_budget_bytes)
     except Exception as e:  # noqa: BLE001 — stable exit code for harnesses
         print(f"graphcheck: internal error: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -78,11 +86,16 @@ def main(argv=None) -> int:
         if fdict["allowlisted"]:
             line += f" (allowed: {fdict['reason']})"
         print(line)
+    stats = report["meta"].get("family_stats") or {}
+    slowest = (max(stats, key=lambda k: stats[k]["elapsed_s"])
+               if stats else None)
+    slow_txt = (f", slowest family {slowest} "
+                f"({stats[slowest]['elapsed_s']}s)" if slowest else "")
     print(f"graphcheck: {'CLEAN' if report['clean'] else 'DIRTY'} — "
           f"{report['blocking_count']} blocking / "
           f"{report['finding_count']} total findings, "
           f"{len(report['meta'].get('traced_entry_points', []))} entry "
-          f"points traced, {report['elapsed_s']}s "
+          f"points traced, {report['elapsed_s']}s{slow_txt} "
           f"(report sha {report['report_sha256']}"
           + (f", written to {args.json})" if args.json else ")"))
     return 0 if report["clean"] else 1

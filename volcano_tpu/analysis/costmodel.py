@@ -1,0 +1,750 @@
+"""Graphcheck family 12: whole-cycle static cost model (ISSUE 17).
+
+The paper's hot loop is the entire scheduling cycle as ONE compiled
+program, and the ROADMAP's pod-slice target (100k nodes / 1M tasks in a
+sub-second cycle) is blocked on hardware this CI lacks. Until then, the
+only way an HBM blow-up or an O(nodes) cross-shard collective can be
+caught is statically — so this family walks every real entry's closed
+jaxpr (the same ``iter_eqns`` recursion the purity/dtype/gather families
+use) and derives four whole-cycle numbers per entry:
+
+1. **FLOPs + bytes touched** — a per-primitive cost table
+   (``_eqn_flops``), trip-count-aware for control flow: ``scan`` bodies
+   multiply by the static ``length`` param, ``while`` bodies by the
+   widest carry-aval axis (the repo's while loops iterate a padded axis
+   carried in the loop state — the job loop walks T task slots, the
+   wavefront walks the candidate list — so the widest carry dim is the
+   documented trip upper bound), ``cond`` takes the most expensive
+   branch. Bytes touched sum every equation's input+output avals times
+   its trip count: the unfused upper bound on HBM traffic.
+2. **Peak live bytes** — a donation-aware liveness sweep over the
+   top-level equation sequence (``peak_live_bytes``): the static HBM
+   watermark the entry needs, the number that must clear the per-chip
+   budget at pod scale.
+3. **Collective bytes** — cross-device traffic of every explicit
+   collective equation (``all_gather``/``psum``/``ppermute``/...)
+   sized against the mesh axis it runs over, trip-aware like the FLOP
+   walk, PLUS the GSPMD-inserted collectives of the compiled sharded
+   module (``hlo_collective_bytes``) — where the real entry's traffic
+   actually lives, since PR 7's design keeps its traced jaxpr
+   collective-free. Gate: per-cycle cross-shard bytes may scale with devices and
+   wave width (the trip multiplier prices the wave sweep), NEVER with
+   the node axis — a full-node-axis ``all_gather`` (output elements >=
+   2x nodes, the sharding family's threshold generalized to traced
+   collectives) and a super-linear node-scaling exponent both flag.
+4. **Arithmetic intensity + north-star projection** — each projection
+   entry is traced at 2-3 problem sizes (tracing is cheap: shapes are
+   abstract), per-component growth exponents are fit on the padded node
+   axis (the synthetic mix holds tasks at 10x nodes, exactly the
+   north-star ratio), and peak HBM / collective bytes are projected to
+   100k nodes / 1M tasks against a configurable per-chip HBM budget
+   (default 16 GiB, ``--cost-hbm-budget-bytes``).
+
+Model caveats, on purpose:
+
+- bytes touched is unfused (XLA fuses elementwise chains); it is an
+  upper bound and a *ratio* metric across PRs, not a prediction.
+- the liveness sweep charges an equation's inputs and outputs
+  simultaneously at its definition point (XLA need not alias in
+  place); donated entry invars die at their last use, non-donated
+  invars and constvars stay live to the end — that asymmetry IS the
+  donation contract (ops/fused_io.DeltaKernel donates the resident
+  buffers on accelerators), and the fixture test pins the arithmetic.
+- sub-jaxpr workspace (one iteration's internal peak) is added at the
+  owning equation: a per-iteration upper bound for scan/while bodies.
+
+The slow-marked fidelity test cross-checks the FLOP table against XLA's
+own ``Compiled.cost_analysis()`` where available (exact on a canonical
+matmul; an upper bound on real entries, whose while trips XLA counts
+once).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding
+
+#: per-chip HBM budget the watermark and the north-star projection gate
+#: against (v4/v5e class chips carry 16 GiB; --cost-hbm-budget-bytes)
+DEFAULT_HBM_BUDGET_BYTES = 16 * 2 ** 30
+
+#: the ROADMAP pod-slice target (projected onto the padded pow2 buckets
+#: the pack path would actually allocate)
+NS_NODES = 100_000
+NS_TASKS = 1_000_000
+
+#: ceiling on the fitted per-cycle collective-bytes growth exponent vs
+#: the node axis: the sharded design's column syncs are O(N) (exponent
+#: ~1), O(N^2) node-state re-materialization is the failure class; the
+#: margin absorbs fit noise from additive O(1) mesh terms
+COLLECTIVE_NODE_EXPONENT_LIMIT = 1.3
+
+#: problem sizes (nodes, jobs, tasks_per_job) traced for the projection
+#: fit — tasks stay at 10x nodes, the north-star mix, so one fitted
+#: exponent covers both axes. Padded N doubles per point (128/256/512).
+PROJECTION_SIZES_FAST = ((100, 250, 4), (200, 500, 4))
+PROJECTION_SIZES_FULL = ((100, 250, 4), (200, 500, 4), (400, 1000, 4))
+
+#: FLOP-free data movement: costs bytes, not arithmetic
+_DATA_MOVEMENT = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "slice", "dynamic_slice",
+    "dynamic_update_slice", "gather", "concatenate", "pad", "iota", "rev",
+    "squeeze", "expand_dims", "copy", "convert_element_type",
+    "bitcast_convert_type", "stop_gradient", "device_put", "select_n",
+})
+
+#: polynomial-approximated elementwise ops: ~10 flops/element
+_TRANSCENDENTAL = frozenset({
+    "exp", "exp2", "expm1", "log", "log2", "log1p", "tanh", "logistic",
+    "sqrt", "rsqrt", "cbrt", "pow", "integer_pow", "sin", "cos", "tan",
+    "erf", "erfc", "erf_inv", "atan2", "lgamma", "digamma",
+})
+
+#: reductions: one flop per INPUT element
+_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin", "reduce_precision",
+    "cumsum", "cummax", "cummin", "cumprod", "cumlogsumexp",
+})
+
+#: explicit cross-device collectives (shard_map bodies; GSPMD inserts
+#: more at compile time — the sharding family audits that HLO side)
+_COLLECTIVES = frozenset({
+    "all_gather", "psum", "pmax", "pmin", "ppermute", "all_to_all",
+    "reduce_scatter", "psum_scatter",
+})
+
+
+# --------------------------------------------------------------- cost table
+def _aval_bytes(aval) -> int:
+    """Static byte size of an abstract value (0 for tokens/opaque)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except (TypeError, ValueError):
+            return 0            # symbolic dim: price it as free
+    return n * dtype.itemsize
+
+
+def _elems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except (TypeError, ValueError):
+            return 0
+    return n
+
+
+def _dot_flops(eqn) -> int:
+    """2 * output_elements * contracted_elements — the textbook count
+    (exactly what XLA's cost_analysis reports for a plain matmul)."""
+    (lc, _rc), _batch = eqn.params["dimension_numbers"]
+    lhs_shape = getattr(eqn.invars[0].aval, "shape", ())
+    contract = 1
+    for d in lc:
+        contract *= int(lhs_shape[d])
+    out = sum(_elems(v.aval) for v in eqn.outvars)
+    return 2 * out * contract
+
+
+def _eqn_flops(eqn) -> int:
+    """Per-primitive FLOP model. Deliberately coarse: exact for
+    dot_general and reductions, 10/element for transcendentals,
+    1/output-element for everything else arithmetic, 0 for pure data
+    movement — good enough for growth exponents and cross-PR ratios."""
+    name = eqn.primitive.name
+    if name in _DATA_MOVEMENT or name in _COLLECTIVES:
+        return 0
+    if name == "dot_general":
+        return _dot_flops(eqn)
+    if name in _REDUCTIONS:
+        return sum(_elems(getattr(v, "aval", None)) for v in eqn.invars)
+    if name == "sort":
+        n = max((_elems(getattr(v, "aval", None)) for v in eqn.invars),
+                default=0)
+        return n * max(1, int(math.log2(n)) if n > 1 else 1)
+    if name in ("scatter", "scatter-add", "scatter_add", "scatter-mul",
+                "scatter_mul", "scatter-min", "scatter-max", "scatter_min",
+                "scatter_max"):
+        # one update op per update element (operand copy is movement)
+        upd = getattr(eqn.invars[-1], "aval", None)
+        return _elems(upd)
+    per = 10 if name in _TRANSCENDENTAL else 1
+    return per * sum(_elems(getattr(v, "aval", None)) for v in eqn.outvars)
+
+
+# ------------------------------------------------- trip-aware jaxpr walk
+class CollectiveSite:
+    """One traced collective equation: its per-invocation output size
+    (the node-axis gate keys on it) and its trip-scaled per-cycle
+    cross-device bytes."""
+
+    __slots__ = ("prim", "loc", "out_elems", "bytes_per_cycle",
+                 "axis_size")
+
+    def __init__(self, prim, loc, out_elems, bytes_per_cycle, axis_size):
+        self.prim = prim
+        self.loc = loc
+        self.out_elems = out_elems
+        self.bytes_per_cycle = bytes_per_cycle
+        self.axis_size = axis_size
+
+
+class JaxprCost:
+    """Accumulated cost of one (sub-)jaxpr: FLOPs, unfused HBM bytes
+    touched, fleet-wide collective bytes, per-primitive breakdown, and
+    the collective sites for the node-axis gate."""
+
+    __slots__ = ("flops", "hbm_bytes", "collective_bytes", "by_prim",
+                 "sites")
+
+    def __init__(self):
+        self.flops = 0
+        self.hbm_bytes = 0
+        self.collective_bytes = 0
+        self.by_prim: Dict[str, List[int]] = {}
+        self.sites: List[CollectiveSite] = []
+
+    def add(self, other: "JaxprCost", mult: int = 1) -> None:
+        self.flops += other.flops * mult
+        self.hbm_bytes += other.hbm_bytes * mult
+        self.collective_bytes += other.collective_bytes * mult
+        for k, (f, b) in other.by_prim.items():
+            cur = self.by_prim.setdefault(k, [0, 0])
+            cur[0] += f * mult
+            cur[1] += b * mult
+        for s in other.sites:
+            self.sites.append(CollectiveSite(
+                s.prim, s.loc, s.out_elems, s.bytes_per_cycle * mult,
+                s.axis_size))
+
+
+def _axis_sizes(params, axis_env) -> int:
+    """Product of the mesh-axis sizes a collective runs over."""
+    names = params.get("axes") or params.get("axis_name") or ()
+    if not isinstance(names, (tuple, list)):
+        names = (names,)
+    d = 1
+    for n in names:
+        d *= int(axis_env.get(n, 1))
+    return max(d, int(params.get("axis_size", 1)))
+
+
+def _collective_cost(eqn, axis_env) -> Tuple[int, int]:
+    """(fleet-wide cross-device bytes, axis size) for one collective eqn.
+
+    Avals inside shard_map bodies are per-device LOCAL views; the counts
+    below are the standard ring-algorithm fleet totals: all_gather moves
+    in_bytes*D*(D-1) = out_bytes*(D-1); psum (ring all-reduce)
+    2*in_bytes*(D-1); ppermute one local buffer per device; all_to_all /
+    reduce_scatter (D-1)/D of the local operand per device.
+    """
+    name = eqn.primitive.name
+    in_b = sum(_aval_bytes(getattr(v, "aval", None)) for v in eqn.invars)
+    out_b = sum(_aval_bytes(getattr(v, "aval", None)) for v in eqn.outvars)
+    d = _axis_sizes(eqn.params, axis_env)
+    if d <= 1:
+        return 0, d
+    if name == "all_gather":
+        return out_b * (d - 1), d
+    if name in ("psum", "pmax", "pmin"):
+        return 2 * in_b * (d - 1), d
+    if name == "ppermute":
+        return in_b * d, d
+    if name in ("all_to_all", "reduce_scatter", "psum_scatter"):
+        return in_b * (d - 1), d
+    return in_b * d, d
+
+
+def _while_trip(eqn) -> int:
+    """Trip-count upper bound for a ``while`` eqn from its carry avals:
+    the widest carried axis (the repo's loops walk a padded axis held in
+    the carry — T task slots for the job loop, the candidate list for
+    the wavefront sweep). Scalar-only carries count as one trip."""
+    nconsts = (int(eqn.params.get("cond_nconsts", 0))
+               + int(eqn.params.get("body_nconsts", 0)))
+    trip = 1
+    for v in eqn.invars[nconsts:]:
+        shape = getattr(getattr(v, "aval", None), "shape", ())
+        for dim in shape or ():
+            try:
+                trip = max(trip, int(dim))
+            except (TypeError, ValueError):
+                continue
+    return trip
+
+
+def _sub_jaxprs(eqn) -> List:
+    """Closed sub-jaxprs in an eqn's params (same discovery rule as
+    jaxpr_audit.iter_eqns, kept in closed form for const access)."""
+    from jax.core import ClosedJaxpr, Jaxpr
+    subs = []
+    for v in eqn.params.values():
+        if isinstance(v, (ClosedJaxpr, Jaxpr)):
+            subs.append(v)
+        elif isinstance(v, (list, tuple)):
+            subs += [x for x in v if isinstance(x, (ClosedJaxpr, Jaxpr))]
+    return subs
+
+
+def _inner(j):
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def jaxpr_cost(jaxpr, axis_env: Optional[dict] = None) -> JaxprCost:
+    """Trip-count-aware cost of a (sub-)jaxpr. ``axis_env`` maps mesh
+    axis names to sizes for collectives without an explicit axis_size
+    param (threaded through shard_map bodies)."""
+    from .jaxpr_audit import _loc
+    axis_env = axis_env or {}
+    acc = JaxprCost()
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        subs = _sub_jaxprs(eqn)
+        if subs:
+            env = axis_env
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                shape = getattr(mesh, "shape", None)
+                if shape:
+                    env = dict(axis_env, **{str(k): int(v)
+                                            for k, v in dict(shape).items()})
+            if name == "scan":
+                trip = max(1, int(eqn.params.get("length", 1)))
+                for s in subs:
+                    acc.add(jaxpr_cost(_inner(s), env), trip)
+            elif name == "while":
+                trip = _while_trip(eqn)
+                for s in subs:
+                    acc.add(jaxpr_cost(_inner(s), env), trip)
+            elif name == "cond":
+                branches = [jaxpr_cost(_inner(s), env) for s in subs]
+                if branches:
+                    acc.add(max(branches, key=lambda c: c.flops))
+            else:
+                # pjit / custom_* / remat / pallas_call / shard_map: once
+                # (pallas grids in this repo use whole-array BlockSpecs)
+                for s in subs:
+                    acc.add(jaxpr_cost(_inner(s), env))
+            continue
+        flops = _eqn_flops(eqn)
+        moved = (sum(_aval_bytes(getattr(v, "aval", None))
+                     for v in eqn.invars)
+                 + sum(_aval_bytes(getattr(v, "aval", None))
+                       for v in eqn.outvars))
+        acc.flops += flops
+        acc.hbm_bytes += moved
+        cur = acc.by_prim.setdefault(name, [0, 0])
+        cur[0] += flops
+        cur[1] += moved
+        if name in _COLLECTIVES:
+            cbytes, d = _collective_cost(eqn, axis_env)
+            acc.collective_bytes += cbytes
+            out_e = sum(_elems(getattr(v, "aval", None))
+                        for v in eqn.outvars)
+            acc.sites.append(CollectiveSite(name, _loc(eqn), out_e,
+                                            cbytes, d))
+    return acc
+
+
+# ------------------------------------------------------- liveness sweep
+def _workspace(eqn) -> int:
+    """Per-invocation internal peak of an eqn's sub-jaxprs (one
+    iteration's workspace for scan/while; the widest branch for cond) —
+    added at the owning equation in the top-level sweep."""
+    best = 0
+    for s in _sub_jaxprs(eqn):
+        j = _inner(s)
+        best = max(best, _sweep(j, donated_ids=frozenset()))
+    return best
+
+
+def _sweep(jaxpr, donated_ids=frozenset(), const_bytes: int = 0) -> int:
+    """Liveness peak over one jaxpr's equation sequence (helper of
+    :func:`peak_live_bytes`, which documents the model)."""
+    n = len(jaxpr.eqns)
+    defined = {}                                    # id(var) -> bytes
+    last: Dict[int, int] = {}                       # id(var) -> last use
+    for v in list(jaxpr.constvars) + list(jaxpr.invars):
+        defined[id(v)] = _aval_bytes(v.aval)
+        # non-donated inputs are caller-owned for the whole call; only
+        # donated invars may die at their last use
+        last[id(v)] = n if id(v) not in donated_ids else -1
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if id(v) in defined and last.get(id(v), n) != n:
+                last[id(v)] = i
+        for v in eqn.outvars:
+            defined[id(v)] = _aval_bytes(getattr(v, "aval", None))
+            last[id(v)] = i                         # dead unless used later
+    for v in jaxpr.outvars:
+        if id(v) in defined:
+            last[id(v)] = n
+    frees: Dict[int, List[int]] = {}
+    for vid, i in last.items():
+        if i < n:
+            frees.setdefault(i, []).append(defined[vid])
+    live = const_bytes + sum(defined[id(v)] for v in
+                             list(jaxpr.constvars) + list(jaxpr.invars))
+    peak = live
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(_aval_bytes(getattr(v, "aval", None))
+                    for v in eqn.outvars)
+        peak = max(peak, live + out_b + _workspace(eqn))
+        live += out_b
+        live -= sum(frees.get(i, []))
+    return peak
+
+
+def peak_live_bytes(closed, donated: Tuple[int, ...] = ()) -> int:
+    """Donation-aware static HBM watermark of a closed jaxpr.
+
+    Model: walk the top-level equation sequence; a value is live from
+    its defining equation to its last use. Non-donated entry inputs and
+    consts stay live to the end (the caller owns those buffers for the
+    whole call); invar indices in ``donated`` die at their last use —
+    exactly the XLA donation contract. An equation transiently holds its
+    inputs AND outputs (no in-place aliasing assumed) plus its
+    sub-jaxprs' per-iteration workspace. Deliberate upper bound; see the
+    module docstring for the fixture-pinned arithmetic.
+    """
+    jaxpr = closed.jaxpr
+    donated_ids = frozenset(id(jaxpr.invars[i]) for i in donated
+                            if 0 <= i < len(jaxpr.invars))
+    return _sweep(jaxpr, donated_ids=donated_ids)
+
+
+# -------------------------------------------------- fit + projection
+def fit_power(points) -> Tuple[float, float]:
+    """Least-squares power-law fit ``y = c * x**e`` over (x, y) points
+    in log-log space; returns (exponent, coefficient). Points with
+    y <= 0 are clamped to 1 byte (log-safe); a single point fits a
+    linear model through the origin exponent-1 style (e=1)."""
+    pts = [(float(x), max(float(y), 1.0)) for x, y in points]
+    if not pts:
+        return 0.0, 0.0
+    if len(pts) == 1:
+        x, y = pts[0]
+        return 1.0, y / x
+    lx = [math.log(x) for x, _ in pts]
+    ly = [math.log(y) for _, y in pts]
+    mx = sum(lx) / len(lx)
+    my = sum(ly) / len(ly)
+    var = sum((a - mx) ** 2 for a in lx)
+    if var == 0:
+        return 0.0, math.exp(my)
+    e = sum((a - mx) * (b - my) for a, b in zip(lx, ly)) / var
+    c = math.exp(my - e * mx)
+    return e, c
+
+
+def project_power(points, x_target: float) -> Tuple[float, float]:
+    """(projected y at x_target, fitted exponent) for (x, y) points."""
+    e, c = fit_power(points)
+    return c * float(x_target) ** e, e
+
+
+def northstar_padded_nodes() -> int:
+    """The padded node-axis width the pack path would allocate at the
+    north-star scale (the projection's x target)."""
+    from ..arrays.schema import bucket
+    return bucket(NS_NODES)
+
+
+def _projection_findings(entry: str, points, budget: int,
+                         kind: str = "peak-live",
+                         x_target: Optional[int] = None) -> List[Finding]:
+    """Gate a fitted north-star projection against the HBM budget.
+    Shared by the live check and the planted O(N^2) test."""
+    x_ns = x_target or northstar_padded_nodes()
+    value, exponent = project_power(points, x_ns)
+    if value <= budget:
+        return []
+    return [Finding(
+        family="cost",
+        key=(f"cost:northstar:{entry}:{kind}:"
+             f"projected={int(value)}:budget={budget}"),
+        where=entry,
+        what=(f"north-star projection ({NS_NODES} nodes / {NS_TASKS} "
+              f"tasks, padded N={x_ns}) of {kind} bytes for '{entry}' is "
+              f"{int(value):,} (growth exponent {exponent:.2f} fit over "
+              f"{[int(x) for x, _ in points]}-node traces), over the "
+              f"{budget:,}-byte per-chip HBM budget — the full-scale "
+              "cycle cannot be resident; shard or re-tile the "
+              "super-linear component before hardware ever sees it"))]
+
+
+def _site_findings(sites, n_nodes: int, where: str) -> List[Finding]:
+    """Per-collective node-axis gate: a traced all_gather whose OUTPUT
+    reaches 2x the node axis re-materializes multi-column node state on
+    every device (the sharding family's HLO threshold applied to
+    explicit collectives, which an interpret-mode launch can hide from
+    the HLO side). Shared by the live check and the planted test."""
+    out: List[Finding] = []
+    seen = set()
+    for s in sites:
+        if s.prim != "all_gather" or s.out_elems < 2 * n_nodes:
+            continue
+        key = f"cost:allgather:{where}:{s.loc}:{s.out_elems}"
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Finding(
+            family="cost", key=key, where=f"{where} @ {s.loc}",
+            what=(f"traced all_gather output carries {s.out_elems} "
+                  f"elements (>= 2*{n_nodes} nodes) across a "
+                  f"{s.axis_size}-way mesh — per-cycle cross-shard bytes "
+                  "must scale with devices and wave width, never the "
+                  "node axis; keep the gather mesh-sized or column-wide "
+                  "and resolve winners with the cross-shard combine")))
+    return out
+
+
+# ------------------------------------------------------------ entry cost
+class EntryCost:
+    """The per-entry summary the report's meta carries."""
+
+    __slots__ = ("flops", "hbm_bytes", "peak_live_bytes",
+                 "collective_bytes", "sites", "by_prim")
+
+    def __init__(self, closed, donated=(), axis_env=None):
+        cost = jaxpr_cost(closed.jaxpr, axis_env)
+        self.flops = int(cost.flops)
+        self.hbm_bytes = int(cost.hbm_bytes)
+        self.collective_bytes = int(cost.collective_bytes)
+        self.sites = cost.sites
+        self.by_prim = cost.by_prim
+        self.peak_live_bytes = int(peak_live_bytes(closed, donated))
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        return round(self.flops / self.hbm_bytes, 4) if self.hbm_bytes \
+            else 0.0
+
+    def to_meta(self) -> dict:
+        top = sorted(self.by_prim.items(), key=lambda kv: -kv[1][1])[:5]
+        return {
+            "flops": self.flops,
+            "hbm_bytes_touched": self.hbm_bytes,
+            "peak_live_bytes": self.peak_live_bytes,
+            "collective_bytes": self.collective_bytes,
+            "arithmetic_intensity": self.arithmetic_intensity,
+            "top_primitives_by_bytes": {
+                k: {"flops": v[0], "bytes": v[1]} for k, v in top},
+        }
+
+
+def entry_cost(closed, donated=(), axis_env=None) -> EntryCost:
+    return EntryCost(closed, donated=donated, axis_env=axis_env)
+
+
+# ------------------------------------------------ compiled-HLO collectives
+#: any collective op with its HLO dtype + output shape, async-start or
+#: sync form; the -done halves restate the shape and are excluded so a
+#: start/done pair counts once
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s*([a-z0-9]+)\[([0-9,]*)\][^ ]*\s+"
+    r"(all-gather|all-reduce|collective-permute|all-to-all|"
+    r"reduce-scatter)(?:-start)?\(")
+
+_HLO_ITEMSIZE = {"pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+                 "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+                 "s32": 4, "u32": 4, "f32": 4,
+                 "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+
+
+def hlo_collective_bytes(hlo_text: str, devices: int) -> int:
+    """Fleet-wide per-cycle collective payload of a compiled GSPMD
+    module: per collective op, output bytes scaled by the ring-algorithm
+    device factor ((D-1) for gather/reduce flavors, D for permute).
+    The model the node-scaling fit and north-star projection run on —
+    explicit jaxpr collectives are the other half (jaxpr_cost)."""
+    total = 0
+    d = max(devices, 2)
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        dtype, dims, op = m.group(1), m.group(2), m.group(3)
+        elems = 1
+        for x in dims.split(","):
+            if x:
+                elems *= int(x)
+        nbytes = elems * _HLO_ITEMSIZE.get(dtype, 4)
+        total += nbytes * (d if op == "collective-permute" else d - 1)
+    return total
+
+
+# --------------------------------------------------------------- the check
+def _collective_audit(sizes, budget: int, meta: dict) -> List[Finding]:
+    """Audit the REAL sharded update+cycle entry at 2 node sizes on a
+    2-device mesh, both halves of the collective story:
+
+    - jaxpr level: explicit collective equations (shard_map bodies) hit
+      the per-site node-axis gate — zero on the real entry by design
+      (PR 7's no-O(N)-gather contract), load-bearing for hand-written
+      shard_map comms and the planted test;
+    - HLO level: the GSPMD-inserted collectives of the compiled module
+      (where the real traffic lives), totalled by
+      :func:`hlo_collective_bytes`, fitted for a node-scaling exponent
+      and projected to north-star scale against the per-chip budget.
+    """
+    import jax
+
+    from ..arrays.schema import bucket
+    from ..parallel import mesh_for_nodes
+    from .sharding import _audit_kernel
+
+    if jax.device_count() < 2:
+        meta["audited"] = False
+        meta["reason"] = "fewer than two devices visible"
+        return []
+    findings: List[Finding] = []
+    points = []
+    jaxpr_bytes = 0
+    devices = 2
+    where = f"ops/fused_io.ShardedDeltaKernel[{devices}dev]"
+    for size in sizes:
+        kernel = _audit_kernel(
+            mesh_for_nodes(bucket(size[0]), devices),
+            f"fused_cycle_costaudit{size[0]}", size=size)
+        args = kernel.example_delta_args(256)
+        closed = jax.make_jaxpr(kernel.traceable)(*args)
+        env = {str(k): int(v)
+               for k, v in dict(kernel.mesh.shape).items()}
+        cost = jaxpr_cost(closed.jaxpr, env)
+        findings += _site_findings(cost.sites, kernel.n_nodes, where)
+        jaxpr_bytes = max(jaxpr_bytes, cost.collective_bytes)
+        hlo = kernel._fn.lower(*args).compile().as_text()
+        points.append((kernel.n_nodes,
+                       hlo_collective_bytes(hlo, devices)
+                       + cost.collective_bytes))
+    per_cycle = points[-1][1]
+    projected, exponent = project_power(points, northstar_padded_nodes())
+    meta.update({
+        "audited": True,
+        "devices": devices,
+        "points": [[int(x), int(y)] for x, y in points],
+        "per_cycle_bytes": int(per_cycle),
+        "jaxpr_explicit_bytes": int(jaxpr_bytes),
+        "node_exponent": round(exponent, 3),
+        "northstar_bytes": int(projected),
+        "northstar_bytes_per_chip": int(projected / devices),
+        "within_budget": projected / devices <= budget,
+    })
+    if per_cycle and exponent > COLLECTIVE_NODE_EXPONENT_LIMIT:
+        findings.append(Finding(
+            family="cost",
+            key=(f"cost:collective-scaling:exponent="
+                 f"{exponent:.2f}:limit={COLLECTIVE_NODE_EXPONENT_LIMIT}"),
+            where=where,
+            what=(f"per-cycle cross-shard collective bytes grow as "
+                  f"N^{exponent:.2f} over {[p[0] for p in points]}-node "
+                  f"compiles (limit {COLLECTIVE_NODE_EXPONENT_LIMIT}) — "
+                  "cross-shard traffic must scale with devices and wave "
+                  "width, never super-linearly with the node axis")))
+    if projected / devices > budget:
+        findings += _projection_findings(where, points, budget,
+                                         kind="collective")
+    return findings
+
+
+def check_cost(traces, fast: bool = False,
+               hbm_budget_bytes: Optional[int] = None,
+               meta: Optional[dict] = None) -> List[Finding]:
+    """The cost family: per-entry summaries + gates over the shared
+    trace set, the north-star projection fit, and the sharded
+    collective audit. ``meta`` (mutated in place when given) receives
+    the numbers the JSON report and the bench ``cost`` block carry."""
+    from .entrypoints import cost_projection_traces
+
+    budget = hbm_budget_bytes or DEFAULT_HBM_BUDGET_BYTES
+    meta = meta if meta is not None else {}
+    meta["hbm_budget_bytes"] = budget
+    meta["northstar"] = {"nodes": NS_NODES, "tasks": NS_TASKS,
+                         "padded_nodes": northstar_padded_nodes()}
+    findings: List[Finding] = []
+
+    entries = meta.setdefault("entries", {})
+    for tr in traces:
+        ec = entry_cost(tr.closed, donated=getattr(tr, "donated", ()))
+        entries[tr.name] = ec.to_meta()
+        n = int(tr.dims.get("N", 0)) if tr.dims else 0
+        if n:
+            findings += _site_findings(ec.sites, n, tr.name)
+        if ec.peak_live_bytes > budget:
+            findings.append(Finding(
+                family="cost",
+                key=(f"cost:{tr.name}:peak={ec.peak_live_bytes}"
+                     f":budget={budget}"),
+                where=tr.name,
+                what=(f"static peak live bytes of '{tr.name}' is "
+                      f"{ec.peak_live_bytes:,} at the AUDIT size, over "
+                      f"the {budget:,}-byte per-chip HBM budget")))
+
+    # north-star projection: re-trace the projection entries at the fit
+    # sizes (tracing is abstract — no compile, no real arrays)
+    proj_meta = meta.setdefault("projection", {})
+    for name, pts in cost_projection_traces(fast=fast):
+        peak_pts = []
+        for n_padded, closed, donated in pts:
+            peak_pts.append((n_padded, peak_live_bytes(closed, donated)))
+        projected, exponent = project_power(peak_pts,
+                                            northstar_padded_nodes())
+        proj_meta[name] = {
+            "points": [[int(x), int(y)] for x, y in peak_pts],
+            "peak_live_exponent": round(exponent, 3),
+            "northstar_peak_live_bytes": int(projected),
+            "within_budget": projected <= budget,
+        }
+        findings += _projection_findings(name, peak_pts, budget)
+
+    coll_meta = meta.setdefault("collectives", {})
+    findings += _collective_audit(
+        PROJECTION_SIZES_FAST, budget, coll_meta)
+    return findings
+
+
+# ------------------------------------------------------------- bench hook
+def bench_cost_meta(report_meta: Optional[dict]) -> Optional[dict]:
+    """Flatten a graphcheck report's ``meta["cost"]`` into the bench
+    ``cost`` block (fail-soft: None in, None out; every lookup
+    null-safe). The headline numbers feed ``_regression_guard``."""
+    cost = (report_meta or {}).get("cost") or {}
+    entries = cost.get("entries") or {}
+    if not entries:
+        return None
+    peak_entry = max(entries,
+                     key=lambda k: entries[k].get("peak_live_bytes", 0))
+    proj = cost.get("projection") or {}
+    ns_peak = max((v.get("northstar_peak_live_bytes", 0)
+                   for v in proj.values()), default=None)
+    coll = cost.get("collectives") or {}
+    scan = entries.get("allocate/scan") or entries[peak_entry]
+    return {
+        "hbm_budget_bytes": cost.get("hbm_budget_bytes"),
+        "peak_live_bytes": entries[peak_entry].get("peak_live_bytes"),
+        "peak_live_entry": peak_entry,
+        "scan_flops": scan.get("flops"),
+        "scan_arithmetic_intensity": scan.get("arithmetic_intensity"),
+        "collective_bytes_per_cycle": coll.get("per_cycle_bytes"),
+        "collective_node_exponent": coll.get("node_exponent"),
+        "northstar": {
+            "nodes": (cost.get("northstar") or {}).get("nodes"),
+            "tasks": (cost.get("northstar") or {}).get("tasks"),
+            "peak_live_bytes": ns_peak,
+            "collective_bytes": coll.get("northstar_bytes"),
+            "within_budget": (
+                all(v.get("within_budget", True) for v in proj.values())
+                and coll.get("within_budget", True)),
+        },
+    }

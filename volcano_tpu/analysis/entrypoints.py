@@ -129,6 +129,10 @@ class EntryTrace:
     closed: object                  # jax.core.ClosedJaxpr
     dims: Dict[str, object]
     cfg: Optional[object] = None    # AllocateConfig when applicable
+    #: entry invar indices the jitted wrapper donates (the cost family's
+    #: liveness sweep frees those at last use; empty on CPU, where
+    #: ops/fused_io.donation_for_backend declines donation)
+    donated: tuple = ()
 
 
 def _allocate_cfgs(fast: bool):
@@ -228,7 +232,8 @@ def build_traces(fast: bool = False) -> List[EntryTrace]:
         dk = DeltaKernel(make_allocate_cycle(_scan_cfg), (snap, extras))
         closed = jax.make_jaxpr(dk.traceable)(*dk.example_delta_args())
         traces.append(EntryTrace("fused_io/delta_update", closed,
-                                 _dims(snap, _scan_cfg, extras), _scan_cfg))
+                                 _dims(snap, _scan_cfg, extras), _scan_cfg,
+                                 donated=tuple(dk.donate_argnums)))
 
         # compiled_session conf presets (in-graph plugin extras included)
         from ..framework.compiled_session import make_conf_cycle
@@ -271,6 +276,50 @@ def build_traces(fast: bool = False) -> List[EntryTrace]:
         traces.append(EntryTrace("ops/preempt", closed, _dims(snap)))
 
     return traces
+
+
+def cost_projection_traces(fast: bool = False) -> List[tuple]:
+    """(entry_name, [(padded_N, closed_jaxpr, donated), ...]) traced at
+    the cost family's projection sizes (costmodel.PROJECTION_SIZES_*) —
+    the raw material of the north-star growth-exponent fit.
+
+    Traced WITHOUT enable_x64: the cost model prices the production
+    32-bit byte widths (the dtype family separately proves no 64-bit
+    intermediate exists, so the x64 trace would carry the same shapes).
+    Tracing stays abstract — no compile, no real arrays — so the 512-node
+    point costs the same as the 128-node one.
+    """
+    import jax
+    from ..ops.allocate_scan import make_allocate_cycle
+    from .costmodel import PROJECTION_SIZES_FAST, PROJECTION_SIZES_FULL
+
+    sizes = PROJECTION_SIZES_FAST if fast else PROJECTION_SIZES_FULL
+    packed = [_snap_extras(s) for s in sizes]
+    cfgs = dict(_allocate_cfgs(fast=True))
+    names = (("allocate/scan",) if fast
+             else ("allocate/scan", "allocate/wave4"))
+    out: List[tuple] = []
+    for name in names:
+        cycle = make_allocate_cycle(cfgs[name])
+        pts = []
+        for snap, extras in packed:
+            closed = jax.make_jaxpr(cycle)(snap, extras)
+            pts.append((snap.nodes.idle.shape[0], closed, ()))
+        out.append((name, pts))
+    if not fast:
+        # the steady-state delta entry: donation-aware, one kernel per
+        # size (the scatter+cycle program the production loop runs)
+        from ..ops.fused_io import DeltaKernel
+        cycle = make_allocate_cycle(cfgs["allocate/scan"])
+        pts = []
+        for snap, extras in packed:
+            dk = DeltaKernel(cycle, (snap, extras))
+            closed = jax.make_jaxpr(dk.traceable)(
+                *dk.example_delta_args())
+            pts.append((snap.nodes.idle.shape[0], closed,
+                        tuple(dk.donate_argnums)))
+        out.append(("fused_io/delta_update", pts))
+    return out
 
 
 def recompile_probes(fast: bool = False) -> List[tuple]:

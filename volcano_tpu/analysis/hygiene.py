@@ -1,0 +1,154 @@
+"""Graphcheck family 13: metrics exposition hygiene (ISSUE 17 satellite).
+
+Strict Prometheus parsers require every metric family on /metrics to
+carry a ``# HELP`` / ``# TYPE`` pair. The exposition layer
+(metrics/metrics.py ``_meta_lines``) always emits the pair — but for a
+name missing from the curated ``_HELP`` table it generates a filler text
+from the metric name, which is exactly the drift PRs 3-12 kept fixing by
+hand: a new counter lands, dashboards show "cycle replays total" instead
+of an operator-useful sentence, and nobody notices until a reviewer
+greps. This family makes the invariant mechanical:
+
+- an AST scan over the package finds every statically-named metric
+  emission — ``*.inc("name", ...)``, ``*.set_gauge("name", ...)``,
+  ``*._hist("name", ...)``, including the local-alias idiom
+  ``g = self.set_gauge; g("name", ...)`` — and each discovered name
+  must have an EXPLICIT ``_HELP`` entry;
+- a structural check on a live registry proves the exposition still
+  emits the HELP/TYPE pair ahead of every sample family (counters,
+  gauges, and the histogram bucket/count/sum series).
+
+Dynamically-composed names (f-strings, variables) are out of scope for
+the static half by construction; the structural half still covers them
+at runtime via the generated-default path.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional
+
+from . import Finding
+
+#: Metrics registry methods whose first positional str argument is a
+#: metric base name (metrics/metrics.py)
+_METRIC_METHODS = frozenset({"inc", "set_gauge", "_hist"})
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _EmissionVisitor(ast.NodeVisitor):
+    """Collect statically-named metric emissions in one module."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.names: Dict[str, str] = {}
+        self._aliases: set = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # the local-alias idiom: g = self.set_gauge
+        if (isinstance(node.value, ast.Attribute)
+                and node.value.attr in _METRIC_METHODS):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._aliases.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        hit = ((isinstance(fn, ast.Attribute)
+                and fn.attr in _METRIC_METHODS)
+               or (isinstance(fn, ast.Name) and fn.id in self._aliases))
+        if hit and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                self.names.setdefault(arg.value,
+                                      f"{self.rel}:{node.lineno}")
+        self.generic_visit(node)
+
+
+def discovered_metric_names(root: Optional[str] = None) -> Dict[str, str]:
+    """name -> "file.py:line" of its first statically-named emission,
+    over every module in the volcano_tpu package."""
+    root = root or _package_root()
+    out: Dict[str, str] = {}
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, os.path.dirname(root))
+            try:
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=rel)
+            except (OSError, SyntaxError):
+                continue
+            v = _EmissionVisitor(rel)
+            v.visit(tree)
+            for name, where in v.names.items():
+                out.setdefault(name, where)
+    return out
+
+
+def _coverage_findings(names: Dict[str, str],
+                       help_map: Dict[str, str]) -> List[Finding]:
+    """Every discovered emission name needs an explicit _HELP entry.
+    Shared by the live check and the planted-gauge test."""
+    out: List[Finding] = []
+    for name in sorted(set(names) - set(help_map)):
+        out.append(Finding(
+            family="hygiene",
+            key=f"hygiene:help-missing:{name}",
+            where=names[name],
+            what=(f"metric '{name}' (emitted at {names[name]}) has no "
+                  "explicit _HELP entry in metrics/metrics.py — the "
+                  "exposition would fall back to a generated filler "
+                  "text; write the operator-facing sentence")))
+    return out
+
+
+def _exposition_findings(metrics=None) -> List[Finding]:
+    """Structural check: every sample family in the exposition is
+    preceded by its ``# HELP`` / ``# TYPE`` pair."""
+    out: List[Finding] = []
+    if metrics is None:
+        from ..metrics.metrics import Metrics
+        metrics = Metrics()
+        metrics.inc("schedule_attempts_total", labels={"result": "ok"})
+        metrics.set_gauge("is_leader", None, 1.0)
+        metrics.observe_cycle(0.001)        # histogram family
+    declared = set()
+    for line in metrics.exposition().splitlines():
+        if line.startswith("# HELP volcano_") \
+                or line.startswith("# TYPE volcano_"):
+            declared.add(line.split()[2][len("volcano_"):])
+            continue
+        if not line.startswith("volcano_"):
+            continue
+        base = line.split("{")[0].split(" ")[0][len("volcano_"):]
+        for suffix in ("_bucket", "_count", "_sum"):
+            if base.endswith(suffix) and base[:-len(suffix)] in declared:
+                base = base[:-len(suffix)]
+                break
+        if base not in declared:
+            out.append(Finding(
+                family="hygiene",
+                key=f"hygiene:pair-missing:{base}",
+                where="metrics/metrics.py",
+                what=(f"exposition sample 'volcano_{base}' appears "
+                      "without a preceding # HELP / # TYPE pair — "
+                      "strict Prometheus parsers reject the payload "
+                      "(keep _meta_lines ahead of every family)")))
+    return out
+
+
+def check_hygiene(repo_root: Optional[str] = None) -> List[Finding]:
+    from ..metrics.metrics import _HELP
+    root = (os.path.join(repo_root, "volcano_tpu")
+            if repo_root else _package_root())
+    findings = _coverage_findings(discovered_metric_names(root), _HELP)
+    findings += _exposition_findings()
+    return findings

@@ -174,23 +174,25 @@ def planted_gather_pallas(n_devices: int = 2, n_nodes: int = 32,
     return closed, rows_per
 
 
-def _audit_kernel(mesh, entry: str, use_pallas=None):
+def _audit_kernel(mesh, entry: str, use_pallas=None, size=None):
     """Build the real sharded update+cycle entry on a small real snapshot
     (same pack path production uses) over ``mesh``. ``use_pallas``
     selects the kernel path exactly like the conf knob — "interpret"
-    builds the shard-local pallas candidate launch (ISSUE 14)."""
+    builds the shard-local pallas candidate launch (ISSUE 14).
+    ``size`` overrides the audit problem size (the cost family's
+    node-scaling fit traces the same entry at two node widths)."""
     import dataclasses
 
     from ..ops.allocate_scan import (AllocateConfig, derive_batching,
                                      make_allocate_cycle)
     from ..ops.fused_io import ShardedDeltaKernel
     from ..parallel import node_leaf_mask
-    from .entrypoints import _snap_extras
+    from .entrypoints import _AUDIT_SIZE, _snap_extras
 
     # the standard audit size (N=128): the node axis must dominate the
     # task/job axes so the O(tasks+jobs) packed-decision replication
     # stays clearly below the 2*N threshold
-    snap, extras = _snap_extras()
+    snap, extras = _snap_extras(size or _AUDIT_SIZE)
     cfg = dataclasses.replace(
         derive_batching(AllocateConfig(binpack_weight=1.0, enable_gpu=False),
                         has_proportion=False), use_pallas=use_pallas)

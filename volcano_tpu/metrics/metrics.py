@@ -179,6 +179,85 @@ _HELP = {
     "sidecar_replay_evictions_total":
         "Per-tenant sidecar replay-cache epochs evicted by the bounded "
         "LRU (VOLCANO_SIDECAR_EPOCH_CAP)",
+    # per-cycle decision readback gauges (telemetry.publish)
+    "cycle_tasks_allocated":
+        "Tasks bound to nodes by the last scheduling cycle",
+    "cycle_tasks_pipelined":
+        "Tasks the last cycle carried as pipelined (in-flight) work",
+    "cycle_gang_discarded_tasks":
+        "Tasks discarded by the in-graph gang (minAvailable) filter in "
+        "the last cycle",
+    "cycle_argmax_ties":
+        "Node-score argmax ties broken by index order in the last cycle "
+        "(a proxy for score-plateau sensitivity)",
+    "cycle_rounds":
+        "Scheduling rounds the last cycle's fixed-trip scan executed",
+    "cycle_pops":
+        "Priority-queue pops the last cycle performed in-graph",
+    "cycle_dyn_launches":
+        "Segments the dynamic early-stop cycle launched last cycle",
+    "cycle_dyn_early_stops":
+        "Dynamic cycles that stopped before the worst-case trip count "
+        "because the queue drained",
+    "cycle_replays_total":
+        "Wavefront task attempts replayed into a later wave by the "
+        "host-side runtime (cumulative across cycles)",
+    "cycle_upload_bytes":
+        "Host-to-device bytes uploaded by the last delta fuse (the "
+        "O(changed rows) payload, not the full snapshot)",
+    "sharded_resharding_copies_total":
+        "Resident buffers that left a sharded cycle with a different "
+        "sharding than they entered (must stay 0: each one is a "
+        "per-iteration resharding copy)",
+    # DRF / queue scorecard gauges (update_queue_family), mirroring
+    # upstream volcano's queue_* exposition names
+    "queue_allocated_milli_cpu":
+        "CPU milli-cores currently allocated to the queue",
+    "queue_allocated_memory_bytes":
+        "Memory bytes currently allocated to the queue",
+    "queue_request_milli_cpu":
+        "CPU milli-cores requested by the queue's pending+running tasks",
+    "queue_request_memory_bytes":
+        "Memory bytes requested by the queue's pending+running tasks",
+    "queue_deserved_milli_cpu":
+        "CPU milli-cores the DRF plugin computed as the queue's "
+        "deserved share",
+    "queue_deserved_memory_bytes":
+        "Memory bytes the DRF plugin computed as the queue's deserved "
+        "share",
+    "queue_share":
+        "Dominant-resource share of the queue (allocated / deserved)",
+    "queue_weight":
+        "Configured scheduling weight of the queue",
+    "queue_overused":
+        "1 if the queue's share exceeds its deserved allocation, else 0",
+    "queue_pod_group_inqueue_count":
+        "PodGroups of the queue in Inqueue state",
+    "queue_pod_group_pending_count":
+        "PodGroups of the queue in Pending state",
+    "queue_pod_group_running_count":
+        "PodGroups of the queue in Running state",
+    "queue_pod_group_unknown_count":
+        "PodGroups of the queue in Unknown state",
+    "namespace_share":
+        "Dominant-resource share of the namespace",
+    "namespace_weight":
+        "Configured scheduling weight of the namespace",
+    "namespace_weighted_share":
+        "Namespace share divided by its weight (the value proportion "
+        "plugins compare across namespaces)",
+    # fleet resync / dispatch counters (fleet/scheduler.py)
+    "resync_retried":
+        "Bind/evict intents re-driven by the fleet resync loop",
+    "resync_succeeded":
+        "Bind/evict intents the fleet resync loop confirmed applied",
+    "resync_dropped":
+        "Bind/evict intents the fleet resync loop abandoned after "
+        "exhausting retries",
+    "resync_tasks":
+        "Tasks touched by the last fleet resync sweep",
+    "schedule_attempts":
+        "Fleet per-tenant schedule attempts, by result",
 }
 
 
