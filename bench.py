@@ -42,7 +42,9 @@ node-axis sharded-cycle comparison subprocess), BENCH_SKIP_SCENARIOS=1
 (skip the scheduling-quality scenario block; BENCH_SCENARIO_CYCLES sets
 its horizon, default 16), BENCH_SKIP_RESTART=1 (skip the crash-consistent
 checkpoint/restore restart block), BENCH_SKIP_FAILOVER=1 (skip the
-warm-standby HA failover block), BENCH_SKIP_FLEET=1 (skip the
+warm-standby HA failover block), BENCH_SKIP_MESHLOSS=1 (skip the
+elastic-mesh device-loss shrink/regrow block; BENCH_MESHLOSS_TIMEOUT sets
+its subprocess cap, default 900s), BENCH_SKIP_FLEET=1 (skip the
 multi-tenant fleet serving block; BENCH_FLEET_TENANTS / BENCH_FLEET_CYCLES
 size it), BENCH_SKIP_WAVEFRONT=1 (skip the wavefront width sweep;
 BENCH_WAVE_NODES / BENCH_WAVE_JOBS size its churn workload).
@@ -247,7 +249,13 @@ def _regression_guard(force_cpu, steady_loop_ms, sub_tpu_ms, quality=None,
                 ("cost_peak_live_bytes",
                  quality.get("cost_peak_live_bytes"), False, None),
                 ("cost_collective_bytes",
-                 quality.get("cost_collective_bytes"), False, None)):
+                 quality.get("cost_collective_bytes"), False, None),
+                # elastic-mesh recovery: quarantine->serving-again latency
+                # and the shrunk mesh's steady cycle must not creep
+                ("remesh_ms_p50",
+                 quality.get("remesh_ms_p50"), False, None),
+                ("post_shrink_steady_ms_p50",
+                 quality.get("post_shrink_steady_ms_p50"), False, None)):
             base = parsed.get(key)
             if cur is None or not base or (invert and not cur):
                 continue
@@ -1029,6 +1037,57 @@ tiers:
                   % (type(e).__name__, e), file=sys.stderr)
             robustness_block = None
 
+    # ---- elastic-mesh degradation block (volcano_tpu/chaos/meshloss) -----
+    # The ISSUE 20 probe: persistent device_loss faults quarantine devices
+    # and shrink the sharded serving mesh 8->4->2, probation regrows it to
+    # full width, decisions stay sha-identical to the clean run, and the
+    # flap leg proves the probation backoff bounds re-mesh churn. Runs as
+    # a subprocess on the CPU backend with 8 forced virtual devices (like
+    # the multichip block) so a GSPMD failure can't take the record down.
+    # remesh_ms_p50 (quarantine -> serving again, dominated by the shrunk
+    # mesh's GSPMD compile) and the post-shrink steady-cycle p50 feed the
+    # regression guard. BENCH_SKIP_MESHLOSS=1 skips; failure records null.
+    if not os.environ.get("BENCH_SKIP_MESHLOSS"):
+        try:
+            menv = dict(os.environ, JAX_PLATFORMS="cpu",
+                        XLA_FLAGS=os.environ.get(
+                            "XLA_FLAGS",
+                            "--xla_force_host_platform_device_count=8"))
+            proc = subprocess.run(
+                [sys.executable, "-m", "volcano_tpu.chaos",
+                 "--smoke", "--meshloss"],
+                capture_output=True, text=True,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=float(os.environ.get("BENCH_MESHLOSS_TIMEOUT",
+                                             900)), env=menv)
+            _emit_child_stderr("meshloss", proc.stderr)
+            if proc.returncode in (0, 1):
+                mrpt = json.loads(proc.stdout)
+                legs = mrpt.get("legs") or {}
+                loss = legs.get("loss_scan") or {}
+                flap = legs.get("flap_scan") or {}
+                robustness_block = dict(robustness_block or {})
+                robustness_block["meshloss"] = {
+                    "ok": mrpt.get("ok"),
+                    "failures": mrpt.get("failures"),
+                    "width_sequence": loss.get("width_sequence"),
+                    "decisions_equal_clean":
+                        loss.get("decisions_equal_clean"),
+                    "mesh_shrinks": loss.get("mesh_shrinks"),
+                    "mesh_regrows": loss.get("mesh_regrows"),
+                    "post_shrink_resharding_copies":
+                        loss.get("post_shrink_resharding_copies"),
+                    "remesh_ms_p50": loss.get("remesh_ms_p50"),
+                    "post_shrink_steady_ms_p50":
+                        loss.get("post_shrink_steady_ms_p50"),
+                    "flap_remesh_events": flap.get("remesh_events"),
+                    "flap_probation_interval":
+                        flap.get("probation_interval_after"),
+                }
+        except Exception as e:  # noqa: BLE001 — fail-soft contract
+            print("bench: meshloss block failed: %s: %s"
+                  % (type(e).__name__, e), file=sys.stderr)
+
     # ---- crash-consistent restart block (volcano_tpu/chaos/restart) ------
     # The restart probe: process_kill at all three phases (pre-dispatch /
     # in-flight / post-drain), each restored from the crash-consistent
@@ -1443,6 +1502,12 @@ tiers:
                     "cost_collective_bytes":
                         (cost_block or {}).get(
                             "collective_bytes_per_cycle"),
+                    "remesh_ms_p50":
+                        ((robustness_block or {}).get("meshloss")
+                         or {}).get("remesh_ms_p50"),
+                    "post_shrink_steady_ms_p50":
+                        ((robustness_block or {}).get("meshloss")
+                         or {}).get("post_shrink_steady_ms_p50"),
                 })
         except Exception as e:  # noqa: BLE001 — fail-soft contract
             print("bench: regression guard failed: %s: %s"
@@ -1590,6 +1655,20 @@ tiers:
             (wavefront_block or {}).get("decisions_sha_equal_all_widths"),
         "wave_commit_ratio":
             (wavefront_block or {}).get("wave_commit_ratio"),
+        # elastic-mesh numbers in the parsed block: remesh latency and
+        # post-shrink steady cycle, baselines for the regression guard
+        "remesh_ms_p50":
+            ((robustness_block or {}).get("meshloss")
+             or {}).get("remesh_ms_p50"),
+        "post_shrink_steady_ms_p50":
+            ((robustness_block or {}).get("meshloss")
+             or {}).get("post_shrink_steady_ms_p50"),
+        "meshloss_decisions_equal_clean":
+            ((robustness_block or {}).get("meshloss")
+             or {}).get("decisions_equal_clean"),
+        "meshloss_flap_remesh_events":
+            ((robustness_block or {}).get("meshloss")
+             or {}).get("flap_remesh_events"),
         # static cost-model numbers in the parsed block: the regression
         # guard ratios future runs against these same-backend baselines
         "cost_peak_live_bytes": (cost_block or {}).get("peak_live_bytes"),

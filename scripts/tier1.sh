@@ -101,6 +101,19 @@ if [ "${TIER1_SKIP_FAILOVER:-0}" != "1" ]; then
     env JAX_PLATFORMS=cpu python -m volcano_tpu.chaos --smoke --failover \
         > /tmp/_t1_failover.json || frc=$?
 fi
+mrc=0
+if [ "${TIER1_SKIP_MESHLOSS:-0}" != "1" ]; then
+    # elastic-mesh smoke (volcano_tpu/chaos/meshloss, ISSUE 20):
+    # persistent device_loss faults on the 8-device CPU mesh must
+    # quarantine + shrink the serving mesh 8->4->2, probation must
+    # regrow it to 8, decisions stay sha-identical to the clean run on
+    # scan AND pallas-interpret, and the device_flap leg proves the
+    # probation backoff bounds re-mesh churn
+    env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}" \
+        python -m volcano_tpu.chaos --smoke --meshloss \
+        > /tmp/_t1_meshloss.json || mrc=$?
+fi
 flrc=0
 if [ "${TIER1_SKIP_FLEET:-0}" != "1" ]; then
     # fleet smoke (volcano_tpu/fleet): N tenants served through one
@@ -137,6 +150,9 @@ if [ $rrc -ne 0 ]; then
 fi
 if [ $frc -ne 0 ]; then
     exit $frc
+fi
+if [ $mrc -ne 0 ]; then
+    exit $mrc
 fi
 if [ $flrc -ne 0 ]; then
     exit $flrc

@@ -1,0 +1,360 @@
+"""Elastic mesh degradation (ISSUE 20 acceptance).
+
+- Stateful Backoff edges: next() escalation, cap, reset(), jitter bounds.
+- DeviceHealthRegistry: a lone strike stays transient; N-in-a-window
+  quarantines and halves the width cap; probation regrow releases and
+  doubles; a probation strike re-quarantines immediately (flap) and
+  escalates the interval; snapshot/restore round-trips.
+- mesh_for_nodes is keyed by the live device tuple, clamped by the
+  registry's shrink cap, and invalidated on quarantine/regrow.
+- Session.drop_sharded_residency makes the next sharded dispatch re-fuse
+  from source truth, decision-neutrally.
+- Transient (anonymous) faults on the sharded Scheduler still walk
+  sync-retry -> cpu-oracle WITHOUT quarantining anything, and the
+  cooldown re-arms the sharded pipelined path.
+- Fleet bucket keys include the current serving mesh width, so a
+  health-driven width change re-buckets sharded tenants.
+- Scheduler checkpoints carry the health registry state.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from volcano_tpu.framework import parse_conf
+from volcano_tpu.metrics import METRICS
+from volcano_tpu.parallel import (HEALTH, DeviceHealthRegistry,
+                                  failed_devices, invalidate_mesh_cache,
+                                  mesh_for_nodes)
+from volcano_tpu.runtime.backoff import Backoff
+
+from test_delta_pipeline import PARITY_CONF, _PARITY_BODY
+from test_runtime_incremental import build_cluster, churn
+
+SHARDED_CONF = parse_conf("sharding: true\nsharding_devices: 8\n"
+                          + _PARITY_BODY)
+
+
+def _loss(*ids):
+    exc = RuntimeError("planted device fault")
+    exc.device_ids = tuple(ids)
+    return exc
+
+
+@pytest.fixture()
+def health_defaults():
+    """Pin the registry to the documented default knobs for the test and
+    restore a clean env-default registry afterwards (no quarantine may
+    leak into other tests' meshes)."""
+    HEALTH.configure(strikes=2, window=8, probation=3, flap_window=6)
+    try:
+        yield HEALTH
+    finally:
+        HEALTH.configure()
+
+
+class TestBackoffStateful:
+    def test_next_escalates_and_caps(self):
+        bo = Backoff(base=3.0, cap=12.0, factor=2.0, jitter=0.0, seed=0)
+        assert [bo.next() for _ in range(5)] == [3.0, 6.0, 12.0, 12.0,
+                                                12.0]
+
+    def test_reset_restores_initial_interval(self):
+        bo = Backoff(base=3.0, cap=48.0, factor=2.0, jitter=0.0, seed=0)
+        assert (bo.next(), bo.next()) == (3.0, 6.0)
+        bo.reset()
+        assert bo.next() == 3.0
+
+    def test_peek_does_not_consume(self):
+        bo = Backoff(base=2.0, cap=32.0, factor=2.0, jitter=0.5, seed=7)
+        assert bo.peek() == bo.peek() == 2.0     # undithered, stateless
+        bo.next()
+        assert bo.peek() == 4.0
+
+    def test_jitter_within_declared_bounds(self):
+        bo = Backoff(base=1.0, cap=1000.0, factor=2.0, jitter=0.25,
+                     seed=11)
+        for attempt in range(8):
+            undithered = min(bo.cap, bo.base * bo.factor ** attempt)
+            d = bo.delay(attempt)
+            assert 0.75 * undithered <= d <= 1.25 * undithered, (attempt,
+                                                                 d)
+
+    def test_cap_respected_after_many_steps(self):
+        bo = Backoff(base=0.5, cap=8.0, factor=2.0, jitter=0.25, seed=3)
+        for _ in range(50):
+            assert bo.next() <= 8.0 * 1.25
+        assert bo.peek() == 8.0
+
+
+class TestFailedDevices:
+    def test_attribution_walks_cause_chain(self):
+        inner = _loss(3, 5)
+        try:
+            raise RuntimeError("wrapper") from inner
+        except RuntimeError as outer:
+            assert failed_devices(outer) == (3, 5)
+
+    def test_anonymous_exception_names_nothing(self):
+        assert failed_devices(RuntimeError("transient blip")) == ()
+
+
+class TestHealthRegistry:
+    def test_single_strike_stays_transient(self, health_defaults):
+        reg = health_defaults
+        assert reg.note_failure(_loss(2), cycle=1, serving_width=8) == ()
+        assert not reg.quarantined and reg.width_cap is None
+
+    def test_strikes_in_window_quarantine_and_halve(self, health_defaults):
+        reg = health_defaults
+        reg.note_failure(_loss(2), cycle=1, serving_width=8)
+        assert reg.note_failure(_loss(2), cycle=2, serving_width=8) == (2,)
+        assert 2 in reg.quarantined
+        assert reg.width_cap == 4            # halved, not pow2-of-healthy
+        assert reg.generation == 1
+
+    def test_strike_outside_window_ages_out(self, health_defaults):
+        reg = health_defaults
+        reg.note_failure(_loss(2), cycle=0, serving_width=8)
+        assert reg.note_failure(_loss(2), cycle=20, serving_width=8) == ()
+        assert not reg.quarantined
+
+    def test_repeated_loss_keeps_descending(self, health_defaults):
+        reg = health_defaults
+        for c in (1, 2):
+            reg.note_failure(_loss(2), cycle=c, serving_width=8)
+        for c in (3, 4):
+            reg.note_failure(_loss(5), cycle=c, serving_width=4)
+        assert reg.width_cap == 2            # 8 -> 4 -> 2, never stuck
+
+    def test_regrow_releases_on_probation_and_doubles(self,
+                                                      health_defaults):
+        reg = health_defaults
+        for c in (1, 2):
+            reg.note_failure(_loss(2), cycle=c, serving_width=8)
+        gen = reg.generation
+        assert reg.tick(3) is None           # interval = probation = 3
+        step = reg.tick(5)                   # quarantined at 2, regrow 2+3
+        assert step is not None and step["released"] == [2]
+        assert reg.width_cap is None         # 4*2 >= 8 devices: cap off
+        assert not reg.quarantined
+        assert reg.generation == gen + 1
+
+    def test_flap_requarantines_immediately_and_escalates(
+            self, health_defaults):
+        reg = health_defaults
+        for c in (1, 2):
+            reg.note_failure(_loss(2), cycle=c, serving_width=8)
+        assert reg.probation_interval == 3
+        reg.tick(5)                          # released on probation
+        # ONE strike inside the flap window re-quarantines
+        assert reg.note_failure(_loss(2), cycle=6, serving_width=8) == (2,)
+        assert reg.quarantined[2]["reason"] == "flap"
+        assert reg.probation_interval == 6   # backoff escalated, no reset
+
+    def test_probation_survivor_rearms_strike_budget(self,
+                                                     health_defaults):
+        reg = health_defaults
+        for c in (1, 2):
+            reg.note_failure(_loss(2), cycle=c, serving_width=8)
+        reg.tick(5)
+        reg.tick(20)                         # probation window long past
+        assert reg.note_failure(_loss(2), cycle=21,
+                                serving_width=8) == ()  # transient again
+
+    def test_snapshot_restore_roundtrip(self, health_defaults):
+        reg = health_defaults
+        for c in (1, 2):
+            reg.note_failure(_loss(2), cycle=c, serving_width=8)
+        snap = reg.snapshot()
+        other = DeviceHealthRegistry()
+        other.configure(strikes=2, window=8, probation=3, flap_window=6)
+        other.restore(snap)
+        assert other.snapshot() == snap
+        assert other.width_cap == 4 and 2 in other.quarantined
+
+    def test_healthy_devices_filters_quarantined(self, health_defaults):
+        reg = health_defaults
+        victim = jax.devices()[0].id
+        for c in (1, 2):
+            reg.note_failure(_loss(victim), cycle=c, serving_width=8)
+        assert victim not in {d.id for d in reg.healthy_devices()}
+        assert len(reg.healthy_devices()) == len(jax.devices()) - 1
+
+
+class TestMeshHealthIntegration:
+    def test_mesh_cache_keyed_by_device_tuple(self, health_defaults):
+        m1 = mesh_for_nodes(128, 2)
+        assert mesh_for_nodes(128, 2) is m1          # cache hit
+        victim = jax.devices()[0].id
+        for c in (1, 2):
+            HEALTH.note_failure(_loss(victim), cycle=c, serving_width=8)
+        m2 = mesh_for_nodes(128, 2)
+        assert m2 is not m1
+        assert victim not in {d.id for d in m2.devices.flat}
+
+    def test_width_cap_clamps_mesh(self, health_defaults):
+        for c in (1, 2):
+            HEALTH.note_failure(_loss(jax.devices()[7].id), cycle=c,
+                                serving_width=8)
+        assert HEALTH.width_cap == 4
+        assert int(mesh_for_nodes(128, 8).devices.size) == 4
+
+    def test_invalidate_drops_and_rebuilds_entries(self):
+        from volcano_tpu.parallel.sharding import _MESH_CACHE
+        m1 = mesh_for_nodes(128, 2)
+        assert _MESH_CACHE
+        invalidate_mesh_cache()
+        assert not _MESH_CACHE
+        m2 = mesh_for_nodes(128, 2)     # same healthy set: same devices
+        assert [d.id for d in m2.devices.flat] == \
+               [d.id for d in m1.devices.flat]
+        assert len(_MESH_CACHE) == 1
+
+    def test_fleet_bucket_key_tracks_mesh_width(self, health_defaults):
+        from volcano_tpu.arrays import pack
+        from volcano_tpu.fleet import bucket_key
+        from volcano_tpu.ops.allocate_scan import (AllocateConfig,
+                                                   AllocateExtras,
+                                                   derive_batching)
+        snap, _maps = pack(build_cluster(n_nodes=4, n_jobs=4))
+        tree = (snap, AllocateExtras.neutral(snap))
+        cfg = derive_batching(AllocateConfig(binpack_weight=1.0,
+                                             enable_gpu=False),
+                              has_proportion=False)
+        key_full = bucket_key(cfg, tree, sharding=True)
+        for c in (1, 2):
+            HEALTH.note_failure(_loss(jax.devices()[0].id), cycle=c,
+                                serving_width=4)
+        key_shrunk = bucket_key(cfg, tree, sharding=True)
+        assert key_full != key_shrunk        # width change re-buckets
+        w_full = dict([key_full[-1]])["mesh_width"]
+        w_shrunk = dict([key_shrunk[-1]])["mesh_width"]
+        assert w_shrunk < w_full
+        assert w_shrunk == HEALTH.width_cap  # clamped by the registry
+        # unsharded tenants never key on the mesh
+        assert bucket_key(cfg, tree) == bucket_key(cfg, tree)
+
+
+class TestSessionRemesh:
+    def test_drop_sharded_residency_refuses_decision_neutral(self):
+        from volcano_tpu.framework.session import Session
+        HEALTH.configure()
+        try:
+            ci = build_cluster(n_nodes=8, n_jobs=10)
+            ssn = Session(ci.clone(), SHARDED_CONF)
+            ref = ssn.run_allocate()
+            ref_binds = sorted((b.task_uid, b.node_name)
+                               for b in ssn.binds)
+            assert ssn._sharded_ids           # residency was sharded
+            dropped = ssn.drop_sharded_residency()
+            assert dropped >= 1 and not ssn._sharded_ids
+            ssn._reset_cycle_state()
+            again = ssn.run_allocate()        # cold re-fuse from truth
+            assert sorted((b.task_uid, b.node_name)
+                          for b in ssn.binds) == ref_binds
+            np.testing.assert_array_equal(np.asarray(again.task_node),
+                                          np.asarray(ref.task_node))
+        finally:
+            HEALTH.configure()
+
+
+class TestSchedulerTransientLadder:
+    def test_anonymous_faults_walk_oracle_without_quarantine(self):
+        """Satellite acceptance: repeated backend_loss (transient, no
+        device attribution) on the SHARDED Scheduler walks sync-retry ->
+        cpu-oracle exactly as before the elastic-mesh rung landed — no
+        quarantine, no shrink — and the cooldown re-arms the sharded
+        pipelined path."""
+        import contextlib
+
+        from volcano_tpu.chaos import FaultInjector, FaultPlan, chaos
+        from volcano_tpu.runtime.fake_cluster import FakeCluster
+        from volcano_tpu.runtime.scheduler import Scheduler
+        from test_delta_pipeline import decisions_sha, digest
+
+        def run(plan, cycles=8):
+            HEALTH.configure()
+            cluster = FakeCluster(build_cluster(n_nodes=8, n_jobs=10))
+            sched = Scheduler(cluster, conf=SHARDED_CONF, pipeline=True)
+            inj = FaultInjector(plan) if plan else None
+            ctx = chaos(inj) if inj else contextlib.nullcontext()
+            digests = []
+            with ctx:
+                for c in range(cycles):
+                    out = sched.run_once(now=1000.0 + c)
+                    rec = sched.drain(now=1000.0 + c) or out
+                    digests.append(digest(rec))
+                    churn(cluster, c, arrivals=True)
+            return decisions_sha(digests), sched, inj
+
+        try:
+            clean_sha, _, _ = run(None)
+            # both faults at cycle 1: dispatch fails AND the sync retry
+            # fails; with no device attribution the mesh rung must pass
+            plan = FaultPlan(seed=2, cycles=2, kinds=("backend_loss",),
+                             per_kind=2)
+            assert [f.cycle for f in plan.faults] == [1, 1]
+            shrinks0 = METRICS.counter_total("mesh_shrink_total")
+            sha, sched, inj = run(plan)
+            assert len(inj.fired) == 2
+            assert sha == clean_sha
+            flights = sched.flight.snapshots()
+            degr = [e.get("degradation", 0) for e in flights]
+            assert 3 in degr                  # oracle rung reached
+            assert not HEALTH.quarantined     # nothing quarantined
+            assert METRICS.counter_total("mesh_shrink_total") == shrinks0
+            # cooldown re-armed the sharded path: the tail cycles serve
+            # on the full mesh at degradation 0 again
+            assert degr[-1] == 0
+            assert flights[-1].get("mesh_devices") == 8
+        finally:
+            HEALTH.configure()
+
+
+class TestCheckpointHealth:
+    def test_checkpoint_carries_health_state(self, tmp_path):
+        from volcano_tpu.runtime.fake_cluster import FakeCluster
+        from volcano_tpu.runtime.scheduler import Scheduler
+        try:
+            HEALTH.configure(strikes=2, window=8, probation=3,
+                             flap_window=6)
+            for c in (1, 2):
+                HEALTH.note_failure(_loss(6), cycle=c, serving_width=8)
+            want = HEALTH.snapshot()
+            sched = Scheduler(FakeCluster(build_cluster(4, 4)),
+                              conf=SHARDED_CONF, pipeline=False)
+            path = str(tmp_path / "sched.ckpt")
+            sched.checkpoint(path)
+
+            HEALTH.configure(strikes=2, window=8, probation=3,
+                             flap_window=6)           # wipe live state
+            assert not HEALTH.quarantined
+            sched2 = Scheduler(FakeCluster(build_cluster(4, 4)),
+                               conf=SHARDED_CONF, pipeline=False)
+            assert sched2.restore(path) == "restored"
+            got = HEALTH.snapshot()
+            # generation restarts per process; everything durable matches
+            assert {k: v for k, v in got.items() if k != "generation"} \
+                == {k: v for k, v in want.items() if k != "generation"}
+            assert 6 in HEALTH.quarantined and HEALTH.width_cap == 4
+        finally:
+            HEALTH.configure()
+
+
+@pytest.mark.slow
+class TestMeshlossProbe:
+    """The full probe (clean + fault runs, three GSPMD widths) rides the
+    slow tail; tier-1 covers it via ``chaos --smoke --meshloss``."""
+
+    def test_loss_leg_green(self):
+        from volcano_tpu.chaos.meshloss import (check_loss_leg,
+                                                run_meshloss_probe)
+        report = run_meshloss_probe()
+        assert check_loss_leg(report) == [], report
+
+    def test_flap_leg_green(self):
+        from volcano_tpu.chaos.meshloss import (check_flap_leg,
+                                                run_meshloss_probe)
+        report = run_meshloss_probe(flap=True)
+        assert check_flap_leg(report) == [], report

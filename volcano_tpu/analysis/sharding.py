@@ -38,6 +38,13 @@ so the family additionally audits the sharded+pallas entry:
   the all-gather check, but an interpreted launch can hide it behind
   element-wise HLO, so the jaxpr-level block check is load-bearing).
 
+Since ISSUE 20 the family also traces the *quarantine-rebuild* entry:
+the mesh :func:`..parallel.sharding.mesh_for_nodes` produces after the
+device-health registry quarantines a device (a non-prefix survivor
+subset at the halved width cap) must satisfy the same collective,
+replicated-decision, and out==in discipline — the elastic-mesh rung
+serves real cycles on exactly that mesh.
+
 With fewer than two local devices there is no mesh to audit and the
 family reports nothing (the tier-1 test environment forces 8 virtual
 CPU devices; scripts/graphcheck.sh exports the same default).
@@ -220,6 +227,26 @@ def check_sharding(fast: bool = False) -> List[Finding]:
         (2, _audit_kernel(mesh2, "fused_cycle_shardaudit2pl",
                           use_pallas="interpret"), True),
     ]
+    # quarantine-rebuild entry (ISSUE 20): after a persistent device loss
+    # the elastic-mesh rung serves on a NON-PREFIX survivor subset at the
+    # halved width cap. The same collective / replicated-decision /
+    # out==in discipline must hold on that rebuilt mesh, audited through
+    # the real path — a strike-quarantined registry feeding
+    # mesh_for_nodes — then restored so no health state leaks.
+    if jax.device_count() >= 5:
+        from ..parallel import HEALTH
+        try:
+            HEALTH.configure()
+            loss = RuntimeError("graphcheck planted device loss")
+            loss.device_ids = (jax.devices()[0].id,)
+            for c in range(HEALTH.strikes):
+                HEALTH.note_failure(loss, cycle=c, serving_width=8)
+            qmesh = mesh_for_nodes(128, 8)
+            dq = int(qmesh.devices.size)
+            meshes.append((dq, _audit_kernel(
+                qmesh, f"fused_cycle_shardaudit{dq}q"), False))
+        finally:
+            HEALTH.configure()
     if not fast and jax.device_count() >= 4:
         wide = mesh_for_nodes(128, jax.device_count())
         d = int(wide.devices.size)

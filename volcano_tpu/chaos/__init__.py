@@ -29,10 +29,20 @@ Three pieces:
   and the stale promotion must self-heal — tier-1 smoke
   ``python -m volcano_tpu.chaos --smoke --failover`` and bench.py's
   ``failover`` block.
+- :mod:`.meshloss` — :func:`run_meshloss_probe`: the elastic-mesh storm
+  (ISSUE 20): persistent ``device_loss`` faults quarantine devices and
+  shrink the sharded serving mesh 8 -> 4 -> 2, probation regrows it to
+  full width, and the decision sha must stay bit-identical to the clean
+  unshrunk run on scan AND pallas-interpret; a ``device_flap`` leg
+  proves the stateful backoff damps re-mesh churn — tier-1 smoke
+  ``python -m volcano_tpu.chaos --smoke --meshloss`` and bench.py's
+  ``robustness`` block.
 
 The hardening the faults exercise lives where it belongs: the in-graph
 integrity digest and mirror-rebuild recovery in :mod:`..ops.fused_io`,
-the pipelined->sync->cpu-oracle degradation ladder in
+the device-health registry and health-aware mesh selection in
+:mod:`..parallel.health` / :mod:`..parallel.sharding`, the
+pipelined -> sync -> elastic-mesh -> cpu-oracle degradation ladder in
 :mod:`..runtime.scheduler`, and the reconnect/idempotent-replay protocol
 in :mod:`..runtime.sidecar` — see docs/architecture.md "Fault tolerance
 & degradation ladder".
@@ -43,13 +53,15 @@ from __future__ import annotations
 from .failover import run_failover_probe
 from .inject import (KILL_PHASES, ChaosError, FaultInjector, active, chaos,
                      install, seam, uninstall)
-from .plan import FAULT_KINDS, RECOVERABLE_KINDS, Fault, FaultPlan
+from .meshloss import run_meshloss_probe
+from .plan import (FAULT_KINDS, PERSISTENT_KINDS, RECOVERABLE_KINDS, Fault,
+                   FaultPlan)
 from .probe import run_chaos_probe
 from .restart import run_restart_probe
 
 __all__ = [
-    "FAULT_KINDS", "RECOVERABLE_KINDS", "KILL_PHASES", "Fault", "FaultPlan",
-    "FaultInjector", "ChaosError", "seam", "active", "install",
-    "uninstall", "chaos", "run_chaos_probe", "run_restart_probe",
-    "run_failover_probe",
+    "FAULT_KINDS", "RECOVERABLE_KINDS", "PERSISTENT_KINDS", "KILL_PHASES",
+    "Fault", "FaultPlan", "FaultInjector", "ChaosError", "seam", "active",
+    "install", "uninstall", "chaos", "run_chaos_probe", "run_restart_probe",
+    "run_failover_probe", "run_meshloss_probe",
 ]

@@ -28,6 +28,14 @@ sha-identical to the uninterrupted run at a cost of at most one cycle, and
 the split-brain leg's deposed-leader writes are rejected by the fencing
 token — not applied.
 
+``--meshloss`` runs the elastic-mesh smoke instead (chaos/meshloss.py):
+persistent ``device_loss`` faults on an 8-device CPU mesh must
+quarantine, shrink the serving mesh 8 -> 4 -> 2, regrow to 8 after
+probation, and keep the decision sha bit-identical to the clean run on
+the scan AND pallas-interpret sharded cycles; a ``device_flap`` leg
+proves the probation backoff bounds re-mesh churn under a device that
+re-dies on every readmission.
+
 Exit 0 on success, 1 on any violated claim, 2 on harness error. The JSON
 report prints either way so CI logs carry the evidence.
 """
@@ -114,6 +122,30 @@ def _failover_smoke(args) -> int:
     return 0 if ok else 1
 
 
+def _meshloss_smoke(args) -> int:
+    from .meshloss import (check_flap_leg, check_loss_leg,
+                           run_meshloss_probe)
+    try:
+        legs = {
+            "loss_scan": run_meshloss_probe(seed=args.seed),
+            "loss_pallas_interpret": run_meshloss_probe(
+                seed=args.seed, use_pallas="interpret"),
+            "flap_scan": run_meshloss_probe(seed=args.seed, flap=True),
+        }
+    except Exception as e:  # harness failure, not a chaos verdict
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        return 2
+    failures = (check_loss_leg(legs["loss_scan"])
+                + check_loss_leg(legs["loss_pallas_interpret"])
+                + check_flap_leg(legs["flap_scan"]))
+    report = {"legs": legs, "failures": failures, "ok": not failures}
+    print(json.dumps(report, indent=2, default=str))
+    if failures:
+        print("meshloss smoke FAILED: " + "; ".join(failures),
+              file=sys.stderr)
+    return 0 if not failures else 1
+
+
 def _spec_smoke(args) -> int:
     from .spec import run_spec_matrix
     try:
@@ -176,6 +208,13 @@ def main(argv=None) -> int:
                         help="run the restart smoke: process_kill at "
                              "every phase, checkpoint restore, decision "
                              "identity vs the uninterrupted run")
+    parser.add_argument("--meshloss", action="store_true",
+                        help="run the elastic-mesh smoke: persistent "
+                             "device_loss shrinks the 8-dev CPU mesh "
+                             "8->4->2, probation regrows to 8, decisions "
+                             "stay sha-identical on scan AND pallas-"
+                             "interpret, and a device_flap leg proves "
+                             "damping bounds the re-mesh churn")
     parser.add_argument("--failover", action="store_true",
                         help="run the HA smoke: leader_kill at every "
                              "phase, warm-standby promotion, fence-"
@@ -188,6 +227,8 @@ def main(argv=None) -> int:
         return _restart_smoke(args)
     if args.failover:
         return _failover_smoke(args)
+    if args.meshloss:
+        return _meshloss_smoke(args)
     from . import run_chaos_probe
     try:
         report = run_chaos_probe(seed=args.seed, cycles=args.cycles,

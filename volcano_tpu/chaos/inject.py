@@ -79,11 +79,19 @@ KILL_PHASES = ("pre_dispatch", "in_flight", "post_drain")
 
 
 class ChaosError(RuntimeError):
-    """An injected fault surfacing as an exception (e.g. backend loss)."""
+    """An injected fault surfacing as an exception (e.g. backend loss).
 
-    def __init__(self, message: str, kind: str = "chaos"):
+    ``device_ids`` is the attribution contract with the device-health
+    registry (parallel/health.py): persistent device faults name the
+    devices the failure is pinned to, transient faults leave it empty —
+    which is exactly how the registry tells a quarantine-worthy loss from
+    a ``backend_loss`` blip the sync-retry rung absorbs."""
+
+    def __init__(self, message: str, kind: str = "chaos",
+                 device_ids: Tuple[int, ...] = ()):
         super().__init__(message)
         self.kind = kind
+        self.device_ids = tuple(device_ids)
 
 
 _ACTIVE: Optional["FaultInjector"] = None
@@ -141,11 +149,23 @@ class FaultInjector:
     """
 
     def __init__(self, plan: FaultPlan, slow_s: float = 0.25,
-                 target_tenant: Optional[str] = None):
+                 target_tenant: Optional[str] = None,
+                 heal_after: Optional[int] = None):
         self.plan = plan
         #: how long a ``slow_dispatch`` fault stalls (must exceed the
         #: scheduler's cycle deadline for the watchdog to trip)
         self.slow_s = slow_s
+        #: cycles until a dead device comes back (None = never): the
+        #: meshloss probe's regrow leg needs the hardware to actually
+        #: return; a ``device_flap`` victim re-dies every time a serving
+        #: mesh readmits it after healing
+        self.heal_after = heal_after
+        #: device id -> {"since", "flap", "heal_at"} — devices that are
+        #: DEAD RIGHT NOW: every sharded dispatch whose mesh contains one
+        #: raises, persistently, until the device heals
+        self.dead_devices = {}
+        #: healed flap victims, waiting to kill their next serving mesh
+        self.flappers = set()
         #: fleet scoping (ISSUE 12): when set, per-tenant fleet faults
         #: fire ONLY inside this tenant's pack step, and whole-bucket
         #: fleet.dispatch faults are suppressed — the chaos isolation
@@ -201,8 +221,63 @@ class FaultInjector:
         if f is not None:
             time.sleep(self.slow_s)
 
-    def _on_session_dispatch(self, **_):
+    def _on_session_dispatch(self, session=None, **_):
+        self._device_faults("session.dispatch", session)
         self._dispatch_faults("session.dispatch")
+
+    def _device_faults(self, point: str, session) -> None:
+        """Persistent device loss on the serving mesh. Unlike every other
+        dispatch fault this is NOT one-shot: once a ``device_loss`` or
+        ``device_flap`` fault marks a device dead, EVERY later sharded
+        dispatch whose mesh still contains it raises with the device
+        attributed — the semantics the elastic-mesh rung exists for. The
+        raise stops only when the mesh stops including the device (the
+        health registry quarantined it and the mesh shrank) or the device
+        heals (``heal_after``)."""
+        if session is None:
+            return
+        try:
+            mesh = session._sharding_mesh()
+        except Exception:
+            return
+        if mesh is None:
+            return
+        ids = [int(d.id) for d in mesh.devices.ravel()]
+        # heal pass: a revived flap victim moves to the flapper pool
+        for dev, rec in list(self.dead_devices.items()):
+            if rec["heal_at"] is not None and self.cycle >= rec["heal_at"]:
+                del self.dead_devices[dev]
+                if rec["flap"]:
+                    self.flappers.add(dev)
+        f = self._take("device_loss", point)
+        if f is not None:
+            victim = ids[f.param % len(ids)]
+            self.dead_devices[victim] = {
+                "since": self.cycle, "flap": False,
+                "heal_at": (self.cycle + self.heal_after
+                            if self.heal_after else None)}
+        f = self._take("device_flap", point)
+        if f is not None:
+            victim = ids[f.param % len(ids)]
+            self.dead_devices[victim] = {
+                "since": self.cycle, "flap": True,
+                "heal_at": self.cycle + (self.heal_after or 2)}
+        # a flapper dies again the moment a serving mesh readmits it
+        for dev in ids:
+            if dev in self.flappers and dev not in self.dead_devices:
+                self.flappers.discard(dev)
+                self.dead_devices[dev] = {
+                    "since": self.cycle, "flap": True,
+                    "heal_at": self.cycle + (self.heal_after or 2)}
+                self.fired.append((self.cycle, "device_flap",
+                                   point + ":refail"))
+        dead = sorted(d for d in ids if d in self.dead_devices)
+        if dead:
+            flap = any(self.dead_devices[d]["flap"] for d in dead)
+            raise ChaosError(
+                f"injected device loss: devices {dead} unreachable",
+                kind="device_flap" if flap else "device_loss",
+                device_ids=tuple(dead))
 
     def _on_sidecar_dispatch(self, **_):
         self._dispatch_faults("sidecar.dispatch")

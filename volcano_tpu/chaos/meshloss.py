@@ -1,0 +1,219 @@
+"""Elastic-mesh degradation probe: permanent device loss, shrink, regrow.
+
+The ISSUE 5 chaos probe proves the ladder survives TRANSIENT faults; this
+probe proves the elastic-mesh rung (ISSUE 20) survives PERSISTENT ones.
+On an 8-device CPU mesh it plants two ``device_loss`` faults that stay
+dead: the first must quarantine its device within the strike budget and
+shrink the serving mesh 8 -> 4, the second 4 -> 2; after the probation
+interval the health registry must regrow 2 -> 4 -> 8 over the healed
+devices — and the full decision sha over every cycle must be
+bit-identical to the clean unshrunk run, on the scan AND the
+pallas-interpret sharded cycle paths (the re-fuse-from-source-truth
+argument: no decision ever depended on the mesh width). A separate
+``device_flap`` leg readmits a device that dies every time a regrown mesh
+includes it and asserts flap damping bounds the re-mesh churn (the
+probation interval doubles per re-failure through the stateful Backoff).
+
+Shared by the tier-1 smoke (``python -m volcano_tpu.chaos --smoke
+--meshloss``) and bench.py's ``robustness`` block
+(``remesh_ms_p50`` / ``post_shrink_steady_ms_p50`` feed the regression
+guard).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+from typing import Dict, Optional
+
+from .inject import FaultInjector, chaos
+from .plan import Fault, FaultPlan
+from .probe import _PROBE_CONF, _churn, _cycle_digest, _small_cluster
+
+#: health-registry knobs the probe pins (explicit, so env vars can't move
+#: the asserted shrink/regrow timeline): 2 strikes in 8 cycles
+#: quarantines, 3-cycle probation, 6-cycle flap window
+_STRIKES, _WINDOW, _PROBATION, _FLAP_WINDOW = 2, 8, 3, 6
+
+
+def _p50(values):
+    values = sorted(values)
+    return values[len(values) // 2] if values else None
+
+
+def _width_runs(widths):
+    """Compress the per-cycle width sequence to its distinct runs —
+    [8, 8, 4, 4, 2, 2, 4, 8] -> [8, 4, 2, 4, 8]."""
+    runs = []
+    for w in widths:
+        if w is not None and (not runs or runs[-1] != w):
+            runs.append(w)
+    return runs
+
+
+def run_meshloss_probe(seed: int = 7, cycles: int = 16,
+                       use_pallas: Optional[str] = None,
+                       devices: int = 8, flap: bool = False,
+                       pipeline: bool = True) -> Dict[str, object]:
+    """One leg: a clean run vs a planted persistent-loss (or flap) storm
+    on the sharded scheduler; returns a JSON-ready report."""
+    import jax
+
+    from ..framework.conf import parse_conf
+    from ..metrics import METRICS
+    from ..parallel.health import HEALTH
+    from ..runtime.driver import step_cycle
+    from ..runtime.fake_cluster import FakeCluster
+    from ..runtime.scheduler import Scheduler
+    if len(jax.devices()) < devices:
+        return {"error": f"needs {devices} devices, have "
+                         f"{len(jax.devices())} (set XLA_FLAGS="
+                         f"--xla_force_host_platform_device_count="
+                         f"{devices})"}
+    conf = parse_conf(f"sharding: true\nsharding_devices: {devices}\n"
+                      + (f"use_pallas: {use_pallas}\n" if use_pallas else "")
+                      + _PROBE_CONF)
+    base = _small_cluster()
+
+    def run(injector):
+        HEALTH.configure(strikes=_STRIKES, window=_WINDOW,
+                         probation=_PROBATION, flap_window=_FLAP_WINDOW)
+        cluster = FakeCluster(base.clone())
+        sched = Scheduler(cluster, conf=conf, pipeline=pipeline)
+        digests = []
+        ctx = chaos(injector) if injector is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            for c in range(cycles):
+                rec = step_cycle(sched, now=1000.0 + c)
+                digests.append(_cycle_digest(rec))
+                _churn(cluster, c)
+        sha = hashlib.sha256(repr(digests).encode()).hexdigest()[:16]
+        return sha, sched
+
+    try:
+        clean_sha, _clean = run(None)
+        if flap:
+            # one device that re-dies on every readmission: param 6 picks
+            # device id 6 on the full mesh, heal_after=2 revives it well
+            # before each probation regrow readmits (and re-kills) it
+            plan = FaultPlan.explicit(
+                [Fault("device_flap", 2, 6)], cycles=cycles, seed=seed)
+            injector = FaultInjector(plan, heal_after=2)
+        else:
+            # loss at cycle 2 kills device 6 of the 8-mesh (param % 8);
+            # loss at cycle 4 kills device 3 of the then-serving 4-mesh
+            # (param 7 % 4 -> index 3). heal_after=3 revives each before
+            # the probation regrow re-serves on it.
+            plan = FaultPlan.explicit(
+                [Fault("device_loss", 2, 6), Fault("device_loss", 4, 7)],
+                cycles=cycles, seed=seed)
+            injector = FaultInjector(plan, heal_after=3)
+        shrinks0 = METRICS.counter_total("mesh_shrink_total")
+        regrows0 = METRICS.counter_value("mesh_regrow_total")
+        fault_sha, sched = run(injector)
+        interval_after = HEALTH.probation_interval
+    finally:
+        HEALTH.configure()       # restore env-default knobs, clean state
+
+    flight = sched.flight.snapshots()
+    widths = [e.get("mesh_devices") for e in flight]
+    width_runs = _width_runs(widths)
+    shrunk_at = next((i for i, w in enumerate(widths)
+                      if w is not None and w < devices), None)
+    # zero-resharding contract on the post-shrink steady path: once the
+    # mesh shrank, every sharded cycle must still leave its residents in
+    # the sharding they entered with
+    post_copies = sum(int(e.get("resharding_copies") or 0)
+                      for e in flight[shrunk_at:]) \
+        if shrunk_at is not None else None
+    remesh_ms = [e["stats"]["remesh_ms"] for e in flight
+                 if "remesh_ms" in e.get("stats", {})]
+    steady_shrunk = [e["cycle_ms"] for e in flight
+                     if e.get("mesh_devices") is not None
+                     and e["mesh_devices"] < devices
+                     and not e.get("faults")
+                     and "remesh_ms" not in e.get("stats", {})]
+    shrinks = METRICS.counter_total("mesh_shrink_total") - shrinks0
+    regrows = METRICS.counter_value("mesh_regrow_total") - regrows0
+    return {
+        "seed": seed,
+        "cycles": cycles,
+        "devices": devices,
+        "use_pallas": use_pallas,
+        "flap": flap,
+        "fault_schedule_sha": plan.schedule_sha(),
+        "fault_log": [list(f) for f in injector.fired],
+        "decisions_sha": fault_sha,
+        "clean_sha": clean_sha,
+        "decisions_equal_clean": fault_sha == clean_sha,
+        "width_sequence": width_runs,
+        "widths_hit": sorted({w for w in widths if w is not None}),
+        "ends_full_width": bool(widths and widths[-1] == devices),
+        "mesh_shrinks": shrinks,
+        "mesh_regrows": regrows,
+        "remesh_events": shrinks + regrows,
+        "post_shrink_resharding_copies": post_copies,
+        "remesh_ms_p50": _p50(remesh_ms),
+        "post_shrink_steady_ms_p50": _p50(steady_shrunk),
+        "probation_interval_after": interval_after,
+        "degradation_max": max((e.get("degradation", 0) or 0)
+                               for e in flight) if flight else 0,
+    }
+
+
+def check_loss_leg(report: Dict[str, object], devices: int = 8) -> list:
+    """The acceptance assertions for a loss leg, as failure strings."""
+    failures = []
+    if report.get("error"):
+        return [str(report["error"])]
+    if not report["decisions_equal_clean"]:
+        failures.append(
+            f"decisions diverged from clean run "
+            f"({report['decisions_sha']} != {report['clean_sha']}, "
+            f"use_pallas={report['use_pallas']})")
+    want = [devices, devices // 2, devices // 4]
+    if report["widths_hit"] != sorted(set(want)):
+        failures.append(f"expected mesh widths {sorted(set(want))}, "
+                        f"served on {report['widths_hit']}")
+    runs = report["width_sequence"]
+    if runs[:3] != want:
+        failures.append(f"shrink sequence {runs} does not start "
+                        f"{want[0]}->{want[1]}->{want[2]}")
+    if not report["ends_full_width"]:
+        failures.append(f"probation did not regrow to {devices} wide "
+                        f"(width sequence {runs})")
+    if report["mesh_shrinks"] != 2:
+        failures.append(f"expected 2 quarantine shrinks, "
+                        f"counted {report['mesh_shrinks']}")
+    if report["mesh_regrows"] != 2:
+        failures.append(f"expected 2 probation regrows, "
+                        f"counted {report['mesh_regrows']}")
+    if report["post_shrink_resharding_copies"] != 0:
+        failures.append(
+            f"post-shrink steady path took "
+            f"{report['post_shrink_resharding_copies']} resharding copies "
+            f"(must be 0)")
+    return failures
+
+
+def check_flap_leg(report: Dict[str, object],
+                   max_remesh: int = 6) -> list:
+    """Acceptance for the flap leg: decision-neutral AND damped."""
+    failures = []
+    if report.get("error"):
+        return [str(report["error"])]
+    if not report["decisions_equal_clean"]:
+        failures.append(
+            f"flap decisions diverged from clean run "
+            f"({report['decisions_sha']} != {report['clean_sha']})")
+    if report["remesh_events"] > max_remesh:
+        failures.append(
+            f"flap damping failed: {report['remesh_events']} re-mesh "
+            f"events (shrinks+regrows) exceed the damped bound "
+            f"{max_remesh}")
+    if report["probation_interval_after"] <= _PROBATION:
+        failures.append(
+            f"probation interval never escalated under flapping "
+            f"(still {report['probation_interval_after']})")
+    return failures

@@ -50,6 +50,20 @@ Fault kinds (the seams they fire at live in :mod:`.inject`):
                          ``replication.send`` seam); the stream must
                          self-repair and a later failover must still
                          promote decision-identically.
+- ``device_loss``      — a device of the serving mesh dies and STAYS
+                         dead: every later sharded dispatch whose mesh
+                         contains it raises with the device attributed
+                         (``ChaosError.device_ids``), until an optional
+                         ``heal_after`` revives it. Distinct from the
+                         transient ``backend_loss``: this is the
+                         persistent fault the elastic-mesh rung
+                         (parallel/health.py) exists for — quarantine,
+                         shrink to the next pow2 width, regrow on
+                         probation (chaos/meshloss.py).
+- ``device_flap``      — a device that dies, heals, and dies again every
+                         time a regrown mesh readmits it; the health
+                         registry's flap damping must bound the re-mesh
+                         churn instead of re-meshing every cooldown.
 """
 
 from __future__ import annotations
@@ -64,7 +78,7 @@ FAULT_KINDS = (
     "socket_drop", "partial_frame", "backend_loss", "resident_corrupt",
     "mirror_drift", "slow_dispatch", "bind_fail", "evict_fail",
     "lease_expiry", "process_kill", "leader_kill", "split_brain",
-    "replication_partition",
+    "replication_partition", "device_loss", "device_flap",
 )
 
 #: kinds whose recovery must keep the decision sequence bit-identical to
@@ -72,6 +86,12 @@ FAULT_KINDS = (
 #: recoverable too but only fire on the sidecar serving path
 RECOVERABLE_KINDS = ("backend_loss", "resident_corrupt", "mirror_drift",
                      "slow_dispatch", "bind_fail", "evict_fail")
+
+#: kinds that model PERSISTENT device loss on the sharded mesh — also
+#: decision-neutral (the elastic-mesh rung re-fuses from source truth on
+#: the shrunk mesh), but driven by their own probe (chaos/meshloss.py)
+#: because they only mean anything when a mesh is serving
+PERSISTENT_KINDS = ("device_loss", "device_flap")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +137,25 @@ class FaultPlan:
                                     param=rng.randrange(1 << 30)))
         self.faults: Tuple[Fault, ...] = tuple(
             sorted(faults, key=lambda f: (f.cycle, f.kind, f.param)))
+
+    @classmethod
+    def explicit(cls, faults: Iterable[Fault], cycles: int = 8,
+                 seed: int = 0) -> "FaultPlan":
+        """A plan with hand-placed faults instead of seed-derived ones —
+        for probes whose acceptance pins an exact sequence (the meshloss
+        probe's loss-at-cycle-2-then-cycle-4 shrink ladder). Still
+        deterministic and still fingerprinted by schedule_sha()."""
+        faults = tuple(faults)
+        unknown = [f.kind for f in faults if f.kind not in FAULT_KINDS]
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {unknown}")
+        plan = cls.__new__(cls)
+        plan.seed = int(seed)
+        plan.cycles = int(cycles)
+        plan.kinds = tuple(dict.fromkeys(f.kind for f in faults))
+        plan.faults = tuple(sorted(faults,
+                                   key=lambda f: (f.cycle, f.kind, f.param)))
+        return plan
 
     def for_cycle(self, cycle: int) -> List[Fault]:
         return [f for f in self.faults if f.cycle == cycle]

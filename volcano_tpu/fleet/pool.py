@@ -83,12 +83,31 @@ def normalize_config(cfg, sharding: bool = False):
     return dataclasses.replace(cfg, telemetry=False, use_pallas=False)
 
 
+def _serving_mesh_width(tree) -> int:
+    """The mesh width the health registry currently admits for this
+    tree's node axis (parallel/sharding.mesh_for_nodes — healthy devices,
+    shrink cap, pow2 divisibility). The mesh object is cached by device
+    tuple, so this is a dict lookup on the steady path."""
+    from ..parallel.sharding import mesh_for_nodes
+    n_nodes = int(np.asarray(jax.tree.leaves(tree[0].nodes)[0]).shape[0])
+    return int(mesh_for_nodes(n_nodes).devices.size)
+
+
 def bucket_key(cfg, tree, sharding: bool = False) -> tuple:
     """Shape-bucket identity: the normalized config + the exact per-leaf
     (shape, dtype) signature — the same key construction the single-tenant
     delta cache uses (ops/fused_io._shape_key), so fleet buckets and
-    single-tenant shape buckets cannot drift."""
-    return _shape_key(tree, normalize_config(cfg, sharding=sharding))
+    single-tenant shape buckets cannot drift.
+
+    Sharded tenants additionally key on the CURRENT serving mesh width
+    (ISSUE 20): when the device-health registry quarantines a device or
+    a probation regrow lifts the cap, the next ``place()`` re-buckets the
+    tenant instead of serving it from a bucket stacked for the old mesh —
+    the fleet analog of the Scheduler's drop-residency-and-refuse."""
+    key = _shape_key(tree, normalize_config(cfg, sharding=sharding))
+    if sharding:
+        key = key + (("mesh_width", _serving_mesh_width(tree)),)
+    return key
 
 
 def _entry_name(key: tuple, width: int) -> str:

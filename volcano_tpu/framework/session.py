@@ -252,6 +252,9 @@ class Session:
         # buffers stay on-device across cycles); a fresh Session starts
         # cold and pays one full upload.
         self._resident: Dict[object, object] = {}
+        #: id(kernel) of residents that live on a device mesh — the set
+        #: drop_sharded_residency() clears on an elastic mesh change
+        self._sharded_ids: set = set()
         self._reset_cycle_state()
         self.repack()
         self._open_plugins()
@@ -928,6 +931,23 @@ class Session:
         return mesh_for_nodes(n_nodes,
                               getattr(self.conf, "sharding_devices", None))
 
+    def drop_sharded_residency(self) -> int:
+        """Forget every mesh-bound resident — the elastic-mesh hook
+        (ISSUE 20). After a quarantine or probation regrow the serving
+        mesh changed, so the old residents' device buffers (and their
+        kernel, whose cache key includes the mesh's device ids) are
+        unreachable history; the next dispatch_allocate resolves a fresh
+        kernel on the new mesh and cold-fuses its residency from source
+        truth — the same re-fuse-from-truth primitive integrity recovery
+        uses, which is why a mesh change needs no new Session and is
+        decision-neutral. Returns how many residents were dropped."""
+        dropped = 0
+        for kid in list(self._sharded_ids):
+            if self._resident.pop(kid, None) is not None:
+                dropped += 1
+        self._sharded_ids.clear()
+        return dropped
+
     def warm_allocate(self) -> None:
         """AOT-compile the allocate entry for the current shape bucket
         WITHOUT executing a cycle — the cold-start hook (pair with
@@ -997,6 +1017,11 @@ class Session:
                                                  mesh)
             else:
                 kernel = _delta_allocate(cfg, self.snap, extras)
+            if mesh is not None:
+                # remember which residents are mesh-bound so an elastic
+                # mesh shrink/regrow can drop exactly them (the scalar
+                # replicated residents never reference dead devices)
+                self._sharded_ids.add(id(kernel))
             state = self._resident.get(id(kernel))
             if state is None:
                 from ..ops.fused_io import ResidentState

@@ -77,7 +77,17 @@ _HELP = {
         "the dead-letter list (never dropped silently)",
     "degradation_level":
         "Current degradation ladder rung: 0 pipelined, 1 sync, "
-        "2 cpu-oracle",
+        "2 elastic-mesh (shrunk sharded), 3 cpu-oracle",
+    "mesh_shrink_total":
+        "Elastic-mesh shrinks: device quarantines that halved the "
+        "serving-width cap and rebuilt the mesh over the survivors, "
+        "by reason",
+    "mesh_regrow_total":
+        "Elastic-mesh probation regrows: cap lifted a pow2 step back "
+        "toward the full mesh after a quiet probation interval",
+    "mesh_width":
+        "Serving mesh width (devices) observed at the last finished "
+        "sharded cycle",
     "sidecar_reconnects_total":
         "Sidecar client reconnects after a socket failure",
     "sidecar_replayed_rounds_total":

@@ -2,13 +2,16 @@
 
 from .distributed import (host_shard_range, initialize_distributed,
                           mask_foreign_shards)
-from .sharding import (make_sharded_allocate, make_sharded_delta,
-                       make_sharded_preempt, mesh_for_nodes, node_leaf_mask,
-                       node_sharding_specs, scheduler_mesh,
-                       sharded_delta_allocate_cached)
+from .health import HEALTH, DeviceHealthRegistry, failed_devices
+from .sharding import (invalidate_mesh_cache, make_sharded_allocate,
+                       make_sharded_delta, make_sharded_preempt,
+                       mesh_for_nodes, node_leaf_mask, node_sharding_specs,
+                       scheduler_mesh, sharded_delta_allocate_cached)
 
-__all__ = ["host_shard_range", "initialize_distributed",
-           "mask_foreign_shards", "make_sharded_allocate",
-           "make_sharded_delta", "make_sharded_preempt", "mesh_for_nodes",
-           "node_leaf_mask", "node_sharding_specs", "scheduler_mesh",
+__all__ = ["HEALTH", "DeviceHealthRegistry", "failed_devices",
+           "host_shard_range", "initialize_distributed",
+           "invalidate_mesh_cache", "mask_foreign_shards",
+           "make_sharded_allocate", "make_sharded_delta",
+           "make_sharded_preempt", "mesh_for_nodes", "node_leaf_mask",
+           "node_sharding_specs", "scheduler_mesh",
            "sharded_delta_allocate_cached"]

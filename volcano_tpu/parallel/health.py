@@ -1,0 +1,249 @@
+"""Device-health registry: quarantine, mesh shrink caps, probation regrow.
+
+The degradation ladder built in ISSUE 5/10/11 assumed the mesh under the
+sharded cycle is immortal: ``backend_loss`` is transient, so one sync
+retry (or the CPU oracle) always finds the same devices alive. On a real
+pod slice the dominant hard fault is the opposite — a chip or host dies
+and STAYS dead — and retrying the same mesh forever pins the runtime to
+the oracle rung. This registry is the missing piece of state:
+
+- **Strike classification.** Sharded dispatch failures that can name
+  their devices (``ChaosError(device_ids=...)``, or any exception chain
+  carrying a ``device_ids`` attribute) are recorded per device. N strikes
+  inside a sliding cycle window (``VOLCANO_MESH_STRIKES`` /
+  ``VOLCANO_MESH_WINDOW``, default 2-in-8) classify the device as
+  *persistently* lost and quarantine it; a lone strike stays transient
+  and ages out, so the existing sync-retry rung keeps absorbing
+  ``backend_loss``-style blips exactly as before.
+- **Width halving.** Quarantine halves the serving-width cap (8 -> 4 ->
+  2), never recomputes it from the healthy count — with 7 of 8 devices
+  healthy the next pow2 down is what keeps the node axis divisible, and
+  repeated losses must keep descending instead of sticking at 4.
+  :func:`..parallel.sharding.mesh_for_nodes` consults the registry, so
+  every mesh consumer (Scheduler session, sidecar, fleet bucket keys)
+  re-meshes over the survivors with no new plumbing.
+- **Probation regrow.** After a quiet probation interval the cap doubles
+  back toward the full mesh and quarantined devices are released *on
+  probation*: one strike inside ``VOLCANO_MESH_FLAP_WINDOW`` of release
+  re-quarantines immediately (no second strike needed) and escalates the
+  probation interval through a stateful :class:`..runtime.backoff.Backoff`
+  — flap damping, so a device that dies every time it is readmitted costs
+  a geometrically rarer re-mesh, not a re-mesh per cooldown.
+
+The registry holds NO device truth — cluster state lives in the
+FakeCluster/API-server analog and residents re-fuse from source truth on
+the rebuilt mesh (the ISSUE 10 recovery primitive), which is why shrink
+and regrow are decision-neutral by construction.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..runtime.backoff import Backoff
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def failed_devices(exc: BaseException) -> Tuple[int, ...]:
+    """Device ids named by ``exc`` or anything in its cause/context chain
+    (the attribution contract: persistent device faults carry a
+    ``device_ids`` tuple; transient faults don't and stay anonymous)."""
+    seen = set()
+    node: Optional[BaseException] = exc
+    while node is not None and id(node) not in seen:
+        seen.add(id(node))
+        ids = getattr(node, "device_ids", None)
+        if ids:
+            try:
+                return tuple(int(i) for i in ids)
+            except (TypeError, ValueError):
+                return ()
+        node = node.__cause__ or node.__context__
+    return ()
+
+
+class DeviceHealthRegistry:
+    """Process-wide strike/quarantine/regrow state for the device mesh."""
+
+    def __init__(self) -> None:
+        self.configure()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def configure(self, strikes: Optional[int] = None,
+                  window: Optional[int] = None,
+                  probation: Optional[int] = None,
+                  flap_window: Optional[int] = None) -> None:
+        """(Re)arm the registry, clearing all health state. Explicit args
+        win over the ``VOLCANO_MESH_*`` env knobs; the chaos probes call
+        this between runs so storms can't leak quarantines."""
+        self.strikes = strikes if strikes is not None else _env_int(
+            "VOLCANO_MESH_STRIKES", 2)
+        self.window = window if window is not None else _env_int(
+            "VOLCANO_MESH_WINDOW", 8)
+        self.probation = probation if probation is not None else _env_int(
+            "VOLCANO_MESH_PROBATION", 3)
+        self.flap_window = flap_window if flap_window is not None else \
+            _env_int("VOLCANO_MESH_FLAP_WINDOW", 6)
+        # stateful interval: base = one probation, doubling per flap,
+        # capped — jitterless/seeded so shrink/regrow cycles stay
+        # deterministic under the chaos probes
+        self._backoff = Backoff(base=float(self.probation),
+                                cap=float(self.probation) * 16.0,
+                                factor=2.0, jitter=0.0, seed=0)
+        self.reset()
+
+    def reset(self) -> None:
+        self.quarantined: Dict[int, dict] = {}
+        self.width_cap: Optional[int] = None
+        self.generation: int = 0
+        self._strikes: Dict[int, List[int]] = {}
+        self._probation: Dict[int, int] = {}   # dev id -> release cycle
+        self._interval: int = self.probation
+        self._next_regrow: Optional[int] = None
+        self._backoff.reset()
+        self._invalidate_meshes()
+
+    # -- failure intake ----------------------------------------------------
+
+    def note_failure(self, exc: BaseException, cycle: int,
+                     serving_width: Optional[int] = None
+                     ) -> Tuple[int, ...]:
+        """Record a dispatch failure; returns the devices this call newly
+        quarantined (empty when the failure stayed transient or carried
+        no device attribution). ``serving_width`` is the mesh width the
+        failure occurred on — the halving base for the shrink cap."""
+        newly = []
+        for dev in failed_devices(exc):
+            if dev in self.quarantined:
+                continue
+            release = self._probation.get(dev)
+            on_probation = (release is not None
+                            and cycle - release <= self.flap_window)
+            log = self._strikes.setdefault(dev, [])
+            log.append(cycle)
+            del log[:max(0, len(log) - 8)]
+            recent = [c for c in log if cycle - c < self.window]
+            if on_probation or len(recent) >= self.strikes:
+                self._quarantine(dev, cycle, flap=on_probation,
+                                 serving_width=serving_width)
+                newly.append(dev)
+        return tuple(newly)
+
+    def _quarantine(self, dev: int, cycle: int, flap: bool,
+                    serving_width: Optional[int]) -> None:
+        self.quarantined[dev] = {
+            "cycle": cycle,
+            "reason": "flap" if flap else "strikes",
+            "strikes": len(self._strikes.get(dev, ())),
+        }
+        self._strikes.pop(dev, None)
+        self._probation.pop(dev, None)
+        base = serving_width if serving_width else self.width_cap
+        if base is not None and base > 1:
+            self.width_cap = max(1, int(base) // 2)
+        if not flap:
+            self._backoff.reset()
+        self._interval = max(1, int(round(self._backoff.next())))
+        self._next_regrow = cycle + self._interval
+        self.generation += 1
+        self._invalidate_meshes()
+
+    # -- regrow ------------------------------------------------------------
+
+    def tick(self, cycle: int) -> Optional[dict]:
+        """Advance the probation clock. Returns a regrow descriptor when
+        this cycle lifts the cap a step (and releases quarantined devices
+        on probation), else None. Call once per scheduler cycle."""
+        for dev, release in list(self._probation.items()):
+            if cycle - release > self.flap_window:
+                del self._probation[dev]       # survived probation clean
+        if not self.quarantined and self.width_cap is None:
+            if not self._probation:
+                self._backoff.reset()
+                self._interval = self.probation
+            self._next_regrow = None
+            return None
+        if self._next_regrow is None or cycle < self._next_regrow:
+            return None
+        released = sorted(self.quarantined)
+        for dev in released:
+            del self.quarantined[dev]
+            self._probation[dev] = cycle
+            self._strikes.pop(dev, None)
+        total = self._device_count()
+        if self.width_cap is not None:
+            self.width_cap *= 2
+            if total and self.width_cap >= total:
+                self.width_cap = None
+        self.generation += 1
+        self._invalidate_meshes()
+        done = self.width_cap is None and not self.quarantined
+        self._next_regrow = None if done else cycle + self._interval
+        return {"width_cap": self.width_cap, "released": released,
+                "interval": self._interval, "cycle": cycle}
+
+    # -- mesh selection inputs --------------------------------------------
+
+    def healthy_devices(self) -> list:
+        import jax
+        return [d for d in jax.devices() if d.id not in self.quarantined]
+
+    def _device_count(self) -> int:
+        try:
+            import jax
+            return len(jax.devices())
+        except Exception:  # pragma: no cover - jax always importable here
+            return 0
+
+    def _invalidate_meshes(self) -> None:
+        from .sharding import invalidate_mesh_cache
+        invalidate_mesh_cache()
+
+    # -- introspection / persistence --------------------------------------
+
+    @property
+    def probation_interval(self) -> int:
+        return self._interval
+
+    def snapshot(self) -> dict:
+        """Checkpointable view (plain ints/dicts only)."""
+        return {
+            "quarantined": {int(k): dict(v)
+                            for k, v in self.quarantined.items()},
+            "width_cap": self.width_cap,
+            "generation": self.generation,
+            "strikes": {int(k): list(v) for k, v in self._strikes.items()},
+            "probation": dict(self._probation),
+            "interval": self._interval,
+            "next_regrow": self._next_regrow,
+            "backoff_attempt": self._backoff._attempt,
+        }
+
+    def restore(self, state: Optional[dict]) -> None:
+        if not state:
+            return
+        self.quarantined = {int(k): dict(v) for k, v in
+                            (state.get("quarantined") or {}).items()}
+        self.width_cap = state.get("width_cap")
+        self.generation = int(state.get("generation", 0))
+        self._strikes = {int(k): list(v) for k, v in
+                         (state.get("strikes") or {}).items()}
+        self._probation = {int(k): int(v) for k, v in
+                           (state.get("probation") or {}).items()}
+        self._interval = int(state.get("interval", self.probation))
+        self._next_regrow = state.get("next_regrow")
+        self._backoff.reset()
+        self._backoff._attempt = int(state.get("backoff_attempt", 0))
+        self._invalidate_meshes()
+
+
+#: the process-wide registry every mesh consumer consults
+HEALTH = DeviceHealthRegistry()

@@ -46,26 +46,47 @@ def scheduler_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices), (NODE_AXIS,))
 
 
-#: shard-count -> Mesh, so every kernel over the same device prefix shares
-#: one Mesh object (NamedShardings compare equal, jit caches stay shared)
-_MESH_CACHE: Dict[int, Mesh] = {}
+#: live-device-id tuple -> Mesh, so every kernel over the same device SET
+#: shares one Mesh object (NamedShardings compare equal, jit caches stay
+#: shared). Keying by the device tuple — not the shard count — is what
+#: makes quarantine safe: after a device-set change a count-keyed cache
+#: would keep handing out a Mesh whose array still references the dead
+#: device. The health registry invalidates on every quarantine/regrow.
+_MESH_CACHE: Dict[tuple, Mesh] = {}
+
+
+def invalidate_mesh_cache() -> None:
+    """Drop every cached Mesh — the hook the device-health registry fires
+    when the healthy-device set changes (quarantine or probation regrow),
+    so the next mesh_for_nodes rebuilds over the survivors."""
+    _MESH_CACHE.clear()
 
 
 def mesh_for_nodes(n_nodes: int, requested: Optional[int] = None) -> Mesh:
     """The production mesh for a snapshot with ``n_nodes`` packed node
     rows: the largest power-of-two device count <= ``requested`` (default:
-    all local devices) that divides the node axis. The bucket grid
+    all local devices) that divides the node axis, built over the HEALTHY
+    devices and clamped by the registry's shrink cap (parallel/health.py)
+    — after a quarantine every consumer of this function (Scheduler
+    session, sidecar, fleet bucket keys) transparently re-meshes at the
+    next halved width over the survivors. The bucket grid
     (arrays/schema.bucket) keeps n_nodes a power of two up to 1024 and a
     multiple of 1024 above, so any pow2 mesh up to 1024 divides it; the
     clamp only bites on sub-bucket test snapshots."""
-    avail = len(jax.devices())
+    from .health import HEALTH
+    devices = HEALTH.healthy_devices()
+    avail = len(devices)
     want = avail if requested is None else max(1, min(int(requested), avail))
+    if HEALTH.width_cap is not None:
+        want = max(1, min(want, HEALTH.width_cap))
     d = 1
     while d * 2 <= want and n_nodes % (d * 2) == 0:
         d *= 2
-    mesh = _MESH_CACHE.get(d)
-    if mesh is None or mesh.devices.size != d:
-        mesh = _MESH_CACHE[d] = scheduler_mesh(d)
+    chosen = tuple(devices[:d])
+    key = tuple(dev.id for dev in chosen)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = _MESH_CACHE[key] = Mesh(np.array(chosen), (NODE_AXIS,))
     return mesh
 
 

@@ -27,9 +27,11 @@ class Backoff:
     jitter: float = 0.1         # +- fraction of the delay
     seed: Optional[int] = None  # pin for deterministic tests
     _rng: random.Random = field(init=False, repr=False, default=None)
+    _attempt: int = field(init=False, repr=False, default=0)
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
+        self._attempt = 0
 
     def delay(self, attempt: int) -> float:
         """Delay before retry ``attempt`` (0-based: the delay AFTER the
@@ -38,6 +40,29 @@ class Backoff:
         if self.jitter:
             d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
         return max(d, 0.0)
+
+    # -- stateful interval (the health registry's probation timer) --------
+
+    def next(self) -> float:
+        """Current interval, then escalate: the first call after
+        ``reset()`` returns ``base`` (jittered), each later call one
+        factor step higher, capped at ``cap``. Unlike :meth:`delay` the
+        position is carried by the instance, so callers that react to
+        spaced-out events (a flapping device re-failing its probation)
+        get the escalating schedule without threading a counter."""
+        d = self.delay(self._attempt)
+        self._attempt += 1
+        return d
+
+    def peek(self) -> float:
+        """The interval :meth:`next` would return, without escalating or
+        consuming jitter (the undithered value)."""
+        return min(self.cap, self.base * (self.factor ** self._attempt))
+
+    def reset(self) -> None:
+        """Restore the initial interval: the next :meth:`next` returns
+        ``base`` again."""
+        self._attempt = 0
 
     def call(self, fn: Callable[[], T],
              retry_on=(OSError,),

@@ -298,6 +298,13 @@ class WarmStandby:
             sched.resync.entries = [dict(e) for e in st["resync_entries"]]
             sched.resync.dead = [dict(e) for e in st["resync_dead"]]
             ckpt.merge_metrics(st.get("metrics"))
+            if st.get("device_health"):
+                # the dead leader's quarantine picture: serve on the
+                # same shrunk mesh instead of re-striking the dead
+                # devices from scratch
+                from ..parallel.health import HEALTH
+                HEALTH.restore(st["device_health"])
+                sched._health_gen_seen = HEALTH.generation
             sched._restored_mirrors = {k: m for k, m in
                                        self.mirrors.items()}
             # intents stranded by the dead leader get a second life, the

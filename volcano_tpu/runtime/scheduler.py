@@ -205,14 +205,23 @@ class Scheduler:
         ddl = getattr(self.conf, "cycle_deadline_ms", None)
         self.cycle_deadline_s = (float(ddl) / 1000.0) if ddl else None
         #: degradation ladder: 0 = pipelined (when configured), 1 = sync
-        #: (a fault was recovered; pipelining suspended), 2 = cpu-oracle
-        #: (the compiled dispatch is gone). De-escalates to 0 after
-        #: ``fault_cooldown`` clean cycles.
+        #: (a fault was recovered; pipelining suspended), 2 = elastic-mesh
+        #: (persistent device loss — the sharded cycle serves on a shrunk
+        #: mesh over the surviving devices, parallel/health.py), 3 =
+        #: cpu-oracle (the compiled dispatch is gone entirely).
+        #: De-escalates to 0 after ``fault_cooldown`` clean cycles.
         self.degradation_level = 0
         self.fault_cooldown = int(os.environ.get("VOLCANO_FAULT_COOLDOWN",
                                                  4))
         self._degrade_until = 0
         self._cycle_faults: List[dict] = []
+        # ---- elastic mesh (ISSUE 20) ----------------------------------
+        #: serving mesh width observed at the last finished cycle — the
+        #: reference point for mesh JSONL events and the mesh_width gauge
+        self._last_mesh_devices: Optional[int] = None
+        #: the health-registry generation this scheduler last re-meshed
+        #: at; a newer generation means the device set changed under us
+        self._health_gen_seen = 0
 
     def _load_conf(self) -> Optional[SchedulerConfiguration]:
         """Conf hot-reload (fsnotify watcher, scheduler.go:146-171 — here a
@@ -408,9 +417,27 @@ class Scheduler:
         # clean cycles, climb back to the configured mode
         if self.degradation_level and self.cycles >= self._degrade_until:
             spans.log_event("degradation", level_from=self.degradation_level,
-                            level_to=0, cycle=self.cycles)
+                            level_to=0, cycle=self.cycles,
+                            mesh_devices=self._last_mesh_devices)
             self.degradation_level = 0
             METRICS.set_gauge("degradation_level", None, 0)
+        # elastic-mesh probation clock: after a quiet probation interval
+        # the health registry lifts the shrink cap a pow2 step and
+        # releases quarantined devices on probation; dropping the sharded
+        # residency makes the next dispatch re-fuse from source truth on
+        # the regrown mesh (decision-neutral, like the shrink was)
+        if getattr(self.conf, "sharding", False):
+            from ..parallel.health import HEALTH
+            regrow = HEALTH.tick(self.cycles)
+            if regrow is not None:
+                if self._session is not None:
+                    self._session.drop_sharded_residency()
+                self._health_gen_seen = HEALTH.generation
+                METRICS.inc("mesh_regrow_total")
+                spans.log_event("mesh", action="regrow", cycle=self.cycles,
+                                width_cap=regrow["width_cap"],
+                                released=regrow["released"],
+                                probation_interval=regrow["interval"])
         actions = list(self.conf.actions)
 
         def _will_pipeline() -> bool:
@@ -459,6 +486,7 @@ class Scheduler:
                     # the compiled allocate failed mid-action: walk the
                     # ladder
                     self._note_fault("allocate", e)
+                    self._note_device_fault(ssn, e)
                     self._allocate_degraded(ssn)
             METRICS.observe_action(name, time.time() - ta)
         if pipelined:
@@ -478,6 +506,7 @@ class Scheduler:
                 # sync fallback below re-dispatches, and the decisions
                 # chain must stay in device order — then walk the ladder
                 self._note_fault("dispatch", e)
+                self._note_device_fault(ssn, e)
                 if self._ring:
                     self.drain(now=wall)
                 self._allocate_degraded(ssn)
@@ -544,17 +573,84 @@ class Scheduler:
         if self.degradation_level != prev:
             spans.log_event("degradation", level_from=prev,
                             level_to=self.degradation_level,
-                            cycle=self.cycles)
+                            cycle=self.cycles,
+                            mesh_devices=self._last_mesh_devices)
         self._degrade_until = self.cycles + self.fault_cooldown
         METRICS.set_gauge("degradation_level", None, self.degradation_level)
+
+    def _note_device_fault(self, ssn: Session, exc: BaseException) -> None:
+        """Feed a dispatch failure's device attribution (if any) to the
+        health registry: strikes accumulate per device and N-in-a-window
+        quarantines, which halves the serving-width cap and invalidates
+        the mesh cache — the next ``_sharding_mesh()`` call anywhere in
+        the process lands on the shrunk survivor mesh."""
+        if not getattr(self.conf, "sharding", False):
+            return
+        from ..parallel.health import HEALTH, failed_devices
+        if not failed_devices(exc):
+            return
+        width = None
+        try:
+            mesh = ssn._sharding_mesh()
+            width = int(mesh.devices.size) if mesh is not None else None
+        except Exception:
+            pass
+        newly = HEALTH.note_failure(exc, self.cycles, serving_width=width)
+        if newly:
+            METRICS.inc("mesh_shrink_total",
+                        labels={"reason": "quarantine"})
+            spans.log_event("mesh", action="shrink", cycle=self.cycles,
+                            quarantined=list(newly),
+                            width_from=width, width_cap=HEALTH.width_cap,
+                            mesh_devices=self._last_mesh_devices)
+
+    def _try_remesh(self, ssn: Session):
+        """The elastic-mesh rung: if the health registry quarantined
+        devices since we last re-meshed, drop the sharded residency and
+        retry the compiled dispatch — ``_sharding_mesh()`` now resolves
+        to the shrunk mesh over the survivors and the residents re-fuse
+        from source truth on it (the ISSUE 10 recovery primitive, so the
+        retry is decision-neutral by construction). Returns the allocate
+        result, or None when there is nothing to re-mesh (no sharding, no
+        new quarantine) or the shrunk mesh failed too."""
+        if not getattr(self.conf, "sharding", False):
+            return None
+        from ..parallel.health import HEALTH
+        for _ in range(3):          # a flap can kill the shrunk mesh too
+            if HEALTH.generation == self._health_gen_seen:
+                return None
+            self._health_gen_seen = HEALTH.generation
+            t0 = time.time()
+            try:
+                with spans.span("cycle.remesh", cat="recovery"):
+                    ssn.drop_sharded_residency()
+                    result = ssn.run_allocate()
+            except Exception as e:
+                self._note_fault("remesh", e)
+                self._note_device_fault(ssn, e)
+                continue
+            remesh_ms = (time.time() - t0) * 1000
+            ssn.stats["remesh_ms"] = remesh_ms
+            width = ssn.stats.get("mesh_devices")
+            spans.log_event("mesh", action="serve_shrunk",
+                            cycle=self.cycles,
+                            mesh_devices=(int(width) if width is not None
+                                          else None),
+                            remesh_ms=round(remesh_ms, 3))
+            return result
+        return None
 
     def _allocate_degraded(self, ssn: Session) -> None:
         """The compiled allocate dispatch raised: walk the degradation
         ladder — one synchronous retry (a transient fault; the delta path
-        reset itself to a clean full upload), then the pure-host CPU
-        oracle if the accelerator is really gone. Decisions stay
-        bit-identical on every rung (the oracle is the kernel suites'
-        equality reference), so a recovered fault is decision-neutral."""
+        reset itself to a clean full upload), then the elastic-mesh rung
+        (persistent device loss: quarantine the attributed devices,
+        rebuild the mesh at the next pow2 width over the survivors,
+        re-fuse from source truth, serve sharded), then the pure-host CPU
+        oracle if no mesh can serve at all. Decisions stay bit-identical
+        on every rung (the oracle is the kernel suites' equality
+        reference; the shrunk mesh re-fuses from the same source truth),
+        so a recovered fault is decision-neutral."""
         import numpy as np
         t0 = time.time()
         with spans.span("cycle.recovery", cat="recovery"):
@@ -564,9 +660,15 @@ class Scheduler:
                 self._degrade(1)
             except Exception as e:
                 self._note_fault("sync_retry", e)
-                result = ssn.run_allocate_oracle()
-                mode = "cpu_oracle"
-                self._degrade(2)
+                self._note_device_fault(ssn, e)
+                result = self._try_remesh(ssn)
+                if result is not None:
+                    mode = "remesh"
+                    self._degrade(2)
+                else:
+                    result = ssn.run_allocate_oracle()
+                    mode = "cpu_oracle"
+                    self._degrade(3)
         ssn.stats["allocated_binds"] = len(ssn.binds)
         ssn.stats["jobs_ready"] = int(np.asarray(result.job_ready).sum())
         ssn.stats["jobs_pipelined"] = int(
@@ -627,7 +729,7 @@ class Scheduler:
             # STILL raised the cycle is unrecoverable. Keep serving: retire
             # it with no decisions applied instead of crashing the loop.
             self._note_fault("drain", e)
-            self._degrade(2)
+            self._degrade(3)
             self._invalidate_ring()
             METRICS.inc("cycle_dropped_total")
             ssn.stats["cycle_dropped"] = 1.0
@@ -640,7 +742,7 @@ class Scheduler:
             # drop to the matching ladder rung for the cooldown window
             self._note_fault("integrity:" + str(integ.get("reason")),
                              RuntimeError(str(integ.get("mode"))))
-            self._degrade(2 if integ.get("mode") == "cpu_oracle" else 1)
+            self._degrade(3 if integ.get("mode") == "cpu_oracle" else 1)
         if self.cycle_deadline_s is not None \
                 and pending.dispatch_ms / 1000.0 > self.cycle_deadline_s \
                 and not replayed:
@@ -782,6 +884,20 @@ class Scheduler:
         self.cycles += 1
         stats = ssn.stats
         faults, self._cycle_faults = self._cycle_faults, []
+        # mesh width transitions observed at the point of truth (what this
+        # cycle actually served on), for the mesh_width gauge and the
+        # post-mortem JSONL narrative correlating rung changes with
+        # re-meshes
+        if "mesh_devices" in stats:
+            width = int(stats["mesh_devices"])
+            if width != self._last_mesh_devices:
+                METRICS.set_gauge("mesh_width", None, width)
+                if self._last_mesh_devices is not None:
+                    spans.log_event("mesh", action="width_change",
+                                    cycle=self.cycles,
+                                    width_from=self._last_mesh_devices,
+                                    width_to=width)
+                self._last_mesh_devices = width
         self.flight.record(
             now=wall, cycle=self.cycles, cycle_ms=round(host_s * 1000, 3),
             binds=len(ssn.binds), evictions=len(ssn.evictions),
@@ -873,6 +989,12 @@ class Scheduler:
             resync_dead=[dict(e) for e in self.resync.dead],
             metrics=ckpt.metrics_snapshot(),
         )
+        if getattr(self.conf, "sharding", False):
+            # device quarantines and the shrink cap survive a restart: a
+            # restored process must not re-serve on hardware the crashed
+            # one already classified as persistently lost
+            from ..parallel.health import HEALTH
+            state["device_health"] = HEALTH.snapshot()
         return state, mirrors
 
     def restore(self, path: str, now: Optional[float] = None) -> str:
@@ -908,6 +1030,10 @@ class Scheduler:
                                    for e in state["resync_entries"]]
             self.resync.dead = [dict(e) for e in state["resync_dead"]]
             ckpt.merge_metrics(state.get("metrics"))
+            if state.get("device_health"):
+                from ..parallel.health import HEALTH
+                HEALTH.restore(state["device_health"])
+                self._health_gen_seen = HEALTH.generation
             # the next _open_session full-packs from the cluster's live
             # view — re-fuse from truth is the recovery primitive; the
             # checkpointed mirrors make that re-fuse warm (delta, not

@@ -301,6 +301,11 @@ class SchedulerSidecar:
         #: shape+mesh signature -> ShardedDeltaKernel (same residency and
         #: invalidation contract as _delta, per-shard residents)
         self._sharded_delta: Dict[tuple, object] = {}
+        #: elastic-mesh bookkeeping (ISSUE 20): the health-registry
+        #: generation the sharded caches were built under, and the last
+        #: served mesh width (width-change event/gauge edge detector)
+        self._health_gen_seen = 0
+        self._mesh_width_served: Optional[int] = None
         #: shape signature -> DeltaKernel, plus per-kernel ResidentState —
         #: the sidecar owns the returned (donated) buffers; nothing may
         #: re-read a handle after a cycle consumed it (graphcheck donation
@@ -462,12 +467,36 @@ class SchedulerSidecar:
         """The ShardedDeltaKernel serving this snapshot's shape bucket:
         mesh sized per the bucket's node axis (parallel/sharding
         .mesh_for_nodes), NamedShardings threaded through the served
-        cycle with out_shardings == in_shardings across rounds. Caller
-        holds _serve_lock."""
+        cycle with out_shardings == in_shardings across rounds.
+
+        mesh_for_nodes consults the device-health registry (ISSUE 20), so
+        after a quarantine or probation regrow this naturally serves on
+        the survivors' mesh; what does NOT happen naturally is cleanup —
+        kernels and residents compiled for the retired mesh would pin
+        buffers on a quarantined device. On a registry generation change
+        every sharded kernel + residency is pruned (the per-tenant client
+        streams in self._streams keep their epochs: re-meshing is a
+        serving-side detail, decision-neutral by the re-fuse-from-source
+        argument). Caller holds _serve_lock."""
         from ..ops.fused_io import sharded_delta_cycle_cached
+        from ..parallel.health import HEALTH
         from ..parallel.sharding import mesh_for_nodes, node_leaf_mask
+        if self._health_gen_seen != HEALTH.generation:
+            for k in self._sharded_delta.values():
+                self._states.pop(id(k), None)
+            self._sharded_delta.clear()
+            self._health_gen_seen = HEALTH.generation
         n_nodes = int(np.asarray(tree_in[0].nodes.valid).shape[0])
         mesh = mesh_for_nodes(n_nodes, self._sharding_devices)
+        width = int(mesh.devices.size)
+        if width != self._mesh_width_served:
+            if self._mesh_width_served is not None:
+                from ..metrics import METRICS
+                METRICS.set_gauge("mesh_width", None, width)
+                _spans.log_event(
+                    "mesh", source="sidecar", action="width_change",
+                    mesh_devices=width, was=self._mesh_width_served)
+            self._mesh_width_served = width
         return sharded_delta_cycle_cached(
             self._cycle_sharded_factory(mesh), tree_in, mesh,
             node_leaf_mask(tree_in), self._sharded_delta)
